@@ -1,0 +1,19 @@
+"""cpr_tpu — TPU-native framework for specifying, simulating, and attacking
+proof-of-work consensus protocols.
+
+Re-architects the capabilities of the reference (pkel/cpr: OCaml discrete-event
+simulator + OCaml/Rust gym extensions + Python MDP toolbox) for JAX/XLA:
+
+- protocols as pure state-transition functions over fixed-capacity block-DAG
+  tensors (`cpr_tpu.core`, `cpr_tpu.protocols`),
+- selfish-mining attack environments as jittable, `vmap`-batched Monte-Carlo
+  kernels (`cpr_tpu.envs`), exposed through gymnasium,
+- the MDP attack-search stack (implicit->explicit compiler, value iteration,
+  RTDP, policy-guided exploration) with JAX solvers (`cpr_tpu.mdp`),
+- device-mesh parallelism (vmap env batch, pjit data-parallel episodes,
+  sharded value-iteration sweeps) behind `cpr_tpu.parallel`.
+"""
+
+__version__ = "0.1.0"
+
+from cpr_tpu.params import EnvParams  # noqa: F401

@@ -1,0 +1,10 @@
+"""Attack environments (jittable JAX kernels) + gymnasium adapters.
+
+The env contract mirrors the reference engine record
+(reference: simulator/gym/intf.ml:3-13): n_actions, observation bounds,
+create/reset/step, built-in policies — re-shaped as pure functions
+`(state, action) -> (state, obs, reward, done, info)` so that `vmap`
+batches thousands of episodes into one XLA program.
+"""
+
+from cpr_tpu.envs.registry import get, keys, register  # noqa: F401

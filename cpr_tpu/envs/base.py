@@ -1,0 +1,115 @@
+"""Base contract for jittable attack environments.
+
+Reference counterpart: the engine record `{n_actions; observation_length;
+create; reset; step; low; high; policies}` (simulator/gym/intf.ml:3-13) and
+its construction in `Engine.of_module` (simulator/gym/engine.ml:97-273).
+
+TPU re-design: an environment is a pair of pure functions over a PyTree
+state. The state carries its own PRNG key; `step` threads it. Batched
+execution is plain `jax.vmap`; episode loops are `lax.scan`.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from cpr_tpu.params import EnvParams
+
+# info keys mirror the reference step info list (simulator/gym/engine.ml:224-241)
+INFO_KEYS = (
+    "step_reward_attacker",
+    "step_reward_defender",
+    "step_progress",
+    "step_chain_time",
+    "step_sim_time",
+    "episode_reward_attacker",
+    "episode_reward_defender",
+    "episode_progress",
+    "episode_chain_time",
+    "episode_sim_time",
+    "episode_n_steps",
+    "episode_n_activations",
+)
+
+
+class JaxEnv:
+    """Abstract jittable environment.
+
+    Subclasses define:
+      n_actions: int
+      obs_fields: tuple[obs.Field, ...]
+      reset(key, params) -> (state, obs)
+      step(state, action, params) -> (state, obs, reward, done, info)
+      policies: dict[str, Callable[obs -> action]]   (jittable)
+    """
+
+    n_actions: int
+    observation_length: int
+    policies: dict[str, Callable]
+
+    def reset(self, key: jax.Array, params: EnvParams):
+        raise NotImplementedError
+
+    def step(self, state, action, params: EnvParams):
+        raise NotImplementedError
+
+    # -- batched rollout helpers ------------------------------------------
+
+    @partial(jax.jit, static_argnums=(0, 3, 4))
+    def rollout(self, key: jax.Array, params: EnvParams, policy: Callable, n_steps: int):
+        """Run one auto-resetting episode stream for `n_steps` env steps.
+
+        Returns per-step (obs, action, reward, done, info) stacked over time.
+        vmap over `key` (and optionally `params`) for batching.
+        """
+        key, k0 = jax.random.split(key)
+        state, obs = self.reset(k0, params)
+
+        def body(carry, _):
+            state, obs = carry
+            action = policy(obs)
+            state, obs2, reward, done, info = self.step(state, action, params)
+            # auto-reset, keeping the state PRNG stream
+            rkey = state.key
+            rstate, robs = self.reset(rkey, params)
+            state = jax.tree.map(
+                lambda a, b: jnp.where(done, a, b), rstate, state
+            )
+            obs_next = jnp.where(done, robs, obs2)
+            return (state, obs_next), (obs, action, reward, done, info)
+
+        (state, obs), traj = jax.lax.scan(body, (state, obs), None, length=n_steps)
+        return traj
+
+    def episode_stats(self, key, params, policy, n_steps: int):
+        """Final-info aggregation over completed episodes in a rollout."""
+        obs, action, reward, done, info = self.rollout(key, params, policy, n_steps)
+        n_done = jnp.maximum(done.sum(), 1)
+        stats = {
+            k: jnp.where(done, v, 0.0).sum() / n_done
+            for k, v in info.items()
+            if k.startswith("episode_")
+        }
+        stats["n_episodes"] = done.sum()
+        return stats
+
+
+def relative_reward(info: dict[str, Any]) -> jax.Array:
+    """attacker / (attacker + defender) at episode end
+    (reference: gym/ocaml/cpr_gym/wrappers.py:8-26)."""
+    a = info["episode_reward_attacker"]
+    d = info["episode_reward_defender"]
+    s = a + d
+    return jnp.where(s != 0, a / jnp.where(s != 0, s, 1.0), 0.0)
+
+
+def reward_per_progress(info: dict[str, Any]) -> jax.Array:
+    """attacker / progress at episode end
+    (reference: gym/ocaml/cpr_gym/wrappers.py:29-51)."""
+    a = info["episode_reward_attacker"]
+    p = info["episode_progress"]
+    return jnp.where(p != 0, a / jnp.where(p != 0, p, 1.0), 0.0)

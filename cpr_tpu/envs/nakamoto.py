@@ -1,0 +1,317 @@
+"""Nakamoto consensus under the SSZ'16 selfish-mining attack space — as a
+closed-form, fully jittable JAX environment.
+
+Reference counterparts:
+- protocol: simulator/protocols/nakamoto.ml (longest chain, reward 1/block)
+- attack space: simulator/protocols/nakamoto_ssz.ml (Observation
+  {public_blocks, private_blocks, diff_blocks, event}, Actions
+  Adopt|Override|Match|Wait, built-in policies honest/simple/
+  eyal-sirer-2014/sapirshtein-2016-sm1)
+- gym engine semantics: simulator/gym/engine.ml:97-273 (selfish-mining
+  network with ~zero propagation delay, defender cloud, gamma emulated by
+  uniform attacker message delays, network.ml:61-105)
+- the same collapse to (a, h, fork) appears in the reference's Rust gym
+  (gym/rust/src/fc16.rs:28-139).
+
+TPU re-design: because `Engine.of_module` reduces the simulation to a
+2-party attacker-vs-defender-cloud game whose only decision points are
+attacker interactions, one env step == one action + one Bernoulli(alpha)
+mining draw (+ one Bernoulli(gamma) communication draw when a match race is
+live). State is a handful of scalars; `vmap` packs millions of episodes
+into one XLA kernel. Rewards/progress on the common chain are accumulated
+incrementally, mirroring the reference's accumulation along `precursor`
+(simulator/lib/simulator.ml:377-388); the step reward is the delta of the
+attacker's accumulated reward at the winner head (engine.ml:196-249).
+
+Known deviations from the reference's event-queue semantics (documented):
+- `chain_time` tracks the mining time of the current chain tips, not every
+  block's first-visibility timestamp (info metric only).
+- Adopt while a match race is live drops the race (the reference's split
+  defenders could still extend the attacker's release; its own fc16 model
+  makes the same simplification, gym/rust/src/fc16.rs:132-138).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from cpr_tpu import obs as obslib
+from cpr_tpu.envs.base import JaxEnv
+from cpr_tpu.params import EnvParams
+
+# action encoding mirrors Variants.to_rank order (nakamoto_ssz.ml:116-154)
+ADOPT, OVERRIDE, MATCH, WAIT = 0, 1, 2, 3
+
+# event encoding mirrors Discrete [`ProofOfWork; `Network] (nakamoto_ssz.ml:38)
+EV_POW, EV_NETWORK = 0, 1
+
+OBS_FIELDS = (
+    obslib.Field("public_blocks", obslib.UINT, scale=1),
+    obslib.Field("private_blocks", obslib.UINT, scale=1),
+    obslib.Field("diff_blocks", obslib.INT, scale=1),
+    obslib.Field("event", obslib.DISCRETE, n=2),
+)
+
+
+@struct.dataclass
+class State:
+    # fork state relative to the common ancestor
+    a: jnp.ndarray  # private (attacker) blocks after common ancestor
+    h: jnp.ndarray  # public (defender) blocks after common ancestor
+    event: jnp.ndarray  # EV_POW | EV_NETWORK, what triggered this interaction
+    match_h: jnp.ndarray  # height of live match race (-1: none)
+    # common-chain accumulators (precursor-accumulation, simulator.ml:377-388)
+    ca_atk: jnp.ndarray
+    ca_def: jnp.ndarray
+    ca_progress: jnp.ndarray
+    # clocks
+    time: jnp.ndarray
+    t_priv: jnp.ndarray  # mining time of private tip
+    t_pub: jnp.ndarray  # mining time of public tip
+    # episode bookkeeping (engine.ml:69-79)
+    steps: jnp.ndarray
+    n_activations: jnp.ndarray
+    last_reward_attacker: jnp.ndarray
+    last_reward_defender: jnp.ndarray
+    last_progress: jnp.ndarray
+    last_chain_time: jnp.ndarray
+    last_sim_time: jnp.ndarray
+    key: jax.Array
+
+
+class NakamotoSSZ(JaxEnv):
+    """cpr-nakamoto SSZ attack env, one step per attacker interaction."""
+
+    n_actions = 4
+    obs_fields = OBS_FIELDS
+    observation_length = len(OBS_FIELDS)
+
+    def __init__(self, unit_observation: bool = True, strict_match: bool = True):
+        # strict_match=True reproduces the reference event-queue network:
+        # a Match only splits the defenders when applied at the interaction
+        # where the competing defender block just arrived (the propagation
+        # race window, network.ml:61-105). strict_match=False reproduces the
+        # SSZ'16 MDP convention (gym/rust/src/fc16.rs:104-115) where a match
+        # race stays live across Wait actions.
+        self.unit_observation = unit_observation
+        self.strict_match = strict_match
+        self.low, self.high = obslib.low_high(OBS_FIELDS, unit_observation)
+        # built once: policy identity is the jit cache key for rollout
+        self.policies = self._make_policies()
+
+    # -- observation ------------------------------------------------------
+
+    def observe(self, state: State):
+        """nakamoto_ssz.ml:220-230."""
+        return obslib.encode(
+            OBS_FIELDS,
+            (state.h, state.a, state.a - state.h, state.event),
+            self.unit_observation,
+        )
+
+    def decode_obs(self, obs):
+        """float observation -> (public, private, diff, event), natural scale."""
+        vals = [
+            obslib.field_of_float(f, obs[..., i], self.unit_observation)
+            for i, f in enumerate(OBS_FIELDS)
+        ]
+        return tuple(jnp.asarray(v, jnp.int32) for v in vals)
+
+    # -- dynamics ---------------------------------------------------------
+
+    def _mine(self, state: State, params: EnvParams) -> State:
+        """One activation: Bernoulli(alpha) miner choice plus the gamma
+        communication race (engine.ml:108-121 fast-forward collapsed to one
+        draw; simulator.ml:465-472 PoW clock)."""
+        key, k_dt, k_mine, k_gamma = jax.random.split(state.key, 4)
+        dt = jax.random.exponential(k_dt) * params.activation_delay
+        time = state.time + dt
+        attacker_mines = jax.random.uniform(k_mine) < params.alpha
+        gamma_hit = jax.random.uniform(k_gamma) < params.gamma
+
+        # attacker branch: extend private chain
+        a_att = state.a + 1
+
+        # defender branch: extend public chain; if a match race is live at
+        # the public tip, a gamma share of defender compute mines on the
+        # attacker's released block instead (network.ml:61-105)
+        on_split = (state.match_h >= 0) & (state.match_h == state.h)
+        def_on_attacker = on_split & gamma_hit
+        # gamma success: common ancestor jumps to the released block; the
+        # new defender block sits on top of h released attacker blocks
+        ca_atk_d = state.ca_atk + jnp.where(def_on_attacker, state.h, 0).astype(jnp.float32)
+        ca_prog_d = state.ca_progress + jnp.where(def_on_attacker, state.h, 0).astype(jnp.float32)
+        a_def = jnp.where(def_on_attacker, state.a - state.h, state.a)
+        h_def = jnp.where(def_on_attacker, 1, state.h + 1)
+
+        return state.replace(
+            a=jnp.where(attacker_mines, a_att, a_def),
+            h=jnp.where(attacker_mines, state.h, h_def),
+            ca_atk=jnp.where(attacker_mines, state.ca_atk, ca_atk_d),
+            ca_progress=jnp.where(attacker_mines, state.ca_progress, ca_prog_d),
+            match_h=jnp.where(attacker_mines, state.match_h, -1),
+            event=jnp.where(attacker_mines, EV_POW, EV_NETWORK),
+            time=time,
+            t_priv=jnp.where(attacker_mines, time, state.t_priv),
+            t_pub=jnp.where(attacker_mines, state.t_pub, time),
+            n_activations=state.n_activations + 1,
+            key=key,
+        )
+
+    def reset(self, key: jax.Array, params: EnvParams):
+        z = jnp.int32(0)
+        f = jnp.float32(0.0)
+        state = State(
+            a=z, h=z, event=jnp.int32(EV_POW), match_h=jnp.int32(-1),
+            ca_atk=f, ca_def=f, ca_progress=f,
+            time=f, t_priv=f, t_pub=f,
+            steps=z, n_activations=z,
+            last_reward_attacker=f, last_reward_defender=f,
+            last_progress=f, last_chain_time=f, last_sim_time=f,
+            key=key,
+        )
+        # the reference fast-forwards to the first attacker interaction at
+        # env construction (engine.ml:137-141): one mining draw
+        state = self._mine(state, params)
+        return state, self.observe(state)
+
+    def _apply(self, state: State, action) -> State:
+        """Apply the agent action (nakamoto_ssz.ml:232-259)."""
+        a, h = state.a, state.h
+
+        # Adopt: private <- public; h defender blocks join the common chain
+        adopt = action == ADOPT
+        # Override: release block at height h+1; effective iff a > h
+        # (otherwise only the private head is released, which the public
+        # ignores: update_head requires strictly larger height,
+        # nakamoto.ml:85-89)
+        override_eff = (action == OVERRIDE) & (a > h)
+        # Match: release block at height h; forms a live race iff the
+        # attacker has a block at that height and (strict mode) the
+        # competing defender block just arrived
+        match_eff = (action == MATCH) & (a >= h) & (h > 0)
+        if self.strict_match:
+            match_eff = match_eff & (state.event == EV_NETWORK)
+
+        ca_atk = state.ca_atk + jnp.where(override_eff, h + 1, 0).astype(jnp.float32)
+        ca_def = state.ca_def + jnp.where(adopt, h, 0).astype(jnp.float32)
+        ca_progress = (
+            state.ca_progress
+            + jnp.where(adopt, h, 0).astype(jnp.float32)
+            + jnp.where(override_eff, h + 1, 0).astype(jnp.float32)
+        )
+        new_a = jnp.where(adopt, 0, jnp.where(override_eff, a - (h + 1), a))
+        new_h = jnp.where(adopt | override_eff, 0, h)
+        match_h = jnp.where(
+            match_eff, h, jnp.where(adopt | override_eff, -1, state.match_h)
+        )
+        t_priv = jnp.where(adopt, state.t_pub, state.t_priv)
+        # after an effective override the public tip is the released
+        # attacker block (approximated by the private tip's mining time)
+        t_pub = jnp.where(override_eff, state.t_priv, state.t_pub)
+        return state.replace(
+            a=new_a, h=new_h, ca_atk=ca_atk, ca_def=ca_def,
+            ca_progress=ca_progress, match_h=match_h,
+            t_priv=t_priv, t_pub=t_pub,
+        )
+
+    def step(self, state: State, action, params: EnvParams):
+        """engine.ml:176-249: apply action, fast-forward to the next
+        attacker interaction, compute winner head, rewards, termination."""
+        state = self._apply(state, action)
+        state = self._mine(state, params)
+        state = state.replace(steps=state.steps + 1)
+
+        # winner over node preferences; ties go to the attacker because it
+        # is node 0 in the fold (engine.ml:196-206, nakamoto.ml:43-48)
+        head_private = state.a >= state.h
+        reward_attacker = state.ca_atk + jnp.where(head_private, state.a, 0).astype(jnp.float32)
+        reward_defender = state.ca_def + jnp.where(head_private, 0, state.h).astype(jnp.float32)
+        progress = state.ca_progress + jnp.maximum(state.a, state.h).astype(jnp.float32)
+        chain_time = jnp.where(head_private, state.t_priv, state.t_pub)
+
+        done = ~(
+            (state.steps < params.max_steps)
+            & (progress < params.max_progress)
+            & (state.time < params.max_time)
+        )
+
+        reward = reward_attacker - state.last_reward_attacker
+        info = {
+            "step_reward_attacker": reward,
+            "step_reward_defender": reward_defender - state.last_reward_defender,
+            "step_progress": progress - state.last_progress,
+            "step_chain_time": chain_time - state.last_chain_time,
+            "step_sim_time": state.time - state.last_sim_time,
+            "episode_reward_attacker": reward_attacker,
+            "episode_reward_defender": reward_defender,
+            "episode_progress": progress,
+            "episode_chain_time": chain_time,
+            "episode_sim_time": state.time,
+            "episode_n_steps": state.steps.astype(jnp.float32),
+            "episode_n_activations": state.n_activations.astype(jnp.float32),
+        }
+        state = state.replace(
+            last_reward_attacker=reward_attacker,
+            last_reward_defender=reward_defender,
+            last_progress=progress,
+            last_chain_time=chain_time,
+            last_sim_time=state.time,
+        )
+        return state, self.observe(state), reward, done, info
+
+    # -- built-in policies (nakamoto_ssz.ml:274-350) ----------------------
+
+    def _policy(self, fn):
+        def wrapped(obs):
+            h, a, _, event = self.decode_obs(obs)
+            return fn(a, h, event)
+        return wrapped
+
+    def _make_policies(self):
+        def honest(a, h, event):
+            return jnp.where(a > h, OVERRIDE, jnp.where(a < h, ADOPT, WAIT))
+
+        def simple(a, h, event):
+            return jnp.where(h > 0, jnp.where(a < h, ADOPT, OVERRIDE), WAIT)
+
+        def es_2014(a, h, event):
+            # Eyal & Sirer 2014 (nakamoto_ssz.ml:294-321)
+            return jnp.where(
+                a < h, ADOPT,
+                jnp.where(
+                    (h == 0) & (a == 1), WAIT,
+                    jnp.where(
+                        (h == 1) & (a == 1), MATCH,
+                        jnp.where(
+                            (h == 1) & (a == 2), OVERRIDE,
+                            jnp.where(
+                                h > 0,
+                                jnp.where(a - h == 1, OVERRIDE, MATCH),
+                                WAIT,
+                            ),
+                        ),
+                    ),
+                ),
+            )
+
+        def sm1(a, h, event):
+            # Sapirshtein et al. 2016, SM1 (nakamoto_ssz.ml:325-339)
+            return jnp.where(
+                h > a, ADOPT,
+                jnp.where(
+                    (h == 1) & (a == 1), MATCH,
+                    jnp.where((h == a - 1) & (h >= 1), OVERRIDE, WAIT),
+                ),
+            )
+
+        return {
+            "honest": self._policy(honest),
+            "simple": self._policy(simple),
+            "eyal-sirer-2014": self._policy(es_2014),
+            "sapirshtein-2016-sm1": self._policy(sm1),
+        }

@@ -1,0 +1,50 @@
+"""Keyed environment registry.
+
+Reference counterpart: the protocol/attack-space registry and string keys
+(simulator/protocols/cpr_protocols.ml:11-180,786-903) plus the gym env ids
+registered in gym/ocaml/cpr_gym/envs.py:166-192.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+_REGISTRY: dict[str, Callable] = {}
+
+
+def register(key: str, factory: Callable):
+    _ensure_builtin()
+    if key in _REGISTRY:
+        raise ValueError(f"duplicate env key: {key}")
+    _REGISTRY[key] = factory
+
+
+def get(key: str, **kwargs):
+    """Instantiate the env registered under `key`."""
+    _ensure_builtin()
+    try:
+        factory = _REGISTRY[key]
+    except KeyError:
+        raise KeyError(
+            f"unknown env '{key}'; choose from {sorted(_REGISTRY)}"
+        ) from None
+    return factory(**kwargs)
+
+
+def keys():
+    _ensure_builtin()
+    return sorted(_REGISTRY)
+
+
+_BUILTIN_LOADED = False
+
+
+def _ensure_builtin():
+    global _BUILTIN_LOADED
+    if _BUILTIN_LOADED:
+        return
+    from cpr_tpu.envs.nakamoto import NakamotoSSZ
+
+    _BUILTIN_LOADED = True
+    if "nakamoto" not in _REGISTRY:
+        _REGISTRY["nakamoto"] = NakamotoSSZ

@@ -1,0 +1,94 @@
+"""Observation field normalization.
+
+JAX re-design of the reference observation normalizers
+(reference: simulator/protocols/ssz_tools.ml:1-74 `NormalizeObs`):
+
+- raw mode keeps the natural scale of each field,
+- unit mode squashes each field into [0, 1]: unbounded non-negative ints via
+  2/pi * atan(x / scale), signed ints via 0.5 + atan(x / scale)/pi, discrete
+  fields via i/(n-1).
+
+Where the reference builds per-record normalizers with ppx-derived field
+folds, here an observation is declared as a tuple of `Field` specs and
+encoded with one vectorized `encode` that jit/vmap compile away.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+import numpy as np
+
+BOOL = "bool"
+DISCRETE = "discrete"
+UINT = "uint"  # unbounded non-negative int
+INT = "int"  # unbounded signed int
+
+
+@dataclass(frozen=True)
+class Field:
+    name: str
+    kind: str = UINT
+    scale: int = 1  # atan squash scale for uint/int
+    n: int = 2  # number of values for discrete
+
+
+def field_to_float(field: Field, x, unit: bool):
+    """Encode one field value as float (ssz_tools.ml:11-40)."""
+    x = jnp.asarray(x, jnp.float32)
+    if not unit:
+        return x
+    if field.kind == BOOL:
+        return x
+    if field.kind == DISCRETE:
+        return x / jnp.float32(field.n - 1)
+    if field.kind == UINT:
+        return 2.0 / jnp.pi * jnp.arctan(x / field.scale)
+    if field.kind == INT:
+        return 0.5 + jnp.arctan(x / field.scale) / jnp.pi
+    raise ValueError(field.kind)
+
+
+def field_of_float(field: Field, v, unit: bool):
+    """Decode one float back into the field's natural scale (ssz_tools.ml:20-59)."""
+    v = jnp.asarray(v, jnp.float32)
+    if not unit:
+        return jnp.round(v) if field.kind != BOOL else v >= 0.5
+    if field.kind == BOOL:
+        return v >= 0.5
+    if field.kind == DISCRETE:
+        return jnp.floor(v * (field.n - 1))
+    if field.kind == UINT:
+        return jnp.round(jnp.tan(jnp.pi / 2.0 * v) * field.scale)
+    if field.kind == INT:
+        return jnp.round(jnp.tan(jnp.pi * (v - 0.5)) * field.scale)
+    raise ValueError(field.kind)
+
+
+def encode(fields: tuple[Field, ...], values, unit: bool):
+    """Encode a tuple of natural-scale values into a float observation vector."""
+    assert len(fields) == len(values)
+    return jnp.stack(
+        [field_to_float(f, v, unit) for f, v in zip(fields, values)], axis=-1
+    )
+
+
+def low_high(fields: tuple[Field, ...], unit: bool):
+    """Observation-space bounds (ssz_tools.ml:64-73)."""
+    low = np.zeros(len(fields), dtype=np.float32)
+    high = np.zeros(len(fields), dtype=np.float32)
+    for i, f in enumerate(fields):
+        if unit:
+            low[i], high[i] = 0.0, 1.0
+        elif f.kind == BOOL:
+            low[i], high[i] = 0.0, 1.0
+        elif f.kind == DISCRETE:
+            low[i], high[i] = 0.0, float(f.n - 1)
+        elif f.kind == UINT:
+            low[i], high[i] = 0.0, np.inf
+        elif f.kind == INT:
+            low[i], high[i] = -np.inf, np.inf
+        else:
+            raise ValueError(f.kind)
+    return low, high

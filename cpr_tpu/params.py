@@ -1,0 +1,104 @@
+"""Environment parameters.
+
+Mirror of the reference gym parameter record and its validation
+(reference: simulator/gym/engine.ml:5-52) plus the defender-count derivation
+from gamma (reference: gym/ocaml/cpr_gym/envs.py:70-82).
+
+Unlike the reference (which validates once at env construction), parameters
+here are a JAX PyTree so that batched environments can sweep (alpha, gamma)
+grids inside one compiled kernel (`vmap` over EnvParams leaves).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+from flax import struct
+
+
+class ParameterError(ValueError):
+    pass
+
+
+@struct.dataclass
+class EnvParams:
+    """Selfish-mining environment parameters.
+
+    alpha: attacker share of compute, 0 <= alpha <= 1.
+    gamma: attacker network advantage, 0 <= gamma < 1. When the attacker
+        matches a freshly arrived defender block, a `gamma` fraction of
+        defender compute mines on the attacker's release.
+    defenders: number of defender nodes the reference would instantiate;
+        kept for parity of the derived quantities, the collapsed JAX engine
+        models the defenders as one cloud (reference: simulator/gym/engine.ml:100-107
+        uses near-zero propagation delay, which makes the cloud exact).
+    activation_delay: mean time between puzzle solutions (difficulty).
+    max_steps / max_progress / max_time: episode termination criteria
+        (reference: simulator/gym/engine.ml:209-214).
+    """
+
+    alpha: jnp.ndarray  # float
+    gamma: jnp.ndarray  # float
+    defenders: jnp.ndarray  # int
+    activation_delay: jnp.ndarray  # float
+    max_steps: jnp.ndarray  # int
+    max_progress: jnp.ndarray  # float
+    max_time: jnp.ndarray  # float
+
+
+def make_params(
+    *,
+    alpha: float,
+    gamma: float,
+    defenders: int | None = None,
+    activation_delay: float = 1.0,
+    max_steps: int | None = None,
+    max_progress: float | None = None,
+    max_time: float | None = None,
+) -> EnvParams:
+    """Validate and build EnvParams.
+
+    Validation mirrors reference simulator/gym/engine.ml:37-51; the
+    defenders-from-gamma rule mirrors gym/ocaml/cpr_gym/envs.py:70-82.
+    """
+    if math.isnan(activation_delay):
+        raise ParameterError("activation_delay cannot be NaN")
+    if math.isnan(alpha):
+        raise ParameterError("alpha cannot be NaN")
+    if math.isnan(gamma):
+        raise ParameterError("gamma cannot be NaN")
+    if alpha < 0.0 or alpha > 1.0:
+        raise ParameterError("alpha < 0 || alpha > 1")
+    if gamma < 0.0 or gamma > 1.0:
+        raise ParameterError("gamma < 0 || gamma > 1")
+    if activation_delay <= 0.0:
+        raise ParameterError("activation_delay <= 0")
+    if max_steps is None and max_progress is None and max_time is None:
+        raise ParameterError(
+            "set at least one of max_steps, max_progress, max_time"
+        )
+    if defenders is None:
+        if gamma >= 1.0:
+            raise ParameterError("gamma must be smaller than 1")
+        defenders = max(2, int(math.ceil(1.0 / (1.0 - gamma))))
+    if defenders < 1:
+        raise ParameterError("defenders < 1")
+    max_steps = max_steps if max_steps is not None else (1 << 30)
+    max_progress = max_progress if max_progress is not None else float("inf")
+    max_time = max_time if max_time is not None else float("inf")
+    if max_steps <= 0:
+        raise ParameterError("max_steps <= 0")
+    if max_progress <= 0.0:
+        raise ParameterError("max_progress <= 0")
+    if max_time <= 0.0:
+        raise ParameterError("max_time <= 0")
+    return EnvParams(
+        alpha=jnp.float32(alpha),
+        gamma=jnp.float32(gamma),
+        defenders=jnp.int32(defenders),
+        activation_delay=jnp.float32(activation_delay),
+        max_steps=jnp.int32(max_steps),
+        max_progress=jnp.float32(max_progress),
+        max_time=jnp.float32(max_time),
+    )
