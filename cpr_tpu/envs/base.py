@@ -69,9 +69,15 @@ class JaxEnv:
         key, k0 = jax.random.split(key)
         state, obs = self.reset(k0, params)
 
+        takes_state = getattr(policy, "takes_state", False)
+
         def body(carry, _):
             state, obs = carry
-            action = policy(obs)
+            # policies normally see the observation (engine.ml:258-261);
+            # policies with `takes_state = True` get the full env state
+            # (used to execute MDP-solver policies that need e.g. the fork
+            # relevance flag, which the observation does not expose)
+            action = policy(state, obs) if takes_state else policy(obs)
             state, obs2, reward, done, info = self.step(state, action, params)
             # auto-reset, keeping the state PRNG stream
             rkey = state.key

@@ -1,0 +1,16 @@
+"""MDP attack-search toolbox.
+
+Reference counterpart: mdp/lib/ (implicit model interface, exhaustive
+compiler, explicit MDP + solvers, RTDP, policy-guided exploration).
+
+TPU re-design: the compiler emits flat transition arrays (COO triples +
+per-(state,action) segments) instead of nested Python lists, and the
+solvers (value iteration, policy evaluation) are jitted segment-sum sweeps
+that run on TPU — optionally sharded over a device mesh
+(`cpr_tpu.parallel`). Host-side pieces (BFS exploration, steady-state
+sparse solves) stay on CPU like the reference.
+"""
+
+from cpr_tpu.mdp.implicit import Effect, Model, PTOWrapper, Transition  # noqa: F401
+from cpr_tpu.mdp.compiler import Compiler  # noqa: F401
+from cpr_tpu.mdp.explicit import MDP, TensorMDP, ptmdp  # noqa: F401

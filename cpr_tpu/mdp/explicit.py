@@ -1,0 +1,427 @@
+"""Explicit (tabular) MDPs as flat transition arrays + JAX solvers.
+
+Reference counterpart: mdp/lib/explicit_mdp.py — `MDP` with nested
+`tab[state][action] -> [Transition]` lists, a single-threaded Python value
+iteration (:97-177), reachable-state search (:179), markov-chain extraction
+and steady state via scipy sparse (:210-326), and policy evaluation (:328).
+
+TPU re-design: transitions live in flat COO arrays (src, act, dst, prob,
+reward, progress). Value iteration and policy evaluation become jitted
+`segment_sum` sweeps under `lax.while_loop` — one dense Bellman backup is
+two gathers, one multiply-add, and one segmented reduction, which XLA maps
+onto the VPU; the sweep can be sharded over a device mesh by partitioning
+the transition arrays (see cpr_tpu.parallel.sharded_value_iteration).
+Host-side pieces (builder, invariant check, steady-state sparse solve)
+remain numpy/scipy, like the reference.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from dataclasses import dataclass, field
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import scipy.sparse
+import scipy.sparse.linalg
+
+
+def sum_to_one(xs) -> bool:
+    return math.isclose(sum(xs), 1.0, rel_tol=1e-9)
+
+
+@dataclass
+class MDP:
+    """Host-side MDP builder with flat transition storage.
+
+    Action ids are positional per state (the compiler enumerates each
+    state's available actions in order), matching the reference compiler
+    convention (mdp/lib/compiler.py:49-54).
+    """
+
+    n_states: int = 0
+    n_actions: int = 0
+    start: dict[int, float] = field(default_factory=dict)
+    src: list[int] = field(default_factory=list)
+    act: list[int] = field(default_factory=list)
+    dst: list[int] = field(default_factory=list)
+    prob: list[float] = field(default_factory=list)
+    reward: list[float] = field(default_factory=list)
+    progress: list[float] = field(default_factory=list)
+
+    @property
+    def n_transitions(self) -> int:
+        return len(self.src)
+
+    def __repr__(self):
+        s, a, t = self.n_states, self.n_actions, self.n_transitions
+        per = t / s if s else 0.0
+        return f"MDP of size {s} / {a} / {t} / {per:.1f}"
+
+    def add_transition(self, src: int, act: int, dst: int, *, probability: float,
+                       reward: float, progress: float):
+        assert src >= 0 and dst >= 0 and act >= 0
+        self.n_states = max(self.n_states, src + 1, dst + 1)
+        self.n_actions = max(self.n_actions, act + 1)
+        self.src.append(src)
+        self.act.append(act)
+        self.dst.append(dst)
+        self.prob.append(probability)
+        self.reward.append(reward)
+        self.progress.append(progress)
+
+    def arrays(self):
+        return (
+            np.asarray(self.src, np.int32),
+            np.asarray(self.act, np.int32),
+            np.asarray(self.dst, np.int32),
+            np.asarray(self.prob, np.float64),
+            np.asarray(self.reward, np.float64),
+            np.asarray(self.progress, np.float64),
+        )
+
+    def check(self) -> bool:
+        """Invariant check (mirrors mdp/lib/explicit_mdp.py:63-95):
+        start distribution sums to one, per-(state,action) outgoing
+        probabilities sum to one, actions are contiguous per state."""
+        src, act, dst, prob, _, _ = self.arrays()
+        assert sum_to_one(self.start.values())
+        for s in self.start:
+            assert 0 <= s < self.n_states
+        key = src.astype(np.int64) * self.n_actions + act
+        sums = np.zeros(self.n_states * self.n_actions)
+        np.add.at(sums, key, prob)
+        present = np.zeros(self.n_states * self.n_actions, dtype=bool)
+        present[key] = True
+        bad = present & ~np.isclose(sums, 1.0, rtol=1e-9)
+        assert not bad.any(), f"probabilities do not sum to 1 at {np.where(bad)[0]}"
+        # action contiguity per state: if action k present, all j<k present
+        # == row-wise monotone decreasing presence
+        pres = present.reshape(self.n_states, self.n_actions)
+        assert (pres[:, :-1] | ~pres[:, 1:]).all(), "non-contiguous actions"
+        assert dst.max(initial=-1) < self.n_states
+        return True
+
+    def tensor(self, dtype=jnp.float32) -> "TensorMDP":
+        src, act, dst, prob, reward, progress = self.arrays()
+        start = np.zeros(self.n_states, dtype=np.float64)
+        for s, p in self.start.items():
+            start[s] = p
+        return TensorMDP(
+            n_states=self.n_states,
+            n_actions=self.n_actions,
+            src=jnp.asarray(src),
+            act=jnp.asarray(act),
+            dst=jnp.asarray(dst),
+            prob=jnp.asarray(prob, dtype),
+            reward=jnp.asarray(reward, dtype),
+            progress=jnp.asarray(progress, dtype),
+            start=jnp.asarray(start, dtype),
+        )
+
+
+def ptmdp(old: MDP, *, horizon: int) -> MDP:
+    """Explicit-level probabilistic-termination transform.
+
+    Adds one terminal state and splits every progress-making transition
+    into continue/terminate branches with continue probability
+    (1 - 1/horizon)^progress (reference: mdp/lib/models/aft20barzur.py:244-304).
+    """
+    assert horizon > 0
+    terminal = old.n_states
+    new = MDP(n_states=old.n_states + 1, n_actions=old.n_actions,
+              start=dict(old.start))
+    keep_base = 1.0 - 1.0 / horizon
+    for i in range(old.n_transitions):
+        s, a, d = old.src[i], old.act[i], old.dst[i]
+        p, r, g = old.prob[i], old.reward[i], old.progress[i]
+        if g == 0.0:
+            new.add_transition(s, a, d, probability=p, reward=r, progress=g)
+        else:
+            keep = keep_base**g
+            new.add_transition(s, a, terminal, probability=p * (1.0 - keep),
+                               reward=0.0, progress=0.0)
+            new.add_transition(s, a, d, probability=p * keep, reward=r,
+                               progress=g)
+    new.n_states = max(new.n_states, terminal + 1)
+    return new
+
+
+def _greedy_backup(qv, qp, valid, any_valid):
+    """Masked argmax backup: ties to lowest action id; action-less states
+    get value 0 / policy -1 (mdp/lib/explicit_mdp.py:123-146)."""
+    S = qv.shape[0]
+    qv_masked = jnp.where(valid, qv, -jnp.inf)
+    best_a = jnp.argmax(qv_masked, axis=1)
+    best_v = jnp.where(any_valid, qv_masked[jnp.arange(S), best_a], 0.0)
+    best_p = jnp.where(any_valid, qp[jnp.arange(S), best_a], 0.0)
+    policy = jnp.where(any_valid, best_a, -1)
+    return best_v, best_p, policy
+
+
+def make_vi_sweep(S: int, A: int, reduce=lambda x: x):
+    """Build one Bellman sweep over flat COO transitions. `reduce` hooks a
+    cross-device reduction (psum) in for transition-sharded sweeps
+    (cpr_tpu.parallel.sharded_value_iteration)."""
+
+    def sweep(src, act, dst, prob, reward, progress, valid, any_valid,
+              discount, value, prog):
+        seg = src * jnp.int32(A) + act
+        qv = reduce(jax.ops.segment_sum(
+            prob * (reward + discount * value[dst]), seg,
+            num_segments=S * A)).reshape(S, A)
+        qp = reduce(jax.ops.segment_sum(
+            prob * (progress + discount * prog[dst]), seg,
+            num_segments=S * A)).reshape(S, A)
+        return _greedy_backup(qv, qp, valid, any_valid)
+
+    return sweep
+
+
+def _valid_actions(src, act, prob, S: int, A: int, reduce=lambda x: x):
+    seg = src * jnp.int32(A) + act
+    counts = reduce(jax.ops.segment_sum(
+        jnp.ones_like(prob), seg, num_segments=S * A))
+    valid = (counts > 0).reshape(S, A)
+    return valid, valid.any(axis=1)
+
+
+@partial(jax.jit, static_argnums=(6, 7, 10))
+def _vi_loop(src, act, dst, prob, reward, progress, S, A, discount,
+             stop_delta, max_iter):
+    sweep = make_vi_sweep(S, A)
+    valid, any_valid = _valid_actions(src, act, prob, S, A)
+
+    def run(value, prog):
+        return sweep(src, act, dst, prob, reward, progress, valid, any_valid,
+                     discount, value, prog)
+
+    def cond(carry):
+        _, _, _, delta, i = carry
+        return (delta > stop_delta) & (i < max_iter)
+
+    def body(carry):
+        value, prog, _, _, i = carry
+        v2, p2, pol = run(value, prog)
+        return v2, p2, pol, jnp.abs(v2 - value).max(), i + 1
+
+    z = jnp.zeros(S, prob.dtype)
+    v, p, pol = run(z, z)
+    delta = jnp.abs(v - z).max()
+    return jax.lax.while_loop(cond, body, (v, p, pol, delta, 1))
+
+
+@partial(jax.jit, static_argnums=(6, 9))
+def _pe_loop(src, dst, prob, reward, progress, onpolicy, S, discount, theta,
+             max_iter):
+    w = jnp.where(onpolicy, prob, 0.0)
+
+    def cond(carry):
+        _, _, delta, i = carry
+        return (delta > theta) & (i < max_iter)
+
+    def body(carry):
+        rew, prg, _, i = carry
+        r2 = jax.ops.segment_sum(
+            w * (reward + discount * rew[dst]), src, num_segments=S)
+        p2 = jax.ops.segment_sum(
+            w * (progress + discount * prg[dst]), src, num_segments=S)
+        return r2, p2, jnp.abs(r2 - rew).max(), i + 1
+
+    z = jnp.zeros(S, prob.dtype)
+    return jax.lax.while_loop(cond, body, (z, z, jnp.inf, 0))
+
+
+@dataclass(frozen=True)
+class TensorMDP:
+    """Device-resident MDP: COO transitions + jitted solvers."""
+
+    n_states: int
+    n_actions: int
+    src: jax.Array
+    act: jax.Array
+    dst: jax.Array
+    prob: jax.Array
+    reward: jax.Array
+    progress: jax.Array
+    start: jax.Array
+
+    # -- value iteration --------------------------------------------------
+
+    def _segments(self):
+        assert self.n_states * self.n_actions < 2**31, (
+            "state-action space exceeds int32 segment ids; "
+            "shard the MDP (cpr_tpu.parallel) instead"
+        )
+        return self.src * jnp.int32(self.n_actions) + self.act
+
+    def _valid_mask(self):
+        seg = self._segments()
+        counts = jax.ops.segment_sum(
+            jnp.ones_like(self.prob), seg, num_segments=self.n_states * self.n_actions
+        )
+        return (counts > 0).reshape(self.n_states, self.n_actions)
+
+    def resolve_stop_delta(self, *, discount, eps, stop_delta, max_iter=1):
+        """Abort rule of eps-optimal VI (mdp/lib/explicit_mdp.py:106-110).
+        For discount == 1 the eps formula degenerates to 0, so an explicit
+        stop_delta (or max_iter) is required."""
+        assert 0.0 < discount <= 1.0
+        if stop_delta is None:
+            if eps is None:
+                raise ValueError("need eps or stop_delta")
+            if discount == 1.0:
+                raise ValueError(
+                    "eps-optimality is undefined at discount=1; pass "
+                    "stop_delta (absolute value-delta threshold) instead"
+                )
+            stop_delta = eps * (1.0 - discount) / discount
+        assert max_iter > 0 or stop_delta > 0, "infinite iteration"
+        return stop_delta
+
+    def value_iteration(self, *, max_iter: int = 0, discount: float = 1.0,
+                        eps: float | None = None, stop_delta: float | None = None,
+                        verbose: bool = False):
+        """eps-optimal value iteration (reference semantics:
+        mdp/lib/explicit_mdp.py:97-177 — double-buffered dense sweep that
+        also tracks expected progress and the greedy policy; ties go to
+        the lowest action id; states without actions get value 0 and
+        policy -1)."""
+        stop_delta = self.resolve_stop_delta(
+            discount=discount, eps=eps, stop_delta=stop_delta, max_iter=max_iter)
+        t0 = time.time()
+        value, progress, policy, delta, it = _vi_loop(
+            self.src, self.act, self.dst, self.prob, self.reward,
+            self.progress, self.n_states, self.n_actions,
+            jnp.asarray(discount, self.prob.dtype),
+            jnp.asarray(stop_delta, self.prob.dtype),
+            max_iter if max_iter > 0 else (1 << 30),
+        )
+        if verbose:
+            print(f"value iteration: {int(it)} sweeps, delta {float(delta):g}")
+        return dict(
+            vi_discount=discount,
+            vi_delta=float(delta),
+            vi_stop_delta=stop_delta,
+            vi_policy=np.asarray(policy),
+            vi_value=np.asarray(value),
+            vi_progress=np.asarray(progress),
+            vi_iter=int(it),
+            vi_max_iter=max_iter,
+            vi_time=time.time() - t0,
+        )
+
+    def policy_evaluation(self, policy, *, theta: float, discount: float = 1.0,
+                          max_iter: int | None = None):
+        """Iterative evaluation of a fixed (positional-action) policy
+        (reference: mdp/lib/explicit_mdp.py:328-378)."""
+        rew, prg, _, it = _pe_loop(
+            self.src, self.dst, self.prob, self.reward, self.progress,
+            jnp.asarray(policy, jnp.int32)[self.src] == self.act,
+            self.n_states,
+            jnp.asarray(discount, self.prob.dtype),
+            jnp.asarray(theta, self.prob.dtype),
+            max_iter if max_iter is not None else (1 << 30),
+        )
+        return dict(pe_reward=np.asarray(rew), pe_progress=np.asarray(prg),
+                    pe_iter=int(it))
+
+    # -- start-state aggregates -------------------------------------------
+
+    def start_value(self, values) -> float:
+        return float(jnp.asarray(values) @ self.start)
+
+    # -- markov chain / steady state (host, scipy) ------------------------
+
+    def _numpy(self):
+        return (np.asarray(self.src), np.asarray(self.act), np.asarray(self.dst),
+                np.asarray(self.prob, np.float64),
+                np.asarray(self.reward, np.float64),
+                np.asarray(self.progress, np.float64))
+
+    def reachable_states(self, policy, *, start_state=None):
+        """States visited under a policy (mdp/lib/explicit_mdp.py:179-208)."""
+        src, act, dst, prob, _, _ = self._numpy()
+        adj: dict[int, list[int]] = {}
+        for i in range(len(src)):
+            if prob[i] == 0.0:
+                continue
+            if policy[src[i]] == act[i]:
+                adj.setdefault(int(src[i]), []).append(int(dst[i]))
+        todo = set()
+        if start_state is None:
+            todo = {int(s) for s, p in enumerate(np.asarray(self.start)) if p > 0}
+        else:
+            todo = {int(start_state)}
+        seen = set()
+        while todo:
+            s = todo.pop()
+            seen.add(s)
+            if policy[s] < 0:
+                continue
+            for d in adj.get(s, []):
+                if d not in seen:
+                    todo.add(d)
+        return seen
+
+    def markov_chain(self, policy, *, start_state):
+        """Policy-induced markov chain as scipy sparse matrices
+        (mdp/lib/explicit_mdp.py:210-250)."""
+        reachable = sorted(self.reachable_states(policy, start_state=start_state))
+        mc_of = {s: i for i, s in enumerate(reachable)}
+        src, act, dst, prob, rew, prg = self._numpy()
+        rows, cols, prbs, rews, prgs = [], [], [], [], []
+        covered = set()
+        for i in range(len(src)):
+            s = int(src[i])
+            if s not in mc_of or policy[s] != act[i] or prob[i] == 0.0:
+                continue
+            covered.add(s)
+            rows.append(mc_of[s])
+            cols.append(mc_of[int(dst[i])])
+            prbs.append(prob[i])
+            rews.append(rew[i])
+            prgs.append(prg[i])
+        for s in reachable:
+            if s not in covered:  # terminal: self loop
+                rows.append(mc_of[s])
+                cols.append(mc_of[s])
+                prbs.append(1.0)
+                rews.append(0.0)
+                prgs.append(0.0)
+        n = len(reachable)
+        return dict(
+            prb=scipy.sparse.coo_matrix((prbs, (rows, cols)), shape=(n, n)),
+            rew=scipy.sparse.coo_matrix((rews, (rows, cols)), shape=(n, n)),
+            prg=scipy.sparse.coo_matrix((prgs, (rows, cols)), shape=(n, n)),
+            mdp_states=reachable,
+        )
+
+    def steady_state(self, policy, *, start_state):
+        """Stationary distribution of the policy-induced chain via a sparse
+        least-norm solve (mdp/lib/explicit_mdp.py:252-326)."""
+        t0 = time.time()
+        mc = self.markov_chain(policy, start_state=start_state)
+        prb = mc["prb"]
+        n = prb.shape[0]
+        rows = list(prb.row) + list(range(n)) + list(range(n))
+        cols = list(prb.col) + list(range(n)) + [n] * n
+        vals = list(prb.data) + [-1.0] * n + [1.0] * n
+        Q = scipy.sparse.csr_matrix((vals, (rows, cols)), shape=(n, n + 1))
+        QTQ = Q.dot(Q.transpose())
+        b = np.ones(n)
+        v = scipy.sparse.linalg.spsolve(QTQ, b)
+        if np.isnan(v).any():
+            lsqr = scipy.sparse.linalg.lsqr(QTQ, b)
+            v = lsqr[0]
+            v = v / v.sum()
+        assert math.isclose(v.sum(), 1.0, rel_tol=1e-5)
+        ss = np.zeros(self.n_states)
+        for mc_s, mdp_s in enumerate(mc["mdp_states"]):
+            ss[mdp_s] = v[mc_s]
+        return dict(ss=ss, ss_reachable=n,
+                    ss_nonzero=int((v != 0).sum()),
+                    ss_time=time.time() - t0)

@@ -1,0 +1,159 @@
+"""Device-mesh parallelism.
+
+Reference counterpart: the reference has NO distributed backend — its
+parallelism is fork-based task farms (experiments/simulate/csv_runner.ml:
+105-131 via Parany) and process-per-env rollouts (experiments/train/
+ppo.py:283 via SubprocVecEnv). See SURVEY.md §2.8 for the full mapping.
+
+TPU re-design: three first-class parallel axes, all on one `jax.sharding.
+Mesh` with XLA collectives over ICI (intra-slice) / DCN (across slices):
+
+- env-batch data parallelism: `vmap` over episodes (free, no mesh),
+- device data parallelism: episode batches sharded over the mesh
+  (`shard_envs`),
+- solver parallelism: value-iteration sweeps with transitions sharded
+  over devices and `psum`-reduced Bellman backups
+  (`sharded_value_iteration`) — the analog of model/tensor parallelism
+  for the MDP workload.
+
+The same code runs on a virtual CPU mesh (tests, CI) and on real TPU
+slices; the mesh is the only seam.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from cpr_tpu.mdp.explicit import TensorMDP, make_vi_sweep
+
+__all__ = [
+    "default_mesh",
+    "shard_envs",
+    "sharded_value_iteration",
+    "sharded_rollout",
+]
+
+
+def default_mesh(axis: str = "d", devices=None) -> Mesh:
+    """One-dimensional mesh over all (or the given) devices."""
+    devices = jax.devices() if devices is None else devices
+    return Mesh(np.asarray(devices), (axis,))
+
+
+def shard_envs(mesh: Mesh, tree, axis: str = "d"):
+    """Place a batched env state/keys PyTree with the batch dimension
+    sharded over the mesh (device data parallelism for episode batches)."""
+    sharding = NamedSharding(mesh, P(axis))
+    return jax.device_put(tree, sharding)
+
+
+def sharded_rollout(env, mesh: Mesh, keys, params, policy, n_steps: int,
+                    axis: str = "d"):
+    """vmap'd `JaxEnv.episode_stats` with the episode batch sharded over
+    the mesh. XLA partitions the whole rollout program; no collectives
+    are needed until the caller aggregates the returned stats."""
+    keys = shard_envs(mesh, keys, axis)
+    fn = jax.jit(jax.vmap(lambda k: env.episode_stats(k, params, policy, n_steps)))
+    return fn(keys)
+
+
+def sharded_value_iteration(tm: TensorMDP, mesh: Mesh, *, axis: str = "d",
+                            max_iter: int = 0, discount: float = 1.0,
+                            eps: float | None = None,
+                            stop_delta: float | None = None):
+    """Value iteration with the transition table sharded over the mesh.
+
+    Each device owns a contiguous transition chunk (padded with
+    zero-probability entries), computes a partial per-(state,action)
+    backup with a local segment-sum, and the partial Q tables are
+    `psum`-combined over ICI. Values/policies stay replicated, so each
+    sweep is one all-reduce of an (S, A) table — the halo exchange for
+    cross-shard transitions described in SURVEY.md §2.8.
+
+    Semantics identical to `TensorMDP.value_iteration` (same greedy
+    backup, same stop rule); returns the same dict.
+    """
+    stop_delta = tm.resolve_stop_delta(
+        discount=discount, eps=eps, stop_delta=stop_delta, max_iter=max_iter)
+    t0 = time.time()
+    n = mesh.shape[axis]
+    S, A = tm.n_states, tm.n_actions
+    T = tm.src.shape[0]
+    pad = (-T) % n
+
+    def padt(x, fill=0):
+        return jnp.concatenate([x, jnp.full((pad,), fill, x.dtype)])
+
+    src = padt(tm.src)
+    act = padt(tm.act)
+    dst = padt(tm.dst)
+    prob = padt(tm.prob)  # zero probability: contributes nothing
+    reward = padt(tm.reward)
+    progress = padt(tm.progress)
+    max_iter_ = max_iter if max_iter > 0 else (1 << 30)
+
+    # NOTE: padding entries have prob=0 but still count in the
+    # action-validity mask if left at (src=0, act=0); mask on prob instead.
+    def valid_reduce(x):
+        return jax.lax.psum(x, axis)
+
+    sweep = make_vi_sweep(S, A, reduce=valid_reduce)
+
+    shard_map = jax.shard_map
+
+    @jax.jit
+    def run():
+        spec = P(axis)
+        rep = P()
+
+        def body(src, act, dst, prob, reward, progress):
+            # validity from probability mass, so padding is inert
+            seg = src * jnp.int32(A) + act
+            counts = jax.lax.psum(
+                jax.ops.segment_sum(jnp.where(prob > 0, 1.0, 0.0), seg,
+                                    num_segments=S * A), axis)
+            valid = (counts > 0).reshape(S, A)
+            any_valid = valid.any(axis=1)
+
+            def cond(carry):
+                _, _, _, delta, i = carry
+                return (delta > stop_delta) & (i < max_iter_)
+
+            def step(value, prog):
+                return sweep(src, act, dst, prob, reward, progress, valid,
+                             any_valid, discount, value, prog)
+
+            def body_fn(carry):
+                value, prog, _, _, i = carry
+                v2, p2, pol = step(value, prog)
+                return v2, p2, pol, jnp.abs(v2 - value).max(), i + 1
+
+            z = jnp.zeros(S, prob.dtype)
+            v, p, pol = step(z, z)
+            delta = jnp.abs(v - z).max()
+            return jax.lax.while_loop(cond, body_fn, (v, p, pol, delta, 1))
+
+        return shard_map(
+            body, mesh=mesh,
+            in_specs=(spec,) * 6,
+            out_specs=(rep, rep, rep, rep, rep),
+            check_vma=False,
+        )(src, act, dst, prob, reward, progress)
+
+    value, progress_v, policy, delta, it = run()
+    return dict(
+        vi_discount=discount,
+        vi_delta=float(delta),
+        vi_stop_delta=stop_delta,
+        vi_policy=np.asarray(policy),
+        vi_value=np.asarray(value),
+        vi_progress=np.asarray(progress_v),
+        vi_iter=int(it),
+        vi_max_iter=max_iter,
+        vi_time=time.time() - t0,
+    )

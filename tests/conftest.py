@@ -1,12 +1,20 @@
 """Test harness: run everything on a virtual 8-device CPU mesh so that
-multi-chip sharding is exercised without TPU hardware (the driver separately
-dry-runs the multi-chip path)."""
+multi-chip sharding is exercised without TPU hardware (the driver
+separately dry-runs the multi-chip path).
+
+Note: this environment's sitecustomize registers the axon TPU plugin and
+forces jax_platforms="axon,cpu", so the JAX_PLATFORMS env var alone is NOT
+enough — the programmatic config update below is what actually selects the
+CPU backend."""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,170 @@
+"""MDP toolbox tests: compiler invariants, value iteration against
+literature closed forms, cross-model validation (fc16 vs aft20, mirroring
+mdp/lib/models/aft20barzur_test.py), parameter remapping, and the
+env <-> MDP equivalence check (the analog of the reference's cross-engine
+validation strategy, SURVEY.md §4)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import jax
+from cpr_tpu.mdp import Compiler, ptmdp
+from cpr_tpu.mdp.models import Aft20BitcoinSM, Fc16BitcoinSM, map_params, mappable_params
+from cpr_tpu.mdp.models.bitcoin_sm import ACTIVE, IRRELEVANT, RELEVANT, WAIT
+
+
+def es2014_revenue(alpha, gamma):
+    a, g = alpha, gamma
+    return (a * (1 - a) ** 2 * (4 * a + g * (1 - 2 * a)) - a**3) / (
+        1 - a * (1 + (2 - a) * a)
+    )
+
+
+def solve(model_cls, alpha, gamma, mfl=40, horizon=100, stop_delta=1e-6):
+    c = Compiler(model_cls(alpha=alpha, gamma=gamma, maximum_fork_length=mfl))
+    m = ptmdp(c.mdp(), horizon=horizon)
+    tm = m.tensor()
+    vi = tm.value_iteration(stop_delta=stop_delta)
+    rev = tm.start_value(vi["vi_value"]) / tm.start_value(vi["vi_progress"])
+    return c, m, tm, vi, rev
+
+
+def test_compiler_and_check():
+    c = Compiler(Fc16BitcoinSM(alpha=0.25, gamma=0.5, maximum_fork_length=10))
+    m = c.mdp()
+    assert m.check()
+    assert m.n_states == len(c.states)
+    # truncation: no WAIT available at fork length >= mfl
+    for sid, st in enumerate(c.states):
+        if st.a >= 10 or st.h >= 10:
+            assert WAIT not in c.action_map[sid]
+
+
+def test_vi_optimal_beats_sm1_and_respects_upper_bound():
+    alpha, gamma = 0.35, 0.5
+    *_, rev = solve(Fc16BitcoinSM, alpha, gamma)
+    lower = es2014_revenue(alpha, gamma)  # optimal >= fixed SM1 strategy
+    upper = alpha / (1 - alpha)  # classic selfish-mining upper bound
+    assert lower - 0.01 <= rev <= upper + 1e-6, (lower, rev, upper)
+
+
+def test_vi_honest_region():
+    # below the profitability threshold the optimal policy earns ~alpha
+    *_, rev = solve(Fc16BitcoinSM, 0.2, 0.0)
+    assert abs(rev - 0.2) < 0.01
+
+
+def test_fc16_vs_aft20_cross_validation():
+    # the two literature formulations agree on optimal revenue
+    for alpha, gamma in [(0.25, 0.5), (0.4, 0.5)]:
+        *_, rev_fc = solve(Fc16BitcoinSM, alpha, gamma, horizon=50)
+        *_, rev_bz = solve(Aft20BitcoinSM, alpha, gamma, horizon=50)
+        assert abs(rev_fc - rev_bz) < 0.01, (alpha, gamma, rev_fc, rev_bz)
+
+
+def test_map_params_equals_direct_compilation():
+    alpha, gamma = 0.3, 0.6
+    c = Compiler(Fc16BitcoinSM(maximum_fork_length=20, **mappable_params))
+    base = c.mdp()
+    mapped = map_params(base, alpha=alpha, gamma=gamma)
+    vi_mapped = ptmdp(mapped, horizon=50).tensor().value_iteration(stop_delta=1e-7)
+    c2 = Compiler(Fc16BitcoinSM(alpha=alpha, gamma=gamma, maximum_fork_length=20))
+    vi_direct = ptmdp(c2.mdp(), horizon=50).tensor().value_iteration(stop_delta=1e-7)
+    np.testing.assert_allclose(
+        vi_mapped["vi_value"], vi_direct["vi_value"], rtol=1e-4
+    )
+
+
+def test_policy_evaluation_honest_yields_alpha():
+    alpha = 0.3
+    c = Compiler(Fc16BitcoinSM(alpha=alpha, gamma=0.5, maximum_fork_length=20))
+    m = ptmdp(c.mdp(), horizon=100)
+    tm = m.tensor()
+    # positional honest policy; the PTO terminal state keeps -1
+    policy = np.full(m.n_states, -1, np.int32)
+    for sid, st in enumerate(c.states):
+        policy[sid] = c.action_map[sid].index(c.model.honest(st))
+    pe = tm.policy_evaluation(policy, theta=1e-7)
+    rev = tm.start_value(pe["pe_reward"]) / tm.start_value(pe["pe_progress"])
+    assert abs(rev - alpha) < 0.005, rev
+
+
+def test_steady_state_sums_to_one():
+    c = Compiler(Fc16BitcoinSM(alpha=0.3, gamma=0.5, maximum_fork_length=10))
+    m = ptmdp(c.mdp(), horizon=20)
+    tm = m.tensor()
+    vi = tm.value_iteration(stop_delta=1e-6)
+    start = int(np.argmax(np.asarray(tm.start)))
+    ss = tm.steady_state(vi["vi_policy"], start_state=start)
+    assert abs(ss["ss"].sum() - 1.0) < 1e-5
+
+
+def test_sharded_vi_matches_single_device():
+    """Transition-sharded VI over the 8-device CPU mesh reproduces the
+    single-device solver exactly."""
+    from cpr_tpu.parallel import default_mesh, sharded_value_iteration
+
+    c = Compiler(Fc16BitcoinSM(alpha=0.33, gamma=0.7, maximum_fork_length=25))
+    tm = ptmdp(c.mdp(), horizon=60).tensor()
+    single = tm.value_iteration(stop_delta=1e-6)
+    mesh = default_mesh()
+    assert mesh.devices.size == 8
+    sharded = sharded_value_iteration(tm, mesh, stop_delta=1e-6)
+    np.testing.assert_allclose(
+        sharded["vi_value"], single["vi_value"], rtol=1e-6, atol=1e-7
+    )
+    np.testing.assert_array_equal(sharded["vi_policy"], single["vi_policy"])
+
+
+def test_vi_eps_guard():
+    c = Compiler(Fc16BitcoinSM(alpha=0.3, gamma=0.5, maximum_fork_length=8))
+    tm = ptmdp(c.mdp(), horizon=20).tensor()
+    with pytest.raises(ValueError, match="stop_delta"):
+        tm.value_iteration(eps=1e-6)  # discount=1 needs stop_delta
+    with pytest.raises(ValueError, match="eps or stop_delta"):
+        tm.value_iteration()
+    # discounted eps-optimality works
+    vi = tm.value_iteration(eps=1e-4, discount=0.9)
+    assert vi["vi_iter"] > 1
+
+
+def test_env_matches_vi_optimal_policy():
+    """Execute the VI-optimal MDP policy inside the JAX environment and
+    compare revenues — the cross-engine equivalence test of SURVEY.md §7.2."""
+    from cpr_tpu.envs.nakamoto import NakamotoSSZ, EV_NETWORK
+    from cpr_tpu.params import make_params
+
+    alpha, gamma, mfl = 0.35, 0.9, 50
+    c, m, tm, vi, rev_vi = solve(Fc16BitcoinSM, alpha, gamma, mfl=mfl,
+                                 horizon=200, stop_delta=1e-7)
+
+    # semantic-action lookup table over (a, h, fork)
+    table = np.zeros((mfl + 2, mfl + 2, 3), np.int32)
+    for sid, st in enumerate(c.states):
+        pos = vi["vi_policy"][sid]
+        if pos >= 0:
+            table[st.a, st.h, st.fork] = c.action_map[sid][pos]
+    jtable = jnp.asarray(table)
+
+    def mdp_policy(state, obs):
+        fork = jnp.where(
+            state.match_h >= 0, ACTIVE,
+            jnp.where(state.event == EV_NETWORK, RELEVANT, IRRELEVANT),
+        )
+        a = jnp.clip(state.a, 0, mfl + 1)
+        h = jnp.clip(state.h, 0, mfl + 1)
+        return jtable[a, h, fork]
+
+    mdp_policy.takes_state = True
+
+    env = NakamotoSSZ(strict_match=False)
+    params = make_params(alpha=alpha, gamma=gamma, max_steps=1024)
+    keys = jax.random.split(jax.random.PRNGKey(11), 512)
+    stats = jax.vmap(
+        lambda k: env.episode_stats(k, params, mdp_policy, 1200)
+    )(keys)
+    atk = np.asarray(stats["episode_reward_attacker"]).mean()
+    dfn = np.asarray(stats["episode_reward_defender"]).mean()
+    rev_env = atk / (atk + dfn)
+    assert abs(rev_env - rev_vi) < 0.02, (rev_vi, rev_env)
