@@ -1,0 +1,54 @@
+"""Benchmark entry point — prints ONE JSON line.
+
+Metric: Nakamoto selfish-mining env-steps/sec on one chip (BASELINE.md
+target config 1/2: vmap-batched episodes, SM1 policy, episode_len=2016).
+Baseline: the north-star target of 10M env-steps/sec for a full v5e-8
+slice (BASELINE.json "north_star"); vs_baseline is the single-chip
+measured rate over that whole-slice target, so vs_baseline > 1 means one
+chip alone beats the 8-chip goal. The reference publishes no numbers
+(BASELINE.md), so the north star is the only fixed point.
+"""
+
+import json
+import time
+
+import jax
+import numpy as np
+
+
+def main():
+    from cpr_tpu.envs.nakamoto import NakamotoSSZ
+    from cpr_tpu.params import make_params
+
+    env = NakamotoSSZ()
+    params = make_params(alpha=0.35, gamma=0.5, max_steps=2016)
+    policy = env.policies["sapirshtein-2016-sm1"]
+
+    # scan past one full episode (max_steps=2016) so episode stats exist
+    n_envs, n_steps = 8192, 2200
+    keys = jax.random.split(jax.random.PRNGKey(0), n_envs)
+    fn = jax.jit(jax.vmap(lambda k: env.episode_stats(k, params, policy, n_steps)))
+    jax.block_until_ready(fn(keys))  # compile
+    reps = 3
+    t0 = time.time()
+    for _ in range(reps):
+        stats = jax.block_until_ready(fn(keys))
+    dt = (time.time() - t0) / reps
+    steps_per_sec = n_envs * n_steps / dt
+
+    # correctness guard: SM1 revenue near the ES'14 closed form
+    atk = np.asarray(stats["episode_reward_attacker"]).mean()
+    dfn = np.asarray(stats["episode_reward_defender"]).mean()
+    rel = atk / (atk + dfn)
+    assert 0.38 < rel < 0.45, f"SM1 revenue {rel} off closed form 0.416"
+
+    print(json.dumps({
+        "metric": "nakamoto_selfish_mining_env_steps_per_sec_per_chip",
+        "value": round(steps_per_sec),
+        "unit": "env-steps/sec/chip",
+        "vs_baseline": round(steps_per_sec / 10_000_000, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

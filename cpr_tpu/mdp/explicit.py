@@ -182,18 +182,24 @@ def make_vi_sweep(S: int, A: int, reduce=lambda x: x):
 
 
 def _valid_actions(src, act, prob, S: int, A: int, reduce=lambda x: x):
+    """Per-(state,action) availability mask. Masked on probability mass so
+    zero-probability padding entries (transition sharding) are inert; real
+    compiled actions always carry positive total mass (probabilities sum
+    to one per action)."""
     seg = src * jnp.int32(A) + act
-    counts = reduce(jax.ops.segment_sum(
-        jnp.ones_like(prob), seg, num_segments=S * A))
-    valid = (counts > 0).reshape(S, A)
+    mass = reduce(jax.ops.segment_sum(
+        jnp.where(prob > 0, 1.0, 0.0), seg, num_segments=S * A))
+    valid = (mass > 0).reshape(S, A)
     return valid, valid.any(axis=1)
 
 
-@partial(jax.jit, static_argnums=(6, 7, 10))
-def _vi_loop(src, act, dst, prob, reward, progress, S, A, discount,
-             stop_delta, max_iter):
-    sweep = make_vi_sweep(S, A)
-    valid, any_valid = _valid_actions(src, act, prob, S, A)
+def vi_while_loop(src, act, dst, prob, reward, progress, S, A, discount,
+                  stop_delta, max_iter, reduce=lambda x: x):
+    """Shared VI driver: Bellman sweeps until the value delta drops below
+    stop_delta or max_iter is hit. `reduce` hooks the cross-device psum
+    for transition-sharded execution."""
+    sweep = make_vi_sweep(S, A, reduce)
+    valid, any_valid = _valid_actions(src, act, prob, S, A, reduce)
 
     def run(value, prog):
         return sweep(src, act, dst, prob, reward, progress, valid, any_valid,
@@ -212,6 +218,13 @@ def _vi_loop(src, act, dst, prob, reward, progress, S, A, discount,
     v, p, pol = run(z, z)
     delta = jnp.abs(v - z).max()
     return jax.lax.while_loop(cond, body, (v, p, pol, delta, 1))
+
+
+@partial(jax.jit, static_argnums=(6, 7, 10))
+def _vi_loop(src, act, dst, prob, reward, progress, S, A, discount,
+             stop_delta, max_iter):
+    return vi_while_loop(src, act, dst, prob, reward, progress, S, A,
+                         discount, stop_delta, max_iter)
 
 
 @partial(jax.jit, static_argnums=(6, 9))
@@ -251,28 +264,17 @@ class TensorMDP:
 
     # -- value iteration --------------------------------------------------
 
-    def _segments(self):
-        assert self.n_states * self.n_actions < 2**31, (
-            "state-action space exceeds int32 segment ids; "
-            "shard the MDP (cpr_tpu.parallel) instead"
-        )
-        return self.src * jnp.int32(self.n_actions) + self.act
-
-    def _valid_mask(self):
-        seg = self._segments()
-        counts = jax.ops.segment_sum(
-            jnp.ones_like(self.prob), seg, num_segments=self.n_states * self.n_actions
-        )
-        return (counts > 0).reshape(self.n_states, self.n_actions)
-
-    def resolve_stop_delta(self, *, discount, eps, stop_delta, max_iter=1):
+    def resolve_stop_delta(self, *, discount, eps, stop_delta, max_iter=0):
         """Abort rule of eps-optimal VI (mdp/lib/explicit_mdp.py:106-110).
         For discount == 1 the eps formula degenerates to 0, so an explicit
-        stop_delta (or max_iter) is required."""
+        stop_delta — or a bare max_iter (fixed number of sweeps) — is
+        required."""
         assert 0.0 < discount <= 1.0
         if stop_delta is None:
             if eps is None:
-                raise ValueError("need eps or stop_delta")
+                if max_iter > 0:
+                    return 0.0  # run exactly max_iter sweeps
+                raise ValueError("need eps, stop_delta, or max_iter")
             if discount == 1.0:
                 raise ValueError(
                     "eps-optimality is undefined at discount=1; pass "
@@ -281,6 +283,13 @@ class TensorMDP:
             stop_delta = eps * (1.0 - discount) / discount
         assert max_iter > 0 or stop_delta > 0, "infinite iteration"
         return stop_delta
+
+    def _check_segment_width(self):
+        assert self.n_states * self.n_actions < 2**31, (
+            "state-action space exceeds int32 segment ids; "
+            "shard the MDP (cpr_tpu.parallel.sharded_value_iteration) "
+            "over more devices with a split state space instead"
+        )
 
     def value_iteration(self, *, max_iter: int = 0, discount: float = 1.0,
                         eps: float | None = None, stop_delta: float | None = None,
@@ -292,6 +301,7 @@ class TensorMDP:
         policy -1)."""
         stop_delta = self.resolve_stop_delta(
             discount=discount, eps=eps, stop_delta=stop_delta, max_iter=max_iter)
+        self._check_segment_width()
         t0 = time.time()
         value, progress, policy, delta, it = _vi_loop(
             self.src, self.act, self.dst, self.prob, self.reward,

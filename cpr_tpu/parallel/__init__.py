@@ -29,7 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from cpr_tpu.mdp.explicit import TensorMDP, make_vi_sweep
+from cpr_tpu.mdp.explicit import TensorMDP, vi_while_loop
 
 __all__ = [
     "default_mesh",
@@ -80,70 +80,35 @@ def sharded_value_iteration(tm: TensorMDP, mesh: Mesh, *, axis: str = "d",
     """
     stop_delta = tm.resolve_stop_delta(
         discount=discount, eps=eps, stop_delta=stop_delta, max_iter=max_iter)
+    tm._check_segment_width()
     t0 = time.time()
     n = mesh.shape[axis]
     S, A = tm.n_states, tm.n_actions
-    T = tm.src.shape[0]
-    pad = (-T) % n
+    pad = (-tm.src.shape[0]) % n
 
-    def padt(x, fill=0):
-        return jnp.concatenate([x, jnp.full((pad,), fill, x.dtype)])
+    def padt(x):
+        # zero-probability padding: inert in both the Bellman backup and
+        # the probability-mass validity test of _valid_actions
+        return jnp.concatenate([x, jnp.zeros((pad,), x.dtype)])
 
-    src = padt(tm.src)
-    act = padt(tm.act)
-    dst = padt(tm.dst)
-    prob = padt(tm.prob)  # zero probability: contributes nothing
-    reward = padt(tm.reward)
-    progress = padt(tm.progress)
+    coo = tuple(padt(x) for x in
+                (tm.src, tm.act, tm.dst, tm.prob, tm.reward, tm.progress))
     max_iter_ = max_iter if max_iter > 0 else (1 << 30)
-
-    # NOTE: padding entries have prob=0 but still count in the
-    # action-validity mask if left at (src=0, act=0); mask on prob instead.
-    def valid_reduce(x):
-        return jax.lax.psum(x, axis)
-
-    sweep = make_vi_sweep(S, A, reduce=valid_reduce)
-
-    shard_map = jax.shard_map
 
     @jax.jit
     def run():
-        spec = P(axis)
-        rep = P()
-
         def body(src, act, dst, prob, reward, progress):
-            # validity from probability mass, so padding is inert
-            seg = src * jnp.int32(A) + act
-            counts = jax.lax.psum(
-                jax.ops.segment_sum(jnp.where(prob > 0, 1.0, 0.0), seg,
-                                    num_segments=S * A), axis)
-            valid = (counts > 0).reshape(S, A)
-            any_valid = valid.any(axis=1)
+            return vi_while_loop(
+                src, act, dst, prob, reward, progress, S, A, discount,
+                stop_delta, max_iter_,
+                reduce=lambda x: jax.lax.psum(x, axis))
 
-            def cond(carry):
-                _, _, _, delta, i = carry
-                return (delta > stop_delta) & (i < max_iter_)
-
-            def step(value, prog):
-                return sweep(src, act, dst, prob, reward, progress, valid,
-                             any_valid, discount, value, prog)
-
-            def body_fn(carry):
-                value, prog, _, _, i = carry
-                v2, p2, pol = step(value, prog)
-                return v2, p2, pol, jnp.abs(v2 - value).max(), i + 1
-
-            z = jnp.zeros(S, prob.dtype)
-            v, p, pol = step(z, z)
-            delta = jnp.abs(v - z).max()
-            return jax.lax.while_loop(cond, body_fn, (v, p, pol, delta, 1))
-
-        return shard_map(
+        return jax.shard_map(
             body, mesh=mesh,
-            in_specs=(spec,) * 6,
-            out_specs=(rep, rep, rep, rep, rep),
+            in_specs=(P(axis),) * 6,
+            out_specs=(P(),) * 5,
             check_vma=False,
-        )(src, act, dst, prob, reward, progress)
+        )(*coo)
 
     value, progress_v, policy, delta, it = run()
     return dict(

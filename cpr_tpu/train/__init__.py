@@ -1,0 +1,13 @@
+"""RL training drivers.
+
+Reference counterpart: experiments/train/ppo.py (stable-baselines3 PPO over
+SubprocVecEnv process-per-env rollouts, W&B logging, YAML configs).
+
+TPU re-design: a native JAX PPO where rollouts are the vmap'd env kernel
+itself (no process boundary, no host<->device copies inside an update) and
+the whole train step — rollout, GAE, minibatched clipped-surrogate updates
+— is one jitted program, shardable over a device mesh (data-parallel env
+batch x tensor-parallel policy network).
+"""
+
+from cpr_tpu.train.ppo import PPOConfig, make_train, ActorCritic  # noqa: F401

@@ -1,0 +1,264 @@
+"""Native JAX PPO over vmap-batched attack environments.
+
+Reference counterpart: experiments/train/ppo.py — sb3 PPO("MlpPolicy"),
+SubprocVecEnv(n_envs) process-per-env rollouts (:278-288), reward shaping
+(:217-244), per-alpha eval aggregation (:296-374). Here the policy is a
+flax MLP actor-critic (sb3's MlpPolicy shape), rollouts are the jitted env
+kernel, and one `train_step` = rollout + GAE + minibatched clipped
+surrogate updates, all inside a single XLA program. Multi-chip scaling:
+the env batch is sharded over the mesh's data axis and the policy's hidden
+layers over the tensor axis (see `shardings`).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import linen as nn
+from flax import struct
+from flax.training.train_state import TrainState
+
+from cpr_tpu.envs.base import JaxEnv
+from cpr_tpu.params import EnvParams
+
+
+@struct.dataclass
+class PPOConfig:
+    n_envs: int = 64
+    n_steps: int = 128  # rollout length per update
+    lr: float = 3e-4
+    gamma: float = 0.99
+    gae_lambda: float = 0.95
+    clip_eps: float = 0.2
+    entropy_coef: float = 0.01
+    vf_coef: float = 0.5
+    max_grad_norm: float = 0.5
+    update_epochs: int = 4
+    n_minibatches: int = 4
+    hidden: tuple[int, ...] = (64, 64)  # sb3 MlpPolicy default net_arch
+    anneal_lr: bool = False
+    total_updates: int = 1000  # for lr annealing
+
+
+class ActorCritic(nn.Module):
+    """MLP actor-critic, the sb3 "MlpPolicy" shape (ppo.py:399-417)."""
+
+    n_actions: int
+    hidden: tuple[int, ...] = (64, 64)
+
+    @nn.compact
+    def __call__(self, obs):
+        x = obs
+        for i, h in enumerate(self.hidden):
+            x = nn.tanh(nn.Dense(h, name=f"pi_{i}")(x))
+        logits = nn.Dense(self.n_actions, name="pi_head")(x)
+        v = obs
+        for i, h in enumerate(self.hidden):
+            v = nn.tanh(nn.Dense(h, name=f"vf_{i}")(v))
+        value = nn.Dense(1, name="vf_head")(v)
+        return logits, value.squeeze(-1)
+
+
+@struct.dataclass
+class Transition:
+    obs: jnp.ndarray
+    action: jnp.ndarray
+    logp: jnp.ndarray
+    value: jnp.ndarray
+    reward: jnp.ndarray
+    done: jnp.ndarray
+    info: dict[str, jnp.ndarray]
+
+
+def shardings(mesh, dp_axis: str = "dp", tp_axis: str = "tp"):
+    """Sharding rules for the train state and batch: env batch over the
+    data axis, MLP hidden weights over the tensor axis, everything else
+    replicated."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    batch = NamedSharding(mesh, P(dp_axis))
+
+    def param_spec(path, x):
+        # Dense kernels: (in, out) — shard the output features of hidden
+        # layers and the input features of the heads over tp
+        names = [getattr(p, "key", str(p)) for p in path]
+        if x.ndim == 2:
+            if any("head" in n for n in names):
+                return NamedSharding(mesh, P(tp_axis, None))
+            return NamedSharding(mesh, P(None, tp_axis))
+        if x.ndim == 1 and not any("head" in n for n in names):
+            return NamedSharding(mesh, P(tp_axis))
+        return NamedSharding(mesh, P())
+
+    return batch, param_spec
+
+
+def make_train(env: JaxEnv, env_params: EnvParams, cfg: PPOConfig,
+               reward_transform: Callable | None = None):
+    """Build (init_fn, train_step) — both jittable, mesh-shardable.
+
+    reward_transform(reward, info, done) -> shaped reward; the analog of
+    the reference's reward shaping pipeline (ppo.py:217-244 and the
+    wrappers in gym/ocaml/cpr_gym/wrappers.py).
+    """
+    net = ActorCritic(env.n_actions, cfg.hidden)
+
+    def lr_schedule(count):
+        if not cfg.anneal_lr:
+            return cfg.lr
+        frac = 1.0 - count / (cfg.total_updates * cfg.update_epochs * cfg.n_minibatches)
+        return cfg.lr * jnp.maximum(frac, 0.0)
+
+    tx = optax.chain(
+        optax.clip_by_global_norm(cfg.max_grad_norm),
+        optax.adam(lr_schedule, eps=1e-5),
+    )
+
+    def init_fn(key):
+        key, k_net, k_env = jax.random.split(key, 3)
+        obs_dim = env.observation_length
+        params = net.init(k_net, jnp.zeros((1, obs_dim)))
+        ts = TrainState.create(apply_fn=net.apply, params=params, tx=tx)
+        env_keys = jax.random.split(k_env, cfg.n_envs)
+        env_state, obs = jax.vmap(lambda k: env.reset(k, env_params))(env_keys)
+        return ts, env_state, obs, key
+
+    def env_step(carry, _):
+        ts, env_state, obs, key = carry
+        key, k_act = jax.random.split(key)
+        logits, value = net.apply(ts.params, obs)
+        action = jax.random.categorical(k_act, logits)
+        logp = jax.nn.log_softmax(logits)[jnp.arange(cfg.n_envs), action]
+        env_state, obs2, reward, done, info = jax.vmap(
+            lambda s, a: env.step(s, a, env_params)
+        )(env_state, action)
+        if reward_transform is not None:
+            reward = reward_transform(reward, info, done)
+        # auto-reset finished episodes, continuing each env's PRNG stream
+        reset_state, reset_obs = jax.vmap(lambda s: env.reset(s.key, env_params))(env_state)
+        env_state = jax.tree.map(
+            lambda a, b: jnp.where(
+                done.reshape(done.shape + (1,) * (a.ndim - 1)), a, b),
+            reset_state, env_state)
+        obs2 = jnp.where(done[:, None], reset_obs, obs2)
+        t = Transition(obs=obs, action=action, logp=logp, value=value,
+                       reward=reward, done=done, info=info)
+        return (ts, env_state, obs2, key), t
+
+    def gae(traj: Transition, last_value):
+        def back(carry, t):
+            adv_next, v_next = carry
+            nonterm = 1.0 - t.done.astype(jnp.float32)
+            delta = t.reward + cfg.gamma * v_next * nonterm - t.value
+            adv = delta + cfg.gamma * cfg.gae_lambda * nonterm * adv_next
+            return (adv, t.value), adv
+
+        (_, _), advs = jax.lax.scan(
+            back, (jnp.zeros_like(last_value), last_value), traj, reverse=True)
+        return advs, advs + traj.value
+
+    def loss_fn(params, batch, adv, target):
+        logits, value = net.apply(params, batch.obs)
+        logp_all = jax.nn.log_softmax(logits)
+        logp = jnp.take_along_axis(logp_all, batch.action[:, None], axis=1)[:, 0]
+        ratio = jnp.exp(logp - batch.logp)
+        adv_n = (adv - adv.mean()) / (adv.std() + 1e-8)
+        pg1 = ratio * adv_n
+        pg2 = jnp.clip(ratio, 1.0 - cfg.clip_eps, 1.0 + cfg.clip_eps) * adv_n
+        pg_loss = -jnp.minimum(pg1, pg2).mean()
+        v_clipped = batch.value + jnp.clip(
+            value - batch.value, -cfg.clip_eps, cfg.clip_eps)
+        v_loss = 0.5 * jnp.maximum(
+            (value - target) ** 2, (v_clipped - target) ** 2).mean()
+        entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
+        total = pg_loss + cfg.vf_coef * v_loss - cfg.entropy_coef * entropy
+        return total, dict(pg_loss=pg_loss, v_loss=v_loss, entropy=entropy)
+
+    def update_minibatch(ts, mb):
+        batch, adv, target = mb
+        grads, metrics = jax.grad(loss_fn, has_aux=True)(ts.params, batch, adv, target)
+        return ts.apply_gradients(grads=grads), metrics
+
+    def train_step(carry):
+        """One PPO update: rollout cfg.n_steps x cfg.n_envs, GAE,
+        cfg.update_epochs x cfg.n_minibatches minibatch updates."""
+        carry, traj = jax.lax.scan(env_step, carry, None, length=cfg.n_steps)
+        ts, env_state, obs, key = carry
+        _, last_value = net.apply(ts.params, obs)
+        advs, targets = gae(traj, last_value)
+
+        # flatten (T, N) -> (T*N,)
+        flat = jax.tree.map(
+            lambda x: x.reshape((cfg.n_steps * cfg.n_envs,) + x.shape[2:]), traj)
+        advs_f = advs.reshape(-1)
+        targets_f = targets.reshape(-1)
+
+        def epoch(carry, _):
+            ts, key = carry
+            key, k_perm = jax.random.split(key)
+            mb_size = cfg.n_steps * cfg.n_envs // cfg.n_minibatches
+            perm = jax.random.permutation(
+                k_perm, cfg.n_steps * cfg.n_envs
+            ).reshape(cfg.n_minibatches, mb_size)
+
+            def one_mb(ts, idx):
+                take = lambda x: x[idx]
+                mb = (jax.tree.map(take, flat), take(advs_f), take(targets_f))
+                return update_minibatch(ts, mb)
+
+            ts, metrics = jax.lax.scan(one_mb, ts, perm)
+            return (ts, key), metrics
+
+        (ts, key), metrics = jax.lax.scan(
+            epoch, (ts, key), None, length=cfg.update_epochs)
+        metrics = jax.tree.map(lambda x: x.mean(), metrics)
+        metrics["mean_step_reward"] = traj.reward.mean()
+        metrics["episode_reward_attacker"] = (
+            jnp.where(traj.done, traj.info["episode_reward_attacker"], 0.0).sum()
+            / jnp.maximum(traj.done.sum(), 1))
+        metrics["episode_reward_defender"] = (
+            jnp.where(traj.done, traj.info["episode_reward_defender"], 0.0).sum()
+            / jnp.maximum(traj.done.sum(), 1))
+        metrics["n_episodes"] = traj.done.sum()
+        return (ts, env_state, obs, key), metrics
+
+    return init_fn, train_step
+
+
+def relative_reward_on_done(reward, info, done):
+    """Sparse relative reward shaping
+    (gym/ocaml/cpr_gym/wrappers.py:8-26): at episode end, the attacker's
+    share of total reward; zero elsewhere."""
+    a = info["episode_reward_attacker"]
+    d = info["episode_reward_defender"]
+    s = a + d
+    rel = jnp.where(s != 0, a / jnp.where(s != 0, s, 1.0), 0.0)
+    return jnp.where(done, rel, 0.0)
+
+
+def train(env, env_params, cfg: PPOConfig, *, n_updates: int, seed: int = 0,
+          reward_transform=relative_reward_on_done, mesh=None,
+          progress: Callable[[int, dict], Any] | None = None):
+    """Run PPO for n_updates; returns (train_state, metrics history)."""
+    init_fn, train_step = make_train(env, env_params, cfg, reward_transform)
+    carry = init_fn(jax.random.PRNGKey(seed))
+    if mesh is not None:
+        from cpr_tpu.parallel import shard_envs
+        ts, env_state, obs, key = carry
+        env_state = shard_envs(mesh, env_state, "dp")
+        obs = shard_envs(mesh, obs, "dp")
+        carry = (ts, env_state, obs, key)
+    step = jax.jit(train_step)
+    history = []
+    for i in range(n_updates):
+        carry, metrics = step(carry)
+        host_metrics = {k: float(v) for k, v in metrics.items()}
+        if progress is not None:
+            progress(i, host_metrics)
+        history.append(host_metrics)
+    return carry[0], history

@@ -122,11 +122,14 @@ def test_vi_eps_guard():
     tm = ptmdp(c.mdp(), horizon=20).tensor()
     with pytest.raises(ValueError, match="stop_delta"):
         tm.value_iteration(eps=1e-6)  # discount=1 needs stop_delta
-    with pytest.raises(ValueError, match="eps or stop_delta"):
+    with pytest.raises(ValueError, match="eps, stop_delta, or max_iter"):
         tm.value_iteration()
     # discounted eps-optimality works
     vi = tm.value_iteration(eps=1e-4, discount=0.9)
     assert vi["vi_iter"] > 1
+    # fixed-sweep mode: exactly max_iter Bellman backups
+    vi = tm.value_iteration(max_iter=7)
+    assert vi["vi_iter"] == 7
 
 
 def test_env_matches_vi_optimal_policy():
