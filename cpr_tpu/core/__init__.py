@@ -1,0 +1,9 @@
+"""Core DAG substrate: fixed-capacity structure-of-arrays block DAGs.
+
+Reference counterpart: simulator/lib/dag.ml (append-only mutable DAG with
+per-node visibility views) and the per-block metadata of the simulator
+(simulator/lib/simulator.ml:2-10). Re-designed as a PyTree of arrays so
+protocols become pure functions and envs stay jittable.
+"""
+
+from cpr_tpu.core.dag import Dag  # noqa: F401

@@ -1,0 +1,272 @@
+"""Fixed-capacity block DAG as a structure of arrays.
+
+Reference counterparts:
+- simulator/lib/dag.ml — append-only DAG, serial ids, O(1) parent/child
+  access, per-node visibility views (dag.ml:39-45),
+- simulator/lib/simulator.ml:2-10 — per-block metadata {value; pow;
+  signature; visibility; received_at; rewards},
+- the Rust gym's per-block view triple (gym/rust/src/generic/mod.rs:21-44):
+  attacker view / defender view / network state,
+- reward accumulation along `precursor` (simulator/lib/simulator.ml:377-388)
+  becomes per-block cumulative reward columns written at append time.
+
+TPU re-design: capacity-B arrays; "views" are boolean visibility masks;
+children lookups are masked scans over the parent matrix; chain walks are
+bounded `lax.while_loop`s following parent slot 0 (the precursor). All ops
+are O(B) or O(B*P) vector ops that XLA fuses; B is sized from the episode
+length (one PoW + at most one structural append per step), so no
+compaction is needed within an episode.
+
+Convention: two parties — miner 0 is the attacker, miner 1 the defender
+cloud (the collapse performed by the reference gym engine,
+simulator/gym/engine.ml:100-107). `vis_a` is the attacker's view mask,
+`vis_d` the defender cloud's. A block appended by the attacker starts
+vis_a & ~vis_d == withheld; releasing sets vis_d (the simulator's
+recursive share of withheld ancestors, simulator.ml:401-419, is
+`release_with_ancestors`).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+NONE = jnp.int32(-1)
+NO_POW = jnp.float32(jnp.inf)  # pow_hash for non-PoW blocks; smaller = better
+
+ATTACKER = 0
+DEFENDER = 1
+
+
+@struct.dataclass
+class Dag:
+    parents: jnp.ndarray  # (B, P) int32, NONE-padded
+    kind: jnp.ndarray  # (B,) int32, protocol block-type tag
+    height: jnp.ndarray  # (B,) int32
+    aux: jnp.ndarray  # (B,) int32, protocol field (vote id, depth, ...)
+    pow_hash: jnp.ndarray  # (B,) float32, NO_POW if not attached via PoW
+    signer: jnp.ndarray  # (B,) int32, NONE if unsigned
+    miner: jnp.ndarray  # (B,) int32, ATTACKER / DEFENDER / NONE (roots)
+    vis_a: jnp.ndarray  # (B,) bool, attacker sees it
+    vis_d: jnp.ndarray  # (B,) bool, defender cloud sees it
+    vis_d_since: jnp.ndarray  # (B,) float32, when the defenders saw it
+    born_at: jnp.ndarray  # (B,) float32, append time
+    cum_atk: jnp.ndarray  # (B,) float32, attacker reward along precursors
+    cum_def: jnp.ndarray  # (B,) float32
+    cum_prog: jnp.ndarray  # (B,) float32, progress at this block
+    n: jnp.ndarray  # () int32, number of blocks
+    overflow: jnp.ndarray  # () bool, capacity exceeded (episode invalid)
+
+    @property
+    def capacity(self) -> int:
+        return self.parents.shape[0]
+
+    @property
+    def max_parents(self) -> int:
+        return self.parents.shape[1]
+
+    def slots(self):
+        """(B,) iota over block slots."""
+        return jnp.arange(self.capacity, dtype=jnp.int32)
+
+    def exists(self):
+        return self.slots() < self.n
+
+
+def empty(capacity: int, max_parents: int) -> Dag:
+    B, P = capacity, max_parents
+    f = lambda fill, dt: jnp.full((B,), fill, dt)
+    return Dag(
+        parents=jnp.full((B, P), NONE, jnp.int32),
+        kind=f(0, jnp.int32),
+        height=f(0, jnp.int32),
+        aux=f(0, jnp.int32),
+        pow_hash=f(NO_POW, jnp.float32),
+        signer=f(NONE, jnp.int32),
+        miner=f(NONE, jnp.int32),
+        vis_a=f(False, jnp.bool_),
+        vis_d=f(False, jnp.bool_),
+        vis_d_since=f(0.0, jnp.float32),
+        born_at=f(0.0, jnp.float32),
+        cum_atk=f(0.0, jnp.float32),
+        cum_def=f(0.0, jnp.float32),
+        cum_prog=f(0.0, jnp.float32),
+        n=jnp.int32(0),
+        overflow=jnp.bool_(False),
+    )
+
+
+def append(dag: Dag, parents, *, kind=0, height=0, aux=0, pow_hash=NO_POW,
+           signer=NONE, miner=NONE, vis_a=True, vis_d=True, time=0.0,
+           reward_atk=0.0, reward_def=0.0, progress=None):
+    """Append one block; returns (dag, index). `parents` is a (P,) int32
+    row (NONE-padded); parent slot 0 is the precursor along which
+    cumulative rewards accumulate (simulator.ml:377-388). `progress`
+    defaults to cum_prog[precursor] + 1 when None-like is passed
+    explicitly; pass the absolute progress value otherwise."""
+    idx = jnp.minimum(dag.n, dag.capacity - 1)
+    overflow = dag.overflow | (dag.n >= dag.capacity)
+    p0 = parents[0]
+    has_p0 = p0 >= 0
+    base = jnp.where(has_p0, p0, 0)
+    cum_atk = jnp.where(has_p0, dag.cum_atk[base], 0.0) + reward_atk
+    cum_def = jnp.where(has_p0, dag.cum_def[base], 0.0) + reward_def
+    if progress is None:
+        cum_prog = jnp.where(has_p0, dag.cum_prog[base], 0.0) + 1.0
+    else:
+        cum_prog = jnp.asarray(progress, jnp.float32)
+    dag = dag.replace(
+        parents=dag.parents.at[idx].set(parents),
+        kind=dag.kind.at[idx].set(kind),
+        height=dag.height.at[idx].set(height),
+        aux=dag.aux.at[idx].set(aux),
+        pow_hash=dag.pow_hash.at[idx].set(pow_hash),
+        signer=dag.signer.at[idx].set(signer),
+        miner=dag.miner.at[idx].set(miner),
+        vis_a=dag.vis_a.at[idx].set(vis_a),
+        vis_d=dag.vis_d.at[idx].set(vis_d),
+        vis_d_since=dag.vis_d_since.at[idx].set(
+            jnp.where(jnp.asarray(vis_d), jnp.asarray(time, jnp.float32),
+                      jnp.float32(jnp.inf))),
+        born_at=dag.born_at.at[idx].set(time),
+        cum_atk=dag.cum_atk.at[idx].set(cum_atk),
+        cum_def=dag.cum_def.at[idx].set(cum_def),
+        cum_prog=dag.cum_prog.at[idx].set(cum_prog),
+        n=jnp.minimum(dag.n + 1, dag.capacity),
+        overflow=overflow,
+    )
+    return dag, idx
+
+
+def children_mask(dag: Dag, v) -> jnp.ndarray:
+    """(B,) mask of blocks having v among their parents (dag.ml:44)."""
+    return dag.exists() & (dag.parents == v).any(axis=1)
+
+
+def release(dag: Dag, mask, time) -> Dag:
+    """Make the masked withheld blocks visible to the defender cloud."""
+    newly = mask & ~dag.vis_d & dag.exists()
+    return dag.replace(
+        vis_d=dag.vis_d | newly,
+        vis_d_since=jnp.where(newly, time, dag.vis_d_since),
+    )
+
+
+def ancestors_mask(dag: Dag, v, max_iter: int | None = None) -> jnp.ndarray:
+    """(B,) mask of v and all its ancestors (bounded BFS over the parent
+    matrix; the analog of dagtools.ml:73-100 iterate_ancestors)."""
+    B = dag.capacity
+    seed = jnp.zeros((B,), jnp.bool_).at[jnp.maximum(v, 0)].set(v >= 0)
+
+    def body(state):
+        mask, _ = state
+        # blocks whose any child is in mask
+        parent_hits = jnp.zeros((B,), jnp.bool_)
+        for p in range(dag.max_parents):
+            col = dag.parents[:, p]
+            hit = mask & (col >= 0)
+            parent_hits = parent_hits | (
+                jnp.zeros((B,), jnp.bool_).at[jnp.clip(col, 0)].max(hit))
+        new = mask | parent_hits
+        return new, (new != mask).any()
+
+    def cond(state):
+        return state[1]
+
+    mask, _ = jax.lax.while_loop(cond, body, (seed, v >= 0))
+    return mask
+
+
+def release_with_ancestors(dag: Dag, v, time) -> Dag:
+    """Share v and (recursively) its withheld ancestors — the simulator's
+    recursive share (simulator.ml:401-419)."""
+    return release(dag, ancestors_mask(dag, v), time)
+
+
+def release_chain(dag: Dag, tip, time) -> Dag:
+    """Release `tip`, its full parent row, and walk down the precursor
+    chain until an already-defender-visible block. Equivalent to
+    `release_with_ancestors` whenever non-precursor parents (votes) sit
+    directly on precursor-chain blocks — true for all chain+vote protocols
+    here — but costs O(newly released) instead of a full-DAG ancestor
+    fixpoint per call."""
+    B = dag.capacity
+
+    def cond(carry):
+        dag, t = carry
+        return (t >= 0) & ~dag.vis_d[jnp.maximum(t, 0)]
+
+    def body(carry):
+        dag, t = carry
+        row = dag.parents[t]
+        mask = jnp.zeros((B,), jnp.bool_).at[jnp.clip(row, 0)].max(row >= 0)
+        mask = mask.at[t].set(True)
+        dag = release(dag, mask, time)
+        return dag, row[0]
+
+    dag, _ = jax.lax.while_loop(cond, body, (dag, tip))
+    return dag
+
+
+def walk_back(dag: Dag, tip, stop_fn, max_iter: int | None = None):
+    """Follow parent slot 0 from `tip` while not stop_fn(dag, idx).
+    Bounded by the DAG height; the chain-walk primitive behind
+    `last_block`, height targeting, and common ancestors."""
+
+    def cond(i):
+        return (i >= 0) & ~stop_fn(dag, i)
+
+    def body(i):
+        nxt = dag.parents[i, 0]
+        return nxt
+
+    return jax.lax.while_loop(cond, body, tip)
+
+
+def block_at_height(dag: Dag, tip, target_height, is_block_fn=None):
+    """Walk the precursor chain from `tip` down to the first block with
+    height <= target_height (nakamoto_ssz.ml:238-247, bk_ssz.ml:283-291)."""
+    def stop(dag, i):
+        ok = dag.height[i] <= target_height
+        if is_block_fn is not None:
+            ok = ok & is_block_fn(dag, i)
+        return ok
+
+    return walk_back(dag, tip, stop)
+
+
+def common_ancestor_by_height(dag: Dag, a, b):
+    """Common ancestor of two chain tips linked via parent slot 0, using
+    heights to synchronize the walk (dagtools.ml:102-121, re-shaped as a
+    height-indexed two-pointer loop)."""
+
+    def cond(state):
+        x, y = state
+        return (x != y) & (x >= 0) & (y >= 0)
+
+    def body(state):
+        x, y = state
+        hx, hy = dag.height[x], dag.height[y]
+        # step the higher one down; on ties step both
+        step_x = hx >= hy
+        step_y = hy >= hx
+        return (jnp.where(step_x, dag.parents[x, 0], x),
+                jnp.where(step_y, dag.parents[y, 0], y))
+
+    x, y = jax.lax.while_loop(cond, body, (a, b))
+    return x
+
+
+def top_k_by(score, mask, k: int, largest: bool = False):
+    """Indices of the k best masked entries by score (ascending by
+    default — used for smallest-hash vote selection). Returns (idx, valid)
+    where valid marks real entries (fewer than k may match)."""
+    s = jnp.where(mask, score, jnp.inf if not largest else -jnp.inf)
+    if largest:
+        vals, idx = jax.lax.top_k(s, k)
+        valid = vals > -jnp.inf
+    else:
+        vals, idx = jax.lax.top_k(-s, k)
+        valid = vals > -jnp.inf
+    return idx, valid

@@ -1,0 +1,520 @@
+"""Bₖ protocol under the SSZ-like withholding attack space, on the DAG
+tensor substrate.
+
+Reference counterparts:
+- protocol: simulator/protocols/bk.ml — k votes (PoW) per block, blocks
+  signed by the leader (smallest vote hash), votes ordered by hash inside
+  the block (bk.ml:110-132), quorum selection with replace-hash fast paths
+  (bk.ml:233-279), `Block`/`Constant` reward schemes (bk.ml:151-176),
+- attack space: simulator/protocols/bk_ssz.ml — 8 actions (Adopt|Override|
+  Match|Wait x Prolong|Proceed, ssz_tools.ml:230-263), 8-field observation
+  (bk_ssz.ml:21-48), release logic targeting (height, votes) of the public
+  head (bk_ssz.ml:271-306), proposals appended with inclusive (Proceed) or
+  exclusive (Prolong) vote filters (bk_ssz.ml:316-326),
+- engine semantics: simulator/gym/engine.ml:97-273 (one env step per
+  attacker interaction; `Append` events for the attacker's own proposals
+  are separate interactions, as are defender proposals arriving right
+  after the vote that completed their quorum).
+
+TPU re-design: the PoW hash is a uniform float32 (only order matters);
+quorum selection is masked top-k over the capacity-B child scan; chain
+walks are bounded while loops. One env step processes exactly one
+attacker event: a pending self-append, a defender proposal, or one mining
+draw.
+
+Documented deviations from the reference event-queue simulation:
+- The defender cloud is one honest node (the engine's collapse). gamma
+  has no effect here: Bₖ block preference is decided by the strict
+  (height, votes, leader-hash) comparison (bk.ml:217-226), never by
+  message arrival order; in the reference gamma only perturbs vote
+  arrival order, which vanishes at cloud granularity.
+- The `lead` observation uses the leader vote's miner id. The reference
+  compares the (unsigned) vote's signature against the attacker id
+  (bk_ssz.ml:240-249), which is vacuously false; we implement the
+  documented intent ("attacker is truthful leader on leading public
+  block").
+- Attacker-view `visible_since` is the append time (the attacker hears
+  defender messages instantly in the selfish-mining network,
+  network.ml:85-95).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from cpr_tpu import obs as obslib
+from cpr_tpu.core import dag as D
+from cpr_tpu.envs.base import JaxEnv
+from cpr_tpu.params import EnvParams
+
+# kinds
+BLOCK, VOTE = 0, 1
+
+# events: Discrete [`Append; `ProofOfWork; `Network] (bk_ssz.ml:47)
+EV_APPEND, EV_POW, EV_NETWORK = 0, 1, 2
+
+# Action8 ranks (ssz_tools.ml:230-263)
+(ADOPT_PROLONG, OVERRIDE_PROLONG, MATCH_PROLONG, WAIT_PROLONG,
+ ADOPT_PROCEED, OVERRIDE_PROCEED, MATCH_PROCEED, WAIT_PROCEED) = range(8)
+
+
+def obs_fields(k: int):
+    return (
+        obslib.Field("public_blocks", obslib.UINT, scale=1),
+        obslib.Field("private_blocks", obslib.UINT, scale=1),
+        obslib.Field("diff_blocks", obslib.INT, scale=1),
+        obslib.Field("public_votes", obslib.UINT, scale=k),
+        obslib.Field("private_votes_inclusive", obslib.UINT, scale=k),
+        obslib.Field("private_votes_exclusive", obslib.UINT, scale=k),
+        obslib.Field("lead", obslib.BOOL),
+        obslib.Field("event", obslib.DISCRETE, n=3),
+    )
+
+
+@struct.dataclass
+class State:
+    dag: D.Dag
+    public: jnp.ndarray  # defender-preferred block (simulated)
+    private: jnp.ndarray  # attacker-preferred block
+    event: jnp.ndarray  # EV_*
+    pending_append: jnp.ndarray  # attacker proposal awaiting Append (-1)
+    # episode bookkeeping (engine.ml:69-79)
+    time: jnp.ndarray
+    steps: jnp.ndarray
+    n_activations: jnp.ndarray
+    last_reward_attacker: jnp.ndarray
+    last_reward_defender: jnp.ndarray
+    last_progress: jnp.ndarray
+    last_chain_time: jnp.ndarray
+    last_sim_time: jnp.ndarray
+    key: jax.Array
+
+
+class BkSSZ(JaxEnv):
+    n_actions = 8
+
+    def __init__(self, k: int = 8, incentive_scheme: str = "constant",
+                 unit_observation: bool = True, max_steps_hint: int = 256):
+        assert incentive_scheme in ("constant", "block")
+        self.k = k
+        self.incentive_scheme = incentive_scheme
+        self.unit_observation = unit_observation
+        # <= 2 appends per step (attacker proposal + PoW/defender proposal)
+        self.capacity = 2 * max_steps_hint + 8
+        self.max_parents = k + 1
+        self.fields = obs_fields(k)
+        self.observation_length = len(self.fields)
+        self.low, self.high = obslib.low_high(self.fields, unit_observation)
+        self.policies = self._make_policies()
+
+    # -- protocol primitives (bk.ml) --------------------------------------
+
+    def is_block(self, dag, idx_mask):
+        return idx_mask & (dag.kind == BLOCK)
+
+    def votes_on(self, dag, b, extra_mask=None):
+        """Mask of votes confirming block b (bk.ml:100-103)."""
+        m = D.children_mask(dag, b) & (dag.kind == VOTE)
+        if extra_mask is not None:
+            m = m & extra_mask
+        return m
+
+    def leader_hash(self, dag, b):
+        """Hash of the block's leader vote (parent slot 1); genesis has
+        none -> +inf == max_pow (bk.ml:205-215)."""
+        v0 = dag.parents[b, 1]
+        return jnp.where(v0 >= 0, dag.pow_hash[jnp.maximum(v0, 0)], D.NO_POW)
+
+    def leader_hash_all(self, dag):
+        """(B,) leader hash per block slot."""
+        v0 = dag.parents[:, 1]
+        return jnp.where(v0 >= 0, dag.pow_hash[jnp.clip(v0, 0)], D.NO_POW)
+
+    def cmp_blocks(self, dag, x, y, vote_filter_mask):
+        """compare_blocks (bk.ml:217-226): height, then filtered confirming
+        votes, then smaller leader hash, then earlier defender visibility.
+        Returns >0 iff x is strictly preferred over y."""
+        nx = self.votes_on(dag, x, vote_filter_mask).sum()
+        ny = self.votes_on(dag, y, vote_filter_mask).sum()
+        key_x = (dag.height[x], nx, -self.leader_hash(dag, x), -dag.vis_d_since[x])
+        key_y = (dag.height[y], ny, -self.leader_hash(dag, y), -dag.vis_d_since[y])
+
+        def lex(a, b):
+            gt = jnp.bool_(False)
+            eq = jnp.bool_(True)
+            for xa, xb in zip(a, b):
+                gt = gt | (eq & (xa > xb))
+                eq = eq & (xa == xb)
+            return gt
+
+        return jnp.where(x == y, False, lex(key_x, key_y))
+
+    def update_head(self, dag, old, candidate, vote_filter_mask):
+        """bk.ml:228-231: switch only on strict improvement."""
+        better = self.cmp_blocks(dag, candidate, old, vote_filter_mask)
+        return jnp.where(better, candidate, old)
+
+    def quorum(self, dag, b, voter, vote_filter_mask, view_mask):
+        """bk.ml:233-279. Returns (found, parents_row) for a proposal on b
+        by `voter` — quorum of k votes, voter's smallest hash leading.
+        `view_mask` is the voter's visibility (the per-node view of
+        dag.ml:39-45): both the candidate votes and the replace-hash fast
+        path only see vertices in the view."""
+        k = self.k
+        votes = self.votes_on(dag, b, vote_filter_mask & view_mask)
+        mine = votes & (dag.aux == voter)
+        theirs = votes & (dag.aux != voter)
+        my_hash = jnp.where(mine, dag.pow_hash, jnp.inf).min()
+        # replace_hash: best leader among visible child blocks of b
+        child_blocks = D.children_mask(dag, b) & (dag.kind == BLOCK) & view_mask
+        replace_hash = jnp.where(
+            child_blocks, self.leader_hash_all(dag), jnp.inf).min()
+        nvotes = votes.sum()
+        nmine = mine.sum()
+
+        # case 1: k of my own votes, smallest hashes first
+        idx_mine, valid_mine = D.top_k_by(dag.pow_hash, mine, k)
+        # case 2: all of mine (nmine < k here) + their votes with hash >
+        # my_hash (keeps the voter leading), earliest seen first
+        theirs_ok = theirs & (dag.pow_hash > my_hash)
+        # attacker view visibility time == born time (see module docstring)
+        seen = jnp.where(voter == D.ATTACKER, dag.born_at, dag.vis_d_since)
+        idx_theirs, valid_theirs = D.top_k_by(seen, theirs_ok, k)
+        n_needed = k - nmine
+        take_theirs = jnp.arange(k) < n_needed
+        sel_mask = jnp.zeros((dag.capacity,), jnp.bool_)
+        sel_mask = sel_mask.at[idx_mine].max(valid_mine)
+        sel_mask = sel_mask.at[idx_theirs].max(valid_theirs & take_theirs)
+
+        case1 = nmine >= k
+        quorum_mask = jnp.where(
+            case1,
+            jnp.zeros((dag.capacity,), jnp.bool_).at[idx_mine].max(valid_mine),
+            sel_mask)
+
+        enough_theirs = theirs_ok.sum() >= n_needed
+        found = (replace_hash > my_hash) & (nvotes >= k) & (case1 | enough_theirs)
+
+        # parent row: [b, votes sorted ascending by hash] (bk.ml:110-132)
+        vidx, vvalid = D.top_k_by(dag.pow_hash, quorum_mask, k)
+        row = jnp.concatenate([jnp.array([b], jnp.int32),
+                               jnp.where(vvalid, vidx, D.NONE)])
+        return found, row
+
+    def reward_of_block(self, dag, parents_row, signer):
+        """Per-block coinbase at append time (bk.ml:151-176)."""
+        votes = parents_row[1:]
+        valid = votes >= 0
+        ids = dag.aux[jnp.clip(votes, 0)]
+        if self.incentive_scheme == "constant":
+            atk = (valid & (ids == D.ATTACKER)).sum().astype(jnp.float32)
+            dfn = (valid & (ids == D.DEFENDER)).sum().astype(jnp.float32)
+        else:  # block: leader takes k
+            atk = jnp.where(signer == D.ATTACKER, float(self.k), 0.0)
+            dfn = jnp.where(signer == D.DEFENDER, float(self.k), 0.0)
+        return atk, dfn
+
+    def append_proposal(self, dag, b, voter, vote_filter_mask, view_mask, time):
+        """Append a quorum proposal on b if possible; returns
+        (dag, idx_or_-1)."""
+        found, row = self.quorum(dag, b, voter, vote_filter_mask, view_mask)
+        atk, dfn = self.reward_of_block(dag, row, voter)
+        height = dag.height[b] + 1
+
+        def do_append(dag):
+            dag2, idx = D.append(
+                dag, row, kind=BLOCK, height=height, aux=0,
+                signer=voter, miner=voter,
+                vis_a=True, vis_d=(voter == D.DEFENDER),
+                time=time, reward_atk=atk, reward_def=dfn,
+                progress=(height * self.k).astype(jnp.float32),
+            )
+            return dag2, idx
+
+        dag2, idx = do_append(dag)
+        # roll back if not found: keep original dag
+        dag = jax.tree.map(lambda a, b_: jnp.where(found, a, b_), dag2, dag)
+        return dag, jnp.where(found, idx, D.NONE)
+
+    # -- env API ----------------------------------------------------------
+
+    def reset(self, key: jax.Array, params: EnvParams):
+        dag = D.empty(self.capacity, self.max_parents)
+        # genesis block (bk.ml:48)
+        dag, root = D.append(
+            dag, jnp.full((self.max_parents,), D.NONE, jnp.int32),
+            kind=BLOCK, height=0, miner=D.NONE, vis_a=True, vis_d=True,
+            time=0.0, progress=0.0)
+        z = jnp.int32(0)
+        f = jnp.float32(0.0)
+        state = State(
+            dag=dag, public=root, private=root,
+            event=jnp.int32(EV_POW), pending_append=D.NONE,
+            time=f, steps=z, n_activations=z,
+            last_reward_attacker=f, last_reward_defender=f,
+            last_progress=f, last_chain_time=f, last_sim_time=f,
+            key=key,
+        )
+        state = self._advance(state, params)
+        return state, self.observe(state)
+
+    def last_block(self, dag, x):
+        """bk.ml:78-87: the block a vertex belongs to."""
+        return jnp.where(dag.kind[x] == BLOCK, x, dag.parents[x, 0])
+
+    def _advance(self, state: State, params: EnvParams) -> State:
+        """Produce the next attacker interaction: pending self-append,
+        defender proposal, or one mining draw (engine.ml:108-121 collapsed)."""
+        dag = state.dag
+
+        def with_pending(state):
+            # Append event: private moves to the proposal (bk_ssz.ml:212)
+            return state.replace(
+                private=state.pending_append,
+                event=jnp.int32(EV_APPEND),
+                pending_append=D.NONE,
+            )
+
+        def without_pending(state):
+            dag = state.dag
+            # defender proposal on its preferred block (honest handler
+            # bk.ml:297-310 via quorum over defender-visible votes)
+            dag2, prop = self.append_proposal(
+                dag, state.public, jnp.int32(D.DEFENDER), dag.vis_d,
+                dag.vis_d, state.time)
+
+            def defender_proposes(state):
+                public = self.update_head(dag2, state.public, prop, dag2.vis_d)
+                return state.replace(dag=dag2, public=public,
+                                     event=jnp.int32(EV_NETWORK))
+
+            def mine(state):
+                dag = state.dag
+                key, k_dt, k_mine, k_hash = jax.random.split(state.key, 4)
+                dt = jax.random.exponential(k_dt) * params.activation_delay
+                time = state.time + dt
+                attacker = jax.random.uniform(k_mine) < params.alpha
+                powh = jax.random.uniform(k_hash)
+                target = jnp.where(attacker, state.private, state.public)
+                row = jnp.full((self.max_parents,), D.NONE, jnp.int32
+                               ).at[0].set(target)
+                miner = jnp.where(attacker, D.ATTACKER, D.DEFENDER)
+                dag, vote = D.append(
+                    dag, row, kind=VOTE, height=dag.height[target],
+                    aux=miner, pow_hash=powh, miner=miner,
+                    vis_a=True, vis_d=~attacker, time=time,
+                    progress=(dag.height[target] * self.k + 1).astype(jnp.float32))
+                # the defender's own vote lands on its preferred block, so
+                # its preference is unchanged; attacker-release preference
+                # flips happen at delivery time in _apply
+                return state.replace(
+                    dag=dag, public=state.public,
+                    event=jnp.where(attacker, EV_POW, EV_NETWORK).astype(jnp.int32),
+                    time=time, n_activations=state.n_activations + 1,
+                    key=key,
+                )
+
+            return jax.lax.cond(prop >= 0, defender_proposes, mine, state)
+
+        return jax.lax.cond(
+            state.pending_append >= 0, with_pending, without_pending, state)
+
+    def observe(self, state: State):
+        """bk_ssz.ml:225-263."""
+        dag = state.dag
+        ca = D.common_ancestor_by_height(dag, state.public, state.private)
+        pub_votes = self.votes_on(dag, state.public, dag.vis_d).sum()
+        priv_inc = self.votes_on(dag, state.private).sum()
+        priv_exc = self.votes_on(dag, state.private,
+                                 dag.miner == D.ATTACKER).sum()
+        votes_pub = self.votes_on(dag, state.public)
+        any_votes = votes_pub.any()
+        leader = jnp.argmin(jnp.where(votes_pub, dag.pow_hash, jnp.inf))
+        lead = any_votes & (dag.aux[leader] == D.ATTACKER)
+        return obslib.encode(
+            self.fields,
+            (
+                dag.height[state.public] - dag.height[ca],
+                dag.height[state.private] - dag.height[ca],
+                dag.height[state.private] - dag.height[state.public],
+                pub_votes,
+                priv_inc,
+                priv_exc,
+                lead,
+                state.event,
+            ),
+            self.unit_observation,
+        )
+
+    def _apply(self, state: State, action) -> State:
+        """bk_ssz.ml:265-331."""
+        dag = state.dag
+        k = self.k
+        is_adopt = (action == ADOPT_PROLONG) | (action == ADOPT_PROCEED)
+        is_override = (action == OVERRIDE_PROLONG) | (action == OVERRIDE_PROCEED)
+        is_match = (action == MATCH_PROLONG) | (action == MATCH_PROCEED)
+        is_release = is_override | is_match
+        proceed = action >= 4  # Proceed variants: inclusive vote filter
+
+        # release targeting (bk_ssz.ml:271-283)
+        h_pub = dag.height[state.public]
+        nv_pub = self.votes_on(dag, state.public, dag.vis_d).sum()
+        tgt_h = jnp.where(is_override & (nv_pub >= k), h_pub + 1, h_pub)
+        tgt_v = jnp.where(is_match, nv_pub,
+                          jnp.where(nv_pub >= k, 0, nv_pub + 1))
+
+        # walk private chain of blocks down to target height
+        blk = D.block_at_height(dag, state.private, tgt_h)
+        blk = jnp.maximum(blk, 0)
+        # if quorum-size votes requested, prefer an existing proposal child
+        child_blocks = D.children_mask(dag, blk) & (dag.kind == BLOCK)
+        has_prop = child_blocks.any()
+        first_prop = jnp.argmax(child_blocks)
+        use_prop = (tgt_v >= k) & has_prop
+        rel_block = jnp.where(use_prop, first_prop, blk)
+        rel_votes_n = jnp.where(use_prop, 0, tgt_v)
+        # release earliest-seen votes on the released block
+        votes = self.votes_on(dag, rel_block)
+        vidx, vvalid = D.top_k_by(dag.born_at, votes, self.capacity_topk)
+        take = jnp.arange(self.capacity_topk) < rel_votes_n
+        not_enough = votes.sum() < rel_votes_n
+        vote_mask = jnp.zeros((self.capacity,), jnp.bool_)
+        vote_mask = vote_mask.at[vidx].max(vvalid & take)
+        vote_mask = jnp.where(not_enough, votes, vote_mask)
+        rel_mask = vote_mask.at[rel_block].set(True)
+
+        released = D.release_chain(dag, rel_block, state.time)
+        # the chosen votes sit directly on the released block's chain, so a
+        # flat release covers their ancestry
+        released = D.release(released, vote_mask, state.time)
+        dag = jax.tree.map(
+            lambda a, b: jnp.where(is_release, a, b), released, dag)
+
+        # deliver to the simulated defender (bk_ssz.ml:196-205)
+        public = jnp.where(
+            is_release,
+            self.update_head(dag, state.public,
+                             self.last_block(dag, rel_block), dag.vis_d),
+            state.public)
+        private = jnp.where(is_adopt, public, state.private)
+
+        # attacker proposal (bk_ssz.ml:316-326)
+        vote_filter = jnp.where(proceed, dag.exists(),
+                                dag.miner == D.ATTACKER)
+        dag, prop = self.append_proposal(
+            dag, private, jnp.int32(D.ATTACKER), vote_filter, dag.vis_a,
+            state.time)
+
+        return state.replace(dag=dag, public=public, private=private,
+                             pending_append=prop)
+
+    @property
+    def capacity_topk(self):
+        return min(self.capacity, 2 * self.k + 8)
+
+    def step(self, state: State, action, params: EnvParams):
+        state = self._apply(state, action)
+        state = self._advance(state, params)
+        state = state.replace(steps=state.steps + 1)
+        dag = state.dag
+
+        # winner over [attacker pref, defender pref]; ties attacker first
+        # (engine.ml:196-206; referee compare: height then all votes,
+        # bk.ml:134-147)
+        n_pub = self.votes_on(dag, state.public).sum()
+        n_priv = self.votes_on(dag, state.private).sum()
+        pub_better = (dag.height[state.public] > dag.height[state.private]) | (
+            (dag.height[state.public] == dag.height[state.private])
+            & (n_pub > n_priv))
+        head = jnp.where(pub_better, state.public, state.private)
+
+        reward_attacker = dag.cum_atk[head]
+        reward_defender = dag.cum_def[head]
+        progress = (dag.height[head] * self.k).astype(jnp.float32)
+        chain_time = dag.born_at[head]
+
+        done = ~(
+            (state.steps < params.max_steps)
+            & (progress < params.max_progress)
+            & (state.time < params.max_time)
+        ) | dag.overflow
+
+        reward = reward_attacker - state.last_reward_attacker
+        info = {
+            "step_reward_attacker": reward,
+            "step_reward_defender": reward_defender - state.last_reward_defender,
+            "step_progress": progress - state.last_progress,
+            "step_chain_time": chain_time - state.last_chain_time,
+            "step_sim_time": state.time - state.last_sim_time,
+            "episode_reward_attacker": reward_attacker,
+            "episode_reward_defender": reward_defender,
+            "episode_progress": progress,
+            "episode_chain_time": chain_time,
+            "episode_sim_time": state.time,
+            "episode_n_steps": state.steps.astype(jnp.float32),
+            "episode_n_activations": state.n_activations.astype(jnp.float32),
+        }
+        state = state.replace(
+            last_reward_attacker=reward_attacker,
+            last_reward_defender=reward_defender,
+            last_progress=progress,
+            last_chain_time=chain_time,
+            last_sim_time=state.time,
+        )
+        return state, self.observe(state), reward, done, info
+
+    # -- policies (bk_ssz.ml:346-404) --------------------------------------
+
+    def decode_obs(self, obs):
+        vals = [
+            obslib.field_of_float(f, obs[..., i], self.unit_observation)
+            for i, f in enumerate(self.fields)
+        ]
+        return tuple(jnp.asarray(v, jnp.int32) for v in vals)
+
+    def _make_policies(self):
+        k = self.k
+
+        def wrap(fn):
+            def wrapped(obs):
+                (pub_b, priv_b, _, pub_v, priv_vi, priv_ve, lead, ev
+                 ) = self.decode_obs(obs)
+                return fn(pub_b, priv_b, pub_v, priv_vi, priv_ve, lead, ev)
+            return wrapped
+
+        def honest(pub_b, priv_b, pub_v, priv_vi, priv_ve, lead, ev):
+            return jnp.where(pub_b > priv_b, ADOPT_PROCEED, OVERRIDE_PROCEED)
+
+        def get_ahead(pub_b, priv_b, pub_v, priv_vi, priv_ve, lead, ev):
+            return jnp.where(
+                pub_b > priv_b, ADOPT_PROCEED,
+                jnp.where(pub_b < priv_b, OVERRIDE_PROCEED, WAIT_PROCEED))
+
+        def minor_delay(pub_b, priv_b, pub_v, priv_vi, priv_ve, lead, ev):
+            return jnp.where(
+                pub_b > priv_b, ADOPT_PROCEED,
+                jnp.where(pub_b == 0, WAIT_PROCEED, OVERRIDE_PROCEED))
+
+        def avoid_loss(pub_b, priv_b, pub_v, priv_vi, priv_ve, lead, ev):
+            # avoid_loss_alt (bk_ssz.ml:389-400)
+            hp = pub_b * k + pub_v
+            ap = priv_b * k + priv_vi
+            return jnp.where(
+                pub_b == 0, WAIT_PROCEED,
+                jnp.where(
+                    (pub_b == 1) & (hp == ap), MATCH_PROCEED,
+                    jnp.where(
+                        hp > ap, ADOPT_PROCEED,
+                        jnp.where(
+                            hp == ap - 1, OVERRIDE_PROCEED,
+                            jnp.where(pub_b < priv_b - 10,
+                                      OVERRIDE_PROCEED, WAIT_PROCEED)))))
+
+        return {
+            "honest": wrap(honest),
+            "get-ahead": wrap(get_ahead),
+            "minor-delay": wrap(minor_delay),
+            "avoid-loss": wrap(avoid_loss),
+        }

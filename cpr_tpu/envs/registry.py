@@ -43,8 +43,10 @@ def _ensure_builtin():
     global _BUILTIN_LOADED
     if _BUILTIN_LOADED:
         return
+    from cpr_tpu.envs.bk import BkSSZ
     from cpr_tpu.envs.nakamoto import NakamotoSSZ
 
     _BUILTIN_LOADED = True
-    if "nakamoto" not in _REGISTRY:
-        _REGISTRY["nakamoto"] = NakamotoSSZ
+    for key, factory in [("nakamoto", NakamotoSSZ), ("bk", BkSSZ)]:
+        if key not in _REGISTRY:
+            _REGISTRY[key] = factory

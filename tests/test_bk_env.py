@@ -1,0 +1,91 @@
+"""Bₖ env tests: stochastic integration checks in the style of the
+reference's orphan-rate batteries (cpr_protocols.ml:200-657) plus DAG
+structure invariants (the analog of the Rust gym's dag_check,
+gym/rust/src/generic/mod.rs:107)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cpr_tpu.core import dag as D
+from cpr_tpu.envs.bk import BLOCK, VOTE, BkSSZ
+from cpr_tpu.params import make_params
+
+
+@pytest.fixture(scope="module")
+def env():
+    return BkSSZ(k=4, incentive_scheme="constant", max_steps_hint=160)
+
+
+def run_policy(env, name, alpha, n_envs=192, episode_steps=128, seed=0):
+    params = make_params(alpha=alpha, gamma=0.5, max_steps=episode_steps)
+    policy = env.policies[name]
+    keys = jax.random.split(jax.random.PRNGKey(seed), n_envs)
+    stats = jax.vmap(
+        lambda k: env.episode_stats(k, params, policy, episode_steps + 32)
+    )(keys)
+    atk = np.asarray(stats["episode_reward_attacker"]).mean()
+    dfn = np.asarray(stats["episode_reward_defender"]).mean()
+    return atk / (atk + dfn)
+
+
+def test_honest_policy_yields_alpha(env):
+    # honest behaviour earns the compute share; constant rewards pay per
+    # vote included in a block (bk.ml:151-161)
+    for alpha in [0.2, 0.4]:
+        rel = run_policy(env, "honest", alpha)
+        assert abs(rel - alpha) < 0.04, (alpha, rel)
+
+
+def test_dag_structure_invariants(env):
+    """Roll an episode and check Bₖ validity (bk.ml:110-132) on the final
+    DAG: votes have one block parent at the same height; blocks have a
+    block parent at height-1 plus exactly k votes ordered by hash."""
+    params = make_params(alpha=0.35, gamma=0.5, max_steps=128)
+    state, obs = env.reset(jax.random.PRNGKey(3), params)
+    step = jax.jit(env.step)
+    policy = env.policies["get-ahead"]
+    for _ in range(128):
+        state, obs, r, done, info = step(state, policy(obs), params)
+    dag = state.dag
+    n = int(dag.n)
+    assert not bool(dag.overflow)
+    parents = np.asarray(dag.parents)[:n]
+    kind = np.asarray(dag.kind)[:n]
+    height = np.asarray(dag.height)[:n]
+    powh = np.asarray(dag.pow_hash)[:n]
+    for i in range(1, n):
+        ps = parents[i][parents[i] >= 0]
+        if kind[i] == VOTE:
+            assert len(ps) == 1
+            assert kind[ps[0]] == BLOCK
+            assert height[i] == height[ps[0]]
+            assert np.isfinite(powh[i])
+        else:
+            assert kind[ps[0]] == BLOCK
+            assert height[i] == height[ps[0]] + 1
+            votes = ps[1:]
+            assert len(votes) == env.k, (i, ps)
+            assert all(kind[v] == VOTE for v in votes)
+            hashes = powh[votes]
+            assert (np.diff(hashes) > 0).all(), "votes must be hash-ordered"
+
+
+def test_policies_run_and_terminate(env):
+    params = make_params(alpha=0.4, gamma=0.5, max_steps=96)
+    for name, policy in env.policies.items():
+        traj = env.rollout(jax.random.PRNGKey(5), params, policy, 200)
+        done = np.asarray(traj[3])
+        assert done.sum() >= 1, name  # episodes complete
+        actions = np.asarray(traj[1])
+        assert actions.min() >= 0 and actions.max() < env.n_actions
+
+
+def test_withholding_beats_honest_at_high_alpha(env):
+    # the avoid-loss policy should out-earn the honest share for a strong
+    # attacker (the reference's withholding experiments,
+    # experiments/simulate/withholding.ml)
+    rel_h = run_policy(env, "honest", 0.44)
+    rel_w = run_policy(env, "avoid-loss", 0.44, episode_steps=192)
+    assert rel_w > rel_h - 0.02, (rel_h, rel_w)
