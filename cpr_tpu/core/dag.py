@@ -153,9 +153,11 @@ def release(dag: Dag, mask, time) -> Dag:
     )
 
 
-def ancestors_mask(dag: Dag, v, max_iter: int | None = None) -> jnp.ndarray:
-    """(B,) mask of v and all its ancestors (bounded BFS over the parent
-    matrix; the analog of dagtools.ml:73-100 iterate_ancestors)."""
+def ancestors_mask(dag: Dag, v) -> jnp.ndarray:
+    """(B,) mask of v and all its ancestors (fixpoint BFS over the parent
+    matrix; the analog of dagtools.ml:73-100 iterate_ancestors). The loop
+    runs until the mask stops growing, <= DAG height iterations on any
+    DAG produced by `append` (parents always point at earlier slots)."""
     B = dag.capacity
     seed = jnp.zeros((B,), jnp.bool_).at[jnp.maximum(v, 0)].set(v >= 0)
 
@@ -209,10 +211,11 @@ def release_chain(dag: Dag, tip, time) -> Dag:
     return dag
 
 
-def walk_back(dag: Dag, tip, stop_fn, max_iter: int | None = None):
+def walk_back(dag: Dag, tip, stop_fn):
     """Follow parent slot 0 from `tip` while not stop_fn(dag, idx).
-    Bounded by the DAG height; the chain-walk primitive behind
-    `last_block`, height targeting, and common ancestors."""
+    Terminates at the root (parent -1) at the latest — <= DAG height
+    iterations; the chain-walk primitive behind `last_block`, height
+    targeting, and common ancestors."""
 
     def cond(i):
         return (i >= 0) & ~stop_fn(dag, i)

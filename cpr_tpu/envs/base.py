@@ -57,6 +57,42 @@ class JaxEnv:
     def step(self, state, action, params: EnvParams):
         raise NotImplementedError
 
+    def finish_step(self, state, params: EnvParams, *, reward_attacker,
+                    reward_defender, progress, chain_time,
+                    extra_done=False):
+        """Shared step epilogue (engine.ml:209-241): termination test,
+        reward delta, the step_/episode_ info dict, and the last_*
+        bookkeeping. Returns (state, obs, reward, done, info); the state
+        must carry the common bookkeeping fields (steps, time, last_*)."""
+        done = ~(
+            (state.steps < params.max_steps)
+            & (progress < params.max_progress)
+            & (state.time < params.max_time)
+        ) | extra_done
+        reward = reward_attacker - state.last_reward_attacker
+        info = {
+            "step_reward_attacker": reward,
+            "step_reward_defender": reward_defender - state.last_reward_defender,
+            "step_progress": progress - state.last_progress,
+            "step_chain_time": chain_time - state.last_chain_time,
+            "step_sim_time": state.time - state.last_sim_time,
+            "episode_reward_attacker": reward_attacker,
+            "episode_reward_defender": reward_defender,
+            "episode_progress": progress,
+            "episode_chain_time": chain_time,
+            "episode_sim_time": state.time,
+            "episode_n_steps": state.steps.astype(jnp.float32),
+            "episode_n_activations": state.n_activations.astype(jnp.float32),
+        }
+        state = state.replace(
+            last_reward_attacker=reward_attacker,
+            last_reward_defender=reward_defender,
+            last_progress=progress,
+            last_chain_time=chain_time,
+            last_sim_time=state.time,
+        )
+        return state, self.observe(state), reward, done, info
+
     # -- batched rollout helpers ------------------------------------------
 
     @partial(jax.jit, static_argnums=(0, 3, 4))

@@ -368,7 +368,10 @@ class BkSSZ(JaxEnv):
         # walk private chain of blocks down to target height
         blk = D.block_at_height(dag, state.private, tgt_h)
         blk = jnp.maximum(blk, 0)
-        # if quorum-size votes requested, prefer an existing proposal child
+        # if quorum-size votes requested, prefer an existing proposal
+        # child; the reference takes the FIRST child block in insertion
+        # order, not the best by leader hash (bk_ssz.ml:294-300), which
+        # lowest-slot argmax reproduces exactly
         child_blocks = D.children_mask(dag, blk) & (dag.kind == BLOCK)
         has_prop = child_blocks.any()
         first_prop = jnp.argmax(child_blocks)
@@ -383,7 +386,6 @@ class BkSSZ(JaxEnv):
         vote_mask = jnp.zeros((self.capacity,), jnp.bool_)
         vote_mask = vote_mask.at[vidx].max(vvalid & take)
         vote_mask = jnp.where(not_enough, votes, vote_mask)
-        rel_mask = vote_mask.at[rel_block].set(True)
 
         released = D.release_chain(dag, rel_block, state.time)
         # the chosen votes sit directly on the released block's chain, so a
@@ -430,40 +432,14 @@ class BkSSZ(JaxEnv):
             & (n_pub > n_priv))
         head = jnp.where(pub_better, state.public, state.private)
 
-        reward_attacker = dag.cum_atk[head]
-        reward_defender = dag.cum_def[head]
-        progress = (dag.height[head] * self.k).astype(jnp.float32)
-        chain_time = dag.born_at[head]
-
-        done = ~(
-            (state.steps < params.max_steps)
-            & (progress < params.max_progress)
-            & (state.time < params.max_time)
-        ) | dag.overflow
-
-        reward = reward_attacker - state.last_reward_attacker
-        info = {
-            "step_reward_attacker": reward,
-            "step_reward_defender": reward_defender - state.last_reward_defender,
-            "step_progress": progress - state.last_progress,
-            "step_chain_time": chain_time - state.last_chain_time,
-            "step_sim_time": state.time - state.last_sim_time,
-            "episode_reward_attacker": reward_attacker,
-            "episode_reward_defender": reward_defender,
-            "episode_progress": progress,
-            "episode_chain_time": chain_time,
-            "episode_sim_time": state.time,
-            "episode_n_steps": state.steps.astype(jnp.float32),
-            "episode_n_activations": state.n_activations.astype(jnp.float32),
-        }
-        state = state.replace(
-            last_reward_attacker=reward_attacker,
-            last_reward_defender=reward_defender,
-            last_progress=progress,
-            last_chain_time=chain_time,
-            last_sim_time=state.time,
+        return self.finish_step(
+            state, params,
+            reward_attacker=dag.cum_atk[head],
+            reward_defender=dag.cum_def[head],
+            progress=(dag.height[head] * self.k).astype(jnp.float32),
+            chain_time=dag.born_at[head],
+            extra_done=dag.overflow,
         )
-        return state, self.observe(state), reward, done, info
 
     # -- policies (bk_ssz.ml:346-404) --------------------------------------
 

@@ -1,0 +1,476 @@
+"""Ethereum PoW (uncle blocks) under the SSZ-like withholding attack
+space, on the DAG tensor substrate.
+
+Reference counterparts:
+- protocol: simulator/protocols/ethereum.ml — blocks with <= 2 uncles
+  (Byzantium) or unbounded uncles (Whitepaper), data {height, work, miner}
+  (ethereum.ml:66-70), uncle validity (recent within 6 generations, child
+  of a chain ancestor, not already in chain/uncles, ethereum.ml:102-151),
+  honest uncle selection over a 6-generation window with own-first,
+  oldest-first preference (ethereum.ml:226-279), constant and discount
+  reward schemes (ethereum.ml:174-198),
+- attack space: simulator/protocols/ethereum_ssz.ml — 10-field observation
+  (ethereum_ssz.ml:21-40), actions {Adopt_discard, Adopt_release,
+  Override, Match, Release1, Wait} x uncle mining rule {own, foreign}
+  (ethereum_ssz.ml:161-277), agent state machine (ethereum_ssz.ml:279-429),
+  policies honest/selfish_release/selfish_discard/fn19/fn19pkel
+  (ethereum_ssz.ml:444-538),
+- engine semantics: simulator/gym/engine.ml:97-273 (one env step per
+  attacker interaction, defender cloud, gamma via message ordering).
+
+TPU re-design: blocks live in the fixed-capacity DAG; parent slot 0 is the
+chain parent (the precursor — "uncles are not part of the linear history",
+ethereum.ml:165), slots 1..U hold uncle references. The 6-generation uncle
+window is a statically unrolled 6-step chain walk producing boolean
+candidate masks; uncle selection is a masked top-k with an (own-first,
+oldest-first) composite score (ethereum.ml:226-232). One env step is one
+attacker action + one Bernoulli(alpha) mining draw.
+
+Documented deviations from the reference:
+- The reference swaps the preference mapping: `LongestChain` compares
+  cumulative work and `HeaviestChain` compares height (ethereum.ml:80-84,
+  the names are crossed). We reproduce the *behavior*: preset
+  "whitepaper" prefers by work and progresses by height; preset
+  "byzantium" prefers by height and progresses by work. Policies follow
+  the same naming convention the reference uses (ethereum_ssz.ml:461-465).
+- Whitepaper's unbounded uncle cap becomes a static `uncle_cap`
+  (default 6): a tensor parents row needs a fixed width. Within the
+  2-party selfish-mining game more than 6 includable orphans do not occur
+  in practice (the 6-generation window bounds candidates).
+- gamma races follow the Nakamoto env's strict-match rule: a released tip
+  whose preference ties the defender head only splits defender compute
+  when the competing defender block has just arrived (event == Network) —
+  the propagation-race window of network.ml:61-105.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from cpr_tpu import obs as obslib
+from cpr_tpu.core import dag as D
+from cpr_tpu.envs.base import JaxEnv
+from cpr_tpu.params import EnvParams
+
+# events: Discrete [`ProofOfWork; `Network] (ethereum_ssz.ml:39)
+EV_POW, EV_NETWORK = 0, 1
+
+# action ranks (ethereum_ssz.ml:172-221, declaration order)
+ADOPT_DISCARD, ADOPT_RELEASE, OVERRIDE, MATCH, RELEASE1, WAIT = range(6)
+# uncle mining rules, index = own * 2 + foreign (ethereum_ssz.ml:238-241)
+N_UNCLE_RULES = 4
+
+OBS_FIELDS = (
+    obslib.Field("public_height", obslib.UINT, scale=1),
+    obslib.Field("public_work", obslib.UINT, scale=1),
+    obslib.Field("private_height", obslib.UINT, scale=1),
+    obslib.Field("private_work", obslib.UINT, scale=1),
+    obslib.Field("diff_height", obslib.INT, scale=1),
+    obslib.Field("diff_work", obslib.INT, scale=1),
+    obslib.Field("public_orphans", obslib.UINT, scale=1),
+    obslib.Field("private_orphans_inclusive", obslib.UINT, scale=1),
+    obslib.Field("private_orphans_exclusive", obslib.UINT, scale=1),
+    obslib.Field("event", obslib.DISCRETE, n=2),
+)
+
+UNCLE_WINDOW = 6  # generations (ethereum.ml:112, check_recent ethereum.ml:124-127)
+
+
+@struct.dataclass
+class State:
+    dag: D.Dag
+    public: jnp.ndarray  # defender cloud's preferred block
+    private: jnp.ndarray  # attacker's preferred block
+    event: jnp.ndarray  # EV_POW | EV_NETWORK
+    race_tip: jnp.ndarray  # released tip of a live preference-tie race (-1)
+    mining_own: jnp.ndarray  # bool, current uncle mining rule
+    mining_foreign: jnp.ndarray  # bool
+    # episode bookkeeping (engine.ml:69-79)
+    time: jnp.ndarray
+    steps: jnp.ndarray
+    n_activations: jnp.ndarray
+    last_reward_attacker: jnp.ndarray
+    last_reward_defender: jnp.ndarray
+    last_progress: jnp.ndarray
+    last_chain_time: jnp.ndarray
+    last_sim_time: jnp.ndarray
+    key: jax.Array
+
+
+class EthereumSSZ(JaxEnv):
+    """Ethereum withholding attack env, one step per attacker interaction."""
+
+    n_actions = 6 * N_UNCLE_RULES
+    obs_fields = OBS_FIELDS
+    observation_length = len(OBS_FIELDS)
+
+    def __init__(self, preset: str = "byzantium", *,
+                 preference: str | None = None, progress: str | None = None,
+                 max_uncles: int | None = None,
+                 incentive_scheme: str | None = None,
+                 uncle_cap: int = 6, unit_observation: bool = True,
+                 strict_match: bool = True, max_steps_hint: int = 256):
+        # presets (ethereum.ml:12-24; behavioral mapping, see module doc)
+        if preset == "whitepaper":
+            defaults = dict(preference="work", progress="height",
+                            max_uncles=None, incentive_scheme="constant")
+        elif preset == "byzantium":
+            defaults = dict(preference="height", progress="work",
+                            max_uncles=2, incentive_scheme="discount")
+        else:
+            raise ValueError(f"unknown preset {preset!r}")
+        self.preset = preset
+        self.preference = preference or defaults["preference"]
+        self.progress = progress or defaults["progress"]
+        mu = max_uncles if max_uncles is not None else defaults["max_uncles"]
+        self.max_uncles = min(mu, uncle_cap) if mu is not None else uncle_cap
+        self.incentive_scheme = (incentive_scheme
+                                 or defaults["incentive_scheme"])
+        assert self.preference in ("height", "work")
+        assert self.progress in ("height", "work")
+        assert self.incentive_scheme in ("constant", "discount")
+        self.unit_observation = unit_observation
+        self.strict_match = strict_match
+        # one block append per step + the reset draw
+        self.capacity = max_steps_hint + 8
+        self.max_parents = 1 + self.max_uncles
+        self.low, self.high = obslib.low_high(OBS_FIELDS, unit_observation)
+        self.policies = self._make_policies()
+
+    # -- protocol primitives (ethereum.ml) ---------------------------------
+
+    def pref(self, dag, b):
+        """Preference value of block b (ethereum.ml:80-84; aux = work)."""
+        if self.preference == "height":
+            return dag.height[b]
+        return dag.aux[b]
+
+    def pref_all(self, dag):
+        return dag.height if self.preference == "height" else dag.aux
+
+    def progress_of(self, dag, b):
+        v = dag.height[b] if self.progress == "height" else dag.aux[b]
+        return v.astype(jnp.float32)
+
+    def chain_window(self, dag, head):
+        """(nua, in_chain) masks for the uncle window at `head`
+        (ethereum.ml:237-246): `nua` = the up-to-6 proper chain ancestors,
+        `in_chain` = head plus the walked blocks and their included
+        uncles (anc6's uncles excluded, exactly like the reference)."""
+        B = dag.capacity
+        nua = jnp.zeros((B,), jnp.bool_)
+        in_chain = jnp.zeros((B,), jnp.bool_).at[jnp.maximum(head, 0)].set(
+            head >= 0)
+        b = head
+        for _ in range(UNCLE_WINDOW):
+            row = dag.parents[jnp.maximum(b, 0)]
+            p0 = row[0]
+            has = (b >= 0) & (p0 >= 0)
+            nua = nua.at[jnp.clip(p0, 0)].max(has)
+            in_chain = in_chain.at[jnp.clip(row, 0)].max((row >= 0) & has)
+            b = jnp.where(has, p0, jnp.int32(-1))
+        return nua, in_chain
+
+    def uncle_candidates(self, dag, head, view_mask, filter_mask):
+        """Mask of includable uncles for a block on `head`
+        (ethereum.ml:252-268): not in chain, chain parent among the
+        non-uncle ancestors, visible in the miner's view, passing the
+        mining-rule filter. Mask semantics dedupe candidates reachable via
+        several window blocks."""
+        nua, in_chain = self.chain_window(dag, head)
+        p0 = dag.parents[:, 0]
+        return (dag.exists() & view_mask & filter_mask
+                & (p0 >= 0) & nua[jnp.clip(p0, 0)] & ~in_chain)
+
+    def select_uncles(self, dag, cand_mask, own_mask):
+        """Top max_uncles candidates by (own first, lowest preference
+        first) (ethereum.ml:226-232, Compare.at_most_first). Returns
+        (idx, valid) of width max_uncles."""
+        big = jnp.float32(1e7)
+        score = (jnp.where(own_mask, 0.0, big)
+                 + self.pref_all(dag).astype(jnp.float32))
+        return D.top_k_by(score, cand_mask, self.max_uncles)
+
+    def make_block(self, dag, head, view_mask, filter_mask, miner, time,
+                   vis_d):
+        """Append a block on `head` with selected uncles; computes work,
+        height, and the miner/uncle rewards (ethereum.ml:174-198,270-277)."""
+        cand = self.uncle_candidates(dag, head, view_mask, filter_mask)
+        own = dag.miner == miner
+        uidx, uvalid = self.select_uncles(dag, cand, own)
+        n_uncles = uvalid.sum()
+        height = dag.height[head] + 1
+        work = dag.aux[head] + 1 + n_uncles
+
+        # rewards (ethereum.ml:174-198): including miner 1 + n*1/32;
+        # uncle miners 15/16 (constant) or (8-delta)/8 (discount)
+        u_miner = dag.miner[jnp.clip(uidx, 0)]
+        if self.incentive_scheme == "constant":
+            u_reward = jnp.where(uvalid, 0.9375, 0.0)
+        else:
+            delta = (height - dag.height[jnp.clip(uidx, 0)]).astype(jnp.float32)
+            u_reward = jnp.where(uvalid, (8.0 - delta) / 8.0, 0.0)
+        miner_reward = 1.0 + n_uncles.astype(jnp.float32) * 0.03125
+        atk = (jnp.where(u_miner == D.ATTACKER, u_reward, 0.0).sum()
+               + jnp.where(miner == D.ATTACKER, miner_reward, 0.0))
+        dfn = (jnp.where(u_miner == D.DEFENDER, u_reward, 0.0).sum()
+               + jnp.where(miner == D.DEFENDER, miner_reward, 0.0))
+
+        row = jnp.concatenate([
+            jnp.array([head], jnp.int32),
+            jnp.where(uvalid, uidx, D.NONE).astype(jnp.int32),
+        ])
+        dag, idx = D.append(
+            dag, row, kind=0, height=height, aux=work, miner=miner,
+            vis_a=True, vis_d=vis_d, time=time,
+            reward_atk=atk, reward_def=dfn,
+            progress=(height if self.progress == "height" else work
+                      ).astype(jnp.float32),
+        )
+        return dag, idx
+
+    def update_head(self, dag, old, candidate):
+        """Strict preference improvement (ethereum.ml:281-285)."""
+        better = self.pref(dag, candidate) > self.pref(dag, old)
+        return jnp.where(better, candidate, old)
+
+    # -- env API -----------------------------------------------------------
+
+    def reset(self, key: jax.Array, params: EnvParams):
+        dag = D.empty(self.capacity, self.max_parents)
+        dag, root = D.append(
+            dag, jnp.full((self.max_parents,), D.NONE, jnp.int32),
+            kind=0, height=0, aux=0, miner=D.NONE, vis_a=True, vis_d=True,
+            time=0.0, progress=0.0)
+        z = jnp.int32(0)
+        f = jnp.float32(0.0)
+        state = State(
+            dag=dag, public=root, private=root,
+            event=jnp.int32(EV_POW), race_tip=jnp.int32(-1),
+            mining_own=jnp.bool_(True), mining_foreign=jnp.bool_(True),
+            time=f, steps=z, n_activations=z,
+            last_reward_attacker=f, last_reward_defender=f,
+            last_progress=f, last_chain_time=f, last_sim_time=f,
+            key=key,
+        )
+        state = self._mine(state, params)
+        return state, self.observe(state)
+
+    def _mine(self, state: State, params: EnvParams) -> State:
+        """One activation (simulator.ml:465-472 collapsed): Bernoulli(alpha)
+        miner choice; the defender cloud splits by gamma while a
+        preference-tie race is live."""
+        dag = state.dag
+        key, k_dt, k_mine, k_gamma = jax.random.split(state.key, 4)
+        dt = jax.random.exponential(k_dt) * params.activation_delay
+        time = state.time + dt
+        attacker_mines = jax.random.uniform(k_mine) < params.alpha
+        gamma_hit = jax.random.uniform(k_gamma) < params.gamma
+
+        race_live = (state.race_tip >= 0) & (
+            self.pref(dag, jnp.maximum(state.race_tip, 0))
+            == self.pref(dag, state.public))
+        def_parent = jnp.where(race_live & gamma_hit,
+                               jnp.maximum(state.race_tip, 0), state.public)
+
+        atk_filter = (jnp.where(state.mining_own,
+                                dag.miner == D.ATTACKER, False)
+                      | jnp.where(state.mining_foreign,
+                                  dag.miner == D.DEFENDER, False))
+        head = jnp.where(attacker_mines, state.private, def_parent)
+        view = jnp.where(attacker_mines, dag.vis_a, dag.vis_d)
+        filt = jnp.where(attacker_mines, atk_filter, dag.exists())
+        miner = jnp.where(attacker_mines, D.ATTACKER, D.DEFENDER)
+        dag, blk = self.make_block(
+            dag, head, view, filt, miner, time,
+            vis_d=~attacker_mines)
+
+        private = jnp.where(attacker_mines, blk, state.private)
+        public = jnp.where(attacker_mines, state.public,
+                           self.update_head(dag, state.public, blk))
+        # a defender block ends any race: either it extends the race tip
+        # (which then wins by preference) or it reasserts the public chain
+        race_tip = jnp.where(attacker_mines, state.race_tip, -1)
+        return state.replace(
+            dag=dag, private=private, public=public, race_tip=race_tip,
+            event=jnp.where(attacker_mines, EV_POW, EV_NETWORK
+                            ).astype(jnp.int32),
+            time=time, n_activations=state.n_activations + 1, key=key,
+        )
+
+    def _release_upto(self, dag, private, target):
+        """Find the first block walking back from `private` with
+        preference <= target (ethereum_ssz.ml:404-412)."""
+        pref_all = self.pref_all(dag)
+
+        def stop(dag_, i):
+            return pref_all[i] <= target
+
+        return D.walk_back(dag, private, stop)
+
+    def _apply(self, state: State, action) -> State:
+        """ethereum_ssz.ml:398-429."""
+        dag = state.dag
+        act = action // N_UNCLE_RULES
+        uncle_rule = action % N_UNCLE_RULES
+        mining_own = uncle_rule >= 2
+        mining_foreign = (uncle_rule % 2) == 1
+
+        is_adopt = (act == ADOPT_DISCARD) | (act == ADOPT_RELEASE)
+        pub_pref = self.pref(dag, state.public)
+        ca = D.common_ancestor_by_height(dag, state.public, state.private)
+        target = jnp.where(
+            act == MATCH, pub_pref,
+            jnp.where(act == OVERRIDE, pub_pref + 1,
+                      jnp.where(act == RELEASE1,
+                                self.pref(dag, ca) + 1,
+                                jnp.int32(0))))
+        release_tip = jnp.where(
+            act == ADOPT_RELEASE, state.private,
+            self._release_upto(dag, state.private, target))
+        do_release = (act == ADOPT_RELEASE) | (act == OVERRIDE) \
+            | (act == MATCH) | (act == RELEASE1)
+        release_tip = jnp.where(do_release, release_tip, jnp.int32(-1))
+
+        released = D.release_with_ancestors(
+            dag, release_tip, state.time)
+        dag = jax.tree.map(
+            lambda a, b: jnp.where(do_release, a, b), released, dag)
+
+        # deliver the released tip to the defender cloud
+        public = jnp.where(
+            do_release,
+            self.update_head(dag, state.public,
+                             jnp.maximum(release_tip, 0)),
+            state.public)
+        private = jnp.where(is_adopt, public, state.private)
+
+        # a release that ties the (possibly just updated) public head arms
+        # the propagation race, in the match window (module doc)
+        tie = do_release & (release_tip >= 0) & (
+            self.pref(dag, jnp.maximum(release_tip, 0))
+            == self.pref(dag, public)) & (
+                jnp.maximum(release_tip, 0) != public)
+        if self.strict_match:
+            tie = tie & (state.event == EV_NETWORK)
+        race_tip = jnp.where(tie, release_tip, state.race_tip)
+
+        return state.replace(
+            dag=dag, public=public, private=private, race_tip=race_tip,
+            mining_own=mining_own, mining_foreign=mining_foreign,
+        )
+
+    def observe(self, state: State):
+        """ethereum_ssz.ml:364-396."""
+        dag = state.dag
+        ca = D.common_ancestor_by_height(dag, state.public, state.private)
+        ph = dag.height[state.public] - dag.height[ca]
+        pw = dag.aux[state.public] - dag.aux[ca]
+        ah = dag.height[state.private] - dag.height[ca]
+        aw = dag.aux[state.private] - dag.aux[ca]
+        # orphan counts are draft uncle counts, capped by max_uncles
+        pub_orph = jnp.minimum(
+            self.uncle_candidates(dag, state.public, dag.vis_a,
+                                  dag.vis_d).sum(),
+            self.max_uncles)
+        inc = jnp.minimum(
+            self.uncle_candidates(dag, state.private, dag.vis_a,
+                                  dag.miner >= 0).sum(),
+            self.max_uncles)
+        exc = jnp.minimum(
+            self.uncle_candidates(dag, state.private, dag.vis_a,
+                                  dag.miner == D.ATTACKER).sum(),
+            self.max_uncles)
+        return obslib.encode(
+            OBS_FIELDS,
+            (ph, pw, ah, aw, ah - ph, aw - pw, pub_orph, inc, exc,
+             state.event),
+            self.unit_observation,
+        )
+
+    def step(self, state: State, action, params: EnvParams):
+        state = self._apply(state, action)
+        state = self._mine(state, params)
+        state = state.replace(steps=state.steps + 1)
+        dag = state.dag
+
+        # winner over [attacker pref, defender pref], ties to the attacker
+        # (ethereum.ml:159-162; node 0 first, engine.ml:196-206)
+        pub_better = (self.pref(dag, state.public)
+                      > self.pref(dag, state.private))
+        head = jnp.where(pub_better, state.public, state.private)
+
+        return self.finish_step(
+            state, params,
+            reward_attacker=dag.cum_atk[head],
+            reward_defender=dag.cum_def[head],
+            progress=self.progress_of(dag, head),
+            chain_time=dag.born_at[head],
+            extra_done=dag.overflow,
+        )
+
+    # -- policies (ethereum_ssz.ml:444-538) --------------------------------
+
+    def decode_obs(self, obs):
+        vals = [
+            obslib.field_of_float(f, obs[..., i], self.unit_observation)
+            for i, f in enumerate(OBS_FIELDS)
+        ]
+        return tuple(jnp.asarray(v, jnp.int32) for v in vals)
+
+    def _pref_fields(self, ph, pw, ah, aw):
+        """Observation fields the reference policies compare, following its
+        naming convention (ethereum_ssz.ml:461-465): whitepaper
+        (`LongestChain`) compares heights, byzantium (`HeaviestChain`)
+        compares works."""
+        if self.preset == "whitepaper":
+            return ah, ph
+        return aw, pw
+
+    def _make_policies(self):
+        # uncle rule indices: own*2 + foreign
+        ALL, OWN_ONLY = 3, 2
+
+        def enc(a, u):
+            return a * N_UNCLE_RULES + u
+
+        def wrap(fn):
+            def wrapped(obs):
+                ph, pw, ah, aw, _, _, _, _, _, ev = self.decode_obs(obs)
+                return fn(ph, pw, ah, aw, ev)
+            return wrapped
+
+        def honest(ph, pw, ah, aw, ev):
+            return jnp.where(pw > 0, enc(ADOPT_RELEASE, ALL),
+                             enc(OVERRIDE, ALL))
+
+        def selfish(adopt_act):
+            def pol(ph, pw, ah, aw, ev):
+                priv, pub = self._pref_fields(ph, pw, ah, aw)
+                return jnp.where(
+                    priv < pub, enc(adopt_act, OWN_ONLY),
+                    jnp.where(pub == 0, enc(WAIT, OWN_ONLY),
+                              enc(OVERRIDE, OWN_ONLY)))
+            return pol
+
+        def fn19_body(adopt_act, rule):
+            def pol(ph, pw, ah, aw, ev):
+                pow_branch = jnp.where((ah == 2) & (ph == 1),
+                                       enc(OVERRIDE, rule), enc(WAIT, rule))
+                net_branch = jnp.where(
+                    ah < ph, enc(adopt_act, rule),
+                    jnp.where(ah == ph, enc(MATCH, rule),
+                              jnp.where(ah == ph + 1, enc(OVERRIDE, rule),
+                                        enc(RELEASE1, rule))))
+                return jnp.where(ev == EV_POW, pow_branch, net_branch)
+            return pol
+
+        return {
+            "honest": wrap(honest),
+            "selfish_release": wrap(selfish(ADOPT_RELEASE)),
+            "selfish_discard": wrap(selfish(ADOPT_DISCARD)),
+            "fn19": wrap(fn19_body(ADOPT_DISCARD, ALL)),
+            "fn19pkel": wrap(fn19_body(ADOPT_RELEASE, OWN_ONLY)),
+        }

@@ -234,35 +234,13 @@ class NakamotoSSZ(JaxEnv):
         progress = state.ca_progress + jnp.maximum(state.a, state.h).astype(jnp.float32)
         chain_time = jnp.where(head_private, state.t_priv, state.t_pub)
 
-        done = ~(
-            (state.steps < params.max_steps)
-            & (progress < params.max_progress)
-            & (state.time < params.max_time)
+        return self.finish_step(
+            state, params,
+            reward_attacker=reward_attacker,
+            reward_defender=reward_defender,
+            progress=progress,
+            chain_time=chain_time,
         )
-
-        reward = reward_attacker - state.last_reward_attacker
-        info = {
-            "step_reward_attacker": reward,
-            "step_reward_defender": reward_defender - state.last_reward_defender,
-            "step_progress": progress - state.last_progress,
-            "step_chain_time": chain_time - state.last_chain_time,
-            "step_sim_time": state.time - state.last_sim_time,
-            "episode_reward_attacker": reward_attacker,
-            "episode_reward_defender": reward_defender,
-            "episode_progress": progress,
-            "episode_chain_time": chain_time,
-            "episode_sim_time": state.time,
-            "episode_n_steps": state.steps.astype(jnp.float32),
-            "episode_n_activations": state.n_activations.astype(jnp.float32),
-        }
-        state = state.replace(
-            last_reward_attacker=reward_attacker,
-            last_reward_defender=reward_defender,
-            last_progress=progress,
-            last_chain_time=chain_time,
-            last_sim_time=state.time,
-        )
-        return state, self.observe(state), reward, done, info
 
     # -- built-in policies (nakamoto_ssz.ml:274-350) ----------------------
 

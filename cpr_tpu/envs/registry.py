@@ -44,9 +44,18 @@ def _ensure_builtin():
     if _BUILTIN_LOADED:
         return
     from cpr_tpu.envs.bk import BkSSZ
+    from cpr_tpu.envs.ethereum import EthereumSSZ
     from cpr_tpu.envs.nakamoto import NakamotoSSZ
 
     _BUILTIN_LOADED = True
-    for key, factory in [("nakamoto", NakamotoSSZ), ("bk", BkSSZ)]:
+    for key, factory in [
+        ("nakamoto", NakamotoSSZ),
+        ("bk", BkSSZ),
+        ("ethereum", EthereumSSZ),
+        ("ethereum-whitepaper",
+         lambda **kw: EthereumSSZ("whitepaper", **kw)),
+        ("ethereum-byzantium",
+         lambda **kw: EthereumSSZ("byzantium", **kw)),
+    ]:
         if key not in _REGISTRY:
             _REGISTRY[key] = factory

@@ -88,4 +88,6 @@ def test_withholding_beats_honest_at_high_alpha(env):
     # experiments/simulate/withholding.ml)
     rel_h = run_policy(env, "honest", 0.44)
     rel_w = run_policy(env, "avoid-loss", 0.44, episode_steps=192)
-    assert rel_w > rel_h - 0.02, (rel_h, rel_w)
+    # measured ~0.44 honest vs ~0.59 avoid-loss; require a real margin
+    assert rel_w > rel_h + 0.05, (rel_h, rel_w)
+    assert rel_w > 0.44 + 0.05, rel_w
