@@ -46,6 +46,7 @@ def _ensure_builtin():
     from cpr_tpu.envs.bk import BkSSZ
     from cpr_tpu.envs.ethereum import EthereumSSZ
     from cpr_tpu.envs.nakamoto import NakamotoSSZ
+    from cpr_tpu.envs.tailstorm import TailstormSSZ
 
     _BUILTIN_LOADED = True
     for key, factory in [
@@ -56,6 +57,7 @@ def _ensure_builtin():
          lambda **kw: EthereumSSZ("whitepaper", **kw)),
         ("ethereum-byzantium",
          lambda **kw: EthereumSSZ("byzantium", **kw)),
+        ("tailstorm", TailstormSSZ),
     ]:
         if key not in _REGISTRY:
             _REGISTRY[key] = factory

@@ -1,0 +1,830 @@
+"""Tailstorm under the SSZ-like withholding attack space, on the DAG
+tensor substrate.
+
+Reference counterparts:
+- protocol: simulator/protocols/tailstorm.ml — summaries (no PoW) + depth-
+  labelled vote trees (tailstorm.ml:54-72), validity (tailstorm.ml:156-180),
+  summary preference by (height, confirming votes) (tailstorm.ml:183-194),
+  reward schemes constant/discount/punish/hybrid (tailstorm.ml:204-227),
+  sub-block selection altruistic_quorum (tailstorm.ml:271-313),
+  heuristic_quorum (tailstorm.ml:329-380), optimal_quorum with 100-option
+  cap + heuristic fallback (tailstorm.ml:418-506), honest handler
+  (tailstorm.ml:565-608),
+- attack space: simulator/protocols/tailstorm_ssz.ml — 10-field observation
+  (tailstorm_ssz.ml:22-38), Action8 (ssz_tools.ml:230-263), agent with
+  deferred private->public delivery (tailstorm_ssz.ml:210-219), release =
+  smallest descendant prefix that flips (Override) or ties (Match) the
+  defender's head (tailstorm_ssz.ml:292-314), summary (re-)appending with
+  inclusive/exclusive vote filters (tailstorm_ssz.ml:322-346), policies
+  honest/get-ahead/minor-delay/avoid-loss{,-a,-b}/long-delay
+  (tailstorm_ssz.ml:365-472),
+- engine semantics: simulator/gym/engine.ml:97-273 (one env step per
+  attacker interaction, defender cloud, gamma via message ordering).
+
+TPU re-design: blocks live in the fixed-capacity DAG; a vote's single
+parent sits in slot 0; a summary's parents are its quorum leaves sorted by
+(depth desc, hash asc), the deepest leaf in slot 0 (the precursor —
+tailstorm.ml:196). Votes record their summary in the `signer` column, so
+`confirming_votes` (tailstorm.ml:151-154) is one masked compare instead of
+a DAG traversal; vote trees are forests of parent-pointer paths, so branch
+closures are bounded pointer walks (depth <= D_MAX). Quorum selection is a
+<= k-round greedy loop whose per-round scores are vectorized closure
+counts. One env step processes exactly one attacker event: a pending
+self-append, a defender summary, or one mining draw.
+
+Documented deviations from the reference event-queue simulation:
+- `optimal` sub-block selection maps to `heuristic`. The reference already
+  falls back to heuristic beyond 100 n-choose-k options
+  (tailstorm.ml:426-428) — for the default k=8 that means any window with
+  more than 10 confirming votes; the exhaustive search only kicks in on
+  tiny windows.
+- The defender cloud attempts one summary append per delivery batch
+  (quorum over its visible votes) instead of one per delivered vertex;
+  same-height summary *replacement* by the defender
+  (tailstorm.ml:557-563) is not emulated. The attacker side re-appends
+  replacements exactly as the reference agent does
+  (tailstorm_ssz.ml:335-342).
+- gamma races follow the Nakamoto env's rule: a Match ties the defender's
+  head, and the next defender activation mines on the attacker's released
+  summary with probability gamma (network.ml:61-105 collapsed to one
+  Bernoulli draw).
+- Vote-tree depth walks are capped at D_MAX = 3k+8; deeper withheld
+  branches (unreachable under the reference's own policies, which cut
+  forks at 10 blocks) would truncate closure counts.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from cpr_tpu import obs as obslib
+from cpr_tpu.core import dag as D
+from cpr_tpu.envs.base import JaxEnv
+from cpr_tpu.params import EnvParams
+
+# kinds
+SUMMARY, VOTE = 0, 1
+
+# events: Discrete [`Append; `ProofOfWork; `Network] (tailstorm_ssz.ml:54)
+EV_APPEND, EV_POW, EV_NETWORK = 0, 1, 2
+
+# Action8 ranks (ssz_tools.ml:230-263)
+(ADOPT_PROLONG, OVERRIDE_PROLONG, MATCH_PROLONG, WAIT_PROLONG,
+ ADOPT_PROCEED, OVERRIDE_PROCEED, MATCH_PROCEED, WAIT_PROCEED) = range(8)
+
+INCENTIVE_SCHEMES = ("constant", "discount", "punish", "hybrid")
+SUBBLOCK_SELECTIONS = ("altruistic", "heuristic", "optimal")
+
+
+def obs_fields(k: int):
+    """tailstorm_ssz.ml:41-55."""
+    return (
+        obslib.Field("public_blocks", obslib.UINT, scale=1),
+        obslib.Field("private_blocks", obslib.UINT, scale=1),
+        obslib.Field("diff_blocks", obslib.INT, scale=1),
+        obslib.Field("public_votes", obslib.UINT, scale=k),
+        obslib.Field("private_votes_inclusive", obslib.UINT, scale=k),
+        obslib.Field("private_votes_exclusive", obslib.UINT, scale=k),
+        obslib.Field("public_depth", obslib.UINT, scale=k),
+        obslib.Field("private_depth_inclusive", obslib.UINT, scale=k),
+        obslib.Field("private_depth_exclusive", obslib.UINT, scale=k),
+        obslib.Field("event", obslib.DISCRETE, n=3),
+    )
+
+
+@struct.dataclass
+class State:
+    dag: D.Dag
+    public: jnp.ndarray  # defender-preferred summary (simulated)
+    private: jnp.ndarray  # attacker-preferred summary
+    event: jnp.ndarray  # EV_*
+    pending_append: jnp.ndarray  # attacker summary awaiting Append (-1)
+    match_tgt: jnp.ndarray  # live match race target summary (-1: none)
+    def_dirty: jnp.ndarray  # bool: defender gained votes since last attempt
+    stale: jnp.ndarray  # (B,) bool: withheld blocks abandoned at an Adopt
+    # episode bookkeeping (engine.ml:69-79)
+    time: jnp.ndarray
+    steps: jnp.ndarray
+    n_activations: jnp.ndarray
+    last_reward_attacker: jnp.ndarray
+    last_reward_defender: jnp.ndarray
+    last_progress: jnp.ndarray
+    last_chain_time: jnp.ndarray
+    last_sim_time: jnp.ndarray
+    key: jax.Array
+
+
+class TailstormSSZ(JaxEnv):
+    n_actions = 8
+
+    def __init__(self, k: int = 8, incentive_scheme: str = "discount",
+                 subblock_selection: str = "heuristic",
+                 unit_observation: bool = True, max_steps_hint: int = 256,
+                 release_scan: int = 128):
+        assert incentive_scheme in INCENTIVE_SCHEMES
+        assert subblock_selection in SUBBLOCK_SELECTIONS
+        self.k = k
+        self.incentive_scheme = incentive_scheme
+        # `optimal` falls back to `heuristic` (see module docstring)
+        self.subblock_selection = (
+            "heuristic" if subblock_selection == "optimal"
+            else subblock_selection)
+        self.unit_observation = unit_observation
+        # <= 2 appends per step (attacker summary + defender summary/vote)
+        self.capacity = 2 * max_steps_hint + 8
+        self.max_parents = k
+        self.D_MAX = 3 * k + 8  # vote-path walk bound
+        self.C_MAX = 4 * k + 16  # quorum candidate window (compacted)
+        self.STALE_WALK = 4  # summary-chain descent check depth at Adopt
+        assert self.C_MAX < (1 << 8), "composite sort keys use 8 bits"
+        self.release_scan = release_scan
+        self.fields = obs_fields(k)
+        self.observation_length = len(self.fields)
+        self.low, self.high = obslib.low_high(self.fields, unit_observation)
+        self.policies = self._make_policies()
+
+    # -- protocol primitives (tailstorm.ml) --------------------------------
+
+    def confirming(self, dag, s, extra_mask=None):
+        """Votes confirming summary s (tailstorm.ml:151-154): votes store
+        their summary in the `signer` column at append time."""
+        m = dag.exists() & (dag.kind == VOTE) & (dag.signer == s)
+        if extra_mask is not None:
+            m = m & extra_mask
+        return m
+
+    def last_summary(self, dag, x):
+        """tailstorm.ml:113-121."""
+        return jnp.where(dag.kind[x] == SUMMARY, x, dag.signer[x])
+
+    def prev_summary(self, dag, s):
+        """Summary preceding s on the chain: the deepest quorum leaf's
+        summary (tailstorm.ml:196 precursor, followed to the next
+        summary). -1 for genesis."""
+        p0 = dag.parents[s, 0]
+        return jnp.where(p0 >= 0, self.last_summary(dag, jnp.maximum(p0, 0)),
+                         jnp.int32(-1))
+
+    def summary_lca(self, dag, a, b):
+        """Common ancestor of two summaries along the summary chain
+        (dagtools.ml:102-121 re-shaped; heights drop by exactly 1 per
+        prev_summary step, so tie-stepping both converges)."""
+
+        def cond(state):
+            x, y = state
+            return (x != y) & (x >= 0) & (y >= 0)
+
+        def body(state):
+            x, y = state
+            hx, hy = dag.height[x], dag.height[y]
+            step_x = hx >= hy
+            step_y = hy >= hx
+            return (jnp.where(step_x, self.prev_summary(dag, x), x),
+                    jnp.where(step_y, self.prev_summary(dag, y), y))
+
+        x, _ = jax.lax.while_loop(cond, body, (a, b))
+        return jnp.maximum(x, 0)
+
+    def vote_ancestors(self, dag, starts):
+        """(C, D_MAX) vote-path matrix: row i lists starts[i] and its vote
+        ancestors (up to, excluding, the summary), -1 padded — the
+        vectorized `acc_votes parents [x]` (tailstorm.ml:134-149). Votes
+        have a single parent, so the closure of a vote is a path. Invalid
+        starts (-1) produce all -1 rows."""
+        is_vote = dag.kind == VOTE
+        cur = jnp.where(
+            (starts >= 0) & is_vote[jnp.maximum(starts, 0)], starts, -1)
+        cols = []
+        for _ in range(self.D_MAX):
+            cols.append(cur)
+            c = jnp.maximum(cur, 0)
+            nxt = dag.parents[c, 0]
+            ok = (cur >= 0) & (nxt >= 0) & is_vote[jnp.maximum(nxt, 0)]
+            cur = jnp.where(ok, nxt, -1)
+        return jnp.stack(cols, axis=1)
+
+    def closure_counts(self, anc, masks):
+        """(C, M) counts of masked vertices along each candidate's vote
+        path. masks is (B, M) bool; anc (C, D_MAX) from
+        `vote_ancestors`."""
+        B = masks.shape[0]
+        pad = jnp.concatenate(
+            [masks, jnp.zeros((1, masks.shape[1]), masks.dtype)], axis=0)
+        idx = jnp.where(anc >= 0, anc, B)
+        return pad[idx].sum(axis=1).astype(jnp.int32)
+
+    def mark_closure(self, anc_row, mask, on=True):
+        """mask |= the vote path listed in anc_row (D_MAX,)."""
+        valid = (anc_row >= 0) & jnp.asarray(on)
+        return mask.at[jnp.maximum(anc_row, 0)].max(valid)
+
+    def own_reward(self, dag, s, my):
+        """The summary's own coinbase share for party `my` — used as the
+        update_head tiebreak (tailstorm.ml:539-549). Delta of the
+        cumulative column across the precursor summary."""
+        cum = jnp.where(my == D.ATTACKER, dag.cum_atk, dag.cum_def)
+        prev = self.prev_summary(dag, s)
+        return cum[s] - jnp.where(prev >= 0, cum[jnp.maximum(prev, 0)], 0.0)
+
+    def cmp_summaries(self, dag, x, y, vote_filter_mask, my):
+        """compare_blocks (tailstorm.ml:539-549): height, then filtered
+        confirming votes, then own reward. >0 iff x strictly preferred."""
+        nx = self.confirming(dag, x, vote_filter_mask).sum()
+        ny = self.confirming(dag, y, vote_filter_mask).sum()
+        rx = self.own_reward(dag, x, my)
+        ry = self.own_reward(dag, y, my)
+        key_x = (dag.height[x], nx, rx)
+        key_y = (dag.height[y], ny, ry)
+        gt = jnp.bool_(False)
+        eq = jnp.bool_(True)
+        for a, b in zip(key_x, key_y):
+            gt = gt | (eq & (a > b))
+            eq = eq & (a == b)
+        return jnp.where(x == y, False, gt)
+
+    def update_head(self, dag, old, candidate, vote_filter_mask, my):
+        """tailstorm.ml:552-555: switch only on strict improvement."""
+        better = self.cmp_summaries(dag, candidate, old, vote_filter_mask, my)
+        return jnp.where(better, candidate, old)
+
+    # -- quorum selection ---------------------------------------------------
+
+    def _candidate_frame(self, dag, cand):
+        """Compact the candidate votes to C_MAX slot-ascending indices and
+        build the candidate-local ancestor bit-matrix abits (C, C):
+        abits[i, j] == candidate j lies on candidate i's vote path
+        (including i == j). The reference reaches candidates through a
+        *filtered* child traversal (tailstorm.ml:509-531), so a vote whose
+        path leaves the candidate set is unreachable — such rows are
+        invalidated. With abits in registers, every quorum round is dense
+        boolean algebra on (C, C) — no gathers on the hot path."""
+        C = self.C_MAX
+        slot_f = dag.slots().astype(jnp.float32)
+        cidx, cvalid = D.top_k_by(slot_f, cand, C)
+        cidx = jnp.where(cvalid, cidx, -1)
+        ci = jnp.maximum(cidx, 0)
+        # one parent edge per candidate (votes have a single parent);
+        # express it as a dense one-hot row and close transitively with
+        # log-doubling boolean matmuls — MXU-friendly, no gathers/scatters
+        par = dag.parents[ci, 0]
+        par_is_vote = cvalid & (par >= 0) & (dag.kind[jnp.maximum(par, 0)]
+                                             == VOTE)
+        big = jnp.int32(jnp.iinfo(jnp.int32).max)
+        sorted_slots = jnp.where(cidx >= 0, cidx, big)
+        pos = jnp.clip(jnp.searchsorted(sorted_slots, jnp.maximum(par, 0)),
+                       0, C - 1).astype(jnp.int32)
+        par_in = par_is_vote & (sorted_slots[pos] == jnp.maximum(par, 0))
+        # parent is a vote outside the candidate set -> the path escapes
+        # the filtered traversal, which the reference can never follow
+        escaped = par_is_vote & ~par_in
+        adj = ((jnp.arange(C)[None, :] == jnp.where(par_in, pos, -1)[:, None])
+               .astype(jnp.float32))
+        reach = adj + jnp.eye(C, dtype=jnp.float32)
+        n_doublings = max(1, (C - 1).bit_length())
+        for _ in range(n_doublings):
+            reach = jnp.minimum(reach + reach @ reach, 1.0)
+        abits = reach > 0.0
+        cvalid = cvalid & ~(abits & escaped[None, :]).any(axis=1)
+        abits = abits & cvalid[:, None]
+        return cidx, cvalid, abits
+
+    def _quorum_heuristic(self, dag, cidx, cvalid, abits, own):
+        """heuristic_quorum (tailstorm.ml:329-380): greedily include the
+        branch maximizing (own fresh reward, total fresh reward), ties by
+        DAG order; <= k rounds since every round includes >= 1 vote."""
+        C = cidx.shape[0]
+        k = self.k
+        own_c = own[jnp.maximum(cidx, 0)] & cvalid
+
+        def body(_, carry):
+            inc, leaves_c, n_rem = carry
+            fresh = abits & ~inc[None, :]
+            f_all = fresh.sum(axis=1)
+            f_own = (fresh & own_c[None, :]).sum(axis=1)
+            eligible = cvalid & ~inc & (f_all >= 1) & (f_all <= n_rem)
+            # lexicographic (own desc, all desc, slot asc) as one int32;
+            # candidates are slot-ascending so local index == DAG order
+            score = ((f_own * (k + 1) + f_all) << 8) + (C - jnp.arange(C))
+            score = jnp.where(eligible & (n_rem > 0), score, -1)
+            c = jnp.argmax(score).astype(jnp.int32)
+            ok = score[c] >= 0
+            inc = inc | (abits[c] & ok)
+            leaves_c = leaves_c.at[c].max(ok)
+            return inc, leaves_c, n_rem - jnp.where(ok, f_all[c], 0)
+
+        z = jnp.zeros((C,), jnp.bool_)
+        _, leaves_c, n_rem = jax.lax.fori_loop(
+            0, k, body, (z, z, jnp.int32(k)))
+        return (n_rem == 0) & (cvalid.sum() >= k), leaves_c
+
+    def _quorum_altruistic(self, dag, cidx, cvalid, abits, own, seen):
+        """altruistic_quorum (tailstorm.ml:271-313): scan candidates by
+        (depth desc, own first, seen asc), greedily adding whole branches
+        that still fit."""
+        C = cidx.shape[0]
+        k = self.k
+        ci = jnp.maximum(cidx, 0)
+        depth = jnp.minimum(dag.aux[ci], 4 * k)  # 6-bit field
+        own_c = own[ci]
+        seen_rank = jnp.argsort(jnp.argsort(seen[ci])).astype(jnp.int32)
+        comp = ((((jnp.int32(4 * k) - depth) << 1 | (~own_c).astype(jnp.int32))
+                 << 8) + seen_rank) << 8
+        comp = comp + jnp.arange(C, dtype=jnp.int32)  # stable: DAG order
+        order = jnp.argsort(jnp.where(cvalid, comp, jnp.iinfo(jnp.int32).max))
+        n_cand = cvalid.sum()
+
+        def cond(carry):
+            i, _, _, n = carry
+            return (n < k) & (i < n_cand)
+
+        def body(carry):
+            i, acc, leaves_c, n = carry
+            c = order[i]
+            fresh = (abits[c] & ~acc).sum()
+            take = (fresh >= 1) & (n + fresh <= k)
+            acc = acc | (abits[c] & take)
+            leaves_c = leaves_c.at[c].max(take)
+            return i + 1, acc, leaves_c, n + jnp.where(take, fresh, 0)
+
+        z = jnp.zeros((C,), jnp.bool_)
+        _, _, leaves_c, n = jax.lax.while_loop(
+            cond, body, (jnp.int32(0), z, z, jnp.int32(0)))
+        return (n == k) & (n_cand >= k), leaves_c
+
+    def quorum(self, dag, b, voter, vote_filter_mask, view_mask):
+        """Select k sub-blocks confirming b; returns (found, parents_row)
+        with leaves sorted by (depth desc, hash asc)
+        (compare_votes_in_block, tailstorm.ml:124-130). Candidates are
+        compacted to the first C_MAX slots (a quorum window holds ~k
+        votes; overflow beyond C_MAX drops the newest candidates)."""
+        cand = self.confirming(dag, b) & vote_filter_mask & view_mask
+        own = dag.miner == voter
+        cidx, cvalid, abits = self._candidate_frame(dag, cand)
+        if self.subblock_selection == "altruistic":
+            seen = jnp.where(voter == D.ATTACKER, dag.born_at,
+                             dag.vis_d_since)
+            found, leaves_c = self._quorum_altruistic(
+                dag, cidx, cvalid, abits, own, seen)
+        else:
+            found, leaves_c = self._quorum_heuristic(
+                dag, cidx, cvalid, abits, own)
+        leaves = jnp.zeros((dag.capacity,), jnp.bool_).at[
+            jnp.maximum(cidx, 0)].max(leaves_c & cvalid)
+        score = dag.aux.astype(jnp.float32) - dag.pow_hash  # depth - hash
+        idx, valid = D.top_k_by(score, leaves, self.k, largest=True)
+        row = jnp.where(valid, idx, D.NONE).astype(jnp.int32)
+        return found, row
+
+    def summary_reward(self, dag, row):
+        """Coinbase of a summary draft (tailstorm.ml:204-227)."""
+        discount = self.incentive_scheme in ("discount", "hybrid")
+        punish = self.incentive_scheme in ("punish", "hybrid")
+        B = dag.capacity
+        leaves = row[:1] if punish else row
+        anc = self.vote_ancestors(dag, leaves)
+        closure = jnp.zeros((B,), jnp.bool_)
+        for i in range(anc.shape[0]):
+            closure = self.mark_closure(anc[i], closure)
+        depth0 = dag.aux[jnp.maximum(row[0], 0)]
+        r = jnp.where(discount, depth0.astype(jnp.float32) / self.k, 1.0)
+        atk = r * (closure & (dag.miner == D.ATTACKER)).sum()
+        dfn = r * (closure & (dag.miner == D.DEFENDER)).sum()
+        return atk, dfn
+
+    def append_summary(self, dag, b, voter, vote_filter_mask, view_mask,
+                       time):
+        """Append the next summary on b if a quorum exists; returns
+        (dag, idx_or_-1, fresh) (tailstorm.ml:530-537).
+
+        Summaries carry no PoW, so appends are deterministic and must be
+        deduplicated against existing summaries with identical parent rows
+        (simulator.ml:138-158 — redundant appends return the existing
+        vertex and trigger no events). Rows are canonical (sorted by
+        depth desc, hash asc), so row equality == quorum equality."""
+        found, row = self.quorum(dag, b, voter, vote_filter_mask,
+                                 view_mask)
+        atk, dfn = self.summary_reward(dag, row)
+        height = dag.height[b] + 1
+        dup_mask = (dag.exists() & (dag.kind == SUMMARY)
+                    & (dag.height == height)
+                    & (dag.parents == row[None, :]).all(axis=1))
+        dup = jnp.where(dup_mask.any(),
+                        jnp.argmax(dup_mask), D.NONE).astype(jnp.int32)
+        fresh = found & (dup < 0)
+        dag2, idx = D.append(
+            dag, row, kind=SUMMARY, height=height, aux=0,
+            signer=D.NONE, miner=voter,
+            vis_a=True, vis_d=(voter == D.DEFENDER),
+            time=time, reward_atk=atk, reward_def=dfn,
+            progress=(height * self.k).astype(jnp.float32),
+        )
+        dag = jax.tree.map(lambda a, b_: jnp.where(fresh, a, b_), dag2, dag)
+        out = jnp.where(fresh, idx, jnp.where(found, dup, D.NONE))
+        return dag, out, fresh
+
+    def mine_vote(self, dag, pref, voter, view_mask, time, pow_hash):
+        """puzzle_payload (tailstorm.ml:509-528): vote on the deepest
+        visible branch confirming the preferred summary."""
+        cand = self.confirming(dag, pref, view_mask)
+        score = dag.aux.astype(jnp.float32) - dag.pow_hash
+        parent = jnp.where(cand.any(),
+                           jnp.argmax(jnp.where(cand, score, -jnp.inf)),
+                           pref).astype(jnp.int32)
+        depth = jnp.where(cand.any(), dag.aux[parent] + 1, 1)
+        height = dag.height[pref]
+        row = jnp.full((self.max_parents,), D.NONE, jnp.int32).at[0].set(parent)
+        dag, idx = D.append(
+            dag, row, kind=VOTE, height=height, aux=depth,
+            pow_hash=pow_hash, signer=pref, miner=voter,
+            vis_a=True, vis_d=(voter == D.DEFENDER), time=time,
+            progress=(height * self.k + depth).astype(jnp.float32),
+        )
+        return dag, idx
+
+    # -- env API ------------------------------------------------------------
+
+    def reset(self, key: jax.Array, params: EnvParams):
+        dag = D.empty(self.capacity, self.max_parents)
+        # genesis summary, height 0 (tailstorm.ml:84)
+        dag, root = D.append(
+            dag, jnp.full((self.max_parents,), D.NONE, jnp.int32),
+            kind=SUMMARY, height=0, miner=D.NONE, vis_a=True, vis_d=True,
+            time=0.0, progress=0.0)
+        z = jnp.int32(0)
+        f = jnp.float32(0.0)
+        state = State(
+            dag=dag, public=root, private=root,
+            event=jnp.int32(EV_POW), pending_append=D.NONE,
+            match_tgt=D.NONE, def_dirty=jnp.bool_(False),
+            stale=jnp.zeros((self.capacity,), jnp.bool_),
+            time=f, steps=z, n_activations=z,
+            last_reward_attacker=f, last_reward_defender=f,
+            last_progress=f, last_chain_time=f, last_sim_time=f,
+            key=key,
+        )
+        state = self._advance(state, params)
+        return state, self.observe(state)
+
+    def _advance(self, state: State, params: EnvParams) -> State:
+        """Next attacker interaction: pending self-append, defender
+        summary, or one mining draw (engine.ml:108-121 collapsed)."""
+
+        def with_pending(state):
+            # Append event: attacker learns its own summary
+            # (tailstorm_ssz.ml:228-235)
+            dag = state.dag
+            private = self.update_head(
+                dag, state.private, state.pending_append, dag.vis_a,
+                jnp.int32(D.ATTACKER))
+            return state.replace(
+                private=private, event=jnp.int32(EV_APPEND),
+                pending_append=D.NONE)
+
+        def without_pending(state):
+            def try_def_append(state):
+                dag, s, fresh = self.append_summary(
+                    state.dag, state.public, jnp.int32(D.DEFENDER),
+                    state.dag.vis_d, state.dag.vis_d, state.time)
+
+                def announced(state):
+                    public = self.update_head(
+                        dag, state.public, s, dag.vis_d, jnp.int32(D.DEFENDER))
+                    return state.replace(
+                        dag=dag, public=public, event=jnp.int32(EV_NETWORK),
+                        def_dirty=jnp.bool_(False))
+
+                def silent_or_mine(state):
+                    # redundant append: the identical summary already
+                    # exists (possibly appended withheld by the attacker);
+                    # the defender adopts it without a new attacker
+                    # interaction (simulator.ml:138-158 + engine
+                    # skip_to_interaction)
+                    def adopt_dup(state):
+                        dag2 = dag.replace(
+                            vis_d=dag.vis_d.at[jnp.maximum(s, 0)].set(True))
+                        public = self.update_head(
+                            dag2, state.public, s, dag2.vis_d,
+                            jnp.int32(D.DEFENDER))
+                        return state.replace(dag=dag2, public=public)
+
+                    state = jax.lax.cond(
+                        s >= 0, adopt_dup, lambda st: st, state)
+                    return mine(state.replace(def_dirty=jnp.bool_(False)))
+
+                return jax.lax.cond(fresh, announced, silent_or_mine, state)
+
+            def mine(state):
+                dag = state.dag
+                key, k_dt, k_mine, k_hash, k_gamma = jax.random.split(
+                    state.key, 5)
+                dt = jax.random.exponential(k_dt) * params.activation_delay
+                time = state.time + dt
+                attacker = jax.random.uniform(k_mine) < params.alpha
+                powh = jax.random.uniform(k_hash)
+
+                # gamma race: defender mines on the matched release
+                # (network.ml:61-105 collapsed); dead once either side is
+                # strictly preferred (defenders only split between
+                # equal-preference tips)
+                tgt = jnp.maximum(state.match_tgt, 0)
+                still_tie = (
+                    ~self.cmp_summaries(dag, state.public, tgt, dag.vis_d,
+                                        jnp.int32(D.DEFENDER))
+                    & ~self.cmp_summaries(dag, tgt, state.public, dag.vis_d,
+                                          jnp.int32(D.DEFENDER)))
+                gamma_hit = (~attacker & (state.match_tgt >= 0) & still_tie
+                             & (jax.random.uniform(k_gamma) < params.gamma))
+                public = jnp.where(gamma_hit, jnp.maximum(state.match_tgt, 0),
+                                   state.public)
+                match_tgt = jnp.where(attacker, state.match_tgt, D.NONE)
+
+                voter = jnp.where(attacker, D.ATTACKER, D.DEFENDER)
+                pref = jnp.where(attacker, state.private, public)
+                view = jnp.where(attacker, dag.vis_a, dag.vis_d)
+                dag, _ = self.mine_vote(dag, pref, voter, view, time, powh)
+                return state.replace(
+                    dag=dag, public=public, match_tgt=match_tgt,
+                    event=jnp.where(attacker, EV_POW, EV_NETWORK
+                                    ).astype(jnp.int32),
+                    def_dirty=state.def_dirty | ~attacker,
+                    time=time, n_activations=state.n_activations + 1,
+                    key=key,
+                )
+
+            return jax.lax.cond(state.def_dirty, try_def_append, mine, state)
+
+        return jax.lax.cond(
+            state.pending_append >= 0, with_pending, without_pending, state)
+
+    def observe(self, state: State):
+        """tailstorm_ssz.ml:262-290."""
+        dag = state.dag
+        ca = self.summary_lca(dag, state.public, state.private)
+
+        def depth_count(mask):
+            return (jnp.where(mask, dag.aux, 0).max(), mask.sum())
+
+        pub_d, pub_v = depth_count(self.confirming(dag, state.public,
+                                                   dag.vis_d))
+        inc_d, inc_v = depth_count(self.confirming(dag, state.private))
+        exc_d, exc_v = depth_count(self.confirming(
+            dag, state.private, dag.miner == D.ATTACKER))
+        return obslib.encode(
+            self.fields,
+            (
+                dag.height[state.public] - dag.height[ca],
+                dag.height[state.private] - dag.height[ca],
+                dag.height[state.private] - dag.height[state.public],
+                pub_v, inc_v, exc_v,
+                pub_d, inc_d, exc_d,
+                state.event,
+            ),
+            self.unit_observation,
+        )
+
+    def _release_sets(self, state: State):
+        """tailstorm_ssz.ml:292-314: scan the withheld descendants of the
+        common ancestor in DAG (= slot, topological) order; the Override
+        set is the smallest prefix whose release flips the defender's
+        head, the Match set is that prefix minus the flipping vertex. If
+        no prefix flips, both release everything.
+
+        TPU re-design: the sequential scan becomes dense prefix algebra
+        over the (compacted) withheld candidates — for every prefix j the
+        defender's head-comparison terms are cumulative counts, so all
+        prefixes are evaluated at once and the stop index is an argmax.
+        "Descendant of the common ancestor" is tracked incrementally via
+        the `stale` bit (blocks withheld at an Adopt are abandoned forever,
+        which is when and only when the common ancestor passes them);
+        after a partial release the approximation can retain a few
+        vertices the reference would skip — they release harmlessly."""
+        dag = state.dag
+        R = self.release_scan
+        B = dag.capacity
+        cands = dag.exists() & ~dag.vis_d & ~state.stale
+        slot_f = dag.slots().astype(jnp.float32)
+        ridx, rvalid = D.top_k_by(slot_f, cands, R)
+        ri = jnp.maximum(ridx, 0)
+        ls = jnp.where(rvalid, self.last_summary(dag, ri), 0)  # (R,)
+
+        is_vote = dag.exists() & (dag.kind == VOTE)
+        # votes visible to the defender confirming each prefix-candidate's
+        # summary: (B, R) compare + reduce
+        conf_vis = ((is_vote & dag.vis_d)[:, None]
+                    & (dag.signer[:, None] == ls[None, :])).sum(axis=0)
+        # released candidates i <= j confirming ls_j
+        cand_vote = (dag.kind[ri] == VOTE) & rvalid
+        csig = dag.signer[ri]
+        cmat = cand_vote[:, None] & (csig[:, None] == ls[None, :])
+        leq = jnp.triu(jnp.ones((R, R), jnp.bool_))  # i <= j
+        nconf = conf_vis + (cmat & leq).sum(axis=0)
+
+        pub = state.public
+        pub_vis = (is_vote & dag.vis_d & (dag.signer == pub)).sum()
+        npub = pub_vis + jnp.cumsum(cand_vote & (csig == pub))
+
+        h_ls, h_pub = dag.height[ls], dag.height[pub]
+        my = jnp.int32(D.DEFENDER)
+        r_ls, r_pub = self.own_reward(dag, ls, my), self.own_reward(dag, pub, my)
+        # compare_blocks (tailstorm.ml:539-549), strict
+        flip = (h_ls > h_pub) | (
+            (h_ls == h_pub) & ((nconf > npub) | (
+                (nconf == npub) & (r_ls > r_pub))))
+        flip = flip & (ls != pub) & rvalid
+        n_withheld = cands.sum()
+        overflow = n_withheld > R
+        found = flip.any() & ~overflow
+        j_stop = jnp.argmax(flip).astype(jnp.int32)
+        take_o = jnp.where(found, jnp.arange(R) <= j_stop, rvalid)
+        take_m = jnp.where(found, jnp.arange(R) < j_stop, rvalid)
+        z = jnp.zeros((B,), jnp.bool_)
+        override_set = z.at[ri].max(take_o & rvalid)
+        match_set = z.at[ri].max(take_m & rvalid)
+        # window overflow (> R withheld vertices): fall back to releasing
+        # everything, and let the release flip the head iff the attacker's
+        # preferred summary beats the public one once fully visible
+        override_set = jnp.where(overflow, cands, override_set)
+        match_set = jnp.where(overflow, cands, match_set)
+        all_flip = self.cmp_summaries(dag, state.private, pub,
+                                      dag.vis_d | cands, my)
+        found = found | (overflow & all_flip)
+        new_head = jnp.where(
+            overflow, jnp.where(all_flip, state.private, pub),
+            jnp.where(found, ls[j_stop], pub))
+        return override_set, match_set, found, new_head
+
+    def _apply(self, state: State, action) -> State:
+        """tailstorm_ssz.ml:292-350."""
+        dag = state.dag
+        is_adopt = (action == ADOPT_PROLONG) | (action == ADOPT_PROCEED)
+        is_override = (action == OVERRIDE_PROLONG) | (action == OVERRIDE_PROCEED)
+        is_match = (action == MATCH_PROLONG) | (action == MATCH_PROCEED)
+        is_release = is_override | is_match
+        proceed = action >= 4  # Proceed: inclusive vote filter
+
+        override_set, match_set, found, new_head = self._release_sets(state)
+        mask = jnp.where(is_override, override_set,
+                         jnp.where(is_match, match_set, jnp.zeros_like(match_set)))
+        released = D.release(dag, mask, state.time)
+        dag = jax.tree.map(
+            lambda a, b: jnp.where(is_release, a, b), released, dag)
+
+        # deliver to the simulated defender
+        public = jnp.where(is_override & found, new_head, state.public)
+        private = jnp.where(is_adopt, public, state.private)
+        def_dirty = state.def_dirty | (is_release & mask.any())
+        # adopting moves the common ancestor to `public`: withheld blocks
+        # NOT descending from it are abandoned for good. Descent is checked
+        # on the compacted withheld set by walking each block's summary
+        # chain down STALE_WALK levels (deeper withheld branches above the
+        # adopted head cannot exist: the attacker adopts because it is
+        # behind)
+        withheld = ~dag.vis_d & dag.exists() & ~state.stale
+        widx, wvalid = D.top_k_by(dag.slots().astype(jnp.float32), withheld,
+                                  self.release_scan)
+        wi = jnp.maximum(widx, 0)
+        cur = self.last_summary(dag, wi)
+        keeps = jnp.zeros_like(wvalid)
+        for _ in range(self.STALE_WALK):
+            keeps = keeps | (cur == public)
+            cur = jnp.where(cur >= 0, self.prev_summary(
+                dag, jnp.maximum(cur, 0)), -1)
+        keep_mask = jnp.zeros_like(withheld).at[wi].max(keeps & wvalid)
+        stale = jnp.where(is_adopt, state.stale | (withheld & ~keep_mask),
+                          state.stale)
+
+        # match race target: deepest released summary's chain tip; armed
+        # only when a flipping prefix exists (found), i.e. the released
+        # set ties the defender's head — a blind release-all is no race
+        rel_tip = jnp.where(match_set, dag.slots(), -1).max()
+        match_tgt = jnp.where(
+            is_match & found & (rel_tip >= 0),
+            self.last_summary(dag, jnp.maximum(rel_tip, 0)),
+            jnp.where(is_adopt | is_override, D.NONE, state.match_tgt))
+
+        # append replacement/extension summary (tailstorm_ssz.ml:322-346)
+        vote_filter = jnp.where(proceed, dag.exists(),
+                                dag.miner == D.ATTACKER)
+        has_conf = self.confirming(dag, private).any()
+        prev = self.prev_summary(dag, private)
+        extend = jnp.where(has_conf | (prev < 0), private, prev)
+        dag, pending, fresh = self.append_summary(
+            dag, extend, jnp.int32(D.ATTACKER), vote_filter, dag.vis_a,
+            state.time)
+        # redundant appends produce no Append interaction (the vertex is
+        # already attacker-visible, so no OnNode event fires)
+        pending = jnp.where(fresh, pending, D.NONE)
+
+        return state.replace(dag=dag, public=public, private=private,
+                             match_tgt=match_tgt, def_dirty=def_dirty,
+                             stale=stale, pending_append=pending)
+
+    def step(self, state: State, action, params: EnvParams):
+        state = self._apply(state, action)
+        state = self._advance(state, params)
+        state = state.replace(steps=state.steps + 1)
+        dag = state.dag
+
+        # winner: compare_summaries = (height, confirming votes), ties to
+        # the attacker (engine.ml:196-206; tailstorm.ml:183-194)
+        n_pub = self.confirming(dag, state.public).sum()
+        n_priv = self.confirming(dag, state.private).sum()
+        pub_better = (dag.height[state.public] > dag.height[state.private]) | (
+            (dag.height[state.public] == dag.height[state.private])
+            & (n_pub > n_priv))
+        head = jnp.where(pub_better, state.public, state.private)
+
+        return self.finish_step(
+            state, params,
+            reward_attacker=dag.cum_atk[head],
+            reward_defender=dag.cum_def[head],
+            progress=(dag.height[head] * self.k).astype(jnp.float32),
+            chain_time=dag.born_at[head],
+            extra_done=dag.overflow,
+        )
+
+    # -- policies (tailstorm_ssz.ml:365-472) --------------------------------
+
+    def decode_obs(self, obs):
+        vals = [
+            obslib.field_of_float(f, obs[..., i], self.unit_observation)
+            for i, f in enumerate(self.fields)
+        ]
+        return tuple(jnp.asarray(v, jnp.int32) for v in vals)
+
+    def _make_policies(self):
+        k = self.k
+
+        def wrap(fn):
+            def wrapped(obs):
+                (pub_b, priv_b, _, pub_v, priv_vi, priv_ve,
+                 _pd, _id, _ed, _ev) = self.decode_obs(obs)
+                return fn(pub_b, priv_b, pub_v, priv_vi, priv_ve)
+            return wrapped
+
+        def honest(pub_b, priv_b, pub_v, priv_vi, priv_ve):
+            return jnp.where(pub_b > priv_b, ADOPT_PROCEED, OVERRIDE_PROCEED)
+
+        def get_ahead(pub_b, priv_b, pub_v, priv_vi, priv_ve):
+            return jnp.where(
+                pub_b > priv_b, ADOPT_PROCEED,
+                jnp.where(pub_b < priv_b, OVERRIDE_PROCEED, WAIT_PROCEED))
+
+        def minor_delay(pub_b, priv_b, pub_v, priv_vi, priv_ve):
+            return jnp.where(
+                pub_b > priv_b, ADOPT_PROCEED,
+                jnp.where(pub_b == 0, WAIT_PROCEED, OVERRIDE_PROCEED))
+
+        def long_delay(pub_b, priv_b, pub_v, priv_vi, priv_ve):
+            return jnp.where(
+                pub_b > priv_b, ADOPT_PROCEED,
+                jnp.where(
+                    pub_b == 0, WAIT_PROCEED,
+                    jnp.where(
+                        pub_b + 10 < priv_b, OVERRIDE_PROCEED,
+                        jnp.where(
+                            pub_b * k + pub_v + 1 < priv_b * k + priv_vi,
+                            WAIT_PROCEED, OVERRIDE_PROCEED))))
+
+        def avoid_loss_a(pub_b, priv_b, pub_v, priv_vi, priv_ve):
+            # avoid_loss (tailstorm_ssz.ml:407-422)
+            return jnp.where(
+                priv_b < pub_b, ADOPT_PROCEED,
+                jnp.where(
+                    pub_b == 0, WAIT_PROCEED,
+                    jnp.where(
+                        (priv_vi == 0) & (priv_b == pub_b + 1),
+                        OVERRIDE_PROCEED,
+                        jnp.where(
+                            (pub_b == priv_b) & (priv_vi == pub_v + 1),
+                            OVERRIDE_PROCEED,
+                            jnp.where(priv_b - pub_b > 10,
+                                      OVERRIDE_PROCEED, WAIT_PROCEED)))))
+
+        def _avoid_loss_alt(match_action):
+            def fn(pub_b, priv_b, pub_v, priv_vi, priv_ve):
+                hp = pub_b * k + pub_v
+                ap = priv_b * k + priv_vi
+                return jnp.where(
+                    pub_b == 0, WAIT_PROCEED,
+                    jnp.where(
+                        (pub_b == 1) & (hp == ap), match_action,
+                        jnp.where(
+                            hp > ap, ADOPT_PROCEED,
+                            jnp.where(
+                                hp == ap - 1, OVERRIDE_PROCEED,
+                                jnp.where(pub_b < priv_b - 10,
+                                          OVERRIDE_PROCEED, WAIT_PROCEED)))))
+            return fn
+
+        return {
+            "honest": wrap(honest),
+            "get-ahead": wrap(get_ahead),
+            "minor-delay": wrap(minor_delay),
+            "avoid-loss": wrap(_avoid_loss_alt(MATCH_PROCEED)),
+            "avoid-loss-a": wrap(avoid_loss_a),
+            "avoid-loss-b": wrap(_avoid_loss_alt(OVERRIDE_PROCEED)),
+            "long-delay": wrap(long_delay),
+        }

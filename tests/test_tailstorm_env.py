@@ -1,0 +1,146 @@
+"""Tailstorm env tests: stochastic integration checks in the style of the
+reference's orphan-rate batteries (cpr_protocols.ml:200-657) plus DAG
+structure invariants mirroring tailstorm.ml:156-180 validity."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cpr_tpu.core import dag as D
+from cpr_tpu.envs.tailstorm import SUMMARY, VOTE, TailstormSSZ
+from cpr_tpu.params import make_params
+
+
+@pytest.fixture(scope="module")
+def env():
+    return TailstormSSZ(k=4, incentive_scheme="constant",
+                        subblock_selection="heuristic", max_steps_hint=160)
+
+
+def run_policy(env, name, alpha, n_envs=128, episode_steps=128, seed=0,
+               gamma=0.5):
+    params = make_params(alpha=alpha, gamma=gamma, max_steps=episode_steps)
+    policy = env.policies[name]
+    keys = jax.random.split(jax.random.PRNGKey(seed), n_envs)
+    stats = jax.vmap(
+        lambda k: env.episode_stats(k, params, policy, episode_steps + 32)
+    )(keys)
+    atk = np.asarray(stats["episode_reward_attacker"]).mean()
+    dfn = np.asarray(stats["episode_reward_defender"]).mean()
+    return atk / (atk + dfn)
+
+
+def test_honest_policy_yields_alpha(env):
+    # honest behaviour earns the compute share; constant rewards pay 1 per
+    # confirmed vote (tailstorm.ml:204-217)
+    for alpha in [0.25, 0.4]:
+        rel = run_policy(env, "honest", alpha)
+        assert abs(rel - alpha) < 0.05, (alpha, rel)
+
+
+def test_dag_structure_invariants(env):
+    """Roll an episode, then check tailstorm validity (tailstorm.ml:156-180)
+    on the final DAG: votes have one parent, depth = parent depth + 1 and
+    the parent's summary; summaries reference k unique votes via leaves
+    sorted by (depth desc, hash asc)."""
+    params = make_params(alpha=0.35, gamma=0.5, max_steps=128)
+    state, obs = env.reset(jax.random.PRNGKey(3), params)
+    step = jax.jit(env.step)
+    policy = env.policies["get-ahead"]
+    for _ in range(128):
+        state, obs, r, done, info = step(state, policy(obs), params)
+    dag = state.dag
+    n = int(dag.n)
+    assert not bool(dag.overflow)
+    parents = np.asarray(dag.parents)[:n]
+    kind = np.asarray(dag.kind)[:n]
+    height = np.asarray(dag.height)[:n]
+    depth = np.asarray(dag.aux)[:n]
+    signer = np.asarray(dag.signer)[:n]
+    powh = np.asarray(dag.pow_hash)[:n]
+
+    def closure(leaf):
+        seen = set()
+        cur = leaf
+        while cur >= 0 and kind[cur] == VOTE:
+            seen.add(cur)
+            cur = parents[cur][0]
+        return seen
+
+    for i in range(1, n):
+        ps = parents[i][parents[i] >= 0]
+        if kind[i] == VOTE:
+            assert len(ps) == 1
+            p = ps[0]
+            assert depth[i] == depth[p] + 1
+            assert np.isfinite(powh[i])
+            # vote's summary link: parent's summary (or the parent itself)
+            want = p if kind[p] == SUMMARY else signer[p]
+            assert signer[i] == want
+            assert height[i] == height[want]
+        else:
+            # summary: k unique votes in the leaf closure, all confirming
+            # the previous summary; leaves sorted by (depth desc, hash asc)
+            votes = set()
+            for leaf in ps:
+                assert kind[leaf] == VOTE
+                votes |= closure(leaf)
+            assert len(votes) == env.k, (i, ps)
+            prevs = {signer[v] for v in votes}
+            assert len(prevs) == 1
+            assert height[i] == height[prevs.pop()] + 1
+            keys = [(-depth[leaf], powh[leaf]) for leaf in ps]
+            assert keys == sorted(keys), (i, keys)
+
+
+def test_progress_tracks_activations(env):
+    # honest run: nearly every PoW vote ends up confirmed (low orphan
+    # rate), so progress ~= n_activations (progress unit = one vote,
+    # tailstorm.ml:72)
+    params = make_params(alpha=0.3, gamma=0.5, max_steps=160)
+    stats = env.episode_stats(
+        jax.random.PRNGKey(7), params, env.policies["honest"], 192)
+    prog = float(stats["episode_progress"])
+    acts = float(stats["episode_n_activations"])
+    assert prog > 0
+    assert prog <= acts + env.k
+    assert prog / acts > 0.8, (prog, acts)
+
+
+def test_policies_run_and_terminate(env):
+    params = make_params(alpha=0.4, gamma=0.5, max_steps=96)
+    for name, policy in env.policies.items():
+        traj = env.rollout(jax.random.PRNGKey(5), params, policy, 160)
+        done = np.asarray(traj[3])
+        assert done.sum() >= 1, name
+        actions = np.asarray(traj[1])
+        assert actions.min() >= 0 and actions.max() < env.n_actions
+
+
+def test_withholding_beats_honest_at_high_alpha(env):
+    rel_h = run_policy(env, "honest", 0.44)
+    rel_w = run_policy(env, "get-ahead", 0.44, episode_steps=160)
+    assert rel_w > rel_h - 0.02, (rel_h, rel_w)
+
+
+def test_discount_scheme_bounds_rewards():
+    # discount pays depth/k per vote (tailstorm.ml:211-217): per-progress
+    # reward must be <= 1 and > 0
+    env = TailstormSSZ(k=4, incentive_scheme="discount", max_steps_hint=96)
+    params = make_params(alpha=0.3, gamma=0.5, max_steps=64)
+    stats = env.episode_stats(
+        jax.random.PRNGKey(11), params, env.policies["honest"], 96)
+    total = float(stats["episode_reward_attacker"]
+                  + stats["episode_reward_defender"])
+    prog = float(stats["episode_progress"])
+    assert 0 < total <= prog + 1e-3, (total, prog)
+
+
+def test_altruistic_selection_runs():
+    env = TailstormSSZ(k=4, subblock_selection="altruistic",
+                       max_steps_hint=96)
+    params = make_params(alpha=0.3, gamma=0.5, max_steps=64)
+    stats = env.episode_stats(
+        jax.random.PRNGKey(13), params, env.policies["honest"], 96)
+    assert float(stats["episode_progress"]) > 0
