@@ -41,7 +41,8 @@ class JaxEnv:
 
     Subclasses define:
       n_actions: int
-      obs_fields: tuple[obs.Field, ...]
+      fields: tuple[obs.Field, ...]
+      unit_observation: bool
       reset(key, params) -> (state, obs)
       step(state, action, params) -> (state, obs, reward, done, info)
       policies: dict[str, Callable[obs -> action]]   (jittable)
@@ -50,6 +51,16 @@ class JaxEnv:
     n_actions: int
     observation_length: int
     policies: dict[str, Callable]
+
+    def decode_obs(self, obs):
+        """float observation -> per-field natural-scale int values
+        (ssz_tools.ml:20-59 of_floatarray)."""
+        from cpr_tpu import obs as obslib
+        vals = [
+            obslib.field_of_float(f, obs[..., i], self.unit_observation)
+            for i, f in enumerate(self.fields)
+        ]
+        return tuple(jnp.asarray(v, jnp.int32) for v in vals)
 
     def reset(self, key: jax.Array, params: EnvParams):
         raise NotImplementedError

@@ -443,13 +443,6 @@ class BkSSZ(JaxEnv):
 
     # -- policies (bk_ssz.ml:346-404) --------------------------------------
 
-    def decode_obs(self, obs):
-        vals = [
-            obslib.field_of_float(f, obs[..., i], self.unit_observation)
-            for i, f in enumerate(self.fields)
-        ]
-        return tuple(jnp.asarray(v, jnp.int32) for v in vals)
-
     def _make_policies(self):
         k = self.k
 

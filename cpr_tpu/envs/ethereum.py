@@ -132,6 +132,7 @@ class EthereumSSZ(JaxEnv):
         assert self.progress in ("height", "work")
         assert self.incentive_scheme in ("constant", "discount")
         self.unit_observation = unit_observation
+        self.fields = OBS_FIELDS
         self.strict_match = strict_match
         # one block append per step + the reset draw
         self.capacity = max_steps_hint + 8
@@ -302,7 +303,13 @@ class EthereumSSZ(JaxEnv):
 
     def _release_upto(self, dag, private, target):
         """Find the first block walking back from `private` with
-        preference <= target (ethereum_ssz.ml:404-412)."""
+        preference <= target (ethereum_ssz.ml:404-412).
+
+        Note: under work preference (whitepaper preset) work can jump by
+        more than 1 per block (uncles), so the walk may stop strictly
+        below `target` and release an already-public block — the
+        reference's release_upto has exactly the same stop rule and
+        behavior; Override is then a deliberate no-op."""
         pref_all = self.pref_all(dag)
 
         def stop(dag_, i):
@@ -321,12 +328,14 @@ class EthereumSSZ(JaxEnv):
         is_adopt = (act == ADOPT_DISCARD) | (act == ADOPT_RELEASE)
         pub_pref = self.pref(dag, state.public)
         ca = D.common_ancestor_by_height(dag, state.public, state.private)
+        # non-walking actions get a huge target so the walk stops at the
+        # private tip immediately instead of running to genesis
         target = jnp.where(
             act == MATCH, pub_pref,
             jnp.where(act == OVERRIDE, pub_pref + 1,
                       jnp.where(act == RELEASE1,
                                 self.pref(dag, ca) + 1,
-                                jnp.int32(0))))
+                                jnp.int32(1 << 30))))
         release_tip = jnp.where(
             act == ADOPT_RELEASE, state.private,
             self._release_upto(dag, state.private, target))
@@ -412,13 +421,6 @@ class EthereumSSZ(JaxEnv):
         )
 
     # -- policies (ethereum_ssz.ml:444-538) --------------------------------
-
-    def decode_obs(self, obs):
-        vals = [
-            obslib.field_of_float(f, obs[..., i], self.unit_observation)
-            for i, f in enumerate(OBS_FIELDS)
-        ]
-        return tuple(jnp.asarray(v, jnp.int32) for v in vals)
 
     def _pref_fields(self, ph, pw, ah, aw):
         """Observation fields the reference policies compare, following its

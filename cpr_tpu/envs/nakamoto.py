@@ -99,6 +99,7 @@ class NakamotoSSZ(JaxEnv):
         # race stays live across Wait actions.
         self.unit_observation = unit_observation
         self.strict_match = strict_match
+        self.fields = OBS_FIELDS
         self.low, self.high = obslib.low_high(OBS_FIELDS, unit_observation)
         # built once: policy identity is the jit cache key for rollout
         self.policies = self._make_policies()
@@ -113,13 +114,6 @@ class NakamotoSSZ(JaxEnv):
             self.unit_observation,
         )
 
-    def decode_obs(self, obs):
-        """float observation -> (public, private, diff, event), natural scale."""
-        vals = [
-            obslib.field_of_float(f, obs[..., i], self.unit_observation)
-            for i, f in enumerate(OBS_FIELDS)
-        ]
-        return tuple(jnp.asarray(v, jnp.int32) for v in vals)
 
     # -- dynamics ---------------------------------------------------------
 

@@ -1,0 +1,225 @@
+"""Shared sub-block (vote) quorum machinery for the parallel-PoW protocol
+family: Tailstorm (tailstorm.ml), Stree (stree.ml), Sdag (sdag.ml).
+
+All three protocols select a bounded set of "votes" confirming the current
+block/summary, subject to a closure constraint: selecting a vote implies
+selecting all its vote ancestors (`acc_votes parents [x]`,
+tailstorm.ml:134-149, stree.ml:103-117, sdag.ml acc_votes). The reference
+walks linked DAG structures per decision; here the candidates are
+compacted into a fixed window of C slot-ascending indices and their
+ancestor relation is materialized as a dense (C, C) boolean matrix built
+by one-hot parent rows closed with log-doubling matmuls — MXU-friendly,
+no gathers or scatters in the selection rounds.
+
+Votes have one parent in tailstorm/stree (paths) and up to P parents in
+sdag (sub-DAGs); the transitive closure covers both.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from cpr_tpu.core import dag as D
+
+
+def candidate_frame(dag, cand, C: int, vote_kind: int, max_vote_parents: int = 1):
+    """Compact the candidate votes to C slot-ascending indices and build
+    the candidate-local ancestor bit-matrix abits (C, C): abits[i, j] ==
+    candidate j lies in candidate i's vote closure (including i == j).
+
+    The reference reaches candidates through a *filtered* child traversal
+    (tailstorm.ml:509-531), so a vote with a vote parent outside the
+    candidate set is unreachable — such rows are invalidated (and the
+    invalidation propagates to their descendants through the closure).
+
+    Returns (cidx, cvalid, abits); cidx is -1-padded.
+    """
+    assert C < (1 << 8), "composite sort keys reserve 8 bits for C-sized fields"
+    cidx, cvalid = D.top_k_by(dag.slots().astype(jnp.float32), cand, C)
+    cidx = jnp.where(cvalid, cidx, -1)
+    ci = jnp.maximum(cidx, 0)
+    big = jnp.int32(jnp.iinfo(jnp.int32).max)
+    sorted_slots = jnp.where(cidx >= 0, cidx, big)
+
+    adj = jnp.zeros((C, C), jnp.float32)
+    escaped = jnp.zeros((C,), jnp.bool_)
+    for p in range(max_vote_parents):
+        par = dag.parents[ci, p]
+        par_is_vote = cvalid & (par >= 0) & (
+            dag.kind[jnp.maximum(par, 0)] == vote_kind)
+        pos = jnp.clip(jnp.searchsorted(sorted_slots, jnp.maximum(par, 0)),
+                       0, C - 1).astype(jnp.int32)
+        par_in = par_is_vote & (sorted_slots[pos] == jnp.maximum(par, 0))
+        escaped = escaped | (par_is_vote & ~par_in)
+        adj = adj + (jnp.arange(C)[None, :]
+                     == jnp.where(par_in, pos, -1)[:, None])
+    reach = jnp.minimum(adj, 1.0) + jnp.eye(C, dtype=jnp.float32)
+    for _ in range(max(1, (C - 1).bit_length())):
+        reach = jnp.minimum(reach + reach @ reach, 1.0)
+    abits = reach > 0.0
+    cvalid = cvalid & ~(abits & escaped[None, :]).any(axis=1)
+    abits = abits & cvalid[:, None]
+    return cidx, cvalid, abits
+
+
+def quorum_heuristic(dag, cidx, cvalid, abits, own, q: int):
+    """Own-reward-first greedy branch selection (tailstorm.ml:329-380,
+    stree.ml:~300): each round includes the candidate whose fresh closure
+    maximizes (own count, total count), DAG order on ties; <= q rounds.
+    Returns (found, leaves_c) with leaves_c a local boolean mask of the
+    chosen branch tips."""
+    C = cidx.shape[0]
+    own_c = own[jnp.maximum(cidx, 0)] & cvalid
+
+    def body(_, carry):
+        inc, leaves_c, n_rem = carry
+        fresh = abits & ~inc[None, :]
+        f_all = fresh.sum(axis=1)
+        f_own = (fresh & own_c[None, :]).sum(axis=1)
+        eligible = cvalid & ~inc & (f_all >= 1) & (f_all <= n_rem)
+        score = ((f_own * (q + 2) + f_all) << 8) + (C - jnp.arange(C))
+        score = jnp.where(eligible & (n_rem > 0), score, -1)
+        c = jnp.argmax(score).astype(jnp.int32)
+        ok = score[c] >= 0
+        inc = inc | (abits[c] & ok)
+        leaves_c = leaves_c.at[c].max(ok)
+        return inc, leaves_c, n_rem - jnp.where(ok, f_all[c], 0)
+
+    z = jnp.zeros((C,), jnp.bool_)
+    _, leaves_c, n_rem = jax.lax.fori_loop(
+        0, max(q, 1), body, (z, z, jnp.int32(q)))
+    return (n_rem == 0) & (cvalid.sum() >= q), leaves_c
+
+
+def quorum_altruistic(dag, cidx, cvalid, abits, own, seen, depth, q: int):
+    """Longest-branch-first greedy selection (tailstorm.ml:271-313,
+    stree.ml:~230, sdag.ml altruistic_quorum): scan candidates by
+    (depth desc, own first, seen asc), adding whole closures that still
+    fit. Returns (n, set_c, tips_c, n_cand): n selected votes, the
+    selected-set mask, the taken tips, and the candidate count — callers
+    decide Full (n == q) vs Partial."""
+    C = cidx.shape[0]
+    ci = jnp.maximum(cidx, 0)
+    d = jnp.minimum(depth[ci], (1 << 6) - 1)
+    own_c = own[ci]
+    seen_rank = jnp.argsort(jnp.argsort(seen[ci])).astype(jnp.int32)
+    comp = (((((1 << 6) - 1 - d) << 1 | (~own_c).astype(jnp.int32))
+             << 8) + seen_rank) << 8
+    comp = comp + jnp.arange(C, dtype=jnp.int32)  # stable: DAG order
+    order = jnp.argsort(jnp.where(cvalid, comp, jnp.iinfo(jnp.int32).max))
+    n_cand = cvalid.sum()
+
+    def cond(carry):
+        i, _, _, n = carry
+        return (n < q) & (i < n_cand)
+
+    def body(carry):
+        i, acc, leaves_c, n = carry
+        c = order[i]
+        fresh = (abits[c] & ~acc).sum()
+        take = (fresh >= 1) & (n + fresh <= q)
+        acc = acc | (abits[c] & take)
+        leaves_c = leaves_c.at[c].max(take)
+        return i + 1, acc, leaves_c, n + jnp.where(take, fresh, 0)
+
+    z = jnp.zeros((C,), jnp.bool_)
+    _, acc, leaves_c, n = jax.lax.while_loop(
+        cond, body, (jnp.int32(0), z, z, jnp.int32(0)))
+    return n, acc, leaves_c, n_cand
+
+
+def leaves_to_row(dag, cidx, leaves_c, cvalid, width: int, score):
+    """Scatter the local leaves mask back to global slots and pick the
+    parent row: `width` leaves sorted descending by `score` (a (B,)
+    array), -1 padded."""
+    leaves = jnp.zeros((dag.capacity,), jnp.bool_).at[
+        jnp.maximum(cidx, 0)].max(leaves_c & cvalid)
+    idx, valid = D.top_k_by(score, leaves, width, largest=True)
+    return jnp.where(valid, idx, D.NONE).astype(jnp.int32)
+
+
+def prefix_release_sets(dag, public, private, cands, R: int, last_fn,
+                        cmp_fn, extra_key=None):
+    """Override/Match release-set computation shared by the tailstorm,
+    stree, and sdag envs (tailstorm_ssz.ml:292-314 and twins): scan the
+    withheld candidates in DAG (= slot, topological) order; the Override
+    set is the smallest prefix whose release flips the defender's head,
+    the Match set is that prefix minus the flipping vertex; if no prefix
+    flips, both release everything.
+
+    All prefixes are evaluated at once: for every prefix j the defender's
+    head-comparison terms are cumulative counts. The flip rule is
+    (height, confirming votes[, extra_key]) strictly greater.
+
+    - last_fn(dag, idx_array): block/summary of a vertex,
+    - cmp_fn(dag, x, y, vote_filter_mask): strict preference, used for the
+      window-overflow fallback (release everything, head flips iff the
+      attacker's preferred block wins once fully visible),
+    - extra_key(dag, sids): optional per-block tiebreak array (tailstorm's
+      defender own-reward, tailstorm.ml:539-549).
+
+    Returns (override_set, match_set, found, new_head).
+    """
+    B = dag.capacity
+    ridx, rvalid = D.top_k_by(dag.slots().astype(jnp.float32), cands, R)
+    ri = jnp.maximum(ridx, 0)
+    lb = jnp.where(rvalid, last_fn(dag, ri), 0)
+
+    # in all three envs votes (and only votes) store their block/summary
+    # in the signer column, so signer >= 0 identifies confirming votes
+    is_conf = dag.exists() & (dag.signer >= 0)
+    conf_vis = ((is_conf & dag.vis_d)[:, None]
+                & (dag.signer[:, None] == lb[None, :])).sum(axis=0)
+    cand_vote = (dag.signer[ri] >= 0) & rvalid
+    csig = dag.signer[ri]
+    cmat = cand_vote[:, None] & (csig[:, None] == lb[None, :])
+    leq = jnp.triu(jnp.ones((R, R), jnp.bool_))
+    nconf = conf_vis + (cmat & leq).sum(axis=0)
+
+    pub_vis = (is_conf & dag.vis_d & (dag.signer == public)).sum()
+    npub = pub_vis + jnp.cumsum(cand_vote & (csig == public))
+
+    h_lb, h_pub = dag.height[lb], dag.height[public]
+    flip = (h_lb > h_pub) | ((h_lb == h_pub) & (nconf > npub))
+    if extra_key is not None:
+        e_lb = extra_key(dag, lb)
+        e_pub = extra_key(dag, jnp.full((R,), public))
+        flip = flip | ((h_lb == h_pub) & (nconf == npub) & (e_lb > e_pub))
+    flip = flip & (lb != public) & rvalid
+    overflow = cands.sum() > R
+    found = flip.any() & ~overflow
+    j_stop = jnp.argmax(flip).astype(jnp.int32)
+    take_o = jnp.where(found, jnp.arange(R) <= j_stop, rvalid)
+    take_m = jnp.where(found, jnp.arange(R) < j_stop, rvalid)
+    z = jnp.zeros((B,), jnp.bool_)
+    override_set = z.at[ri].max(take_o & rvalid)
+    match_set = z.at[ri].max(take_m & rvalid)
+    override_set = jnp.where(overflow, cands, override_set)
+    match_set = jnp.where(overflow, cands, match_set)
+    all_flip = cmp_fn(dag, private, public, dag.vis_d | cands)
+    found = found | (overflow & all_flip)
+    new_head = jnp.where(
+        overflow, jnp.where(all_flip, private, public),
+        jnp.where(found, lb[j_stop], public))
+    return override_set, match_set, found, new_head
+
+
+def stale_after_adopt(dag, public, stale, is_adopt, R: int, walk: int,
+                      last_fn, prev_fn):
+    """Stale-bit update at Adopt, shared by tailstorm/stree/sdag:
+    adopting moves the common ancestor to `public`, abandoning every
+    withheld vertex that does not descend from it. Descent is checked on
+    the compacted withheld set by walking each vertex's block/summary
+    chain down `walk` levels (deeper withheld branches above the adopted
+    head cannot exist: the attacker adopts because it is behind)."""
+    withheld = ~dag.vis_d & dag.exists() & ~stale
+    widx, wvalid = D.top_k_by(dag.slots().astype(jnp.float32), withheld, R)
+    wi = jnp.maximum(widx, 0)
+    cur = last_fn(dag, wi)
+    keeps = jnp.zeros_like(wvalid)
+    for _ in range(walk):
+        keeps = keeps | (cur == public)
+        cur = jnp.where(cur >= 0, prev_fn(dag, jnp.maximum(cur, 0)), -1)
+    keep_mask = jnp.zeros_like(withheld).at[wi].max(keeps & wvalid)
+    return jnp.where(is_adopt, stale | (withheld & ~keep_mask), stale)

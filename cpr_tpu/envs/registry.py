@@ -1,7 +1,9 @@
-"""Keyed environment registry.
+"""Keyed environment registry + protocol-key parser.
 
 Reference counterpart: the protocol/attack-space registry and string keys
-(simulator/protocols/cpr_protocols.ml:11-180,786-903) plus the gym env ids
+(simulator/protocols/cpr_protocols.ml:11-180) with the `of_key` grammar
+(cpr_protocols.ml:786-903) that parses keys like `nakamoto`,
+`bk-8-constant`, `tailstorm-8-discount-heuristic`; plus the gym env ids
 registered in gym/ocaml/cpr_gym/envs.py:166-192.
 """
 
@@ -20,20 +22,77 @@ def register(key: str, factory: Callable):
 
 
 def get(key: str, **kwargs):
-    """Instantiate the env registered under `key`."""
+    """Instantiate the env for `key` — either a registered family name
+    with explicit kwargs, or a full protocol key parsed by `parse_key`."""
     _ensure_builtin()
-    try:
-        factory = _REGISTRY[key]
-    except KeyError:
-        raise KeyError(
-            f"unknown env '{key}'; choose from {sorted(_REGISTRY)}"
-        ) from None
+    factory = _REGISTRY.get(key)
+    if factory is None:
+        family, parsed = parse_key(key)
+        factory = _REGISTRY.get(family)
+        if factory is None:
+            raise KeyError(
+                f"unknown env '{key}'; choose from {sorted(_REGISTRY)}")
+        parsed.update(kwargs)
+        kwargs = parsed
     return factory(**kwargs)
 
 
 def keys():
     _ensure_builtin()
     return sorted(_REGISTRY)
+
+
+def parse_key(key: str):
+    """Parse a reference-style protocol key (cpr_protocols.ml:786-903):
+
+        nakamoto
+        ethereum-whitepaper | ethereum-byzantium
+        bk-<k>-<constant|block>
+        spar-<k>-<constant|block>
+        stree-<k>-<scheme>[-<selection>]
+        sdag-<k>-<constant|discount>[-<selection>]
+        tailstorm-<k>-<scheme>[-<selection>]
+
+    Returns (family, kwargs)."""
+    parts = key.split("-")
+    family = parts[0]
+    if family in ("nakamoto",) and len(parts) == 1:
+        return family, {}
+    if family == "ethereum":
+        if len(parts) == 1:
+            return family, {}
+        if len(parts) == 2 and parts[1] in ("whitepaper", "byzantium"):
+            return family, {"preset": parts[1]}
+        raise KeyError(f"cannot parse protocol key '{key}'")
+    grammars = {
+        # family: (schemes, selections or None)
+        "bk": (("constant", "block"), None),
+        "spar": (("constant", "block"), None),
+        "stree": (("constant", "discount", "punish", "hybrid"),
+                  ("altruistic", "heuristic", "optimal")),
+        "sdag": (("constant", "discount"), ("altruistic", "heuristic")),
+        "tailstorm": (("constant", "discount", "punish", "hybrid"),
+                      ("altruistic", "heuristic", "optimal")),
+    }
+    if family in grammars:
+        schemes, selections = grammars[family]
+        max_parts = 3 if selections is None else 4
+        if (len(parts) < 2 or len(parts) > max_parts
+                or not parts[1].isdigit()):
+            raise KeyError(f"cannot parse protocol key '{key}'")
+        kw = {"k": int(parts[1])}
+        if len(parts) >= 3:
+            if parts[2] not in schemes:
+                raise KeyError(f"cannot parse protocol key '{key}': "
+                               f"scheme must be one of {schemes}")
+            kw["incentive_scheme"] = parts[2]
+        if len(parts) >= 4:
+            if parts[3] not in selections:
+                raise KeyError(f"cannot parse protocol key '{key}': "
+                               f"selection must be one of {selections}")
+            kw["subblock_selection"] = parts[3]
+        return family, kw
+    raise KeyError(f"cannot parse protocol key '{key}'")
 
 
 _BUILTIN_LOADED = False
@@ -46,6 +105,9 @@ def _ensure_builtin():
     from cpr_tpu.envs.bk import BkSSZ
     from cpr_tpu.envs.ethereum import EthereumSSZ
     from cpr_tpu.envs.nakamoto import NakamotoSSZ
+    from cpr_tpu.envs.sdag import SdagSSZ
+    from cpr_tpu.envs.spar import SparSSZ
+    from cpr_tpu.envs.stree import StreeSSZ
     from cpr_tpu.envs.tailstorm import TailstormSSZ
 
     _BUILTIN_LOADED = True
@@ -57,6 +119,9 @@ def _ensure_builtin():
          lambda **kw: EthereumSSZ("whitepaper", **kw)),
         ("ethereum-byzantium",
          lambda **kw: EthereumSSZ("byzantium", **kw)),
+        ("spar", SparSSZ),
+        ("stree", StreeSSZ),
+        ("sdag", SdagSSZ),
         ("tailstorm", TailstormSSZ),
     ]:
         if key not in _REGISTRY:

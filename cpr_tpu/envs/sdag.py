@@ -1,0 +1,491 @@
+"""Sdag — Simple Parallel PoW with DAG-structured voting — under the
+SSZ-like withholding attack space, on the DAG tensor substrate.
+
+Reference counterparts:
+- protocol: simulator/protocols/sdag.ml — every vertex carries PoW; a
+  vote references the *leaves of its miner's current quorum attempt* (so
+  votes merge branches; a vote's number = cardinality of its vote
+  closure), a block references leaves whose closure has exactly k-1
+  votes, all confirming the same previous block (validity sdag.ml:139-172);
+  quorum selection altruistic (longest-closure first) and heuristic
+  (own-reward *density* greedy) return Full or Partial sets
+  (sdag.ml:292-359,360-364); rewards constant/discount — the block miner
+  earns 1 and each confirmed vote earns r, discount
+  r = (fwd + bwd)/(k-1) with fwd/bwd counted inside the confirmed
+  closure (sdag.ml:190-223); preference (height, confirming votes,
+  earlier-seen) (sdag.ml:399-413),
+- attack space: simulator/protocols/sdag_ssz.ml — 7-field observation
+  (sdag_ssz.ml:22-46), Action8 with persistent Proceed/Prolong mining
+  filter, prefix release scan, policies honest/release-block/
+  override-block/override-catchup/minor-delay/avoid-loss,
+- engine semantics: simulator/gym/engine.ml:97-273.
+
+TPU re-design mirrors cpr_tpu.envs.stree; votes are multi-parent, so the
+candidate frame closes over all parent columns and quorum sets live as
+local boolean masks whose fwd/bwd reward terms are row/column sums of the
+ancestor bit-matrix. The heuristic's reward-density argmax evaluates all
+candidate additions at once with batched (C, C) matmuls.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from cpr_tpu import obs as obslib
+from cpr_tpu.core import dag as D
+from cpr_tpu.envs import quorum as Q
+from cpr_tpu.envs.base import JaxEnv
+from cpr_tpu.params import EnvParams
+
+BLOCK, VOTE = 0, 1
+EV_POW, EV_NETWORK = 0, 1
+
+(ADOPT_PROLONG, OVERRIDE_PROLONG, MATCH_PROLONG, WAIT_PROLONG,
+ ADOPT_PROCEED, OVERRIDE_PROCEED, MATCH_PROCEED, WAIT_PROCEED) = range(8)
+
+INCENTIVE_SCHEMES = ("constant", "discount")
+SUBBLOCK_SELECTIONS = ("altruistic", "heuristic")
+
+
+def obs_fields(k: int):
+    """sdag_ssz.ml:22-46."""
+    return (
+        obslib.Field("public_blocks", obslib.UINT, scale=1),
+        obslib.Field("private_blocks", obslib.UINT, scale=1),
+        obslib.Field("diff_blocks", obslib.INT, scale=1),
+        obslib.Field("public_votes", obslib.UINT, scale=k),
+        obslib.Field("private_votes_inclusive", obslib.UINT,
+                     scale=max(k - 1, 1)),
+        obslib.Field("private_votes_exclusive", obslib.UINT,
+                     scale=max(k - 1, 1)),
+        obslib.Field("event", obslib.DISCRETE, n=2),
+    )
+
+
+@struct.dataclass
+class State:
+    dag: D.Dag
+    public: jnp.ndarray
+    private: jnp.ndarray
+    event: jnp.ndarray
+    race_tip: jnp.ndarray
+    mining_excl: jnp.ndarray
+    stale: jnp.ndarray
+    time: jnp.ndarray
+    steps: jnp.ndarray
+    n_activations: jnp.ndarray
+    last_reward_attacker: jnp.ndarray
+    last_reward_defender: jnp.ndarray
+    last_progress: jnp.ndarray
+    last_chain_time: jnp.ndarray
+    last_sim_time: jnp.ndarray
+    key: jax.Array
+
+
+class SdagSSZ(JaxEnv):
+    n_actions = 8
+
+    def __init__(self, k: int = 8, incentive_scheme: str = "constant",
+                 subblock_selection: str = "heuristic",
+                 unit_observation: bool = True, max_steps_hint: int = 256,
+                 release_scan: int = 128):
+        assert k >= 2  # sdag.ml:3-24 requires k >= 2
+        assert incentive_scheme in INCENTIVE_SCHEMES
+        assert subblock_selection in SUBBLOCK_SELECTIONS
+        self.k = k
+        self.q = k - 1
+        self.incentive_scheme = incentive_scheme
+        self.subblock_selection = subblock_selection
+        self.unit_observation = unit_observation
+        self.capacity = max_steps_hint + 8  # one PoW append per step
+        self.max_parents = max(k - 1, 1)  # leaves only (votes or blocks)
+        self.C_MAX = 4 * k + 16
+        self.STALE_WALK = 4
+        self.release_scan = min(release_scan, self.capacity)
+        self.fields = obs_fields(k)
+        self.observation_length = len(self.fields)
+        self.low, self.high = obslib.low_high(self.fields, unit_observation)
+        self.policies = self._make_policies()
+
+    # -- protocol primitives (sdag.ml) -------------------------------------
+
+    def confirming(self, dag, b, extra_mask=None):
+        m = dag.exists() & (dag.kind == VOTE) & (dag.signer == b)
+        if extra_mask is not None:
+            m = m & extra_mask
+        return m
+
+    def last_block(self, dag, x):
+        return jnp.where(dag.kind[x] == BLOCK, x, dag.signer[x])
+
+    def prev_block(self, dag, b):
+        """A block's parents are votes confirming the previous block
+        (sdag.ml:139-172), so the precursor block is parent 0's signer."""
+        p0 = dag.parents[b, 0]
+        return jnp.where(p0 >= 0, self.last_block(dag, jnp.maximum(p0, 0)),
+                         jnp.int32(-1))
+
+    def block_lca(self, dag, a, b):
+        """Common ancestor along the block chain (heights drop by 1 per
+        prev_block step)."""
+
+        def cond(state):
+            x, y = state
+            return (x != y) & (x >= 0) & (y >= 0)
+
+        def body(state):
+            x, y = state
+            hx, hy = dag.height[x], dag.height[y]
+            return (jnp.where(hx >= hy, self.prev_block(dag, x), x),
+                    jnp.where(hy >= hx, self.prev_block(dag, y), y))
+
+        x, _ = jax.lax.while_loop(cond, body, (a, b))
+        return jnp.maximum(x, 0)
+
+    def vote_score(self, dag):
+        """compare_votes_in_block: vote number desc, DAG order on ties."""
+        return (dag.aux.astype(jnp.float32)
+                - dag.slots().astype(jnp.float32) / self.capacity)
+
+    def cmp_blocks(self, dag, x, y, vote_filter_mask):
+        """sdag.ml:399-413: height then filtered confirming votes; the
+        visible_since tiebreak always favors the incumbent y."""
+        nx = self.confirming(dag, x, vote_filter_mask).sum()
+        ny = self.confirming(dag, y, vote_filter_mask).sum()
+        hx, hy = dag.height[x], dag.height[y]
+        return jnp.where(x == y, False,
+                         (hx > hy) | ((hx == hy) & (nx > ny)))
+
+    def update_head(self, dag, old, cand, vote_filter_mask):
+        return jnp.where(self.cmp_blocks(dag, cand, old, vote_filter_mask),
+                         cand, old)
+
+    # -- quorum selection ---------------------------------------------------
+
+    def _select_heuristic(self, cidx, cvalid, abits, own_c):
+        """Reward-density greedy (sdag.ml:330-359): repeatedly add the
+        candidate whose closure maximizes (own reward gain)/(size gain)
+        under the constant scheme, until the set reaches k-1 votes or
+        nothing fits. All candidate additions are scored at once: for
+        S'_c = S | closure(c), own reward(S') = sum over own x in S' of
+        fwd(x) + bwd(x) = column + row sums of abits restricted to S'."""
+        C = cidx.shape[0]
+        q = self.q
+        A = abits.astype(jnp.float32)
+
+        def reward_rows(Sc):
+            # Sc: (C, C) row c = candidate-set after adding c
+            Sf = Sc.astype(jnp.float32)
+            col = Sf @ A          # col[c, x] = |descendants of x in S'_c|
+            row = Sf @ A.T        # row[c, x] = |closure(x) ∩ S'_c|
+            contrib = (col + row - 1.0) * (own_c & cvalid)[None, :] * Sf
+            return contrib.sum(axis=1)
+
+        def body(_, carry):
+            S, n, mrn = carry
+            Sc = S[None, :] | abits
+            size = Sc.sum(axis=1)
+            mrt = reward_rows(Sc)
+            eligible = cvalid & ~S & (size <= q) & (size > n)
+            density = (mrt - mrn) / jnp.maximum(
+                (size - n).astype(jnp.float32), 1.0)
+            # ties -> first candidate in DAG order
+            density = density - jnp.arange(C) * 1e-7
+            density = jnp.where(eligible & (n < q), density, -jnp.inf)
+            c = jnp.argmax(density).astype(jnp.int32)
+            ok = density[c] > -jnp.inf
+            S = jnp.where(ok, Sc[c], S)
+            return (S, jnp.where(ok, size[c], n),
+                    jnp.where(ok, mrt[c], mrn))
+
+        z = jnp.zeros((C,), jnp.bool_)
+        S, n, _ = jax.lax.fori_loop(
+            0, max(q, 1), body, (z, jnp.int32(0), jnp.float32(0.0)))
+        return S, n
+
+    def select(self, dag, b, voter, vote_filter_mask, view_mask):
+        """Full/Partial vote-set selection (sdag.ml:292-364). Returns
+        (full, n, leaves_row) where leaves_row lists the true leaves of
+        the selected set (finalize_quorum, sdag.ml:366-377), -1 padded."""
+        cand = self.confirming(dag, b) & vote_filter_mask & view_mask
+        own = dag.miner == voter
+        cidx, cvalid, abits = Q.candidate_frame(
+            dag, cand, self.C_MAX, VOTE, max_vote_parents=self.max_parents)
+        if self.subblock_selection == "altruistic":
+            seen = jnp.where(voter == D.ATTACKER, dag.born_at,
+                             dag.vis_d_since)
+            n, S, _, _ = Q.quorum_altruistic(
+                dag, cidx, cvalid, abits, own, seen, dag.aux, self.q)
+        else:
+            own_c = own[jnp.maximum(cidx, 0)]
+            S, n = self._select_heuristic(cidx, cvalid, abits, own_c)
+        # true leaves: x in S with no other S-member having x in its
+        # closure (column count == 1)
+        desc_in_S = (abits & S[:, None]).sum(axis=0)
+        leaves_c = S & (desc_in_S == 1)
+        row = Q.leaves_to_row(dag, cidx, leaves_c, cvalid, self.max_parents,
+                              self.vote_score(dag))
+        return (n == self.q), n, row, (cidx, cvalid, abits, S)
+
+    def block_reward(self, dag, frame, miner):
+        """sdag.ml:190-223: block miner earns 1; each confirmed vote v
+        earns r = discount ? (fwd(v)+bwd(v))/(k-1) : 1 with fwd/bwd inside
+        the confirmed closure."""
+        cidx, cvalid, abits, S = frame
+        A = abits.astype(jnp.float32)
+        Sf = (S & cvalid).astype(jnp.float32)
+        fwd = (Sf[:, None] * A).sum(axis=0)   # |descendants of x in S|
+        bwd = (A * Sf[None, :]).sum(axis=1)   # |closure(x) ∩ S|
+        if self.incentive_scheme == "discount":
+            r = (fwd + bwd - 1.0) / max(self.q, 1)
+        else:
+            r = jnp.ones_like(fwd)
+        in_S = S & cvalid
+        m = dag.miner[jnp.maximum(cidx, 0)]
+        atk = (jnp.where(in_S & (m == D.ATTACKER), r, 0.0).sum()
+               + (miner == D.ATTACKER))
+        dfn = (jnp.where(in_S & (m == D.DEFENDER), r, 0.0).sum()
+               + (miner == D.DEFENDER))
+        return atk, dfn
+
+    def _mine_one(self, dag, head, view, vote_filter, miner, time, powh):
+        """puzzle_payload' (sdag.ml:366-397): block on a Full selection,
+        else a vote referencing the leaves of the Partial selection (or
+        the block itself when empty)."""
+        full, n, leaves_row, frame = self.select(
+            dag, head, miner, vote_filter, view)
+        atk, dfn = self.block_reward(dag, frame, miner)
+        row_first_vote = jnp.full((self.max_parents,), D.NONE, jnp.int32
+                                  ).at[0].set(head)
+        row = jnp.where(full | (n > 0), leaves_row, row_first_vote)
+        kind = jnp.where(full, BLOCK, VOTE)
+        height = dag.height[head] + jnp.where(full, 1, 0)
+        aux = jnp.where(full, 0, n + 1)  # vote number = closure size
+        signer = jnp.where(full, D.NONE, head)
+        progress = (height * self.k + aux).astype(jnp.float32)
+        dag, idx = D.append(
+            dag, row, kind=kind, height=height, aux=aux, pow_hash=powh,
+            signer=signer, miner=miner, vis_a=True,
+            vis_d=(miner == D.DEFENDER), time=time,
+            reward_atk=jnp.where(full, atk, 0.0),
+            reward_def=jnp.where(full, dfn, 0.0),
+            progress=progress)
+        return dag, idx, full
+
+    # -- env API (mirrors cpr_tpu.envs.stree) -------------------------------
+
+    def reset(self, key: jax.Array, params: EnvParams):
+        dag = D.empty(self.capacity, self.max_parents)
+        dag, root = D.append(
+            dag, jnp.full((self.max_parents,), D.NONE, jnp.int32),
+            kind=BLOCK, height=0, miner=D.NONE, vis_a=True, vis_d=True,
+            time=0.0, progress=0.0)
+        z = jnp.int32(0)
+        f = jnp.float32(0.0)
+        state = State(
+            dag=dag, public=root, private=root,
+            event=jnp.int32(EV_POW), race_tip=D.NONE,
+            mining_excl=jnp.bool_(False),
+            stale=jnp.zeros((self.capacity,), jnp.bool_),
+            time=f, steps=z, n_activations=z,
+            last_reward_attacker=f, last_reward_defender=f,
+            last_progress=f, last_chain_time=f, last_sim_time=f,
+            key=key,
+        )
+        state = self._mine(state, params)
+        return state, self.observe(state)
+
+    def _mine(self, state: State, params: EnvParams) -> State:
+        dag = state.dag
+        key, k_dt, k_mine, k_hash, k_gamma = jax.random.split(state.key, 5)
+        dt = jax.random.exponential(k_dt) * params.activation_delay
+        time = state.time + dt
+        attacker = jax.random.uniform(k_mine) < params.alpha
+        powh = jax.random.uniform(k_hash)
+
+        tgt = jnp.maximum(state.race_tip, 0)
+        still_tie = ((state.race_tip >= 0)
+                     & ~self.cmp_blocks(dag, state.public, tgt, dag.vis_d)
+                     & ~self.cmp_blocks(dag, tgt, state.public, dag.vis_d))
+        gamma_hit = (~attacker & still_tie
+                     & (jax.random.uniform(k_gamma) < params.gamma))
+        def_head = jnp.where(gamma_hit, tgt, state.public)
+        race_tip = jnp.where(attacker, state.race_tip, D.NONE)
+
+        atk_filter = jnp.where(state.mining_excl,
+                               dag.miner == D.ATTACKER, dag.exists())
+        head = jnp.where(attacker, state.private, def_head)
+        view = jnp.where(attacker, dag.vis_a, dag.vis_d)
+        filt = jnp.where(attacker, atk_filter, dag.exists())
+        miner = jnp.where(attacker, D.ATTACKER, D.DEFENDER)
+        dag, idx, is_blk = self._mine_one(
+            dag, head, view, filt, miner, time, powh)
+
+        private = jnp.where(attacker & is_blk, idx, state.private)
+        public = jnp.where(
+            attacker, state.public,
+            jnp.where(is_blk,
+                      self.update_head(dag, def_head, idx, dag.vis_d),
+                      def_head))
+        return state.replace(
+            dag=dag, private=private, public=public, race_tip=race_tip,
+            event=jnp.where(attacker, EV_POW, EV_NETWORK).astype(jnp.int32),
+            time=time, n_activations=state.n_activations + 1, key=key,
+        )
+
+    def observe(self, state: State):
+        """sdag_ssz.ml:226-249."""
+        dag = state.dag
+        ca = self.block_lca(dag, state.public, state.private)
+        pub_votes = self.confirming(dag, state.public, dag.vis_d).sum()
+        priv_inc = self.confirming(dag, state.private).sum()
+        priv_exc = self.confirming(dag, state.private,
+                                   dag.miner == D.ATTACKER).sum()
+        return obslib.encode(
+            self.fields,
+            (
+                dag.height[state.public] - dag.height[ca],
+                dag.height[state.private] - dag.height[ca],
+                dag.height[state.private] - dag.height[state.public],
+                pub_votes, priv_inc, priv_exc,
+                state.event,
+            ),
+            self.unit_observation,
+        )
+
+    def _release_sets(self, state: State):
+        """Prefix release scan via the shared dense implementation."""
+        dag = state.dag
+        cands = dag.exists() & ~dag.vis_d & ~state.stale
+        return Q.prefix_release_sets(
+            dag, state.public, state.private, cands, self.release_scan,
+            lambda d, i: self.last_block(d, i), self.cmp_blocks)
+
+    def _apply(self, state: State, action) -> State:
+        dag = state.dag
+        is_adopt = (action == ADOPT_PROLONG) | (action == ADOPT_PROCEED)
+        is_override = (action == OVERRIDE_PROLONG) | (action == OVERRIDE_PROCEED)
+        is_match = (action == MATCH_PROLONG) | (action == MATCH_PROCEED)
+        is_release = is_override | is_match
+        mining_excl = action < 4
+
+        override_set, match_set, found, new_head = self._release_sets(state)
+        mask = jnp.where(is_override, override_set,
+                         jnp.where(is_match, match_set,
+                                   jnp.zeros_like(match_set)))
+        released = D.release(dag, mask, state.time)
+        dag = jax.tree.map(
+            lambda a, b: jnp.where(is_release, a, b), released, dag)
+
+        public = jnp.where(is_override & found, new_head, state.public)
+        private = jnp.where(is_adopt, public, state.private)
+
+        stale = Q.stale_after_adopt(
+            dag, public, state.stale, is_adopt, self.release_scan,
+            self.STALE_WALK, lambda d, i: self.last_block(d, i),
+            lambda d, i: self.prev_block(d, i))
+
+        rel_tip = jnp.where(match_set, dag.slots(), -1).max()
+        race_tip = jnp.where(
+            is_match & found & (rel_tip >= 0),
+            self.last_block(dag, jnp.maximum(rel_tip, 0)),
+            jnp.where(is_adopt | is_override, D.NONE, state.race_tip))
+
+        return state.replace(dag=dag, public=public, private=private,
+                             race_tip=race_tip, stale=stale,
+                             mining_excl=jnp.asarray(mining_excl))
+
+    def step(self, state: State, action, params: EnvParams):
+        state = self._apply(state, action)
+        state = self._mine(state, params)
+        state = state.replace(steps=state.steps + 1)
+        dag = state.dag
+
+        n_pub = self.confirming(dag, state.public).sum()
+        n_priv = self.confirming(dag, state.private).sum()
+        pub_better = (dag.height[state.public] > dag.height[state.private]) | (
+            (dag.height[state.public] == dag.height[state.private])
+            & (n_pub > n_priv))
+        head = jnp.where(pub_better, state.public, state.private)
+
+        return self.finish_step(
+            state, params,
+            reward_attacker=dag.cum_atk[head],
+            reward_defender=dag.cum_def[head],
+            progress=(dag.height[head] * self.k).astype(jnp.float32),
+            chain_time=dag.born_at[head],
+            extra_done=dag.overflow,
+        )
+
+    # -- policies (sdag_ssz.ml Policies) ------------------------------------
+
+    def _make_policies(self):
+        k = self.k
+
+        def wrap(fn):
+            def wrapped(obs):
+                pub_b, priv_b, _, pub_v, priv_vi, priv_ve, _ev = \
+                    self.decode_obs(obs)
+                return fn(pub_b, priv_b, pub_v, priv_vi, priv_ve)
+            return wrapped
+
+        def honest(pub_b, priv_b, pub_v, priv_vi, priv_ve):
+            return jnp.where(pub_b > 0, ADOPT_PROCEED, OVERRIDE_PROCEED)
+
+        def release_block(pub_b, priv_b, pub_v, priv_vi, priv_ve):
+            return jnp.where(
+                priv_b < pub_b, ADOPT_PROCEED,
+                jnp.where(priv_b > pub_b, OVERRIDE_PROCEED, WAIT_PROCEED))
+
+        def override_block(pub_b, priv_b, pub_v, priv_vi, priv_ve):
+            return jnp.where(
+                priv_b < pub_b, ADOPT_PROCEED,
+                jnp.where(pub_b == 0, WAIT_PROCEED, OVERRIDE_PROCEED))
+
+        def override_catchup(pub_b, priv_b, pub_v, priv_vi, priv_ve):
+            return jnp.where(
+                priv_b < pub_b, ADOPT_PROCEED,
+                jnp.where(
+                    (priv_b == 0) & (pub_b == 0), WAIT_PROCEED,
+                    jnp.where(
+                        pub_b == 0, WAIT_PROCEED,
+                        jnp.where(
+                            (priv_vi == 0) & (priv_b == pub_b + 1),
+                            OVERRIDE_PROCEED,
+                            jnp.where(
+                                (pub_b == priv_b)
+                                & (priv_vi == pub_v + 1),
+                                OVERRIDE_PROCEED,
+                                jnp.where(priv_b - pub_b > 10,
+                                          OVERRIDE_PROCEED,
+                                          WAIT_PROCEED))))))
+
+        def minor_delay(pub_b, priv_b, pub_v, priv_vi, priv_ve):
+            return jnp.where(
+                pub_b > priv_b, ADOPT_PROCEED,
+                jnp.where(pub_b == 0, WAIT_PROCEED, OVERRIDE_PROCEED))
+
+        def avoid_loss(pub_b, priv_b, pub_v, priv_vi, priv_ve):
+            hp = pub_b * k + pub_v
+            ap = priv_b * k + priv_vi
+            return jnp.where(
+                pub_b == 0, WAIT_PROCEED,
+                jnp.where(
+                    (pub_b == 1) & (hp == ap), MATCH_PROCEED,
+                    jnp.where(
+                        hp > ap, ADOPT_PROCEED,
+                        jnp.where(
+                            hp == ap - 1, OVERRIDE_PROCEED,
+                            jnp.where(pub_b < priv_b - 10,
+                                      OVERRIDE_PROCEED, WAIT_PROCEED)))))
+
+        return {
+            "honest": wrap(honest),
+            "release-block": wrap(release_block),
+            "override-block": wrap(override_block),
+            "override-catchup": wrap(override_catchup),
+            "minor-delay": wrap(minor_delay),
+            "avoid-loss": wrap(avoid_loss),
+        }

@@ -1,0 +1,375 @@
+"""Spar — Simple Parallel PoW — under the SSZ-like withholding attack
+space, on the DAG tensor substrate.
+
+Reference counterparts:
+- protocol: simulator/protocols/spar.ml — every puzzle solution is either
+  a vote (single parent block, same height) or a block (parent block +
+  k-1 votes on it, height+1) (spar.ml:100-117); the miner drafts a block
+  as soon as k-1 votes confirm its preferred block, otherwise a vote
+  (spar.ml:203-222); preference by (height, confirming votes, own-first,
+  earliest-seen) (spar.ml:185-196); `Constant` (1 per PoW in the block's
+  closure incl. the block) and `Block` (k to the block miner) rewards
+  (spar.ml:140-156),
+- attack space: simulator/protocols/spar_ssz.ml — 7-field observation
+  (spar_ssz.ml:22-33), Action8 (ssz_tools.ml:230-263) where
+  Proceed/Prolong set a *persistent* mining filter used by subsequent
+  puzzle drafts (spar_ssz.ml:186-189,305-308), release targeting by
+  (height, votes) of the public head with proposal fast-path
+  (spar_ssz.ml:261-298), policies honest/selfish (spar_ssz.ml:332-351),
+- engine semantics: simulator/gym/engine.ml:97-273 (one env step per
+  attacker interaction, defender cloud, gamma via message ordering).
+
+TPU re-design: one env step = one attacker action + one Bernoulli(alpha)
+activation whose payload (block vs vote) is decided at mining time from
+masked vote counts; vote selection for a block draft is one top-k over an
+(own-first, earliest-seen) composite score. Votes store their block in the
+`signer` column so confirming-vote counts are masked compares. gamma races
+follow the Nakamoto env's rule: a release that ties the defender's
+(height, votes) preference arms a race and the next defender activation
+mines on the attacker's released block with probability gamma.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from cpr_tpu import obs as obslib
+from cpr_tpu.core import dag as D
+from cpr_tpu.envs.base import JaxEnv
+from cpr_tpu.params import EnvParams
+
+BLOCK, VOTE = 0, 1
+
+# events: Discrete [`ProofOfWork; `Network] (spar_ssz.ml:45)
+EV_POW, EV_NETWORK = 0, 1
+
+# Action8 ranks (ssz_tools.ml:230-263)
+(ADOPT_PROLONG, OVERRIDE_PROLONG, MATCH_PROLONG, WAIT_PROLONG,
+ ADOPT_PROCEED, OVERRIDE_PROCEED, MATCH_PROCEED, WAIT_PROCEED) = range(8)
+
+
+def obs_fields(k: int):
+    """spar_ssz.ml:36-46."""
+    return (
+        obslib.Field("public_blocks", obslib.UINT, scale=1),
+        obslib.Field("private_blocks", obslib.UINT, scale=1),
+        obslib.Field("diff_blocks", obslib.INT, scale=1),
+        obslib.Field("public_votes", obslib.UINT, scale=k - 1),
+        obslib.Field("private_votes_inclusive", obslib.UINT, scale=k - 1),
+        obslib.Field("private_votes_exclusive", obslib.UINT, scale=k - 1),
+        obslib.Field("event", obslib.DISCRETE, n=2),
+    )
+
+
+@struct.dataclass
+class State:
+    dag: D.Dag
+    public: jnp.ndarray  # defender-preferred block (simulated)
+    private: jnp.ndarray  # attacker-preferred block
+    event: jnp.ndarray  # EV_*
+    race_tip: jnp.ndarray  # live match race target block (-1: none)
+    mining_excl: jnp.ndarray  # bool: Prolong = exclusive vote filter
+    # episode bookkeeping (engine.ml:69-79)
+    time: jnp.ndarray
+    steps: jnp.ndarray
+    n_activations: jnp.ndarray
+    last_reward_attacker: jnp.ndarray
+    last_reward_defender: jnp.ndarray
+    last_progress: jnp.ndarray
+    last_chain_time: jnp.ndarray
+    last_sim_time: jnp.ndarray
+    key: jax.Array
+
+
+class SparSSZ(JaxEnv):
+    n_actions = 8
+
+    def __init__(self, k: int = 8, incentive_scheme: str = "constant",
+                 unit_observation: bool = True, max_steps_hint: int = 256):
+        assert k >= 2
+        assert incentive_scheme in ("constant", "block")
+        self.k = k
+        self.incentive_scheme = incentive_scheme
+        self.unit_observation = unit_observation
+        # exactly one PoW append per step
+        self.capacity = max_steps_hint + 8
+        self.max_parents = k
+        self.fields = obs_fields(k)
+        self.observation_length = len(self.fields)
+        self.low, self.high = obslib.low_high(self.fields, unit_observation)
+        self.policies = self._make_policies()
+
+    # -- protocol primitives (spar.ml) -------------------------------------
+
+    def confirming(self, dag, b, extra_mask=None):
+        """Votes confirming block b (spar.ml:88-91); votes store their
+        block in the `signer` column."""
+        m = dag.exists() & (dag.kind == VOTE) & (dag.signer == b)
+        if extra_mask is not None:
+            m = m & extra_mask
+        return m
+
+    def last_block(self, dag, x):
+        """spar.ml:77-84."""
+        return jnp.where(dag.kind[x] == BLOCK, x, dag.signer[x])
+
+    def cmp_blocks(self, dag, x, y, vote_filter_mask, me):
+        """Honest compare (spar.ml:185-196): height, confirming votes,
+        own-appended first, earliest-seen first. >0 iff x preferred."""
+        nx = self.confirming(dag, x, vote_filter_mask).sum()
+        ny = self.confirming(dag, y, vote_filter_mask).sum()
+        own_x = (dag.miner[x] == me).astype(jnp.int32)
+        own_y = (dag.miner[y] == me).astype(jnp.int32)
+        seen = jnp.where(me == D.ATTACKER, dag.born_at, dag.vis_d_since)
+        key_x = (dag.height[x], nx, own_x, -seen[x])
+        key_y = (dag.height[y], ny, own_y, -seen[y])
+        gt = jnp.bool_(False)
+        eq = jnp.bool_(True)
+        for a, b in zip(key_x, key_y):
+            gt = gt | (eq & (a > b))
+            eq = eq & (a == b)
+        return jnp.where(x == y, False, gt)
+
+    def update_head(self, dag, old, cand, me):
+        mask = jnp.where(me == D.ATTACKER, dag.exists(), dag.vis_d)
+        better = self.cmp_blocks(dag, cand, old, mask, me)
+        return jnp.where(better, cand, old)
+
+    def _mine_one(self, dag, head, view, vote_filter, miner, time, powh):
+        """puzzle_payload' (spar.ml:203-227): block if >= k-1 filtered
+        votes confirm the head, else a vote. Returns (dag, idx, is_block)."""
+        k = self.k
+        votes = self.confirming(dag, head, view) & vote_filter
+        n = votes.sum()
+        make_block = n >= (k - 1)
+        # vote choice: own first, then earliest seen (spar.ml:208-214)
+        seen = jnp.where(miner == D.ATTACKER, dag.born_at, dag.vis_d_since)
+        horizon = dag.born_at.max() + 1.0
+        score = jnp.where(dag.miner == miner, seen, seen + horizon)
+        vidx, vvalid = D.top_k_by(score, votes, k - 1)
+        take = vvalid  # exactly k-1 valid when make_block
+        row_block = jnp.concatenate([
+            jnp.array([head], jnp.int32),
+            jnp.where(take, vidx, D.NONE).astype(jnp.int32)])
+        row_vote = jnp.full((self.max_parents,), D.NONE, jnp.int32
+                            ).at[0].set(head)
+        row = jnp.where(make_block, row_block, row_vote)
+        height = dag.height[head] + jnp.where(make_block, 1, 0)
+        # rewards at block append (spar.ml:140-156)
+        ids = jnp.where(take, dag.miner[jnp.clip(vidx, 0)], D.NONE)
+        if self.incentive_scheme == "constant":
+            atk = ((ids == D.ATTACKER).sum() + (miner == D.ATTACKER)
+                   ).astype(jnp.float32)
+            dfn = ((ids == D.DEFENDER).sum() + (miner == D.DEFENDER)
+                   ).astype(jnp.float32)
+        else:  # block: k to the block miner
+            atk = jnp.where(miner == D.ATTACKER, float(self.k), 0.0)
+            dfn = jnp.where(miner == D.DEFENDER, float(self.k), 0.0)
+        atk = jnp.where(make_block, atk, 0.0)
+        dfn = jnp.where(make_block, dfn, 0.0)
+        kind = jnp.where(make_block, BLOCK, VOTE)
+        signer = jnp.where(make_block, D.NONE, head)
+        progress = (height * k + jnp.where(make_block, 0, 1)
+                    ).astype(jnp.float32)
+        dag, idx = D.append(
+            dag, row, kind=kind, height=height, pow_hash=powh,
+            signer=signer, miner=miner, vis_a=True,
+            vis_d=(miner == D.DEFENDER), time=time,
+            reward_atk=atk, reward_def=dfn, progress=progress)
+        return dag, idx, make_block
+
+    # -- env API ------------------------------------------------------------
+
+    def reset(self, key: jax.Array, params: EnvParams):
+        dag = D.empty(self.capacity, self.max_parents)
+        dag, root = D.append(
+            dag, jnp.full((self.max_parents,), D.NONE, jnp.int32),
+            kind=BLOCK, height=0, miner=D.NONE, vis_a=True, vis_d=True,
+            time=0.0, progress=0.0)
+        z = jnp.int32(0)
+        f = jnp.float32(0.0)
+        state = State(
+            dag=dag, public=root, private=root,
+            event=jnp.int32(EV_POW), race_tip=D.NONE,
+            mining_excl=jnp.bool_(False),
+            time=f, steps=z, n_activations=z,
+            last_reward_attacker=f, last_reward_defender=f,
+            last_progress=f, last_chain_time=f, last_sim_time=f,
+            key=key,
+        )
+        state = self._mine(state, params)
+        return state, self.observe(state)
+
+    def _mine(self, state: State, params: EnvParams) -> State:
+        """One activation (engine.ml:108-121 collapsed)."""
+        dag = state.dag
+        key, k_dt, k_mine, k_hash, k_gamma = jax.random.split(state.key, 5)
+        dt = jax.random.exponential(k_dt) * params.activation_delay
+        time = state.time + dt
+        attacker = jax.random.uniform(k_mine) < params.alpha
+        powh = jax.random.uniform(k_hash)
+
+        # gamma race (network.ml:61-105 collapsed): the defender mines on
+        # the attacker's released tip while the preference tie is live
+        tgt = jnp.maximum(state.race_tip, 0)
+        still_tie = ((state.race_tip >= 0)
+                     & (dag.height[tgt] == dag.height[state.public])
+                     & (self.confirming(dag, tgt, dag.vis_d).sum()
+                        == self.confirming(dag, state.public,
+                                           dag.vis_d).sum()))
+        gamma_hit = (~attacker & still_tie
+                     & (jax.random.uniform(k_gamma) < params.gamma))
+        def_head = jnp.where(gamma_hit, tgt, state.public)
+        race_tip = jnp.where(attacker, state.race_tip, D.NONE)
+
+        atk_filter = jnp.where(state.mining_excl,
+                               dag.miner == D.ATTACKER, dag.exists())
+        head = jnp.where(attacker, state.private, def_head)
+        view = jnp.where(attacker, dag.vis_a, dag.vis_d)
+        filt = jnp.where(attacker, atk_filter, dag.exists())
+        miner = jnp.where(attacker, D.ATTACKER, D.DEFENDER)
+        dag, idx, is_blk = self._mine_one(
+            dag, head, view, filt, miner, time, powh)
+
+        # prepare (spar_ssz.ml:209-222): attacker prefers its own block;
+        # the defender runs update_head on the new block's chain
+        private = jnp.where(attacker & is_blk, idx, state.private)
+        public = jnp.where(
+            attacker, state.public,
+            jnp.where(is_blk,
+                      self.update_head(dag, def_head, idx,
+                                       jnp.int32(D.DEFENDER)),
+                      def_head))
+        return state.replace(
+            dag=dag, private=private, public=public, race_tip=race_tip,
+            event=jnp.where(attacker, EV_POW, EV_NETWORK).astype(jnp.int32),
+            time=time, n_activations=state.n_activations + 1, key=key,
+        )
+
+    def observe(self, state: State):
+        """spar_ssz.ml:226-253."""
+        dag = state.dag
+        ca = D.common_ancestor_by_height(dag, state.public, state.private)
+        pub_votes = self.confirming(dag, state.public, dag.vis_d).sum()
+        priv_inc = self.confirming(dag, state.private).sum()
+        priv_exc = self.confirming(dag, state.private,
+                                   dag.miner == D.ATTACKER).sum()
+        return obslib.encode(
+            self.fields,
+            (
+                dag.height[state.public] - dag.height[ca],
+                dag.height[state.private] - dag.height[ca],
+                dag.height[state.private] - dag.height[state.public],
+                pub_votes, priv_inc, priv_exc,
+                state.event,
+            ),
+            self.unit_observation,
+        )
+
+    def _apply(self, state: State, action) -> State:
+        """spar_ssz.ml:255-317."""
+        dag = state.dag
+        k = self.k
+        is_adopt = (action == ADOPT_PROLONG) | (action == ADOPT_PROCEED)
+        is_override = (action == OVERRIDE_PROLONG) | (action == OVERRIDE_PROCEED)
+        is_match = (action == MATCH_PROLONG) | (action == MATCH_PROCEED)
+        is_release = is_override | is_match
+        mining_excl = action < 4  # Prolong variants
+
+        # release targeting (spar_ssz.ml:261-273)
+        h_pub = dag.height[state.public]
+        nv_pub = self.confirming(dag, state.public, dag.vis_d).sum()
+        tgt_h = jnp.where(is_override & (nv_pub >= k), h_pub + 1, h_pub)
+        tgt_v = jnp.where(is_match, nv_pub,
+                          jnp.where(nv_pub >= k, 0, nv_pub + 1))
+
+        blk = D.block_at_height(dag, state.private, tgt_h)
+        blk = jnp.maximum(blk, 0)
+        # proposal fast path (spar_ssz.ml:283-291): if quorum-many votes
+        # requested, prefer an existing block child (first in DAG order)
+        child_blocks = (dag.exists() & (dag.kind == BLOCK)
+                        & (dag.parents[:, 0] == blk))
+        has_prop = child_blocks.any()
+        first_prop = jnp.argmax(child_blocks)
+        use_prop = (tgt_v >= k) & has_prop
+        rel_block = jnp.where(use_prop, first_prop, blk).astype(jnp.int32)
+        rel_votes_n = jnp.where(use_prop, 0, tgt_v)
+
+        votes = self.confirming(dag, rel_block)
+        vidx, vvalid = D.top_k_by(dag.born_at, votes, self.k + 8)
+        take = jnp.arange(self.k + 8) < rel_votes_n
+        not_enough = votes.sum() < rel_votes_n
+        vote_mask = jnp.zeros((self.capacity,), jnp.bool_)
+        vote_mask = vote_mask.at[vidx].max(vvalid & take)
+        vote_mask = jnp.where(not_enough, votes, vote_mask)
+
+        released = D.release_chain(dag, rel_block, state.time)
+        released = D.release(released, vote_mask, state.time)
+        dag = jax.tree.map(
+            lambda a, b: jnp.where(is_release, a, b), released, dag)
+
+        # deliver to the simulated defender; a tie arms the gamma race
+        rb = self.last_block(dag, rel_block)
+        public = jnp.where(
+            is_release,
+            self.update_head(dag, state.public, rb, jnp.int32(D.DEFENDER)),
+            state.public)
+        tie = (is_release & (rb != public)
+               & (dag.height[rb] == dag.height[public])
+               & (self.confirming(dag, rb, dag.vis_d).sum()
+                  == self.confirming(dag, public, dag.vis_d).sum()))
+        race_tip = jnp.where(tie, rb,
+                             jnp.where(is_adopt | is_override, D.NONE,
+                                       state.race_tip))
+        private = jnp.where(is_adopt, public, state.private)
+        return state.replace(dag=dag, public=public, private=private,
+                             race_tip=race_tip,
+                             mining_excl=jnp.asarray(mining_excl))
+
+    def step(self, state: State, action, params: EnvParams):
+        state = self._apply(state, action)
+        state = self._mine(state, params)
+        state = state.replace(steps=state.steps + 1)
+        dag = state.dag
+
+        # winner (spar.ml:123-128): (height, confirming votes), ties to
+        # the attacker (node 0 first in the fold)
+        n_pub = self.confirming(dag, state.public).sum()
+        n_priv = self.confirming(dag, state.private).sum()
+        pub_better = (dag.height[state.public] > dag.height[state.private]) | (
+            (dag.height[state.public] == dag.height[state.private])
+            & (n_pub > n_priv))
+        head = jnp.where(pub_better, state.public, state.private)
+
+        return self.finish_step(
+            state, params,
+            reward_attacker=dag.cum_atk[head],
+            reward_defender=dag.cum_def[head],
+            progress=(dag.height[head] * self.k).astype(jnp.float32),
+            chain_time=dag.born_at[head],
+            extra_done=dag.overflow,
+        )
+
+    # -- policies (spar_ssz.ml:332-351) -------------------------------------
+
+    def _make_policies(self):
+        def wrap(fn):
+            def wrapped(obs):
+                pub_b, priv_b, _, pub_v, priv_vi, priv_ve, ev = \
+                    self.decode_obs(obs)
+                return fn(pub_b, priv_b, pub_v, priv_vi, priv_ve)
+            return wrapped
+
+        def honest(pub_b, priv_b, pub_v, priv_vi, priv_ve):
+            return jnp.where(pub_b > 0, ADOPT_PROCEED, OVERRIDE_PROCEED)
+
+        def selfish(pub_b, priv_b, pub_v, priv_vi, priv_ve):
+            return jnp.where(
+                priv_b < pub_b, ADOPT_PROCEED,
+                jnp.where(
+                    (priv_b == 0) & (pub_b == 0), WAIT_PROLONG,
+                    jnp.where(pub_b == 0, WAIT_PROCEED, OVERRIDE_PROCEED)))
+
+        return {"honest": wrap(honest), "selfish": wrap(selfish)}

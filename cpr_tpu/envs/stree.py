@@ -1,0 +1,451 @@
+"""Stree — Simple Parallel PoW with tree-structured voting — under the
+SSZ-like withholding attack space, on the DAG tensor substrate.
+
+Reference counterparts:
+- protocol: simulator/protocols/stree.ml — every vertex carries PoW; a
+  vote extends the deepest branch confirming a block (depth = parent
+  depth + 1, stree.ml:136-144), a block references its parent block plus
+  quorum leaves whose vote closure has exactly k-1 votes
+  (stree.ml:144-151); quorum selection altruistic/heuristic (+ optimal
+  with 100-option cap -> heuristic fallback, stree.ml:383-486); rewards
+  constant/discount/punish/hybrid pay the block AND its confirmed votes,
+  discount rate (depth+1)/k (stree.ml:176-202); preference (height,
+  confirming votes, earlier-seen) (stree.ml:518-531),
+- attack space: simulator/protocols/stree_ssz.ml — 10-field observation
+  with 2-valued event (stree_ssz.ml:22-44), Action8 with a *persistent*
+  Proceed/Prolong mining filter (stree_ssz.ml:166,302-309), release =
+  smallest withheld descendant prefix that flips (Override) or ties
+  (Match) the defender's head (stree_ssz.ml:272-295), policies honest/
+  release-block/override-block/override-catchup/minor-delay/avoid-loss
+  (stree_ssz.ml:327-420),
+- engine semantics: simulator/gym/engine.ml:97-273.
+
+TPU re-design mirrors cpr_tpu.envs.tailstorm: votes store their block in
+the `signer` column, quorum selection runs on the compacted candidate
+frame (cpr_tpu.envs.quorum), the release scan is dense prefix algebra,
+and descent-from-common-ancestor is tracked with a `stale` bit set at
+Adopt. Unlike Tailstorm, blocks carry PoW, so appends are never
+deduplicated and there are no Append interactions: one env step = one
+attacker action + one Bernoulli(alpha) activation whose payload (block
+vs vote) is decided at mining time.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from cpr_tpu import obs as obslib
+from cpr_tpu.core import dag as D
+from cpr_tpu.envs import quorum as Q
+from cpr_tpu.envs.base import JaxEnv
+from cpr_tpu.params import EnvParams
+
+BLOCK, VOTE = 0, 1
+
+# events: Discrete [`ProofOfWork; `Network] (stree_ssz.ml:49)
+EV_POW, EV_NETWORK = 0, 1
+
+(ADOPT_PROLONG, OVERRIDE_PROLONG, MATCH_PROLONG, WAIT_PROLONG,
+ ADOPT_PROCEED, OVERRIDE_PROCEED, MATCH_PROCEED, WAIT_PROCEED) = range(8)
+
+INCENTIVE_SCHEMES = ("constant", "discount", "punish", "hybrid")
+SUBBLOCK_SELECTIONS = ("altruistic", "heuristic", "optimal")
+
+
+def obs_fields(k: int):
+    """stree_ssz.ml:22-49."""
+    q = max(k - 1, 1)
+    return (
+        obslib.Field("public_blocks", obslib.UINT, scale=1),
+        obslib.Field("private_blocks", obslib.UINT, scale=1),
+        obslib.Field("diff_blocks", obslib.INT, scale=1),
+        obslib.Field("public_votes", obslib.UINT, scale=q),
+        obslib.Field("private_votes_inclusive", obslib.UINT, scale=q),
+        obslib.Field("private_votes_exclusive", obslib.UINT, scale=q),
+        obslib.Field("public_depth", obslib.UINT, scale=q),
+        obslib.Field("private_depth_inclusive", obslib.UINT, scale=q),
+        obslib.Field("private_depth_exclusive", obslib.UINT, scale=q),
+        obslib.Field("event", obslib.DISCRETE, n=2),
+    )
+
+
+@struct.dataclass
+class State:
+    dag: D.Dag
+    public: jnp.ndarray
+    private: jnp.ndarray
+    event: jnp.ndarray
+    race_tip: jnp.ndarray  # live match race target block (-1: none)
+    mining_excl: jnp.ndarray  # bool: Prolong = exclusive vote filter
+    stale: jnp.ndarray  # (B,) withheld blocks abandoned at an Adopt
+    time: jnp.ndarray
+    steps: jnp.ndarray
+    n_activations: jnp.ndarray
+    last_reward_attacker: jnp.ndarray
+    last_reward_defender: jnp.ndarray
+    last_progress: jnp.ndarray
+    last_chain_time: jnp.ndarray
+    last_sim_time: jnp.ndarray
+    key: jax.Array
+
+
+class StreeSSZ(JaxEnv):
+    n_actions = 8
+
+    def __init__(self, k: int = 8, incentive_scheme: str = "constant",
+                 subblock_selection: str = "heuristic",
+                 unit_observation: bool = True, max_steps_hint: int = 256,
+                 release_scan: int = 128):
+        assert k >= 2
+        assert incentive_scheme in INCENTIVE_SCHEMES
+        assert subblock_selection in SUBBLOCK_SELECTIONS
+        self.k = k
+        self.q = k - 1
+        self.incentive_scheme = incentive_scheme
+        # `optimal` falls back to `heuristic` as the reference does beyond
+        # 100 n-choose-k options (stree.ml:389-391)
+        self.subblock_selection = (
+            "heuristic" if subblock_selection == "optimal"
+            else subblock_selection)
+        self.unit_observation = unit_observation
+        self.capacity = max_steps_hint + 8  # one PoW append per step
+        self.max_parents = k  # parent block + k-1 leaves
+        self.C_MAX = 4 * k + 16
+        self.STALE_WALK = 4
+        self.release_scan = min(release_scan, self.capacity)
+        self.fields = obs_fields(k)
+        self.observation_length = len(self.fields)
+        self.low, self.high = obslib.low_high(self.fields, unit_observation)
+        self.policies = self._make_policies()
+
+    # -- protocol primitives (stree.ml) ------------------------------------
+
+    def confirming(self, dag, b, extra_mask=None):
+        m = dag.exists() & (dag.kind == VOTE) & (dag.signer == b)
+        if extra_mask is not None:
+            m = m & extra_mask
+        return m
+
+    def last_block(self, dag, x):
+        return jnp.where(dag.kind[x] == BLOCK, x, dag.signer[x])
+
+    def vote_score(self, dag):
+        """compare_votes_in_block (stree.ml:96-100): depth desc, ties in
+        DAG (slot) order."""
+        return (dag.aux.astype(jnp.float32)
+                - dag.slots().astype(jnp.float32) / self.capacity)
+
+    def cmp_blocks(self, dag, x, y, vote_filter_mask):
+        """stree.ml:518-527: height, filtered confirming votes; the
+        visible_since tiebreak always favors the incumbent `y` (x is the
+        newer block), so strict (height, count) decides."""
+        nx = self.confirming(dag, x, vote_filter_mask).sum()
+        ny = self.confirming(dag, y, vote_filter_mask).sum()
+        hx, hy = dag.height[x], dag.height[y]
+        return jnp.where(x == y, False,
+                         (hx > hy) | ((hx == hy) & (nx > ny)))
+
+    def update_head(self, dag, old, cand, vote_filter_mask):
+        return jnp.where(self.cmp_blocks(dag, cand, old, vote_filter_mask),
+                         cand, old)
+
+    def quorum(self, dag, b, voter, vote_filter_mask, view_mask):
+        """k-1 sized vote-closure selection (stree.ml:383-486)."""
+        cand = self.confirming(dag, b) & vote_filter_mask & view_mask
+        own = dag.miner == voter
+        cidx, cvalid, abits = Q.candidate_frame(dag, cand, self.C_MAX, VOTE)
+        if self.subblock_selection == "altruistic":
+            seen = jnp.where(voter == D.ATTACKER, dag.born_at,
+                             dag.vis_d_since)
+            n, _, leaves_c, n_cand = Q.quorum_altruistic(
+                dag, cidx, cvalid, abits, own, seen, dag.aux, self.q)
+            found = (n == self.q) & (n_cand >= self.q)
+        else:
+            found, leaves_c = Q.quorum_heuristic(
+                dag, cidx, cvalid, abits, own, self.q)
+        row = Q.leaves_to_row(dag, cidx, leaves_c, cvalid, self.q,
+                              self.vote_score(dag))
+        return found, row
+
+    def block_reward(self, dag, leaves_row, miner):
+        """stree.ml:176-202: the block and its confirmed vote closure each
+        earn r; discount r = (depth_first + 1)/k, punish restricts the
+        closure to the deepest leaf's branch."""
+        discount = self.incentive_scheme in ("discount", "hybrid")
+        punish = self.incentive_scheme in ("punish", "hybrid")
+        leaves = leaves_row[:1] if punish else leaves_row
+        closure = jnp.zeros((self.capacity,), jnp.bool_)
+        cur = jnp.where(leaves >= 0, leaves, -1)
+        for _ in range(self.C_MAX):
+            valid = (cur >= 0) & (dag.kind[jnp.maximum(cur, 0)] == VOTE)
+            closure = closure.at[jnp.maximum(cur, 0)].max(valid)
+            cur = jnp.where(valid, dag.parents[jnp.maximum(cur, 0), 0], -1)
+        depth0 = dag.aux[jnp.maximum(leaves_row[0], 0)]
+        r = jnp.where(discount, (depth0 + 1).astype(jnp.float32) / self.k,
+                      1.0)
+        atk = r * ((closure & (dag.miner == D.ATTACKER)).sum()
+                   + (miner == D.ATTACKER))
+        dfn = r * ((closure & (dag.miner == D.DEFENDER)).sum()
+                   + (miner == D.DEFENDER))
+        return atk, dfn
+
+    def _mine_one(self, dag, head, view, vote_filter, miner, time, powh):
+        """puzzle_payload' (stree.ml:488-516): block draft when a k-1
+        quorum exists, else a vote on the deepest filtered branch."""
+        found, leaves = self.quorum(dag, head, miner, vote_filter, view)
+        # block variant
+        row_block = jnp.concatenate(
+            [jnp.array([head], jnp.int32), leaves])
+        atk, dfn = self.block_reward(dag, leaves, miner)
+        # vote variant: deepest filtered+visible vote, else the block
+        cand = self.confirming(dag, head, view) & vote_filter
+        parent = jnp.where(
+            cand.any(),
+            jnp.argmax(jnp.where(cand, self.vote_score(dag), -jnp.inf)),
+            head).astype(jnp.int32)
+        depth = jnp.where(cand.any(), dag.aux[parent] + 1, 1)
+        row_vote = jnp.full((self.max_parents,), D.NONE, jnp.int32
+                            ).at[0].set(parent)
+
+        row = jnp.where(found, row_block, row_vote)
+        kind = jnp.where(found, BLOCK, VOTE)
+        height = dag.height[head] + jnp.where(found, 1, 0)
+        aux = jnp.where(found, 0, depth)
+        signer = jnp.where(found, D.NONE, head)
+        progress = (height * self.k + aux).astype(jnp.float32)
+        dag, idx = D.append(
+            dag, row, kind=kind, height=height, aux=aux, pow_hash=powh,
+            signer=signer, miner=miner, vis_a=True,
+            vis_d=(miner == D.DEFENDER), time=time,
+            reward_atk=jnp.where(found, atk, 0.0),
+            reward_def=jnp.where(found, dfn, 0.0),
+            progress=progress)
+        return dag, idx, found
+
+    # -- env API ------------------------------------------------------------
+
+    def reset(self, key: jax.Array, params: EnvParams):
+        dag = D.empty(self.capacity, self.max_parents)
+        dag, root = D.append(
+            dag, jnp.full((self.max_parents,), D.NONE, jnp.int32),
+            kind=BLOCK, height=0, miner=D.NONE, vis_a=True, vis_d=True,
+            time=0.0, progress=0.0)
+        z = jnp.int32(0)
+        f = jnp.float32(0.0)
+        state = State(
+            dag=dag, public=root, private=root,
+            event=jnp.int32(EV_POW), race_tip=D.NONE,
+            mining_excl=jnp.bool_(False),
+            stale=jnp.zeros((self.capacity,), jnp.bool_),
+            time=f, steps=z, n_activations=z,
+            last_reward_attacker=f, last_reward_defender=f,
+            last_progress=f, last_chain_time=f, last_sim_time=f,
+            key=key,
+        )
+        state = self._mine(state, params)
+        return state, self.observe(state)
+
+    def _mine(self, state: State, params: EnvParams) -> State:
+        dag = state.dag
+        key, k_dt, k_mine, k_hash, k_gamma = jax.random.split(state.key, 5)
+        dt = jax.random.exponential(k_dt) * params.activation_delay
+        time = state.time + dt
+        attacker = jax.random.uniform(k_mine) < params.alpha
+        powh = jax.random.uniform(k_hash)
+
+        # gamma race while the (height, votes) tie is live
+        tgt = jnp.maximum(state.race_tip, 0)
+        still_tie = ((state.race_tip >= 0)
+                     & ~self.cmp_blocks(dag, state.public, tgt, dag.vis_d)
+                     & ~self.cmp_blocks(dag, tgt, state.public, dag.vis_d))
+        gamma_hit = (~attacker & still_tie
+                     & (jax.random.uniform(k_gamma) < params.gamma))
+        def_head = jnp.where(gamma_hit, tgt, state.public)
+        race_tip = jnp.where(attacker, state.race_tip, D.NONE)
+
+        atk_filter = jnp.where(state.mining_excl,
+                               dag.miner == D.ATTACKER, dag.exists())
+        head = jnp.where(attacker, state.private, def_head)
+        view = jnp.where(attacker, dag.vis_a, dag.vis_d)
+        filt = jnp.where(attacker, atk_filter, dag.exists())
+        miner = jnp.where(attacker, D.ATTACKER, D.DEFENDER)
+        dag, idx, is_blk = self._mine_one(
+            dag, head, view, filt, miner, time, powh)
+
+        private = jnp.where(attacker & is_blk, idx, state.private)
+        public = jnp.where(
+            attacker, state.public,
+            jnp.where(is_blk,
+                      self.update_head(dag, def_head, idx, dag.vis_d),
+                      def_head))
+        return state.replace(
+            dag=dag, private=private, public=public, race_tip=race_tip,
+            event=jnp.where(attacker, EV_POW, EV_NETWORK).astype(jnp.int32),
+            time=time, n_activations=state.n_activations + 1, key=key,
+        )
+
+    def observe(self, state: State):
+        """stree_ssz.ml:242-270."""
+        dag = state.dag
+        ca = D.common_ancestor_by_height(dag, state.public, state.private)
+
+        def depth_count(mask):
+            return (jnp.where(mask, dag.aux, 0).max(), mask.sum())
+
+        pub_d, pub_v = depth_count(self.confirming(dag, state.public,
+                                                   dag.vis_d))
+        inc_d, inc_v = depth_count(self.confirming(dag, state.private))
+        exc_d, exc_v = depth_count(self.confirming(
+            dag, state.private, dag.miner == D.ATTACKER))
+        return obslib.encode(
+            self.fields,
+            (
+                dag.height[state.public] - dag.height[ca],
+                dag.height[state.private] - dag.height[ca],
+                dag.height[state.private] - dag.height[state.public],
+                pub_v, inc_v, exc_v,
+                pub_d, inc_d, exc_d,
+                state.event,
+            ),
+            self.unit_observation,
+        )
+
+    def _release_sets(self, state: State):
+        """stree_ssz.ml:272-295 via the shared dense prefix scan."""
+        dag = state.dag
+        cands = dag.exists() & ~dag.vis_d & ~state.stale
+        return Q.prefix_release_sets(
+            dag, state.public, state.private, cands, self.release_scan,
+            lambda d, i: self.last_block(d, i), self.cmp_blocks)
+
+    def _apply(self, state: State, action) -> State:
+        """stree_ssz.ml:272-314."""
+        dag = state.dag
+        is_adopt = (action == ADOPT_PROLONG) | (action == ADOPT_PROCEED)
+        is_override = (action == OVERRIDE_PROLONG) | (action == OVERRIDE_PROCEED)
+        is_match = (action == MATCH_PROLONG) | (action == MATCH_PROCEED)
+        is_release = is_override | is_match
+        mining_excl = action < 4
+
+        override_set, match_set, found, new_head = self._release_sets(state)
+        mask = jnp.where(is_override, override_set,
+                         jnp.where(is_match, match_set,
+                                   jnp.zeros_like(match_set)))
+        released = D.release(dag, mask, state.time)
+        dag = jax.tree.map(
+            lambda a, b: jnp.where(is_release, a, b), released, dag)
+
+        public = jnp.where(is_override & found, new_head, state.public)
+        private = jnp.where(is_adopt, public, state.private)
+
+        stale = Q.stale_after_adopt(
+            dag, public, state.stale, is_adopt, self.release_scan,
+            self.STALE_WALK, lambda d, i: self.last_block(d, i),
+            lambda d, i: d.parents[i, 0])
+
+        # match race target: last block of the deepest released vertex,
+        # armed only when a flipping prefix exists
+        rel_tip = jnp.where(match_set, dag.slots(), -1).max()
+        race_tip = jnp.where(
+            is_match & found & (rel_tip >= 0),
+            self.last_block(dag, jnp.maximum(rel_tip, 0)),
+            jnp.where(is_adopt | is_override, D.NONE, state.race_tip))
+
+        return state.replace(dag=dag, public=public, private=private,
+                             race_tip=race_tip, stale=stale,
+                             mining_excl=jnp.asarray(mining_excl))
+
+    def step(self, state: State, action, params: EnvParams):
+        state = self._apply(state, action)
+        state = self._mine(state, params)
+        state = state.replace(steps=state.steps + 1)
+        dag = state.dag
+
+        n_pub = self.confirming(dag, state.public).sum()
+        n_priv = self.confirming(dag, state.private).sum()
+        pub_better = (dag.height[state.public] > dag.height[state.private]) | (
+            (dag.height[state.public] == dag.height[state.private])
+            & (n_pub > n_priv))
+        head = jnp.where(pub_better, state.public, state.private)
+
+        return self.finish_step(
+            state, params,
+            reward_attacker=dag.cum_atk[head],
+            reward_defender=dag.cum_def[head],
+            progress=(dag.height[head] * self.k).astype(jnp.float32),
+            chain_time=dag.born_at[head],
+            extra_done=dag.overflow,
+        )
+
+    # -- policies (stree_ssz.ml:327-420) ------------------------------------
+
+    def _make_policies(self):
+        k = self.k
+
+        def wrap(fn):
+            def wrapped(obs):
+                (pub_b, priv_b, _, pub_v, priv_vi, priv_ve,
+                 _pd, inc_d, _ed, _ev) = self.decode_obs(obs)
+                return fn(pub_b, priv_b, pub_v, priv_vi, priv_ve, inc_d)
+            return wrapped
+
+        def honest(pub_b, priv_b, pub_v, priv_vi, priv_ve, inc_d):
+            return jnp.where(pub_b > 0, ADOPT_PROCEED, OVERRIDE_PROCEED)
+
+        def release_block(pub_b, priv_b, pub_v, priv_vi, priv_ve, inc_d):
+            return jnp.where(
+                priv_b < pub_b, ADOPT_PROCEED,
+                jnp.where(priv_b > pub_b, OVERRIDE_PROCEED, WAIT_PROCEED))
+
+        def override_block(pub_b, priv_b, pub_v, priv_vi, priv_ve, inc_d):
+            return jnp.where(
+                priv_b < pub_b, ADOPT_PROCEED,
+                jnp.where(pub_b == 0, WAIT_PROCEED, OVERRIDE_PROCEED))
+
+        def override_catchup(pub_b, priv_b, pub_v, priv_vi, priv_ve, inc_d):
+            return jnp.where(
+                priv_b < pub_b, ADOPT_PROCEED,
+                jnp.where(
+                    (priv_b == 0) & (pub_b == 0), WAIT_PROCEED,
+                    jnp.where(
+                        pub_b == 0, WAIT_PROCEED,
+                        jnp.where(
+                            (inc_d == 0) & (priv_b == pub_b + 1),
+                            OVERRIDE_PROCEED,
+                            jnp.where(
+                                (pub_b == priv_b)
+                                & (priv_vi == pub_v + 1),
+                                OVERRIDE_PROCEED,
+                                jnp.where(priv_b - pub_b > 10,
+                                          OVERRIDE_PROCEED,
+                                          WAIT_PROCEED))))))
+
+        def minor_delay(pub_b, priv_b, pub_v, priv_vi, priv_ve, inc_d):
+            return jnp.where(
+                pub_b > priv_b, ADOPT_PROCEED,
+                jnp.where(pub_b == 0, WAIT_PROCEED, OVERRIDE_PROCEED))
+
+        def avoid_loss(pub_b, priv_b, pub_v, priv_vi, priv_ve, inc_d):
+            hp = pub_b * k + pub_v
+            ap = priv_b * k + priv_vi
+            return jnp.where(
+                pub_b == 0, WAIT_PROCEED,
+                jnp.where(
+                    (pub_b == 1) & (hp == ap), MATCH_PROCEED,
+                    jnp.where(
+                        hp > ap, ADOPT_PROCEED,
+                        jnp.where(
+                            hp == ap - 1, OVERRIDE_PROCEED,
+                            jnp.where(pub_b < priv_b - 10,
+                                      OVERRIDE_PROCEED, WAIT_PROCEED)))))
+
+        return {
+            "honest": wrap(honest),
+            "release-block": wrap(release_block),
+            "override-block": wrap(override_block),
+            "override-catchup": wrap(override_catchup),
+            "minor-delay": wrap(minor_delay),
+            "avoid-loss": wrap(avoid_loss),
+        }

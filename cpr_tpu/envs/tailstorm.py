@@ -61,6 +61,7 @@ from flax import struct
 
 from cpr_tpu import obs as obslib
 from cpr_tpu.core import dag as D
+from cpr_tpu.envs import quorum as Q
 from cpr_tpu.envs.base import JaxEnv
 from cpr_tpu.params import EnvParams
 
@@ -139,7 +140,7 @@ class TailstormSSZ(JaxEnv):
         self.C_MAX = 4 * k + 16  # quorum candidate window (compacted)
         self.STALE_WALK = 4  # summary-chain descent check depth at Adopt
         assert self.C_MAX < (1 << 8), "composite sort keys use 8 bits"
-        self.release_scan = release_scan
+        self.release_scan = min(release_scan, self.capacity)
         self.fields = obs_fields(k)
         self.observation_length = len(self.fields)
         self.low, self.high = obslib.low_high(self.fields, unit_observation)
@@ -251,130 +252,26 @@ class TailstormSSZ(JaxEnv):
 
     # -- quorum selection ---------------------------------------------------
 
-    def _candidate_frame(self, dag, cand):
-        """Compact the candidate votes to C_MAX slot-ascending indices and
-        build the candidate-local ancestor bit-matrix abits (C, C):
-        abits[i, j] == candidate j lies on candidate i's vote path
-        (including i == j). The reference reaches candidates through a
-        *filtered* child traversal (tailstorm.ml:509-531), so a vote whose
-        path leaves the candidate set is unreachable — such rows are
-        invalidated. With abits in registers, every quorum round is dense
-        boolean algebra on (C, C) — no gathers on the hot path."""
-        C = self.C_MAX
-        slot_f = dag.slots().astype(jnp.float32)
-        cidx, cvalid = D.top_k_by(slot_f, cand, C)
-        cidx = jnp.where(cvalid, cidx, -1)
-        ci = jnp.maximum(cidx, 0)
-        # one parent edge per candidate (votes have a single parent);
-        # express it as a dense one-hot row and close transitively with
-        # log-doubling boolean matmuls — MXU-friendly, no gathers/scatters
-        par = dag.parents[ci, 0]
-        par_is_vote = cvalid & (par >= 0) & (dag.kind[jnp.maximum(par, 0)]
-                                             == VOTE)
-        big = jnp.int32(jnp.iinfo(jnp.int32).max)
-        sorted_slots = jnp.where(cidx >= 0, cidx, big)
-        pos = jnp.clip(jnp.searchsorted(sorted_slots, jnp.maximum(par, 0)),
-                       0, C - 1).astype(jnp.int32)
-        par_in = par_is_vote & (sorted_slots[pos] == jnp.maximum(par, 0))
-        # parent is a vote outside the candidate set -> the path escapes
-        # the filtered traversal, which the reference can never follow
-        escaped = par_is_vote & ~par_in
-        adj = ((jnp.arange(C)[None, :] == jnp.where(par_in, pos, -1)[:, None])
-               .astype(jnp.float32))
-        reach = adj + jnp.eye(C, dtype=jnp.float32)
-        n_doublings = max(1, (C - 1).bit_length())
-        for _ in range(n_doublings):
-            reach = jnp.minimum(reach + reach @ reach, 1.0)
-        abits = reach > 0.0
-        cvalid = cvalid & ~(abits & escaped[None, :]).any(axis=1)
-        abits = abits & cvalid[:, None]
-        return cidx, cvalid, abits
-
-    def _quorum_heuristic(self, dag, cidx, cvalid, abits, own):
-        """heuristic_quorum (tailstorm.ml:329-380): greedily include the
-        branch maximizing (own fresh reward, total fresh reward), ties by
-        DAG order; <= k rounds since every round includes >= 1 vote."""
-        C = cidx.shape[0]
-        k = self.k
-        own_c = own[jnp.maximum(cidx, 0)] & cvalid
-
-        def body(_, carry):
-            inc, leaves_c, n_rem = carry
-            fresh = abits & ~inc[None, :]
-            f_all = fresh.sum(axis=1)
-            f_own = (fresh & own_c[None, :]).sum(axis=1)
-            eligible = cvalid & ~inc & (f_all >= 1) & (f_all <= n_rem)
-            # lexicographic (own desc, all desc, slot asc) as one int32;
-            # candidates are slot-ascending so local index == DAG order
-            score = ((f_own * (k + 1) + f_all) << 8) + (C - jnp.arange(C))
-            score = jnp.where(eligible & (n_rem > 0), score, -1)
-            c = jnp.argmax(score).astype(jnp.int32)
-            ok = score[c] >= 0
-            inc = inc | (abits[c] & ok)
-            leaves_c = leaves_c.at[c].max(ok)
-            return inc, leaves_c, n_rem - jnp.where(ok, f_all[c], 0)
-
-        z = jnp.zeros((C,), jnp.bool_)
-        _, leaves_c, n_rem = jax.lax.fori_loop(
-            0, k, body, (z, z, jnp.int32(k)))
-        return (n_rem == 0) & (cvalid.sum() >= k), leaves_c
-
-    def _quorum_altruistic(self, dag, cidx, cvalid, abits, own, seen):
-        """altruistic_quorum (tailstorm.ml:271-313): scan candidates by
-        (depth desc, own first, seen asc), greedily adding whole branches
-        that still fit."""
-        C = cidx.shape[0]
-        k = self.k
-        ci = jnp.maximum(cidx, 0)
-        depth = jnp.minimum(dag.aux[ci], 4 * k)  # 6-bit field
-        own_c = own[ci]
-        seen_rank = jnp.argsort(jnp.argsort(seen[ci])).astype(jnp.int32)
-        comp = ((((jnp.int32(4 * k) - depth) << 1 | (~own_c).astype(jnp.int32))
-                 << 8) + seen_rank) << 8
-        comp = comp + jnp.arange(C, dtype=jnp.int32)  # stable: DAG order
-        order = jnp.argsort(jnp.where(cvalid, comp, jnp.iinfo(jnp.int32).max))
-        n_cand = cvalid.sum()
-
-        def cond(carry):
-            i, _, _, n = carry
-            return (n < k) & (i < n_cand)
-
-        def body(carry):
-            i, acc, leaves_c, n = carry
-            c = order[i]
-            fresh = (abits[c] & ~acc).sum()
-            take = (fresh >= 1) & (n + fresh <= k)
-            acc = acc | (abits[c] & take)
-            leaves_c = leaves_c.at[c].max(take)
-            return i + 1, acc, leaves_c, n + jnp.where(take, fresh, 0)
-
-        z = jnp.zeros((C,), jnp.bool_)
-        _, _, leaves_c, n = jax.lax.while_loop(
-            cond, body, (jnp.int32(0), z, z, jnp.int32(0)))
-        return (n == k) & (n_cand >= k), leaves_c
-
     def quorum(self, dag, b, voter, vote_filter_mask, view_mask):
         """Select k sub-blocks confirming b; returns (found, parents_row)
         with leaves sorted by (depth desc, hash asc)
-        (compare_votes_in_block, tailstorm.ml:124-130). Candidates are
-        compacted to the first C_MAX slots (a quorum window holds ~k
-        votes; overflow beyond C_MAX drops the newest candidates)."""
+        (compare_votes_in_block, tailstorm.ml:124-130). Selection runs on
+        the compacted candidate frame (cpr_tpu.envs.quorum); overflow
+        beyond C_MAX drops the newest candidates."""
         cand = self.confirming(dag, b) & vote_filter_mask & view_mask
         own = dag.miner == voter
-        cidx, cvalid, abits = self._candidate_frame(dag, cand)
+        cidx, cvalid, abits = Q.candidate_frame(dag, cand, self.C_MAX, VOTE)
         if self.subblock_selection == "altruistic":
             seen = jnp.where(voter == D.ATTACKER, dag.born_at,
                              dag.vis_d_since)
-            found, leaves_c = self._quorum_altruistic(
-                dag, cidx, cvalid, abits, own, seen)
+            n, _, leaves_c, n_cand = Q.quorum_altruistic(
+                dag, cidx, cvalid, abits, own, seen, dag.aux, self.k)
+            found = (n == self.k) & (n_cand >= self.k)
         else:
-            found, leaves_c = self._quorum_heuristic(
-                dag, cidx, cvalid, abits, own)
-        leaves = jnp.zeros((dag.capacity,), jnp.bool_).at[
-            jnp.maximum(cidx, 0)].max(leaves_c & cvalid)
+            found, leaves_c = Q.quorum_heuristic(
+                dag, cidx, cvalid, abits, own, self.k)
         score = dag.aux.astype(jnp.float32) - dag.pow_hash  # depth - hash
-        idx, valid = D.top_k_by(score, leaves, self.k, largest=True)
-        row = jnp.where(valid, idx, D.NONE).astype(jnp.int32)
+        row = Q.leaves_to_row(dag, cidx, leaves_c, cvalid, self.k, score)
         return found, row
 
     def summary_reward(self, dag, row):
@@ -585,75 +482,22 @@ class TailstormSSZ(JaxEnv):
         )
 
     def _release_sets(self, state: State):
-        """tailstorm_ssz.ml:292-314: scan the withheld descendants of the
-        common ancestor in DAG (= slot, topological) order; the Override
-        set is the smallest prefix whose release flips the defender's
-        head, the Match set is that prefix minus the flipping vertex. If
-        no prefix flips, both release everything.
-
-        TPU re-design: the sequential scan becomes dense prefix algebra
-        over the (compacted) withheld candidates — for every prefix j the
-        defender's head-comparison terms are cumulative counts, so all
-        prefixes are evaluated at once and the stop index is an argmax.
-        "Descendant of the common ancestor" is tracked incrementally via
-        the `stale` bit (blocks withheld at an Adopt are abandoned forever,
-        which is when and only when the common ancestor passes them);
-        after a partial release the approximation can retain a few
-        vertices the reference would skip — they release harmlessly."""
+        """tailstorm_ssz.ml:292-314 via the shared dense prefix scan
+        (cpr_tpu.envs.quorum.prefix_release_sets); the flip tiebreak is
+        the defender's own summary reward (tailstorm.ml:539-549)."""
         dag = state.dag
-        R = self.release_scan
-        B = dag.capacity
+
+        def extra(dag_, sids):
+            return self.own_reward(dag_, sids, jnp.int32(D.DEFENDER))
+
+        def cmp(dag_, x, y, mask):
+            return self.cmp_summaries(dag_, x, y, mask,
+                                      jnp.int32(D.DEFENDER))
+
         cands = dag.exists() & ~dag.vis_d & ~state.stale
-        slot_f = dag.slots().astype(jnp.float32)
-        ridx, rvalid = D.top_k_by(slot_f, cands, R)
-        ri = jnp.maximum(ridx, 0)
-        ls = jnp.where(rvalid, self.last_summary(dag, ri), 0)  # (R,)
-
-        is_vote = dag.exists() & (dag.kind == VOTE)
-        # votes visible to the defender confirming each prefix-candidate's
-        # summary: (B, R) compare + reduce
-        conf_vis = ((is_vote & dag.vis_d)[:, None]
-                    & (dag.signer[:, None] == ls[None, :])).sum(axis=0)
-        # released candidates i <= j confirming ls_j
-        cand_vote = (dag.kind[ri] == VOTE) & rvalid
-        csig = dag.signer[ri]
-        cmat = cand_vote[:, None] & (csig[:, None] == ls[None, :])
-        leq = jnp.triu(jnp.ones((R, R), jnp.bool_))  # i <= j
-        nconf = conf_vis + (cmat & leq).sum(axis=0)
-
-        pub = state.public
-        pub_vis = (is_vote & dag.vis_d & (dag.signer == pub)).sum()
-        npub = pub_vis + jnp.cumsum(cand_vote & (csig == pub))
-
-        h_ls, h_pub = dag.height[ls], dag.height[pub]
-        my = jnp.int32(D.DEFENDER)
-        r_ls, r_pub = self.own_reward(dag, ls, my), self.own_reward(dag, pub, my)
-        # compare_blocks (tailstorm.ml:539-549), strict
-        flip = (h_ls > h_pub) | (
-            (h_ls == h_pub) & ((nconf > npub) | (
-                (nconf == npub) & (r_ls > r_pub))))
-        flip = flip & (ls != pub) & rvalid
-        n_withheld = cands.sum()
-        overflow = n_withheld > R
-        found = flip.any() & ~overflow
-        j_stop = jnp.argmax(flip).astype(jnp.int32)
-        take_o = jnp.where(found, jnp.arange(R) <= j_stop, rvalid)
-        take_m = jnp.where(found, jnp.arange(R) < j_stop, rvalid)
-        z = jnp.zeros((B,), jnp.bool_)
-        override_set = z.at[ri].max(take_o & rvalid)
-        match_set = z.at[ri].max(take_m & rvalid)
-        # window overflow (> R withheld vertices): fall back to releasing
-        # everything, and let the release flip the head iff the attacker's
-        # preferred summary beats the public one once fully visible
-        override_set = jnp.where(overflow, cands, override_set)
-        match_set = jnp.where(overflow, cands, match_set)
-        all_flip = self.cmp_summaries(dag, state.private, pub,
-                                      dag.vis_d | cands, my)
-        found = found | (overflow & all_flip)
-        new_head = jnp.where(
-            overflow, jnp.where(all_flip, state.private, pub),
-            jnp.where(found, ls[j_stop], pub))
-        return override_set, match_set, found, new_head
+        return Q.prefix_release_sets(
+            dag, state.public, state.private, cands, self.release_scan,
+            lambda d, i: self.last_summary(d, i), cmp, extra_key=extra)
 
     def _apply(self, state: State, action) -> State:
         """tailstorm_ssz.ml:292-350."""
@@ -675,25 +519,10 @@ class TailstormSSZ(JaxEnv):
         public = jnp.where(is_override & found, new_head, state.public)
         private = jnp.where(is_adopt, public, state.private)
         def_dirty = state.def_dirty | (is_release & mask.any())
-        # adopting moves the common ancestor to `public`: withheld blocks
-        # NOT descending from it are abandoned for good. Descent is checked
-        # on the compacted withheld set by walking each block's summary
-        # chain down STALE_WALK levels (deeper withheld branches above the
-        # adopted head cannot exist: the attacker adopts because it is
-        # behind)
-        withheld = ~dag.vis_d & dag.exists() & ~state.stale
-        widx, wvalid = D.top_k_by(dag.slots().astype(jnp.float32), withheld,
-                                  self.release_scan)
-        wi = jnp.maximum(widx, 0)
-        cur = self.last_summary(dag, wi)
-        keeps = jnp.zeros_like(wvalid)
-        for _ in range(self.STALE_WALK):
-            keeps = keeps | (cur == public)
-            cur = jnp.where(cur >= 0, self.prev_summary(
-                dag, jnp.maximum(cur, 0)), -1)
-        keep_mask = jnp.zeros_like(withheld).at[wi].max(keeps & wvalid)
-        stale = jnp.where(is_adopt, state.stale | (withheld & ~keep_mask),
-                          state.stale)
+        stale = Q.stale_after_adopt(
+            dag, public, state.stale, is_adopt, self.release_scan,
+            self.STALE_WALK, lambda d, i: self.last_summary(d, i),
+            lambda d, i: self.prev_summary(d, i))
 
         # match race target: deepest released summary's chain tip; armed
         # only when a flipping prefix exists (found), i.e. the released
@@ -746,13 +575,6 @@ class TailstormSSZ(JaxEnv):
         )
 
     # -- policies (tailstorm_ssz.ml:365-472) --------------------------------
-
-    def decode_obs(self, obs):
-        vals = [
-            obslib.field_of_float(f, obs[..., i], self.unit_observation)
-            for i, f in enumerate(self.fields)
-        ]
-        return tuple(jnp.asarray(v, jnp.int32) for v in vals)
 
     def _make_policies(self):
         k = self.k

@@ -1,0 +1,41 @@
+"""Registry + protocol-key parser tests (cpr_protocols.ml:786-903 expect
+test analog)."""
+
+import pytest
+
+from cpr_tpu.envs import registry
+
+
+def test_family_keys_present():
+    ks = registry.keys()
+    for family in ("nakamoto", "ethereum", "bk", "spar", "stree", "sdag",
+                   "tailstorm"):
+        assert family in ks
+
+
+@pytest.mark.parametrize("key,cls,attrs,kwargs", [
+    ("nakamoto", "NakamotoSSZ", {}, {}),
+    ("ethereum-byzantium", "EthereumSSZ", {}, {"max_steps_hint": 32}),
+    ("bk-4-constant", "BkSSZ", {"k": 4, "incentive_scheme": "constant"},
+     {"max_steps_hint": 32}),
+    ("spar-4-block", "SparSSZ", {"k": 4, "incentive_scheme": "block"},
+     {"max_steps_hint": 32}),
+    ("stree-4-discount-altruistic", "StreeSSZ",
+     {"k": 4, "incentive_scheme": "discount",
+      "subblock_selection": "altruistic"}, {"max_steps_hint": 32}),
+    ("sdag-4-constant", "SdagSSZ", {"k": 4}, {"max_steps_hint": 32}),
+    ("tailstorm-4-discount-heuristic", "TailstormSSZ",
+     {"k": 4, "incentive_scheme": "discount"}, {"max_steps_hint": 32}),
+])
+def test_parse_and_instantiate(key, cls, attrs, kwargs):
+    env = registry.get(key, **kwargs)
+    assert type(env).__name__ == cls
+    for a, v in attrs.items():
+        assert getattr(env, a) == v, (a, getattr(env, a), v)
+
+
+def test_bad_keys_rejected():
+    for key in ("tailstorm-x-discount", "foo", "bk-4-constant-extra-bits",
+                "ethereum-petersburg"):
+        with pytest.raises(KeyError):
+            registry.get(key)

@@ -1,0 +1,121 @@
+"""Sdag env tests (sdag.ml validity + stochastic batteries)."""
+
+import jax
+import numpy as np
+import pytest
+
+from cpr_tpu.envs.sdag import BLOCK, VOTE, SdagSSZ
+from cpr_tpu.params import make_params
+
+
+@pytest.fixture(scope="module")
+def env():
+    return SdagSSZ(k=4, incentive_scheme="constant", max_steps_hint=192)
+
+
+def run_policy(env, name, alpha, n_envs=96, episode_steps=128, seed=0):
+    params = make_params(alpha=alpha, gamma=0.5, max_steps=episode_steps)
+    policy = env.policies[name]
+    keys = jax.random.split(jax.random.PRNGKey(seed), n_envs)
+    stats = jax.vmap(
+        lambda k: env.episode_stats(k, params, policy, episode_steps + 32)
+    )(keys)
+    atk = np.asarray(stats["episode_reward_attacker"]).mean()
+    dfn = np.asarray(stats["episode_reward_defender"]).mean()
+    return atk / (atk + dfn)
+
+
+def test_honest_policy_yields_alpha(env):
+    for alpha in [0.25, 0.4]:
+        rel = run_policy(env, "honest", alpha)
+        assert abs(rel - alpha) < 0.05, (alpha, rel)
+
+
+def test_dag_structure_invariants(env):
+    """sdag.ml:139-172: a vote's number equals its closure cardinality and
+    all parents share its block; a block's confirmed closure has exactly
+    k-1 votes confirming the previous block."""
+    params = make_params(alpha=0.35, gamma=0.5, max_steps=160)
+    state, obs = env.reset(jax.random.PRNGKey(3), params)
+    step = jax.jit(env.step)
+    policy = env.policies["release-block"]
+    for _ in range(160):
+        state, obs, r, done, info = step(state, policy(obs), params)
+    dag = state.dag
+    n = int(dag.n)
+    assert not bool(dag.overflow)
+    parents = np.asarray(dag.parents)[:n]
+    kind = np.asarray(dag.kind)[:n]
+    height = np.asarray(dag.height)[:n]
+    vote_no = np.asarray(dag.aux)[:n]
+    signer = np.asarray(dag.signer)[:n]
+    powh = np.asarray(dag.pow_hash)[:n]
+
+    def closure(starts):
+        seen = set()
+        stack = [s for s in starts if s >= 0 and kind[s] == VOTE]
+        while stack:
+            cur = stack.pop()
+            if cur in seen:
+                continue
+            seen.add(cur)
+            for p in parents[cur]:
+                if p >= 0 and kind[p] == VOTE:
+                    stack.append(p)
+        return seen
+
+    saw_block = False
+    for i in range(1, n):
+        ps = parents[i][parents[i] >= 0]
+        assert np.isfinite(powh[i])
+        if kind[i] == VOTE:
+            assert len(ps) >= 1
+            cl = closure(list(ps))
+            assert vote_no[i] == len(cl) + 1, (i, vote_no[i], cl)
+            blocks = {p if kind[p] == BLOCK else signer[p] for p in ps}
+            assert blocks == {signer[i]}
+            assert height[i] == height[signer[i]]
+        else:
+            saw_block = True
+            cl = closure(list(ps))
+            assert len(cl) == env.k - 1, (i, cl)
+            prevs = {signer[v] for v in cl}
+            assert len(prevs) == 1
+            assert height[i] == height[prevs.pop()] + 1
+    assert saw_block
+
+
+def test_progress_tracks_activations(env):
+    params = make_params(alpha=0.3, gamma=0.5, max_steps=160)
+    stats = env.episode_stats(
+        jax.random.PRNGKey(7), params, env.policies["honest"], 192)
+    prog = float(stats["episode_progress"])
+    acts = float(stats["episode_n_activations"])
+    assert prog > 0 and prog / acts > 0.6, (prog, acts)
+
+
+def test_policies_run_and_terminate(env):
+    params = make_params(alpha=0.4, gamma=0.5, max_steps=96)
+    for name, policy in env.policies.items():
+        traj = env.rollout(jax.random.PRNGKey(5), params, policy, 160)
+        done = np.asarray(traj[3])
+        assert done.sum() >= 1, name
+
+
+def test_discount_scheme_bounds_rewards():
+    env = SdagSSZ(k=4, incentive_scheme="discount", max_steps_hint=96)
+    params = make_params(alpha=0.3, gamma=0.5, max_steps=64)
+    stats = env.episode_stats(
+        jax.random.PRNGKey(11), params, env.policies["honest"], 96)
+    total = float(stats["episode_reward_attacker"]
+                  + stats["episode_reward_defender"])
+    prog = float(stats["episode_progress"])
+    assert 0 < total <= prog + env.k, (total, prog)
+
+
+def test_altruistic_selection_runs():
+    env = SdagSSZ(k=4, subblock_selection="altruistic", max_steps_hint=96)
+    params = make_params(alpha=0.3, gamma=0.5, max_steps=64)
+    stats = env.episode_stats(
+        jax.random.PRNGKey(13), params, env.policies["honest"], 96)
+    assert float(stats["episode_progress"]) > 0

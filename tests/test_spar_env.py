@@ -1,0 +1,98 @@
+"""Spar env tests, mirroring the reference's stochastic batteries
+(cpr_protocols.ml:200-657) and spar.ml:100-117 validity."""
+
+import jax
+import numpy as np
+import pytest
+
+from cpr_tpu.envs.spar import BLOCK, VOTE, SparSSZ
+from cpr_tpu.params import make_params
+
+
+@pytest.fixture(scope="module")
+def env():
+    return SparSSZ(k=4, incentive_scheme="constant", max_steps_hint=192)
+
+
+def run_policy(env, name, alpha, n_envs=128, episode_steps=128, seed=0):
+    params = make_params(alpha=alpha, gamma=0.5, max_steps=episode_steps)
+    policy = env.policies[name]
+    keys = jax.random.split(jax.random.PRNGKey(seed), n_envs)
+    stats = jax.vmap(
+        lambda k: env.episode_stats(k, params, policy, episode_steps + 32)
+    )(keys)
+    atk = np.asarray(stats["episode_reward_attacker"]).mean()
+    dfn = np.asarray(stats["episode_reward_defender"]).mean()
+    return atk / (atk + dfn)
+
+
+def test_honest_policy_yields_alpha(env):
+    for alpha in [0.25, 0.4]:
+        rel = run_policy(env, "honest", alpha)
+        assert abs(rel - alpha) < 0.05, (alpha, rel)
+
+
+def test_dag_structure_invariants(env):
+    """spar.ml:100-117: votes have one block parent at the same height;
+    blocks have a block parent at height-1 plus exactly k-1 votes on it."""
+    params = make_params(alpha=0.35, gamma=0.5, max_steps=160)
+    state, obs = env.reset(jax.random.PRNGKey(3), params)
+    step = jax.jit(env.step)
+    policy = env.policies["selfish"]
+    for _ in range(160):
+        state, obs, r, done, info = step(state, policy(obs), params)
+    dag = state.dag
+    n = int(dag.n)
+    assert not bool(dag.overflow)
+    parents = np.asarray(dag.parents)[:n]
+    kind = np.asarray(dag.kind)[:n]
+    height = np.asarray(dag.height)[:n]
+    signer = np.asarray(dag.signer)[:n]
+    powh = np.asarray(dag.pow_hash)[:n]
+    saw_block = False
+    for i in range(1, n):
+        ps = parents[i][parents[i] >= 0]
+        assert np.isfinite(powh[i])
+        if kind[i] == VOTE:
+            assert len(ps) == 1
+            assert kind[ps[0]] == BLOCK
+            assert height[i] == height[ps[0]]
+            assert signer[i] == ps[0]
+        else:
+            saw_block = True
+            p0, votes = ps[0], ps[1:]
+            assert kind[p0] == BLOCK
+            assert height[i] == height[p0] + 1
+            assert len(votes) == env.k - 1
+            for v in votes:
+                assert kind[v] == VOTE and signer[v] == p0
+    assert saw_block
+
+
+def test_progress_tracks_activations(env):
+    params = make_params(alpha=0.3, gamma=0.5, max_steps=160)
+    stats = env.episode_stats(
+        jax.random.PRNGKey(7), params, env.policies["honest"], 192)
+    prog = float(stats["episode_progress"])
+    acts = float(stats["episode_n_activations"])
+    assert prog > 0 and prog / acts > 0.7, (prog, acts)
+
+
+def test_policies_run_and_terminate(env):
+    params = make_params(alpha=0.4, gamma=0.5, max_steps=96)
+    for name, policy in env.policies.items():
+        traj = env.rollout(jax.random.PRNGKey(5), params, policy, 160)
+        done = np.asarray(traj[3])
+        assert done.sum() >= 1, name
+
+
+def test_block_scheme_pays_leader():
+    env = SparSSZ(k=4, incentive_scheme="block", max_steps_hint=96)
+    params = make_params(alpha=0.3, gamma=0.5, max_steps=64)
+    stats = env.episode_stats(
+        jax.random.PRNGKey(11), params, env.policies["honest"], 96)
+    total = float(stats["episode_reward_attacker"]
+                  + stats["episode_reward_defender"])
+    prog = float(stats["episode_progress"])
+    # k per block == 1 per progress unit on the winning chain
+    assert abs(total - prog) <= env.k, (total, prog)

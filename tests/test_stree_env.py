@@ -1,0 +1,120 @@
+"""Stree env tests (stree.ml validity + stochastic batteries)."""
+
+import jax
+import numpy as np
+import pytest
+
+from cpr_tpu.envs.stree import BLOCK, VOTE, StreeSSZ
+from cpr_tpu.params import make_params
+
+
+@pytest.fixture(scope="module")
+def env():
+    return StreeSSZ(k=4, incentive_scheme="constant", max_steps_hint=192)
+
+
+def run_policy(env, name, alpha, n_envs=128, episode_steps=128, seed=0):
+    params = make_params(alpha=alpha, gamma=0.5, max_steps=episode_steps)
+    policy = env.policies[name]
+    keys = jax.random.split(jax.random.PRNGKey(seed), n_envs)
+    stats = jax.vmap(
+        lambda k: env.episode_stats(k, params, policy, episode_steps + 32)
+    )(keys)
+    atk = np.asarray(stats["episode_reward_attacker"]).mean()
+    dfn = np.asarray(stats["episode_reward_defender"]).mean()
+    return atk / (atk + dfn)
+
+
+def test_honest_policy_yields_alpha(env):
+    for alpha in [0.25, 0.4]:
+        rel = run_policy(env, "honest", alpha)
+        assert abs(rel - alpha) < 0.05, (alpha, rel)
+
+
+def test_dag_structure_invariants(env):
+    """stree.ml:128-152: votes have one parent, depth = parent + 1, same
+    block; blocks have a block parent plus leaves whose closure has
+    exactly k-1 unique votes, all confirming the parent block."""
+    params = make_params(alpha=0.35, gamma=0.5, max_steps=160)
+    state, obs = env.reset(jax.random.PRNGKey(3), params)
+    step = jax.jit(env.step)
+    policy = env.policies["release-block"]
+    for _ in range(160):
+        state, obs, r, done, info = step(state, policy(obs), params)
+    dag = state.dag
+    n = int(dag.n)
+    assert not bool(dag.overflow)
+    parents = np.asarray(dag.parents)[:n]
+    kind = np.asarray(dag.kind)[:n]
+    height = np.asarray(dag.height)[:n]
+    depth = np.asarray(dag.aux)[:n]
+    signer = np.asarray(dag.signer)[:n]
+    powh = np.asarray(dag.pow_hash)[:n]
+
+    def closure(leaf):
+        seen = set()
+        cur = leaf
+        while cur >= 0 and kind[cur] == VOTE:
+            seen.add(cur)
+            cur = parents[cur][0]
+        return seen
+
+    saw_block = False
+    for i in range(1, n):
+        ps = parents[i][parents[i] >= 0]
+        assert np.isfinite(powh[i])
+        if kind[i] == VOTE:
+            assert len(ps) == 1
+            p = ps[0]
+            assert depth[i] == depth[p] + 1
+            want = p if kind[p] == BLOCK else signer[p]
+            assert signer[i] == want
+            assert height[i] == height[want]
+        else:
+            saw_block = True
+            p0, leaves = ps[0], ps[1:]
+            assert kind[p0] == BLOCK
+            assert height[i] == height[p0] + 1
+            votes = set()
+            for leaf in leaves:
+                assert kind[leaf] == VOTE
+                votes |= closure(leaf)
+            assert len(votes) == env.k - 1, (i, leaves)
+            assert all(signer[v] == p0 for v in votes)
+    assert saw_block
+
+
+def test_progress_tracks_activations(env):
+    params = make_params(alpha=0.3, gamma=0.5, max_steps=160)
+    stats = env.episode_stats(
+        jax.random.PRNGKey(7), params, env.policies["honest"], 192)
+    prog = float(stats["episode_progress"])
+    acts = float(stats["episode_n_activations"])
+    assert prog > 0 and prog / acts > 0.7, (prog, acts)
+
+
+def test_policies_run_and_terminate(env):
+    params = make_params(alpha=0.4, gamma=0.5, max_steps=96)
+    for name, policy in env.policies.items():
+        traj = env.rollout(jax.random.PRNGKey(5), params, policy, 160)
+        done = np.asarray(traj[3])
+        assert done.sum() >= 1, name
+
+
+def test_discount_scheme_bounds_rewards():
+    env = StreeSSZ(k=4, incentive_scheme="discount", max_steps_hint=96)
+    params = make_params(alpha=0.3, gamma=0.5, max_steps=64)
+    stats = env.episode_stats(
+        jax.random.PRNGKey(11), params, env.policies["honest"], 96)
+    total = float(stats["episode_reward_attacker"]
+                  + stats["episode_reward_defender"])
+    prog = float(stats["episode_progress"])
+    assert 0 < total <= prog + env.k, (total, prog)
+
+
+def test_altruistic_selection_runs():
+    env = StreeSSZ(k=4, subblock_selection="altruistic", max_steps_hint=96)
+    params = make_params(alpha=0.3, gamma=0.5, max_steps=64)
+    stats = env.episode_stats(
+        jax.random.PRNGKey(13), params, env.policies["honest"], 96)
+    assert float(stats["episode_progress"]) > 0
