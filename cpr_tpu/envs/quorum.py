@@ -101,10 +101,14 @@ def quorum_altruistic(dag, cidx, cvalid, abits, own, seen, depth, q: int):
     decide Full (n == q) vs Partial."""
     C = cidx.shape[0]
     ci = jnp.maximum(cidx, 0)
-    d = jnp.minimum(depth[ci], (1 << 6) - 1)
+    # 12-bit depth field: composite key is 12+1+8+8 = 29 bits < int32.
+    # Depths reach D_MAX = 3k+8 in tailstorm; 4095 covers any k that fits
+    # a DAG window, unlike a 6-bit field which saturated at k >= 19.
+    d_max = (1 << 12) - 1
+    d = jnp.minimum(depth[ci], d_max)
     own_c = own[ci]
     seen_rank = jnp.argsort(jnp.argsort(seen[ci])).astype(jnp.int32)
-    comp = (((((1 << 6) - 1 - d) << 1 | (~own_c).astype(jnp.int32))
+    comp = ((((d_max - d) << 1 | (~own_c).astype(jnp.int32))
              << 8) + seen_rank) << 8
     comp = comp + jnp.arange(C, dtype=jnp.int32)  # stable: DAG order
     order = jnp.argsort(jnp.where(cvalid, comp, jnp.iinfo(jnp.int32).max))

@@ -59,34 +59,44 @@ def parse_key(key: str):
     if family in ("nakamoto",) and len(parts) == 1:
         return family, {}
     if family == "ethereum":
-        if len(parts) == 1:
-            return family, {}
+        # our grammar keys the reward preset; the reference keys the
+        # incentive scheme (`ethereum-discount`, cpr_protocols.ml:815-818)
         if len(parts) == 2 and parts[1] in ("whitepaper", "byzantium"):
             return family, {"preset": parts[1]}
-        raise KeyError(f"cannot parse protocol key '{key}'")
+        raise KeyError(f"cannot parse protocol key '{key}': expected "
+                       "ethereum-<whitepaper|byzantium>")
     grammars = {
-        # family: (schemes, selections or None)
-        "bk": (("constant", "block"), None),
-        "spar": (("constant", "block"), None),
+        # family: (schemes, selections or None, min k) — like the
+        # reference grammar, every option is mandatory
+        # (cpr_protocols.ml:800-811 fails on a missing option); sdag
+        # additionally requires k >= 2 (sdag.ml:24)
+        "bk": (("constant", "block"), None, 1),
+        "spar": (("constant", "block"), None, 1),
         "stree": (("constant", "discount", "punish", "hybrid"),
-                  ("altruistic", "heuristic", "optimal")),
-        "sdag": (("constant", "discount"), ("altruistic", "heuristic")),
+                  ("altruistic", "heuristic", "optimal"), 1),
+        "sdag": (("constant", "discount"), ("altruistic", "heuristic"), 2),
         "tailstorm": (("constant", "discount", "punish", "hybrid"),
-                      ("altruistic", "heuristic", "optimal")),
+                      ("altruistic", "heuristic", "optimal"), 1),
+        "tailstormjune": (("constant", "discount", "punish", "hybrid",
+                           "block"), None, 1),
     }
     if family in grammars:
-        schemes, selections = grammars[family]
-        max_parts = 3 if selections is None else 4
-        if (len(parts) < 2 or len(parts) > max_parts
-                or not parts[1].isdigit()):
-            raise KeyError(f"cannot parse protocol key '{key}'")
+        schemes, selections, min_k = grammars[family]
+        want_parts = 3 if selections is None else 4
+        if len(parts) != want_parts or not parts[1].isdigit():
+            raise KeyError(
+                f"cannot parse protocol key '{key}': expected "
+                f"{family}-<k>-<scheme>"
+                + ("-<selection>" if selections else ""))
         kw = {"k": int(parts[1])}
-        if len(parts) >= 3:
-            if parts[2] not in schemes:
-                raise KeyError(f"cannot parse protocol key '{key}': "
-                               f"scheme must be one of {schemes}")
-            kw["incentive_scheme"] = parts[2]
-        if len(parts) >= 4:
+        if kw["k"] < min_k:
+            raise KeyError(f"cannot parse protocol key '{key}': "
+                           f"{family} requires k >= {min_k}")
+        if parts[2] not in schemes:
+            raise KeyError(f"cannot parse protocol key '{key}': "
+                           f"scheme must be one of {schemes}")
+        kw["incentive_scheme"] = parts[2]
+        if selections is not None:
             if parts[3] not in selections:
                 raise KeyError(f"cannot parse protocol key '{key}': "
                                f"selection must be one of {selections}")

@@ -300,7 +300,11 @@ class SparSSZ(JaxEnv):
         votes = self.confirming(dag, rel_block)
         vidx, vvalid = D.top_k_by(dag.born_at, votes, self.k + 8)
         take = jnp.arange(self.k + 8) < rel_votes_n
-        not_enough = votes.sum() < rel_votes_n
+        # fall back to releasing every confirming vote when the selection
+        # window cannot hold the request (rel_votes_n > k+8) — otherwise
+        # the release would silently ship fewer votes than the reference's
+        # Compare.first nvotes selection and the override might not bite
+        not_enough = (votes.sum() < rel_votes_n) | (rel_votes_n > self.k + 8)
         vote_mask = jnp.zeros((self.capacity,), jnp.bool_)
         vote_mask = vote_mask.at[vidx].max(vvalid & take)
         vote_mask = jnp.where(not_enough, votes, vote_mask)

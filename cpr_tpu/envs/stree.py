@@ -55,16 +55,17 @@ SUBBLOCK_SELECTIONS = ("altruistic", "heuristic", "optimal")
 
 
 def obs_fields(k: int):
-    """stree_ssz.ml:22-49."""
+    """stree_ssz.ml:22-49: public_votes/public_depth scale with k
+    (stree_ssz.ml:43,46), the private_* fields with k-1."""
     q = max(k - 1, 1)
     return (
         obslib.Field("public_blocks", obslib.UINT, scale=1),
         obslib.Field("private_blocks", obslib.UINT, scale=1),
         obslib.Field("diff_blocks", obslib.INT, scale=1),
-        obslib.Field("public_votes", obslib.UINT, scale=q),
+        obslib.Field("public_votes", obslib.UINT, scale=k),
         obslib.Field("private_votes_inclusive", obslib.UINT, scale=q),
         obslib.Field("private_votes_exclusive", obslib.UINT, scale=q),
-        obslib.Field("public_depth", obslib.UINT, scale=q),
+        obslib.Field("public_depth", obslib.UINT, scale=k),
         obslib.Field("private_depth_inclusive", obslib.UINT, scale=q),
         obslib.Field("private_depth_exclusive", obslib.UINT, scale=q),
         obslib.Field("event", obslib.DISCRETE, n=2),

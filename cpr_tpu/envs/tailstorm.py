@@ -533,12 +533,15 @@ class TailstormSSZ(JaxEnv):
             self.last_summary(dag, jnp.maximum(rel_tip, 0)),
             jnp.where(is_adopt | is_override, D.NONE, state.match_tgt))
 
-        # append replacement/extension summary (tailstorm_ssz.ml:322-346)
+        # append replacement/extension summary (tailstorm_ssz.ml:322-346);
+        # extend derives from the PRE-action private tip (the reference's
+        # `state.private_` in apply), so on Adopt the replacement summary
+        # still targets the abandoned chain, not the freshly adopted one
         vote_filter = jnp.where(proceed, dag.exists(),
                                 dag.miner == D.ATTACKER)
-        has_conf = self.confirming(dag, private).any()
-        prev = self.prev_summary(dag, private)
-        extend = jnp.where(has_conf | (prev < 0), private, prev)
+        has_conf = self.confirming(dag, state.private).any()
+        prev = self.prev_summary(dag, state.private)
+        extend = jnp.where(has_conf | (prev < 0), state.private, prev)
         dag, pending, fresh = self.append_summary(
             dag, extend, jnp.int32(D.ATTACKER), vote_filter, dag.vis_a,
             state.time)

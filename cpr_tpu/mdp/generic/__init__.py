@@ -1,0 +1,39 @@
+"""Generic DAG-protocol attack models (single-agent Release/Consider/
+Continue MDPs over explicit block DAGs).
+
+Reference counterpart: mdp/lib/models/generic_v1/ — the generic
+single-agent attack model (model.py:339-530), state canonicalization
+(model.py:591-682), garbage collection and common-chain truncation
+(model.py:971-1117), and the protocol specs bitcoin/ethereum/byzantium/
+parallel/ghostdag (protocols/).
+
+TPU-first split: all of this is *compile-time* host work — BFS state
+enumeration with hashing and canonical labeling is inherently dynamic and
+does not belong under jit.  The output is a flat transition table (COO
+tensors) that the jitted segment-sum value iteration and the mesh-sharded
+solver (cpr_tpu/mdp/explicit.py, cpr_tpu/parallel) chew on.  Unlike the
+reference, states here are immutable hashable values (visibility sets as
+int bitmasks, parent lists as nested tuples) so fingerprinting is plain
+`hash`, and canonical labeling is a self-contained individualization-
+refinement search instead of a pynauty dependency.
+"""
+
+from cpr_tpu.mdp.generic.dag import GDag, View
+from cpr_tpu.mdp.generic.model import (
+    Continue,
+    Consider,
+    Release,
+    SingleAgent,
+)
+from cpr_tpu.mdp.generic.protocols import get_protocol, protocol_names
+
+__all__ = [
+    "GDag",
+    "View",
+    "SingleAgent",
+    "Release",
+    "Consider",
+    "Continue",
+    "get_protocol",
+    "protocol_names",
+]

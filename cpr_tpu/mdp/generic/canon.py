@@ -1,0 +1,101 @@
+"""Canonical labeling of colored DAGs, self-contained.
+
+Reference counterpart: generic_v1/model.py:591-682 delegates canonical
+labeling to pynauty (the nauty C library) and then repairs topological
+order.  This environment does not ship pynauty, and the DAGs here are
+tiny (garbage collection + common-chain truncation keep them to a
+handful of blocks), so a compact individualization-refinement search is
+both sufficient and dependency-free:
+
+1. refine: iterate colors to the coarsest stable partition where a
+   vertex's color determines the multiset of its parent and child colors
+   (directed 1-WL refinement);
+2. individualize: if the partition is not discrete, branch over every
+   vertex of the first non-singleton cell (an isomorphism-invariant
+   choice), giving it a fresh color, and recurse;
+3. certificate: each discrete partition yields an ordering; keep the
+   ordering whose relabeled (color, parent-set) rows are lexicographically
+   smallest.
+
+Isomorphic colored DAGs produce identical certificates, so relabeling by
+the canonical order merges isomorphic MDP states exactly like the
+reference's nauty path does.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+
+def _refine(n, parents, children, colors):
+    """Directed color refinement to a stable partition; colors are dense
+    ranks, refining the input coloring."""
+    while True:
+        sig = [
+            (
+                colors[v],
+                tuple(sorted(colors[p] for p in parents[v])),
+                tuple(sorted(colors[c] for c in children[v])),
+            )
+            for v in range(n)
+        ]
+        rank = {s: i for i, s in enumerate(sorted(set(sig)))}
+        new = [rank[s] for s in sig]
+        if new == colors:
+            return colors
+        colors = new
+
+
+def _certificate(order, parents, orig_colors):
+    new_id = {b: i for i, b in enumerate(order)}
+    return tuple(
+        (orig_colors[b], tuple(sorted(new_id[p] for p in parents[b])))
+        for b in order
+    )
+
+
+def _search(n, parents, children, colors, orig_colors):
+    colors = _refine(n, parents, children, colors)
+    cells: dict[int, list[int]] = {}
+    for v, c in enumerate(colors):
+        cells.setdefault(c, []).append(v)
+    target = None
+    for c in sorted(cells):
+        if len(cells[c]) > 1:
+            target = cells[c]
+            break
+    if target is None:
+        order = sorted(range(n), key=lambda v: colors[v])
+        return _certificate(order, parents, orig_colors), order
+    best = None
+    for v in target:
+        branched = list(colors)
+        branched[v] = n  # fresh color, larger than every rank
+        cand = _search(n, parents, children, branched, orig_colors)
+        if best is None or cand[0] < best[0]:
+            best = cand
+    return best
+
+
+@lru_cache(maxsize=1 << 16)
+def canonical_order(parents: tuple[tuple[int, ...], ...],
+                    colors: tuple[int, ...],
+                    heights: tuple[int, ...]) -> tuple[int, ...]:
+    """Canonical, topologically-sorted ordering of a colored DAG.
+
+    The raw canonical order ignores the model's invariant that block ids
+    are topologically sorted; sorting blocks by (height, canonical rank)
+    restores it while remaining a deterministic function of canonical
+    data — so the result is still canonical (generic_v1/model.py:627-645
+    repairs nauty's labels the same way, for the same reason).
+    """
+    n = len(parents)
+    children: list[list[int]] = [[] for _ in range(n)]
+    for b, ps in enumerate(parents):
+        for p in ps:
+            children[p].append(b)
+    rank = {c: i for i, c in enumerate(sorted(set(colors)))}
+    start = [rank[c] for c in colors]
+    _, order = _search(n, parents, children, start, colors)
+    pos = {b: i for i, b in enumerate(order)}
+    return tuple(sorted(range(n), key=lambda b: (heights[b], pos[b])))

@@ -1,0 +1,185 @@
+"""Immutable small-DAG value type + visibility-filtered views.
+
+Reference counterpart: the mutable `DAG` class and visibility-filtered
+`Miner` wrapper of mdp/lib/models/generic_v1/model.py:15-311.  The
+reference mutates shared adjacency lists and freezes objects before
+hashing them with xxhash; here a DAG is a frozen value — nested parent
+tuples plus a miner tuple — so states hash and compare structurally for
+free, and per-DAG derived data (children, heights) is memoized on the
+value itself via lru_cache.
+
+Block ids are dense ints, topologically ordered (id of a child is larger
+than the ids of all its parents); block 0 is the genesis.  Sets of blocks
+travel as int bitmasks (bit b = block b), which keeps the whole model
+state hashable and makes set algebra single integer ops.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import lru_cache
+
+
+def bits_of(mask: int):
+    """Iterate the set bits of a mask, ascending (= topological order)."""
+    while mask:
+        low = mask & -mask
+        yield low.bit_length() - 1
+        mask ^= low
+
+
+def mask_of(blocks) -> int:
+    m = 0
+    for b in blocks:
+        m |= 1 << b
+    return m
+
+
+@dataclass(frozen=True)
+class GDag:
+    """parents[b] is the sorted tuple of b's parents; miners[b] is the
+    miner id (genesis: -1)."""
+
+    parents: tuple[tuple[int, ...], ...]
+    miners: tuple[int, ...]
+
+    @staticmethod
+    def genesis_dag() -> "GDag":
+        return GDag(parents=((),), miners=(-1,))
+
+    @property
+    def genesis(self) -> int:
+        return 0
+
+    def size(self) -> int:
+        return len(self.parents)
+
+    def all_mask(self) -> int:
+        return (1 << self.size()) - 1
+
+    def append(self, parents, miner: int) -> tuple["GDag", int]:
+        """Value-append: returns (new dag, new block id)."""
+        ps = tuple(sorted(parents))
+        assert all(0 <= p < self.size() for p in ps), (ps, self.size())
+        return (
+            GDag(self.parents + (ps,), self.miners + (miner,)),
+            self.size(),
+        )
+
+    def children(self, block: int) -> int:
+        return _children(self)[block]
+
+    def height(self, block: int) -> int:
+        return _heights(self)[block]
+
+    def past(self, block: int) -> int:
+        """Bitmask of all ancestors of `block` (excluding it)."""
+        return _pasts(self)[block]
+
+    def future(self, block: int) -> int:
+        """Bitmask of all descendants of `block` (excluding it)."""
+        acc = 0
+        stack = self.children(block)
+        while stack:
+            b = stack & -stack
+            stack ^= b
+            if not acc & b:
+                acc |= b
+                stack |= self.children(b.bit_length() - 1) & ~acc
+        return acc
+
+    def topo_sorted(self, mask: int) -> list[int]:
+        """Blocks of `mask` in topological (= id) order; ids are kept
+        topologically sorted as a class invariant."""
+        return list(bits_of(mask))
+
+    def relabel(self, order: list[int]) -> tuple["GDag", dict[int, int]]:
+        """Rebuild the DAG keeping exactly the blocks in `order` (which
+        must be topologically sorted and closed under parents within
+        itself); returns (new dag, old id -> new id)."""
+        new_ids = {b: i for i, b in enumerate(order)}
+        parents = tuple(
+            tuple(sorted(new_ids[p] for p in self.parents[b] if p in new_ids))
+            for b in order
+        )
+        miners = tuple(
+            -1 if i == 0 else self.miners[b] for i, b in enumerate(order)
+        )
+        return GDag(parents=parents, miners=miners), new_ids
+
+
+@lru_cache(maxsize=1 << 16)
+def _children(dag: GDag) -> tuple[int, ...]:
+    ch = [0] * dag.size()
+    for b, ps in enumerate(dag.parents):
+        for p in ps:
+            ch[p] |= 1 << b
+    return tuple(ch)
+
+
+@lru_cache(maxsize=1 << 16)
+def _heights(dag: GDag) -> tuple[int, ...]:
+    h = [0] * dag.size()
+    for b, ps in enumerate(dag.parents):
+        for p in ps:
+            h[b] = max(h[b], h[p] + 1)
+    return tuple(h)
+
+
+@lru_cache(maxsize=1 << 16)
+def _pasts(dag: GDag) -> tuple[int, ...]:
+    pa = [0] * dag.size()
+    for b, ps in enumerate(dag.parents):
+        for p in ps:
+            pa[b] |= pa[p] | (1 << p)
+    return tuple(pa)
+
+
+@dataclass(frozen=True)
+class View:
+    """A miner's visibility-filtered window onto a DAG (the reference's
+    `Miner` children-filtering, generic_v1/model.py:261-265): parents are
+    always fully visible (delivery is topological), children are
+    restricted to the visible set."""
+
+    dag: GDag
+    visible: int  # bitmask
+    me: int  # miner id (judge views use -1)
+
+    @property
+    def genesis(self) -> int:
+        return 0
+
+    def parents(self, block: int) -> tuple[int, ...]:
+        return self.dag.parents[block]
+
+    def children(self, block: int) -> int:
+        return self.dag.children(block) & self.visible
+
+    def height(self, block: int) -> int:
+        return self.dag.height(block)
+
+    def miner_of(self, block: int) -> int:
+        return self.dag.miners[block]
+
+    def tips(self, subgraph: int) -> int:
+        """Blocks of `subgraph` without visible children in `subgraph`."""
+        acc = 0
+        for b in bits_of(subgraph):
+            if not (self.dag.children(b) & subgraph):
+                acc |= 1 << b
+        return acc
+
+    def past_in(self, subgraph: int, block: int) -> int:
+        return self.dag.past(block) & subgraph
+
+    def future_in(self, subgraph: int, block: int) -> int:
+        return self.dag.future(block) & subgraph
+
+    def anticone(self, subgraph: int, block: int) -> int:
+        return (
+            subgraph
+            & ~(1 << block)
+            & ~self.past_in(subgraph, block)
+            & ~self.future_in(subgraph, block)
+        )

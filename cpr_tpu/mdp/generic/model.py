@@ -1,0 +1,421 @@
+"""Generic single-agent DAG attack model (Release/Consider/Continue).
+
+Reference counterpart: generic_v1/model.py — SingleAgentImp's action
+machinery (:339-530), the SingleAgent implicit MDP with alpha/gamma
+randomness (:729-969), garbage collection (:971-1026), honest-loop and
+common-chain truncation (:1028-1118), and isomorphic-state merging via
+canonical relabeling (:591-682).
+
+Modeled after Sapirshtein et al. FC'16 and Bar-Zur et al. AFT'20: one
+attacker (miner 0) plays against one defender (miner 1) on an explicit
+block DAG.  The attacker *ignores* blocks until it Considers them (its
+protocol state advances lazily) and *withholds* its own blocks until it
+Releases them; Continue rolls the communication (gamma) and mining
+(alpha) randomness.
+
+Everything here is host-side compile-time work; the compiled transition
+table is what runs on TPU (jitted/sharded value iteration).  The state
+is one flat frozen dataclass — DAG value + four bitmask sets + two
+protocol states — so hashing, equality, and memoization need no manual
+fingerprinting, unlike the reference's freeze()/xxhash discipline.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from typing import Hashable, Optional
+
+from cpr_tpu.mdp.generic.canon import canonical_order
+from cpr_tpu.mdp.generic.dag import GDag, View, bits_of
+from cpr_tpu.mdp.generic.protocols.base import ProtocolSpec
+from cpr_tpu.mdp.implicit import Model, Transition
+
+ATTACKER, DEFENDER = 0, 1
+
+
+@dataclass(frozen=True)
+class Release:
+    block: int
+
+
+@dataclass(frozen=True)
+class Consider:
+    block: int
+
+
+@dataclass(frozen=True)
+class Continue:
+    pass
+
+
+@dataclass(frozen=True)
+class AgentState:
+    dag: GDag
+    avis: int  # attacker-visible bitmask
+    dvis: int  # defender-visible bitmask
+    withheld: int  # attacker blocks not yet released
+    ignored: int  # blocks the attacker has not Considered yet
+    astate: Hashable  # attacker protocol state
+    dstate: Hashable  # defender protocol state
+
+    def aview(self) -> View:
+        return View(self.dag, self.avis, ATTACKER)
+
+    def dview(self) -> View:
+        return View(self.dag, self.dvis, DEFENDER)
+
+
+def _initial_state(proto: ProtocolSpec) -> AgentState:
+    dag = GDag.genesis_dag()
+    av = View(dag, 1, ATTACKER)
+    dv = View(dag, 1, DEFENDER)
+    return AgentState(dag=dag, avis=1, dvis=1, withheld=0, ignored=0,
+                      astate=proto.init(av), dstate=proto.init(dv))
+
+
+class SingleAgent(Model):
+    """Implicit MDP over AgentState; plug into cpr_tpu.mdp.Compiler /
+    PTOWrapper and solve with the jitted (or mesh-sharded) VI."""
+
+    def __init__(
+        self,
+        proto: ProtocolSpec,
+        *,
+        alpha: float,
+        gamma: float,
+        collect_garbage: Optional[str] = "simple",  # None|"simple"|"judge"
+        dag_size_cutoff: Optional[int] = None,
+        traditional_height_cutoff: Optional[int] = None,
+        loop_honest: bool = False,
+        merge_isomorphic: bool = True,
+        truncate_common_chain: bool = True,
+        reward_common_chain: bool = False,
+        force_consider_own: bool = False,
+    ):
+        assert 0.0 <= alpha <= 1.0 and 0.0 <= gamma <= 1.0
+        assert collect_garbage in (None, "simple", "judge")
+        if truncate_common_chain and loop_honest:
+            raise ValueError(
+                "choose either truncate_common_chain or loop_honest")
+        if reward_common_chain and not truncate_common_chain:
+            raise ValueError(
+                "reward_common_chain requires truncate_common_chain")
+        self.proto = proto
+        self.alpha = alpha
+        self.gamma = gamma
+        self.collect_garbage = collect_garbage
+        self.dag_size_cutoff = dag_size_cutoff
+        self.traditional_height_cutoff = traditional_height_cutoff
+        self.loop_honest = loop_honest
+        self.merge_isomorphic = merge_isomorphic
+        self.truncate_common_chain = truncate_common_chain
+        self.reward_common_chain = reward_common_chain
+        self.force_consider_own = force_consider_own
+
+        if loop_honest:
+            self.reset_attacker = self._normalize_opt(
+                self._mine(_initial_state(proto), ATTACKER))
+            self.reset_defender = self._normalize_opt(
+                self._mine(_initial_state(proto), DEFENDER))
+        else:
+            self.start_state = self._normalize_opt(_initial_state(proto))
+
+    # -- elementary moves ------------------------------------------------
+
+    def _deliver_defender(self, s: AgentState, block: int) -> AgentState:
+        assert not s.dvis & (1 << block), "deliver once"
+        assert all(s.dvis & (1 << p) for p in s.dag.parents[block])
+        dvis = s.dvis | (1 << block)
+        dstate = self.proto.update(View(s.dag, dvis, DEFENDER),
+                                   s.dstate, block)
+        return replace(s, dvis=dvis, dstate=dstate)
+
+    def _do_consider(self, s: AgentState, block: int) -> AgentState:
+        assert s.ignored & (1 << block)
+        avis = s.avis | (1 << block)
+        astate = self.proto.update(View(s.dag, avis, ATTACKER),
+                                   s.astate, block)
+        return replace(s, ignored=s.ignored & ~(1 << block),
+                       avis=avis, astate=astate)
+
+    def _do_release(self, s: AgentState, block: int) -> AgentState:
+        assert s.withheld & (1 << block)
+        return replace(s, withheld=s.withheld & ~(1 << block))
+
+    def _just_released(self, s: AgentState) -> int:
+        """Released attacker blocks the defender has not seen."""
+        mined_by_atk = 0
+        for b in range(1, s.dag.size()):
+            if s.dag.miners[b] == ATTACKER:
+                mined_by_atk |= 1 << b
+        return mined_by_atk & ~s.withheld & ~s.dvis
+
+    def _defender_fresh(self, s: AgentState) -> int:
+        """Defender blocks the defender has not seen yet (its own mining
+        reaches it with the next communication round)."""
+        mined_by_def = 0
+        for b in range(1, s.dag.size()):
+            if s.dag.miners[b] == DEFENDER:
+                mined_by_def |= 1 << b
+        return mined_by_def & ~s.dvis
+
+    def _do_communication(self, s: AgentState, atk_fast: bool) -> AgentState:
+        released = s.dag.topo_sorted(self._just_released(s))
+        fresh = s.dag.topo_sorted(self._defender_fresh(s))
+        order = released + fresh if atk_fast else fresh + released
+        for b in order:
+            s = self._deliver_defender(s, b)
+        return s
+
+    def _mine(self, s: AgentState, miner: int) -> AgentState:
+        if miner == ATTACKER:
+            parents = self.proto.mining(s.aview(), s.astate)
+            dag, b = s.dag.append(parents, ATTACKER)
+            s = replace(s, dag=dag, ignored=s.ignored | (1 << b),
+                        withheld=s.withheld | (1 << b))
+            if self.force_consider_own:
+                s = self._do_consider(s, b)
+            return s
+        parents = self.proto.mining(s.dview(), s.dstate)
+        dag, b = s.dag.append(parents, DEFENDER)
+        return replace(s, dag=dag, ignored=s.ignored | (1 << b))
+
+    # -- action surface --------------------------------------------------
+
+    def _to_release(self, s: AgentState) -> list[int]:
+        return [b for b in bits_of(s.withheld)
+                if not any(s.withheld & (1 << p) for p in s.dag.parents[b])]
+
+    def _to_consider(self, s: AgentState) -> list[int]:
+        return [b for b in bits_of(s.ignored)
+                if not any(s.ignored & (1 << p) for p in s.dag.parents[b])]
+
+    def actions(self, s: AgentState):
+        if self.traditional_height_cutoff is not None:
+            if max(s.dag.height(b)
+                   for b in range(s.dag.size())) >= self.traditional_height_cutoff:
+                return [self.honest(s)]
+        if self.dag_size_cutoff is not None:
+            if s.dag.size() >= self.dag_size_cutoff:
+                return [self.honest(s)]
+        acts: list = [Consider(b) for b in self._to_consider(s)]
+        acts += [Release(b) for b in self._to_release(s)]
+        acts.append(Continue())
+        return acts
+
+    def honest(self, s: AgentState):
+        """Consider first (lowest id), then release, then continue —
+        honest nodes neither ignore nor withhold."""
+        tc = self._to_consider(s)
+        if tc:
+            return Consider(tc[0])
+        tr = self._to_release(s)
+        if tr:
+            return Release(tr[0])
+        return Continue()
+
+    def start(self):
+        if self.loop_honest:
+            return [(self.reset_attacker, self.alpha),
+                    (self.reset_defender, 1.0 - self.alpha)]
+        return [(self.start_state, 1.0)]
+
+    # -- transitions -----------------------------------------------------
+
+    def apply(self, action, s: AgentState):
+        if isinstance(action, Release):
+            return self._finalize(s, [
+                (1.0, self._do_release(s, action.block))])
+        if isinstance(action, Consider):
+            return self._finalize(s, [
+                (1.0, self._do_consider(s, action.block))])
+        assert isinstance(action, Continue)
+        a, g = self.alpha, self.gamma
+        cases = []
+        for p_comm, fast in ((g, True), (1.0 - g, False)):
+            for p_mine, miner in ((a, ATTACKER), (1.0 - a, DEFENDER)):
+                if p_comm * p_mine == 0.0:
+                    continue
+                nxt = self._mine(self._do_communication(s, fast), miner)
+                cases.append((p_comm * p_mine, nxt))
+        return self._finalize(s, cases)
+
+    def shutdown(self, s: AgentState):
+        cases = []
+        for p, fast in ((self.gamma, True), (1.0 - self.gamma, False)):
+            if p == 0.0:
+                continue
+            nxt = self._do_communication(replace(s, withheld=0), fast)
+            cases.append((p, nxt))
+        return self._finalize(s, cases)
+
+    # -- reward + state-space reduction ----------------------------------
+
+    def _measure(self, s: AgentState, hist: list[int]):
+        """(attacker reward, progress) summed over non-genesis history
+        blocks, judged by the defender's view."""
+        view = s.dview()
+        rew = prg = 0.0
+        for b in hist:
+            prg += self.proto.progress(view, b)
+            for miner, amount in self.proto.coinbase(view, b):
+                if miner == ATTACKER:
+                    rew += amount
+        return rew, prg
+
+    def _finalize(self, old: AgentState, cases):
+        if not self.reward_common_chain:
+            old_hist = self.proto.history(old.dview(), old.dstate)
+            assert old_hist[0] == 0
+            old_rew, old_prg = self._measure(old, old_hist[1:])
+
+        out = []
+        for prob, new in cases:
+            rew = prg = 0.0
+            if not self.reward_common_chain:
+                new_hist = self.proto.history(new.dview(), new.dstate)
+                assert new_hist[0] == 0
+                new_rew, new_prg = self._measure(new, new_hist[1:])
+                rew, prg = new_rew - old_rew, new_prg - old_prg
+
+            if self.collect_garbage:
+                new = self._gc(new)
+            if self.loop_honest:
+                new = self._loop_honest(new)
+            if self.truncate_common_chain:
+                pre = new
+                new, cut_hist = self._truncate(new)
+                if self.reward_common_chain:
+                    rew, prg = self._measure(pre, cut_hist)
+            new = self._normalize_opt(new)
+            out.append(Transition(probability=prob, state=new,
+                                  reward=rew, progress=prg))
+        return out
+
+    def _relabel(self, s: AgentState, order: list[int]) -> AgentState:
+        dag, new_ids = s.dag.relabel(order)
+
+        def remap(mask: int) -> int:
+            out = 0
+            for b in bits_of(mask):
+                if b in new_ids:
+                    out |= 1 << new_ids[b]
+            return out
+
+        return AgentState(
+            dag=dag,
+            avis=remap(s.avis), dvis=remap(s.dvis),
+            withheld=remap(s.withheld), ignored=remap(s.ignored),
+            astate=self.proto.relabel(s.astate, new_ids),
+            dstate=self.proto.relabel(s.dstate, new_ids),
+        )
+
+    def _gc(self, s: AgentState) -> AgentState:
+        """Drop stale blocks: keep anything still undelivered to one of
+        the parties, anything a protocol view marks relevant (plus, in
+        "judge" mode, what an omniscient defender would keep), closed
+        over ancestry (generic_v1/model.py:971-1026)."""
+        every = s.dag.all_mask()
+        keep = (every & ~s.avis) | (every & ~s.dvis)
+        keep |= self.proto.keep(s.aview(), s.astate)
+        keep |= self.proto.keep(s.dview(), s.dstate)
+        if self.collect_garbage == "judge":
+            dstate, dvis = s.dstate, s.dvis
+            for b in s.dag.topo_sorted(every & ~dvis):
+                dvis |= 1 << b
+                dstate = self.proto.update(
+                    View(s.dag, dvis, DEFENDER), dstate, b)
+            keep |= self.proto.keep(View(s.dag, dvis, DEFENDER), dstate)
+        keep |= 1  # genesis
+        closed = keep
+        for b in bits_of(keep):
+            closed |= s.dag.past(b)
+        if closed == every:
+            return s
+        return self._relabel(s, s.dag.topo_sorted(closed))
+
+    def _truncate(self, s: AgentState):
+        """Chop the common history prefix, making its last viable block
+        the new genesis (generic_v1/model.py:1073-1118).  Returns
+        (state, old-history-prefix-that-was-cut) — the prefix feeds
+        reward_common_chain accounting."""
+        atk = self.proto.history(s.aview(), s.astate)
+        dfn = self.proto.history(s.dview(), s.dstate)
+        assert atk[0] == 0 and dfn[0] == 0
+        next_genesis = 0
+        for i in range(1, min(len(atk), len(dfn))):
+            b = atk[i]
+            if b != dfn[i]:
+                break
+            past = s.dag.past(b)
+            past_and_b = past | (1 << b)
+            viable = all(
+                (s.dag.children(p) & ~past_and_b) == 0
+                for p in bits_of(past))
+            if viable:
+                next_genesis = b
+        if next_genesis == 0:
+            return s, []
+        cut = []
+        for b in dfn[1:]:
+            cut.append(b)
+            if b == next_genesis:
+                break
+        keep_mask = (1 << next_genesis) | s.dag.future(next_genesis)
+        truncated = self._relabel(s, s.dag.topo_sorted(keep_mask))
+        return truncated, cut
+
+    def _loop_honest(self, s: AgentState) -> AgentState:
+        """Snap honest-looking states back to the start states so the
+        honest policy loops on a closed set (generic_v1/model.py:1028-71)."""
+        last = s.dag.size() - 1
+        if last == 0:
+            return s
+        every = s.dag.all_mask()
+        last_bit = 1 << last
+
+        def common(loop_state):
+            assert s.avis == every & ~last_bit or s.avis == every
+            if s.dvis != every & ~last_bit:
+                return s
+            atk = self.proto.history(s.aview(), s.astate)
+            dfn = self.proto.history(s.dview(), s.dstate)
+            if atk != dfn:
+                return s
+            hist_mask = 0
+            for b in dfn[:-1]:
+                hist_mask |= 1 << b
+            if hist_mask != s.dag.past(dfn[-1]):
+                return s
+            return loop_state
+
+        if (s.dag.miners[last] == ATTACKER and s.withheld == last_bit
+                and s.ignored == last_bit and s.avis == every & ~last_bit):
+            return common(self.reset_attacker)
+        if (s.dag.miners[last] == DEFENDER and s.withheld == 0
+                and s.ignored == last_bit and s.avis == every & ~last_bit):
+            return common(self.reset_defender)
+        return s
+
+    def _normalize_opt(self, s: AgentState) -> AgentState:
+        if not self.merge_isomorphic:
+            return s
+        colors = []
+        av, dv = s.aview(), s.dview()
+        for b in range(s.dag.size()):
+            c = 0 if b == 0 else (1 + s.dag.miners[b])
+            c |= ((s.dvis >> b) & 1) << 2
+            c |= ((s.avis >> b) & 1) << 3
+            c |= ((s.withheld >> b) & 1) << 4
+            c |= ((s.ignored >> b) & 1) << 5
+            if s.dvis & (1 << b):
+                c |= self.proto.color(dv, s.dstate, b) << 6
+            if s.avis & (1 << b):
+                c |= self.proto.color(av, s.astate, b) << 7
+            colors.append(c)
+        order = canonical_order(s.dag.parents, tuple(colors),
+                                tuple(s.dag.height(b)
+                                      for b in range(s.dag.size())))
+        if list(order) == list(range(s.dag.size())):
+            return s
+        return self._relabel(s, list(order))

@@ -1,0 +1,46 @@
+"""Protocol specifications for the generic DAG attack model.
+
+Reference counterpart: mdp/lib/models/generic_v1/protocols/ — bitcoin
+(bitcoin.py:6-44), ethereum whitepaper uncles (ethereum.py:6-73),
+byzantium (byzantium.py:6-31), parallel voting (parallel.py:6-76), and
+GhostDAG's k-cluster blue-set rule (ghostdag.py:6-101).
+"""
+
+from cpr_tpu.mdp.generic.protocols.base import ProtocolSpec
+from cpr_tpu.mdp.generic.protocols.bitcoin import Bitcoin
+from cpr_tpu.mdp.generic.protocols.ethereum import Byzantium, Ethereum
+from cpr_tpu.mdp.generic.protocols.ghostdag import GhostDag
+from cpr_tpu.mdp.generic.protocols.parallel import Parallel
+
+_FACTORIES = {
+    "bitcoin": Bitcoin,
+    "ethereum": Ethereum,
+    "byzantium": Byzantium,
+    "parallel": Parallel,
+    "ghostdag": GhostDag,
+}
+
+
+def protocol_names():
+    return sorted(_FACTORIES)
+
+
+def get_protocol(name: str, **kwargs) -> ProtocolSpec:
+    try:
+        factory = _FACTORIES[name]
+    except KeyError:
+        raise KeyError(
+            f"unknown protocol '{name}'; choose from {protocol_names()}")
+    return factory(**kwargs)
+
+
+__all__ = [
+    "ProtocolSpec",
+    "Bitcoin",
+    "Ethereum",
+    "Byzantium",
+    "Parallel",
+    "GhostDag",
+    "get_protocol",
+    "protocol_names",
+]

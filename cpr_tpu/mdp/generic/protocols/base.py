@@ -1,0 +1,58 @@
+"""Functional protocol-spec interface.
+
+Reference counterpart: generic_v1/protocols/interface.py:1-117.  The
+reference injects DAG accessors into a mutable spec object whose
+`self.state` is a free-form DynObj; here a spec is a stateless strategy
+object of pure functions over an immutable `View`, and the miner state
+`pstate` is an explicit hashable value passed in and returned — which is
+what lets the whole MDP state be a flat frozen dataclass.
+"""
+
+from __future__ import annotations
+
+from typing import Hashable
+
+
+class ProtocolSpec:
+    """All methods are pure; `view` is a cpr_tpu.mdp.generic.dag.View
+    restricted to the miner's visible blocks, `pstate` is the miner's
+    protocol state (hashable)."""
+
+    name: str = "?"
+
+    def init(self, view) -> Hashable:
+        """Initial miner state at genesis."""
+        raise NotImplementedError
+
+    def mining(self, view, pstate) -> tuple[int, ...]:
+        """Parents of the block this miner would mine now."""
+        raise NotImplementedError
+
+    def update(self, view, pstate, block: int) -> Hashable:
+        """New miner state after learning `block` (already in view)."""
+        raise NotImplementedError
+
+    def history(self, view, pstate) -> list[int]:
+        """The miner's linear block history, genesis first."""
+        raise NotImplementedError
+
+    def progress(self, view, block: int) -> float:
+        """Difficulty-adjustment progress contributed by a history block."""
+        raise NotImplementedError
+
+    def coinbase(self, view, block: int) -> list[tuple[int, float]]:
+        """(miner, amount) rewards associated with a history block."""
+        raise NotImplementedError
+
+    def relabel(self, pstate, new_ids: dict[int, int]) -> Hashable:
+        """Rewrite block ids inside the miner state."""
+        raise NotImplementedError
+
+    def color(self, view, pstate, block: int) -> int:
+        """0/1 color capturing miner-state info for canonicalization."""
+        raise NotImplementedError
+
+    def keep(self, view, pstate) -> int:
+        """Bitmask of relevant tips for garbage collection (the kept set
+        is closed over parents by the model)."""
+        raise NotImplementedError

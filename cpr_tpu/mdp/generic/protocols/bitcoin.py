@@ -1,0 +1,47 @@
+"""Bitcoin (longest chain) spec.
+
+Reference counterpart: generic_v1/protocols/bitcoin.py:6-44.
+"""
+
+from __future__ import annotations
+
+from cpr_tpu.mdp.generic.protocols.base import ProtocolSpec
+
+
+class Bitcoin(ProtocolSpec):
+    name = "bitcoin"
+
+    def init(self, view):
+        return view.genesis  # pstate = preferred head
+
+    def mining(self, view, head):
+        return (head,)
+
+    def update(self, view, head, block):
+        return block if view.height(block) > view.height(head) else head
+
+    def history(self, view, head):
+        hist = []
+        b = head
+        while True:
+            hist.append(b)
+            if b == view.genesis:
+                break
+            b = view.parents(b)[0]
+        hist.reverse()
+        return hist
+
+    def progress(self, view, block):
+        return 1.0
+
+    def coinbase(self, view, block):
+        return [(view.miner_of(block), 1.0)]
+
+    def relabel(self, head, new_ids):
+        return new_ids[head]
+
+    def color(self, view, head, block):
+        return 1 if block == head else 0
+
+    def keep(self, view, head):
+        return 1 << head

@@ -1,0 +1,110 @@
+"""Ethereum uncle specs: whitepaper and Byzantium variants.
+
+Reference counterpart: generic_v1/protocols/ethereum.py:6-73 (whitepaper:
+every leaf whose parent sits within the last `h` history blocks is an
+includable uncle, all uncles pay 1) and byzantium.py:6-31 (at most two
+uncles, own first; heaviest progress preference; discounted uncle
+rewards, nephew bonus 1/32).
+"""
+
+from __future__ import annotations
+
+from cpr_tpu.mdp.generic.dag import bits_of
+from cpr_tpu.mdp.generic.protocols.base import ProtocolSpec
+
+
+class Ethereum(ProtocolSpec):
+    name = "ethereum"
+
+    def __init__(self, h: int = 7):
+        # uncles need room between head and the uncle window: h >= 2
+        self.h = h
+
+    # the highest parent is the chain parent, the rest are uncles
+    def parent_and_uncles(self, view, block):
+        ps = sorted(view.parents(block), key=lambda p: -view.height(p))
+        if not ps:
+            return None, []
+        return ps[0], ps[1:]
+
+    def init(self, view):
+        return view.genesis
+
+    def available_uncles(self, view, head):
+        hist = self.history(view, head)
+        window = set(hist[-self.h - 1:-2])
+        uncles = []
+        for b in bits_of(view.visible):
+            if view.children(b):
+                continue  # not a leaf
+            p, _ = self.parent_and_uncles(view, b)
+            if p is not None and p in window:
+                uncles.append(b)
+        return uncles
+
+    def mining(self, view, head):
+        return tuple([head] + self.available_uncles(view, head))
+
+    def update(self, view, head, block):
+        return block if view.height(block) > view.height(head) else head
+
+    def history(self, view, head):
+        hist = []
+        b = head
+        while b is not None:
+            hist.append(b)
+            if b == view.genesis:
+                break
+            b, _ = self.parent_and_uncles(view, b)
+        hist.reverse()
+        return hist
+
+    def progress(self, view, block):
+        return 1.0
+
+    def coinbase(self, view, block):
+        _, uncles = self.parent_and_uncles(view, block)
+        return [(view.miner_of(b), 1.0) for b in [block] + uncles]
+
+    def relabel(self, head, new_ids):
+        return new_ids[head]
+
+    def color(self, view, head, block):
+        return 1 if block == head else 0
+
+    def keep(self, view, head):
+        m = 1 << head
+        for u in self.available_uncles(view, head):
+            m |= 1 << u
+        return m
+
+
+class Byzantium(Ethereum):
+    name = "byzantium"
+
+    def mining(self, view, head):
+        uncles = sorted(self.available_uncles(view, head),
+                        key=lambda u: (view.miner_of(u) != view.me, u))
+        return tuple([head] + uncles[:2])
+
+    def _weight(self, view, block):
+        return sum(self.progress(view, b)
+                   for b in self.history(view, block)[1:])
+
+    def update(self, view, head, block):
+        if self._weight(view, block) > self._weight(view, head):
+            return block
+        return head
+
+    def progress(self, view, block):
+        _, uncles = self.parent_and_uncles(view, block)
+        return 1.0 + len(uncles)
+
+    def coinbase(self, view, block):
+        _, uncles = self.parent_and_uncles(view, block)
+        out = [(view.miner_of(block), 1.0 + 0.03125 * len(uncles))]
+        h = view.height(block)
+        max_d = self.h + 1
+        for u in uncles:
+            out.append((view.miner_of(u), (max_d - (h - view.height(u))) / max_d))
+        return out

@@ -1,0 +1,88 @@
+"""GhostDAG spec (k-cluster blue-set chain selection).
+
+Reference counterpart: generic_v1/protocols/ghostdag.py:6-101, itself
+after eprint.iacr.org/2018/104.pdf Algorithm 1: recursively pick the tip
+with the largest blue past, then greedily admit anticone blocks whose
+addition keeps every blue block's blue anticone within k.
+
+The recursion is memoized on (dag, visible-subgraph mask) — subgraph
+masks are ints, the DAG is a hashable value, so the cache key is free.
+Miners are stateless: the visible set IS the state.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+from cpr_tpu.mdp.generic.dag import bits_of
+from cpr_tpu.mdp.generic.protocols.base import ProtocolSpec
+
+
+@lru_cache(maxsize=1 << 18)
+def _blue_and_history(dag, subgraph: int, k: int):
+    """(blue mask, history tuple) of the visible subgraph."""
+    if subgraph == 1:  # genesis only
+        return 1, (0,)
+
+    def tips(sub):
+        return [b for b in bits_of(sub) if not (dag.children(b) & sub)]
+
+    blue, hist = {}, {}
+    for t in tips(subgraph):
+        past = dag.past(t) & subgraph
+        blue[t], hist[t] = _blue_and_history(dag, past, k)
+    b_max = min(tips(subgraph), key=lambda t: (-bin(blue[t]).count("1"), t))
+    blue_set = blue[b_max] | (1 << b_max)
+    history = hist[b_max] + (b_max,)
+
+    def anticone(sub, b):
+        return (sub & ~(1 << b) & ~(dag.past(b) & sub)
+                & ~(dag.future(b) & sub))
+
+    def is_k_cluster(sub, s_mask):
+        for b in bits_of(s_mask):
+            if bin(anticone(sub, b) & s_mask).count("1") > k:
+                return False
+        return True
+
+    ac = anticone(subgraph, b_max)
+    for b in sorted(bits_of(ac), key=lambda b: (dag.height(b), b)):
+        if is_k_cluster(subgraph, blue_set | (1 << b)):
+            blue_set |= 1 << b
+            history = history + (b,)
+    return blue_set, history
+
+
+class GhostDag(ProtocolSpec):
+    name = "ghostdag"
+
+    def __init__(self, k: int = 3):
+        self.k = k
+
+    def init(self, view):
+        return None  # stateless: the visible set is the state
+
+    def mining(self, view, pstate):
+        return tuple(bits_of(view.tips(view.visible)))
+
+    def update(self, view, pstate, block):
+        return None
+
+    def history(self, view, pstate):
+        _, hist = _blue_and_history(view.dag, view.visible, self.k)
+        return list(hist)
+
+    def progress(self, view, block):
+        return 1.0
+
+    def coinbase(self, view, block):
+        return [(view.miner_of(block), 1.0)]
+
+    def relabel(self, pstate, new_ids):
+        return None
+
+    def color(self, view, pstate, block):
+        return 0
+
+    def keep(self, view, pstate):
+        return view.tips(view.visible)

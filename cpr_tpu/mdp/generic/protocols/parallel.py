@@ -1,0 +1,76 @@
+"""Parallel-voting spec (k votes confirm each block).
+
+Reference counterpart: generic_v1/protocols/parallel.py:6-76.  A "vote"
+is a block with exactly one parent; a "block" references k votes (its
+parents) once enough votes confirm the head.  k >= 2 is required so the
+parent count distinguishes votes from blocks.
+"""
+
+from __future__ import annotations
+
+from cpr_tpu.mdp.generic.dag import bits_of
+from cpr_tpu.mdp.generic.protocols.base import ProtocolSpec
+
+
+class Parallel(ProtocolSpec):
+    name = "parallel"
+
+    def __init__(self, k: int = 3):
+        assert k >= 2, "parallel: need k >= 2 to tell votes from blocks"
+        self.k = k
+
+    def is_vote(self, view, block):
+        return len(view.parents(block)) == 1
+
+    def init(self, view):
+        return view.genesis
+
+    def mining(self, view, head):
+        votes = [b for b in bits_of(view.children(head))]
+        if len(votes) >= self.k:
+            votes.sort(key=lambda v: (view.miner_of(v) != view.me, v))
+            return tuple(votes[: self.k])
+        return (head,)
+
+    def update(self, view, head, block):
+        if self.is_vote(view, block):
+            block = view.parents(block)[0]
+        bh, hh = view.height(block), view.height(head)
+        if bh > hh:
+            return block
+        if bh == hh and block != head:
+            nb = bin(view.children(block)).count("1")
+            nh = bin(view.children(head)).count("1")
+            if nb > nh:
+                return block
+        return head
+
+    def history(self, view, head):
+        hist = []
+        b = head
+        while True:
+            if not self.is_vote(view, b) or b == view.genesis:
+                hist.append(b)
+            if b == view.genesis:
+                break
+            b = view.parents(b)[0]
+        hist.reverse()
+        return hist
+
+    def progress(self, view, block):
+        return float(self.k + 1)
+
+    def coinbase(self, view, block):
+        out = [(view.miner_of(block), 1.0)]
+        for p in view.parents(block):
+            out.append((view.miner_of(p), 1.0))
+        return out
+
+    def relabel(self, head, new_ids):
+        return new_ids[head]
+
+    def color(self, view, head, block):
+        return 1 if block == head else 0
+
+    def keep(self, view, head):
+        return (1 << head) | view.children(head)

@@ -1,0 +1,169 @@
+"""Generic DAG-protocol MDP family tests.
+
+Mirrors the reference's validation strategy
+(mdp/lib/models/generic_v1/test/test_single_agent_model.py): random walks
+around the honest policy must earn ~alpha per progress, exploration must
+not violate invariants, and canonicalization must merge isomorphic states
+without changing values.  Adds the capstone: GhostDAG compiles to an
+explicit table and the mesh-sharded VI reproduces the single-device
+solve.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from cpr_tpu.mdp import Compiler, ptmdp
+from cpr_tpu.mdp.generic import SingleAgent, get_protocol
+from cpr_tpu.mdp.generic.canon import canonical_order
+
+
+def walk(m, n, exp=0.0, seed=42):
+    rng = random.Random(seed)
+    s = m.start()[0][0]
+    prg = rew = 0.0
+    for _ in range(n):
+        if rng.random() < exp:
+            opts = m.actions(s)
+            a = opts[rng.randrange(len(opts))]
+        else:
+            a = m.honest(s)
+        ts = m.apply(a, s)
+        assert abs(sum(t.probability for t in ts) - 1.0) < 1e-9
+        t = rng.choices(ts, weights=[t.probability for t in ts])[0]
+        s, prg, rew = t.state, prg + t.progress, rew + t.reward
+    return rew, prg, s
+
+
+PROTOS = [
+    ("bitcoin", {}),
+    ("ethereum", {}),
+    ("byzantium", {}),
+    ("parallel", {"k": 3}),
+    ("ghostdag", {"k": 3}),
+]
+
+
+@pytest.mark.parametrize("name,kw", PROTOS)
+def test_honest_walk_earns_alpha(name, kw):
+    m = SingleAgent(get_protocol(name, **kw), alpha=0.33, gamma=0.5,
+                    collect_garbage="simple", merge_isomorphic=False,
+                    truncate_common_chain=True)
+    rew, prg, s = walk(m, 400)
+    assert 0.27 <= rew / prg <= 0.40, rew / prg
+    # truncation keeps the DAG bounded along honest play
+    assert s.dag.size() <= 8
+
+
+@pytest.mark.parametrize("name,kw", PROTOS)
+def test_exploring_walk_keeps_invariants(name, kw):
+    m = SingleAgent(get_protocol(name, **kw), alpha=0.33, gamma=0.5,
+                    collect_garbage="simple", merge_isomorphic=True,
+                    truncate_common_chain=True)
+    rew, prg, s = walk(m, 60, exp=0.4)
+    assert prg >= 0.0 and s.dag.size() >= 1
+
+
+def test_honest_policy_evaluation_yields_alpha():
+    alpha = 0.3
+    m = SingleAgent(get_protocol("bitcoin"), alpha=alpha, gamma=0.5,
+                    collect_garbage="simple", merge_isomorphic=True,
+                    truncate_common_chain=True, dag_size_cutoff=6)
+    c = Compiler(m)
+    mdp = ptmdp(c.mdp(), horizon=30)
+    tm = mdp.tensor()
+    policy = np.full(mdp.n_states, -1, np.int32)
+    for sid, st in enumerate(c.states):
+        policy[sid] = c.action_map[sid].index(c.model.honest(st))
+    pe = tm.policy_evaluation(policy, theta=1e-7)
+    rev = tm.start_value(pe["pe_reward"]) / tm.start_value(pe["pe_progress"])
+    assert abs(rev - alpha) < 0.005, rev
+
+
+def test_optimal_between_honest_and_upper_bound():
+    alpha, gamma = 0.35, 0.5
+    m = SingleAgent(get_protocol("bitcoin"), alpha=alpha, gamma=gamma,
+                    collect_garbage="simple", merge_isomorphic=True,
+                    truncate_common_chain=True, dag_size_cutoff=6)
+    tm = ptmdp(Compiler(m).mdp(), horizon=30).tensor()
+    vi = tm.value_iteration(stop_delta=1e-6)
+    rev = tm.start_value(vi["vi_value"]) / tm.start_value(vi["vi_progress"])
+    assert alpha - 0.005 <= rev <= alpha / (1 - alpha) + 1e-6, rev
+
+
+def test_merge_isomorphic_preserves_value_and_shrinks():
+    kw = dict(alpha=0.32, gamma=0.6, collect_garbage="simple",
+              truncate_common_chain=True, dag_size_cutoff=6)
+    merged = Compiler(SingleAgent(get_protocol("bitcoin"),
+                                  merge_isomorphic=True, **kw)).mdp()
+    plain = Compiler(SingleAgent(get_protocol("bitcoin"),
+                                 merge_isomorphic=False, **kw)).mdp()
+    assert merged.n_states < plain.n_states
+    vi_m = ptmdp(merged, horizon=20).tensor()
+    vi_p = ptmdp(plain, horizon=20).tensor()
+    r_m = vi_m.value_iteration(stop_delta=1e-7)
+    r_p = vi_p.value_iteration(stop_delta=1e-7)
+    assert abs(vi_m.start_value(r_m["vi_value"])
+               - vi_p.start_value(r_p["vi_value"])) < 1e-4
+
+
+def test_canonical_order_invariant_under_relabeling():
+    """Permuting a colored DAG (topologically) must not change its
+    canonical form."""
+    rng = random.Random(0)
+    parents = ((), (0,), (0,), (1, 2), (1, 2), (3,))
+    colors = (0, 1, 1, 2, 2, 1)
+    heights = (0, 1, 1, 2, 2, 3)
+
+    def canon_form(parents, colors, heights):
+        order = canonical_order(parents, colors, heights)
+        new_id = {b: i for i, b in enumerate(order)}
+        return tuple(
+            (colors[b], tuple(sorted(new_id[p] for p in parents[b])))
+            for b in order
+        )
+
+    base = canon_form(parents, colors, heights)
+    # swap the two interchangeable height-1 siblings and the height-2 pair
+    perm = {0: 0, 1: 2, 2: 1, 3: 4, 4: 3, 5: 5}
+    p2 = tuple(tuple(sorted(perm[p] for p in parents[b]))
+               for b in sorted(range(6), key=lambda b: perm[b]))
+    c2 = tuple(colors[b] for b in sorted(range(6), key=lambda b: perm[b]))
+    assert canon_form(p2, c2, heights) == base
+    assert rng is not None
+
+
+def test_ghostdag_capstone_sharded_vi():
+    """BASELINE.md target config 5: GhostDAG MDP value iteration solved
+    by the mesh-sharded sweep, equal to the single-device solve."""
+    from cpr_tpu.parallel import default_mesh, sharded_value_iteration
+
+    m = SingleAgent(get_protocol("ghostdag", k=2), alpha=0.3, gamma=0.5,
+                    collect_garbage="simple", merge_isomorphic=True,
+                    truncate_common_chain=True, dag_size_cutoff=5)
+    tm = ptmdp(Compiler(m).mdp(), horizon=20).tensor()
+    single = tm.value_iteration(stop_delta=1e-6)
+    sharded = sharded_value_iteration(tm, default_mesh(), stop_delta=1e-6)
+    np.testing.assert_allclose(
+        sharded["vi_value"], single["vi_value"], rtol=1e-6, atol=1e-7)
+
+
+def test_loop_honest_closes_state_space():
+    m = SingleAgent(get_protocol("bitcoin"), alpha=0.3, gamma=0.5,
+                    collect_garbage="simple", merge_isomorphic=True,
+                    loop_honest=True, truncate_common_chain=False)
+    starts = {s for s, _ in m.start()}
+    # honest play from each start must stay within a small closed set
+    seen = set()
+    frontier = list(starts)
+    while frontier:
+        s = frontier.pop()
+        if s in seen:
+            continue
+        seen.add(s)
+        for t in m.apply(m.honest(s), s):
+            if t.state not in seen:
+                frontier.append(t.state)
+        assert len(seen) < 50, "honest loop did not close"
+    assert starts <= seen

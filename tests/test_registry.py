@@ -23,7 +23,8 @@ def test_family_keys_present():
     ("stree-4-discount-altruistic", "StreeSSZ",
      {"k": 4, "incentive_scheme": "discount",
       "subblock_selection": "altruistic"}, {"max_steps_hint": 32}),
-    ("sdag-4-constant", "SdagSSZ", {"k": 4}, {"max_steps_hint": 32}),
+    ("sdag-4-constant-altruistic", "SdagSSZ", {"k": 4},
+     {"max_steps_hint": 32}),
     ("tailstorm-4-discount-heuristic", "TailstormSSZ",
      {"k": 4, "incentive_scheme": "discount"}, {"max_steps_hint": 32}),
 ])
@@ -36,6 +37,11 @@ def test_parse_and_instantiate(key, cls, attrs, kwargs):
 
 def test_bad_keys_rejected():
     for key in ("tailstorm-x-discount", "foo", "bk-4-constant-extra-bits",
-                "ethereum-petersburg"):
+                "ethereum-petersburg",
+                # every option is mandatory, as in the reference grammar
+                # (cpr_protocols.ml:800-811)
+                "bk-4", "stree-4-constant", "tailstorm-8-discount",
+                # k bounds: sdag requires k >= 2 (sdag.ml:24)
+                "sdag-1-constant-altruistic", "bk-0-constant"):
         with pytest.raises(KeyError):
             registry.get(key)
