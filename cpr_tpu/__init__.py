@@ -7,9 +7,11 @@ simulator + OCaml/Rust gym extensions + Python MDP toolbox) for JAX/XLA:
 - protocols as pure state-transition functions over fixed-capacity block-DAG
   tensors (`cpr_tpu.core`, `cpr_tpu.protocols`),
 - selfish-mining attack environments as jittable, `vmap`-batched Monte-Carlo
-  kernels (`cpr_tpu.envs`), exposed through gymnasium,
+  kernels (`cpr_tpu.envs`), exposed through gymnasium env ids
+  (`cpr_tpu.gym`: core-v0, cpr-v0, cpr-nakamoto-v0, cpr-tailstorm-v0),
 - the MDP attack-search stack (implicit->explicit compiler, value iteration,
-  RTDP, policy-guided exploration) with JAX solvers (`cpr_tpu.mdp`),
+  RTDP, policy-guided exploration, generic DAG-protocol models incl.
+  GhostDAG) with JAX solvers (`cpr_tpu.mdp`),
 - device-mesh parallelism (vmap env batch, pjit data-parallel episodes,
   sharded value-iteration sweeps) behind `cpr_tpu.parallel`.
 """

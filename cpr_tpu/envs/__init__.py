@@ -1,4 +1,5 @@
-"""Attack environments (jittable JAX kernels) + gymnasium adapters.
+"""Attack environments (jittable JAX kernels); the gymnasium adapters
+and registered env ids live in cpr_tpu.gym.
 
 The env contract mirrors the reference engine record
 (reference: simulator/gym/intf.ml:3-13): n_actions, observation bounds,

@@ -1,0 +1,79 @@
+"""gymnasium plugin boundary: env ids + composed env factory.
+
+Reference counterpart: gym/ocaml/cpr_gym/envs.py:96-192 — the registered
+ids `core-v0`, `cpr-v0`, `cpr-nakamoto-v0`, `cpr-tailstorm-v0` and the
+`env_fn` composition (Core + AssumptionScheduleWrapper + reward wrapper +
+normalization).  Importing this module registers the ids; external
+trainers then use plain `gymnasium.make("cpr-nakamoto-v0")` with the
+JAX/TPU engine behind it.
+"""
+
+from __future__ import annotations
+
+import gymnasium
+
+from cpr_tpu.gym import wrappers
+from cpr_tpu.gym.envs import BatchedCore, Core
+
+
+def env_fn(protocol="nakamoto", protocol_args=None,
+           _protocol_args=None, episode_len=128, alpha=0.45,
+           gamma=0.5, pretend_alpha=None, pretend_gamma=None,
+           defenders=None, reward="sparse_relative",
+           normalize_reward=True, seed=0):
+    """Composed environment (reference env_fn, envs.py:99-163):
+    Core + assumption schedule + reward shaping + normalization."""
+    protocol_args = {**(_protocol_args or {}), **(protocol_args or {})}
+
+    rewards = {
+        "sparse_relative": (
+            wrappers.SparseRelativeRewardWrapper,
+            dict(max_steps=episode_len)),
+        "sparse_per_progress": (
+            wrappers.SparseRewardPerProgressWrapper,
+            dict(max_steps=episode_len)),
+        # same bounds the wrapper will install, so it overwrites nothing
+        "dense_per_progress": (
+            lambda env: wrappers.DenseRewardPerProgressWrapper(
+                env, episode_len=episode_len),
+            dict(max_steps=episode_len * 100, max_progress=episode_len)),
+    }
+    try:
+        reward_wrapper, env_args = rewards[reward]
+    except KeyError:
+        raise ValueError(
+            f"unknown reward '{reward}'; choose from {sorted(rewards)}")
+
+    env = Core(protocol, alpha=0.25, gamma=0.0, defenders=defenders,
+               seed=seed, **env_args, **protocol_args)
+    env = wrappers.AssumptionScheduleWrapper(
+        env, alpha=alpha, gamma=gamma,
+        pretend_alpha=pretend_alpha, pretend_gamma=pretend_gamma)
+    env.reset()  # apply the schedule's first alpha/gamma draw
+    env = reward_wrapper(env)
+    if normalize_reward:
+        env = wrappers.MapRewardWrapper(env, lambda r, i: r / i["alpha"])
+    return env
+
+
+def _register():
+    specs = [
+        dict(id="core-v0", entry_point=Core),
+        dict(id="cpr-v0", entry_point=env_fn),
+        dict(id="cpr-nakamoto-v0", entry_point=env_fn,
+             kwargs=dict(protocol="nakamoto", reward="sparse_relative")),
+        dict(id="cpr-tailstorm-v0", entry_point=env_fn,
+             kwargs=dict(protocol="tailstorm",
+                         _protocol_args=dict(
+                             k=8, incentive_scheme="discount",
+                             subblock_selection="heuristic"),
+                         reward="sparse_per_progress")),
+    ]
+    for spec in specs:  # per-id guard: re-import must be idempotent
+        if spec["id"] not in gymnasium.envs.registry:
+            gymnasium.register(**spec)
+
+
+_register()
+
+__all__ = ["Core", "BatchedCore", "env_fn", "wrappers"]

@@ -1,0 +1,174 @@
+"""gymnasium adapter over the jittable JAX environments.
+
+Reference counterpart: gym/ocaml/cpr_gym/envs.py — `Core(gym.Env)` over
+the OCaml engine (:9-93), the composed `env_fn` (:99-163), and the
+registered ids (:96,166-192).  The north-star contract is the same:
+`gymnasium.make("cpr-nakamoto-v0")` hands a standard env to an unchanged
+external trainer, with the TPU/JAX engine behind the step call.
+
+Where the reference marshals through a CPython extension into the OCaml
+runtime, this adapter jits the env's reset/step once per instance and
+feeds numpy scalars across — the single-env gym surface is the
+compatibility path; high-throughput training uses the vmap'd rollout
+kernels directly (cpr_tpu.train.ppo) or `BatchedCore` below.
+"""
+
+from __future__ import annotations
+
+import gymnasium
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cpr_tpu.envs import registry
+from cpr_tpu.envs.base import JaxEnv
+from cpr_tpu.params import ParameterError, make_params
+
+
+class Core(gymnasium.Env):
+    """Single gymnasium env over a JaxEnv.
+
+    `proto` is a JaxEnv instance or a registry/protocol key
+    ("nakamoto", "tailstorm-8-discount-heuristic", ...); construction
+    kwargs mirror the reference Core (envs.py:12-53): alpha, gamma,
+    activation_delay, defenders, and at least one of max_steps /
+    max_progress / max_time.
+    """
+
+    metadata = {"render_modes": ["ascii"]}
+
+    def __init__(self, proto: JaxEnv | str = "nakamoto", *, alpha=0.25,
+                 gamma=0.5, activation_delay=1.0, defenders=None,
+                 max_steps=None, max_progress=None, max_time=None,
+                 seed: int = 0, **proto_kwargs):
+        if max_steps is None and max_progress is None and max_time is None:
+            raise ParameterError(
+                "set at least one of max_steps, max_progress, max_time")
+        if isinstance(proto, str):
+            if max_steps is not None:
+                proto_kwargs.setdefault("max_steps_hint", int(max_steps))
+            try:
+                proto = registry.get(proto, **proto_kwargs)
+            except TypeError:
+                # envs without capacity planning (e.g. nakamoto) don't
+                # take max_steps_hint
+                proto_kwargs.pop("max_steps_hint", None)
+                proto = registry.get(proto, **proto_kwargs)
+        self.jax_env: JaxEnv = proto
+        # mutable parameter record, re-read on every reset — wrappers
+        # reconfigure assumptions by writing here (the reference's
+        # core_kwargs contract, envs.py:20-24, wrappers.py:227-235)
+        self.core_kwargs = dict(
+            alpha=alpha, gamma=gamma, activation_delay=activation_delay,
+            defenders=defenders, max_steps=max_steps,
+            max_progress=max_progress, max_time=max_time)
+
+        self._reset_fn = jax.jit(proto.reset)
+        self._step_fn = jax.jit(proto.step)
+        self._key = jax.random.PRNGKey(seed)
+        self._state = None
+        self.params = None
+
+        self.action_space = gymnasium.spaces.Discrete(proto.n_actions)
+        self.observation_space = gymnasium.spaces.Box(
+            np.asarray(proto.low, np.float64),
+            np.asarray(proto.high, np.float64), dtype=np.float64)
+
+    # -- gymnasium API ---------------------------------------------------
+
+    def reset(self, *, seed=None, options=None):
+        super().reset(seed=seed)
+        if seed is not None:
+            self._key = jax.random.PRNGKey(seed)
+        self.params = make_params(**self.core_kwargs)
+        self._key, k = jax.random.split(self._key)
+        self._state, obs = self._reset_fn(k, self.params)
+        return np.asarray(obs, np.float64), {}
+
+    def step(self, action):
+        self._state, obs, reward, done, info = self._step_fn(
+            self._state, jnp.int32(action), self.params)
+        info = {k: float(v) for k, v in info.items()}
+        return (np.asarray(obs, np.float64), float(reward), bool(done),
+                False, info)
+
+    def render(self):
+        fields = getattr(self.jax_env, "fields", ())
+        if self._state is None or not fields:
+            print(f"<{type(self.jax_env).__name__}: not reset>")
+            return
+        obs = np.asarray(self.jax_env.observe(self._state))
+        vals = self.jax_env.decode_obs(obs)
+        print(", ".join(f"{f.name}={int(v)}"
+                        for f, v in zip(fields, vals)))
+
+    # -- reference surface beyond gymnasium ------------------------------
+
+    def policies(self):
+        return self.jax_env.policies.keys()
+
+    def policy(self, obs, name="honest"):
+        try:
+            fn = self.jax_env.policies[name]
+        except KeyError:
+            raise ValueError(
+                f"{name} is not a valid policy; choose from "
+                + ", ".join(self.policies()))
+        if getattr(fn, "takes_state", False):
+            return int(fn(self._state, jnp.asarray(obs, jnp.float32)))
+        return int(fn(jnp.asarray(obs, jnp.float32)))
+
+
+class BatchedCore(gymnasium.Env):
+    """vmap-batched variant: actions/observations/rewards carry a leading
+    `n_envs` axis and episodes auto-reset per lane.  This is the
+    TPU-throughput path for external trainers that can consume batched
+    streams (the analog of wrapping the reference Core in
+    sb3 SubprocVecEnv — except the batch is one compiled kernel)."""
+
+    metadata = {"render_modes": []}
+
+    def __init__(self, proto: JaxEnv | str = "nakamoto", *, n_envs: int = 128,
+                 seed: int = 0, **kwargs):
+        self._single = Core(proto, seed=seed, **kwargs)
+        env = self._single.jax_env
+        self.jax_env = env
+        self.core_kwargs = self._single.core_kwargs
+        self.n_envs = n_envs
+        self._key = jax.random.PRNGKey(seed)
+        self._reset_fn = jax.jit(jax.vmap(env.reset, in_axes=(0, None)))
+        self._step_fn = jax.jit(jax.vmap(env.step, in_axes=(0, 0, None)))
+        self._state = None
+        self.params = None
+        self.action_space = gymnasium.spaces.MultiDiscrete(
+            np.full(n_envs, env.n_actions))
+        low = np.tile(np.asarray(env.low, np.float64), (n_envs, 1))
+        high = np.tile(np.asarray(env.high, np.float64), (n_envs, 1))
+        self.observation_space = gymnasium.spaces.Box(low, high,
+                                                      dtype=np.float64)
+
+    def reset(self, *, seed=None, options=None):
+        if seed is not None:
+            self._key = jax.random.PRNGKey(seed)
+        self.params = make_params(**self.core_kwargs)
+        self._key, k = jax.random.split(self._key)
+        self._state, obs = self._reset_fn(
+            jax.random.split(k, self.n_envs), self.params)
+        return np.asarray(obs, np.float64), {}
+
+    def step(self, actions):
+        state, obs, reward, done, info = self._step_fn(
+            self._state, jnp.asarray(actions, jnp.int32), self.params)
+        np_done = np.asarray(done)
+        if np_done.any():
+            # per-lane auto-reset, keeping each lane's PRNG stream
+            rstate, robs = self._reset_fn(state.key, self.params)
+            state = jax.tree.map(
+                lambda a, b: jnp.where(
+                    done.reshape(done.shape + (1,) * (a.ndim - 1)), a, b),
+                rstate, state)
+            obs = jnp.where(done[:, None], robs, obs)
+        self._state = state
+        info = {k: np.asarray(v) for k, v in info.items()}
+        return (np.asarray(obs, np.float64), np.asarray(reward),
+                np_done, np.zeros_like(np_done), info)

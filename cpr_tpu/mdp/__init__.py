@@ -14,4 +14,6 @@ sparse solves) stay on CPU like the reference.
 from cpr_tpu.mdp.implicit import Effect, Model, PTOWrapper, Transition  # noqa: F401
 from cpr_tpu.mdp.compiler import Compiler  # noqa: F401
 from cpr_tpu.mdp.explicit import MDP, TensorMDP, ptmdp  # noqa: F401
+from cpr_tpu.mdp.explorer import Explorer  # noqa: F401
+from cpr_tpu.mdp.rtdp import RTDP  # noqa: F401
 from cpr_tpu.mdp import generic  # noqa: F401

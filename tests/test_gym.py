@@ -1,0 +1,183 @@
+"""gymnasium plugin boundary tests.
+
+Mirrors the reference's gym tests (gym/ocaml/test/test_envs.py:24-40
+check_env + wrapper behaviours; test_protocols.py policy runs) against
+the JAX engine: registered ids construct, the env contract holds, built-in
+policies run through the gym surface, and every wrapper behaves.
+"""
+
+import gymnasium
+import numpy as np
+import pytest
+from gymnasium.utils.env_checker import check_env
+
+import cpr_tpu.gym  # noqa: F401  (import registers the env ids)
+from cpr_tpu.gym import BatchedCore, Core, env_fn, wrappers
+
+
+def test_env_ids_registered():
+    for eid in ("core-v0", "cpr-v0", "cpr-nakamoto-v0", "cpr-tailstorm-v0"):
+        assert eid in gymnasium.envs.registry
+
+
+def test_check_env_core():
+    check_env(Core("nakamoto", max_steps=32), skip_render_check=True)
+
+
+def test_check_env_composed():
+    env = gymnasium.make("cpr-nakamoto-v0", episode_len=32)
+    check_env(env.unwrapped, skip_render_check=True)
+
+
+def test_core_requires_termination_criterion():
+    with pytest.raises(Exception, match="max_steps"):
+        Core("nakamoto")
+
+
+def test_honest_policy_through_gym_surface():
+    """Honest policy earns ~alpha relative reward (the reference's
+    test_protocols.py pattern, run through gym)."""
+    alpha = 0.3
+    env = Core("nakamoto", alpha=alpha, gamma=0.5, max_steps=256, seed=4)
+    rels = []
+    for ep in range(8):
+        obs, _ = env.reset()
+        while True:
+            obs, r, term, trunc, info = env.step(env.policy(obs, "honest"))
+            if term or trunc:
+                a = info["episode_reward_attacker"]
+                d = info["episode_reward_defender"]
+                rels.append(a / (a + d))
+                break
+    assert abs(np.mean(rels) - alpha) < 0.08, np.mean(rels)
+
+
+def test_policy_name_error():
+    env = Core("nakamoto", max_steps=16)
+    obs, _ = env.reset()
+    with pytest.raises(ValueError, match="not a valid policy"):
+        env.policy(obs, "no-such-policy")
+
+
+def test_sparse_relative_wrapper():
+    env = wrappers.SparseRelativeRewardWrapper(
+        Core("nakamoto", alpha=0.25, max_steps=64, seed=0))
+    obs, _ = env.reset()
+    rewards = []
+    while True:
+        obs, r, term, trunc, info = env.step(env.env.policy(obs, "honest"))
+        rewards.append(r)
+        if term or trunc:
+            break
+    assert all(r == 0.0 for r in rewards[:-1])
+    a = info["episode_reward_attacker"]
+    d = info["episode_reward_defender"]
+    assert rewards[-1] == pytest.approx(a / (a + d))
+
+
+def test_assumption_schedule_cycles_and_extends_obs():
+    alphas = [0.1, 0.2, 0.3]
+    env = wrappers.AssumptionScheduleWrapper(
+        Core("nakamoto", max_steps=8, seed=1), alpha=alphas, gamma=0.5)
+    seen = []
+    for _ in range(6):
+        obs, _ = env.reset()
+        assert obs.shape[-1] == 6  # 4 fields + alpha + gamma
+        assert obs[-2] == pytest.approx(env.asw_alpha)
+        assert obs[-1] == pytest.approx(0.5)
+        obs, r, term, trunc, info = env.step(0)
+        assert info["alpha"] == env.asw_alpha
+        seen.append(env.asw_alpha)
+    assert seen == [0.1, 0.2, 0.3, 0.1, 0.2, 0.3]
+    # env params actually track the schedule
+    assert float(env.unwrapped.params.alpha) == pytest.approx(env.asw_alpha)
+
+
+def test_pretend_assumptions_mask_observation_only():
+    env = wrappers.AssumptionScheduleWrapper(
+        Core("nakamoto", max_steps=8), alpha=0.3, gamma=0.5,
+        pretend_alpha=0.45)
+    obs, _ = env.reset()
+    assert obs[-2] == pytest.approx(0.45)  # shown
+    assert float(env.unwrapped.params.alpha) == pytest.approx(0.3)  # real
+
+
+def test_extend_observation_wrapper():
+    fields = [(lambda w, i: i["episode_progress"], 0.0, np.inf, -1.0)]
+    env = wrappers.ExtendObservationWrapper(
+        Core("nakamoto", max_steps=8), fields)
+    obs, _ = env.reset()
+    assert obs[-1] == -1.0
+    obs, *_ = env.step(0)
+    assert obs.shape[-1] == 5
+    # policy dispatch strips the extension
+    env.policy(obs, "honest")
+
+
+def test_episode_recorder_and_clear_info():
+    env = wrappers.EpisodeRecorderWrapper(
+        wrappers.ClearInfoWrapper(
+            wrappers.SparseRelativeRewardWrapper(
+                Core("nakamoto", alpha=0.3, max_steps=16, seed=2)),
+            keep_keys=("episode_reward_attacker",
+                       "episode_reward_defender")),
+        n=4, info_keys=("episode_reward_attacker",))
+    obs, _ = env.reset()
+    for _ in range(3):
+        while True:
+            obs, r, term, trunc, info = env.step(0)
+            assert set(info) <= {"episode_reward_attacker",
+                                 "episode_reward_defender"}
+            if term or trunc:
+                obs, _ = env.reset()
+                break
+    assert len(env.erw_history) == 3
+    assert all("episode_reward" in e for e in env.erw_history)
+
+
+def test_dense_per_progress_accumulates_to_sparse_objective():
+    """Dense rewards accumulate (after the end-of-episode mismatch fix)
+    to exactly the sparse per-progress objective of the same episode:
+    episode_reward_attacker / episode_progress (wrappers.py:54-113)."""
+    dense = env_fn(protocol="nakamoto", episode_len=32, alpha=0.3,
+                   gamma=0.5, reward="dense_per_progress",
+                   normalize_reward=False, seed=7)
+    obs, _ = dense.reset(seed=11)
+    total = 0.0
+    while True:
+        obs, r, term, trunc, info = dense.step(dense.policy(obs, "honest"))
+        total += r
+        if term or trunc:
+            break
+    assert info["episode_progress"] > 0
+    assert total == pytest.approx(
+        info["episode_reward_attacker"] / info["episode_progress"],
+        rel=1e-6)
+
+
+def test_batched_core_auto_resets():
+    env = BatchedCore("nakamoto", n_envs=32, alpha=0.33, gamma=0.5,
+                      max_steps=16, seed=3)
+    obs, _ = env.reset()
+    assert obs.shape == (32, 4)
+    dones = 0
+    for _ in range(40):
+        obs, r, done, trunc, info = env.step(np.zeros(32, np.int64))
+        dones += int(done.sum())
+    assert dones > 0  # lanes terminated and auto-reset
+    assert obs.shape == (32, 4)
+
+
+def test_env_fn_reward_normalization():
+    env = env_fn(protocol="nakamoto", episode_len=16, alpha=0.4,
+                 reward="sparse_relative", normalize_reward=True)
+    obs, _ = env.reset()
+    while True:
+        obs, r, term, trunc, info = env.step(0)
+        if term or trunc:
+            break
+    # normalized: raw relative reward divided by alpha
+    assert r == pytest.approx(
+        (info["episode_reward_attacker"]
+         / max(info["episode_reward_attacker"]
+               + info["episode_reward_defender"], 1e-12)) / 0.4)

@@ -1,0 +1,119 @@
+"""RTDP + policy-guided explorer tests.
+
+Mirrors mdp/lib/rtdp_test.py (RTDP on literature Bitcoin models with PTO
+horizons, monotone start value) and policy_guided_explorer_test.py
+(prefix-compatible truncated MDPs), with the convergence criterion made
+explicit: RTDP's start value must approach the exhaustive VI solution.
+"""
+
+import numpy as np
+
+from cpr_tpu.mdp import RTDP, Compiler, Explorer, PTOWrapper, ptmdp
+from cpr_tpu.mdp.generic import SingleAgent, get_protocol
+from cpr_tpu.mdp.models import Fc16BitcoinSM
+
+TERM = "terminal"
+
+
+def vi_start_value(model_factory, horizon):
+    c = Compiler(model_factory())
+    tm = ptmdp(c.mdp(), horizon=horizon).tensor()
+    vi = tm.value_iteration(stop_delta=1e-7)
+    return tm.start_value(vi["vi_value"])
+
+
+def test_rtdp_converges_to_vi_on_fc16():
+    factory = lambda: Fc16BitcoinSM(alpha=0.3, gamma=0.5,  # noqa: E731
+                                    maximum_fork_length=6)
+    horizon = 20
+    ref = vi_start_value(factory, horizon)
+    agent = RTDP(PTOWrapper(factory(), horizon=horizon, terminal_state=TERM),
+                 eps=0.2, eps_honest=0.2, es=0.2, seed=1)
+    agent.run(60_000)
+    v, _ = agent.start_value_and_progress()
+    assert abs(v - ref) / ref < 0.05, (v, ref)
+
+
+def test_rtdp_settles_near_vi_and_mdp_roundtrip():
+    factory = lambda: Fc16BitcoinSM(alpha=0.35, gamma=0.6,  # noqa: E731
+                                    maximum_fork_length=5)
+    horizon = 15
+    ref = vi_start_value(factory, horizon)
+    model = PTOWrapper(factory(), horizon=horizon, terminal_state=TERM)
+    agent = RTDP(model, eps=0.3, eps_honest=0.3, seed=3)
+    # the shutdown-based init is optimistic guidance: estimates start
+    # high and settle toward the exhaustive VI value from above
+    for _ in range(10):
+        agent.run(2_000)
+    v, _ = agent.start_value_and_progress()
+    assert abs(v - ref) / ref < 0.05, (v, ref)
+    # the extracted partial MDP re-solves close to the agent's estimate
+    out = agent.mdp()
+    tm = out["mdp"].tensor()
+    vi = tm.value_iteration(stop_delta=1e-7)
+    assert abs(tm.start_value(vi["vi_value"]) - v) / max(v, 1.0) < 0.05
+
+
+def test_rtdp_on_generic_dag_model():
+    """RTDP drives the generic DAG model without exhaustive compilation
+    (the reference pairing: rtdp over generic_v1, measure-rtdp.py)."""
+    m = SingleAgent(get_protocol("bitcoin"), alpha=0.33, gamma=0.5,
+                    collect_garbage="simple", merge_isomorphic=True,
+                    truncate_common_chain=True)
+    agent = RTDP(PTOWrapper(m, horizon=12, terminal_state=TERM),
+                 eps=0.15, eps_honest=0.25, seed=5)
+    agent.run(8_000)
+    v, p = agent.start_value_and_progress()
+    # honest baseline earns ~alpha per progress; the optimum at these
+    # params is near-honest, so the estimate should sit in a sane band
+    assert 0.2 <= v / p <= 0.6, (v, p)
+    assert agent.n_states > 100
+
+
+def test_explorer_prefix_compatible():
+    m = SingleAgent(get_protocol("bitcoin"), alpha=0.3, gamma=0.2,
+                    collect_garbage="simple", merge_isomorphic=True,
+                    loop_honest=True, truncate_common_chain=False)
+    model = PTOWrapper(m, horizon=10, terminal_state=TERM)
+    e = Explorer(model, model.honest)
+    e.explore_along_policy(max_states=50_000)
+    small = e.mdp()
+    n_small = e.n_states
+    # the guiding policy is positional action 0 everywhere
+    for sid in range(small.n_states):
+        if e.policy_actions[sid] >= 0:
+            acts = model.actions(e.states[sid])
+            assert acts[e.policy_actions[sid]] == model.honest(e.states[sid])
+    prefix_before = list(e.states[:n_small])
+    e.explore_aside_policy(max_states=200_000)
+    big = e.mdp()
+    assert big.n_states > n_small
+    # prefix compatibility: the first n_small states are the same states,
+    # and action 0 still encodes the guiding policy in the bigger MDP
+    assert list(e.states[:n_small]) == prefix_before
+    src = np.asarray(big.src)
+    act = np.asarray(big.act)
+    for sid in range(n_small):
+        assert ((src == sid) & (act == 0)).any() or \
+            e.policy_actions[sid] == -1
+
+
+def test_explorer_policy_value_grows_with_exploration():
+    """Solving the truncated MDPs of growing size yields non-decreasing
+    optimal value (more options can only help the attacker)."""
+    m = SingleAgent(get_protocol("bitcoin"), alpha=0.35, gamma=0.5,
+                    collect_garbage="simple", merge_isomorphic=True,
+                    loop_honest=True, truncate_common_chain=False)
+    model = PTOWrapper(m, horizon=10, terminal_state=TERM)
+    e = Explorer(model, model.honest)
+    e.explore_along_policy(max_states=100_000)
+    v_policy = _solve(e.mdp())
+    e.explore_aside_policy(max_states=400_000)
+    v_aside = _solve(e.mdp())
+    assert v_aside >= v_policy - 1e-6, (v_policy, v_aside)
+
+
+def _solve(m):
+    tm = m.tensor()
+    vi = tm.value_iteration(stop_delta=1e-7)
+    return tm.start_value(vi["vi_value"])
