@@ -1,0 +1,120 @@
+"""Native (C++) oracle engine: build-on-demand + ctypes bindings.
+
+Reference counterpart: the OCaml runtime compiled into cpr_gym_engine.so
+and loaded via PyDLL (gym/ocaml/cpr_gym/__init__.py:38-58).  pybind11 is
+not available in this environment, so the library exposes a plain C API
+driven through ctypes; the source lives in cpr_tpu/native/src/oracle.cpp
+and is compiled with g++ on first use (cached next to the source).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "src", "oracle.cpp")
+_SO = os.path.join(_HERE, "liboracle.so")
+_LOCK = threading.Lock()
+_LIB = None
+
+
+def _build():
+    cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", _SRC, "-o", _SO]
+    r = subprocess.run(cmd, capture_output=True, text=True)
+    if r.returncode != 0:
+        raise RuntimeError(
+            f"oracle build failed ({' '.join(cmd)}):\n{r.stderr}")
+
+
+def lib() -> ctypes.CDLL:
+    """Load (building if stale) the oracle shared library."""
+    global _LIB
+    with _LOCK:
+        if _LIB is not None:
+            return _LIB
+        if (not os.path.exists(_SO)
+                or os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
+            _build()
+        L = ctypes.CDLL(_SO)
+        L.cpr_oracle_create.restype = ctypes.c_void_p
+        L.cpr_oracle_create.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p,  # proto,k,scheme
+            ctypes.c_char_p, ctypes.c_int,  # topology, n_nodes
+            ctypes.c_double, ctypes.c_double, ctypes.c_int,  # alpha,gamma,def
+            ctypes.c_double, ctypes.c_double,  # activation, propagation
+            ctypes.c_char_p, ctypes.c_uint64,  # attacker policy, seed
+        ]
+        L.cpr_oracle_run.restype = ctypes.c_long
+        L.cpr_oracle_run.argtypes = [ctypes.c_void_p, ctypes.c_long]
+        L.cpr_oracle_metric.restype = ctypes.c_double
+        L.cpr_oracle_metric.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                        ctypes.c_int]
+        L.cpr_oracle_destroy.restype = None
+        L.cpr_oracle_destroy.argtypes = [ctypes.c_void_p]
+        _LIB = L
+        return L
+
+
+_METRICS = {"reward_of": 0, "progress": 1, "sim_time": 2, "n_blocks": 3,
+            "head_height": 4, "on_chain": 5, "head_time": 6}
+
+
+class OracleSim:
+    """One discrete-event simulation on the C++ engine.
+
+    Protocols: nakamoto, ethereum-whitepaper, ethereum-byzantium,
+    bk (with k + scheme constant|block).
+    Topologies: clique (n_nodes equal miners), two_agents (alpha split),
+    selfish_mining (attacker + defender cloud, gamma via message delays,
+    network.ml:61-105).
+    attacker_policy (nakamoto + selfish_mining/two_agents): none, honest,
+    eyal-sirer-2014, sapirshtein-2016-sm1.
+    """
+
+    def __init__(self, protocol: str = "nakamoto", *, k: int = 0,
+                 scheme: str = "", topology: str = "clique",
+                 n_nodes: int = 7, alpha: float = 0.25,
+                 gamma: float = 0.5, defenders: int | None = None,
+                 activation_delay: float = 1.0,
+                 propagation_delay: float = 1e-9,
+                 attacker_policy: str = "none", seed: int = 0):
+        import math
+
+        if defenders is None:
+            defenders = max(2, int(math.ceil(1.0 / (1.0 - gamma)))) \
+                if gamma < 1.0 else 2
+        self._lib = lib()
+        self._h = self._lib.cpr_oracle_create(
+            protocol.encode(), k, scheme.encode(), topology.encode(),
+            n_nodes, alpha, gamma, defenders, activation_delay,
+            propagation_delay, attacker_policy.encode(), seed)
+        if not self._h:
+            raise ValueError(
+                f"oracle rejected configuration: protocol={protocol} "
+                f"topology={topology} attacker_policy={attacker_policy}")
+
+    def run(self, activations: int) -> int:
+        return self._lib.cpr_oracle_run(self._h, activations)
+
+    def metric(self, name: str, arg: int = 0) -> float:
+        return self._lib.cpr_oracle_metric(self._h, _METRICS[name], arg)
+
+    def rewards(self, n: int) -> list[float]:
+        return [self.metric("reward_of", i) for i in range(n)]
+
+    def close(self):
+        if self._h:
+            self._lib.cpr_oracle_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+__all__ = ["OracleSim", "lib"]

@@ -1,0 +1,796 @@
+// Multi-node discrete-event consensus simulator — the CPU oracle engine.
+//
+// Reference counterpart: the OCaml core runtime (simulator/lib/simulator.ml
+// event loop :421-533, network.ml topologies :29-105, dag.ml views) and the
+// honest protocol modules (nakamoto.ml, ethereum.ml, bk.ml) plus the
+// nakamoto_ssz.ml withholding agent (:156-350).  The reference compiles this
+// machinery into cpr_gym_engine.so; this framework's equivalent is a C
+// shared library driven through ctypes (cpr_tpu/native/__init__.py).
+//
+// Role in the TPU framework: the general multi-node simulator is host-side
+// by nature (pointer-chasing DAGs, data-dependent event queues) and serves
+// as the equivalence oracle for the collapsed 2-party JAX environments and
+// as the engine for honest-network topology sweeps.  The hot RL path runs
+// on TPU; this code validates its semantics.
+//
+// Clean-room implementation: structures and algorithms re-derived from the
+// reference's documented behavior, not translated.
+
+#include <algorithm>
+#include <cassert>
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <queue>
+#include <random>
+#include <string>
+#include <vector>
+
+namespace {
+
+// ---------------------------------------------------------------- blocks
+
+struct Block {
+  std::vector<int> parents;  // parents[0] = chain predecessor
+  std::vector<int> children;
+  int miner = -1;            // -1: root
+  int height = 0;
+  int work = 0;              // ethereum: cumulative work; bk votes: unused
+  bool is_vote = false;      // bk
+  int vote_id = -1;          // bk vote: voter id; bk block: signer (leader)
+  double pow_hash = 2.0;     // < 2.0 iff proof-of-work block
+  double time = 0.0;         // append time
+};
+
+struct Dag {
+  std::vector<Block> blocks;
+
+  int add(Block b) {
+    int id = (int)blocks.size();
+    for (int p : b.parents) blocks[p].children.push_back(id);
+    blocks.push_back(std::move(b));
+    return id;
+  }
+};
+
+// ------------------------------------------------------------- protocols
+
+struct Sim;  // fwd
+
+// A protocol defines drafts (what an honest node mines on), preference
+// updates, optional non-PoW proposals, progress, and rewards.
+struct Protocol {
+  virtual ~Protocol() = default;
+  virtual Block genesis() const = 0;
+  // honest mining draft given the node's preferred tip
+  virtual Block draft(Sim& s, int node, int preferred) = 0;
+  // preference after learning `b` (visibility-filtered view belongs to
+  // the caller; protocols only compare chain data)
+  virtual int prefer(Sim& s, int node, int old, int b) = 0;
+  // non-PoW block the node would append after learning `b` (bk proposal);
+  // return empty vector if none
+  virtual std::vector<Block> proposals(Sim& s, int node, int b) {
+    (void)s; (void)node; (void)b;
+    return {};
+  }
+  virtual double progress(const Dag& d, int head) const = 0;
+  // attacker-share bookkeeping: per-miner rewards along head's history
+  virtual void rewards(const Dag& d, int head,
+                       std::vector<double>& per_miner) const = 0;
+  // chain membership for orphan statistics: number of blocks that count
+  virtual long on_chain(const Dag& d, int head) const = 0;
+  // winner among node preferences (referee `winner`)
+  virtual int winner(Sim& s, const std::vector<int>& prefs) = 0;
+};
+
+// ------------------------------------------------------------ event loop
+
+struct Event {
+  double time;
+  long seq;  // FIFO tie-break
+  int type;  // 0 = activation, 1 = receive(node, block)
+  int node = -1;
+  int block = -1;
+  bool operator<(const Event& o) const {  // min-heap via greater
+    if (time != o.time) return time > o.time;
+    return seq > o.seq;
+  }
+};
+
+struct NakAgent;  // fwd: nakamoto withholding attacker
+
+struct Sim {
+  Dag dag;
+  std::unique_ptr<Protocol> proto;
+  std::mt19937_64 rng;
+
+  int n_nodes = 0;
+  std::vector<double> compute;          // mining weight per node
+  double activation_delay = 1.0;
+  // link delays: delay_matrix[src][dst]; -1 = uniform attacker delay
+  std::vector<std::vector<double>> delay;
+  double attacker_delay_upper = 0.0;    // uniform upper bound for src 0
+
+  std::vector<std::vector<char>> visible;   // [node][block]
+  std::vector<std::vector<char>> known;     // received but maybe buffered
+  std::vector<int> preferred;               // per node
+  std::priority_queue<Event> queue;
+  long seq = 0;
+  double now = 0.0;
+  long activations = 0;
+
+  std::unique_ptr<NakAgent> agent;          // node 0, optional
+
+  // bk proposal dedup (simulator.ml:138-158): key -> block id
+  std::map<std::string, int> dedup;
+
+  double rand_u() { return std::uniform_real_distribution<>(0, 1)(rng); }
+
+  void push(double t, int type, int node, int block) {
+    queue.push(Event{t, seq++, type, node, block});
+  }
+
+  void init() {
+    int g = dag.add(proto->genesis());
+    visible.assign(n_nodes, {});
+    known.assign(n_nodes, {});
+    preferred.assign(n_nodes, g);
+    for (int i = 0; i < n_nodes; i++) mark_visible(i, g);
+    schedule_activation();
+  }
+
+  void mark_visible(int node, int b) {
+    auto& v = visible[node];
+    auto& k = known[node];
+    if ((int)v.size() <= b) v.resize(dag.blocks.size(), 0);
+    if ((int)k.size() <= b) k.resize(dag.blocks.size(), 0);
+    v[b] = 1;
+    k[b] = 1;
+  }
+
+  bool is_visible(int node, int b) const {
+    return b < (int)visible[node].size() && visible[node][b];
+  }
+
+  bool parents_visible(int node, int b) const {
+    for (int p : dag.blocks[b].parents)
+      if (!is_visible(node, p)) return false;
+    return true;
+  }
+
+  void schedule_activation() {
+    double dt = std::exponential_distribution<>(1.0 / activation_delay)(rng);
+    push(now + dt, 0, -1, -1);
+  }
+
+  int sample_miner() {
+    double total = 0;
+    for (double c : compute) total += c;
+    double r = rand_u() * total, acc = 0;
+    for (int i = 0; i < n_nodes; i++) {
+      acc += compute[i];
+      if (r <= acc) return i;
+    }
+    return n_nodes - 1;
+  }
+
+  void send(int src, int b) {  // share a block on all links
+    for (int dst = 0; dst < n_nodes; dst++) {
+      if (dst == src) continue;
+      double d = delay[src][dst];
+      if (d < 0) d = rand_u() * attacker_delay_upper;
+      push(now + d, 1, dst, b);
+    }
+  }
+
+  // deliver b (parents-visible) to node, then its unlocked descendants
+  void deliver(int node, int b);
+  void handle_honest(int node, int b);
+  void handle_agent(int b, bool is_pow);
+
+  int append_pow(int miner, Block b) {
+    b.miner = miner;
+    b.pow_hash = rand_u();
+    b.time = now;
+    return dag.add(std::move(b));
+  }
+
+  // append-or-dedup for non-PoW proposals
+  int append_plain(int miner, Block b) {
+    std::string key;
+    key.reserve(b.parents.size() * 4 + 16);
+    for (int p : b.parents) key += std::to_string(p) + ",";
+    key += "|" + std::to_string(b.vote_id) + "|" + std::to_string(b.height);
+    auto it = dedup.find(key);
+    if (it != dedup.end()) return it->second;
+    b.miner = miner;
+    b.time = now;
+    int id = dag.add(std::move(b));
+    dedup[key] = id;
+    return id;
+  }
+
+  void step_event();
+  void run(long n_activations);
+};
+
+// ------------------------------------------------------------- nakamoto
+
+struct Nakamoto final : Protocol {
+  Block genesis() const override { return Block{}; }
+
+  Block draft(Sim&, int, int preferred) override {
+    Block b;
+    b.parents = {preferred};
+    return b;  // height set by caller context
+  }
+
+  int prefer(Sim& s, int, int old, int b) override {
+    return s.dag.blocks[b].height > s.dag.blocks[old].height ? b : old;
+  }
+
+  double progress(const Dag& d, int head) const override {
+    return d.blocks[head].height;
+  }
+
+  void rewards(const Dag& d, int head,
+               std::vector<double>& per_miner) const override {
+    for (int b = head; d.blocks[b].miner >= 0; b = d.blocks[b].parents[0])
+      per_miner[d.blocks[b].miner] += 1.0;
+  }
+
+  long on_chain(const Dag& d, int head) const override {
+    return d.blocks[head].height;
+  }
+
+  int winner(Sim& s, const std::vector<int>& prefs) override {
+    int best = prefs[0];
+    for (int p : prefs)
+      if (s.dag.blocks[p].height > s.dag.blocks[best].height) best = p;
+    return best;
+  }
+};
+
+// ------------------------------------------------------------- ethereum
+
+struct Ethereum final : Protocol {
+  // ethereum.ml preset semantics (ethereum.ml:12-24,74-83): the
+  // whitepaper preset prefers by cumulative work and progresses by
+  // height; byzantium prefers by height, progresses by work, caps
+  // uncles at 2 and discounts uncle rewards.
+  bool byzantium;
+  explicit Ethereum(bool byz) : byzantium(byz) {}
+
+  int pref_key(const Dag& d, int b) const {
+    return byzantium ? d.blocks[b].height : d.blocks[b].work;
+  }
+
+  Block genesis() const override { return Block{}; }
+
+  // non-uncle ancestors of `tip` up to 6 generations + in-chain set
+  void chain_window(const Dag& d, int tip, std::vector<int>& ancestors,
+                    std::vector<int>& in_chain) const {
+    ancestors.clear();
+    in_chain.clear();
+    in_chain.push_back(tip);
+    int b = tip;
+    for (int gen = 0; gen < 6 && !d.blocks[b].parents.empty(); gen++) {
+      const auto& ps = d.blocks[b].parents;
+      ancestors.push_back(ps[0]);
+      for (int p : ps) in_chain.push_back(p);
+      b = ps[0];
+    }
+  }
+
+  Block draft(Sim& s, int node, int preferred) override {
+    const Dag& d = s.dag;
+    std::vector<int> anc, chain;
+    chain_window(d, preferred, anc, chain);
+    std::vector<int> uncles;
+    for (int a : anc) {
+      for (int c : d.blocks[a].children) {
+        if (!s.is_visible(node, c)) continue;
+        if (std::find(chain.begin(), chain.end(), c) != chain.end())
+          continue;
+        if (d.blocks[c].parents.empty()) continue;
+        int cp = d.blocks[c].parents[0];
+        if (std::find(anc.begin(), anc.end(), cp) == anc.end()) continue;
+        uncles.push_back(c);
+      }
+    }
+    // own uncles first, older (lower preference key) first
+    std::stable_sort(uncles.begin(), uncles.end(), [&](int a, int b) {
+      bool am = d.blocks[a].miner == node, bm = d.blocks[b].miner == node;
+      if (am != bm) return am;
+      return pref_key(d, a) < pref_key(d, b);
+    });
+    if (byzantium && uncles.size() > 2) uncles.resize(2);
+    Block b;
+    b.parents = {preferred};
+    b.parents.insert(b.parents.end(), uncles.begin(), uncles.end());
+    b.height = d.blocks[preferred].height + 1;
+    b.work = d.blocks[preferred].work + 1 + (int)uncles.size();
+    return b;
+  }
+
+  int prefer(Sim& s, int, int old, int b) override {
+    return pref_key(s.dag, b) > pref_key(s.dag, old) ? b : old;
+  }
+
+  double progress(const Dag& d, int head) const override {
+    return byzantium ? d.blocks[head].work : d.blocks[head].height;
+  }
+
+  void rewards(const Dag& d, int head,
+               std::vector<double>& per_miner) const override {
+    for (int b = head; d.blocks[b].miner >= 0; b = d.blocks[b].parents[0]) {
+      const auto& blk = d.blocks[b];
+      int nu = (int)blk.parents.size() - 1;
+      per_miner[blk.miner] += 1.0 + nu * 0.03125;
+      for (size_t i = 1; i < blk.parents.size(); i++) {
+        const auto& u = d.blocks[blk.parents[i]];
+        if (u.miner < 0) continue;
+        double amt = byzantium
+            ? (8.0 - (blk.height - u.height)) / 8.0  // discount
+            : 0.9375;                                // constant
+        per_miner[u.miner] += amt;
+      }
+    }
+  }
+
+  long on_chain(const Dag& d, int head) const override {
+    long n = 0;
+    for (int b = head; d.blocks[b].miner >= 0; b = d.blocks[b].parents[0])
+      n += (long)d.blocks[b].parents.size();  // block + its uncles
+    return n;
+  }
+
+  int winner(Sim& s, const std::vector<int>& prefs) override {
+    int best = prefs[0];
+    for (int p : prefs)
+      if (pref_key(s.dag, p) > pref_key(s.dag, best)) best = p;
+    return best;
+  }
+};
+
+// ------------------------------------------------------------------- bk
+
+struct Bk final : Protocol {
+  int k;
+  bool reward_block;  // `Block scheme: signer gets k; `Constant: voters 1
+  Bk(int k_, bool rb) : k(k_), reward_block(rb) {}
+
+  Block genesis() const override { return Block{}; }
+
+  static int last_block(const Dag& d, int x) {
+    return d.blocks[x].is_vote ? d.blocks[x].parents[0] : x;
+  }
+
+  double leader_hash(const Dag& d, int blk) const {
+    // leader vote is parents[1] (parents[0] = predecessor block)
+    if (d.blocks[blk].parents.size() >= 2)
+      return d.blocks[d.blocks[blk].parents[1]].pow_hash;
+    return 2.0;  // genesis: max
+  }
+
+  Block draft(Sim& s, int node, int preferred) override {
+    Block b;  // a vote on the preferred block
+    b.parents = {preferred};
+    b.is_vote = true;
+    b.vote_id = node;
+    b.height = s.dag.blocks[preferred].height;
+    return b;
+  }
+
+  // (height, confirming votes, -leader hash) lexicographic preference
+  bool better(Sim& s, int node, int a, int b) const {
+    const Dag& d = s.dag;
+    if (d.blocks[a].height != d.blocks[b].height)
+      return d.blocks[a].height > d.blocks[b].height;
+    int va = 0, vb = 0;
+    for (int c : d.blocks[a].children)
+      if (d.blocks[c].is_vote && s.is_visible(node, c)) va++;
+    for (int c : d.blocks[b].children)
+      if (d.blocks[c].is_vote && s.is_visible(node, c)) vb++;
+    if (va != vb) return va > vb;
+    return leader_hash(d, a) < leader_hash(d, b);
+  }
+
+  int prefer(Sim& s, int node, int old, int x) override {
+    int b = last_block(s.dag, x);
+    return better(s, node, b, old) ? b : old;
+  }
+
+  std::vector<Block> proposals(Sim& s, int node, int x) override {
+    const Dag& d = s.dag;
+    int b = last_block(d, x);
+    // visible confirming votes, split mine/theirs (bk.ml quorum :233-279)
+    double my_hash = 2.0, replace_hash = 2.0;
+    std::vector<int> mine, theirs;
+    for (int c : d.blocks[b].children) {
+      if (!s.is_visible(node, c)) continue;
+      if (d.blocks[c].is_vote) {
+        if (d.blocks[c].vote_id == node) {
+          mine.push_back(c);
+          my_hash = std::min(my_hash, d.blocks[c].pow_hash);
+        } else {
+          theirs.push_back(c);
+        }
+      } else {
+        replace_hash = std::min(replace_hash, leader_hash(d, c));
+      }
+    }
+    if (replace_hash <= my_hash ||
+        (int)(mine.size() + theirs.size()) < k)
+      return {};
+    std::vector<int> q;
+    auto by_hash = [&](int a, int c) {
+      return d.blocks[a].pow_hash < d.blocks[c].pow_hash;
+    };
+    if ((int)mine.size() >= k) {
+      std::sort(mine.begin(), mine.end(), by_hash);
+      q.assign(mine.begin(), mine.begin() + k);
+    } else {
+      // theirs with hash above my best, earliest-seen first
+      std::vector<int> cand;
+      for (int t : theirs)
+        if (d.blocks[t].pow_hash > my_hash) cand.push_back(t);
+      if ((int)(mine.size() + cand.size()) < k) return {};
+      std::stable_sort(cand.begin(), cand.end(), [&](int a, int c) {
+        return d.blocks[a].time < d.blocks[c].time;
+      });
+      cand.resize(k - mine.size());
+      q = mine;
+      q.insert(q.end(), cand.begin(), cand.end());
+      std::sort(q.begin(), q.end(), by_hash);
+    }
+    Block prop;
+    prop.parents = {b};
+    prop.parents.insert(prop.parents.end(), q.begin(), q.end());
+    prop.height = d.blocks[b].height + 1;
+    prop.vote_id = d.blocks[q[0]].vote_id;  // leader signs
+    return {prop};
+  }
+
+  double progress(const Dag& d, int head) const override {
+    return (double)d.blocks[head].height * k;
+  }
+
+  void rewards(const Dag& d, int head,
+               std::vector<double>& per_miner) const override {
+    for (int b = head; !d.blocks[b].parents.empty();
+         b = d.blocks[b].parents[0]) {
+      const auto& blk = d.blocks[b];
+      if (reward_block) {
+        if (blk.vote_id >= 0) per_miner[blk.vote_id] += (double)k;
+      } else {
+        for (size_t i = 1; i < blk.parents.size(); i++) {
+          const auto& v = d.blocks[blk.parents[i]];
+          if (v.is_vote && v.vote_id >= 0) per_miner[v.vote_id] += 1.0;
+        }
+      }
+    }
+  }
+
+  long on_chain(const Dag& d, int head) const override {
+    // each chain block carries k votes + itself (genesis excluded)
+    return (long)d.blocks[head].height * (k + 1);
+  }
+
+  int winner(Sim& s, const std::vector<int>& prefs) override {
+    // referee compare: (height, confirming votes) over full visibility
+    const Dag& d = s.dag;
+    auto votes_all = [&](int b) {
+      int n = 0;
+      for (int c : d.blocks[b].children)
+        if (d.blocks[c].is_vote) n++;
+      return n;
+    };
+    int best = prefs[0];
+    for (int p : prefs) {
+      if (d.blocks[p].height > d.blocks[best].height ||
+          (d.blocks[p].height == d.blocks[best].height &&
+           votes_all(p) > votes_all(best)))
+        best = p;
+    }
+    return best;
+  }
+};
+
+// ------------------------------------------- nakamoto withholding agent
+
+// Clean-room SSZ'16 state machine (nakamoto_ssz.ml:156-350): the attacker
+// (node 0) tracks a private tip and a simulated defender ("public") view;
+// a policy maps {public_blocks, private_blocks, diff_blocks, event} to
+// Adopt/Override/Match/Wait.
+struct NakAgent {
+  int policy;  // 0 honest, 1 eyal-sirer-2014, 2 sapirshtein-2016-sm1
+  int priv, pub;
+
+  void init(int g) { priv = pub = g; }
+
+  static int common_height(const Dag& d, int a, int b) {
+    while (a != b) {
+      if (d.blocks[a].height >= d.blocks[b].height)
+        a = d.blocks[a].parents[0];
+      else
+        b = d.blocks[b].parents[0];
+    }
+    return d.blocks[a].height;
+  }
+
+  int act(int pub_blocks, int priv_blocks, bool pow_event) const {
+    (void)pow_event;
+    enum { ADOPT, OVERRIDE, MATCH, WAIT };
+    int h = pub_blocks, a = priv_blocks;
+    switch (policy) {
+      case 0:  // honest
+        return a > h ? OVERRIDE : (a < h ? ADOPT : WAIT);
+      case 1:  // ES'14 (nakamoto_ssz.ml:295-320)
+        if (a < h) return ADOPT;
+        if (h == 0 && a == 1) return WAIT;
+        if (h == 1 && a == 1) return MATCH;
+        if (h == 1 && a == 2) return OVERRIDE;
+        if (h > 0) return (a - h == 1) ? OVERRIDE : MATCH;
+        return WAIT;
+      default:  // SM1 (nakamoto_ssz.ml:325-341)
+        if (h > a) return ADOPT;
+        if (h == 1 && a == 1) return MATCH;
+        if (h == a - 1 && h >= 1) return OVERRIDE;
+        return WAIT;
+    }
+  }
+
+  // returns blocks to share; updates priv/pub
+  std::vector<int> handle(Sim& s, int b, bool is_pow) {
+    Dag& d = s.dag;
+    if (is_pow)
+      priv = b;  // mined on private chain
+    else if (d.blocks[b].height > d.blocks[pub].height)
+      pub = b;  // simulated defender follows longest chain
+    int ca = common_height(d, pub, priv);
+    int pub_blocks = d.blocks[pub].height - ca;
+    int priv_blocks = d.blocks[priv].height - ca;
+    enum { ADOPT, OVERRIDE, MATCH, WAIT };
+    int a = act(pub_blocks, priv_blocks, is_pow);
+    std::vector<int> share;
+    if (a == ADOPT) {
+      priv = pub;
+    } else if (a == OVERRIDE || a == MATCH) {
+      int target = d.blocks[pub].height + (a == OVERRIDE ? 1 : 0);
+      int x = priv;
+      while (d.blocks[x].height > target) x = d.blocks[x].parents[0];
+      share.push_back(x);
+      // releasing updates the simulated defender model at next event via
+      // pending messages; model it immediately like prepare() would
+      if (d.blocks[x].height > d.blocks[pub].height) pub = x;
+    }
+    return share;
+  }
+};
+
+// -------------------------------------------------------- sim internals
+
+void Sim::deliver(int node, int b) {
+  if (is_visible(node, b)) return;
+  mark_visible(node, b);
+  if (node == 0 && agent) {
+    handle_agent(b, false);
+  } else {
+    handle_honest(node, b);
+  }
+  // unlock buffered children (dependency-ordered delivery,
+  // simulator.ml:424-450); snapshot the child list first — recursive
+  // delivery can append proposal blocks, growing dag.blocks and the
+  // children vector under a live iterator
+  std::vector<int> kids = dag.blocks[b].children;
+  for (int c : kids) {
+    if (c < (int)known[node].size() && known[node][c] &&
+        !is_visible(node, c) && parents_visible(node, c))
+      deliver(node, c);
+  }
+}
+
+void Sim::handle_honest(int node, int b) {
+  preferred[node] = proto->prefer(*this, node, preferred[node], b);
+  for (Block& prop : proto->proposals(*this, node, b)) {
+    int id = append_plain(node, std::move(prop));
+    if (!is_visible(node, id)) {
+      mark_visible(node, id);
+      send(node, id);
+      preferred[node] = proto->prefer(*this, node, preferred[node], id);
+    }
+  }
+}
+
+void Sim::handle_agent(int b, bool is_pow) {
+  for (int x : agent->handle(*this, b, is_pow)) {
+    // release the chain up to x (parents must reach defenders too;
+    // sharing recursively covers withheld ancestors,
+    // simulator.ml:401-419)
+    std::vector<int> chain;
+    for (int y = x; dag.blocks[y].miner >= 0;
+         y = dag.blocks[y].parents[0]) {
+      bool withheld = false;
+      for (int n = 1; n < n_nodes; n++)
+        if (!is_visible(n, y)) withheld = true;
+      if (!withheld) break;
+      chain.push_back(y);
+    }
+    for (auto it = chain.rbegin(); it != chain.rend(); ++it) send(0, *it);
+  }
+  preferred[0] = agent->priv;
+}
+
+void Sim::step_event() {
+  Event e = queue.top();
+  queue.pop();
+  now = e.time;
+  if (e.type == 0) {  // activation
+    activations++;
+    int m = sample_miner();
+    int pref = (m == 0 && agent) ? agent->priv : preferred[m];
+    Block d = proto->draft(*this, m, pref);
+    if (!d.is_vote && d.height == 0)
+      d.height = dag.blocks[d.parents[0]].height + 1;  // nakamoto fill-in
+    int id = append_pow(m, std::move(d));
+    mark_visible(m, id);
+    if (m == 0 && agent) {
+      handle_agent(id, true);  // agent decides whether to share
+    } else {
+      handle_honest(m, id);
+      send(m, id);  // honest nodes share their blocks immediately
+    }
+    schedule_activation();
+  } else {  // receive
+    int node = e.node, b = e.block;
+    if ((int)known[node].size() <= b)
+      known[node].resize(dag.blocks.size(), 0);
+    if (known[node][b]) return;  // duplicate receipt
+    known[node][b] = 1;
+    if (parents_visible(node, b))
+      deliver(node, b);
+    // else: buffered; unlocked when parents become visible
+  }
+}
+
+void Sim::run(long n_activations) {
+  long target = activations + n_activations;
+  while (activations < target && !queue.empty()) step_event();
+  // drain in-flight messages so final metrics see a settled network
+  while (!queue.empty()) {
+    if (queue.top().type == 0) break;
+    step_event();
+  }
+}
+
+}  // namespace
+
+// ------------------------------------------------------------- C API
+
+extern "C" {
+
+struct Handle {
+  Sim sim;
+};
+
+void* cpr_oracle_create(const char* protocol, int k, const char* scheme,
+                        const char* topology, int n_nodes, double alpha,
+                        double gamma, int defenders,
+                        double activation_delay, double propagation_delay,
+                        const char* attacker_policy, uint64_t seed) {
+  auto* h = new Handle();
+  Sim& s = h->sim;
+  s.rng.seed(seed);
+  s.activation_delay = activation_delay;
+
+  std::string proto(protocol), topo(topology), sch(scheme ? scheme : "");
+  if (proto == "nakamoto") {
+    s.proto.reset(new Nakamoto());
+  } else if (proto == "ethereum-whitepaper") {
+    s.proto.reset(new Ethereum(false));
+  } else if (proto == "ethereum-byzantium") {
+    s.proto.reset(new Ethereum(true));
+  } else if (proto == "bk") {
+    s.proto.reset(new Bk(k, sch == "block"));
+  } else {
+    delete h;
+    return nullptr;
+  }
+
+  if (topo == "clique") {
+    s.n_nodes = n_nodes;
+    s.compute.assign(n_nodes, 1.0 / n_nodes);
+    s.delay.assign(n_nodes, std::vector<double>(n_nodes,
+                                                propagation_delay));
+  } else if (topo == "two_agents") {
+    s.n_nodes = 2;
+    s.compute = {alpha, 1.0 - alpha};
+    s.delay.assign(2, std::vector<double>(2, 0.0));
+  } else if (topo == "selfish_mining") {
+    // network.ml:61-105: attacker node 0; defenders split 1-alpha;
+    // attacker->defender delays uniform in [0, (d-1)/d * prop/gamma]
+    // emulate gamma; defender->attacker is instant.
+    int d = defenders >= 2 ? defenders : 2;
+    s.n_nodes = d + 1;
+    s.compute.assign(d + 1, (1.0 - alpha) / d);
+    s.compute[0] = alpha;
+    s.delay.assign(d + 1, std::vector<double>(d + 1, propagation_delay));
+    // gamma = 0 exactly would make the delay bound infinite, so that even
+    // Override releases never arrive — a degenerate corner of the
+    // delay-based emulation (the SSZ'16 model it emulates has overrides
+    // succeed at any gamma; gamma only decides Match races).  Flooring
+    // gamma keeps match races ~always lost while overrides still deliver.
+    double g = gamma > 1e-6 ? gamma : 1e-6;
+    s.attacker_delay_upper = (double)(d - 1) / d * propagation_delay / g;
+    for (int j = 0; j <= d; j++) {
+      s.delay[0][j] = -1.0;  // sentinel: sample uniform
+      s.delay[j][0] = 0.0;
+    }
+  } else {
+    delete h;
+    return nullptr;
+  }
+
+  std::string pol(attacker_policy ? attacker_policy : "");
+  if (!pol.empty() && pol != "none") {
+    if (proto != "nakamoto") {
+      delete h;
+      return nullptr;  // withholding agent implemented for nakamoto
+    }
+    s.agent.reset(new NakAgent());
+    s.agent->policy = pol == "honest" ? 0
+                      : pol == "eyal-sirer-2014" ? 1
+                      : 2;  // sapirshtein-2016-sm1
+  }
+
+  s.init();
+  if (s.agent) s.agent->init(0);
+  return h;
+}
+
+long cpr_oracle_run(void* hp, long activations) {
+  auto* h = static_cast<Handle*>(hp);
+  h->sim.run(activations);
+  return h->sim.activations;
+}
+
+// metrics: 0 reward_of(arg) | 1 progress | 2 sim_time | 3 n_blocks |
+// 4 head_height | 5 on_chain | 6 head_time
+double cpr_oracle_metric(void* hp, int what, int arg) {
+  auto* h = static_cast<Handle*>(hp);
+  Sim& s = h->sim;
+  int head = s.proto->winner(s, s.preferred);
+  switch (what) {
+    case 0: {
+      std::vector<double> per(s.n_nodes, 0.0);
+      s.proto->rewards(s.dag, head, per);
+      return (arg >= 0 && arg < s.n_nodes) ? per[arg] : 0.0;
+    }
+    case 1:
+      return s.proto->progress(s.dag, head);
+    case 2:
+      return s.now;
+    case 3:
+      return (double)s.dag.blocks.size() - 1;  // exclude genesis
+    case 4:
+      return (double)s.dag.blocks[head].height;
+    case 5:
+      return (double)s.proto->on_chain(s.dag, head);
+    case 6:
+      return s.dag.blocks[head].time;
+    case 7: {  // preferred height of node `arg` (diagnostics)
+      if (arg < 0 || arg >= s.n_nodes) return std::nan("");
+      int p = (arg == 0 && s.agent) ? s.agent->priv : s.preferred[arg];
+      return (double)s.dag.blocks[p].height;
+    }
+    default:
+      return std::nan("");
+  }
+}
+
+void cpr_oracle_destroy(void* hp) { delete static_cast<Handle*>(hp); }
+
+}  // extern "C"

@@ -1,0 +1,144 @@
+"""Cross-engine equivalence: JAX envs vs the C++ discrete-event oracle.
+
+The reference validates every model against an independent engine
+(generic_v1/test/test_network_sim.py, aft20barzur_test.py); here the
+collapsed 2-party JAX environments are checked against the multi-node
+event-queue simulator (cpr_tpu/native) on reward statistics over an
+(alpha, gamma) grid, and both engines are checked against the ES'14
+closed form.  Tolerances are statistical (Monte-Carlo on both sides).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from cpr_tpu.native import OracleSim
+from cpr_tpu.params import make_params
+
+
+def es2014_revenue(a, g):
+    return (a * (1 - a) ** 2 * (4 * a + g * (1 - 2 * a)) - a**3) / (
+        1 - a * (1 + (2 - a) * a))
+
+
+def oracle_share(protocol, *, alpha, gamma, policy, activations,
+                 seed=0, **kw):
+    s = OracleSim(protocol, topology="selfish_mining", alpha=alpha,
+                  gamma=gamma, attacker_policy=policy,
+                  propagation_delay=1e-9, seed=seed, **kw)
+    s.run(activations)
+    n = int(1 / (1 - gamma)) + 2 if gamma < 1 else 4
+    rw = s.rewards(max(n, 8))
+    return rw[0] / sum(rw)
+
+
+def jax_share(env, *, alpha, gamma, policy, n_envs=1024, max_steps=512):
+    params = make_params(alpha=alpha, gamma=gamma, max_steps=max_steps)
+    keys = jax.random.split(jax.random.PRNGKey(0), n_envs)
+    f = jax.jit(jax.vmap(lambda k: env.episode_stats(
+        k, params, env.policies[policy], max_steps + 8)))
+    stats = jax.block_until_ready(f(keys))
+    a = np.asarray(stats["episode_reward_attacker"]).mean()
+    d = np.asarray(stats["episode_reward_defender"]).mean()
+    return a / (a + d)
+
+
+def test_oracle_nakamoto_sm1_matches_closed_form():
+    a, g = 1 / 3, 0.5
+    share = oracle_share("nakamoto", alpha=a, gamma=g,
+                         policy="sapirshtein-2016-sm1", activations=60_000)
+    assert abs(share - es2014_revenue(a, g)) < 0.015, share
+
+
+@pytest.mark.parametrize("alpha,gamma", [(0.25, 0.5), (0.35, 0.5),
+                                         (0.4, 0.0)])
+def test_nakamoto_sm1_cross_engine(alpha, gamma):
+    """SM1 revenue: JAX env vs C++ oracle on the (alpha, gamma) grid."""
+    from cpr_tpu.envs.nakamoto import NakamotoSSZ
+
+    o = oracle_share("nakamoto", alpha=alpha, gamma=gamma,
+                     policy="sapirshtein-2016-sm1", activations=60_000)
+    j = jax_share(NakamotoSSZ(), alpha=alpha, gamma=gamma,
+                  policy="sapirshtein-2016-sm1")
+    assert abs(o - j) < 0.02, (alpha, gamma, o, j)
+
+
+@pytest.mark.parametrize("alpha", [0.25, 0.4])
+def test_nakamoto_honest_cross_engine(alpha):
+    from cpr_tpu.envs.nakamoto import NakamotoSSZ
+
+    o = oracle_share("nakamoto", alpha=alpha, gamma=0.5, policy="honest",
+                     activations=40_000)
+    j = jax_share(NakamotoSSZ(), alpha=alpha, gamma=0.5, policy="honest")
+    assert abs(o - alpha) < 0.01, o
+    assert abs(o - j) < 0.015, (o, j)
+
+
+def _two_agents_share(protocol, alpha, activations, seed=0, **kw):
+    s = OracleSim(protocol, topology="two_agents", alpha=alpha,
+                  activation_delay=1.0, seed=seed, **kw)
+    s.run(activations)
+    rw = s.rewards(2)
+    return rw[0] / sum(rw)
+
+
+def test_ethereum_honest_cross_engine():
+    """Honest-play reward share: JAX ethereum attack env vs oracle
+    two-party network (whitepaper uncles on both sides)."""
+    from cpr_tpu.envs.ethereum import EthereumSSZ
+
+    alpha = 0.3
+    o = _two_agents_share("ethereum-whitepaper", alpha, 30_000)
+    j = jax_share(EthereumSSZ("whitepaper", max_steps_hint=192),
+                  alpha=alpha, gamma=0.5, policy="honest",
+                  n_envs=256, max_steps=192)
+    assert abs(o - alpha) < 0.01, o
+    assert abs(j - alpha) < 0.02, j
+    assert abs(o - j) < 0.025, (o, j)
+
+
+def test_bk_honest_cross_engine():
+    from cpr_tpu.envs.bk import BkSSZ
+
+    alpha, k = 0.3, 8
+    o = _two_agents_share("bk", alpha, 40_000, k=k, scheme="constant")
+    j = jax_share(BkSSZ(k=k, incentive_scheme="constant",
+                        max_steps_hint=192),
+                  alpha=alpha, gamma=0.5, policy="honest",
+                  n_envs=256, max_steps=192)
+    assert abs(o - alpha) < 0.015, o
+    assert abs(j - alpha) < 0.02, j
+    assert abs(o - j) < 0.03, (o, j)
+
+
+def test_oracle_orphan_rates_by_difficulty():
+    """The reference's stochastic battery shape (cpr_protocols.ml:200-258):
+    orphan rate on a 7-node clique must be small at easy difficulty and
+    grow as the block interval approaches the propagation delay."""
+    rates = {}
+    for name, ad in [("easy", 600.0), ("real", 30.0), ("hard", 3.0)]:
+        s = OracleSim("nakamoto", topology="clique", n_nodes=7,
+                      activation_delay=ad, propagation_delay=1.0, seed=5)
+        s.run(3000)
+        rates[name] = 1.0 - s.metric("head_height") / s.metric("n_blocks")
+    assert rates["easy"] < 0.01, rates
+    assert rates["real"] < 0.05, rates
+    assert rates["easy"] <= rates["real"] <= rates["hard"], rates
+    assert rates["hard"] > 0.1, rates
+
+
+def test_oracle_clique_fairness():
+    """Equal-compute clique: each node's reward share ~ 1/n."""
+    s = OracleSim("nakamoto", topology="clique", n_nodes=5,
+                  activation_delay=100.0, propagation_delay=1.0, seed=6)
+    s.run(20_000)
+    rw = np.array(s.rewards(5))
+    np.testing.assert_allclose(rw / rw.sum(), 0.2, atol=0.02)
+
+
+def test_oracle_seeds_are_deterministic():
+    a = oracle_share("nakamoto", alpha=0.3, gamma=0.5, policy="honest",
+                     activations=5_000, seed=9)
+    b = oracle_share("nakamoto", alpha=0.3, gamma=0.5, policy="honest",
+                     activations=5_000, seed=9)
+    assert a == b
