@@ -1,0 +1,56 @@
+"""Assumption-aware env wrapper (jittable).
+
+Reference counterpart: AssumptionScheduleWrapper
+(gym/ocaml/cpr_gym/wrappers.py:172-242) — append the current (alpha,
+gamma) assumptions to the observation so one policy can generalize over
+them.  In the TPU design the schedule itself lives in the *batch*: each
+vmap lane carries its own EnvParams (see make_train per_env_params), and
+this wrapper only extends the observation with the lane's parameters.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from cpr_tpu.envs.base import JaxEnv
+from cpr_tpu.params import EnvParams
+
+
+class AssumptionEnv(JaxEnv):
+    def __init__(self, inner: JaxEnv):
+        self.inner = inner
+        self.n_actions = inner.n_actions
+        self.observation_length = inner.observation_length + 2
+        self.low = jnp.concatenate(
+            [jnp.asarray(inner.low), jnp.zeros(2)])
+        self.high = jnp.concatenate(
+            [jnp.asarray(inner.high), jnp.ones(2)])
+        self.policies = {
+            name: self._strip(fn) for name, fn in inner.policies.items()}
+
+    @staticmethod
+    def _strip(fn):
+        if getattr(fn, "takes_state", False):
+            def wrapped(state, obs):
+                return fn(state, obs[..., :-2])
+            wrapped.takes_state = True
+        else:
+            def wrapped(obs):
+                return fn(obs[..., :-2])
+        return wrapped
+
+    @staticmethod
+    def _extend(obs, params: EnvParams):
+        a = jnp.asarray(params.alpha, jnp.float32).reshape(())
+        g = jnp.asarray(params.gamma, jnp.float32).reshape(())
+        return jnp.concatenate(
+            [obs, jnp.stack([a, g]).astype(obs.dtype)])
+
+    def reset(self, key, params: EnvParams):
+        state, obs = self.inner.reset(key, params)
+        return state, self._extend(obs, params)
+
+    def step(self, state, action, params: EnvParams):
+        state, obs, reward, done, info = self.inner.step(
+            state, action, params)
+        return state, self._extend(obs, params), reward, done, info

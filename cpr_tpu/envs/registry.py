@@ -42,6 +42,32 @@ def keys():
     return sorted(_REGISTRY)
 
 
+def get_sized(key: str, max_steps_hint: int, **kwargs):
+    """get() with a capacity hint, dropped for envs that don't plan
+    capacity (e.g. nakamoto's closed-form scalar state).  Signature
+    inspection (not try/except) decides, so constructor-internal
+    TypeErrors still surface."""
+    import inspect
+
+    _ensure_builtin()
+    factory = _REGISTRY.get(key)
+    if factory is None:
+        family, _ = parse_key(key)
+        factory = _REGISTRY.get(family)
+    takes_hint = False
+    if factory is not None:
+        try:
+            sig = inspect.signature(factory)
+            takes_hint = "max_steps_hint" in sig.parameters or any(
+                p.kind is inspect.Parameter.VAR_KEYWORD
+                for p in sig.parameters.values())
+        except (TypeError, ValueError):
+            takes_hint = True
+    if takes_hint:
+        return get(key, max_steps_hint=max_steps_hint, **kwargs)
+    return get(key, **kwargs)
+
+
 def parse_key(key: str):
     """Parse a reference-style protocol key (cpr_protocols.ml:786-903):
 

@@ -1,0 +1,21 @@
+"""Experiment drivers: sweeps, TSV output, break-even search.
+
+Reference counterpart: experiments/ — the csv_runner task farm
+(simulate/csv_runner.ml:61-143), honest_net (simulate/honest_net.ml),
+withholding (simulate/withholding.ml), and the rl-eval break-even search
+(rl-eval/break_even.py:13-50).
+
+TPU re-design: where the reference forks a process per simulation task
+(Parany), the JAX sweeps batch the whole parameter grid into one vmap'd
+kernel; the multi-node honest-network studies run on the C++ oracle
+engine (cpr_tpu.native), which plays the role of the reference's
+compiled simulator.
+"""
+
+from cpr_tpu.experiments.sweep import write_tsv
+from cpr_tpu.experiments.honest_net import honest_net_rows
+from cpr_tpu.experiments.withholding import withholding_rows
+from cpr_tpu.experiments.break_even import break_even
+
+__all__ = ["write_tsv", "honest_net_rows", "withholding_rows",
+           "break_even"]

@@ -1,0 +1,94 @@
+"""Break-even search: the smallest alpha where an attack beats honesty.
+
+Reference counterpart: experiments/rl-eval/break_even.py:13-50 — skopt
+Gaussian-process minimization of |revenue(alpha)/alpha - 1| with
+joblib.Memory caching.  skopt is unavailable here, and the objective
+excess(alpha) = revenue(alpha)/alpha - 1 is monotone increasing for the
+withholding policies studied, so a Monte-Carlo bisection finds the root
+directly; each evaluation is one vmap'd batched kernel, and results are
+memoized on disk keyed by the evaluation parameters.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+
+import jax
+import numpy as np
+
+import cpr_tpu
+from cpr_tpu.envs.registry import get_sized
+from cpr_tpu.params import make_params
+
+# override with CPR_TPU_CACHE; delete the directory to bust the cache
+_CACHE_DIR = os.environ.get(
+    "CPR_TPU_CACHE",
+    os.path.join(os.path.expanduser("~"), ".cache", "cpr_tpu",
+                 "break_even"))
+
+
+def _cached(key: dict, compute):
+    os.makedirs(_CACHE_DIR, exist_ok=True)
+    # the package version salts the key so env/policy fixes invalidate
+    # cached revenues (bump __version__ when semantics change)
+    key = dict(key, _version=cpr_tpu.__version__)
+    h = hashlib.sha256(
+        json.dumps(key, sort_keys=True).encode()).hexdigest()[:24]
+    path = os.path.join(_CACHE_DIR, h + ".json")
+    if os.path.exists(path):
+        with open(path) as f:
+            return json.load(f)["value"]
+    value = compute()
+    with open(path, "w") as f:
+        json.dump({"key": key, "value": value}, f)
+    return value
+
+
+def revenue(protocol_key: str, policy: str, *, alpha: float, gamma: float,
+            episode_len: int = 256, reps: int = 512, seed: int = 0,
+            cache: bool = True) -> float:
+    """Mean attacker relative revenue of `policy` at (alpha, gamma)."""
+    key = dict(protocol=protocol_key, policy=policy, alpha=alpha,
+               gamma=gamma, episode_len=episode_len, reps=reps, seed=seed)
+
+    def compute():
+        env = get_sized(protocol_key, episode_len)
+        params = make_params(alpha=alpha, gamma=gamma,
+                             max_steps=episode_len)
+        keys = jax.random.split(jax.random.PRNGKey(seed), reps)
+        fn = jax.jit(jax.vmap(lambda k: env.episode_stats(
+            k, params, env.policies[policy], episode_len + 8)))
+        stats = jax.block_until_ready(fn(keys))
+        a = float(np.asarray(stats["episode_reward_attacker"]).mean())
+        d = float(np.asarray(stats["episode_reward_defender"]).mean())
+        return a / (a + d) if (a + d) else 0.0
+
+    return _cached(key, compute) if cache else compute()
+
+
+def break_even(protocol_key: str, policy: str, *, gamma: float,
+               support=(0.1, 0.5), tol: float = 0.005,
+               episode_len: int = 256, reps: int = 512,
+               seed: int = 0) -> float:
+    """Bisection root of excess(alpha) = revenue/alpha - 1 over
+    `support`; returns the break-even alpha (clipped to the support
+    bounds when the policy is never/always profitable there)."""
+    lo, hi = support
+
+    def excess(a):
+        return revenue(protocol_key, policy, alpha=a, gamma=gamma,
+                       episode_len=episode_len, reps=reps, seed=seed) / a - 1.0
+
+    if excess(lo) > 0:
+        return lo
+    if excess(hi) < 0:
+        return hi
+    while hi - lo > tol:
+        mid = 0.5 * (lo + hi)
+        if excess(mid) > 0:
+            hi = mid
+        else:
+            lo = mid
+    return 0.5 * (lo + hi)

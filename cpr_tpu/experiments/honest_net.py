@@ -1,0 +1,65 @@
+"""Honest-network sweep on the multi-node oracle engine.
+
+Reference counterpart: experiments/simulate/honest_net.ml:4-49 — honest
+10-node cliques, protocols x activation delays, orphan-rate and
+efficiency rows into TSV.  The reference farms tasks over processes
+(csv_runner.ml:105-131); the oracle is C++ and single tasks are fast, so
+a plain loop suffices — rows carry `machine_duration_s` like the
+reference's Mtime counter (csv_runner.ml:65,76).
+"""
+
+from __future__ import annotations
+
+import time
+
+from cpr_tpu.native import OracleSim
+
+DEFAULT_PROTOCOLS = (
+    ("nakamoto", {}),
+    ("ethereum-whitepaper", {}),
+    ("ethereum-byzantium", {}),
+    ("bk", dict(k=4, scheme="constant")),
+    ("bk", dict(k=8, scheme="constant")),
+    ("bk", dict(k=8, scheme="block")),
+)
+
+DEFAULT_ACTIVATION_DELAYS = (30.0, 60.0, 120.0, 300.0, 600.0)
+
+
+def honest_net_rows(protocols=DEFAULT_PROTOCOLS,
+                    activation_delays=DEFAULT_ACTIVATION_DELAYS,
+                    *, n_nodes: int = 10, n_activations: int = 10_000,
+                    propagation_delay: float = 1.0, seed: int = 0):
+    """One row per (protocol, activation_delay) honest clique run."""
+    rows = []
+    for proto, kw in protocols:
+        for ad in activation_delays:
+            t0 = time.time()
+            s = OracleSim(proto, topology="clique", n_nodes=n_nodes,
+                          activation_delay=ad,
+                          propagation_delay=propagation_delay,
+                          seed=seed, **kw)
+            s.run(n_activations)
+            rewards = s.rewards(n_nodes)
+            n_blocks = s.metric("n_blocks")
+            on_chain = s.metric("on_chain")
+            rows.append({
+                "network": f"honest_clique_{n_nodes}",
+                "protocol": proto,
+                "k": kw.get("k", 1),
+                "incentive_scheme": kw.get("scheme", "constant"),
+                "activation_delay": ad,
+                "activations": n_activations,
+                "sim_time": s.metric("sim_time"),
+                "head_height": s.metric("head_height"),
+                "head_progress": s.metric("progress"),
+                "n_blocks": n_blocks,
+                "on_chain": on_chain,
+                "orphan_rate": 1.0 - on_chain / max(n_blocks, 1.0),
+                "reward_total": sum(rewards),
+                "reward_min": min(rewards),
+                "reward_max": max(rewards),
+                "machine_duration_s": time.time() - t0,
+            })
+            s.close()
+    return rows

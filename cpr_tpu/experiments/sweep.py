@@ -1,0 +1,42 @@
+"""TSV sweep output.
+
+Reference counterpart: the csv_runner row collection and `Info.pp_rows`
+TSV printer (experiments/simulate/csv_runner.ml:16-29, lib/info.ml:26-60):
+rows are typed key-value dicts; the writer unions all keys into one
+header and prints row-major TSV, empty cells for missing keys.
+"""
+
+from __future__ import annotations
+
+import io
+from typing import Iterable
+
+
+def _fmt(v) -> str:
+    if v is None:
+        return ""
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, float):
+        return f"{v:.6g}"
+    return str(v)
+
+
+def write_tsv(rows: Iterable[dict], path: str | None = None) -> str:
+    """Serialize dict rows to TSV (union of keys, first-seen order).
+    Writes to `path` when given; returns the TSV text either way."""
+    rows = list(rows)
+    cols: list[str] = []
+    for r in rows:
+        for k in r:
+            if k not in cols:
+                cols.append(k)
+    buf = io.StringIO()
+    buf.write("\t".join(cols) + "\n")
+    for r in rows:
+        buf.write("\t".join(_fmt(r.get(c)) for c in cols) + "\n")
+    text = buf.getvalue()
+    if path is not None:
+        with open(path, "w") as f:
+            f.write(text)
+    return text

@@ -1,0 +1,74 @@
+"""Withholding-attack sweeps as batched TPU kernels.
+
+Reference counterpart: experiments/simulate/withholding.ml:4-99 — fixed
+attack policies evaluated over alpha x gamma grids.  The reference runs
+one simulation process per grid point (Parany fork farm,
+csv_runner.ml:105-131); here the WHOLE grid for one (protocol, policy)
+pair is a single vmap'd `episode_stats` kernel: EnvParams is a PyTree of
+scalars, so stacking the grid into leading axes and vmapping over
+(key, params) turns the sweep into one XLA program per policy.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cpr_tpu.envs.registry import get_sized
+from cpr_tpu.params import stack_params
+
+DEFAULT_ALPHAS = (0.1, 0.2, 0.25, 0.33, 0.4, 0.45, 0.5)
+DEFAULT_GAMMAS = (0.0, 0.5, 0.75, 0.9)
+
+
+def _stack_params(grid, max_steps):
+    return stack_params([dict(alpha=a, gamma=g, max_steps=max_steps)
+                         for a, g in grid])
+
+
+def withholding_rows(protocol_key: str, policies=None, *,
+                     alphas=DEFAULT_ALPHAS, gammas=DEFAULT_GAMMAS,
+                     episode_len: int = 256, reps: int = 128,
+                     seed: int = 0, env_kwargs=None):
+    """One row per (policy, alpha, gamma); all grid points and reps of a
+    policy run as one batched kernel."""
+    env = get_sized(protocol_key, episode_len, **(env_kwargs or {}))
+    if policies is None:
+        policies = list(env.policies)
+    grid = [(a, g) for a in alphas for g in gammas]
+    params = _stack_params(grid, episode_len)
+    keys = jax.random.split(
+        jax.random.PRNGKey(seed), (len(grid), reps))
+
+    rows = []
+    for pol in policies:
+        t0 = time.time()
+        fn = jax.jit(jax.vmap(jax.vmap(
+            lambda k, p: env.episode_stats(
+                k, p, env.policies[pol], episode_len + 8),
+            in_axes=(0, None)), in_axes=(0, 0)))
+        stats = jax.block_until_ready(fn(keys, params))
+        dt = time.time() - t0
+        atk = np.asarray(stats["episode_reward_attacker"]).mean(axis=1)
+        dfn = np.asarray(stats["episode_reward_defender"]).mean(axis=1)
+        prg = np.asarray(stats["episode_progress"]).mean(axis=1)
+        for i, (a, g) in enumerate(grid):
+            total = atk[i] + dfn[i]
+            rows.append({
+                "protocol": protocol_key,
+                "attack": f"{protocol_key}-{pol}",
+                "alpha": a,
+                "gamma": g,
+                "episode_len": episode_len,
+                "reps": reps,
+                "reward_attacker": float(atk[i]),
+                "reward_defender": float(dfn[i]),
+                "relative_reward": float(atk[i] / total) if total else 0.0,
+                "reward_per_progress":
+                    float(atk[i] / prg[i]) if prg[i] else 0.0,
+                "machine_duration_s": dt / len(grid),
+            })
+    return rows

@@ -45,14 +45,10 @@ class Core(gymnasium.Env):
             raise ParameterError(
                 "set at least one of max_steps, max_progress, max_time")
         if isinstance(proto, str):
-            if max_steps is not None:
-                proto_kwargs.setdefault("max_steps_hint", int(max_steps))
-            try:
-                proto = registry.get(proto, **proto_kwargs)
-            except TypeError:
-                # envs without capacity planning (e.g. nakamoto) don't
-                # take max_steps_hint
-                proto_kwargs.pop("max_steps_hint", None)
+            if max_steps is not None and "max_steps_hint" not in proto_kwargs:
+                proto = registry.get_sized(proto, int(max_steps),
+                                           **proto_kwargs)
+            else:
                 proto = registry.get(proto, **proto_kwargs)
         self.jax_env: JaxEnv = proto
         # mutable parameter record, re-read on every reset — wrappers

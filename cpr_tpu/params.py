@@ -102,3 +102,13 @@ def make_params(
         max_progress=jnp.float32(max_progress),
         max_time=jnp.float32(max_time),
     )
+
+
+def stack_params(kwargs_list) -> EnvParams:
+    """Stack many make_params(**kwargs) into one EnvParams whose leaves
+    carry a leading axis — the batched form consumed by vmap'd sweeps
+    and per-lane schedule training."""
+    import jax
+
+    ps = [make_params(**kw) for kw in kwargs_list]
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *ps)

@@ -1,0 +1,100 @@
+"""YAML training configuration model.
+
+Reference counterpart: experiments/train/cfg_model/__init__.py:12-137 —
+pydantic config with protocol variant, alpha schedules (fixed / list /
+range), env + PPO + eval blocks, parsed from YAML files
+(experiments/train/configs/*.yaml).  Protocols here are addressed by the
+registry key grammar ("nakamoto", "tailstorm-8-discount-heuristic", ...)
+instead of a parallel class hierarchy.
+"""
+
+from __future__ import annotations
+
+from typing import List, Literal, Union
+
+import numpy as np
+import yaml
+from pydantic import BaseModel, field_validator
+
+
+class Range(BaseModel):
+    min: float
+    max: float
+
+
+Alpha = Union[float, List[float], Range]
+
+
+class PPOBlock(BaseModel):
+    lr: float = 3e-4
+    gamma: float = 0.99
+    gae_lambda: float = 0.95
+    clip_eps: float = 0.2
+    ent_coef: float = 0.01
+    vf_coef: float = 0.5
+    n_steps: int = 128
+    n_minibatches: int = 4
+    update_epochs: int = 4
+    n_layers: int = 2
+    layer_size: int = 64
+    anneal_lr: bool = False
+
+
+class EvalBlock(BaseModel):
+    # evaluate every `freq` updates, skipping the first
+    # `start_at_iteration` (cfg_model/__init__.py:80-105)
+    freq: int = 10
+    start_at_iteration: int = 1
+    alpha_step: float = 0.025
+    episodes_per_alpha: int = 64
+
+
+class TrainConfig(BaseModel):
+    protocol: str = "nakamoto"
+    alpha: Alpha = 0.33
+    gamma: float = 0.5
+    episode_len: int = 128
+    reward: Literal["sparse_relative", "sparse_per_progress"] = \
+        "sparse_relative"
+    shape: Literal["raw", "cut", "exp"] = "raw"
+    n_envs: int = 256
+    total_updates: int = 200
+    seed: int = 0
+    ppo: PPOBlock = PPOBlock()
+    eval: EvalBlock = EvalBlock()
+
+    @field_validator("gamma")
+    @classmethod
+    def _gamma_range(cls, v):
+        if not 0.0 <= v < 1.0:
+            raise ValueError("gamma must be in [0, 1)")
+        return v
+
+    @classmethod
+    def from_yaml(cls, path: str) -> "TrainConfig":
+        with open(path) as f:
+            return cls.model_validate(yaml.safe_load(f))
+
+    # -- schedule helpers ------------------------------------------------
+
+    def alpha_is_scheduled(self) -> bool:
+        return not isinstance(self.alpha, float)
+
+    def lane_alphas(self, n: int) -> np.ndarray:
+        """Per-env-lane alphas covering the schedule (the batched analog
+        of per-reset schedule draws)."""
+        if isinstance(self.alpha, float):
+            return np.full(n, self.alpha)
+        if isinstance(self.alpha, Range):
+            return np.linspace(self.alpha.min, self.alpha.max, n)
+        return np.asarray(
+            [self.alpha[i % len(self.alpha)] for i in range(n)])
+
+    def eval_alphas(self) -> np.ndarray:
+        if isinstance(self.alpha, float):
+            return np.asarray([self.alpha])
+        if isinstance(self.alpha, Range):
+            n = max(2, int(round(
+                (self.alpha.max - self.alpha.min) / self.eval.alpha_step)) + 1)
+            return np.linspace(self.alpha.min, self.alpha.max, n)
+        return np.asarray(sorted(set(self.alpha)))

@@ -1,0 +1,205 @@
+"""Config-driven PPO training: schedules, per-alpha eval, checkpoints.
+
+Reference counterpart: experiments/train/ppo.py — alpha schedules
+(:105-141), reward shaping raw/cut/exp (:217-244), the per-alpha
+EvalCallback aggregation (:296-374), and model.zip / best-model.zip /
+last-model.zip checkpoints (:429-453).  sb3 + SubprocVecEnv become the
+native JAX trainer over one vmap'd env batch whose lanes carry the
+schedule (make_train per_env_params); checkpoints are flax-serialized
+parameter files.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import serialization
+
+from cpr_tpu.envs.registry import get_sized
+from cpr_tpu.envs.assumption import AssumptionEnv
+from cpr_tpu.params import stack_params
+from cpr_tpu.train.config import TrainConfig
+from cpr_tpu.train.ppo import ActorCritic, PPOConfig, make_train
+
+
+def _stack_params(alphas, gamma, episode_len):
+    return stack_params([dict(alpha=float(a), gamma=gamma,
+                              max_steps=episode_len) for a in alphas])
+
+
+def make_reward_transform(cfg: TrainConfig, lane_alphas) -> Callable:
+    """Sparse objective + shaping + 1/alpha normalization
+    (ppo.py:217-244; wrappers.py:8-51)."""
+    alphas = jnp.asarray(lane_alphas, jnp.float32)
+
+    def transform(reward, info, done):
+        a = info["episode_reward_attacker"]
+        d = info["episode_reward_defender"]
+        p = info["episode_progress"]
+        if cfg.reward == "sparse_relative":
+            s = a + d
+            base = jnp.where(s != 0, a / jnp.where(s != 0, s, 1.0), 0.0)
+        else:  # sparse_per_progress
+            base = jnp.where(p != 0, a / jnp.where(p != 0, p, 1.0), 0.0)
+        if cfg.shape == "cut":
+            # punish honest-looking behaviour (ppo.py:224-236): no
+            # orphans means the episode was ~honest, scale by 0.9
+            orphans = jnp.where(
+                p > 0, info["episode_n_activations"] / p, jnp.inf)
+            base = jnp.where((base > 0) & (orphans <= 1.05),
+                             base * 0.9, base)
+        elif cfg.shape == "exp":
+            base = jnp.where(base > 0, jnp.exp(base - 1.0), 0.0)
+        return jnp.where(done, base / alphas, 0.0)
+
+    return transform
+
+
+def ppo_config(cfg: TrainConfig) -> PPOConfig:
+    p = cfg.ppo
+    return PPOConfig(
+        n_envs=cfg.n_envs, n_steps=p.n_steps, lr=p.lr, gamma=p.gamma,
+        gae_lambda=p.gae_lambda, clip_eps=p.clip_eps,
+        entropy_coef=p.ent_coef, vf_coef=p.vf_coef,
+        update_epochs=p.update_epochs, n_minibatches=p.n_minibatches,
+        hidden=tuple([p.layer_size] * p.n_layers),
+        anneal_lr=p.anneal_lr, total_updates=cfg.total_updates)
+
+
+def build_env(cfg: TrainConfig):
+    env = get_sized(cfg.protocol, cfg.episode_len)
+    if cfg.alpha_is_scheduled():
+        env = AssumptionEnv(env)
+    return env
+
+
+_EVAL_FN_CACHE: dict = {}
+
+
+def _eval_fn(env, hidden, episode_len):
+    """Jitted (net_params, keys, stacked_params) -> stats, cached so
+    repeated evals during one training run compile once."""
+    cache_key = (id(env), hidden, episode_len)
+    fn = _EVAL_FN_CACHE.get(cache_key)
+    if fn is None:
+        net = ActorCritic(env.n_actions, hidden)
+
+        def run(net_params, keys, params):
+            def policy(obs):
+                logits, _ = net.apply(net_params, obs)
+                return jnp.argmax(logits, axis=-1)
+
+            return jax.vmap(jax.vmap(
+                lambda k, p: env.episode_stats(
+                    k, p, policy, episode_len + 8),
+                in_axes=(0, None)), in_axes=(0, 0))(keys, params)
+
+        fn = _EVAL_FN_CACHE[cache_key] = jax.jit(run)
+    return fn
+
+
+def evaluate_per_alpha(env, cfg: TrainConfig, net_params, *,
+                       episodes_per_alpha=None, seed=1):
+    """Greedy-policy evaluation on the eval alpha grid; one batched
+    kernel over (alphas x episodes) — the EvalCallback aggregation
+    (ppo.py:296-374) as a single program.  Returns one row per alpha."""
+    alphas = cfg.eval_alphas()
+    reps = episodes_per_alpha or cfg.eval.episodes_per_alpha
+    params = _stack_params(alphas, cfg.gamma, cfg.episode_len)
+    keys = jax.random.split(jax.random.PRNGKey(seed), (len(alphas), reps))
+    fn = _eval_fn(env, ppo_config(cfg).hidden, cfg.episode_len)
+    stats = jax.block_until_ready(fn(net_params, keys, params))
+    rows = []
+    for i, a in enumerate(alphas):
+        atk = float(np.asarray(
+            stats["episode_reward_attacker"][i]).mean())
+        dfn = float(np.asarray(
+            stats["episode_reward_defender"][i]).mean())
+        prg = float(np.asarray(stats["episode_progress"][i]).mean())
+        rows.append({
+            "alpha": float(a),
+            "gamma": cfg.gamma,
+            "relative_reward": atk / (atk + dfn) if atk + dfn else 0.0,
+            "reward_per_progress": atk / prg if prg else 0.0,
+            "episode_progress": prg,
+        })
+    return rows
+
+
+def save_checkpoint(path: str, net_params, meta: dict | None = None):
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "wb") as f:
+        f.write(serialization.to_bytes(net_params))
+    if meta is not None:
+        with open(path + ".json", "w") as f:
+            json.dump(meta, f)
+
+
+def load_checkpoint(path: str, env, cfg: TrainConfig):
+    net = ActorCritic(env.n_actions, ppo_config(cfg).hidden)
+    template = net.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, env.observation_length)))
+    with open(path, "rb") as f:
+        return serialization.from_bytes(template, f.read())
+
+
+def train_from_config(cfg: TrainConfig, *, out_dir: str | None = None,
+                      n_updates: int | None = None, mesh=None,
+                      progress: Callable | None = None):
+    """Full training run: returns (net_params, history, eval_rows).
+
+    Checkpoints (when out_dir is set): last-model.msgpack after every
+    eval, best-model.msgpack when the mean eval relative reward improves
+    (ppo.py:429-453 contract).
+    """
+    env = build_env(cfg)
+    lane_alphas = cfg.lane_alphas(cfg.n_envs)
+    env_params = _stack_params(lane_alphas, cfg.gamma, cfg.episode_len)
+    pcfg = ppo_config(cfg)
+    transform = make_reward_transform(cfg, lane_alphas)
+    init_fn, train_step = make_train(env, env_params, pcfg, transform,
+                                     per_env_params=True)
+    carry = init_fn(jax.random.PRNGKey(cfg.seed))
+    if mesh is not None:
+        from cpr_tpu.parallel import shard_envs
+        ts, env_state, obs, key = carry
+        env_state = shard_envs(mesh, env_state, "dp")
+        obs = shard_envs(mesh, obs, "dp")
+        carry = (ts, env_state, obs, key)
+    step = jax.jit(train_step)
+
+    total = n_updates if n_updates is not None else cfg.total_updates
+    history, eval_rows, best = [], [], -np.inf
+    for i in range(total):
+        carry, metrics = step(carry)
+        m = {k: float(v) for k, v in metrics.items()}
+        history.append(m)
+        if progress is not None:
+            progress(i, m)
+        # the first start_at_iteration updates never evaluate (early
+        # deterministic policies are degenerate — cfg_model rationale)
+        due = (i + 1) % cfg.eval.freq == 0 or i + 1 == total
+        if due and i + 1 > cfg.eval.start_at_iteration:
+            rows = evaluate_per_alpha(env, cfg, carry[0].params)
+            for r in rows:
+                r["update"] = i + 1
+            eval_rows.extend(rows)
+            if out_dir is not None:
+                score = float(np.mean(
+                    [r["relative_reward"] for r in rows]))
+                meta = dict(update=i + 1, score=score,
+                            protocol=cfg.protocol)
+                save_checkpoint(os.path.join(out_dir,
+                                             "last-model.msgpack"),
+                                carry[0].params, meta)
+                if score > best:
+                    best = score
+                    save_checkpoint(os.path.join(out_dir,
+                                                 "best-model.msgpack"),
+                                    carry[0].params, meta)
+    return carry[0].params, history, eval_rows

@@ -99,14 +99,21 @@ def shardings(mesh, dp_axis: str = "dp", tp_axis: str = "tp"):
 
 
 def make_train(env: JaxEnv, env_params: EnvParams, cfg: PPOConfig,
-               reward_transform: Callable | None = None):
+               reward_transform: Callable | None = None,
+               per_env_params: bool = False):
     """Build (init_fn, train_step) — both jittable, mesh-shardable.
 
     reward_transform(reward, info, done) -> shaped reward; the analog of
     the reference's reward shaping pipeline (ppo.py:217-244 and the
     wrappers in gym/ocaml/cpr_gym/wrappers.py).
+
+    per_env_params: env_params leaves carry a leading (n_envs,) axis and
+    each env lane runs its own (alpha, gamma, ...) — the batched analog
+    of training under an assumption schedule
+    (wrappers.py:172-242 / cfg alpha lists and ranges).
     """
     net = ActorCritic(env.n_actions, cfg.hidden)
+    p_axis = 0 if per_env_params else None
 
     def lr_schedule(count):
         if not cfg.anneal_lr:
@@ -125,7 +132,9 @@ def make_train(env: JaxEnv, env_params: EnvParams, cfg: PPOConfig,
         params = net.init(k_net, jnp.zeros((1, obs_dim)))
         ts = TrainState.create(apply_fn=net.apply, params=params, tx=tx)
         env_keys = jax.random.split(k_env, cfg.n_envs)
-        env_state, obs = jax.vmap(lambda k: env.reset(k, env_params))(env_keys)
+        env_state, obs = jax.vmap(
+            lambda k, p: env.reset(k, p), in_axes=(0, p_axis)
+        )(env_keys, env_params)
         return ts, env_state, obs, key
 
     def env_step(carry, _):
@@ -135,12 +144,14 @@ def make_train(env: JaxEnv, env_params: EnvParams, cfg: PPOConfig,
         action = jax.random.categorical(k_act, logits)
         logp = jax.nn.log_softmax(logits)[jnp.arange(cfg.n_envs), action]
         env_state, obs2, reward, done, info = jax.vmap(
-            lambda s, a: env.step(s, a, env_params)
-        )(env_state, action)
+            lambda s, a, p: env.step(s, a, p), in_axes=(0, 0, p_axis)
+        )(env_state, action, env_params)
         if reward_transform is not None:
             reward = reward_transform(reward, info, done)
         # auto-reset finished episodes, continuing each env's PRNG stream
-        reset_state, reset_obs = jax.vmap(lambda s: env.reset(s.key, env_params))(env_state)
+        reset_state, reset_obs = jax.vmap(
+            lambda s, p: env.reset(s.key, p), in_axes=(0, p_axis)
+        )(env_state, env_params)
         env_state = jax.tree.map(
             lambda a, b: jnp.where(
                 done.reshape(done.shape + (1,) * (a.ndim - 1)), a, b),
