@@ -133,6 +133,115 @@ def quorum_altruistic(dag, cidx, cvalid, abits, own, seen, depth, q: int):
     return n, acc, leaves_c, n_cand
 
 
+def optimal_window(q: int, C: int, max_options: int = 100) -> int:
+    """Largest candidate-window W with C(W, q) <= max_options — the
+    static-shape form of the reference's option cap
+    (tailstorm.ml:419-431: more than `max_options` n-choose-k choices
+    falls back to the heuristic).  comb(n, q) grows in n, so
+    `n_cand > W` if and only if the reference would fall back."""
+    import math
+
+    W = q
+    while W + 1 <= C and math.comb(W + 1, q) <= max_options:
+        W += 1
+    return W
+
+
+def optimal_combos(q: int, W: int):
+    """(n_opt, W) bool table of all size-q subsets of the window."""
+    import itertools
+
+    import numpy as np
+
+    rows = []
+    for combo in itertools.combinations(range(W), q):
+        row = np.zeros(W, bool)
+        row[list(combo)] = True
+        rows.append(row)
+    return np.asarray(rows)
+
+
+def quorum_optimal(dag, cidx, cvalid, abits, own, depth, q: int,
+                   combos, *, k: int, discount: bool, punish: bool,
+                   depth_plus: int = 0):
+    """Exhaustive reward-optimal selection (tailstorm.ml:418-506,
+    stree.ml equivalent): enumerate every closed size-q vote subset and
+    keep the one maximizing the miner's own reward under the incentive
+    scheme.  `combos` is the static optimal_combos table; the caller
+    falls back to the heuristic when candidates exceed the window.
+
+    depth_plus: the discount numerator offset — tailstorm pays
+    r = depth/k (tailstorm.ml reward'), stree and tailstorm_june pay
+    r = (depth+1)/k (stree.ml:176-193), so the scoring must match the
+    scheme the env later pays out.
+
+    Returns (found, leaves_c).  Deviation: the reference breaks reward
+    ties via its list ordering of choices; here ties go to the first
+    combination in table order (candidate-slot order), which is
+    deterministic but may pick a different equally-rewarded quorum.
+    """
+    C = cidx.shape[0]
+    W = combos.shape[1]
+    sel = jnp.zeros((combos.shape[0], C), jnp.bool_).at[:, :W].set(
+        jnp.asarray(combos))
+    ci = jnp.maximum(cidx, 0)
+    own_c = own[ci] & cvalid
+    depth_c = jnp.where(cvalid, depth[ci], -1)
+    n_cand = cvalid.sum()
+
+    ok_valid = (sel & ~cvalid[None, :]).sum(axis=1) == 0
+    # closure-closed: every selected vote's vote-ancestors are selected
+    escape = (sel[:, :, None] & abits[None, :, :]
+              & ~sel[:, None, :]).any(axis=(1, 2))
+    valid = ok_valid & ~escape & (n_cand >= q)
+
+    # deepest selected vote; ties by smaller pow hash then slot order
+    # (compare_votes_in_block, tailstorm.ml:123-133)
+    powh = dag.pow_hash[ci]
+    deep_key = (depth_c[None, :].astype(jnp.float32) * 4.0
+                - powh[None, :] * 2.0
+                - jnp.arange(C, dtype=jnp.float32) * 1e-6)
+    deep_key = jnp.where(sel, deep_key, -jnp.inf)
+    deepest = jnp.argmax(deep_key, axis=1)
+    depth_max = jnp.max(jnp.where(sel, depth_c[None, :], -1), axis=1)
+
+    r = jnp.where(discount,
+                  (depth_max + depth_plus).astype(jnp.float32) / k, 1.0)
+    rewarded = jnp.where(punish, abits[deepest], sel)
+    score = r * (rewarded & own_c[None, :]).sum(axis=1)
+    score = jnp.where(valid, score, -jnp.inf)
+
+    best = jnp.argmax(score)
+    found = valid.any()
+    sel_best = sel[best] & found
+    # leaves: selected votes with no selected strict descendant
+    # (abits[i, j]: j lies in i's closure, including i == j)
+    desc = sel_best[:, None] & abits & ~jnp.eye(C, dtype=jnp.bool_)
+    leaves_c = sel_best & ~desc.any(axis=0)
+    return found, leaves_c
+
+
+def quorum_optimal_or_heuristic(dag, cidx, cvalid, abits, own, depth,
+                                q: int, window: int, combos, *, k: int,
+                                discount: bool, punish: bool,
+                                depth_plus: int = 0):
+    """Optimal selection with the reference's option-cap fallback: when
+    any valid candidate sits beyond the static window (more combinations
+    than the cap, or escape-invalidation pushed a valid vote past slot
+    W), use the heuristic instead.  The second case is conservative: the
+    reference packs candidates densely and might still enumerate; here
+    the window is positional, so out-of-window candidates force the
+    fallback."""
+    found_o, leaves_o = quorum_optimal(
+        dag, cidx, cvalid, abits, own, depth, q, combos, k=k,
+        discount=discount, punish=punish, depth_plus=depth_plus)
+    found_h, leaves_h = quorum_heuristic(dag, cidx, cvalid, abits, own, q)
+    C = cidx.shape[0]
+    over = (cvalid & (jnp.arange(C) >= window)).any()
+    return (jnp.where(over, found_h, found_o),
+            jnp.where(over, leaves_h, leaves_o))
+
+
 def leaves_to_row(dag, cidx, leaves_c, cvalid, width: int, score):
     """Scatter the local leaves mask back to global slots and pick the
     parent row: `width` leaves sorted descending by `score` (a (B,)

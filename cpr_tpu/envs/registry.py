@@ -145,6 +145,7 @@ def _ensure_builtin():
     from cpr_tpu.envs.spar import SparSSZ
     from cpr_tpu.envs.stree import StreeSSZ
     from cpr_tpu.envs.tailstorm import TailstormSSZ
+    from cpr_tpu.envs.tailstorm_june import TailstormJuneSSZ
 
     _BUILTIN_LOADED = True
     for key, factory in [
@@ -159,6 +160,7 @@ def _ensure_builtin():
         ("stree", StreeSSZ),
         ("sdag", SdagSSZ),
         ("tailstorm", TailstormSSZ),
+        ("tailstormjune", TailstormJuneSSZ),
     ]:
         if key not in _REGISTRY:
             _REGISTRY[key] = factory

@@ -105,11 +105,13 @@ class StreeSSZ(JaxEnv):
         self.k = k
         self.q = k - 1
         self.incentive_scheme = incentive_scheme
-        # `optimal` falls back to `heuristic` as the reference does beyond
-        # 100 n-choose-k options (stree.ml:389-391)
-        self.subblock_selection = (
-            "heuristic" if subblock_selection == "optimal"
-            else subblock_selection)
+        self.subblock_selection = subblock_selection
+        if subblock_selection == "optimal":
+            # static n-choose-(k-1) tables; candidate counts beyond the
+            # window fall back to heuristic, matching the reference's
+            # 100-option cap (stree.ml:389-391)
+            self.opt_window = Q.optimal_window(k - 1, 4 * k + 16)
+            self.opt_combos = Q.optimal_combos(k - 1, self.opt_window)
         self.unit_observation = unit_observation
         self.capacity = max_steps_hint + 8  # one PoW append per step
         self.max_parents = k  # parent block + k-1 leaves
@@ -163,6 +165,14 @@ class StreeSSZ(JaxEnv):
             n, _, leaves_c, n_cand = Q.quorum_altruistic(
                 dag, cidx, cvalid, abits, own, seen, dag.aux, self.q)
             found = (n == self.q) & (n_cand >= self.q)
+        elif self.subblock_selection == "optimal":
+            # stree pays discount r = (depth+1)/k (depth_plus=1)
+            found, leaves_c = Q.quorum_optimal_or_heuristic(
+                dag, cidx, cvalid, abits, own, dag.aux, self.q,
+                self.opt_window, self.opt_combos, k=self.k,
+                discount=self.incentive_scheme in ("discount", "hybrid"),
+                punish=self.incentive_scheme in ("punish", "hybrid"),
+                depth_plus=1)
         else:
             found, leaves_c = Q.quorum_heuristic(
                 dag, cidx, cvalid, abits, own, self.q)

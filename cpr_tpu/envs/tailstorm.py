@@ -33,11 +33,11 @@ counts. One env step processes exactly one attacker event: a pending
 self-append, a defender summary, or one mining draw.
 
 Documented deviations from the reference event-queue simulation:
-- `optimal` sub-block selection maps to `heuristic`. The reference already
-  falls back to heuristic beyond 100 n-choose-k options
-  (tailstorm.ml:426-428) — for the default k=8 that means any window with
-  more than 10 confirming votes; the exhaustive search only kicks in on
-  tiny windows.
+- `optimal` sub-block selection enumerates a static n-choose-k table
+  (cpr_tpu.envs.quorum.quorum_optimal) and falls back to `heuristic`
+  exactly where the reference's 100-option cap does
+  (tailstorm.ml:426-428); reward ties between quorum choices resolve in
+  table order rather than the reference's list order.
 - The defender cloud attempts one summary append per delivery batch
   (quorum over its visible votes) instead of one per delivered vertex;
   same-height summary *replacement* by the defender
@@ -128,10 +128,13 @@ class TailstormSSZ(JaxEnv):
         assert subblock_selection in SUBBLOCK_SELECTIONS
         self.k = k
         self.incentive_scheme = incentive_scheme
-        # `optimal` falls back to `heuristic` (see module docstring)
-        self.subblock_selection = (
-            "heuristic" if subblock_selection == "optimal"
-            else subblock_selection)
+        self.subblock_selection = subblock_selection
+        if subblock_selection == "optimal":
+            # static n-choose-k tables; beyond the window the selection
+            # falls back to heuristic, exactly where the reference's
+            # 100-option cap does (tailstorm.ml:419-431)
+            self.opt_window = Q.optimal_window(k, 4 * k + 16)
+            self.opt_combos = Q.optimal_combos(k, self.opt_window)
         self.unit_observation = unit_observation
         # <= 2 appends per step (attacker summary + defender summary/vote)
         self.capacity = 2 * max_steps_hint + 8
@@ -267,6 +270,14 @@ class TailstormSSZ(JaxEnv):
             n, _, leaves_c, n_cand = Q.quorum_altruistic(
                 dag, cidx, cvalid, abits, own, seen, dag.aux, self.k)
             found = (n == self.k) & (n_cand >= self.k)
+        elif self.subblock_selection == "optimal":
+            # tailstorm pays discount r = depth/k (depth_plus=0)
+            found, leaves_c = Q.quorum_optimal_or_heuristic(
+                dag, cidx, cvalid, abits, own, dag.aux, self.k,
+                self.opt_window, self.opt_combos, k=self.k,
+                discount=self.incentive_scheme in ("discount", "hybrid"),
+                punish=self.incentive_scheme in ("punish", "hybrid"),
+                depth_plus=0)
         else:
             found, leaves_c = Q.quorum_heuristic(
                 dag, cidx, cvalid, abits, own, self.k)

@@ -1,0 +1,51 @@
+"""Tailstorm/ll (June '22) attack environment.
+
+Reference counterpart: simulator/protocols/tailstorm_june.ml (kept by the
+reference to reproduce its W&B run 257) and tailstorm_june_ssz.ml.  The
+protocol is Stree's structure — proof-of-work summaries carrying k-1
+depth-labelled votes, preference by (block, vote) — with Tailstorm's
+reward menu plus a `block` scheme paying the whole k to the summary
+miner (tailstorm_june.ml:176-205).  Sub-block selection is fixed to the
+own-reward-first greedy quorum (tailstorm_june.ml:282-350), i.e. Stree's
+`heuristic`.
+
+The env therefore derives from StreeSSZ: same DAG layout, observation
+fields, action space (8 actions), and policies; only the key, the scheme
+menu, and the `block` reward branch differ.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from cpr_tpu.core import dag as D
+from cpr_tpu.envs.stree import StreeSSZ
+
+INCENTIVE_SCHEMES = ("block", "constant", "discount", "punish", "hybrid")
+
+
+class TailstormJuneSSZ(StreeSSZ):
+    def __init__(self, k: int = 8, incentive_scheme: str = "constant",
+                 unit_observation: bool = True, max_steps_hint: int = 256,
+                 release_scan: int = 128):
+        assert incentive_scheme in INCENTIVE_SCHEMES
+        super().__init__(
+            k=k,
+            incentive_scheme=("constant" if incentive_scheme == "block"
+                              else incentive_scheme),
+            subblock_selection="heuristic",
+            unit_observation=unit_observation,
+            max_steps_hint=max_steps_hint,
+            release_scan=release_scan)
+        self.incentive_scheme = incentive_scheme
+
+    def block_reward(self, dag, leaves_row, miner):
+        """`block`: the summary's miner collects the whole k
+        (tailstorm_june.ml:177 constant_block); other schemes follow
+        Stree (same reward' core, tailstorm_june.ml:179-205)."""
+        if self.incentive_scheme != "block":
+            return super().block_reward(dag, leaves_row, miner)
+        k = jnp.float32(self.k)
+        atk = jnp.where(miner == D.ATTACKER, k, 0.0)
+        dfn = jnp.where(miner == D.DEFENDER, k, 0.0)
+        return atk, dfn

@@ -9,6 +9,8 @@ CPU backend."""
 
 import os
 
+import pytest
+
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -18,3 +20,33 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+# -- tiering ---------------------------------------------------------------
+# The deep stochastic batteries (full DAG-env policy sweeps) compile
+# multi-hundred-line jitted kernels many times; on the CPU host they push
+# the suite far past a CI budget.  Default runs execute the fast tier
+# (every module still has smoke/contract coverage via
+# test_protocol_smoke.py); the slow tier runs with --runslow or
+# CPR_RUN_SLOW=1.
+
+
+def pytest_addoption(parser):
+    parser.addoption("--runslow", action="store_true", default=False,
+                     help="run tests marked slow (deep stochastic tier)")
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: deep stochastic battery, opt-in via --runslow")
+
+
+def pytest_collection_modifyitems(config, items):
+    if config.getoption("--runslow") or \
+            os.environ.get("CPR_RUN_SLOW", "").lower() in ("1", "true",
+                                                           "yes"):
+        return
+    skip = pytest.mark.skip(reason="slow tier: use --runslow or CPR_RUN_SLOW=1")
+    for item in items:
+        if "slow" in item.keywords:
+            item.add_marker(skip)

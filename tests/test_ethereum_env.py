@@ -10,6 +10,10 @@ import pytest
 from cpr_tpu.envs.ethereum import EthereumSSZ
 from cpr_tpu.params import make_params
 
+# deep stochastic battery: opt-in (fast coverage lives in
+# test_protocol_smoke.py)
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture(scope="module", params=["byzantium", "whitepaper"])
 def env(request):

@@ -1,6 +1,8 @@
 """PPO trainer tests: learning signal on the selfish-mining env and the
 multi-chip dry run on the virtual CPU mesh."""
 
+import pytest
+
 import numpy as np
 
 import jax
@@ -8,6 +10,10 @@ import jax
 from cpr_tpu.envs.nakamoto import NakamotoSSZ
 from cpr_tpu.params import make_params
 from cpr_tpu.train.ppo import PPOConfig, train
+
+# deep stochastic battery: opt-in (fast coverage lives in
+# test_protocol_smoke.py)
+pytestmark = pytest.mark.slow
 
 
 def rel(h):

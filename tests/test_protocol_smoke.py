@@ -1,0 +1,83 @@
+"""Fast-tier protocol coverage: every env family constructs, jits, and
+behaves sanely on tiny shapes.
+
+The deep stochastic batteries (test_*_env.py) are the slow tier
+(--runslow); this file is their always-on floor, shaped after the
+reference's three-battery structure (cpr_protocols.ml:200-782): honest
+runs stay near alpha, the honest policy through the attack space stays
+~honest, and random policies don't violate invariants.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cpr_tpu.envs import registry
+from cpr_tpu.params import make_params
+
+KEYS = (
+    "nakamoto",
+    "ethereum-byzantium",
+    "bk-4-constant",
+    "spar-4-block",
+    "stree-4-discount-altruistic",
+    "stree-4-constant-optimal",
+    "sdag-4-constant-altruistic",
+    "tailstorm-4-discount-heuristic",
+    "tailstorm-4-constant-optimal",
+    "tailstormjune-4-block",
+)
+
+ALPHA = 0.3
+
+
+def run_honest(env, n_envs=32, max_steps=48):
+    params = make_params(alpha=ALPHA, gamma=0.5, max_steps=max_steps)
+    keys = jax.random.split(jax.random.PRNGKey(0), n_envs)
+    f = jax.jit(jax.vmap(lambda k: env.episode_stats(
+        k, params, env.policies["honest"], max_steps + 8)))
+    return jax.block_until_ready(f(keys))
+
+
+@pytest.mark.parametrize("key", KEYS)
+def test_honest_policy_earns_alpha(key):
+    env = registry.get_sized(key, 48)
+    stats = run_honest(env)
+    a = np.asarray(stats["episode_reward_attacker"]).mean()
+    d = np.asarray(stats["episode_reward_defender"]).mean()
+    assert a + d > 0
+    assert abs(a / (a + d) - ALPHA) < 0.08, (key, a / (a + d))
+
+
+@pytest.mark.parametrize("key", ["bk-4-constant",
+                                 "tailstorm-4-discount-heuristic"])
+def test_random_policy_keeps_invariants(key):
+    """The reference's `random` battery (cpr_protocols.ml:658-782) in
+    miniature: random actions must not crash or overflow the DAG."""
+    env = registry.get_sized(key, 48)
+    params = make_params(alpha=0.4, gamma=0.5, max_steps=48)
+
+    def random_policy(obs):
+        # pseudo-random but jittable: hash the observation
+        h = jnp.abs(jnp.sum(obs * 1000.0)).astype(jnp.int32)
+        return h % env.n_actions
+
+    keys = jax.random.split(jax.random.PRNGKey(1), 16)
+    f = jax.jit(jax.vmap(lambda k: env.episode_stats(
+        k, params, random_policy, 56)))
+    stats = jax.block_until_ready(f(keys))
+    assert np.isfinite(
+        np.asarray(stats["episode_reward_attacker"])).all()
+    assert (np.asarray(stats["episode_progress"]) >= 0).all()
+
+
+def test_observation_bounds():
+    for key in ("nakamoto", "bk-4-constant"):
+        env = registry.get_sized(key, 48)
+        params = make_params(alpha=0.3, gamma=0.5, max_steps=32)
+        state, obs = jax.jit(env.reset)(jax.random.PRNGKey(0), params)
+        lo = np.asarray(env.low)
+        hi = np.asarray(env.high)
+        o = np.asarray(obs)
+        assert (o >= lo - 1e-6).all() and (o <= hi + 1e-6).all(), key

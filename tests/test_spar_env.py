@@ -8,6 +8,10 @@ import pytest
 from cpr_tpu.envs.spar import BLOCK, VOTE, SparSSZ
 from cpr_tpu.params import make_params
 
+# deep stochastic battery: opt-in (fast coverage lives in
+# test_protocol_smoke.py)
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture(scope="module")
 def env():

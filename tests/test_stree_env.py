@@ -7,6 +7,10 @@ import pytest
 from cpr_tpu.envs.stree import BLOCK, VOTE, StreeSSZ
 from cpr_tpu.params import make_params
 
+# deep stochastic battery: opt-in (fast coverage lives in
+# test_protocol_smoke.py)
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture(scope="module")
 def env():

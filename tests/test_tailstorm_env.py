@@ -11,6 +11,10 @@ from cpr_tpu.core import dag as D
 from cpr_tpu.envs.tailstorm import SUMMARY, VOTE, TailstormSSZ
 from cpr_tpu.params import make_params
 
+# deep stochastic battery: opt-in (fast coverage lives in
+# test_protocol_smoke.py)
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture(scope="module")
 def env():
