@@ -163,17 +163,25 @@ def optimal_combos(q: int, W: int):
 
 def quorum_optimal(dag, cidx, cvalid, abits, own, depth, q: int,
                    combos, *, k: int, discount: bool, punish: bool,
-                   depth_plus: int = 0):
+                   depth_plus: int = 0, leaf_score=None,
+                   miner_share: int = 0):
     """Exhaustive reward-optimal selection (tailstorm.ml:418-506,
     stree.ml equivalent): enumerate every closed size-q vote subset and
     keep the one maximizing the miner's own reward under the incentive
     scheme.  `combos` is the static optimal_combos table; the caller
     falls back to the heuristic when candidates exceed the window.
 
-    depth_plus: the discount numerator offset — tailstorm pays
-    r = depth/k (tailstorm.ml reward'), stree and tailstorm_june pay
-    r = (depth+1)/k (stree.ml:176-193), so the scoring must match the
-    scheme the env later pays out.
+    The scorer must mirror the env's payout exactly or the argmax
+    inverts, hence three env-specific knobs:
+    - depth_plus: discount numerator offset — tailstorm pays r = depth/k
+      (tailstorm.ml reward'), stree/tailstorm_june pay r = (depth+1)/k
+      (stree.ml:176-193);
+    - leaf_score: the env's own vote_score array (capacity,), used to
+      pick the branch the punish scheme will actually pay (the envs use
+      it in leaves_to_row, so tiebreaks agree by construction);
+    - miner_share: 1 when the scheme also pays the block's miner r
+      (stree.ml:188-190 adds the block to the rewarded set), 0 when it
+      pays votes only (tailstorm).
 
     Returns (found, leaves_c).  Deviation: the reference breaks reward
     ties via its list ordering of choices; here ties go to the first
@@ -195,20 +203,19 @@ def quorum_optimal(dag, cidx, cvalid, abits, own, depth, q: int,
               & ~sel[:, None, :]).any(axis=(1, 2))
     valid = ok_valid & ~escape & (n_cand >= q)
 
-    # deepest selected vote; ties by smaller pow hash then slot order
-    # (compare_votes_in_block, tailstorm.ml:123-133)
-    powh = dag.pow_hash[ci]
-    deep_key = (depth_c[None, :].astype(jnp.float32) * 4.0
-                - powh[None, :] * 2.0
-                - jnp.arange(C, dtype=jnp.float32) * 1e-6)
-    deep_key = jnp.where(sel, deep_key, -jnp.inf)
+    # the leaf the punish scheme pays: highest env leaf_score (the same
+    # preference the env's leaves_to_row applies)
+    if leaf_score is None:
+        leaf_score = dag.aux.astype(jnp.float32) - dag.pow_hash
+    score_c = jnp.where(cvalid, leaf_score[ci], -jnp.inf)
+    deep_key = jnp.where(sel, score_c[None, :], -jnp.inf)
     deepest = jnp.argmax(deep_key, axis=1)
     depth_max = jnp.max(jnp.where(sel, depth_c[None, :], -1), axis=1)
 
     r = jnp.where(discount,
                   (depth_max + depth_plus).astype(jnp.float32) / k, 1.0)
     rewarded = jnp.where(punish, abits[deepest], sel)
-    score = r * (rewarded & own_c[None, :]).sum(axis=1)
+    score = r * ((rewarded & own_c[None, :]).sum(axis=1) + miner_share)
     score = jnp.where(valid, score, -jnp.inf)
 
     best = jnp.argmax(score)
@@ -224,7 +231,8 @@ def quorum_optimal(dag, cidx, cvalid, abits, own, depth, q: int,
 def quorum_optimal_or_heuristic(dag, cidx, cvalid, abits, own, depth,
                                 q: int, window: int, combos, *, k: int,
                                 discount: bool, punish: bool,
-                                depth_plus: int = 0):
+                                depth_plus: int = 0, leaf_score=None,
+                                miner_share: int = 0):
     """Optimal selection with the reference's option-cap fallback: when
     any valid candidate sits beyond the static window (more combinations
     than the cap, or escape-invalidation pushed a valid vote past slot
@@ -234,7 +242,8 @@ def quorum_optimal_or_heuristic(dag, cidx, cvalid, abits, own, depth,
     fallback."""
     found_o, leaves_o = quorum_optimal(
         dag, cidx, cvalid, abits, own, depth, q, combos, k=k,
-        discount=discount, punish=punish, depth_plus=depth_plus)
+        discount=discount, punish=punish, depth_plus=depth_plus,
+        leaf_score=leaf_score, miner_share=miner_share)
     found_h, leaves_h = quorum_heuristic(dag, cidx, cvalid, abits, own, q)
     C = cidx.shape[0]
     over = (cvalid & (jnp.arange(C) >= window)).any()

@@ -166,13 +166,17 @@ class StreeSSZ(JaxEnv):
                 dag, cidx, cvalid, abits, own, seen, dag.aux, self.q)
             found = (n == self.q) & (n_cand >= self.q)
         elif self.subblock_selection == "optimal":
-            # stree pays discount r = (depth+1)/k (depth_plus=1)
+            # stree pays discount r = (depth+1)/k and also pays the
+            # block's miner (stree.ml:188-190), so the scorer gets
+            # depth_plus=1 and miner_share=1; leaf preference follows
+            # this env's vote_score so punish pays the scored branch
             found, leaves_c = Q.quorum_optimal_or_heuristic(
                 dag, cidx, cvalid, abits, own, dag.aux, self.q,
                 self.opt_window, self.opt_combos, k=self.k,
                 discount=self.incentive_scheme in ("discount", "hybrid"),
                 punish=self.incentive_scheme in ("punish", "hybrid"),
-                depth_plus=1)
+                depth_plus=1, leaf_score=self.vote_score(dag),
+                miner_share=1)
         else:
             found, leaves_c = Q.quorum_heuristic(
                 dag, cidx, cvalid, abits, own, self.q)

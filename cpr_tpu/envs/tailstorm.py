@@ -35,9 +35,11 @@ self-append, a defender summary, or one mining draw.
 Documented deviations from the reference event-queue simulation:
 - `optimal` sub-block selection enumerates a static n-choose-k table
   (cpr_tpu.envs.quorum.quorum_optimal) and falls back to `heuristic`
-  exactly where the reference's 100-option cap does
-  (tailstorm.ml:426-428); reward ties between quorum choices resolve in
-  table order rather than the reference's list order.
+  at or before the reference's 100-option cap (tailstorm.ml:426-428):
+  the positional window can trigger the fallback slightly earlier when
+  escape-invalidation leaves holes in the candidate frame. Reward ties
+  between quorum choices resolve in table order rather than the
+  reference's list order.
 - The defender cloud attempts one summary append per delivery batch
   (quorum over its visible votes) instead of one per delivered vertex;
   same-height summary *replacement* by the defender
@@ -131,8 +133,8 @@ class TailstormSSZ(JaxEnv):
         self.subblock_selection = subblock_selection
         if subblock_selection == "optimal":
             # static n-choose-k tables; beyond the window the selection
-            # falls back to heuristic, exactly where the reference's
-            # 100-option cap does (tailstorm.ml:419-431)
+            # falls back to heuristic, at or before the reference's
+            # 100-option cap (tailstorm.ml:419-431, module docstring)
             self.opt_window = Q.optimal_window(k, 4 * k + 16)
             self.opt_combos = Q.optimal_combos(k, self.opt_window)
         self.unit_observation = unit_observation
@@ -271,13 +273,16 @@ class TailstormSSZ(JaxEnv):
                 dag, cidx, cvalid, abits, own, seen, dag.aux, self.k)
             found = (n == self.k) & (n_cand >= self.k)
         elif self.subblock_selection == "optimal":
-            # tailstorm pays discount r = depth/k (depth_plus=0)
+            # tailstorm pays discount r = depth/k and pays votes only
+            # (no summary-miner share, tailstorm.ml:204-218)
             found, leaves_c = Q.quorum_optimal_or_heuristic(
                 dag, cidx, cvalid, abits, own, dag.aux, self.k,
                 self.opt_window, self.opt_combos, k=self.k,
                 discount=self.incentive_scheme in ("discount", "hybrid"),
                 punish=self.incentive_scheme in ("punish", "hybrid"),
-                depth_plus=0)
+                depth_plus=0,
+                leaf_score=(dag.aux.astype(jnp.float32) - dag.pow_hash),
+                miner_share=0)
         else:
             found, leaves_c = Q.quorum_heuristic(
                 dag, cidx, cvalid, abits, own, self.k)

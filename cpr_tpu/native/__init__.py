@@ -59,7 +59,8 @@ def lib() -> ctypes.CDLL:
 
 
 _METRICS = {"reward_of": 0, "progress": 1, "sim_time": 2, "n_blocks": 3,
-            "head_height": 4, "on_chain": 5, "head_time": 6}
+            "head_height": 4, "on_chain": 5, "head_time": 6,
+            "pref_height": 7, "trace_truncated": 8}
 
 
 class OracleSim:

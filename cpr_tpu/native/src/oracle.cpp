@@ -17,6 +17,7 @@
 // reference's documented behavior, not translated.
 
 #include <algorithm>
+#include <array>
 #include <cassert>
 #include <cmath>
 #include <cstdint>
@@ -126,6 +127,23 @@ struct Sim {
   // bk proposal dedup (simulator.ml:138-158): key -> block id
   std::map<std::string, int> dedup;
 
+  // structured causal trace (log.ml:1-26): (time, kind, node, block);
+  // kinds: 0 append, 1 share, 2 receive, 3 learn.  Bounded so long runs
+  // don't exhaust memory; `trace_truncated` reports the overflow.
+  static constexpr size_t kTraceCap = 1 << 20;
+  std::vector<std::array<double, 4>> trace;
+  bool trace_truncated = false;
+
+  void record(int kind, int node, int block) {
+    if (trace.size() >= kTraceCap) {
+      trace_truncated = true;
+      return;
+    }
+    trace.push_back(
+        std::array<double, 4>{now, (double)kind, (double)node,
+                              (double)block});
+  }
+
   double rand_u() { return std::uniform_real_distribution<>(0, 1)(rng); }
 
   void push(double t, int type, int node, int block) {
@@ -177,6 +195,7 @@ struct Sim {
   }
 
   void send(int src, int b) {  // share a block on all links
+    record(1, src, b);
     for (int dst = 0; dst < n_nodes; dst++) {
       if (dst == src) continue;
       double d = delay[src][dst];
@@ -208,6 +227,7 @@ struct Sim {
     b.miner = miner;
     b.time = now;
     int id = dag.add(std::move(b));
+    record(0, miner, id);
     dedup[key] = id;
     return id;
   }
@@ -576,6 +596,7 @@ struct NakAgent {
 void Sim::deliver(int node, int b) {
   if (is_visible(node, b)) return;
   mark_visible(node, b);
+  record(3, node, b);
   if (node == 0 && agent) {
     handle_agent(b, false);
   } else {
@@ -636,6 +657,7 @@ void Sim::step_event() {
     if (!d.is_vote && d.height == 0)
       d.height = dag.blocks[d.parents[0]].height + 1;  // nakamoto fill-in
     int id = append_pow(m, std::move(d));
+    record(0, m, id);
     mark_visible(m, id);
     if (m == 0 && agent) {
       handle_agent(id, true);  // agent decides whether to share
@@ -650,6 +672,7 @@ void Sim::step_event() {
       known[node].resize(dag.blocks.size(), 0);
     if (known[node][b]) return;  // duplicate receipt
     known[node][b] = 1;
+    record(2, node, b);
     if (parents_visible(node, b))
       deliver(node, b);
     // else: buffered; unlocked when parents become visible
@@ -786,9 +809,43 @@ double cpr_oracle_metric(void* hp, int what, int arg) {
       int p = (arg == 0 && s.agent) ? s.agent->priv : s.preferred[arg];
       return (double)s.dag.blocks[p].height;
     }
+    case 8:  // causal trace hit its cap; exported traces are incomplete
+      return s.trace_truncated ? 1.0 : 0.0;
     default:
       return std::nan("");
   }
+}
+
+long cpr_oracle_trace_len(void* hp) {
+  return (long)static_cast<Handle*>(hp)->sim.trace.size();
+}
+
+// out4 = [time, kind, node, block]; kinds: 0 append, 1 share,
+// 2 receive, 3 learn
+void cpr_oracle_trace_get(void* hp, long i, double* out4) {
+  auto& tr = static_cast<Handle*>(hp)->sim.trace;
+  if (i < 0 || i >= (long)tr.size()) return;
+  for (int j = 0; j < 4; j++) out4[j] = tr[i][j];
+}
+
+// out = [miner, height, is_vote, vote_id, time, n_parents]
+void cpr_oracle_block(void* hp, int i, double* out6) {
+  auto& d = static_cast<Handle*>(hp)->sim.dag;
+  if (i < 0 || i >= (int)d.blocks.size()) return;
+  const auto& b = d.blocks[i];
+  out6[0] = b.miner;
+  out6[1] = b.height;
+  out6[2] = b.is_vote ? 1.0 : 0.0;
+  out6[3] = b.vote_id;
+  out6[4] = b.time;
+  out6[5] = (double)b.parents.size();
+}
+
+int cpr_oracle_block_parent(void* hp, int i, int j) {
+  auto& d = static_cast<Handle*>(hp)->sim.dag;
+  if (i < 0 || i >= (int)d.blocks.size()) return -1;
+  if (j < 0 || j >= (int)d.blocks[i].parents.size()) return -1;
+  return d.blocks[i].parents[j];
 }
 
 void cpr_oracle_destroy(void* hp) { delete static_cast<Handle*>(hp); }

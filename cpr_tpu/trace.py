@@ -1,0 +1,189 @@
+"""Structured traces, DAG exports, and malformed-DAG forensics.
+
+Reference counterparts: the structured sim log with GraphML export
+(simulator/lib/log.ml:1-160), the dot/GraphML DAG serializers
+(simulator/lib/dagtools.ml:136-226), and the malformed-DAG dump hook
+`CPR_MALFORMED_DAG_TO_FILE` (dagtools.ml:227-293, Makefile:1).
+
+Everything here is host-side: JAX env states are pulled off-device once
+per export, and the C++ oracle exposes its causal trace through the
+ctypes API.  The common currency is `DagView` — plain node/edge lists
+with typed attributes — which both engines can produce.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from dataclasses import dataclass, field
+from xml.etree import ElementTree as ET
+from xml.sax.saxutils import escape
+
+import numpy as np
+
+EVENT_KINDS = ("appends", "shares", "receives", "learns")
+
+
+@dataclass
+class DagView:
+    """nodes: one dict per block, must contain 'id'; edges: (child,
+    parent) pairs; events: (time, kind, node, block) causal trace."""
+
+    nodes: list[dict] = field(default_factory=list)
+    edges: list[tuple[int, int]] = field(default_factory=list)
+    events: list[tuple[float, str, int, int]] = field(default_factory=list)
+
+
+# -- adapters ----------------------------------------------------------------
+
+
+def view_of_env_state(dag) -> DagView:
+    """DagView of a JAX env's Dag pytree (cpr_tpu.core.dag.Dag)."""
+    n = int(dag.n)
+    parents = np.asarray(dag.parents)[:n]
+    view = DagView()
+    fields = {
+        "kind": np.asarray(dag.kind)[:n],
+        "height": np.asarray(dag.height)[:n],
+        "aux": np.asarray(dag.aux)[:n],
+        "miner": np.asarray(dag.miner)[:n],
+        "vis_a": np.asarray(dag.vis_a)[:n],
+        "vis_d": np.asarray(dag.vis_d)[:n],
+        "born_at": np.asarray(dag.born_at)[:n],
+    }
+    for i in range(n):
+        node = {"id": i}
+        for k, arr in fields.items():
+            v = arr[i]
+            node[k] = bool(v) if arr.dtype == bool else (
+                float(v) if arr.dtype.kind == "f" else int(v))
+        view.nodes.append(node)
+        for p in parents[i]:
+            if p >= 0:
+                view.edges.append((i, int(p)))
+    return view
+
+
+def view_of_oracle(sim) -> DagView:
+    """DagView + causal trace of a cpr_tpu.native.OracleSim."""
+    L = sim._lib
+    L.cpr_oracle_trace_len.restype = ctypes.c_long
+    L.cpr_oracle_trace_len.argtypes = [ctypes.c_void_p]
+    L.cpr_oracle_trace_get.restype = None
+    L.cpr_oracle_trace_get.argtypes = [ctypes.c_void_p, ctypes.c_long,
+                                       ctypes.POINTER(ctypes.c_double)]
+    L.cpr_oracle_block.restype = None
+    L.cpr_oracle_block.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                   ctypes.POINTER(ctypes.c_double)]
+    L.cpr_oracle_block_parent.restype = ctypes.c_int
+    L.cpr_oracle_block_parent.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                          ctypes.c_int]
+    view = DagView()
+    n = int(sim.metric("n_blocks")) + 1  # incl genesis
+    buf = (ctypes.c_double * 6)()
+    for i in range(n):
+        L.cpr_oracle_block(sim._h, i, buf)
+        view.nodes.append({
+            "id": i, "miner": int(buf[0]), "height": int(buf[1]),
+            "is_vote": bool(buf[2]), "vote_id": int(buf[3]),
+            "time": float(buf[4]),
+        })
+        for j in range(int(buf[5])):
+            p = L.cpr_oracle_block_parent(sim._h, i, j)
+            if p >= 0:
+                view.edges.append((i, p))
+    if sim.metric("trace_truncated"):
+        import warnings
+
+        warnings.warn("oracle trace hit its capacity; the exported "
+                      "event chain is incomplete")
+    tb = (ctypes.c_double * 4)()
+    for i in range(L.cpr_oracle_trace_len(sim._h)):
+        L.cpr_oracle_trace_get(sim._h, i, tb)
+        view.events.append((float(tb[0]), EVENT_KINDS[int(tb[1])],
+                            int(tb[2]), int(tb[3])))
+    return view
+
+
+# -- exporters ---------------------------------------------------------------
+
+
+def to_dot(view: DagView) -> str:
+    """Graphviz dot text (dagtools.ml:136-192 analog)."""
+    lines = ["digraph dag {", "  rankdir=RL;"]
+    for nd in view.nodes:
+        label = ", ".join(f"{k}={v}" for k, v in nd.items() if k != "id")
+        lines.append(f'  b{nd["id"]} [label="{escape(label)}"];')
+    for child, parent in view.edges:
+        lines.append(f"  b{child} -> b{parent};")
+    lines.append("}")
+    return "\n".join(lines)
+
+
+def to_graphml(view: DagView) -> str:
+    """GraphML with typed data keys; vertices + parent edges + the event
+    chain when present (log.ml to_graphml analog)."""
+    root = ET.Element("graphml",
+                      xmlns="http://graphml.graphdrawing.org/xmlns")
+    keys: dict[tuple[str, str], str] = {}
+
+    def key_id(name, typ):
+        kid = keys.get((name, typ))
+        if kid is None:
+            kid = f"k{len(keys)}"
+            keys[(name, typ)] = kid
+            el = ET.Element("key", id=kid)
+            el.set("for", "node")
+            el.set("attr.name", name)
+            el.set("attr.type", typ)
+            root.insert(0, el)
+        return kid
+
+    graph = ET.SubElement(root, "graph", edgedefault="directed")
+
+    def data_of(el, d):
+        for k, v in d.items():
+            if k == "id":
+                continue
+            typ = ("boolean" if isinstance(v, bool)
+                   else "double" if isinstance(v, float)
+                   else "long" if isinstance(v, int) else "string")
+            de = ET.SubElement(el, "data", key=key_id(k, typ))
+            de.text = str(v).lower() if isinstance(v, bool) else str(v)
+
+    for nd in view.nodes:
+        el = ET.SubElement(graph, "node", id=f"vertex{nd['id']}")
+        data_of(el, nd)
+    for child, parent in view.edges:
+        ET.SubElement(graph, "edge", source=f"vertex{child}",
+                      target=f"vertex{parent}")
+    for i, (time, kind, node, block) in enumerate(view.events):
+        el = ET.SubElement(graph, "node", id=f"event{i}")
+        data_of(el, {"time": float(time), "event": kind,
+                     "node": int(node)})
+        ET.SubElement(graph, "edge", source=f"event{i}",
+                      target=f"vertex{block}")
+        if i > 0:
+            ET.SubElement(graph, "edge", source=f"event{i - 1}",
+                          target=f"event{i}")
+    return ET.tostring(root, encoding="unicode")
+
+
+# -- forensics ---------------------------------------------------------------
+
+MALFORMED_ENV_VAR = "CPR_MALFORMED_DAG_TO_FILE"
+
+
+class MalformedDag(Exception):
+    pass
+
+
+def raise_malformed(view: DagView, message: str):
+    """Dump the offending DAG as dot when $CPR_MALFORMED_DAG_TO_FILE is
+    set, then raise (dagtools.ml Exn.raise, :227-293)."""
+    path = os.environ.get(MALFORMED_ENV_VAR)
+    if path:
+        with open(path, "w") as f:
+            f.write(to_dot(view))
+        message = f"{message} (DAG dumped to {path})"
+    raise MalformedDag(message)
