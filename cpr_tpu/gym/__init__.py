@@ -57,9 +57,14 @@ def env_fn(protocol="nakamoto", protocol_args=None,
 
 
 def _register():
+    from cpr_tpu.gym.generic_env import FC16Env, GenericEnv
+
     specs = [
         dict(id="core-v0", entry_point=Core),
         dict(id="cpr-v0", entry_point=env_fn),
+        # the alternative gym (reference: gym/rust/cpr_gym_rs/envs.py)
+        dict(id="FC16SSZwPT-v0", entry_point=FC16Env),
+        dict(id="cpr-generic-v0", entry_point=GenericEnv),
         dict(id="cpr-nakamoto-v0", entry_point=env_fn,
              kwargs=dict(protocol="nakamoto", reward="sparse_relative")),
         dict(id="cpr-tailstorm-v0", entry_point=env_fn,

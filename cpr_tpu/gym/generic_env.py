@@ -1,0 +1,238 @@
+"""Alternative gym: sampled implicit-model environments.
+
+Reference counterpart: the Rust/pyo3 gym (gym/rust/) — `FC16SSZwPT`
+(fc16.rs:28-212), the closed-form SSZ'16 Bitcoin env with probabilistic
+termination, and the generic petgraph env with the Release/Consider/
+Continue action space encoded into one f32 in (-1, 1)
+(generic/mod.rs:224-313) plus per-step invariant checking
+(generic/mod.rs:107).
+
+Here both ride the host-side implicit-MDP machinery this framework
+already has: the fc16 literature model (cpr_tpu.mdp.models) and the
+generic DAG model (cpr_tpu.mdp.generic) — written once, reused by the
+compiler, RTDP, and these envs.  The TPU hot path stays with the
+jittable SSZ envs; these are the CPU-side general-action-space gyms,
+like the reference's Rust extension is.
+"""
+
+from __future__ import annotations
+
+import random
+
+import gymnasium
+import numpy as np
+
+from cpr_tpu.mdp.generic import (Consider, Continue, Release, SingleAgent,
+                                 get_protocol)
+from cpr_tpu.mdp.implicit import Model
+from cpr_tpu.mdp.models import Fc16BitcoinSM
+from cpr_tpu.mdp.models.bitcoin_sm import ADOPT, MATCH, OVERRIDE, WAIT
+
+
+def _squash(x):
+    return x / (1.0 + x)
+
+
+class FC16Env(gymnasium.Env):
+    """SSZ'16 Bitcoin selfish mining with probabilistic termination
+    (fc16.rs:28-139): state (a, h, fork), Bernoulli mining/termination
+    draws, observation [a, h, fork] squashed into [0, 1).
+
+    Discrete(4) actions Adopt/Override/Match/Wait (the fc16 model's
+    order); an unavailable action falls back to Wait, which is always
+    available below the fork-length cutoff."""
+
+    metadata = {"render_modes": []}
+    ACTIONS = (ADOPT, OVERRIDE, MATCH, WAIT)
+
+    def __init__(self, *, alpha: float = 0.3, gamma: float = 0.5,
+                 horizon: int = 100, maximum_fork_length: int = 64,
+                 seed: int = 0):
+        self.model = Fc16BitcoinSM(alpha=alpha, gamma=gamma,
+                                   maximum_fork_length=maximum_fork_length)
+        self.horizon = horizon
+        self.rng = random.Random(seed)
+        self.action_space = gymnasium.spaces.Discrete(4)
+        self.observation_space = gymnasium.spaces.Box(
+            0.0, 1.0, shape=(3,), dtype=np.float64)
+        self.state = None
+
+    def _obs(self):
+        s = self.state
+        return np.array([_squash(float(s.a)), _squash(float(s.h)),
+                         _squash(float(s.fork))], np.float64)
+
+    def reset(self, *, seed=None, options=None):
+        super().reset(seed=seed)
+        if seed is not None:
+            self.rng = random.Random(seed)
+        states = self.model.start()
+        r = self.rng.random() * sum(p for _, p in states)
+        acc = 0.0
+        for s, p in states:
+            acc += p
+            if r <= acc:
+                break
+        self.state = s
+        return self._obs(), {}
+
+    def _sample(self, transitions):
+        r = self.rng.random() * sum(t.probability for t in transitions)
+        acc = 0.0
+        for t in transitions:
+            acc += t.probability
+            if r <= acc:
+                return t
+        return transitions[-1]
+
+    def step(self, action):
+        avail = self.model.actions(self.state)
+        a = self.ACTIONS[int(action)]
+        if a not in avail:
+            a = WAIT if WAIT in avail else avail[0]
+        t = self._sample(self.model.apply(a, self.state))
+        self.state = t.state
+        reward, progress = t.reward, t.progress
+        # probabilistic termination (Bar-Zur AFT'20): each unit of
+        # progress flips the termination coin; fair shutdown settles
+        # withheld blocks
+        done = (progress > 0.0 and self.rng.random()
+                > (1.0 - 1.0 / self.horizon) ** progress)
+        if done:
+            ts = self.model.shutdown(self.state)
+            if ts:
+                t = self._sample(ts)
+                self.state = t.state
+                reward += t.reward
+                progress += t.progress
+        info = {"progress": progress}
+        return self._obs(), float(reward), done, False, info
+
+
+def encode_action(kind: str, index: int = 0) -> float:
+    """ActionHum -> f32 in (-1, 1) (generic/mod.rs:236-248): Release(i)
+    maps below zero, Consider(i) above, Continue to exactly 0; indices
+    near zero get more of the action space."""
+    if kind == "continue":
+        return 0.0
+    x = float(index) + 1.0
+    if kind == "release":
+        return -x / (1.0 + x)
+    if kind == "consider":
+        return x / (1.0 + x)
+    raise ValueError(kind)
+
+
+def decode_action(a: float) -> tuple[str, int]:
+    """f32 -> (kind, index) (generic/mod.rs:250-279)."""
+    assert -1.0 <= a <= 1.0, f"action {a} outside [-1, 1]"
+    if a == -1.0:
+        return "release", 255
+    if a == 1.0:
+        return "consider", 255
+    x = -a / (a - 1.0) if a >= 0.0 else a / (a + 1.0)
+    x = round(x)
+    if x < 0:
+        return "release", min(-x - 1, 255)
+    if x > 0:
+        return "consider", min(x - 1, 255)
+    return "continue", 0
+
+
+class GenericEnv(gymnasium.Env):
+    """Generic DAG-protocol attack env with the Release/Consider/
+    Continue action space (generic/mod.rs:224-560) over the
+    cpr_tpu.mdp.generic model: protocols bitcoin/ethereum/byzantium/
+    parallel/ghostdag, alpha/gamma randomness, probabilistic termination
+    with fair shutdown, defender-chain reward tracking.
+
+    Action space Box(-1, 1): the scalar encodes Release(i)/Consider(i)/
+    Continue; i indexes the available-action lists (block-id order); an
+    out-of-range index clamps to the last available entry, Continue when
+    none is available (mirroring the Rust env's saturating decode).
+    """
+
+    metadata = {"render_modes": []}
+
+    def __init__(self, protocol: str = "bitcoin", *, alpha: float = 0.3,
+                 gamma: float = 0.5, horizon: int = 50, seed: int = 0,
+                 dag_size_cutoff: int | None = 24, **proto_kwargs):
+        self.model: Model = SingleAgent(
+            get_protocol(protocol, **proto_kwargs), alpha=alpha,
+            gamma=gamma, collect_garbage="simple", merge_isomorphic=False,
+            truncate_common_chain=True, dag_size_cutoff=dag_size_cutoff)
+        self.horizon = horizon
+        self.rng = random.Random(seed)
+        self.action_space = gymnasium.spaces.Box(
+            -1.0, 1.0, shape=(1,), dtype=np.float32)
+        self.observation_space = gymnasium.spaces.Box(
+            0.0, 1.0, shape=(5,), dtype=np.float64)
+        self.state = None
+
+    def _obs(self):
+        s = self.state
+        atk = self.model.proto.history(s.aview(), s.astate)
+        dfn = self.model.proto.history(s.dview(), s.dstate)
+        common = 0
+        for x, y in zip(atk, dfn):
+            if x != y:
+                break
+            common += 1
+        return np.array([
+            _squash(float(s.dag.size() - 1)),
+            _squash(float(bin(s.withheld).count("1"))),
+            _squash(float(bin(s.ignored).count("1"))),
+            _squash(float(len(atk) - common)),
+            _squash(float(len(dfn) - common)),
+        ], np.float64)
+
+    def reset(self, *, seed=None, options=None):
+        super().reset(seed=seed)
+        if seed is not None:
+            self.rng = random.Random(seed)
+        states = self.model.start()
+        r = self.rng.random() * sum(p for _, p in states)
+        acc = 0.0
+        for s, p in states:
+            acc += p
+            if r <= acc:
+                break
+        self.state = s
+        return self._obs(), {}
+
+    def _semantic(self, action) -> object:
+        kind, idx = decode_action(float(np.asarray(action).reshape(())))
+        if kind == "continue":
+            return Continue()
+        avail = [a for a in self.model.actions(self.state)
+                 if isinstance(a, Release if kind == "release"
+                               else Consider)]
+        if not avail:
+            return Continue()
+        return avail[min(idx, len(avail) - 1)]
+
+    def step(self, action):
+        t = self._sample(self.model.apply(self._semantic(action),
+                                          self.state))
+        self.state = t.state
+        reward, progress = t.reward, t.progress
+        done = (progress > 0.0 and self.rng.random()
+                > (1.0 - 1.0 / self.horizon) ** progress)
+        if done:
+            ts = self.model.shutdown(self.state)
+            if ts:
+                t = self._sample(ts)
+                self.state = t.state
+                reward += t.reward
+                progress += t.progress
+        return self._obs(), float(reward), done, False, \
+            {"progress": progress}
+
+    def _sample(self, transitions):
+        r = self.rng.random() * sum(t.probability for t in transitions)
+        acc = 0.0
+        for t in transitions:
+            acc += t.probability
+            if r <= acc:
+                return t
+        return transitions[-1]

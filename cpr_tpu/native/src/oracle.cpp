@@ -519,6 +519,425 @@ struct Bk final : Protocol {
   }
 };
 
+
+// ---------------------------------------------- parallel-PoW family
+//
+// Spar (spar.ml), Stree (stree.ml), Sdag (sdag.ml), Tailstorm
+// (tailstorm.ml): k proofs-of-work per chain block.  Votes record the
+// block/summary they confirm in `vote_id` (set at draft time, so
+// confirming-vote lookups are linear scans, no walks) and their tree
+// depth / vote number in `work`.
+
+struct ParallelBase : Protocol {
+  int k;
+  explicit ParallelBase(int k_) : k(k_) {}
+
+  static int last_block(const Dag& d, int x) {
+    while (d.blocks[x].is_vote) x = d.blocks[x].vote_id;
+    return x;
+  }
+
+  // visible votes confirming block/summary b, ascending id
+  std::vector<int> confirming(Sim& s, int node, int b) const {
+    std::vector<int> out;
+    for (int i = b + 1; i < (int)s.dag.blocks.size(); i++) {
+      if (s.dag.blocks[i].is_vote && s.dag.blocks[i].vote_id == b &&
+          s.is_visible(node, i))
+        out.push_back(i);
+    }
+    return out;
+  }
+
+  int count_confirming(Sim& s, int node, int b) const {
+    int n = 0;
+    for (int i = b + 1; i < (int)s.dag.blocks.size(); i++)
+      if (s.dag.blocks[i].is_vote && s.dag.blocks[i].vote_id == b &&
+          s.is_visible(node, i))
+        n++;
+    return n;
+  }
+
+  // preference: (height, confirming votes, -first-seen) — the shared
+  // shape of spar.ml:185-196 / stree.ml:516-528 / tailstorm.ml:183-194
+  int prefer(Sim& s, int node, int old, int x) override {
+    int b = last_block(s.dag, x);
+    int ob = last_block(s.dag, old);
+    if (b == ob) return old;
+    const Dag& d = s.dag;
+    if (d.blocks[b].height != d.blocks[ob].height)
+      return d.blocks[b].height > d.blocks[ob].height ? b : old;
+    int nb = count_confirming(s, node, b);
+    int no = count_confirming(s, node, ob);
+    if (nb != no) return nb > no ? b : old;
+    return old;  // earlier-seen (the incumbent) wins ties
+  }
+
+  double progress(const Dag& d, int head) const override {
+    return (double)d.blocks[last_block(d, head)].height * k;
+  }
+
+  long on_chain(const Dag& d, int head) const override {
+    return (long)d.blocks[last_block(d, head)].height * k;
+  }
+
+  int winner(Sim& s, const std::vector<int>& prefs) override {
+    const Dag& d = s.dag;
+    auto votes_all = [&](int b) {
+      int n = 0;
+      for (int i = b + 1; i < (int)d.blocks.size(); i++)
+        if (d.blocks[i].is_vote && d.blocks[i].vote_id == b) n++;
+      return n;
+    };
+    int best = last_block(d, prefs[0]);
+    for (int p : prefs) {
+      int b = last_block(d, p);
+      if (d.blocks[b].height > d.blocks[best].height ||
+          (d.blocks[b].height == d.blocks[best].height &&
+           votes_all(b) > votes_all(best)))
+        best = b;
+    }
+    return best;
+  }
+};
+
+struct Spar final : ParallelBase {
+  bool reward_block;
+  Spar(int k_, bool rb) : ParallelBase(k_), reward_block(rb) {}
+
+  Block genesis() const override { return Block{}; }
+
+  Block draft(Sim& s, int node, int preferred) override {
+    const Dag& d = s.dag;
+    int pref = last_block(d, preferred);
+    std::vector<int> votes = confirming(s, node, pref);
+    if ((int)votes.size() >= k - 1) {
+      // own votes first, then earliest-seen (spar.ml:205-213)
+      std::stable_sort(votes.begin(), votes.end(), [&](int a, int b) {
+        bool am = d.blocks[a].miner == node, bm = d.blocks[b].miner == node;
+        if (am != bm) return am;
+        return d.blocks[a].time < d.blocks[b].time;
+      });
+      Block blk;
+      blk.parents = {pref};
+      blk.parents.insert(blk.parents.end(), votes.begin(),
+                         votes.begin() + (k - 1));
+      blk.height = d.blocks[pref].height + 1;
+      return blk;
+    }
+    Block v;
+    v.parents = {pref};
+    v.is_vote = true;
+    v.vote_id = pref;
+    v.height = d.blocks[pref].height;
+    return v;
+  }
+
+  void rewards(const Dag& d, int head,
+               std::vector<double>& per_miner) const override {
+    for (int b = last_block(d, head); d.blocks[b].miner >= 0;
+         b = last_block(d, d.blocks[b].parents[0])) {
+      if (reward_block) {
+        per_miner[d.blocks[b].miner] += (double)k;
+      } else {
+        per_miner[d.blocks[b].miner] += 1.0;
+        for (size_t i = 1; i < d.blocks[b].parents.size(); i++) {
+          const auto& v = d.blocks[d.blocks[b].parents[i]];
+          if (v.miner >= 0) per_miner[v.miner] += 1.0;
+        }
+      }
+    }
+  }
+};
+
+// tree / path closure helper: the vote-ancestor closure of `x` down to
+// (excluding) its block, following vote parents only
+static std::vector<int> vote_closure(const Dag& d, int x) {
+  std::vector<int> out;
+  std::vector<int> stack = {x};
+  while (!stack.empty()) {
+    int v = stack.back();
+    stack.pop_back();
+    if (!d.blocks[v].is_vote) continue;
+    if (std::find(out.begin(), out.end(), v) != out.end()) continue;
+    out.push_back(v);
+    for (int p : d.blocks[v].parents) stack.push_back(p);
+  }
+  return out;
+}
+
+// own-reward-first greedy quorum of `q` votes from `cands`
+// (stree.ml:280-344 / tailstorm.ml:329-380 heuristic): each round adds
+// the candidate whose fresh closure maximizes (own, total) while still
+// fitting.  Returns the selected set or empty when infeasible.
+static std::vector<int> heuristic_quorum(const Dag& d,
+                                         const std::vector<int>& cands,
+                                         int me, int q) {
+  std::vector<int> sel;
+  auto in_sel = [&](int v) {
+    return std::find(sel.begin(), sel.end(), v) != sel.end();
+  };
+  int n = 0;
+  while (n < q) {
+    int best = -1, best_own = -1, best_all = -1;
+    for (int c : cands) {
+      if (in_sel(c)) continue;
+      int own = 0, all = 0;
+      for (int v : vote_closure(d, c)) {
+        if (in_sel(v)) continue;
+        all++;
+        if (d.blocks[v].miner == me) own++;
+      }
+      if (all < 1 || n + all > q) continue;
+      if (own > best_own || (own == best_own && all > best_all)) {
+        best = c;
+        best_own = own;
+        best_all = all;
+      }
+    }
+    if (best < 0) return {};
+    for (int v : vote_closure(d, best))
+      if (!in_sel(v)) sel.push_back(v);
+    n = (int)sel.size();
+  }
+  return sel;
+}
+
+// leaves of a selected vote set: members no other member descends from
+static std::vector<int> quorum_leaves(const Dag& d, std::vector<int> sel) {
+  std::vector<int> leaves;
+  for (int v : sel) {
+    bool has_child = false;
+    for (int w : sel) {
+      if (w == v) continue;
+      auto cl = vote_closure(d, w);
+      if (std::find(cl.begin(), cl.end(), v) != cl.end() && w != v) {
+        has_child = true;
+        break;
+      }
+    }
+    if (!has_child) leaves.push_back(v);
+  }
+  // (depth desc, pow asc) — compare_votes_in_block
+  std::sort(leaves.begin(), leaves.end(), [&](int a, int b) {
+    if (d.blocks[a].work != d.blocks[b].work)
+      return d.blocks[a].work > d.blocks[b].work;
+    return d.blocks[a].pow_hash < d.blocks[b].pow_hash;
+  });
+  return leaves;
+}
+
+struct Stree final : ParallelBase {
+  int scheme;  // 0 constant, 1 discount, 2 punish, 3 hybrid
+  Stree(int k_, int sch) : ParallelBase(k_), scheme(sch) {}
+
+  Block genesis() const override { return Block{}; }
+
+  Block draft(Sim& s, int node, int preferred) override {
+    const Dag& d = s.dag;
+    int pref = last_block(d, preferred);
+    std::vector<int> cands = confirming(s, node, pref);
+    std::vector<int> sel = heuristic_quorum(d, cands, node, k - 1);
+    if (!sel.empty() || k == 1) {
+      std::vector<int> leaves = quorum_leaves(d, sel);
+      Block blk;
+      blk.parents = {pref};
+      blk.parents.insert(blk.parents.end(), leaves.begin(), leaves.end());
+      blk.height = d.blocks[pref].height + 1;
+      return blk;
+    }
+    // extend the deepest branch (stree.ml:497-511)
+    int parent = pref, pd = 0;
+    for (int c : cands) {
+      if (d.blocks[c].work > pd ||
+          (d.blocks[c].work == pd && parent != pref &&
+           d.blocks[c].pow_hash < d.blocks[parent].pow_hash)) {
+        parent = c;
+        pd = d.blocks[c].work;
+      }
+    }
+    Block v;
+    v.parents = {parent};
+    v.is_vote = true;
+    v.vote_id = pref;
+    v.work = pd + 1;  // depth
+    v.height = d.blocks[pref].height;
+    return v;
+  }
+
+  void rewards(const Dag& d, int head,
+               std::vector<double>& per_miner) const override {
+    bool discount = scheme == 1 || scheme == 3;
+    bool punish = scheme == 2 || scheme == 3;
+    for (int b = last_block(d, head); d.blocks[b].miner >= 0;
+         b = last_block(d, d.blocks[b].parents[0])) {
+      const auto& blk = d.blocks[b];
+      if (blk.parents.size() < 2) {  // k == 1: block only
+        per_miner[blk.miner] += 1.0;
+        continue;
+      }
+      int depth_first = d.blocks[blk.parents[1]].work;
+      double r = discount ? (double)(depth_first + 1) / k : 1.0;
+      per_miner[blk.miner] += r;
+      std::vector<int> paid;
+      if (punish) {
+        paid = vote_closure(d, blk.parents[1]);
+      } else {
+        for (size_t i = 1; i < blk.parents.size(); i++)
+          for (int v : vote_closure(d, blk.parents[i]))
+            if (std::find(paid.begin(), paid.end(), v) == paid.end())
+              paid.push_back(v);
+      }
+      for (int v : paid)
+        if (d.blocks[v].miner >= 0) per_miner[d.blocks[v].miner] += r;
+    }
+  }
+};
+
+struct Tailstorm final : ParallelBase {
+  int scheme;  // 0 constant, 1 discount, 2 punish, 3 hybrid
+  Tailstorm(int k_, int sch) : ParallelBase(k_), scheme(sch) {}
+
+  Block genesis() const override { return Block{}; }
+
+  // every PoW is a vote on the deepest visible branch of the preferred
+  // summary (tailstorm.ml puzzle_payload)
+  Block draft(Sim& s, int node, int preferred) override {
+    const Dag& d = s.dag;
+    int pref = last_block(d, preferred);
+    std::vector<int> cands = confirming(s, node, pref);
+    int parent = pref, pd = 0;
+    for (int c : cands) {
+      if (d.blocks[c].work > pd ||
+          (d.blocks[c].work == pd && parent != pref &&
+           d.blocks[c].pow_hash < d.blocks[parent].pow_hash)) {
+        parent = c;
+        pd = d.blocks[c].work;
+      }
+    }
+    Block v;
+    v.parents = {parent};
+    v.is_vote = true;
+    v.vote_id = pref;
+    v.work = pd + 1;
+    v.height = d.blocks[pref].height;
+    return v;
+  }
+
+  // learning a vote may enable the next summary (non-PoW append with
+  // dedup, tailstorm.ml:565-608)
+  std::vector<Block> proposals(Sim& s, int node, int x) override {
+    const Dag& d = s.dag;
+    if (!d.blocks[x].is_vote) return {};
+    int summ = d.blocks[x].vote_id;
+    int pref = last_block(d, s.preferred[node]);
+    // only worthwhile when it can become the preferred tip
+    if (d.blocks[summ].height + 1 < d.blocks[pref].height) return {};
+    std::vector<int> cands = confirming(s, node, summ);
+    std::vector<int> sel = heuristic_quorum(d, cands, node, k);
+    if (sel.empty() && k > 0) return {};
+    std::vector<int> leaves = quorum_leaves(d, sel);
+    Block blk;
+    blk.parents = leaves;  // summaries carry only their quorum leaves
+    blk.height = d.blocks[summ].height + 1;
+    blk.vote_id = -1;
+    return {blk};
+  }
+
+  void rewards(const Dag& d, int head,
+               std::vector<double>& per_miner) const override {
+    bool discount = scheme == 1 || scheme == 3;
+    bool punish = scheme == 2 || scheme == 3;
+    for (int b = last_block(d, head);
+         !d.blocks[b].parents.empty();
+         b = last_block(d, d.blocks[b].parents[0])) {
+      const auto& blk = d.blocks[b];
+      int depth_first = d.blocks[blk.parents[0]].work;
+      double r = discount ? (double)depth_first / k : 1.0;
+      std::vector<int> paid;
+      if (punish) {
+        paid = vote_closure(d, blk.parents[0]);
+      } else {
+        for (int leaf : blk.parents)
+          for (int v : vote_closure(d, leaf))
+            if (std::find(paid.begin(), paid.end(), v) == paid.end())
+              paid.push_back(v);
+      }
+      for (int v : paid)
+        if (d.blocks[v].miner >= 0) per_miner[d.blocks[v].miner] += r;
+    }
+  }
+};
+
+struct Sdag final : ParallelBase {
+  bool discount;
+  Sdag(int k_, bool disc) : ParallelBase(k_), discount(disc) {}
+
+  Block genesis() const override { return Block{}; }
+
+  Block draft(Sim& s, int node, int preferred) override {
+    const Dag& d = s.dag;
+    int pref = last_block(d, preferred);
+    std::vector<int> cands = confirming(s, node, pref);
+    std::vector<int> sel = heuristic_quorum(d, cands, node, k - 1);
+    if (!sel.empty() || k == 1) {
+      std::vector<int> leaves = quorum_leaves(d, sel);
+      Block blk;
+      blk.parents = {pref};
+      blk.parents.insert(blk.parents.end(), leaves.begin(), leaves.end());
+      blk.height = d.blocks[pref].height + 1;
+      return blk;
+    }
+    // another vote referencing the leaves of everything seen
+    // (sdag.ml:366-396 `Partial)
+    std::vector<int> leaves = quorum_leaves(d, cands);
+    Block v;
+    v.is_vote = true;
+    v.vote_id = pref;
+    v.work = (int)cands.size() + 1;  // vote number
+    v.height = d.blocks[pref].height;
+    if (leaves.empty())
+      v.parents = {pref};
+    else
+      v.parents = leaves;
+    return v;
+  }
+
+  void rewards(const Dag& d, int head,
+               std::vector<double>& per_miner) const override {
+    for (int b = last_block(d, head); d.blocks[b].miner >= 0;
+         b = last_block(d, d.blocks[b].parents[0])) {
+      const auto& blk = d.blocks[b];
+      per_miner[blk.miner] += 1.0;  // block share c = 1 (sdag.ml reward')
+      std::vector<int> cv;
+      for (size_t i = 1; i < blk.parents.size(); i++)
+        for (int v : vote_closure(d, blk.parents[i]))
+          if (std::find(cv.begin(), cv.end(), v) == cv.end())
+            cv.push_back(v);
+      for (int v : cv) {
+        double r = 1.0;
+        if (discount) {
+          // fwd + bwd connectivity within the confirmed set
+          // (sdag.ml reward': fwd counts descendants + the next block,
+          // bwd counts ancestors)
+          int bwd = 0, fwd = 0;
+          auto anc = vote_closure(d, v);
+          for (int w : cv) {
+            if (w == v) continue;
+            auto wanc = vote_closure(d, w);
+            bool v_in_w = std::find(wanc.begin(), wanc.end(), v) != wanc.end();
+            bool w_in_v = std::find(anc.begin(), anc.end(), w) != anc.end();
+            if (v_in_w) fwd++;
+            if (w_in_v) bwd++;
+          }
+          fwd += 1;  // the hypothetical next block
+          r = (double)(fwd + bwd) / (k - 1);
+        }
+        if (d.blocks[v].miner >= 0) per_miner[d.blocks[v].miner] += r;
+      }
+    }
+  }
+};
+
 // ------------------------------------------- nakamoto withholding agent
 
 // Clean-room SSZ'16 state machine (nakamoto_ssz.ml:156-350): the attacker
@@ -718,6 +1137,17 @@ void* cpr_oracle_create(const char* protocol, int k, const char* scheme,
     s.proto.reset(new Ethereum(true));
   } else if (proto == "bk") {
     s.proto.reset(new Bk(k, sch == "block"));
+  } else if (proto == "spar") {
+    s.proto.reset(new Spar(k, sch == "block"));
+  } else if (proto == "stree" || proto == "tailstorm") {
+    int scheme = sch == "discount" ? 1 : sch == "punish" ? 2
+                 : sch == "hybrid" ? 3 : 0;
+    if (proto == "stree")
+      s.proto.reset(new Stree(k, scheme));
+    else
+      s.proto.reset(new Tailstorm(k, scheme));
+  } else if (proto == "sdag") {
+    s.proto.reset(new Sdag(k, sch == "discount"));
   } else {
     delete h;
     return nullptr;

@@ -1,0 +1,100 @@
+"""Alternative-gym tests: fc16 closed-form env + generic
+Release/Consider/Continue env.
+
+Mirrors gym/rust/test/test_rust.py + test_regenvs.py: env contract,
+action codec round-trip, revenue sanity against the closed form.
+"""
+
+import gymnasium
+import numpy as np
+import pytest
+from gymnasium.utils.env_checker import check_env
+
+import cpr_tpu.gym  # noqa: F401  (registers ids)
+from cpr_tpu.gym.generic_env import (FC16Env, GenericEnv, decode_action,
+                                     encode_action)
+
+
+def test_action_codec_roundtrip():
+    """generic/mod.rs:236-279 semantics: Continue at 0, Release below,
+    Consider above, saturating at the u8 bound."""
+    assert decode_action(0.0) == ("continue", 0)
+    for kind in ("release", "consider"):
+        for i in (0, 1, 5, 40):
+            a = encode_action(kind, i)
+            assert -1.0 < a < 1.0
+            assert decode_action(a) == (kind, i)
+    assert decode_action(-1.0) == ("release", 255)
+    assert decode_action(1.0) == ("consider", 255)
+    assert encode_action("release", 0) < 0 < encode_action("consider", 0)
+
+
+def test_fc16_env_contract():
+    check_env(FC16Env(alpha=0.3, gamma=0.5, horizon=20),
+              skip_render_check=True)
+
+
+def test_fc16_env_ids_registered():
+    for eid in ("FC16SSZwPT-v0", "cpr-generic-v0"):
+        assert eid in gymnasium.envs.registry
+    env = gymnasium.make("FC16SSZwPT-v0", alpha=0.25)
+    obs, _ = env.reset(seed=0)
+    assert obs.shape == (3,)
+
+
+def test_fc16_wait_adopt_policy_earns_alpha():
+    """Honest-equivalent play (adopt when behind, override when ahead)
+    earns ~alpha of progress across many PT episodes."""
+    env = FC16Env(alpha=0.3, gamma=0.5, horizon=30, seed=2)
+    total_r = total_p = 0.0
+    for ep in range(300):
+        obs, _ = env.reset()
+        done = False
+        while not done:
+            a, h = env.state.a, env.state.h
+            act = 1 if a > h else (0 if h > a else 3)
+            obs, r, done, trunc, info = env.step(act)
+            total_r += r
+            total_p += info["progress"]
+    assert abs(total_r / total_p - 0.3) < 0.04, total_r / total_p
+
+
+@pytest.mark.parametrize("protocol,kw", [("bitcoin", {}),
+                                         ("ghostdag", {"k": 2})])
+def test_generic_env_random_rollout(protocol, kw):
+    env = GenericEnv(protocol, alpha=0.33, gamma=0.5, horizon=20,
+                     seed=3, **kw)
+    obs, _ = env.reset(seed=1)
+    episodes = 0
+    for _ in range(400):
+        obs, r, done, trunc, info = env.step(env.action_space.sample())
+        assert obs.shape == (5,)
+        assert np.isfinite(obs).all() and np.isfinite(r)
+        if done:
+            episodes += 1
+            obs, _ = env.reset()
+    assert episodes > 0
+
+
+def test_generic_env_continue_only_is_honest():
+    """Driving with Continue plus honest Consider/Release (via the
+    model's honest action encoded through the codec) earns ~alpha."""
+    from cpr_tpu.mdp.generic import Consider, Release
+
+    env = GenericEnv("bitcoin", alpha=0.3, gamma=0.5, horizon=25, seed=4)
+    total_r = total_p = 0.0
+    for ep in range(150):
+        obs, _ = env.reset()
+        done = False
+        while not done:
+            h = env.model.honest(env.state)
+            if isinstance(h, Release):
+                a = encode_action("release", 0)
+            elif isinstance(h, Consider):
+                a = encode_action("consider", 0)
+            else:
+                a = encode_action("continue")
+            obs, r, done, trunc, info = env.step(np.float32(a))
+            total_r += r
+            total_p += info["progress"]
+    assert abs(total_r / total_p - 0.3) < 0.05, total_r / total_p

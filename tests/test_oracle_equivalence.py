@@ -111,6 +111,35 @@ def test_bk_honest_cross_engine():
     assert abs(o - j) < 0.03, (o, j)
 
 
+@pytest.mark.parametrize("family,oracle_proto,key,okw", [
+    ("spar", "spar", "spar-4-constant", dict(k=4, scheme="constant")),
+    ("stree", "stree", "stree-4-discount-heuristic",
+     dict(k=4, scheme="discount")),
+    ("sdag", "sdag", "sdag-4-constant-altruistic",
+     dict(k=4, scheme="constant")),
+    ("tailstorm", "tailstorm", "tailstorm-4-discount-heuristic",
+     dict(k=4, scheme="discount")),
+    ("tailstormjune", "stree", "tailstormjune-4-discount",
+     dict(k=4, scheme="discount")),
+])
+def test_parallel_family_honest_cross_engine(family, oracle_proto, key,
+                                             okw):
+    """Honest-play reward shares for the parallel-PoW family: JAX attack
+    env vs the oracle's multi-node implementation; both must sit at
+    alpha and agree (tailstormjune shares stree's protocol structure, so
+    the stree oracle is its anchor)."""
+    from cpr_tpu.envs import registry
+
+    alpha = 0.3
+    o = _two_agents_share(oracle_proto, alpha, 20_000, **okw)
+    env = registry.get_sized(key, 96)
+    j = jax_share(env, alpha=alpha, gamma=0.5, policy="honest",
+                  n_envs=128, max_steps=96)
+    assert abs(o - alpha) < 0.02, (family, o)
+    assert abs(j - alpha) < 0.03, (family, j)
+    assert abs(o - j) < 0.04, (family, o, j)
+
+
 def test_oracle_orphan_rates_by_difficulty():
     """The reference's stochastic battery shape (cpr_protocols.ml:200-258):
     orphan rate on a 7-node clique must be small at easy difficulty and
