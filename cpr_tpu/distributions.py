@@ -1,0 +1,128 @@
+"""Distribution samplers + string round-trip.
+
+Reference counterpart: simulator/lib/distributions.ml — constant /
+uniform / exponential / geometric samplers, the Vose alias method for
+weighted discrete draws (:12-98), and the string grammar used by
+GraphML-driven network configs (`constant 1`, `uniform 0 2`,
+`exponential 1.2`; :100-153).
+
+Two faces per distribution: `sample(rng)` for host-side simulation
+(the C++ oracle and the network sims), and `sample_jax(key)` for use
+inside jitted kernels — the same declaration drives both engines.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclass(frozen=True)
+class Distribution:
+    kind: str  # constant | uniform | exponential | geometric | discrete
+    params: tuple
+
+    def sample(self, rng: random.Random) -> float:
+        k, p = self.kind, self.params
+        if k == "constant":
+            return p[0]
+        if k == "uniform":
+            return rng.uniform(p[0], p[1])
+        if k == "exponential":
+            return rng.expovariate(1.0 / p[0])  # p[0] = expected value
+        if k == "geometric":
+            # trials until first success at probability p[0]; >= 1
+            if p[0] >= 1.0:
+                return 1.0
+            return max(1.0, float(int(np.ceil(
+                np.log(max(rng.random(), 1e-300))
+                / np.log(1.0 - p[0])))))
+        if k == "discrete":
+            return float(rng.choices(range(len(p)), weights=p)[0])
+        raise ValueError(k)
+
+    def sample_jax(self, key):
+        k, p = self.kind, self.params
+        if k == "constant":
+            return jnp.float32(p[0])
+        if k == "uniform":
+            return jax.random.uniform(key, minval=p[0], maxval=p[1])
+        if k == "exponential":
+            return jax.random.exponential(key) * p[0]
+        if k == "geometric":
+            if p[0] >= 1.0:
+                return jnp.float32(1.0)
+            u = jax.random.uniform(key, minval=1e-12, maxval=1.0)
+            return jnp.maximum(
+                jnp.ceil(jnp.log(u) / jnp.log(1.0 - p[0])), 1.0)
+        if k == "discrete":
+            # alias-free categorical; XLA computes the gumbel trick
+            w = jnp.asarray(p, jnp.float32)
+            return jax.random.categorical(key, jnp.log(w)).astype(
+                jnp.float32)
+        raise ValueError(k)
+
+    def to_string(self) -> str:
+        fmt = " ".join(_fmt_float(x) for x in self.params)
+        return f"{self.kind} {fmt}"
+
+
+def _fmt_float(x: float) -> str:
+    return str(int(x)) if float(x).is_integer() else repr(float(x))
+
+
+def constant(value: float) -> Distribution:
+    return Distribution("constant", (float(value),))
+
+
+def uniform(lower: float, upper: float) -> Distribution:
+    assert lower <= upper
+    return Distribution("uniform", (float(lower), float(upper)))
+
+
+def exponential(ev: float) -> Distribution:
+    assert ev > 0
+    return Distribution("exponential", (float(ev),))
+
+
+def geometric(p: float) -> Distribution:
+    assert 0.0 < p <= 1.0
+    return Distribution("geometric", (float(p),))
+
+
+def discrete(weights) -> Distribution:
+    ws = tuple(float(w) for w in weights)
+    assert ws and all(w >= 0 for w in ws) and sum(ws) > 0
+    return Distribution("discrete", ws)
+
+
+def of_string(s: str) -> Distribution:
+    """Parse the reference grammar (distributions.ml:100-141):
+    `constant X`, `uniform LO HI`, `exponential EV`, plus `geometric P`
+    and `discrete W...`; round-trips with to_string."""
+    parts = s.split()
+    if not parts:
+        raise ValueError("empty distribution string")
+    kind, args = parts[0], parts[1:]
+    try:
+        vals = [float(a) for a in args]
+    except ValueError:
+        raise ValueError(f"cannot parse distribution '{s}'")
+    arity = {"constant": 1, "uniform": 2, "exponential": 1,
+             "geometric": 1}
+    if kind == "discrete":
+        if not vals:
+            raise ValueError(f"cannot parse distribution '{s}'")
+        return discrete(vals)
+    if kind not in arity:
+        raise ValueError(f"unknown distribution '{kind}'")
+    if len(vals) != arity[kind]:
+        raise ValueError(
+            f"'{kind}' takes {arity[kind]} parameter(s), got {len(vals)}")
+    return {"constant": constant, "uniform": lambda a, b: uniform(a, b),
+            "exponential": exponential,
+            "geometric": geometric}[kind](*vals)

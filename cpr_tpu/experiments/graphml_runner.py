@@ -1,0 +1,68 @@
+"""GraphML-in -> simulate -> GraphML-out pipe + dot visualization.
+
+Reference counterpart: simulator/bin/graphml_runner.ml:4-44 (read a
+network GraphML, run the named protocol on it, emit the resulting DAG +
+metrics as GraphML) and experiments/simulate/visualize.ml (short sims
+rendered to graphviz dot).
+"""
+
+from __future__ import annotations
+
+import time
+from xml.etree import ElementTree as ET
+
+from cpr_tpu import network as netlib
+from cpr_tpu import trace
+from cpr_tpu.envs.registry import parse_key
+
+
+def _oracle_args(protocol_key: str):
+    """Map a protocol key onto the oracle's (protocol, k, scheme)."""
+    if protocol_key == "nakamoto":
+        return "nakamoto", 0, ""
+    family, kw = parse_key(protocol_key)
+    if family == "ethereum":
+        return f"ethereum-{kw.get('preset', 'byzantium')}", 0, ""
+    return family, kw.get("k", 0), kw.get("incentive_scheme", "")
+
+
+def run_graphml(xml_in: str, *, protocol: str = "nakamoto",
+                activations: int = 1000, seed: int = 0) -> str:
+    """The graphml_runner pipe: parse the network, simulate, and return
+    GraphML holding the block DAG, the causal trace, and run metrics."""
+    net = netlib.of_graphml(xml_in)
+    proto, k, scheme = _oracle_args(protocol)
+    t0 = time.time()
+    sim = netlib.simulate(net, protocol=proto, k=k, scheme=scheme,
+                          activations=activations, seed=seed)
+    duration = time.time() - t0
+    view = trace.view_of_oracle(sim)
+    out = trace.to_graphml(view)
+    root = ET.fromstring(out)
+    graph = next(el for el in root if el.tag.endswith("graph"))
+    for name, value in [
+            ("protocol", protocol),
+            ("activations", activations),
+            ("sim_time", sim.metric("sim_time")),
+            ("head_progress", sim.metric("progress")),
+            ("machine_duration_s", duration)]:
+        el = ET.SubElement(graph, "data", key=f"run_{name}")
+        el.text = str(value)
+    sim.close()
+    return ET.tostring(root, encoding="unicode")
+
+
+def visualize(protocol: str = "nakamoto", *, activations: int = 20,
+              n_nodes: int = 3, activation_delay: float = 10.0,
+              propagation_delay: float = 1.0, seed: int = 0) -> str:
+    """Short simulation rendered to graphviz dot (visualize.ml analog)."""
+    from cpr_tpu.native import OracleSim
+
+    proto, k, scheme = _oracle_args(protocol)
+    sim = OracleSim(proto, k=k, scheme=scheme, topology="clique",
+                    n_nodes=n_nodes, activation_delay=activation_delay,
+                    propagation_delay=propagation_delay, seed=seed)
+    sim.run(activations)
+    dot = trace.to_dot(trace.view_of_oracle(sim))
+    sim.close()
+    return dot

@@ -1,0 +1,131 @@
+"""Safety-bound queue simulations vs analytical bounds.
+
+Reference counterpart: experiments/safety-bounds/ml/ — the QueueSim
+micro discrete-event engine (QueueSim.ml), the "rigged" longest-chain
+safety model version0 (bounds.ml:7-70, after the GR22AFT paper's model
+where the attacker steals every tailgater), and the Guo-Ren AFT'22
+analytical latency-security bounds (GR22AFT.ml).
+
+The math here is the published paper's (like the fc16/aft20 MDP models,
+it must match the literature); the engine is a ~30-line heap loop.
+"""
+
+from __future__ import annotations
+
+import heapq
+import math
+import random
+from dataclasses import dataclass
+
+
+class QueueSim:
+    """Tiny discrete-event loop: handler(schedule, time, event) returns
+    None to continue or the outcome to stop (QueueSim.ml)."""
+
+    def __init__(self, init_events, handler):
+        self.queue = []
+        self.seq = 0
+        self.time = 0.0
+        self.handler = handler
+        for t, e in init_events:
+            heapq.heappush(self.queue, (t, self.seq, e))
+            self.seq += 1
+
+    def schedule(self, delay, event):
+        heapq.heappush(self.queue, (self.time + delay, self.seq, event))
+        self.seq += 1
+
+    def run(self):
+        while self.queue:
+            self.time, _, event = heapq.heappop(self.queue)
+            out = self.handler(self.schedule, self.time, event)
+            if out is not None:
+                return out
+        raise RuntimeError("empty queue")
+
+
+@dataclass(frozen=True)
+class GR22Params:
+    k: int  # confirmation depth
+    delta: float  # message delay bound
+    lam: float  # total mining rate
+    rho: float  # honest fraction
+
+    @property
+    def p(self) -> float:
+        """Probability a block is an honest 'lagger' (GR22AFT.ml p)."""
+        return self.rho * math.exp(-self.lam * self.delta)
+
+
+def t1upper(x: GR22Params) -> float:
+    """Guo-Ren theorem 1 upper bound on safety violation."""
+    p = x.p
+    assert p > 0.5, "bound needs honest laggers in the majority"
+    return (2.0 + 2.0 * math.sqrt(p / (1.0 - p))) * \
+        (4.0 * p * (1.0 - p)) ** x.k
+
+
+def t1lower(x: GR22Params) -> float:
+    return (4.0 * x.rho * (1.0 - x.rho)) ** x.k / math.sqrt(x.k)
+
+
+def catchup_probability(deficit: int, p: float) -> float:
+    """Chance a rigged attacker ever closes a `deficit`-block gap
+    (gambler's ruin, GR22AFT.ml t2F1)."""
+    q = 1.0 - p
+    return (q / p) ** deficit
+
+
+def rigged_attack(*, k: int, cutoff: int, tau: float, lam: float,
+                  alpha: float, delta: float, atk_plus: int = 0,
+                  rng: random.Random) -> bool:
+    """One episode of the version0 rigged model (bounds.ml:17-70): the
+    attacker owns its own blocks AND every honest tailgater (mined
+    within delta of the previous block); a target transaction enters the
+    defender chain after time tau and commits after k confirmations;
+    returns True when the attacker can revert it."""
+    state = {"attacker": 0, "defender": 0, "tx": ("pending",)}
+
+    def sample_mining():
+        d = rng.expovariate(lam)
+        return d, (d <= delta, rng.random() <= alpha)
+
+    def handler(schedule, now, event):
+        if state["tx"][0] == "pending":
+            state["attacker"] = max(state["attacker"], state["defender"])
+        tailgater, by_attacker = event
+        if by_attacker or tailgater:
+            state["attacker"] += 1
+        else:
+            state["defender"] += 1
+            tx = state["tx"]
+            if tx[0] == "pending" and now >= tau:
+                state["tx"] = ("included", state["defender"])
+            elif tx[0] == "included" and state["defender"] >= tx[1] + k:
+                state["tx"] = ("committed",)
+        schedule(*sample_mining())
+        if state["tx"][0] != "committed":
+            return None
+        if state["attacker"] >= state["defender"]:
+            return True
+        deficit = state["defender"] - state["attacker"]
+        if deficit > cutoff:
+            p = GR22Params(k=k, delta=delta, lam=lam,
+                           rho=1.0 - alpha).p
+            return rng.random() <= catchup_probability(
+                deficit - atk_plus, p)
+        return None
+
+    d, e = sample_mining()
+    return QueueSim([(d, e)], handler).run()
+
+
+def violation_rate(*, k: int, alpha: float, lam: float, delta: float,
+                   tau: float = 1.0, cutoff: int = 32,
+                   episodes: int = 2000, seed: int = 0) -> float:
+    rng = random.Random(seed)
+    fails = sum(
+        rigged_attack(k=k, cutoff=cutoff, tau=tau, lam=lam, alpha=alpha,
+                      delta=delta, rng=rng)
+        for _ in range(episodes))
+    return fails / episodes

@@ -1,0 +1,150 @@
+"""Cross-validation simulators for the generic protocol specs.
+
+Reference counterpart: mdp/lib/models/generic_v1/sim.py:5-131 —
+SingleMinerSim (one miner extends its own chain; sanity-checks reward
+and progress accounting) and NetworkSim (a small discrete-event network
+of miners with sampled mining and message delays, judged by an
+omniscient observer).  These validate the protocol specs independently
+of the attack model: honest networks must pay each miner its compute
+share and keep progress consistent.
+
+Built on the same immutable GDag/View machinery as the model, so a spec
+that passes here exercises exactly the code the MDP compiler uses.
+"""
+
+from __future__ import annotations
+
+import heapq
+import random
+from typing import Callable
+
+from cpr_tpu.mdp.generic.dag import GDag, View
+from cpr_tpu.mdp.generic.protocols.base import ProtocolSpec
+
+
+class SingleMinerSim:
+    """One miner, no network: every block is delivered instantly."""
+
+    def __init__(self, proto: ProtocolSpec):
+        self.proto = proto
+        self.dag = GDag.genesis_dag()
+        self.visible = 1
+        self.pstate = proto.init(View(self.dag, 1, 0))
+
+    def view(self) -> View:
+        return View(self.dag, self.visible, 0)
+
+    def step(self):
+        parents = self.proto.mining(self.view(), self.pstate)
+        self.dag, b = self.dag.append(parents, 0)
+        self.visible |= 1 << b
+        self.pstate = self.proto.update(self.view(), self.pstate, b)
+
+    def reward_and_progress(self):
+        view = self.view()
+        hist = self.proto.history(view, self.pstate)
+        rew = prg = 0.0
+        for b in hist[1:]:
+            prg += self.proto.progress(view, b)
+            for _, amount in self.proto.coinbase(view, b):
+                rew += amount
+        return rew, prg
+
+    def run(self, max_progress: float):
+        rew = prg = 0.0
+        while prg < max_progress:
+            self.step()
+            rew, prg = self.reward_and_progress()
+        return rew, prg
+
+
+class NetworkSim:
+    """Discrete-event network of honest miners running a protocol spec;
+    an omniscient judge miner scores the final history
+    (generic_v1/sim.py:54-131)."""
+
+    def __init__(self, proto: ProtocolSpec, *, n_miners: int,
+                 mining_delay: Callable[[random.Random], float],
+                 select_miner: Callable[[random.Random], int],
+                 message_delay: Callable[[random.Random], float],
+                 seed: int = 0):
+        self.proto = proto
+        self.rng = random.Random(seed)
+        self.n_miners = n_miners
+        self.dag = GDag.genesis_dag()
+        self.visible = [1] * n_miners  # per-miner bitmask
+        self.pstates = [proto.init(View(self.dag, 1, i))
+                        for i in range(n_miners)]
+        self.mining_delay = mining_delay
+        self.select_miner = select_miner
+        self.message_delay = message_delay
+        self.clock = 0.0
+        self._seq = 0
+        self.queue: list = []
+        self._push(self.mining_delay(self.rng), ("mine",))
+
+    def _push(self, delay, event):
+        heapq.heappush(self.queue, (self.clock + delay, self._seq, event))
+        self._seq += 1
+
+    def _view(self, i) -> View:
+        return View(self.dag, self.visible[i], i)
+
+    def _deliver(self, i, b):
+        if self.visible[i] & (1 << b):
+            return
+        for p in self.dag.parents[b]:  # in-order delivery
+            self._deliver(i, p)
+        self.visible[i] |= 1 << b
+        self.pstates[i] = self.proto.update(self._view(i),
+                                            self.pstates[i], b)
+
+    def _mine(self):
+        m = self.select_miner(self.rng)
+        parents = self.proto.mining(self._view(m), self.pstates[m])
+        self.dag, b = self.dag.append(parents, m)
+        self._deliver(m, b)
+        for i in range(self.n_miners):
+            if i != m:
+                self._push(self.message_delay(self.rng),
+                           ("recv", i, b))
+        self._push(self.mining_delay(self.rng), ("mine",))
+
+    def step(self):
+        self.clock, _, event = heapq.heappop(self.queue)
+        if event[0] == "mine":
+            self._mine()
+        else:
+            _, i, b = event
+            self._deliver(i, b)
+
+    def judge(self):
+        """Omniscient scoring: per-miner rewards + progress of the full
+        visibility history."""
+        view = View(self.dag, self.dag.all_mask(), -1)
+        # replay deliveries in topological order
+        vis = 1
+        judge_state = self.proto.init(View(GDag.genesis_dag(), 1, -1))
+        for b in range(1, self.dag.size()):
+            vis |= 1 << b
+            judge_state = self.proto.update(
+                View(self.dag, vis, -1), judge_state, b)
+        hist = self.proto.history(view, judge_state)
+        rewards = [0.0] * self.n_miners
+        prg = 0.0
+        for b in hist[1:]:
+            prg += self.proto.progress(view, b)
+            for miner, amount in self.proto.coinbase(view, b):
+                if 0 <= miner < self.n_miners:
+                    rewards[miner] += amount
+        return dict(time=self.clock, blocks=self.dag.size(),
+                    rewards=rewards, progress=prg)
+
+    def run(self, max_progress: float):
+        # judging replays the DAG; amortize by checking periodically
+        while True:
+            for _ in range(16):
+                self.step()
+            out = self.judge()
+            if out["progress"] >= max_progress:
+                return out

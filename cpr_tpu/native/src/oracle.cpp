@@ -113,6 +113,11 @@ struct Sim {
   // link delays: delay_matrix[src][dst]; -1 = uniform attacker delay
   std::vector<std::vector<double>> delay;
   double attacker_delay_upper = 0.0;    // uniform upper bound for src 0
+  // optional general link distributions (custom topologies):
+  // kind 0 constant(p0), 1 uniform(p0,p1), 2 exponential(ev=p0)
+  bool custom_links = false;
+  std::vector<int> lkind;
+  std::vector<double> lp0, lp1;
 
   std::vector<std::vector<char>> visible;   // [node][block]
   std::vector<std::vector<char>> known;     // received but maybe buffered
@@ -194,12 +199,31 @@ struct Sim {
     return n_nodes - 1;
   }
 
+  // negative = no link (caller must skip the send)
+  double link_delay(int src, int dst) {
+    if (custom_links) {
+      int i = src * n_nodes + dst;
+      if (lkind[i] < 0) return -1.0;
+      switch (lkind[i]) {
+        case 1:
+          return lp0[i] + rand_u() * (lp1[i] - lp0[i]);
+        case 2:
+          return -std::log(std::max(rand_u(), 1e-300)) * lp0[i];
+        default:
+          return lp0[i];
+      }
+    }
+    double d = delay[src][dst];
+    if (d < 0) d = rand_u() * attacker_delay_upper;
+    return d;
+  }
+
   void send(int src, int b) {  // share a block on all links
     record(1, src, b);
     for (int dst = 0; dst < n_nodes; dst++) {
       if (dst == src) continue;
-      double d = delay[src][dst];
-      if (d < 0) d = rand_u() * attacker_delay_upper;
+      double d = link_delay(src, dst);
+      if (d < 0) continue;  // no link
       push(now + d, 1, dst, b);
     }
   }
@@ -1244,6 +1268,27 @@ double cpr_oracle_metric(void* hp, int what, int arg) {
     default:
       return std::nan("");
   }
+}
+
+// custom topology: per-node compute weights and per-link delay
+// distributions (kind 0 constant, 1 uniform, 2 exponential), row-major
+// n*n arrays.  Protocol/k/scheme as in cpr_oracle_create.
+void* cpr_oracle_create_custom(const char* protocol, int k,
+                               const char* scheme, int n_nodes,
+                               const double* compute, const int* dkind,
+                               const double* dp0, const double* dp1,
+                               double activation_delay, uint64_t seed) {
+  auto* h = static_cast<Handle*>(cpr_oracle_create(
+      protocol, k, scheme, "clique", n_nodes, 0.0, 0.0, 2,
+      activation_delay, 0.0, "none", seed));
+  if (!h) return nullptr;
+  Sim& s = h->sim;
+  s.compute.assign(compute, compute + n_nodes);
+  s.custom_links = true;
+  s.lkind.assign(dkind, dkind + n_nodes * n_nodes);
+  s.lp0.assign(dp0, dp0 + n_nodes * n_nodes);
+  s.lp1.assign(dp1, dp1 + n_nodes * n_nodes);
+  return h;
 }
 
 long cpr_oracle_trace_len(void* hp) {

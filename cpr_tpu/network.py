@@ -1,0 +1,210 @@
+"""Network topology model + GraphML round-trip + simulation bridge.
+
+Reference counterpart: simulator/lib/network.ml — the topology record
+(nodes with compute + delay-distribution links, :3-33), constructors
+symmetric_clique / two_agents / selfish_mining (:36-105), and the
+GraphML round-trip used by graphml_runner and the igraph topology
+studies (:115-232; experiments/simulate-topology/igraph.ml).
+
+Custom topologies execute on the C++ oracle through its custom-link C
+API; constant/uniform/exponential link delays map directly, other
+distributions are rejected at run time.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from dataclasses import dataclass, field
+from xml.etree import ElementTree as ET
+
+from cpr_tpu import distributions as dist
+from cpr_tpu.native import OracleSim, lib
+
+
+@dataclass
+class Link:
+    dest: int
+    delay: dist.Distribution
+
+
+@dataclass
+class NetNode:
+    compute: float
+    links: list[Link] = field(default_factory=list)
+
+
+@dataclass
+class Network:
+    nodes: list[NetNode]
+    activation_delay: float = 1.0
+    dissemination: str = "simple"
+
+
+def symmetric_clique(n: int, *, activation_delay: float,
+                     propagation_delay: float) -> Network:
+    """network.ml:36-48."""
+    d = dist.constant(propagation_delay)
+    return Network(
+        nodes=[NetNode(1.0 / n, [Link(j, d) for j in range(n) if j != i])
+               for i in range(n)],
+        activation_delay=activation_delay)
+
+
+def two_agents(*, alpha: float, activation_delay: float) -> Network:
+    """network.ml:50-59."""
+    z = dist.constant(0.0)
+    return Network(nodes=[NetNode(alpha, [Link(1, z)]),
+                          NetNode(1.0 - alpha, [Link(0, z)])],
+                   activation_delay=activation_delay)
+
+
+def selfish_mining(*, alpha: float, gamma: float, defenders: int,
+                   activation_delay: float,
+                   propagation_delay: float) -> Network:
+    """network.ml:61-105: gamma emulated by uniform attacker delays."""
+    assert defenders >= 2
+    d = defenders
+    if gamma > (d - 1) / d:
+        raise ValueError("gamma must not exceed (defenders-1)/defenders")
+    g = max(gamma, 1e-6)  # see the oracle's gamma-0 note
+    atk = dist.uniform(0.0, (d - 1) / d * propagation_delay / g)
+    prop = dist.constant(propagation_delay)
+    zero = dist.constant(0.0)
+    nodes = [NetNode(alpha, [Link(j, atk) for j in range(1, d + 1)])]
+    for i in range(1, d + 1):
+        links = [Link(0, zero)]
+        links += [Link(j, prop) for j in range(1, d + 1) if j != i]
+        nodes.append(NetNode((1.0 - alpha) / d, links))
+    return Network(nodes=nodes, activation_delay=activation_delay)
+
+
+# -- GraphML round-trip ------------------------------------------------------
+
+
+def to_graphml(net: Network) -> str:
+    """network.ml:115-170 analog: nodes carry compute, edges carry the
+    link-delay distribution string; graph data holds activation delay
+    and dissemination."""
+    root = ET.Element("graphml",
+                      xmlns="http://graphml.graphdrawing.org/xmlns")
+    for kid, name, typ, dom in [
+            ("d0", "activation_delay", "double", "graph"),
+            ("d1", "dissemination", "string", "graph"),
+            ("d2", "compute", "double", "node"),
+            ("d3", "delay", "string", "edge")]:
+        el = ET.SubElement(root, "key", id=kid)
+        el.set("for", dom)
+        el.set("attr.name", name)
+        el.set("attr.type", typ)
+    graph = ET.SubElement(root, "graph", edgedefault="directed")
+    ET.SubElement(graph, "data", key="d0").text = \
+        repr(net.activation_delay)
+    ET.SubElement(graph, "data", key="d1").text = net.dissemination
+    for i, node in enumerate(net.nodes):
+        el = ET.SubElement(graph, "node", id=f"n{i}")
+        ET.SubElement(el, "data", key="d2").text = repr(node.compute)
+    for i, node in enumerate(net.nodes):
+        for link in node.links:
+            el = ET.SubElement(graph, "edge", source=f"n{i}",
+                               target=f"n{link.dest}")
+            ET.SubElement(el, "data", key="d3").text = \
+                link.delay.to_string()
+    return ET.tostring(root, encoding="unicode")
+
+
+def of_graphml(xml: str) -> Network:
+    root = ET.fromstring(xml)
+
+    def strip(tag):
+        return tag.rsplit("}", 1)[-1]
+
+    keys = {}
+    for el in root:
+        if strip(el.tag) == "key":
+            keys[el.get("id")] = el.get("attr.name")
+    graph = next(el for el in root if strip(el.tag) == "graph")
+    undirected = graph.get("edgedefault") == "undirected"
+    activation_delay, dissemination = 1.0, "simple"
+    node_ids: dict[str, int] = {}
+    nodes: list[NetNode] = []
+    for el in graph:
+        tag = strip(el.tag)
+        if tag == "data":
+            name = keys.get(el.get("key"))
+            if name == "activation_delay":
+                activation_delay = float(el.text)
+            elif name == "dissemination":
+                dissemination = el.text.strip()
+        elif tag == "node":
+            compute = 0.0
+            for d in el:
+                if keys.get(d.get("key")) == "compute":
+                    compute = float(d.text)
+            node_ids[el.get("id")] = len(nodes)
+            nodes.append(NetNode(compute))
+    for el in graph:
+        if strip(el.tag) == "edge":
+            delay = dist.constant(0.0)
+            for d in el:
+                if keys.get(d.get("key")) == "delay":
+                    delay = dist.of_string(d.text)
+            src = node_ids[el.get("source")]
+            dst = node_ids[el.get("target")]
+            nodes[src].links.append(Link(dst, delay))
+            if undirected:
+                nodes[dst].links.append(Link(src, delay))
+    return Network(nodes=nodes, activation_delay=activation_delay,
+                   dissemination=dissemination)
+
+
+# -- execution on the oracle -------------------------------------------------
+
+_KINDS = {"constant": 0, "uniform": 1, "exponential": 2}
+
+
+def simulate(net: Network, *, protocol: str = "nakamoto", k: int = 0,
+             scheme: str = "", activations: int, seed: int = 0):
+    """Run an arbitrary topology on the C++ oracle
+    (simulate-topology/igraph.ml + graphml_runner analog).  Returns the
+    OracleSim after `activations` puzzle solutions."""
+    if net.dissemination != "simple":
+        raise ValueError(
+            f"oracle implements simple dissemination only, not "
+            f"'{net.dissemination}'")
+    n = len(net.nodes)
+    L = lib()
+    L.cpr_oracle_create_custom.restype = ctypes.c_void_p
+    L.cpr_oracle_create_custom.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_double),
+        ctypes.c_double, ctypes.c_uint64]
+    compute = (ctypes.c_double * n)(*[nd.compute for nd in net.nodes])
+    kind = (ctypes.c_int * (n * n))()
+    p0 = (ctypes.c_double * (n * n))()
+    p1 = (ctypes.c_double * (n * n))()
+    # unlinked pairs: kind -1 tells the oracle to skip the send
+    # entirely (no dead events in the queue)
+    for i in range(n * n):
+        kind[i] = -1
+    for i, nd in enumerate(net.nodes):
+        for link in nd.links:
+            j = i * n + link.dest
+            d = link.delay
+            if d.kind not in _KINDS:
+                raise ValueError(
+                    f"oracle supports constant/uniform/exponential link "
+                    f"delays, not '{d.kind}'")
+            kind[j] = _KINDS[d.kind]
+            p0[j] = d.params[0]
+            p1[j] = d.params[1] if len(d.params) > 1 else 0.0
+    handle = L.cpr_oracle_create_custom(
+        protocol.encode(), k, scheme.encode(), n, compute, kind, p0, p1,
+        net.activation_delay, seed)
+    if not handle:
+        raise ValueError(f"oracle rejected protocol '{protocol}'")
+    sim = OracleSim.__new__(OracleSim)
+    sim._lib = L
+    sim._h = handle
+    sim.run(activations)
+    return sim

@@ -1,0 +1,108 @@
+"""Distribution library, network topologies + GraphML round-trip,
+custom-topology simulation, the graphml_runner pipe, and safety bounds.
+
+Mirrors the reference's distribution round-trip tests
+(distributions.ml:155-184), network GraphML tests (network.ml:234-270),
+graphml_runner.ml, and the safety-bounds comparison (bounds.ml).
+"""
+
+import random
+
+import jax
+import numpy as np
+import pytest
+
+from cpr_tpu import distributions as dist
+from cpr_tpu import network as netlib
+from cpr_tpu.experiments.graphml_runner import run_graphml, visualize
+from cpr_tpu.experiments.safety_bounds import (GR22Params, t1lower, t1upper,
+                                               violation_rate)
+
+
+def test_distribution_string_roundtrip():
+    """distributions.ml:155-184 expectations."""
+    for s in ("constant 1", "constant 0", "constant 1.2",
+              "uniform 1.2 2", "exponential 1.2", "geometric 0.5",
+              "discrete 1 2 3"):
+        d = dist.of_string(s)
+        assert dist.of_string(d.to_string()) == d
+    for bad in ("", "random", "constant", "uniform 1",
+                "exponential 1 2", "discrete"):
+        with pytest.raises(ValueError):
+            dist.of_string(bad)
+
+
+def test_distribution_sampling_moments():
+    rng = random.Random(0)
+    u = dist.uniform(1.0, 3.0)
+    e = dist.exponential(2.5)
+    us = [u.sample(rng) for _ in range(4000)]
+    es = [e.sample(rng) for _ in range(4000)]
+    assert abs(np.mean(us) - 2.0) < 0.05
+    assert all(1.0 <= x <= 3.0 for x in us)
+    assert abs(np.mean(es) - 2.5) < 0.15
+    # jax face agrees
+    keys = jax.random.split(jax.random.PRNGKey(0), 4000)
+    js = jax.vmap(e.sample_jax)(keys)
+    assert abs(float(js.mean()) - 2.5) < 0.15
+
+
+def test_network_graphml_roundtrip():
+    net = netlib.selfish_mining(alpha=0.3, gamma=0.5, defenders=3,
+                                activation_delay=30.0,
+                                propagation_delay=1.0)
+    xml = netlib.to_graphml(net)
+    back = netlib.of_graphml(xml)
+    assert back.activation_delay == net.activation_delay
+    assert len(back.nodes) == len(net.nodes)
+    for a, b in zip(net.nodes, back.nodes):
+        assert a.compute == pytest.approx(b.compute)
+        assert [(l.dest, l.delay) for l in a.links] == \
+            [(l.dest, l.delay) for l in b.links]
+
+
+def test_custom_topology_simulation():
+    """A star network: the hub relays nothing (simple dissemination),
+    so leaves only learn hub blocks — leaves orphan each other."""
+    z = dist.constant(0.5)
+    nodes = [netlib.NetNode(0.4, [netlib.Link(1, z), netlib.Link(2, z)]),
+             netlib.NetNode(0.3, [netlib.Link(0, z)]),
+             netlib.NetNode(0.3, [netlib.Link(0, z)])]
+    net = netlib.Network(nodes=nodes, activation_delay=10.0)
+    sim = netlib.simulate(net, activations=3000, seed=1)
+    assert sim.metric("head_height") > 0
+    rw = sim.rewards(3)
+    # hub hears everyone: it earns at least its share
+    assert rw[0] / sum(rw) >= 0.35, rw
+    sim.close()
+
+
+def test_graphml_runner_pipe():
+    net = netlib.symmetric_clique(4, activation_delay=20.0,
+                                  propagation_delay=1.0)
+    out = run_graphml(netlib.to_graphml(net), protocol="nakamoto",
+                      activations=200, seed=2)
+    assert "vertex" in out and "run_protocol" in out
+    out2 = run_graphml(netlib.to_graphml(net), protocol="bk-4-constant",
+                       activations=200, seed=2)
+    assert "vertex" in out2
+
+
+def test_visualize_dot():
+    dot = visualize("nakamoto", activations=12, n_nodes=3, seed=4)
+    assert dot.startswith("digraph") and dot.count("->") >= 12
+
+
+def test_safety_bound_between_analytical_bounds():
+    """Monte-Carlo violation rate of the rigged model sits between the
+    Guo-Ren lower and upper bounds (bounds.ml's comparison)."""
+    k, alpha, lam, delta = 4, 0.2, 0.2, 1.0
+    x = GR22Params(k=k, delta=delta, lam=lam, rho=1.0 - alpha)
+    mc = violation_rate(k=k, alpha=alpha, lam=lam, delta=delta,
+                        episodes=3000, seed=5)
+    assert t1lower(x) * 0.1 <= mc <= t1upper(x), \
+        (t1lower(x), mc, t1upper(x))
+    # deeper confirmation -> safer
+    mc8 = violation_rate(k=8, alpha=alpha, lam=lam, delta=delta,
+                         episodes=3000, seed=6)
+    assert mc8 <= mc
