@@ -113,8 +113,9 @@ def test_bk_honest_cross_engine():
 
 @pytest.mark.parametrize("family,oracle_proto,key,okw", [
     ("spar", "spar", "spar-4-constant", dict(k=4, scheme="constant")),
-    ("stree", "stree", "stree-4-discount-heuristic",
-     dict(k=4, scheme="discount")),
+    pytest.param("stree", "stree", "stree-4-discount-heuristic",
+                 dict(k=4, scheme="discount"),
+                 marks=pytest.mark.slow),  # structure shared with june
     ("sdag", "sdag", "sdag-4-constant-altruistic",
      dict(k=4, scheme="constant")),
     ("tailstorm", "tailstorm", "tailstorm-4-discount-heuristic",

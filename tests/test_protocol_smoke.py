@@ -16,16 +16,16 @@ import pytest
 from cpr_tpu.envs import registry
 from cpr_tpu.params import make_params
 
+# one config per family + one per selection algorithm; the remaining
+# scheme/selection combinations live in the slow-tier batteries
 KEYS = (
     "nakamoto",
     "ethereum-byzantium",
     "bk-4-constant",
     "spar-4-block",
-    "stree-4-discount-altruistic",
     "stree-4-constant-optimal",
     "sdag-4-constant-altruistic",
     "tailstorm-4-discount-heuristic",
-    "tailstorm-4-constant-optimal",
     "tailstormjune-4-block",
 )
 
