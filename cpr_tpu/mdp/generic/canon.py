@@ -31,6 +31,8 @@ def _refine(n, parents, children, colors):
     """Directed color refinement to a stable partition; colors are dense
     ranks, refining the input coloring."""
     while True:
+        if len(set(colors)) == n:
+            return colors  # already discrete
         sig = [
             (
                 colors[v],
@@ -90,6 +92,11 @@ def canonical_order(parents: tuple[tuple[int, ...], ...],
     repairs nauty's labels the same way, for the same reason).
     """
     n = len(parents)
+    if len(set(colors)) == n:
+        # colors already discrete: they ARE a canonical rank, so sort
+        # directly on (height, color) without any search
+        return tuple(sorted(range(n),
+                            key=lambda b: (heights[b], colors[b])))
     children: list[list[int]] = [[] for _ in range(n)]
     for b, ps in enumerate(parents):
         for p in ps:
