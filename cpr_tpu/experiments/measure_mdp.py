@@ -1,0 +1,74 @@
+"""MDP solve-time measurement sweep.
+
+Reference counterpart: mdp/sprint-0-explicit-mdps/measure-ours.py and
+measure-multicore.py — compile a battery of attack models, solve each
+with value iteration, and record sizes + wall-times (the reference
+filters to models under 1M transitions; same default here).
+
+One row per (model, alpha, gamma): state/transition counts, compile and
+solve wall-times, optimal revenue.  Feeds write_tsv like every other
+sweep.
+"""
+
+from __future__ import annotations
+
+import time
+
+from cpr_tpu.mdp import Compiler, ptmdp
+from cpr_tpu.mdp.generic import SingleAgent, get_protocol
+from cpr_tpu.mdp.models import Aft20BitcoinSM, Fc16BitcoinSM
+
+
+def model_battery(alphas=(0.25, 0.33, 0.4), gamma=0.5):
+    """(name, factory) pairs covering the literature + generic models."""
+    battery = []
+    for a in alphas:
+        battery.append((f"fc16-{a}", lambda a=a: Fc16BitcoinSM(
+            alpha=a, gamma=gamma, maximum_fork_length=20)))
+        battery.append((f"aft20-{a}", lambda a=a: Aft20BitcoinSM(
+            alpha=a, gamma=gamma, maximum_fork_length=20)))
+        for proto, kw, cutoff in (("bitcoin", {}, 7),
+                                  ("ghostdag", {"k": 2}, 7)):
+            battery.append((
+                f"generic-{proto}-{a}",
+                lambda a=a, proto=proto, kw=kw, cutoff=cutoff:
+                SingleAgent(get_protocol(proto, **kw), alpha=a,
+                            gamma=gamma, collect_garbage="simple",
+                            merge_isomorphic=True,
+                            truncate_common_chain=True,
+                            dag_size_cutoff=cutoff)))
+    return battery
+
+
+def measure_rows(battery=None, *, horizon=100, stop_delta=1e-6,
+                 max_transitions=1_000_000, mesh=None):
+    """Compile + solve each model; skip those over `max_transitions`
+    (measure-ours.py:14-21 filter)."""
+    rows = []
+    if battery is None:
+        battery = model_battery()
+    for name, factory in battery:
+        t0 = time.time()
+        mdp = ptmdp(Compiler(factory()).mdp(), horizon=horizon)
+        compile_s = time.time() - t0
+        row = {"model": name, "n_states": mdp.n_states,
+               "n_transitions": mdp.n_transitions,
+               "compile_s": compile_s}
+        if mdp.n_transitions > max_transitions:
+            row["skipped"] = "transition cap"
+            rows.append(row)
+            continue
+        tm = mdp.tensor()
+        t0 = time.time()
+        if mesh is not None:
+            from cpr_tpu.parallel import sharded_value_iteration
+            vi = sharded_value_iteration(tm, mesh, stop_delta=stop_delta)
+        else:
+            vi = tm.value_iteration(stop_delta=stop_delta)
+        row["vi_s"] = time.time() - t0
+        row["vi_iter"] = int(vi["vi_iter"])
+        prog = tm.start_value(vi["vi_progress"])
+        row["revenue"] = (float(tm.start_value(vi["vi_value"]) / prog)
+                          if prog else 0.0)
+        rows.append(row)
+    return rows

@@ -175,31 +175,52 @@ def train_from_config(cfg: TrainConfig, *, out_dir: str | None = None,
 
     total = n_updates if n_updates is not None else cfg.total_updates
     history, eval_rows, best = [], [], -np.inf
-    for i in range(total):
-        carry, metrics = step(carry)
-        m = {k: float(v) for k, v in metrics.items()}
-        history.append(m)
-        if progress is not None:
-            progress(i, m)
-        # the first start_at_iteration updates never evaluate (early
-        # deterministic policies are degenerate — cfg_model rationale)
-        due = (i + 1) % cfg.eval.freq == 0 or i + 1 == total
-        if due and i + 1 > cfg.eval.start_at_iteration:
-            rows = evaluate_per_alpha(env, cfg, carry[0].params)
-            for r in rows:
-                r["update"] = i + 1
-            eval_rows.extend(rows)
-            if out_dir is not None:
-                score = float(np.mean(
-                    [r["relative_reward"] for r in rows]))
-                meta = dict(update=i + 1, score=score,
-                            protocol=cfg.protocol)
-                save_checkpoint(os.path.join(out_dir,
-                                             "last-model.msgpack"),
-                                carry[0].params, meta)
-                if score > best:
-                    best = score
+    metrics_log = None
+    if out_dir is not None:
+        os.makedirs(out_dir, exist_ok=True)
+        # JSONL metrics stream (the W&B-run-log analog, ppo.py:180-193):
+        # one line per update, eval rows tagged; a header line separates
+        # runs appended into the same directory
+        metrics_log = open(os.path.join(out_dir, "metrics.jsonl"), "a")
+        metrics_log.write(json.dumps(
+            {"run": True, "protocol": cfg.protocol, "seed": cfg.seed,
+             "total_updates": total}) + "\n")
+    try:
+        for i in range(total):
+            carry, metrics = step(carry)
+            m = {k: float(v) for k, v in metrics.items()}
+            history.append(m)
+            if metrics_log is not None:
+                metrics_log.write(json.dumps({"update": i + 1, **m}) + "\n")
+            if progress is not None:
+                progress(i, m)
+            # the first start_at_iteration updates never evaluate (early
+            # deterministic policies are degenerate — cfg_model rationale)
+            due = (i + 1) % cfg.eval.freq == 0 or i + 1 == total
+            if due and i + 1 > cfg.eval.start_at_iteration:
+                rows = evaluate_per_alpha(env, cfg, carry[0].params)
+                for r in rows:
+                    r["update"] = i + 1
+                eval_rows.extend(rows)
+                if metrics_log is not None:
+                    for r in rows:
+                        metrics_log.write(
+                            json.dumps({"eval": True, **r}) + "\n")
+                    metrics_log.flush()
+                if out_dir is not None:
+                    score = float(np.mean(
+                        [r["relative_reward"] for r in rows]))
+                    meta = dict(update=i + 1, score=score,
+                                protocol=cfg.protocol)
                     save_checkpoint(os.path.join(out_dir,
-                                                 "best-model.msgpack"),
+                                                 "last-model.msgpack"),
                                     carry[0].params, meta)
+                    if score > best:
+                        best = score
+                        save_checkpoint(os.path.join(out_dir,
+                                                     "best-model.msgpack"),
+                                        carry[0].params, meta)
+    finally:
+        if metrics_log is not None:
+            metrics_log.close()
     return carry[0].params, history, eval_rows

@@ -72,6 +72,23 @@ def test_break_even_sm1():
     assert a == b
 
 
+def test_measure_mdp_rows():
+    """measure-ours.py analog: sizes + wall-times + revenue per model,
+    with the transition cap honored."""
+    from cpr_tpu.experiments.measure_mdp import measure_rows
+    from cpr_tpu.mdp.models import Fc16BitcoinSM
+
+    rows = measure_rows(
+        [("small", lambda: Fc16BitcoinSM(alpha=0.3, gamma=0.5,
+                                         maximum_fork_length=8)),
+         ("capped", lambda: Fc16BitcoinSM(alpha=0.3, gamma=0.5,
+                                          maximum_fork_length=12))],
+        horizon=20, max_transitions=2000)
+    assert rows[0]["vi_iter"] > 0 and 0.2 < rows[0]["revenue"] < 0.6
+    assert rows[1].get("skipped") == "transition cap"
+    write_tsv(rows)
+
+
 def test_config_yaml_roundtrip(tmp_path):
     cfg = TrainConfig.from_yaml(
         os.path.join(os.path.dirname(__file__), "..", "cpr_tpu", "train",
