@@ -7,51 +7,33 @@ slice (BASELINE.json "north_star"); vs_baseline is the single-chip
 measured rate over that whole-slice target, so vs_baseline > 1 means one
 chip alone beats the 8-chip goal. The reference publishes no numbers
 (BASELINE.md), so the north star is the only fixed point.
+
+Robustness: a faulted axon backend can HANG rather than raise (observed
+when a large kernel crashed the device), so the TPU attempt runs in a
+watchdog subprocess; on timeout or failure the parent falls back to CPU
+in-process — a number with a visible backend tag always gets printed.
 """
 
 import json
+import os
+import subprocess
 import sys
 import time
 
-import numpy as np
 
-
-def _init_backend():
-    """Initialize a JAX backend, preferring TPU, with diagnostics + retry.
-
-    Round-1 postmortem: the driver bench run died with rc=1 ("Unable to
-    initialize backend 'axon': UNAVAILABLE") and recorded no number.  A
-    transiently claimed chip must not zero out the round's evidence, so:
-    try TPU, retry once after a pause, then fall back to CPU — a number on
-    CPU with a visible backend tag beats no number at all.
-    """
+def run_bench(platform_hint: str):
+    """Measure and print the JSON line on whatever backend comes up."""
     import jax
 
-    last_err = None
-    for attempt in range(2):
-        try:
-            devs = jax.devices()
-            print(f"bench: backend={devs[0].platform} devices={len(devs)}",
-                  file=sys.stderr)
-            return jax, devs[0].platform
-        except Exception as e:  # backend init failure (e.g. chip claimed)
-            last_err = e
-            print(f"bench: backend init attempt {attempt + 1} failed: {e!r}",
-                  file=sys.stderr)
-            time.sleep(15.0)
-    print("bench: TPU unavailable, falling back to CPU", file=sys.stderr)
-    try:
+    if platform_hint == "cpu":
         jax.config.update("jax_platforms", "cpu")
-        devs = jax.devices()
-        return jax, devs[0].platform
-    except Exception as e:
-        print(f"bench: CPU fallback also failed: {e!r}; "
-              f"first error: {last_err!r}", file=sys.stderr)
-        raise
+    devs = jax.devices()
+    platform = devs[0].platform
+    print(f"bench: backend={platform} devices={len(devs)}",
+          file=sys.stderr)
 
+    import numpy as np
 
-def main():
-    jax, platform = _init_backend()
     from cpr_tpu.envs.nakamoto import NakamotoSSZ
     from cpr_tpu.params import make_params
 
@@ -62,7 +44,8 @@ def main():
     # scan past one full episode (max_steps=2016) so episode stats exist
     n_envs, n_steps = (8192, 2200) if platform != "cpu" else (512, 2200)
     keys = jax.random.split(jax.random.PRNGKey(0), n_envs)
-    fn = jax.jit(jax.vmap(lambda k: env.episode_stats(k, params, policy, n_steps)))
+    fn = jax.jit(jax.vmap(
+        lambda k: env.episode_stats(k, params, policy, n_steps)))
     jax.block_until_ready(fn(keys))  # compile
     reps = 3
     t0 = time.time()
@@ -84,6 +67,68 @@ def main():
         "vs_baseline": round(steps_per_sec / 10_000_000, 3),
         "backend": platform,
     }))
+
+
+def _attempt(timeout: float):
+    """One watchdog-bounded child run.  Returns ("ok", json_line),
+    ("failed", rc), or ("hung", None).  Manual Popen because
+    subprocess.run's post-kill wait() is untimed — a child stuck in
+    uninterruptible device I/O would hang the parent forever."""
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--direct"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+    try:
+        out, err = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        try:
+            out, err = proc.communicate(timeout=10)
+        except subprocess.TimeoutExpired:
+            # unkillable (D-state on the device fd): abandon the child
+            out, err = "", ""
+        sys.stderr.write(err or "")
+        return "hung", None
+    sys.stderr.write(err or "")
+    line = next((ln for ln in (out or "").splitlines()
+                 if ln.startswith("{")), None)
+    if proc.returncode == 0 and line:
+        return "ok", line
+    return "failed", proc.returncode
+
+
+def main():
+    if "--direct" in sys.argv:
+        # child mode: let the default (TPU-preferring) backend come up;
+        # on a host with no TPU this IS the CPU bench and its result is
+        # relayed as-is (the 512-env CPU run finishes well inside the
+        # watchdog timeout)
+        run_bench("default")
+        return
+    if os.environ.get("CPR_BENCH_BACKEND") == "cpu":
+        run_bench("cpu")
+        return
+    # watchdog: try the TPU in a subprocess so a hung backend cannot
+    # stall this process past the driver's patience; a clean failure
+    # (e.g. transiently claimed chip) gets one paused retry, a hang
+    # (wedged device) goes straight to CPU
+    timeout = float(os.environ.get("CPR_BENCH_TPU_TIMEOUT", "360"))
+    for attempt in range(2):
+        status, payload = _attempt(timeout)
+        if status == "ok":
+            print(payload)
+            return
+        if status == "hung":
+            print(f"bench: TPU attempt hung past {timeout:.0f}s (wedged "
+                  f"backend?), falling back to CPU", file=sys.stderr)
+            break
+        print(f"bench: TPU attempt {attempt + 1} rc={payload}",
+              file=sys.stderr)
+        if attempt == 0:
+            time.sleep(15.0)  # transiently claimed chip may free up
+    else:
+        print("bench: TPU attempts failed, falling back to CPU",
+              file=sys.stderr)
+    run_bench("cpu")
 
 
 if __name__ == "__main__":
