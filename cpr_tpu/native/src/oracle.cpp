@@ -118,6 +118,9 @@ struct Sim {
   bool custom_links = false;
   std::vector<int> lkind;
   std::vector<double> lp0, lp1;
+  // flooding dissemination (simulator.ml:494-507): re-share received
+  // blocks on all links, so multi-hop topologies converge
+  bool flooding = false;
 
   std::vector<std::vector<char>> visible;   // [node][block]
   std::vector<std::vector<char>> known;     // received but maybe buffered
@@ -1040,6 +1043,7 @@ void Sim::deliver(int node, int b) {
   if (is_visible(node, b)) return;
   mark_visible(node, b);
   record(3, node, b);
+  if (flooding && dag.blocks[b].miner != node) send(node, b);
   if (node == 0 && agent) {
     handle_agent(b, false);
   } else {
@@ -1277,7 +1281,8 @@ void* cpr_oracle_create_custom(const char* protocol, int k,
                                const char* scheme, int n_nodes,
                                const double* compute, const int* dkind,
                                const double* dp0, const double* dp1,
-                               double activation_delay, uint64_t seed) {
+                               double activation_delay, int flooding,
+                               uint64_t seed) {
   auto* h = static_cast<Handle*>(cpr_oracle_create(
       protocol, k, scheme, "clique", n_nodes, 0.0, 0.0, 2,
       activation_delay, 0.0, "none", seed));
@@ -1285,6 +1290,7 @@ void* cpr_oracle_create_custom(const char* protocol, int k,
   Sim& s = h->sim;
   s.compute.assign(compute, compute + n_nodes);
   s.custom_links = true;
+  s.flooding = flooding != 0;
   s.lkind.assign(dkind, dkind + n_nodes * n_nodes);
   s.lp0.assign(dp0, dp0 + n_nodes * n_nodes);
   s.lp1.assign(dp1, dp1 + n_nodes * n_nodes);

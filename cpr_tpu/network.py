@@ -78,6 +78,48 @@ def selfish_mining(*, alpha: float, gamma: float, defenders: int,
     return Network(nodes=nodes, activation_delay=activation_delay)
 
 
+def random_regular(n: int, degree: int, *, activation_delay: float,
+                   delay: dist.Distribution, compute=None,
+                   seed: int = 0) -> Network:
+    """Random connected degree-regular-ish topology — the stand-in for
+    the reference's R/igraph-generated networks
+    (experiments/simulate-topology/igraph.ml:1-50): a ring guarantees
+    connectivity, random chords raise the degree; links are
+    bidirectional."""
+    import random as _random
+
+    assert n >= 3 and degree >= 2
+    rng = _random.Random(seed)
+    # connected ring, normalized (a < b) so dedup sees every edge
+    edges = {(min(i, (i + 1) % n), max(i, (i + 1) % n)) for i in range(n)}
+    degs = [2] * n
+    deficient = sum(1 for d in degs if d < degree)
+
+    tries = 0
+    while deficient > 0 and tries < n * degree * 10:
+        tries += 1
+        a, b = rng.randrange(n), rng.randrange(n)
+        if a == b:
+            continue
+        e = (min(a, b), max(a, b))
+        if e in edges or degs[a] >= degree or degs[b] >= degree:
+            continue
+        edges.add(e)
+        for v in (a, b):
+            degs[v] += 1
+            if degs[v] == degree:
+                deficient -= 1
+    if compute is None:
+        compute = [1.0 / n] * n
+    nodes = [NetNode(c) for c in compute]
+    for a, b in sorted(edges):
+        nodes[a].links.append(Link(b, delay))
+        nodes[b].links.append(Link(a, delay))
+    # sparse graphs need relaying to converge (simulator.ml:494-507)
+    return Network(nodes=nodes, activation_delay=activation_delay,
+                   dissemination="flooding")
+
+
 # -- GraphML round-trip ------------------------------------------------------
 
 
@@ -167,10 +209,9 @@ def simulate(net: Network, *, protocol: str = "nakamoto", k: int = 0,
     """Run an arbitrary topology on the C++ oracle
     (simulate-topology/igraph.ml + graphml_runner analog).  Returns the
     OracleSim after `activations` puzzle solutions."""
-    if net.dissemination != "simple":
+    if net.dissemination not in ("simple", "flooding"):
         raise ValueError(
-            f"oracle implements simple dissemination only, not "
-            f"'{net.dissemination}'")
+            f"unknown dissemination '{net.dissemination}'")
     n = len(net.nodes)
     L = lib()
     L.cpr_oracle_create_custom.restype = ctypes.c_void_p
@@ -178,7 +219,7 @@ def simulate(net: Network, *, protocol: str = "nakamoto", k: int = 0,
         ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
         ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_int),
         ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_double),
-        ctypes.c_double, ctypes.c_uint64]
+        ctypes.c_double, ctypes.c_int, ctypes.c_uint64]
     compute = (ctypes.c_double * n)(*[nd.compute for nd in net.nodes])
     kind = (ctypes.c_int * (n * n))()
     p0 = (ctypes.c_double * (n * n))()
@@ -200,7 +241,8 @@ def simulate(net: Network, *, protocol: str = "nakamoto", k: int = 0,
             p1[j] = d.params[1] if len(d.params) > 1 else 0.0
     handle = L.cpr_oracle_create_custom(
         protocol.encode(), k, scheme.encode(), n, compute, kind, p0, p1,
-        net.activation_delay, seed)
+        net.activation_delay,
+        1 if net.dissemination == "flooding" else 0, seed)
     if not handle:
         raise ValueError(f"oracle rejected protocol '{protocol}'")
     sim = OracleSim.__new__(OracleSim)

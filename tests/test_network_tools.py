@@ -77,6 +77,21 @@ def test_custom_topology_simulation():
     sim.close()
 
 
+def test_random_topology_sweep():
+    """simulate-topology analog: generated sparse networks simulate and
+    show delay-dependent orphan rates."""
+    rates = {}
+    for prop in (0.5, 8.0):
+        net = netlib.random_regular(
+            8, 3, activation_delay=30.0,
+            delay=dist.constant(prop), seed=2)
+        sim = netlib.simulate(net, activations=4000, seed=3)
+        rates[prop] = 1.0 - sim.metric("head_height") / sim.metric(
+            "n_blocks")
+        sim.close()
+    assert rates[0.5] < rates[8.0], rates
+
+
 def test_graphml_runner_pipe():
     net = netlib.symmetric_clique(4, activation_delay=20.0,
                                   propagation_delay=1.0)
