@@ -1,0 +1,32 @@
+"""Honest-network sweep -> TSV (the reference's honest_net experiment).
+
+Usage: python examples/honest_net_sweep.py [out.tsv]
+"""
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.join(_os.path.dirname(
+    _os.path.abspath(__file__)), ".."))  # repo-root import
+
+if _os.environ.get("CPR_PLATFORM"):
+    # select the backend programmatically — in some environments the
+    # JAX_PLATFORMS env var is overridden at interpreter startup
+    import jax as _jax
+
+    _jax.config.update("jax_platforms", _os.environ["CPR_PLATFORM"])
+
+import sys
+
+from cpr_tpu.experiments import honest_net_rows, write_tsv
+
+
+def main():
+    rows = honest_net_rows(n_activations=5_000)
+    out = sys.argv[1] if len(sys.argv) > 1 else None
+    text = write_tsv(rows, out)
+    print(text if out is None else f"wrote {len(rows)} rows to {out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,48 @@
+"""Config-driven PPO training with per-alpha eval and checkpoints.
+
+Usage: python examples/train_ppo.py [config.yaml] [out_dir] [n_updates]
+Defaults to the nakamoto alpha-range config, 20 updates.
+"""
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.join(_os.path.dirname(
+    _os.path.abspath(__file__)), ".."))  # repo-root import
+
+if _os.environ.get("CPR_PLATFORM"):
+    # select the backend programmatically — in some environments the
+    # JAX_PLATFORMS env var is overridden at interpreter startup
+    import jax as _jax
+
+    _jax.config.update("jax_platforms", _os.environ["CPR_PLATFORM"])
+
+import os
+import sys
+
+from cpr_tpu.experiments import write_tsv
+from cpr_tpu.train.config import TrainConfig
+from cpr_tpu.train.driver import train_from_config
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def main():
+    cfg_path = sys.argv[1] if len(sys.argv) > 1 else os.path.join(
+        HERE, "..", "cpr_tpu", "train", "configs", "nakamoto.yaml")
+    out_dir = sys.argv[2] if len(sys.argv) > 2 else "runs/example"
+    n_updates = int(sys.argv[3]) if len(sys.argv) > 3 else 20
+    cfg = TrainConfig.from_yaml(cfg_path)
+
+    def progress(i, m):
+        print(f"update {i + 1}: step_reward={m['mean_step_reward']:.4f} "
+              f"entropy={m['entropy']:.3f}")
+
+    params, history, eval_rows = train_from_config(
+        cfg, out_dir=out_dir, n_updates=n_updates, progress=progress)
+    print(write_tsv(eval_rows))
+    print(f"checkpoints + metrics.jsonl in {out_dir}/")
+
+
+if __name__ == "__main__":
+    main()

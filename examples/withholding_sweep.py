@@ -1,0 +1,35 @@
+"""Withholding-policy sweep over an (alpha, gamma) grid — each policy's
+whole grid runs as one vmap'd TPU kernel (the reference's withholding
+experiment).
+
+Usage: python examples/withholding_sweep.py [protocol-key] [out.tsv]
+"""
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.join(_os.path.dirname(
+    _os.path.abspath(__file__)), ".."))  # repo-root import
+
+if _os.environ.get("CPR_PLATFORM"):
+    # select the backend programmatically — in some environments the
+    # JAX_PLATFORMS env var is overridden at interpreter startup
+    import jax as _jax
+
+    _jax.config.update("jax_platforms", _os.environ["CPR_PLATFORM"])
+
+import sys
+
+from cpr_tpu.experiments import withholding_rows, write_tsv
+
+
+def main():
+    key = sys.argv[1] if len(sys.argv) > 1 else "nakamoto"
+    rows = withholding_rows(key, episode_len=256, reps=128)
+    out = sys.argv[2] if len(sys.argv) > 2 else None
+    text = write_tsv(rows, out)
+    print(text if out is None else f"wrote {len(rows)} rows to {out}")
+
+
+if __name__ == "__main__":
+    main()
