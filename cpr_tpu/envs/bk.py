@@ -101,8 +101,9 @@ class BkSSZ(JaxEnv):
         self.k = k
         self.incentive_scheme = incentive_scheme
         self.unit_observation = unit_observation
-        # <= 2 appends per step (attacker proposal + PoW/defender proposal)
-        self.capacity = 2 * max_steps_hint + 8
+        # <= 2 appends per step (attacker proposal + PoW/defender
+        # proposal); floored at k so quorum top_k always fits
+        self.capacity = max(2 * max_steps_hint + 8, k + 8)
         self.max_parents = k + 1
         self.fields = obs_fields(k)
         self.observation_length = len(self.fields)

@@ -99,9 +99,11 @@ class SdagSSZ(JaxEnv):
         self.incentive_scheme = incentive_scheme
         self.subblock_selection = subblock_selection
         self.unit_observation = unit_observation
-        self.capacity = max_steps_hint + 8  # one PoW append per step
         self.max_parents = max(k - 1, 1)  # leaves only (votes or blocks)
         self.C_MAX = 4 * k + 16
+        # one PoW append per step; floored at the candidate window so
+        # small hints with large k still hold a full quorum frame
+        self.capacity = max(max_steps_hint + 8, self.C_MAX)
         self.STALE_WALK = 4
         self.release_scan = min(release_scan, self.capacity)
         self.fields = obs_fields(k)

@@ -93,8 +93,9 @@ class SparSSZ(JaxEnv):
         self.k = k
         self.incentive_scheme = incentive_scheme
         self.unit_observation = unit_observation
-        # exactly one PoW append per step
-        self.capacity = max_steps_hint + 8
+        # exactly one PoW append per step; floored at the k+8 release
+        # window (top_k needs k <= capacity)
+        self.capacity = max(max_steps_hint + 8, k + 8)
         self.max_parents = k
         self.fields = obs_fields(k)
         self.observation_length = len(self.fields)

@@ -113,9 +113,11 @@ class StreeSSZ(JaxEnv):
             self.opt_window = Q.optimal_window(k - 1, 4 * k + 16)
             self.opt_combos = Q.optimal_combos(k - 1, self.opt_window)
         self.unit_observation = unit_observation
-        self.capacity = max_steps_hint + 8  # one PoW append per step
         self.max_parents = k  # parent block + k-1 leaves
         self.C_MAX = 4 * k + 16
+        # one PoW append per step; floored at the candidate window so
+        # small hints with large k still hold a full quorum frame
+        self.capacity = max(max_steps_hint + 8, self.C_MAX)
         self.STALE_WALK = 4
         self.release_scan = min(release_scan, self.capacity)
         self.fields = obs_fields(k)

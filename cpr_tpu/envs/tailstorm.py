@@ -138,11 +138,13 @@ class TailstormSSZ(JaxEnv):
             self.opt_window = Q.optimal_window(k, 4 * k + 16)
             self.opt_combos = Q.optimal_combos(k, self.opt_window)
         self.unit_observation = unit_observation
-        # <= 2 appends per step (attacker summary + defender summary/vote)
-        self.capacity = 2 * max_steps_hint + 8
         self.max_parents = k
         self.D_MAX = 3 * k + 8  # vote-path walk bound
         self.C_MAX = 4 * k + 16  # quorum candidate window (compacted)
+        # <= 2 appends per step (attacker summary + defender summary/vote);
+        # floored at the candidate window so small hints with large k
+        # still hold a full quorum frame (top_k needs k <= capacity)
+        self.capacity = max(2 * max_steps_hint + 8, self.C_MAX)
         self.STALE_WALK = 4  # summary-chain descent check depth at Adopt
         assert self.C_MAX < (1 << 8), "composite sort keys use 8 bits"
         self.release_scan = min(release_scan, self.capacity)

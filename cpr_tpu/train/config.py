@@ -14,7 +14,7 @@ from typing import List, Literal, Union
 
 import numpy as np
 import yaml
-from pydantic import BaseModel, field_validator
+from pydantic import BaseModel, field_validator, model_validator
 
 
 class Range(BaseModel):
@@ -54,8 +54,12 @@ class TrainConfig(BaseModel):
     alpha: Alpha = 0.33
     gamma: float = 0.5
     episode_len: int = 128
-    reward: Literal["sparse_relative", "sparse_per_progress"] = \
-        "sparse_relative"
+    # dense_per_progress mirrors the reference's DenseRewardPerProgress
+    # wrapper (gym/ocaml/cpr_gym/wrappers.py:54-113): episodes terminate
+    # at target progress `episode_len`, per-step reward is the attacker
+    # reward delta / target, with an end-of-episode mismatch correction.
+    reward: Literal["sparse_relative", "sparse_per_progress",
+                    "dense_per_progress"] = "sparse_relative"
     shape: Literal["raw", "cut", "exp"] = "raw"
     n_envs: int = 256
     total_updates: int = 200
@@ -69,6 +73,14 @@ class TrainConfig(BaseModel):
         if not 0.0 <= v < 1.0:
             raise ValueError("gamma must be in [0, 1)")
         return v
+
+    @model_validator(mode="after")
+    def _dense_shape(self):
+        if self.reward == "dense_per_progress" and self.shape != "raw":
+            raise ValueError(
+                "dense_per_progress emits per-step rewards; the sparse "
+                "end-of-episode shapings (cut/exp) do not apply")
+        return self
 
     @classmethod
     def from_yaml(cls, path: str) -> "TrainConfig":

@@ -27,7 +27,23 @@ from cpr_tpu.train.config import TrainConfig
 from cpr_tpu.train.ppo import ActorCritic, PPOConfig, make_train
 
 
-def _stack_params(alphas, gamma, episode_len):
+# Dense per-progress episodes terminate at target *progress*; max_steps
+# is only a runaway guard.  The gym wrapper uses a loose 100x guard
+# (cpr_tpu/gym/__init__.py core-v0 registration); here the factor also
+# sizes the fixed DAG capacity and the scan length of every rollout, so
+# it is a deliberate 4x — enough for any policy that makes progress at
+# >= 1/4 the honest rate; pathological full-withholding episodes
+# truncate at the cap instead of running 100x-long scans.
+DENSE_RUNAWAY_FACTOR = 4
+
+
+def _stack_params(alphas, gamma, episode_len, *, dense=False):
+    if dense:
+        return stack_params([dict(alpha=float(a), gamma=gamma,
+                                  max_steps=(DENSE_RUNAWAY_FACTOR
+                                             * episode_len),
+                                  max_progress=float(episode_len))
+                             for a in alphas])
     return stack_params([dict(alpha=float(a), gamma=gamma,
                               max_steps=episode_len) for a in alphas])
 
@@ -41,6 +57,16 @@ def make_reward_transform(cfg: TrainConfig, lane_alphas) -> Callable:
         a = info["episode_reward_attacker"]
         d = info["episode_reward_defender"]
         p = info["episode_progress"]
+        if cfg.reward == "dense_per_progress":
+            # per-step emission a_delta/h; the end-of-episode correction
+            # a/p - a/h trues the total up to the real per-progress
+            # objective (the sum of deltas over an episode is a, so the
+            # emitted total is a/h — wrappers.py:78-113 stateless form)
+            h = float(cfg.episode_len)
+            step = info["step_reward_attacker"] / h
+            corr = jnp.where(
+                done, a / jnp.where(p != 0, p, 1.0) - a / h, 0.0)
+            return (step + corr) / alphas
         if cfg.reward == "sparse_relative":
             s = a + d
             base = jnp.where(s != 0, a / jnp.where(s != 0, s, 1.0), 0.0)
@@ -72,7 +98,11 @@ def ppo_config(cfg: TrainConfig) -> PPOConfig:
 
 
 def build_env(cfg: TrainConfig):
-    env = get_sized(cfg.protocol, cfg.episode_len)
+    # dense episodes run up to 4*episode_len steps (progress can lag
+    # steps); size DAG capacity for the worst case, not the target
+    hint = cfg.episode_len * (
+        DENSE_RUNAWAY_FACTOR if cfg.reward == "dense_per_progress" else 1)
+    env = get_sized(cfg.protocol, hint)
     if cfg.alpha_is_scheduled():
         env = AssumptionEnv(env)
     return env
@@ -110,9 +140,13 @@ def evaluate_per_alpha(env, cfg: TrainConfig, net_params, *,
     (ppo.py:296-374) as a single program.  Returns one row per alpha."""
     alphas = cfg.eval_alphas()
     reps = episodes_per_alpha or cfg.eval.episodes_per_alpha
-    params = _stack_params(alphas, cfg.gamma, cfg.episode_len)
+    dense = cfg.reward == "dense_per_progress"
+    params = _stack_params(alphas, cfg.gamma, cfg.episode_len, dense=dense)
+    # dense episodes terminate on progress, which can lag steps; give the
+    # eval rollout the same runaway budget as training (4x)
+    fn = _eval_fn(env, ppo_config(cfg).hidden,
+                  cfg.episode_len * (DENSE_RUNAWAY_FACTOR if dense else 1))
     keys = jax.random.split(jax.random.PRNGKey(seed), (len(alphas), reps))
-    fn = _eval_fn(env, ppo_config(cfg).hidden, cfg.episode_len)
     stats = jax.block_until_ready(fn(net_params, keys, params))
     rows = []
     for i, a in enumerate(alphas):
@@ -159,7 +193,8 @@ def train_from_config(cfg: TrainConfig, *, out_dir: str | None = None,
     """
     env = build_env(cfg)
     lane_alphas = cfg.lane_alphas(cfg.n_envs)
-    env_params = _stack_params(lane_alphas, cfg.gamma, cfg.episode_len)
+    env_params = _stack_params(lane_alphas, cfg.gamma, cfg.episode_len,
+                               dense=cfg.reward == "dense_per_progress")
     pcfg = ppo_config(cfg)
     transform = make_reward_transform(cfg, lane_alphas)
     init_fn, train_step = make_train(env, env_params, pcfg, transform,

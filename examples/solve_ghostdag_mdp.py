@@ -4,18 +4,7 @@ iteration (BASELINE.md capstone config 5).
 Usage: python examples/solve_ghostdag_mdp.py [dag_size_cutoff]
 """
 
-import os as _os
-import sys as _sys
-
-_sys.path.insert(0, _os.path.join(_os.path.dirname(
-    _os.path.abspath(__file__)), ".."))  # repo-root import
-
-if _os.environ.get("CPR_PLATFORM"):
-    # select the backend programmatically — in some environments the
-    # JAX_PLATFORMS env var is overridden at interpreter startup
-    import jax as _jax
-
-    _jax.config.update("jax_platforms", _os.environ["CPR_PLATFORM"])
+import _bootstrap  # noqa: F401  (repo-root path + backend pick)
 
 import sys
 import time

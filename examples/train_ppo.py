@@ -4,18 +4,7 @@ Usage: python examples/train_ppo.py [config.yaml] [out_dir] [n_updates]
 Defaults to the nakamoto alpha-range config, 20 updates.
 """
 
-import os as _os
-import sys as _sys
-
-_sys.path.insert(0, _os.path.join(_os.path.dirname(
-    _os.path.abspath(__file__)), ".."))  # repo-root import
-
-if _os.environ.get("CPR_PLATFORM"):
-    # select the backend programmatically — in some environments the
-    # JAX_PLATFORMS env var is overridden at interpreter startup
-    import jax as _jax
-
-    _jax.config.update("jax_platforms", _os.environ["CPR_PLATFORM"])
+import _bootstrap  # noqa: F401  (repo-root path + backend pick)
 
 import os
 import sys
