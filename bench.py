@@ -42,7 +42,10 @@ def run_bench(platform_hint: str):
     policy = env.policies["sapirshtein-2016-sm1"]
 
     # scan past one full episode (max_steps=2016) so episode stats exist
-    n_envs, n_steps = (8192, 2200) if platform != "cpu" else (512, 2200)
+    # batch sweep on v5e-1 (2026-07): 8192 -> 137M steps/s, 65536 ->
+    # 281M, 131072 -> 306M, 262144 -> 312M (saturated); 131072 keeps
+    # compile + memory comfortable at ~98% of peak
+    n_envs, n_steps = (131072, 2200) if platform != "cpu" else (512, 2200)
     keys = jax.random.split(jax.random.PRNGKey(0), n_envs)
     fn = jax.jit(jax.vmap(
         lambda k: env.episode_stats(k, params, policy, n_steps)))

@@ -19,12 +19,31 @@ def register(key: str, factory: Callable):
     if key in _REGISTRY:
         raise ValueError(f"duplicate env key: {key}")
     _REGISTRY[key] = factory
+    # a full key may already have been served via the parse_key family
+    # fallback; drop those memo entries so the new factory wins
+    for mk in [mk for mk in _ENV_MEMO if mk[0] == key]:
+        del _ENV_MEMO[mk]
+
+
+_ENV_MEMO: dict = {}
 
 
 def get(key: str, **kwargs):
     """Instantiate the env for `key` — either a registered family name
-    with explicit kwargs, or a full protocol key parsed by `parse_key`."""
+    with explicit kwargs, or a full protocol key parsed by `parse_key`.
+
+    Identical (key, kwargs) return the SAME env object: envs are
+    immutable config holders, and jit caches key on the env instance
+    (rollout/step have static self), so sharing instances shares
+    compiled kernels across callers — e.g. across tests in one process."""
     _ensure_builtin()
+    try:
+        memo_key = (key, tuple(sorted(kwargs.items())))
+        hash(memo_key)
+    except TypeError:
+        memo_key = None
+    if memo_key is not None and memo_key in _ENV_MEMO:
+        return _ENV_MEMO[memo_key]
     factory = _REGISTRY.get(key)
     if factory is None:
         family, parsed = parse_key(key)
@@ -34,7 +53,10 @@ def get(key: str, **kwargs):
                 f"unknown env '{key}'; choose from {sorted(_REGISTRY)}")
         parsed.update(kwargs)
         kwargs = parsed
-    return factory(**kwargs)
+    env = factory(**kwargs)
+    if memo_key is not None:
+        _ENV_MEMO[memo_key] = env
+    return env
 
 
 def keys():

@@ -16,6 +16,7 @@ from cpr_tpu.experiments.sweep import write_tsv
 from cpr_tpu.experiments.honest_net import honest_net_rows
 from cpr_tpu.experiments.withholding import withholding_rows
 from cpr_tpu.experiments.break_even import break_even
+from cpr_tpu.experiments.measure_rtdp import measure_rtdp_rows
 
 __all__ = ["write_tsv", "honest_net_rows", "withholding_rows",
-           "break_even"]
+           "break_even", "measure_rtdp_rows"]

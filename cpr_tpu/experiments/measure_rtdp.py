@@ -1,0 +1,74 @@
+"""RTDP measurement sweep: sampled solver vs exact value iteration.
+
+Reference counterpart: mdp/sprint-2-rtdp/measure-rtdp.py — run RTDP on
+a battery of attack models with a step budget, record explored-state
+counts and start-value trajectories, and compare against the exact VI
+solve of the same (truncated) model.
+
+One row per (model, step budget): explored states, RTDP start value /
+progress, exact VI revenue, relative error, wall-times.  Feeds
+write_tsv like every other sweep.
+"""
+
+from __future__ import annotations
+
+import time
+
+from cpr_tpu.mdp import Compiler, ptmdp
+from cpr_tpu.mdp.models import Aft20BitcoinSM, Fc16BitcoinSM
+from cpr_tpu.mdp.rtdp import RTDP
+
+
+def rtdp_battery(alphas=(0.25, 0.33, 0.4), gamma=0.5, fork_len=12):
+    battery = []
+    for a in alphas:
+        battery.append((f"fc16-{a}", lambda a=a: Fc16BitcoinSM(
+            alpha=a, gamma=gamma, maximum_fork_length=fork_len)))
+        battery.append((f"aft20-{a}", lambda a=a: Aft20BitcoinSM(
+            alpha=a, gamma=gamma, maximum_fork_length=fork_len)))
+    return battery
+
+
+def measure_rtdp_rows(battery=None, *, horizon=30, step_budgets=(50_000,),
+                      eps=0.2, eps_honest=0.05, es=0.1, seed=0,
+                      stop_delta=1e-6):
+    """For each model: exact jitted-VI revenue once, then one RTDP run
+    per step budget (continuing the same run between budgets, so rows
+    show convergence over the budget schedule)."""
+    rows = []
+    if battery is None:
+        battery = rtdp_battery()
+    for name, factory in battery:
+        model = factory()  # stateless: RTDP and exact VI share it
+        t0 = time.time()
+        tm = ptmdp(Compiler(model).mdp(), horizon=horizon).tensor()
+        vi = tm.value_iteration(stop_delta=stop_delta)
+        prog = tm.start_value(vi["vi_progress"])
+        exact = float(tm.start_value(vi["vi_value"]) / prog) if prog else 0.0
+        vi_s = time.time() - t0
+
+        solver = RTDP(ptmdp_model(model, horizon), eps=eps,
+                      eps_honest=eps_honest, es=es, seed=seed)
+        done, rtdp_s = 0, 0.0
+        for budget in sorted(step_budgets):
+            t0 = time.time()
+            solver.run(budget - done)
+            rtdp_s += time.time() - t0  # cumulative, like `steps`
+            done = budget
+            v, g = solver.start_value_and_progress()
+            est = v / g if g else 0.0
+            rows.append({
+                "model": name, "steps": budget,
+                "n_states": solver.n_states,
+                "rtdp_revenue": est, "vi_revenue": exact,
+                "abs_error": abs(est - exact),
+                "rtdp_s": rtdp_s, "vi_s": vi_s,
+            })
+    return rows
+
+
+def ptmdp_model(model, horizon):
+    """The PTO wrapper as an implicit model (what RTDP samples from)."""
+    from cpr_tpu.mdp.implicit import PTOWrapper
+
+    return PTOWrapper(model, horizon=horizon, terminal_state="terminal")

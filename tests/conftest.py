@@ -13,13 +13,27 @@ import pytest
 
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+    flags = (flags + " --xla_force_host_platform_device_count=8").strip()
+if ("xla_backend_optimization_level" not in flags
+        and not os.environ.get("CPR_TEST_FULL_OPT")):
+    # compile time dominates the suite (the big DAG-env kernels take
+    # 15-40s each to build); at test shapes the runtime difference is
+    # noise, so trade codegen quality for ~2x faster compiles.  Set
+    # CPR_TEST_FULL_OPT=1 to test with production codegen.
+    flags = (flags + " --xla_backend_optimization_level=0").strip()
+os.environ["XLA_FLAGS"] = flags
 
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+if os.environ.get("CPR_JAX_CACHE"):
+    # opt-in persistent compile cache (reruns start warm).  Not default:
+    # the XLA:CPU AOT loader logs machine-feature-mismatch noise on
+    # load, and a stale cache across toolchain bumps risks SIGILL.
+    jax.config.update("jax_compilation_cache_dir",
+                      os.environ["CPR_JAX_CACHE"])
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 
 # -- tiering ---------------------------------------------------------------

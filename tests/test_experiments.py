@@ -183,3 +183,18 @@ def test_dense_env_sized_for_runaway_budget():
     from cpr_tpu.envs import registry
     tiny = registry.get_sized("tailstorm-8-constant-heuristic", 8)
     assert tiny.capacity >= tiny.C_MAX
+
+
+def test_measure_rtdp_sweep():
+    """measure-rtdp analog: RTDP rows approach the exact VI revenue as
+    the step budget grows (sprint-2 measurement shape)."""
+    from cpr_tpu.experiments.measure_rtdp import (measure_rtdp_rows,
+                                                  rtdp_battery)
+
+    rows = measure_rtdp_rows(
+        rtdp_battery(alphas=(0.33,), fork_len=6)[:1],
+        horizon=20, step_budgets=(5_000, 40_000))
+    assert [r["steps"] for r in rows] == [5_000, 40_000]
+    assert rows[-1]["abs_error"] < 0.02
+    assert rows[-1]["n_states"] >= rows[0]["n_states"]
+    write_tsv(rows)
