@@ -132,21 +132,23 @@ def ptmdp(old: MDP, *, horizon: int) -> MDP:
     """
     assert horizon > 0
     terminal = old.n_states
-    new = MDP(n_states=old.n_states + 1, n_actions=old.n_actions,
-              start=dict(old.start))
-    keep_base = 1.0 - 1.0 / horizon
-    for i in range(old.n_transitions):
-        s, a, d = old.src[i], old.act[i], old.dst[i]
-        p, r, g = old.prob[i], old.reward[i], old.progress[i]
-        if g == 0.0:
-            new.add_transition(s, a, d, probability=p, reward=r, progress=g)
-        else:
-            keep = keep_base**g
-            new.add_transition(s, a, terminal, probability=p * (1.0 - keep),
-                               reward=0.0, progress=0.0)
-            new.add_transition(s, a, d, probability=p * keep, reward=r,
-                               progress=g)
-    new.n_states = max(new.n_states, terminal + 1)
+    src, act, dst, prob, reward, progress = old.arrays()
+    keep = (1.0 - 1.0 / horizon) ** progress
+    hp = progress != 0.0  # progress-making rows split in two
+    term = np.full(hp.sum(), terminal, np.int32)
+    zeros = np.zeros(hp.sum())
+    new = MDP(
+        n_states=old.n_states + 1,
+        n_actions=old.n_actions,
+        start=dict(old.start),
+        src=np.concatenate([src, src[hp]]),
+        act=np.concatenate([act, act[hp]]),
+        dst=np.concatenate([dst, term]).astype(np.int32),
+        prob=np.concatenate([np.where(hp, prob * keep, prob),
+                             (prob * (1.0 - keep))[hp]]),
+        reward=np.concatenate([reward, zeros]),
+        progress=np.concatenate([progress, zeros]),
+    )
     return new
 
 

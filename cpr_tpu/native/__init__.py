@@ -21,12 +21,34 @@ _LOCK = threading.Lock()
 _LIB = None
 
 
-def _build():
-    cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", _SRC, "-o", _SO]
+def build_lib(src: str, so: str, opt: str = "-O2") -> None:
+    """g++-compile `src` into shared library `so` (skipped when fresh)."""
+    if (os.path.exists(so)
+            and os.path.getmtime(so) >= os.path.getmtime(src)):
+        return
+    cmd = ["g++", opt, "-std=c++17", "-shared", "-fPIC", src, "-o", so]
     r = subprocess.run(cmd, capture_output=True, text=True)
     if r.returncode != 0:
         raise RuntimeError(
-            f"oracle build failed ({' '.join(cmd)}):\n{r.stderr}")
+            f"native build failed ({' '.join(cmd)}):\n{r.stderr}")
+
+
+_LOADED: dict = {}
+
+
+def load_lib(src: str, so: str, opt: str = "-O2") -> ctypes.CDLL:
+    """Lock-guarded memoized build+load; callers attach ctypes
+    signatures to the returned CDLL once (idempotent)."""
+    with _LOCK:
+        L = _LOADED.get(so)
+        if L is None:
+            build_lib(src, so, opt)
+            L = _LOADED[so] = ctypes.CDLL(so)
+        return L
+
+
+def _build():
+    build_lib(_SRC, _SO)
 
 
 def lib() -> ctypes.CDLL:
