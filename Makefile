@@ -1,0 +1,32 @@
+# Workflow entry points (reference: Makefile + mdp/justfile).
+# The CPU mesh env vars mirror tests/conftest.py; bench/examples run on
+# whatever backend JAX selects (TPU when healthy).
+
+CPU_MESH = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
+
+.PHONY: test test-slow bench dryrun sweeps ghostdag train-dummy native
+
+test:  ## fast tier (< ~8 min on the 1-core host)
+	python -m pytest tests/ -q
+
+test-slow:  ## full suite incl. deep stochastic batteries
+	python -m pytest tests/ -q --runslow
+
+bench:  ## one-line JSON benchmark (TPU with CPU fallback)
+	python bench.py
+
+dryrun:  ## multi-chip sharding dry run on the virtual CPU mesh
+	$(CPU_MESH) python -c "from __graft_entry__ import dryrun_multichip; dryrun_multichip(8)"
+
+sweeps:  ## honest-net + withholding sweep tables (TSV to stdout)
+	python examples/honest_net_sweep.py
+	python examples/withholding_sweep.py
+
+ghostdag:  ## BASELINE config 5: native compile + mesh-sharded VI
+	$(CPU_MESH) CPR_PLATFORM=cpu python examples/solve_ghostdag_mdp.py 7
+
+train-dummy:  ## smoke the config-driven PPO driver
+	python examples/train_ppo.py cpr_tpu/train/configs/dummy.yaml /tmp/cpr-train-dummy 4
+
+native:  ## (re)build both C++ libraries
+	python -c "import cpr_tpu.native as n; n.lib(); import cpr_tpu.mdp.generic.native as g; g.lib(); print('native libs ready')"

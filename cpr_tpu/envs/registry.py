@@ -27,6 +27,32 @@ def register(key: str, factory: Callable):
 
 _ENV_MEMO: dict = {}
 
+# Collection-style one-line info strings (simulator/lib/collection.ml
+# keyed registries carry (key, info, object); cpr_protocols.ml attaches
+# a describe_* string to every constructor)
+_INFO = {
+    "nakamoto": "Nakamoto consensus / longest chain",
+    "bk": "Bk: k parallel PoW votes per block, leader-signed",
+    "ethereum": "Ethereum PoW with uncles (whitepaper/byzantium presets)",
+    "ethereum-whitepaper": "Ethereum PoW, whitepaper uncle rules",
+    "ethereum-byzantium": "Ethereum PoW, byzantium uncle rules",
+    "spar": "Simple parallel PoW (k PoW per block, k-1 votes)",
+    "stree": "Parallel PoW with tree-structured votes",
+    "sdag": "Parallel PoW with DAG-structured votes (k >= 2)",
+    "tailstorm": "Tailstorm: summaries over depth-labelled vote trees",
+    "tailstormjune": "Tailstorm, June'22 variant (W&B run 257 repro)",
+}
+
+
+def describe(key: str | None = None):
+    """Info string(s) for registered env families; `describe()` lists
+    everything (the Collection iteration pattern)."""
+    _ensure_builtin()
+    if key is not None:
+        family = key if key in _REGISTRY else parse_key(key)[0]
+        return _INFO.get(family, "")
+    return {k: _INFO.get(k, "") for k in sorted(_REGISTRY)}
+
 
 def get(key: str, **kwargs):
     """Instantiate the env for `key` — either a registered family name

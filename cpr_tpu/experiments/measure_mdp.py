@@ -15,28 +15,41 @@ from __future__ import annotations
 import time
 
 from cpr_tpu.mdp import Compiler, ptmdp
+from cpr_tpu.mdp.explicit import MDP
 from cpr_tpu.mdp.generic import SingleAgent, get_protocol
 from cpr_tpu.mdp.models import Aft20BitcoinSM, Fc16BitcoinSM
 
 
-def model_battery(alphas=(0.25, 0.33, 0.4), gamma=0.5):
-    """(name, factory) pairs covering the literature + generic models."""
+def model_battery(alphas=(0.25, 0.33, 0.4), gamma=0.5, *, native=True,
+                  generic_cutoff=7):
+    """(name, factory) pairs covering the literature + generic models.
+
+    Factories may return an implicit model (compiled through the Python
+    BFS) or a ready MDP; with `native=True` the generic entries use the
+    C++ compiler, which reaches cutoffs the Python BFS cannot (the
+    capstone sweep runs generic_cutoff=8 at ~3.8M transitions)."""
     battery = []
     for a in alphas:
         battery.append((f"fc16-{a}", lambda a=a: Fc16BitcoinSM(
             alpha=a, gamma=gamma, maximum_fork_length=20)))
         battery.append((f"aft20-{a}", lambda a=a: Aft20BitcoinSM(
             alpha=a, gamma=gamma, maximum_fork_length=20)))
-        for proto, kw, cutoff in (("bitcoin", {}, 7),
-                                  ("ghostdag", {"k": 2}, 7)):
-            battery.append((
-                f"generic-{proto}-{a}",
-                lambda a=a, proto=proto, kw=kw, cutoff=cutoff:
-                SingleAgent(get_protocol(proto, **kw), alpha=a,
-                            gamma=gamma, collect_garbage="simple",
-                            merge_isomorphic=True,
-                            truncate_common_chain=True,
-                            dag_size_cutoff=cutoff)))
+        for proto, kw in (("bitcoin", {}), ("ghostdag", {"k": 2})):
+            if native:
+                def fac(a=a, proto=proto, kw=kw):
+                    from cpr_tpu.mdp.generic.native import compile_native
+                    return compile_native(
+                        proto, k=kw.get("k", 0), alpha=a, gamma=gamma,
+                        collect_garbage="simple",
+                        dag_size_cutoff=generic_cutoff)
+            else:
+                def fac(a=a, proto=proto, kw=kw):
+                    return SingleAgent(
+                        get_protocol(proto, **kw), alpha=a, gamma=gamma,
+                        collect_garbage="simple", merge_isomorphic=True,
+                        truncate_common_chain=True,
+                        dag_size_cutoff=generic_cutoff)
+            battery.append((f"generic-{proto}-{a}", fac))
     return battery
 
 
@@ -49,7 +62,9 @@ def measure_rows(battery=None, *, horizon=100, stop_delta=1e-6,
         battery = model_battery()
     for name, factory in battery:
         t0 = time.time()
-        mdp = ptmdp(Compiler(factory()).mdp(), horizon=horizon)
+        made = factory()
+        table = made if isinstance(made, MDP) else Compiler(made).mdp()
+        mdp = ptmdp(table, horizon=horizon)
         compile_s = time.time() - t0
         row = {"model": name, "n_states": mdp.n_states,
                "n_transitions": mdp.n_transitions,

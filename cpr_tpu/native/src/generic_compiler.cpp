@@ -1047,17 +1047,21 @@ struct Model {
         return relabel_state(s, order);
     }
 
-    void finalize(const State& old, std::vector<Transition>& cases) const {
-        double old_rew = 0.0, old_prg = 0.0;
-        if (!reward_cc) {
-            Derived dv;
-            derive(old.dag, dv);
-            View dw{old.dag, dv, old.dvis, DEFENDER};
-            proto->history(dw, old.dstate, hist_a);
-            std::vector<int> h(hist_a);
-            measure(old, dv, h.data() + 1, (int)h.size() - 1, old_rew,
-                    old_prg);
-        }
+    // defender-view measurement of a state's full history — hoisted out
+    // of finalize so the BFS pays it once per state, not once per action
+    void measure_state(const State& s, double& rew, double& prg) const {
+        rew = prg = 0.0;
+        if (reward_cc) return;
+        Derived dv;
+        derive(s.dag, dv);
+        View dw{s.dag, dv, s.dvis, DEFENDER};
+        proto->history(dw, s.dstate, hist_a);
+        std::vector<int> h(hist_a);
+        measure(s, dv, h.data() + 1, (int)h.size() - 1, rew, prg);
+    }
+
+    void finalize(const State& old, std::vector<Transition>& cases,
+                  double old_rew, double old_prg) const {
         for (auto& t : cases) {
             double rew = 0.0, prg = 0.0;
             if (!reward_cc) {
@@ -1089,7 +1093,8 @@ struct Model {
         }
     }
 
-    void apply(int action, const State& s, std::vector<Transition>& out) const {
+    void apply(int action, const State& s, std::vector<Transition>& out,
+               double old_rew, double old_prg) const {
         out.clear();
         int kind = action / 64, block = action % 64;
         Derived dv;
@@ -1120,7 +1125,7 @@ struct Model {
                     out.push_back({p, n, 0.0, 0.0});
                 }
         }
-        finalize(s, out);
+        finalize(s, out, old_rew, old_prg);
     }
 };
 
@@ -1153,6 +1158,15 @@ static Result* compile_impl(const std::string& proto_name, int k,
     if (dag_cutoff > MAXN - 4) {
         g_last_error = "dag_size_cutoff too large for the native compiler "
                        "(max " + std::to_string(MAXN - 4) + ")";
+        return nullptr;
+    }
+    // the Python anchor's constructor-time flag validation (model.py:97-102)
+    if (truncate_cc && loop_honest) {
+        g_last_error = "choose either truncate_common_chain or loop_honest";
+        return nullptr;
+    }
+    if (reward_cc && !truncate_cc) {
+        g_last_error = "reward_common_chain requires truncate_common_chain";
         return nullptr;
     }
     Proto* proto;
@@ -1231,9 +1245,10 @@ static Result* compile_impl(const std::string& proto_name, int k,
         State s = queue_states[qi];  // copy: vector may reallocate
         int32_t sid = (int32_t)qi;
         m.actions(s, acts);
-        std::vector<int> actions(acts);
-        for (size_t ai = 0; ai < actions.size(); ai++) {
-            m.apply(actions[ai], s, trans);
+        double old_rew, old_prg;
+        m.measure_state(s, old_rew, old_prg);
+        for (size_t ai = 0; ai < acts.size(); ai++) {
+            m.apply(acts[ai], s, trans, old_rew, old_prg);
             double total = 0.0;
             for (auto& t : trans) total += t.prob;
             if (std::fabs(total - 1.0) > 1e-9) {

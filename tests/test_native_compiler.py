@@ -98,3 +98,15 @@ def test_ghostdag_capstone_large_sharded_vi():
     np.testing.assert_allclose(
         np.asarray(sharded["vi_value"]), np.asarray(single["vi_value"]),
         rtol=1e-5, atol=1e-6)
+
+
+def test_native_rejects_invalid_flag_combinations():
+    """The anchor's constructor validation (model.py:97-102) holds
+    natively too."""
+    with pytest.raises(RuntimeError, match="either truncate"):
+        compile_native("bitcoin", k=0, alpha=0.3, gamma=0.5,
+                       dag_size_cutoff=5, loop_honest=True)
+    with pytest.raises(RuntimeError, match="requires truncate"):
+        compile_native("bitcoin", k=0, alpha=0.3, gamma=0.5,
+                       dag_size_cutoff=5, reward_common_chain=True,
+                       truncate_common_chain=False)

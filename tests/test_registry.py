@@ -45,3 +45,13 @@ def test_bad_keys_rejected():
                 "sdag-1-constant-altruistic", "bk-0-constant"):
         with pytest.raises(KeyError):
             registry.get(key)
+
+
+def test_describe_info_strings():
+    from cpr_tpu.envs import registry
+
+    all_info = registry.describe()
+    assert set(all_info) == set(registry.keys())
+    assert all(all_info.values()), "every family needs an info string"
+    assert "longest chain" in registry.describe("nakamoto")
+    assert registry.describe("tailstorm-8-discount-heuristic")

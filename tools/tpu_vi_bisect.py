@@ -1,0 +1,107 @@
+"""Bisect the axon-TPU VI kernel fault, one candidate per subprocess.
+
+Each candidate runs in a watchdog-bounded child (the bench.py pattern:
+a crashed worker can wedge backend init for the NEXT process, so the
+parent detects both crash-rc and init-hang).  Run when the chip is
+healthy; stop at the first crash to avoid wedging it repeatedly.
+
+Usage: python tools/tpu_vi_bisect.py [max_candidates]
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+CANDIDATES = [
+    ("baseline_sum", "print(int(jnp.arange(8).sum()))"),
+    ("segment_sum_small", """
+import numpy as np
+src = jnp.asarray(np.random.default_rng(0).integers(0, 1000, 5000), jnp.int32)
+out = jax.ops.segment_sum(jnp.ones(5000, jnp.float32), src, num_segments=1000)
+print(float(out.sum()))"""),
+    ("argmax_neginf", """
+x = jnp.where(jnp.arange(4096) % 3 == 0,
+              -jnp.inf, jnp.arange(4096, dtype=jnp.float32)).reshape(512, 8)
+print(int(jnp.argmax(x, axis=1).sum()))"""),
+    ("gather_large", """
+import numpy as np
+v = jnp.arange(100000, dtype=jnp.float32)
+idx = jnp.asarray(np.random.default_rng(0).integers(0, 100000, 500000), jnp.int32)
+print(float(v[idx].sum()))"""),
+    ("while_loop_sweep", """
+def body(c):
+    v, i = c
+    v2 = jax.ops.segment_sum(v[jnp.arange(1000) % 100] * 0.5,
+                             jnp.arange(1000) % 100, num_segments=100)[
+        jnp.arange(1000) % 100]
+    return v2, i + 1
+v, i = jax.lax.while_loop(lambda c: c[1] < 50, body,
+                          (jnp.ones(1000, jnp.float32), 0))
+print(int(i), float(v.sum()))"""),
+    ("vi_fc16_small", """
+from cpr_tpu.mdp import Compiler, ptmdp
+from cpr_tpu.mdp.models import Fc16BitcoinSM
+tm = ptmdp(Compiler(Fc16BitcoinSM(alpha=0.3, gamma=0.5,
+                                  maximum_fork_length=8)).mdp(),
+           horizon=20).tensor()
+vi = tm.value_iteration(stop_delta=1e-6)
+print(int(vi["vi_iter"]))"""),
+    ("vi_ghostdag_c5", """
+from cpr_tpu.mdp import ptmdp
+from cpr_tpu.mdp.generic.native import compile_native
+tm = ptmdp(compile_native("ghostdag", k=2, alpha=0.33, gamma=0.5,
+                          collect_garbage="simple", dag_size_cutoff=5),
+           horizon=20).tensor()
+vi = tm.value_iteration(stop_delta=1e-6)
+print(int(vi["vi_iter"]))"""),
+    ("vi_ghostdag_c7", """
+from cpr_tpu.mdp import ptmdp
+from cpr_tpu.mdp.generic.native import compile_native
+tm = ptmdp(compile_native("ghostdag", k=2, alpha=0.33, gamma=0.5,
+                          collect_garbage="simple", dag_size_cutoff=7),
+           horizon=100).tensor()
+vi = tm.value_iteration(stop_delta=1e-5)
+print(int(vi["vi_iter"]))"""),
+]
+
+PRE = "import jax, jax.numpy as jnp\n"
+
+
+def run_one(name, code, timeout=240.0):
+    proc = subprocess.Popen(
+        [sys.executable, "-u", "-c", PRE + code], cwd=REPO,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+    t0 = time.time()
+    try:
+        out, err = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        try:
+            proc.communicate(timeout=20)
+        except subprocess.TimeoutExpired:
+            pass
+        return name, "HANG", time.time() - t0, ""
+    status = "ok" if proc.returncode == 0 else f"rc={proc.returncode}"
+    tail = (err.strip().splitlines() or [""])[-1]
+    if "crashed or restarted" in err or "UNAVAILABLE" in err:
+        status = "CRASH"
+    return name, status, time.time() - t0, tail if status != "ok" else out.strip()
+
+
+def main():
+    limit = int(sys.argv[1]) if len(sys.argv) > 1 else len(CANDIDATES)
+    for name, code in CANDIDATES[:limit]:
+        name, status, dt, info = run_one(name, code)
+        print(f"{name:20s} {status:8s} {dt:6.1f}s  {info[:100]}", flush=True)
+        if status in ("CRASH", "HANG"):
+            print("stopping: chip likely wedged; wait before re-running",
+                  flush=True)
+            break
+
+
+if __name__ == "__main__":
+    main()
