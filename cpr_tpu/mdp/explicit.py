@@ -250,6 +250,69 @@ def _pe_loop(src, dst, prob, reward, progress, onpolicy, S, discount, theta,
     return jax.lax.while_loop(cond, body, (z, z, jnp.inf, 0))
 
 
+@partial(jax.jit, static_argnums=(4, 5, 6, 7))
+def _rtdp_loop(Tdst, Tpack, start_cdf, key, S, A, steps, batch,
+               eps, discount, value0, prog0):
+    """Batched asynchronous VI with eps-greedy trajectory sampling.
+
+    Tdst: [S*A, K] padded destination ids; Tpack: [S*A, K, 3] padded
+    (prob, reward, progress).  Each of `batch` lanes walks the MDP under
+    the eps-greedy policy of the CURRENT value estimate, applying a
+    greedy Bellman backup to every visited state (RTDP, Barto et al.);
+    terminal lanes restart from the start distribution."""
+    Tprob = Tpack[..., 0]
+    valid_a = Tprob.reshape(S, A, -1).sum(-1) > 0  # [S, A]
+    any_valid = valid_a.any(-1)  # [S]
+    B = batch
+    bi = jnp.arange(B)
+
+    def draw_start(k):
+        # inverse-CDF draw (a categorical over S logits would cost
+        # O(batch*S) gumbel noise per step).  side='right' skips
+        # zero-mass prefix states at u == 0.0; scaling u into the
+        # realized cdf range keeps a float32 cumsum shortfall from
+        # landing past the last massive state.
+        u = jax.random.uniform(k, (B,)) * start_cdf[-1]
+        return jnp.clip(jnp.searchsorted(start_cdf, u, side="right"),
+                        0, S - 1).astype(jnp.int32)
+
+    def body(carry, _):
+        V, P, s, k = carry
+        k, k1, k2, k3, k4 = jax.random.split(k, 5)
+        rows = s[:, None] * A + jnp.arange(A)  # [B, A]
+        dstb = Tdst[rows]  # [B, A, K]
+        packb = Tpack[rows]
+        probb, rewb, prgb = packb[..., 0], packb[..., 1], packb[..., 2]
+        q = (probb * (rewb + discount * V[dstb])).sum(-1)  # [B, A]
+        qp = (probb * (prgb + discount * P[dstb])).sum(-1)
+        va = valid_a[s]
+        qm = jnp.where(va, q, -jnp.inf)
+        a_greedy = jnp.argmax(qm, -1)
+        has_a = any_valid[s]
+        V = V.at[s].set(jnp.where(has_a, qm[bi, a_greedy], 0.0))
+        P = P.at[s].set(jnp.where(has_a, qp[bi, a_greedy], 0.0))
+        # eps-greedy behavior action over the valid set
+        a_rand = jax.random.categorical(
+            k1, jnp.where(va, 0.0, -jnp.inf), axis=-1)
+        a_beh = jnp.where(jax.random.uniform(k2, (B,)) < eps,
+                          a_rand, a_greedy)
+        a_beh = jnp.where(has_a, a_beh, 0)
+        # sample the successor from the chosen action's transitions
+        prow = probb[bi, a_beh]  # [B, K]; padding prob 0 ~ never drawn
+        nxt = jax.random.categorical(k3, jnp.log(prow + 1e-30), axis=-1)
+        s_next = dstb[bi, a_beh, nxt]
+        # restart terminal/action-less lanes from the start distribution
+        s_next = jnp.where(any_valid[s_next] & has_a, s_next,
+                           draw_start(k4))
+        return (V, P, s_next, k), None
+
+    key, k0 = jax.random.split(key)
+    s0 = draw_start(k0)
+    (V, P, s, _), _ = jax.lax.scan(
+        body, (value0, prog0, s0, key), None, length=steps)
+    return V, P
+
+
 @dataclass(frozen=True)
 class TensorMDP:
     """Device-resident MDP: COO transitions + jitted solvers."""
@@ -340,6 +403,57 @@ class TensorMDP:
         )
         return dict(pe_reward=np.asarray(rew), pe_progress=np.asarray(prg),
                     pe_iter=int(it))
+
+    # -- device RTDP ------------------------------------------------------
+
+    def padded_layout(self):
+        """[S*A, K] padded per-(state,action) transition tables — the
+        gather-friendly twin of the COO layout, for solvers that index
+        by (state, action) instead of sweeping all transitions."""
+        S, A = self.n_states, self.n_actions
+        dtype = np.dtype(self.prob.dtype)  # honor the tensor()'s dtype
+        src = np.asarray(self.src, np.int64)
+        act = np.asarray(self.act, np.int64)
+        key = src * A + act
+        order = np.argsort(key, kind="stable")
+        key_s = key[order]
+        pos = np.arange(len(key_s)) - np.searchsorted(key_s, key_s)
+        K = int(pos.max()) + 1 if len(key_s) else 1
+        Tdst = np.zeros((S * A, K), np.int32)
+        Tpack = np.zeros((S * A, K, 3), dtype)
+        Tdst[key_s, pos] = np.asarray(self.dst, np.int32)[order]
+        Tpack[key_s, pos, 0] = np.asarray(self.prob, dtype)[order]
+        Tpack[key_s, pos, 1] = np.asarray(self.reward, dtype)[order]
+        Tpack[key_s, pos, 2] = np.asarray(self.progress, dtype)[order]
+        return jnp.asarray(Tdst), jnp.asarray(Tpack), K
+
+    def rtdp(self, key, *, steps: int, batch: int = 256, eps: float = 0.2,
+             discount: float = 1.0, value0=None, progress0=None):
+        """Device-side RTDP: `batch` parallel eps-greedy trajectories,
+        asynchronous greedy Bellman backups on every visited state —
+        one jitted scan, no host round-trips.
+
+        The TPU-native counterpart of the host RTDP (cpr_tpu/mdp/rtdp.py
+        samples an *implicit* model on the host; this solves the
+        *compiled* table without full sweeps, converging on the states
+        reachable under near-greedy play).  Returns dict with rtdp_value
+        / rtdp_progress arrays; unvisited states keep their init."""
+        assert steps > 0 and batch > 0 and 0.0 <= eps <= 1.0
+        self._check_segment_width()  # rows index by s*A+a in int32 too
+        Tdst, Tpack, K = self.padded_layout()
+        dtype = self.prob.dtype
+        start_cdf = jnp.cumsum(jnp.asarray(self.start, dtype))
+        z = jnp.zeros(self.n_states, dtype)
+        v0 = z if value0 is None else jnp.asarray(value0, dtype)
+        p0 = z if progress0 is None else jnp.asarray(progress0, dtype)
+        t0 = time.time()
+        V, P = _rtdp_loop(Tdst, Tpack, start_cdf, key, self.n_states,
+                          self.n_actions, steps, batch,
+                          jnp.asarray(eps, dtype),
+                          jnp.asarray(discount, dtype), v0, p0)
+        return dict(rtdp_value=np.asarray(V), rtdp_progress=np.asarray(P),
+                    rtdp_steps=steps, rtdp_batch=batch,
+                    rtdp_time=time.time() - t0)
 
     # -- start-state aggregates -------------------------------------------
 

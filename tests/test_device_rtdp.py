@@ -1,0 +1,69 @@
+"""Device-side RTDP (TensorMDP.rtdp): batched async VI with sampled
+trajectories, the TPU-native counterpart of the host RTDP."""
+
+import jax
+import numpy as np
+
+from cpr_tpu.mdp import Compiler, ptmdp
+from cpr_tpu.mdp.models import Fc16BitcoinSM
+
+
+def _tm(fork_len=8, horizon=20):
+    return ptmdp(Compiler(Fc16BitcoinSM(
+        alpha=0.3, gamma=0.5, maximum_fork_length=fork_len)).mdp(),
+        horizon=horizon).tensor()
+
+
+def test_padded_layout_partitions_probability():
+    tm = _tm()
+    Tdst, Tpack, K = tm.padded_layout()
+    mass = np.asarray(Tpack[..., 0]).reshape(
+        tm.n_states, tm.n_actions, K).sum(-1)
+    present = mass > 0
+    np.testing.assert_allclose(mass[present], 1.0, rtol=1e-5)
+    # padded rows carry exactly the COO transition count
+    assert int((np.asarray(Tpack[..., 0]) > 0).sum()) == len(tm.src)
+
+
+def test_device_rtdp_converges_to_vi():
+    tm = _tm()
+    vi = tm.value_iteration(stop_delta=1e-8)
+    exact = tm.start_value(vi["vi_value"]) / tm.start_value(
+        vi["vi_progress"])
+    r = tm.rtdp(jax.random.PRNGKey(1), steps=4000, batch=128, eps=0.25)
+    est = tm.start_value(r["rtdp_value"]) / tm.start_value(
+        r["rtdp_progress"])
+    assert abs(est - exact) / exact < 0.02, (est, exact)
+    # RTDP touches only near-greedy-reachable states
+    visited = int((np.asarray(r["rtdp_value"]) != 0).sum())
+    assert 0 < visited < tm.n_states
+
+
+def test_device_rtdp_warm_start():
+    """Warm-starting from the exact values keeps them (greedy backups
+    are a fixed point there)."""
+    tm = _tm()
+    vi = tm.value_iteration(stop_delta=1e-9)
+    r = tm.rtdp(jax.random.PRNGKey(2), steps=500, batch=64, eps=0.2,
+                value0=vi["vi_value"], progress0=vi["vi_progress"])
+    exact = tm.start_value(vi["vi_value"])
+    warm = tm.start_value(r["rtdp_value"])
+    assert abs(warm - exact) < 5e-4, (warm, exact)
+
+
+def test_device_rtdp_ghostdag_native_table():
+    """Deep-attack MDPs need hot exploration (the attack path runs
+    through low-value withholding states): with eps=0.5 the device RTDP
+    converges to the exact optimum on the native-compiled GhostDAG."""
+    from cpr_tpu.mdp.generic.native import compile_native
+
+    tm = ptmdp(compile_native("ghostdag", k=2, alpha=0.33, gamma=0.5,
+                              collect_garbage="simple", dag_size_cutoff=5),
+               horizon=20).tensor()
+    vi = tm.value_iteration(stop_delta=1e-8)
+    exact = tm.start_value(vi["vi_value"]) / tm.start_value(
+        vi["vi_progress"])
+    r = tm.rtdp(jax.random.PRNGKey(3), steps=30000, batch=256, eps=0.5)
+    est = tm.start_value(r["rtdp_value"]) / tm.start_value(
+        r["rtdp_progress"])
+    assert abs(est - exact) / exact < 0.005, (est, exact)
