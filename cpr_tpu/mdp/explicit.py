@@ -286,11 +286,11 @@ def _rtdp_loop(Tdst, Tpack, start_cdf, key, S, A, steps, batch,
         q = (probb * (rewb + discount * V[dstb])).sum(-1)  # [B, A]
         qp = (probb * (prgb + discount * P[dstb])).sum(-1)
         va = valid_a[s]
-        qm = jnp.where(va, q, -jnp.inf)
-        a_greedy = jnp.argmax(qm, -1)
         has_a = any_valid[s]
-        V = V.at[s].set(jnp.where(has_a, qm[bi, a_greedy], 0.0))
-        P = P.at[s].set(jnp.where(has_a, qp[bi, a_greedy], 0.0))
+        # the same masked greedy backup VI sweeps use (shape-generic)
+        newv, newp, a_greedy = _greedy_backup(q, qp, va, has_a)
+        V = V.at[s].set(newv)
+        P = P.at[s].set(newp)
         # eps-greedy behavior action over the valid set
         a_rand = jax.random.categorical(
             k1, jnp.where(va, 0.0, -jnp.inf), axis=-1)
@@ -409,7 +409,12 @@ class TensorMDP:
     def padded_layout(self):
         """[S*A, K] padded per-(state,action) transition tables — the
         gather-friendly twin of the COO layout, for solvers that index
-        by (state, action) instead of sweeping all transitions."""
+        by (state, action) instead of sweeping all transitions.
+        Memoized on the instance: iterative rtdp() refinement rounds
+        (warm starts) reuse the sort + dense build + device transfer."""
+        cached = getattr(self, "_padded_cache", None)
+        if cached is not None:
+            return cached
         S, A = self.n_states, self.n_actions
         dtype = np.dtype(self.prob.dtype)  # honor the tensor()'s dtype
         src = np.asarray(self.src, np.int64)
@@ -425,7 +430,9 @@ class TensorMDP:
         Tpack[key_s, pos, 0] = np.asarray(self.prob, dtype)[order]
         Tpack[key_s, pos, 1] = np.asarray(self.reward, dtype)[order]
         Tpack[key_s, pos, 2] = np.asarray(self.progress, dtype)[order]
-        return jnp.asarray(Tdst), jnp.asarray(Tpack), K
+        out = (jnp.asarray(Tdst), jnp.asarray(Tpack), K)
+        object.__setattr__(self, "_padded_cache", out)  # frozen dataclass
+        return out
 
     def rtdp(self, key, *, steps: int, batch: int = 256, eps: float = 0.2,
              discount: float = 1.0, value0=None, progress0=None):

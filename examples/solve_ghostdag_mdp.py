@@ -6,7 +6,13 @@ builds 1.19M states / 3.76M transitions in ~40s on one host core (the
 Python BFS is kept as the cross-checked semantic anchor; pass --python
 to use it on small cutoffs).
 
-Usage: python examples/solve_ghostdag_mdp.py [dag_size_cutoff] [--python]
+Usage: python examples/solve_ghostdag_mdp.py [dag_size_cutoff]
+           [--python] [--rtdp]
+
+--rtdp solves with the device RTDP (sampled trajectories, async
+backups) instead of exact sweeps — the practical choice for cutoff 8's
+5.27M-row PT table on a CPU host; the estimate lower-bounds the exact
+optimum (docs/CAPSTONE.md has measured numbers).
 """
 
 import _bootstrap  # noqa: F401  (repo-root path + backend pick)
@@ -21,7 +27,12 @@ from cpr_tpu.parallel import default_mesh, sharded_value_iteration
 
 
 def main():
-    args = [a for a in sys.argv[1:] if a != "--python"]
+    flags = [a for a in sys.argv[1:] if a.startswith("--")]
+    unknown = set(flags) - {"--python", "--rtdp"}
+    if unknown:
+        sys.exit(f"unknown flag(s): {' '.join(sorted(unknown))} "
+                 "(choose from --python --rtdp)")
+    args = [a for a in sys.argv[1:] if not a.startswith("--")]
     cutoff = int(args[0]) if args else 7
     t0 = time.time()
     if "--python" in sys.argv:
@@ -40,6 +51,16 @@ def main():
           f"transitions in {time.time() - t0:.1f}s")
     tm = mdp.tensor()
     t0 = time.time()
+    if "--rtdp" in sys.argv:
+        import jax
+
+        r = tm.rtdp(jax.random.PRNGKey(0), steps=200_000, batch=512,
+                    eps=0.5)
+        rev = tm.start_value(r["rtdp_value"]) / tm.start_value(
+            r["rtdp_progress"])
+        print(f"device RTDP: {time.time() - t0:.1f}s; revenue >= "
+              f"{rev:.4f} (lower bound; honest = 0.3)")
+        return
     vi = sharded_value_iteration(tm, default_mesh(), stop_delta=1e-6)
     rev = tm.start_value(vi["vi_value"]) / tm.start_value(
         vi["vi_progress"])
