@@ -93,8 +93,10 @@ class OracleSim:
     Topologies: clique (n_nodes equal miners), two_agents (alpha split),
     selfish_mining (attacker + defender cloud, gamma via message delays,
     network.ml:61-105).
-    attacker_policy (nakamoto + selfish_mining/two_agents): none, honest,
-    eyal-sirer-2014, sapirshtein-2016-sm1.
+    attacker_policy (selfish_mining/two_agents topologies):
+      nakamoto — none, honest, eyal-sirer-2014, sapirshtein-2016-sm1;
+      ethereum-* — none, honest, fn19, fn19pkel (uncle-bearing
+      withholding with per-step uncle-mining rules).
     """
 
     def __init__(self, protocol: str = "nakamoto", *, k: int = 0,

@@ -100,7 +100,30 @@ struct Event {
   }
 };
 
-struct NakAgent;  // fwd: nakamoto withholding attacker
+struct Sim;
+
+// Withholding attacker on node 0 (optional): tracks a private tip and
+// its model of the defenders' preferred block, and decides per event
+// what to share.  One subclass per attack-space family.
+struct Agent {
+  int policy = 0;
+  int priv = 0, pub = 0;
+  virtual ~Agent() {}
+  void init(int g) { priv = pub = g; }
+  virtual std::vector<int> handle(Sim& s, int b, bool is_pow) = 0;
+  // chain-parent common ancestor (heights along parents[0] are
+  // sequential, so height-stepping both sides converges)
+  template <typename D>
+  static int common_anc(const D& d, int a, int b) {
+    while (a != b) {
+      if (d.blocks[a].height >= d.blocks[b].height)
+        a = d.blocks[a].parents[0];
+      else
+        b = d.blocks[b].parents[0];
+    }
+    return a;
+  }
+};
 
 struct Sim {
   Dag dag;
@@ -130,7 +153,10 @@ struct Sim {
   double now = 0.0;
   long activations = 0;
 
-  std::unique_ptr<NakAgent> agent;          // node 0, optional
+  std::unique_ptr<Agent> agent;             // node 0, optional
+  // attacker uncle-mining rule (set per step by EthAgent; the ethereum
+  // draft for node 0 filters uncle candidates through it)
+  bool atk_mine_own = true, atk_mine_foreign = true;
 
   // bk proposal dedup (simulator.ml:138-158): key -> block id
   std::map<std::string, int> dedup;
@@ -346,6 +372,14 @@ struct Ethereum final : Protocol {
         if (std::find(anc.begin(), anc.end(), cp) == anc.end()) continue;
         uncles.push_back(c);
       }
+    }
+    // the withholding agent steers which uncles its drafts reference
+    // (the uncle-mining rule of the attack space)
+    if (node == 0 && s.agent) {
+      uncles.erase(std::remove_if(uncles.begin(), uncles.end(), [&](int u) {
+        bool own = d.blocks[u].miner == 0;
+        return own ? !s.atk_mine_own : !s.atk_mine_foreign;
+      }), uncles.end());
     }
     // own uncles first, older (lower preference key) first
     std::stable_sort(uncles.begin(), uncles.end(), [&](int a, int b) {
@@ -971,21 +1005,9 @@ struct Sdag final : ParallelBase {
 // (node 0) tracks a private tip and a simulated defender ("public") view;
 // a policy maps {public_blocks, private_blocks, diff_blocks, event} to
 // Adopt/Override/Match/Wait.
-struct NakAgent {
-  int policy;  // 0 honest, 1 eyal-sirer-2014, 2 sapirshtein-2016-sm1
-  int priv, pub;
+struct NakAgent final : Agent {
+  // policy: 0 honest, 1 eyal-sirer-2014, 2 sapirshtein-2016-sm1
 
-  void init(int g) { priv = pub = g; }
-
-  static int common_height(const Dag& d, int a, int b) {
-    while (a != b) {
-      if (d.blocks[a].height >= d.blocks[b].height)
-        a = d.blocks[a].parents[0];
-      else
-        b = d.blocks[b].parents[0];
-    }
-    return d.blocks[a].height;
-  }
 
   int act(int pub_blocks, int priv_blocks, bool pow_event) const {
     (void)pow_event;
@@ -1010,13 +1032,13 @@ struct NakAgent {
   }
 
   // returns blocks to share; updates priv/pub
-  std::vector<int> handle(Sim& s, int b, bool is_pow) {
+  std::vector<int> handle(Sim& s, int b, bool is_pow) override {
     Dag& d = s.dag;
     if (is_pow)
       priv = b;  // mined on private chain
     else if (d.blocks[b].height > d.blocks[pub].height)
       pub = b;  // simulated defender follows longest chain
-    int ca = common_height(d, pub, priv);
+    int ca = d.blocks[common_anc(d, pub, priv)].height;
     int pub_blocks = d.blocks[pub].height - ca;
     int priv_blocks = d.blocks[priv].height - ca;
     enum { ADOPT, OVERRIDE, MATCH, WAIT };
@@ -1032,6 +1054,77 @@ struct NakAgent {
       // releasing updates the simulated defender model at next event via
       // pending messages; model it immediately like prepare() would
       if (d.blocks[x].height > d.blocks[pub].height) pub = x;
+    }
+    return share;
+  }
+};
+
+// ------------------------------------------- ethereum withholding agent
+
+// Clean-room FN'19-style state machine (ethereum_ssz.ml:172-221 actions,
+// :444-538 policies; same semantics as cpr_tpu/envs/ethereum.py): the
+// attacker withholds a private uncle-bearing chain, adopts / overrides /
+// matches by the preset's preference key, and steers which uncles its
+// own drafts include (the Sim::atk_mine_* hook).
+struct EthAgent final : Agent {
+  // policy: 0 honest, 1 fn19 (adopt-discard, all uncles),
+  //         2 fn19pkel (adopt-release, own uncles only)
+  bool byzantium = true;  // preference: byzantium height, whitepaper work
+
+  int pkey(const Dag& d, int b) const {
+    return byzantium ? d.blocks[b].height : d.blocks[b].work;
+  }
+
+  std::vector<int> handle(Sim& s, int b, bool is_pow) override {
+    Dag& d = s.dag;
+    if (is_pow)
+      priv = b;
+    else if (pkey(d, b) > pkey(d, pub))
+      pub = b;  // defenders follow strict preference improvement
+    int ca = common_anc(d, pub, priv);
+    int ph = d.blocks[pub].height - d.blocks[ca].height;
+    int ah = d.blocks[priv].height - d.blocks[ca].height;
+
+    enum { ADOPT_DISCARD, ADOPT_RELEASE, OVERRIDE, MATCH, RELEASE1, WAIT };
+    int act;
+    bool own = true, foreign = true;
+    if (policy == 0) {  // honest: behind on work -> adopt, else release
+      int pw = d.blocks[pub].work - d.blocks[ca].work;
+      act = pw > 0 ? ADOPT_RELEASE : OVERRIDE;
+    } else {  // fn19 / fn19pkel (ethereum_ssz.ml:505-538)
+      int adopt = policy == 1 ? ADOPT_DISCARD : ADOPT_RELEASE;
+      if (policy == 2) foreign = false;  // OWN_ONLY uncle rule
+      if (is_pow)
+        act = (ah == 2 && ph == 1) ? OVERRIDE : WAIT;
+      else if (ah < ph)
+        act = adopt;
+      else if (ah == ph)
+        act = MATCH;
+      else if (ah == ph + 1)
+        act = OVERRIDE;
+      else
+        act = RELEASE1;
+    }
+    s.atk_mine_own = own;
+    s.atk_mine_foreign = foreign;
+
+    std::vector<int> share;
+    if (act == ADOPT_DISCARD) {
+      priv = pub;
+    } else if (act == ADOPT_RELEASE) {
+      if (priv != pub) share.push_back(priv);
+      priv = pub;
+    } else if (act == OVERRIDE || act == MATCH || act == RELEASE1) {
+      // release_upto: first block back from priv with pref <= target
+      // (ethereum_ssz.ml:404-412)
+      int target = act == OVERRIDE ? pkey(d, pub) + 1
+                   : act == MATCH  ? pkey(d, pub)
+                                   : pkey(d, ca) + 1;
+      int x = priv;
+      while (pkey(d, x) > target && d.blocks[x].miner >= 0)
+        x = d.blocks[x].parents[0];
+      share.push_back(x);
+      if (pkey(d, x) > pkey(d, pub)) pub = x;
     }
     return share;
   }
@@ -1075,19 +1168,26 @@ void Sim::handle_honest(int node, int b) {
 
 void Sim::handle_agent(int b, bool is_pow) {
   for (int x : agent->handle(*this, b, is_pow)) {
-    // release the chain up to x (parents must reach defenders too;
-    // sharing recursively covers withheld ancestors,
-    // simulator.ml:401-419)
-    std::vector<int> chain;
-    for (int y = x; dag.blocks[y].miner >= 0;
-         y = dag.blocks[y].parents[0]) {
+    // release x and its withheld ancestry over ALL parent slots —
+    // uncle references too, or defenders would buffer the released
+    // block forever (recursive share of withheld ancestors,
+    // simulator.ml:401-419); a non-withheld block's ancestry is
+    // already public, so the walk prunes there
+    std::vector<int> stack{x}, rel;
+    while (!stack.empty()) {
+      int y = stack.back();
+      stack.pop_back();
+      if (y < 0 || dag.blocks[y].miner < 0) continue;
       bool withheld = false;
       for (int n = 1; n < n_nodes; n++)
         if (!is_visible(n, y)) withheld = true;
-      if (!withheld) break;
-      chain.push_back(y);
+      if (!withheld) continue;
+      if (std::find(rel.begin(), rel.end(), y) != rel.end()) continue;
+      rel.push_back(y);
+      for (int p : dag.blocks[y].parents) stack.push_back(p);
     }
-    for (auto it = chain.rbegin(); it != chain.rend(); ++it) send(0, *it);
+    std::sort(rel.begin(), rel.end());  // ids are topological
+    for (int y : rel) send(0, y);
   }
   preferred[0] = agent->priv;
 }
@@ -1217,14 +1317,27 @@ void* cpr_oracle_create(const char* protocol, int k, const char* scheme,
 
   std::string pol(attacker_policy ? attacker_policy : "");
   if (!pol.empty() && pol != "none") {
-    if (proto != "nakamoto") {
+    if (proto == "nakamoto") {
+      s.agent.reset(new NakAgent());
+      s.agent->policy = pol == "honest" ? 0
+                        : pol == "eyal-sirer-2014" ? 1
+                        : pol == "sapirshtein-2016-sm1" ? 2 : -1;
+    } else if (proto == "ethereum-whitepaper" ||
+               proto == "ethereum-byzantium") {
+      auto* a = new EthAgent();
+      a->byzantium = proto == "ethereum-byzantium";
+      s.agent.reset(a);
+      s.agent->policy = pol == "honest" ? 0
+                        : pol == "fn19" ? 1
+                        : pol == "fn19pkel" ? 2 : -1;
+    } else {
       delete h;
-      return nullptr;  // withholding agent implemented for nakamoto
+      return nullptr;  // withholding agents: nakamoto + ethereum
     }
-    s.agent.reset(new NakAgent());
-    s.agent->policy = pol == "honest" ? 0
-                      : pol == "eyal-sirer-2014" ? 1
-                      : 2;  // sapirshtein-2016-sm1
+    if (s.agent->policy < 0) {
+      delete h;
+      return nullptr;  // unknown policy name for this protocol
+    }
   }
 
   s.init();

@@ -3,6 +3,7 @@ trajectories, the TPU-native counterpart of the host RTDP."""
 
 import jax
 import numpy as np
+import pytest
 
 from cpr_tpu.mdp import Compiler, ptmdp
 from cpr_tpu.mdp.models import Fc16BitcoinSM
@@ -51,6 +52,7 @@ def test_device_rtdp_warm_start():
     assert abs(warm - exact) < 5e-4, (warm, exact)
 
 
+@pytest.mark.slow  # ~45s; fc16 convergence covers the fast tier
 def test_device_rtdp_ghostdag_native_table():
     """Deep-attack MDPs need hot exploration (the attack path runs
     through low-value withholding states): with eps=0.5 the device RTDP

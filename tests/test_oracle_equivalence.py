@@ -82,6 +82,8 @@ def _two_agents_share(protocol, alpha, activations, seed=0, **kw):
     return rw[0] / sum(rw)
 
 
+@pytest.mark.slow  # whitepaper-preset anchor; byzantium honest stays
+# fast via test_ethereum_attacker_cross_engine[honest]
 def test_ethereum_honest_cross_engine():
     """Honest-play reward share: JAX ethereum attack env vs oracle
     two-party network (whitepaper uncles on both sides)."""
@@ -120,8 +122,10 @@ def test_bk_honest_cross_engine():
      dict(k=4, scheme="constant")),
     ("tailstorm", "tailstorm", "tailstorm-4-discount-heuristic",
      dict(k=4, scheme="discount")),
-    ("tailstormjune", "stree", "tailstormjune-4-discount",
-     dict(k=4, scheme="discount")),
+    pytest.param("tailstormjune", "stree", "tailstormjune-4-discount",
+                 dict(k=4, scheme="discount"),
+                 marks=pytest.mark.slow),  # heaviest compile; tailstorm
+    # stays fast as the family's cross-engine representative
 ])
 def test_parallel_family_honest_cross_engine(family, oracle_proto, key,
                                              okw):
@@ -172,3 +176,28 @@ def test_oracle_seeds_are_deterministic():
     b = oracle_share("nakamoto", alpha=0.3, gamma=0.5, policy="honest",
                      activations=5_000, seed=9)
     assert a == b
+
+
+@pytest.mark.parametrize("policy,tol", [
+    ("honest", 0.015),
+    ("fn19", 0.025),
+    pytest.param("fn19pkel", 0.025, marks=pytest.mark.slow),
+])
+def test_ethereum_attacker_cross_engine(policy, tol):
+    """Second attack-space anchor: the oracle's FN'19-style ethereum
+    withholding agent vs the JAX env's policies — revenue agreement on
+    the byzantium preset (both engines must also rank the attacks
+    identically: fn19pkel > fn19 > honest at alpha=0.35)."""
+    from cpr_tpu.envs.ethereum import EthereumSSZ
+
+    alpha, gamma = 0.35, 0.5
+    o = oracle_share("ethereum-byzantium", alpha=alpha, gamma=gamma,
+                     policy=policy, activations=60_000)
+    env = EthereumSSZ("byzantium", max_steps_hint=192)
+    j = jax_share(env, alpha=alpha, gamma=gamma, policy=policy,
+                  n_envs=256, max_steps=192)
+    assert abs(o - j) < tol, (policy, o, j)
+    if policy == "honest":
+        assert abs(o - alpha) < 0.01, o
+    else:
+        assert o > alpha + 0.01 and j > alpha + 0.01, (policy, o, j)
