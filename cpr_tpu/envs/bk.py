@@ -36,6 +36,14 @@ Documented deviations from the reference event-queue simulation:
 - Attacker-view `visible_since` is the append time (the attacker hears
   defender messages instantly in the selfish-mining network,
   network.ml:85-95).
+- Measured against the C++ multi-node oracle's BkAgent
+  (tests/test_oracle_equivalence.py): honest play agrees within 0.01
+  for alpha <= 1/3 (drifting to ~0.02 by alpha = 0.4);
+  `get-ahead` revenue differs by up to ~0.05-0.07 in either direction
+  (alpha 0.35-0.45, gamma 0.5, k 1-4) — vote-race and proposal-timing
+  dynamics at event granularity don't collapse cleanly into the
+  one-step-per-interaction model, so the cross-engine tests record the
+  error bar rather than asserting parity for this policy.
 """
 
 from __future__ import annotations

@@ -96,7 +96,9 @@ class OracleSim:
     attacker_policy (selfish_mining/two_agents topologies):
       nakamoto — none, honest, eyal-sirer-2014, sapirshtein-2016-sm1;
       ethereum-* — none, honest, fn19, fn19pkel (uncle-bearing
-      withholding with per-step uncle-mining rules).
+      withholding with per-step uncle-mining rules);
+      bk — none, honest, get-ahead (vote withholding with private
+      quorum proposals).
     """
 
     def __init__(self, protocol: str = "nakamoto", *, k: int = 0,

@@ -111,6 +111,10 @@ struct Agent {
   virtual ~Agent() {}
   void init(int g) { priv = pub = g; }
   virtual std::vector<int> handle(Sim& s, int b, bool is_pow) = 0;
+  // called for EVERY block the release machinery actually sends —
+  // including withheld ancestors shared implicitly — so agents that
+  // track in-flight releases see the full set
+  virtual void note_sent(Sim& s, int b) { (void)s; (void)b; }
   // chain-parent common ancestor (heights along parents[0] are
   // sequential, so height-stepping both sides converges)
   template <typename D>
@@ -1116,7 +1120,11 @@ struct EthAgent final : Agent {
       priv = pub;
     } else if (act == OVERRIDE || act == MATCH || act == RELEASE1) {
       // release_upto: first block back from priv with pref <= target
-      // (ethereum_ssz.ml:404-412)
+      // (ethereum_ssz.ml:404-412).  Under the work-keyed whitepaper
+      // preference the walk can step BELOW the target (work jumps by
+      // 1+uncles) and release an already-public block — a deliberate
+      // no-op with exactly the reference's stop rule; the JAX env
+      // documents the same behavior (envs/ethereum.py _release_upto)
       int target = act == OVERRIDE ? pkey(d, pub) + 1
                    : act == MATCH  ? pkey(d, pub)
                                    : pkey(d, ca) + 1;
@@ -1125,6 +1133,123 @@ struct EthAgent final : Agent {
         x = d.blocks[x].parents[0];
       share.push_back(x);
       if (pkey(d, x) > pkey(d, pub)) pub = x;
+    }
+    return share;
+  }
+};
+
+// ------------------------------------------------- bk withholding agent
+
+// Vote-withholding attacker for the Bk family (bk_ssz.ml:265-331 apply,
+// :346-404 policies; same semantics as cpr_tpu/envs/bk.py, Proceed
+// variants): the attacker mines votes on a private chain, assembles
+// private proposals through the protocol's own quorum logic, and on
+// Override releases the private block at the target height plus just
+// enough withheld votes to flip the defenders' preference.
+struct BkAgent final : Agent {
+  // policy: 0 honest, 1 get-ahead
+  int k = 1;
+  std::vector<char> sent;  // released by us but possibly still in flight
+
+  bool is_public(Sim& s, int b) {
+    if (b < (int)sent.size() && sent[b]) return true;
+    for (int n = 1; n < s.n_nodes; n++)
+      if (s.is_visible(n, b)) return true;
+    return false;
+  }
+  void mark_sent(Sim& s, int b) {
+    if ((int)sent.size() <= b) sent.resize(s.dag.blocks.size(), 0);
+    sent[b] = 1;
+  }
+  // the release machinery shares withheld ancestors implicitly (quorum
+  // votes inside a released proposal); count them in-flight too
+  void note_sent(Sim& s, int b) override { mark_sent(s, b); }
+
+  int public_votes_on(Sim& s, int b) {
+    int n = 0;
+    for (int c : s.dag.blocks[b].children)
+      if (s.dag.blocks[c].is_vote && is_public(s, c)) n++;
+    return n;
+  }
+
+  // defender-eye preference (height, public votes, -leader hash)
+  bool pub_better(Sim& s, int a, int b) {
+    const Dag& d = s.dag;
+    if (d.blocks[a].height != d.blocks[b].height)
+      return d.blocks[a].height > d.blocks[b].height;
+    int va = public_votes_on(s, a), vb = public_votes_on(s, b);
+    if (va != vb) return va > vb;
+    auto lh = [&](int blk) {
+      if (d.blocks[blk].parents.size() >= 2)
+        return d.blocks[d.blocks[blk].parents[1]].pow_hash;
+      return 2.0;
+    };
+    return lh(a) < lh(b);
+  }
+
+  std::vector<int> handle(Sim& s, int b, bool is_pow) override {
+    Dag& d = s.dag;
+    if (!is_pow) {
+      int cand = d.blocks[b].is_vote ? d.blocks[b].parents[0] : b;
+      if (pub_better(s, cand, pub)) pub = cand;
+      // defender proposals can also beat the private tip outright
+      if (d.blocks[cand].height > d.blocks[priv].height) priv = cand;
+    }
+    int ca = common_anc(d, pub, priv);
+    int pub_b = d.blocks[pub].height - d.blocks[ca].height;
+    int priv_b = d.blocks[priv].height - d.blocks[ca].height;
+
+    enum { ADOPT, OVERRIDE, WAIT };
+    int act;
+    if (policy == 0)  // honest (bk_ssz.ml:349-352)
+      act = pub_b > priv_b ? ADOPT : OVERRIDE;
+    else  // get-ahead (bk_ssz.ml:354-360)
+      act = pub_b > priv_b ? ADOPT : (pub_b < priv_b ? OVERRIDE : WAIT);
+
+    std::vector<int> share;
+    if (act == ADOPT) {
+      priv = pub;
+    } else if (act == OVERRIDE) {
+      // release targeting (bk_ssz.ml:271-283)
+      int nv_pub = public_votes_on(s, pub);
+      int tgt_h = d.blocks[pub].height + (nv_pub >= k ? 1 : 0);
+      int tgt_v = nv_pub >= k ? 0 : nv_pub + 1;
+      int blk = priv;
+      while (d.blocks[blk].height > tgt_h && d.blocks[blk].miner >= 0)
+        blk = d.blocks[blk].parents[0];
+      int rel = blk;
+      if (tgt_v >= k) {  // prefer an existing proposal child
+        for (int c : d.blocks[blk].children)
+          if (!d.blocks[c].is_vote) {
+            rel = c;
+            tgt_v = 0;
+            break;
+          }
+      }
+      share.push_back(rel);
+      // + earliest-seen withheld votes on the released block
+      std::vector<int> held;
+      for (int c : d.blocks[rel].children)
+        if (d.blocks[c].is_vote && !is_public(s, c)) held.push_back(c);
+      std::stable_sort(held.begin(), held.end(), [&](int a, int c) {
+        return d.blocks[a].time < d.blocks[c].time;
+      });
+      int public_already = public_votes_on(s, rel);
+      for (int i = 0; i < (int)held.size() && public_already + i < tgt_v;
+           i++)
+        share.push_back(held[i]);
+      for (int y : share) mark_sent(s, y);
+      if (pub_better(s, rel, pub)) pub = rel;
+    }
+    // one attacker proposal attempt per interaction on the (post-action)
+    // private tip, like the env's append_proposal at the end of _apply —
+    // a defender vote can complete an attacker-led quorum, so this must
+    // run on every event, not just own PoW (Proceed's inclusive vote
+    // filter == node-0 visibility)
+    for (Block& prop : s.proto->proposals(s, 0, priv)) {
+      int id = s.append_plain(0, std::move(prop));
+      if (!s.is_visible(0, id)) s.mark_visible(0, id);
+      if (d.blocks[id].height > d.blocks[priv].height) priv = id;
     }
     return share;
   }
@@ -1187,7 +1312,10 @@ void Sim::handle_agent(int b, bool is_pow) {
       for (int p : dag.blocks[y].parents) stack.push_back(p);
     }
     std::sort(rel.begin(), rel.end());  // ids are topological
-    for (int y : rel) send(0, y);
+    for (int y : rel) {
+      agent->note_sent(*this, y);
+      send(0, y);
+    }
   }
   preferred[0] = agent->priv;
 }
@@ -1330,9 +1458,15 @@ void* cpr_oracle_create(const char* protocol, int k, const char* scheme,
       s.agent->policy = pol == "honest" ? 0
                         : pol == "fn19" ? 1
                         : pol == "fn19pkel" ? 2 : -1;
+    } else if (proto == "bk") {
+      auto* a = new BkAgent();
+      a->k = k;
+      s.agent.reset(a);
+      s.agent->policy = pol == "honest" ? 0
+                        : pol == "get-ahead" ? 1 : -1;
     } else {
       delete h;
-      return nullptr;  // withholding agents: nakamoto + ethereum
+      return nullptr;  // withholding agents: nakamoto, ethereum, bk
     }
     if (s.agent->policy < 0) {
       delete h;
