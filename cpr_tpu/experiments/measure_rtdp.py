@@ -31,10 +31,13 @@ def rtdp_battery(alphas=(0.25, 0.33, 0.4), gamma=0.5, fork_len=12):
 
 def measure_rtdp_rows(battery=None, *, horizon=30, step_budgets=(50_000,),
                       eps=0.2, eps_honest=0.05, es=0.1, seed=0,
-                      stop_delta=1e-6):
-    """For each model: exact jitted-VI revenue once, then one RTDP run
-    per step budget (continuing the same run between budgets, so rows
-    show convergence over the budget schedule)."""
+                      stop_delta=1e-6, device_rtdp=True,
+                      device_batch=128, device_eps=0.4):
+    """For each model: exact jitted-VI revenue once, then one host-RTDP
+    run per step budget (continuing the same run between budgets, so
+    rows show convergence over the budget schedule), plus — when
+    `device_rtdp` — the device solver (TensorMDP.rtdp) warm-started
+    from zero at the same per-budget step counts for comparison."""
     rows = []
     if battery is None:
         battery = rtdp_battery()
@@ -50,6 +53,8 @@ def measure_rtdp_rows(battery=None, *, horizon=30, step_budgets=(50_000,),
         solver = RTDP(ptmdp_model(model, horizon), eps=eps,
                       eps_honest=eps_honest, es=es, seed=seed)
         done, rtdp_s = 0, 0.0
+        dev_v = dev_p = None
+        dev_done, dev_s = 0, 0.0
         for budget in sorted(step_budgets):
             t0 = time.time()
             solver.run(budget - done)
@@ -57,13 +62,33 @@ def measure_rtdp_rows(battery=None, *, horizon=30, step_budgets=(50_000,),
             done = budget
             v, g = solver.start_value_and_progress()
             est = v / g if g else 0.0
-            rows.append({
+            row = {
                 "model": name, "steps": budget,
                 "n_states": solver.n_states,
                 "rtdp_revenue": est, "vi_revenue": exact,
                 "abs_error": abs(est - exact),
                 "rtdp_s": rtdp_s, "vi_s": vi_s,
-            })
+            }
+            if device_rtdp:
+                import jax
+
+                # batched lanes: budget counts total sampled steps
+                dev_steps = max(1, (budget - dev_done) // device_batch)
+                # fresh stream per continuation segment — reusing the
+                # same key would replay the previous segment's draws
+                seg_key = jax.random.fold_in(
+                    jax.random.PRNGKey(seed), budget)
+                r = tm.rtdp(seg_key, steps=dev_steps,
+                            batch=device_batch, eps=device_eps,
+                            value0=dev_v, progress0=dev_p)
+                dev_v, dev_p = r["rtdp_value"], r["rtdp_progress"]
+                dev_s += r["rtdp_time"]
+                dev_done = budget
+                dg = tm.start_value(dev_p)
+                dest = tm.start_value(dev_v) / dg if dg else 0.0
+                row["device_rtdp_revenue"] = dest
+                row["device_rtdp_s"] = dev_s
+            rows.append(row)
     return rows
 
 

@@ -229,7 +229,6 @@ def test_bk_attacker_cross_engine(k, policy, alpha, tol):
         assert o > alpha and j > alpha, (o, j)
 
 
-@pytest.mark.slow
 def test_ethereum_attack_ranking():
     """The oracle must rank the ethereum attacks fn19pkel > fn19 >
     honest at alpha=0.35 (oracle-only: cheap, no JAX compiles)."""
