@@ -46,10 +46,12 @@ def test_native_matches_python_compiler(proto, kw, k):
 
 
 def test_native_flag_variants_match_python():
-    """loop_honest and judge-GC paths agree with the Python model too."""
+    """Every non-default flag path agrees with the Python model too
+    (one variant per entry below; extend the tuple, not a new test)."""
     for flags in (dict(loop_honest=True, truncate_common_chain=False),
                   dict(collect_garbage="judge"),
-                  dict(force_consider_own=True)):
+                  dict(force_consider_own=True),
+                  dict(reward_common_chain=True)):
         base = dict(alpha=0.3, gamma=0.5, collect_garbage="simple",
                     merge_isomorphic=True, truncate_common_chain=True,
                     dag_size_cutoff=5)
