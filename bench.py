@@ -21,6 +21,40 @@ import sys
 import time
 
 
+def measure_nakamoto(n_envs: int, n_steps: int = 2200, reps: int = 3):
+    """The headline workload: SM1 selfish mining over `n_envs` vmapped
+    episode streams.  Returns (env-steps/sec, SM1 relative revenue) —
+    the one definition shared by the bench and the perf-experiment
+    tooling (tools/tpu_bench_experiments.py), so sweeps there measure
+    exactly what the bench reports."""
+    import jax
+    import numpy as np
+
+    from cpr_tpu.envs.nakamoto import NakamotoSSZ
+    from cpr_tpu.params import make_params
+
+    env = NakamotoSSZ()
+    # scan n_steps past one full episode (max_steps=2016) so stats exist
+    params = make_params(alpha=0.35, gamma=0.5, max_steps=2016)
+    policy = env.policies["sapirshtein-2016-sm1"]
+    keys = jax.random.split(jax.random.PRNGKey(0), n_envs)
+    fn = jax.jit(jax.vmap(
+        lambda k: env.episode_stats(k, params, policy, n_steps)))
+    jax.block_until_ready(fn(keys))  # compile
+    t0 = time.time()
+    for _ in range(reps):
+        stats = jax.block_until_ready(fn(keys))
+    dt = (time.time() - t0) / reps
+    atk = np.asarray(stats["episode_reward_attacker"]).mean()
+    dfn = np.asarray(stats["episode_reward_defender"]).mean()
+    return n_envs * n_steps / dt, atk / (atk + dfn)
+
+
+# correctness guard bounds: SM1 revenue near the ES'14 closed form
+# (alpha=.35, gamma=.5 -> 0.416)
+SM1_GUARD = (0.38, 0.45)
+
+
 def run_bench(platform_hint: str):
     """Measure and print the JSON line on whatever backend comes up."""
     import jax
@@ -32,36 +66,13 @@ def run_bench(platform_hint: str):
     print(f"bench: backend={platform} devices={len(devs)}",
           file=sys.stderr)
 
-    import numpy as np
-
-    from cpr_tpu.envs.nakamoto import NakamotoSSZ
-    from cpr_tpu.params import make_params
-
-    env = NakamotoSSZ()
-    params = make_params(alpha=0.35, gamma=0.5, max_steps=2016)
-    policy = env.policies["sapirshtein-2016-sm1"]
-
-    # scan past one full episode (max_steps=2016) so episode stats exist
     # batch sweep on v5e-1 (2026-07): 8192 -> 137M steps/s, 65536 ->
     # 281M, 131072 -> 306M, 262144 -> 312M (saturated); 131072 keeps
     # compile + memory comfortable at ~98% of peak
-    n_envs, n_steps = (131072, 2200) if platform != "cpu" else (512, 2200)
-    keys = jax.random.split(jax.random.PRNGKey(0), n_envs)
-    fn = jax.jit(jax.vmap(
-        lambda k: env.episode_stats(k, params, policy, n_steps)))
-    jax.block_until_ready(fn(keys))  # compile
-    reps = 3
-    t0 = time.time()
-    for _ in range(reps):
-        stats = jax.block_until_ready(fn(keys))
-    dt = (time.time() - t0) / reps
-    steps_per_sec = n_envs * n_steps / dt
-
-    # correctness guard: SM1 revenue near the ES'14 closed form
-    atk = np.asarray(stats["episode_reward_attacker"]).mean()
-    dfn = np.asarray(stats["episode_reward_defender"]).mean()
-    rel = atk / (atk + dfn)
-    assert 0.38 < rel < 0.45, f"SM1 revenue {rel} off closed form 0.416"
+    n_envs = 131072 if platform != "cpu" else 512
+    steps_per_sec, rel = measure_nakamoto(n_envs)
+    assert SM1_GUARD[0] < rel < SM1_GUARD[1], \
+        f"SM1 revenue {rel} off closed form 0.416"
 
     print(json.dumps({
         "metric": "nakamoto_selfish_mining_env_steps_per_sec_per_chip",

@@ -1,0 +1,38 @@
+"""Bench experiments to run when the TPU is healthy: PRNG-implementation
+sweep on the exact bench.py workload.
+
+threefry (JAX default) is counter-based and compute-heavy; rbg uses the
+hardware RNG path and often doubles rollout throughput on TPU.  Results
+print one line per config; fold winners into bench.py (the measurement
+and the SM1-vs-ES'14 guard are shared via bench.measure_nakamoto, so
+numbers transfer 1:1).
+
+Usage: python tools/tpu_bench_experiments.py [n_envs]
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+
+def main():
+    import jax
+
+    from bench import SM1_GUARD, measure_nakamoto
+
+    n_envs = int(sys.argv[1]) if len(sys.argv) > 1 else 131072
+    # prng impl only affects trace-time key types; each run builds a
+    # fresh trace, so one process can sweep both
+    for prng in ("threefry2x32", "rbg"):
+        jax.config.update("jax_default_prng_impl", prng)
+        steps_per_sec, rel = measure_nakamoto(n_envs)
+        ok = SM1_GUARD[0] < rel < SM1_GUARD[1]
+        print(f"prng={prng} n_envs={n_envs}: {steps_per_sec / 1e6:.0f}M "
+              f"steps/s (SM1 rel {rel:.4f} guard {'ok' if ok else 'FAIL'})",
+              flush=True)
+
+
+if __name__ == "__main__":
+    main()
