@@ -5,7 +5,8 @@ Re-architects the capabilities of the reference (pkel/cpr: OCaml discrete-event
 simulator + OCaml/Rust gym extensions + Python MDP toolbox) for JAX/XLA:
 
 - protocols as pure state-transition functions over fixed-capacity block-DAG
-  tensors (`cpr_tpu.core`, `cpr_tpu.protocols`),
+  tensors (`cpr_tpu.core`; protocol rules live inside each attack env and
+  in `cpr_tpu.mdp.generic.protocols`),
 - selfish-mining attack environments as jittable, `vmap`-batched Monte-Carlo
   kernels (`cpr_tpu.envs`), exposed through gymnasium env ids
   (`cpr_tpu.gym`: core-v0, cpr-v0, cpr-nakamoto-v0, cpr-tailstorm-v0),
