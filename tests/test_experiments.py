@@ -198,3 +198,25 @@ def test_measure_rtdp_sweep():
     assert rows[-1]["abs_error"] < 0.02
     assert rows[-1]["n_states"] >= rows[0]["n_states"]
     write_tsv(rows)
+
+
+@pytest.mark.slow
+def test_config_battery_trains_each_family():
+    """One tiny end-to-end training run per protocol family's shipped
+    config: catches config -> env -> trainer integration gaps the
+    parse/resolve test cannot (e.g. observation-length or capacity
+    mismatches under schedules)."""
+    import numpy as np
+
+    cfg_dir = os.path.join(os.path.dirname(__file__), "..", "cpr_tpu",
+                           "train", "configs")
+    for name in ("spar-4.yaml", "stree-4-constant.yaml",
+                 "sdag-4-constant.yaml", "bk-8.yaml"):
+        cfg = TrainConfig.from_yaml(os.path.join(cfg_dir, name))
+        cfg = cfg.model_copy(update=dict(
+            n_envs=8, total_updates=1, episode_len=16,
+            ppo=type(cfg.ppo)(n_steps=8, n_minibatches=2,
+                              update_epochs=1, layer_size=16),
+            eval=type(cfg.eval)(freq=100)))
+        params, history, rows = train_from_config(cfg, n_updates=1)
+        assert np.isfinite(history[-1]["mean_step_reward"]), name
