@@ -4,7 +4,7 @@
 
 CPU_MESH = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test test-slow bench dryrun sweeps ghostdag train-dummy native
+.PHONY: test test-slow bench dryrun sweeps ghostdag train-dummy native asan
 
 test:  ## fast tier (< ~8 min on the 1-core host)
 	python -m pytest tests/ -q
@@ -40,3 +40,12 @@ train-dummy:  ## smoke the config-driven PPO driver
 
 native:  ## (re)build both C++ libraries
 	python -c "import cpr_tpu.native as n; n.lib(); import cpr_tpu.mdp.generic.native as g; g.lib(); print('native libs ready')"
+
+asan:  ## AddressSanitizer pass over both native libraries
+	g++ -O1 -g -fsanitize=address -std=c++17 -shared -fPIC \
+		cpr_tpu/native/src/generic_compiler.cpp -o /tmp/libgc_asan.so
+	g++ -O1 -g -fsanitize=address -std=c++17 -shared -fPIC \
+		cpr_tpu/native/src/oracle.cpp -o /tmp/liborc_asan.so
+	LD_PRELOAD=$$(g++ -print-file-name=libasan.so) \
+		ASAN_OPTIONS=detect_leaks=0:abort_on_error=1 \
+		python tools/asan_drive.py

@@ -211,12 +211,15 @@ def test_config_battery_trains_each_family():
     cfg_dir = os.path.join(os.path.dirname(__file__), "..", "cpr_tpu",
                            "train", "configs")
     for name in ("spar-4.yaml", "stree-4-constant.yaml",
-                 "sdag-4-constant.yaml", "bk-8.yaml"):
+                 "sdag-4-constant.yaml", "bk-8.yaml",
+                 "tailstorm-8-discount.yaml"):
         cfg = TrainConfig.from_yaml(os.path.join(cfg_dir, name))
+        # shrink only the size knobs; keep the shipped hyperparameters
         cfg = cfg.model_copy(update=dict(
-            n_envs=8, total_updates=1, episode_len=16,
-            ppo=type(cfg.ppo)(n_steps=8, n_minibatches=2,
-                              update_epochs=1, layer_size=16),
-            eval=type(cfg.eval)(freq=100)))
+            n_envs=8, episode_len=16,
+            ppo=cfg.ppo.model_copy(update=dict(
+                n_steps=8, n_minibatches=2, update_epochs=1,
+                layer_size=16)),
+            eval=cfg.eval.model_copy(update=dict(freq=100))))
         params, history, rows = train_from_config(cfg, n_updates=1)
         assert np.isfinite(history[-1]["mean_step_reward"]), name

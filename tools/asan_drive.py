@@ -1,0 +1,90 @@
+"""Drive the ASan builds of both native libraries (see `make asan`).
+
+Exercises every protocol module, topology, and withholding-agent family
+in the oracle, and every protocol spec + flag path in the generic-MDP
+compiler — the C++ surface a memory bug could hide in.  Run under
+LD_PRELOAD=libasan.so; any ASan report aborts with a nonzero exit.
+"""
+
+import ctypes
+
+
+def drive_compiler(path="/tmp/libgc_asan.so"):
+    L = ctypes.CDLL(path)
+    L.gmc_compile.restype = ctypes.c_void_p
+    L.gmc_compile.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.c_double, ctypes.c_double,
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.c_int64]
+    for f in ("gmc_n_states", "gmc_n_transitions"):
+        getattr(L, f).restype = ctypes.c_int64
+        getattr(L, f).argtypes = [ctypes.c_void_p]
+    L.gmc_error.restype = ctypes.c_char_p
+    L.gmc_error.argtypes = [ctypes.c_void_p]
+    L.gmc_free.argtypes = [ctypes.c_void_p]
+
+    cases = [(b"ghostdag", 2), (b"bitcoin", 0), (b"parallel", 2),
+             (b"ethereum", 3), (b"byzantium", 3)]
+    for proto, k in cases:
+        # (proto, k, alpha, gamma, dag_cutoff, height_cutoff, gc_mode,
+        #  merge_iso, truncate, loop_honest, reward_cc, force_own, cap)
+        h = L.gmc_compile(proto, k, 0.33, 0.5, 6, -1, 1, 1, 1, 0, 0, 0,
+                          10**7)
+        # a non-null handle can still carry a partial-compile error
+        # (state cap, probability-sum failure)
+        assert h and not L.gmc_error(h), (proto, L.gmc_error(h))
+        print("compiler", proto.decode(), int(L.gmc_n_states(h)),
+              int(L.gmc_n_transitions(h)), flush=True)
+        L.gmc_free(h)
+    # flag variants on bitcoin (judge GC, loop-honest, reward-cc)
+    for args in ((5, -1, 2, 1, 1, 0, 0, 0), (5, -1, 1, 1, 0, 1, 0, 0),
+                 (5, -1, 1, 1, 1, 0, 1, 0), (5, -1, 1, 1, 1, 0, 0, 1)):
+        h = L.gmc_compile(b"bitcoin", 0, 0.3, 0.5, *args, 10**6)
+        assert h and not L.gmc_error(h), (args, L.gmc_error(h))
+        L.gmc_free(h)
+    print("compiler flag variants: clean", flush=True)
+
+
+def drive_oracle(path="/tmp/liborc_asan.so"):
+    L = ctypes.CDLL(path)
+    L.cpr_oracle_create.restype = ctypes.c_void_p
+    L.cpr_oracle_create.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_char_p,
+        ctypes.c_int, ctypes.c_double, ctypes.c_double, ctypes.c_int,
+        ctypes.c_double, ctypes.c_double, ctypes.c_char_p,
+        ctypes.c_uint64]
+    L.cpr_oracle_run.restype = ctypes.c_long
+    L.cpr_oracle_run.argtypes = [ctypes.c_void_p, ctypes.c_long]
+    L.cpr_oracle_metric.restype = ctypes.c_double
+    L.cpr_oracle_metric.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                    ctypes.c_int]
+    L.cpr_oracle_destroy.argtypes = [ctypes.c_void_p]
+
+    cases = [
+        (b"nakamoto", 0, b"", b"selfish_mining", b"sapirshtein-2016-sm1"),
+        (b"nakamoto", 0, b"", b"selfish_mining", b"eyal-sirer-2014"),
+        (b"ethereum-byzantium", 0, b"", b"selfish_mining", b"fn19"),
+        (b"ethereum-whitepaper", 0, b"", b"selfish_mining", b"fn19pkel"),
+        (b"bk", 4, b"constant", b"selfish_mining", b"get-ahead"),
+        (b"bk", 8, b"block", b"clique", b"none"),
+        (b"tailstorm", 4, b"discount", b"two_agents", b"none"),
+        (b"stree", 4, b"discount", b"clique", b"none"),
+        (b"sdag", 4, b"constant", b"two_agents", b"none"),
+        (b"spar", 4, b"constant", b"clique", b"none"),
+    ]
+    for proto, k, sch, topo, pol in cases:
+        h = L.cpr_oracle_create(proto, k, sch, topo, 7, 0.35, 0.5, 2,
+                                1.0, 1e-9, pol, 3)
+        assert h, (proto, topo, pol)
+        L.cpr_oracle_run(h, 20_000)
+        print("oracle", proto.decode(), topo.decode(), pol.decode(),
+              round(L.cpr_oracle_metric(h, 0, 0), 1), flush=True)
+        L.cpr_oracle_destroy(h)
+    print("oracle: clean", flush=True)
+
+
+if __name__ == "__main__":
+    drive_compiler()
+    drive_oracle()
+    print("ASAN drive: all clean")
