@@ -97,6 +97,13 @@ class SingleAgent(Model):
         if truncate_common_chain and loop_honest:
             raise ValueError(
                 "choose either truncate_common_chain or loop_honest")
+        # NOTE: loop_honest closes the state space only when honest play
+        # reaches the snap condition (clean linear history, fresh tip) —
+        # true for bitcoin, NOT for uncle-/vote-bearing protocols
+        # (ethereum/byzantium/parallel/ghostdag), where the BFS is then
+        # unbounded below the dag_size_cutoff growth guard.  Use
+        # truncate_common_chain for those (generic_v1/model.py:1028-71
+        # has the same reach).
         if reward_common_chain and not truncate_common_chain:
             raise ValueError(
                 "reward_common_chain requires truncate_common_chain")

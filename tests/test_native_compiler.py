@@ -112,3 +112,48 @@ def test_native_rejects_invalid_flag_combinations():
         compile_native("bitcoin", k=0, alpha=0.3, gamma=0.5,
                        dag_size_cutoff=5, reward_common_chain=True,
                        truncate_common_chain=False)
+
+
+@pytest.mark.slow
+def test_native_parity_randomized_combinations():
+    """Fuzz-lite: random (protocol, alpha, gamma, flags) combinations at
+    small cutoffs must match the Python anchor exactly — broad coverage
+    of flag interactions the curated variants miss."""
+    import random
+
+    rng = random.Random(7)
+    protos = [("bitcoin", {}, 0), ("ghostdag", {"k": 2}, 2),
+              ("parallel", {"k": 2}, 2), ("ethereum", {"h": 2}, 2),
+              ("byzantium", {"h": 2}, 2)]
+    for trial in range(12):
+        proto, kw, k = rng.choice(protos)
+        alpha = rng.choice((0.2, 0.33, 0.45))
+        gamma = rng.choice((0.0, 0.5, 1.0))
+        flags = dict(
+            collect_garbage=rng.choice(("simple", "judge")),
+            merge_isomorphic=rng.random() < 0.7,
+            force_consider_own=rng.random() < 0.3,
+            # cutoff 4 keeps the PYTHON anchor fast (judge-GC walks the
+            # full delivery per state); scale parity is covered by the
+            # curated cutoff-5/6 tests
+            dag_size_cutoff=4,
+        )
+        if rng.random() < 0.3 and proto == "bitcoin":
+            # loop_honest closes the state space only for linear-chain
+            # protocols (see SingleAgent docstring); elsewhere the BFS
+            # is unbounded and both compilers would grind forever
+            flags.update(truncate_common_chain=False, loop_honest=True)
+        elif rng.random() < 0.3:
+            flags.update(reward_common_chain=True)
+        py = Compiler(SingleAgent(get_protocol(proto, **kw), alpha=alpha,
+                                  gamma=gamma, **flags)).mdp()
+        nat = compile_native(proto, k=k, alpha=alpha, gamma=gamma, **flags)
+        assert (nat.n_states, nat.n_transitions) == \
+            (py.n_states, py.n_transitions), (trial, proto, flags)
+        # transition-content equality without per-shape VI compiles:
+        # sorted COO rows must match exactly
+        def rows(m):
+            import numpy as np
+            cols = m.arrays()
+            return sorted(zip(*(np.asarray(c).tolist() for c in cols)))
+        assert rows(py) == rows(nat), (trial, proto, flags)
