@@ -1,0 +1,85 @@
+"""Cross-engine anchoring demo: the same attack, two engines.
+
+Runs a withholding policy through BOTH the jittable JAX environment
+(collapsed 2-party model, the TPU hot path) and the C++ multi-node
+discrete-event oracle (cpr_tpu.native), and prints the revenue from
+each side plus the closed form where one exists.  This is the
+validation pattern the test suite applies across protocols
+(tests/test_oracle_equivalence.py).
+
+Usage: python examples/cross_engine_anchor.py [nakamoto|ethereum|bk]
+"""
+
+import _bootstrap  # noqa: F401  (repo-root path + backend pick)
+
+import sys
+
+import numpy as np
+
+
+def jax_share(env, policy, alpha, gamma, n_envs=512, steps=256):
+    import jax
+
+    from cpr_tpu.params import make_params
+
+    params = make_params(alpha=alpha, gamma=gamma, max_steps=steps)
+    keys = jax.random.split(jax.random.PRNGKey(0), n_envs)
+    f = jax.jit(jax.vmap(lambda k: env.episode_stats(
+        k, params, env.policies[policy], steps + 8)))
+    st = jax.block_until_ready(f(keys))
+    a = np.asarray(st["episode_reward_attacker"]).mean()
+    d = np.asarray(st["episode_reward_defender"]).mean()
+    return a / (a + d)
+
+
+def oracle_share(proto, policy, alpha, gamma, **kw):
+    from cpr_tpu.native import OracleSim
+
+    s = OracleSim(proto, topology="selfish_mining", alpha=alpha,
+                  gamma=gamma, attacker_policy=policy,
+                  propagation_delay=1e-9, seed=0, **kw)
+    s.run(60_000)
+    rw = s.rewards(8)
+    return rw[0] / sum(rw)
+
+
+def main():
+    which = sys.argv[1] if len(sys.argv) > 1 else "nakamoto"
+    alpha, gamma = 0.35, 0.5
+    if which == "nakamoto":
+        from cpr_tpu.envs.nakamoto import NakamotoSSZ
+
+        policy = "sapirshtein-2016-sm1"
+        o = oracle_share("nakamoto", policy, alpha, gamma)
+        j = jax_share(NakamotoSSZ(), policy, alpha, gamma)
+        es = (alpha * (1 - alpha) ** 2 * (4 * alpha + gamma * (1 - 2 * alpha))
+              - alpha**3) / (1 - alpha * (1 + (2 - alpha) * alpha))
+        print(f"nakamoto {policy} @ a={alpha} g={gamma}:")
+        print(f"  ES'14 closed form  {es:.4f}")
+    elif which == "ethereum":
+        from cpr_tpu.envs.ethereum import EthereumSSZ
+
+        policy = "fn19"
+        o = oracle_share("ethereum-byzantium", policy, alpha, gamma)
+        j = jax_share(EthereumSSZ("byzantium", max_steps_hint=256),
+                      policy, alpha, gamma, n_envs=256)
+        print(f"ethereum-byzantium {policy} @ a={alpha} g={gamma}:")
+    elif which == "bk":
+        from cpr_tpu.envs.bk import BkSSZ
+
+        policy = "honest"
+        o = oracle_share("bk", policy, alpha, gamma, k=4, scheme="constant")
+        j = jax_share(BkSSZ(k=4, incentive_scheme="constant",
+                            max_steps_hint=256), policy, alpha, gamma,
+                      n_envs=256)
+        print(f"bk-4-constant {policy} @ a={alpha} g={gamma}:")
+    else:
+        sys.exit(f"unknown protocol {which!r} "
+                 "(choose nakamoto, ethereum, or bk)")
+    print(f"  C++ oracle engine  {o:.4f}")
+    print(f"  JAX environment    {j:.4f}")
+    print(f"  |difference|       {abs(o - j):.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -122,6 +122,11 @@ def test_native_parity_randomized_combinations():
     import random
 
     rng = random.Random(7)
+
+    def rows(m):
+        cols = m.arrays()
+        return sorted(zip(*(np.asarray(c).tolist() for c in cols)))
+
     protos = [("bitcoin", {}, 0), ("ghostdag", {"k": 2}, 2),
               ("parallel", {"k": 2}, 2), ("ethereum", {"h": 2}, 2),
               ("byzantium", {"h": 2}, 2)]
@@ -151,9 +156,6 @@ def test_native_parity_randomized_combinations():
         assert (nat.n_states, nat.n_transitions) == \
             (py.n_states, py.n_transitions), (trial, proto, flags)
         # transition-content equality without per-shape VI compiles:
-        # sorted COO rows must match exactly
-        def rows(m):
-            import numpy as np
-            cols = m.arrays()
-            return sorted(zip(*(np.asarray(c).tolist() for c in cols)))
+        # sorted COO rows and the start distribution must match exactly
         assert rows(py) == rows(nat), (trial, proto, flags)
+        assert py.start == nat.start, (trial, proto, flags)
