@@ -42,7 +42,10 @@ if os.environ.get("CPR_JAX_CACHE"):
 # the suite far past a CI budget.  Default runs execute the fast tier
 # (every module still has smoke/contract coverage via
 # test_protocol_smoke.py); the slow tier runs with --runslow or
-# CPR_RUN_SLOW=1.
+# CPR_RUN_SLOW=1.  Run the FULL slow tier as two pytest processes
+# (`make test-slow`): one process compiling the whole tier's worth of
+# kernels segfaults XLA:CPU's JIT deterministically ~200 compilations
+# in (backend_compile_and_load, any optimization level).
 
 
 def pytest_addoption(parser):
