@@ -61,7 +61,10 @@ def get(key: str, **kwargs):
     Identical (key, kwargs) return the SAME env object: envs are
     immutable config holders, and jit caches key on the env instance
     (rollout/step have static self), so sharing instances shares
-    compiled kernels across callers — e.g. across tests in one process."""
+    compiled kernels across callers — e.g. across tests in one process.
+    Do NOT mutate a returned env (set attributes, wrap in place): every
+    other caller of the same key sees the change.  Wrap it in a new
+    object instead, or call `clear_memo()` first to detach."""
     _ensure_builtin()
     try:
         memo_key = (key, tuple(sorted(kwargs.items())))
@@ -83,6 +86,14 @@ def get(key: str, **kwargs):
     if memo_key is not None:
         _ENV_MEMO[memo_key] = env
     return env
+
+
+def clear_memo():
+    """Drop all memoized env instances — subsequent get() calls build
+    fresh objects (at the cost of re-jitting their kernels).  Use before
+    intentionally mutating an env, or to bound the memo's footprint in
+    a long-lived process."""
+    _ENV_MEMO.clear()
 
 
 def keys():

@@ -12,11 +12,14 @@ engine (cpr_tpu.native), which plays the role of the reference's
 compiled simulator.
 """
 
-from cpr_tpu.experiments.sweep import write_tsv
+from cpr_tpu.experiments.sweep import run_task, write_tsv
 from cpr_tpu.experiments.honest_net import honest_net_rows
 from cpr_tpu.experiments.withholding import withholding_rows
 from cpr_tpu.experiments.break_even import break_even
 from cpr_tpu.experiments.measure_rtdp import measure_rtdp_rows
+from cpr_tpu.experiments.analysis import (efficiency_pivot, expand_rows,
+                                          gini)
 
-__all__ = ["write_tsv", "honest_net_rows", "withholding_rows",
-           "break_even", "measure_rtdp_rows"]
+__all__ = ["write_tsv", "run_task", "honest_net_rows", "withholding_rows",
+           "break_even", "measure_rtdp_rows", "expand_rows",
+           "efficiency_pivot", "gini"]

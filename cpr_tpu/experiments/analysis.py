@@ -1,0 +1,106 @@
+"""Post-processing for honest-net sweep output.
+
+Reference counterpart: experiments/simulate/honest_net.py:1-77 — the
+pandas consumption layer over the TSV: per-row expansion of the
+"|"-joined per-node arrays into gini coefficients, weakest/strongest
+node shares, per-node efficiency (reward share / activation share), and
+the two gini deltas, followed by a (block_interval x protocol) pivot.
+Here `expand_rows` works on the dict rows `honest_net_rows` produces
+directly (no file round-trip needed) and `efficiency_pivot` reproduces
+the pivot as a nested dict so callers don't need pandas; `to_dataframe`
+hands the expanded rows to pandas for anyone who wants the notebook
+workflow.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def gini(x) -> float:
+    """Gini coefficient via relative mean absolute difference (the
+    reference uses the same O(n^2) formula, honest_net.py:12-25)."""
+    x = np.asarray(x, dtype=float)
+    mu = x.mean()
+    if mu == 0.0:
+        return 0.0
+    mad = np.abs(np.subtract.outer(x, x)).mean()
+    return 0.5 * mad / mu
+
+def parse_array(s) -> np.ndarray:
+    """Decode a "|"-joined per-node array cell (honest_net.py:28-32)."""
+    if s is None or s == "":
+        return np.array([float("nan")])
+    if isinstance(s, str):
+        return np.fromstring(s, dtype=float, sep="|")
+    return np.asarray(s, dtype=float)
+
+
+def expand_row(row: dict) -> dict:
+    """honest_net.py:35-57's `expand`: weakest/strongest/gini stats for
+    compute, activations, reward, and efficiency, plus gini deltas.
+    Error rows (per-task capture) pass through unexpanded."""
+    if row.get("error"):
+        return {}
+    compute = parse_array(row["compute"])
+    weakest = int(np.argmin(compute))
+    strongest = int(np.argmax(compute))
+    d: dict = {}
+
+    def wsg(k, v):
+        d[k + "_weakest"] = float(v[weakest])
+        d[k + "_strongest"] = float(v[strongest])
+        d[k + "_gini"] = float(gini(v))
+
+    def normalized(v):
+        """Share vector, or None when the total is zero (e.g. a run too
+        short to form any block earns zero reward) — a silent 0/0 would
+        spread NaN cells through the TSV and the pivot."""
+        s = v.sum()
+        return v / s if s > 0 else None
+
+    rcompute = normalized(compute)
+    if rcompute is None:
+        return {"error": "expand: zero total compute"}
+    wsg("compute", rcompute)
+    ractivations = normalized(parse_array(row["node_activations"]))
+    if ractivations is None:
+        return {"error": "expand: zero total activations"}
+    wsg("activations", ractivations)
+    rreward = normalized(parse_array(row["reward"]))
+    if rreward is None:
+        return {"error": "expand: zero total reward"}
+    wsg("reward", rreward)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        wsg("efficiency", rreward / ractivations)
+    d["activations_compute_gini_delta"] = \
+        d["activations_gini"] - d["compute_gini"]
+    d["reward_activations_gini_delta"] = \
+        d["reward_gini"] - d["activations_gini"]
+    return d
+
+
+def expand_rows(rows: list[dict]) -> list[dict]:
+    """Join each row with its expansion (honest_net.py:60)."""
+    return [{**r, **expand_row(r)} for r in rows]
+
+
+def efficiency_pivot(rows: list[dict], value: str = "efficiency_weakest",
+                     index: str = "activation_delay") -> dict:
+    """The reference's closing pivot (honest_net.py:62-69):
+    {(protocol, k, scheme): {activation_delay: value}}."""
+    out: dict = {}
+    for r in rows:
+        if r.get("error") or value not in r:
+            continue
+        col = (r["protocol"], r.get("k", 1),
+               r.get("incentive_scheme", "constant"))
+        out.setdefault(col, {})[r[index]] = r[value]
+    return out
+
+
+def to_dataframe(rows: list[dict]):
+    """Expanded rows as a pandas DataFrame (notebook workflow)."""
+    import pandas as pd
+
+    return pd.DataFrame(expand_rows(rows))

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import time
 
+from cpr_tpu.experiments.sweep import run_task
 from cpr_tpu.native import OracleSim
 
 DEFAULT_PROTOCOLS = (
@@ -31,19 +32,19 @@ def honest_net_rows(protocols=DEFAULT_PROTOCOLS,
                     *, n_nodes: int = 10, n_activations: int = 10_000,
                     propagation_delay: float = 1.0, seed: int = 0):
     """One row per (protocol, activation_delay) honest clique run."""
-    rows = []
-    for proto, kw in protocols:
-        for ad in activation_delays:
-            t0 = time.time()
-            s = OracleSim(proto, topology="clique", n_nodes=n_nodes,
-                          activation_delay=ad,
-                          propagation_delay=propagation_delay,
-                          seed=seed, **kw)
+    def one(proto, kw, ad):
+        t0 = time.time()
+        s = OracleSim(proto, topology="clique", n_nodes=n_nodes,
+                      activation_delay=ad,
+                      propagation_delay=propagation_delay,
+                      seed=seed, **kw)
+        try:
             s.run(n_activations)
             rewards = s.rewards(n_nodes)
+            activations = s.activations(n_nodes)
             n_blocks = s.metric("n_blocks")
             on_chain = s.metric("on_chain")
-            rows.append({
+            return {
                 "network": f"honest_clique_{n_nodes}",
                 "protocol": proto,
                 "k": kw.get("k", 1),
@@ -59,7 +60,24 @@ def honest_net_rows(protocols=DEFAULT_PROTOCOLS,
                 "reward_total": sum(rewards),
                 "reward_min": min(rewards),
                 "reward_max": max(rewards),
+                # per-node arrays, "|"-joined like the reference TSV
+                # (csv_runner.ml:43-48,77-78); honest cliques weight
+                # compute uniformly (models.ml honest_clique)
+                "compute": "|".join("1" for _ in range(n_nodes)),
+                "node_activations": "|".join(str(a) for a in activations),
+                "reward": "|".join(f"{r:.6g}" for r in rewards),
                 "machine_duration_s": time.time() - t0,
-            })
+            }
+        finally:
             s.close()
+
+    rows = []
+    for proto, kw in protocols:
+        for ad in activation_delays:
+            rows.extend(run_task(
+                lambda p=proto, k=kw, a=ad: one(p, k, a),
+                {"network": f"honest_clique_{n_nodes}", "protocol": proto,
+                 "k": kw.get("k", 1),
+                 "incentive_scheme": kw.get("scheme", "constant"),
+                 "activation_delay": ad}))
     return rows

@@ -9,7 +9,8 @@ header and prints row-major TSV, empty cells for missing keys.
 from __future__ import annotations
 
 import io
-from typing import Iterable
+import time
+from typing import Callable, Iterable
 
 
 def _fmt(v) -> str:
@@ -40,3 +41,25 @@ def write_tsv(rows: Iterable[dict], path: str | None = None) -> str:
         with open(path, "w") as f:
             f.write(text)
     return text
+
+
+def run_task(task: Callable[[], list[dict] | dict], ident: dict) -> list[dict]:
+    """Run one sweep task, capturing failures as rows instead of raising.
+
+    The reference's task farm records a failing simulation's error in its
+    TSV row and carries on with the rest of the sweep
+    (experiments/simulate/csv_runner.ml:83-102); one bad grid point must
+    not kill a 19-config run.  `ident` carries the identifying columns
+    (protocol, alpha, ...) for the error row; successful tasks return
+    their row(s) untouched.
+    """
+    t0 = time.time()
+    try:
+        out = task()
+        return out if isinstance(out, list) else [out]
+    except KeyboardInterrupt:
+        raise
+    except Exception as e:  # noqa: BLE001 — sweep must degrade per-task
+        return [{**ident,
+                 "error": f"{type(e).__name__}: {e}",
+                 "machine_duration_s": time.time() - t0}]

@@ -18,6 +18,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from cpr_tpu.envs.registry import get_sized
+from cpr_tpu.experiments.sweep import run_task
 from cpr_tpu.params import stack_params
 
 DEFAULT_ALPHAS = (0.1, 0.2, 0.25, 0.33, 0.4, 0.45, 0.5)
@@ -43,8 +44,7 @@ def withholding_rows(protocol_key: str, policies=None, *,
     keys = jax.random.split(
         jax.random.PRNGKey(seed), (len(grid), reps))
 
-    rows = []
-    for pol in policies:
+    def one(pol):
         t0 = time.time()
         fn = jax.jit(jax.vmap(jax.vmap(
             lambda k, p: env.episode_stats(
@@ -55,9 +55,10 @@ def withholding_rows(protocol_key: str, policies=None, *,
         atk = np.asarray(stats["episode_reward_attacker"]).mean(axis=1)
         dfn = np.asarray(stats["episode_reward_defender"]).mean(axis=1)
         prg = np.asarray(stats["episode_progress"]).mean(axis=1)
+        out = []
         for i, (a, g) in enumerate(grid):
             total = atk[i] + dfn[i]
-            rows.append({
+            out.append({
                 "protocol": protocol_key,
                 "attack": f"{protocol_key}-{pol}",
                 "alpha": a,
@@ -71,4 +72,11 @@ def withholding_rows(protocol_key: str, policies=None, *,
                     float(atk[i] / prg[i]) if prg[i] else 0.0,
                 "machine_duration_s": dt / len(grid),
             })
+        return out
+
+    rows = []
+    for pol in policies:
+        rows.extend(run_task(
+            lambda p=pol: one(p),
+            {"protocol": protocol_key, "attack": f"{protocol_key}-{pol}"}))
     return rows

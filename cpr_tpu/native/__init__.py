@@ -22,15 +22,28 @@ _LIB = None
 
 
 def build_lib(src: str, so: str, opt: str = "-O2") -> None:
-    """g++-compile `src` into shared library `so` (skipped when fresh)."""
+    """g++-compile `src` into shared library `so` (skipped when fresh).
+
+    Freshness requires BOTH a newer-than-source .so and an identical
+    compile command recorded in the sidecar stamp (`so`.cmd) — an mtime
+    check alone would serve an -O2 artifact for an -O3 request."""
+    cmd = ["g++", opt, "-std=c++17", "-shared", "-fPIC", src, "-o", so]
+    stamp = so + ".cmd"
+    cmd_line = " ".join(cmd)
     if (os.path.exists(so)
             and os.path.getmtime(so) >= os.path.getmtime(src)):
-        return
-    cmd = ["g++", opt, "-std=c++17", "-shared", "-fPIC", src, "-o", so]
+        try:
+            with open(stamp) as f:
+                if f.read() == cmd_line:
+                    return
+        except OSError:
+            pass  # no/unreadable stamp: rebuild
     r = subprocess.run(cmd, capture_output=True, text=True)
     if r.returncode != 0:
         raise RuntimeError(
-            f"native build failed ({' '.join(cmd)}):\n{r.stderr}")
+            f"native build failed ({cmd_line}):\n{r.stderr}")
+    with open(stamp, "w") as f:
+        f.write(cmd_line)
 
 
 _LOADED: dict = {}
@@ -57,9 +70,7 @@ def lib() -> ctypes.CDLL:
     with _LOCK:
         if _LIB is not None:
             return _LIB
-        if (not os.path.exists(_SO)
-                or os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
-            _build()
+        _build()  # build_lib early-returns when fresh (mtime + stamp)
         L = ctypes.CDLL(_SO)
         L.cpr_oracle_create.restype = ctypes.c_void_p
         L.cpr_oracle_create.argtypes = [
@@ -82,7 +93,7 @@ def lib() -> ctypes.CDLL:
 
 _METRICS = {"reward_of": 0, "progress": 1, "sim_time": 2, "n_blocks": 3,
             "head_height": 4, "on_chain": 5, "head_time": 6,
-            "pref_height": 7, "trace_truncated": 8}
+            "pref_height": 7, "trace_truncated": 8, "activations_of": 9}
 
 
 class OracleSim:
@@ -131,6 +142,10 @@ class OracleSim:
 
     def rewards(self, n: int) -> list[float]:
         return [self.metric("reward_of", i) for i in range(n)]
+
+    def activations(self, n: int) -> list[int]:
+        """Per-node PoW success counts (csv_runner.ml:77's array)."""
+        return [int(self.metric("activations_of", i)) for i in range(n)]
 
     def close(self):
         if self._h:

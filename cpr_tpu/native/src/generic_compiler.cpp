@@ -1140,7 +1140,10 @@ struct Result {
     std::string error;
 };
 
-static std::string g_last_error;
+// thread_local: ctypes releases the GIL during gmc_compile, so two
+// Python threads can compile concurrently; a shared global would let
+// one thread's failure message clobber the other's nullptr-path report
+static thread_local std::string g_last_error;
 
 static Result* compile_impl(const std::string& proto_name, int k,
                             double alpha, double gamma, int dag_cutoff,
@@ -1156,8 +1159,12 @@ static Result* compile_impl(const std::string& proto_name, int k,
         return nullptr;
     }
     if (dag_cutoff > MAXN - 4) {
-        g_last_error = "dag_size_cutoff too large for the native compiler "
-                       "(max " + std::to_string(MAXN - 4) + ")";
+        g_last_error = "dag_size_cutoff too large for the native compiler: "
+                       "max " + std::to_string(MAXN - 4) + " (DAGs are u" +
+                       std::to_string(8 * sizeof(u32)) + " bitmasks capped "
+                       "at MAXN=" + std::to_string(MAXN) + " blocks, with 4 "
+                       "blocks of BFS head-room); use the Python compiler "
+                       "for larger cutoffs";
         return nullptr;
     }
     // the Python anchor's constructor-time flag validation (model.py:97-102)

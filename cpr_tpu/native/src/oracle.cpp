@@ -1516,6 +1516,15 @@ double cpr_oracle_metric(void* hp, int what, int arg) {
     }
     case 8:  // causal trace hit its cap; exported traces are incomplete
       return s.trace_truncated ? 1.0 : 0.0;
+    case 9: {  // activations_of(arg): PoW successes won by node `arg`
+      // (csv_runner.ml:77 exports sim.activations per node; every
+      // activation mints exactly one pow block, so counting mined pow
+      // blocks reproduces that array without extra sim state)
+      long n = 0;
+      for (const auto& b : s.dag.blocks)
+        if (b.miner == arg && b.pow_hash < 2.0) n++;
+      return (double)n;
+    }
     default:
       return std::nan("");
   }

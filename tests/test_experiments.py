@@ -58,6 +58,64 @@ def test_withholding_sweep_grid():
         honest[(0.4, 0.5)]["relative_reward"]
 
 
+def test_honest_net_sweep_captures_task_errors():
+    """csv_runner.ml:83-102 analog: one bad config yields an error row,
+    the rest of the sweep still completes."""
+    rows = honest_net_rows(
+        protocols=(("nakamoto", {}), ("no-such-protocol", {})),
+        activation_delays=(60.0,), n_activations=500)
+    assert len(rows) == 2
+    ok = [r for r in rows if "error" not in r]
+    bad = [r for r in rows if "error" in r]
+    assert len(ok) == 1 and ok[0]["protocol"] == "nakamoto"
+    assert len(bad) == 1 and bad[0]["protocol"] == "no-such-protocol"
+    assert bad[0]["error"]  # non-empty "Type: message" string
+    text = write_tsv(rows)
+    assert "error" in text.split("\n")[0].split("\t")
+
+
+def test_honest_net_analysis_expand_and_pivot():
+    """honest_net.py:35-69 analog: per-node arrays expand to gini /
+    weakest-strongest / efficiency columns; pivot keys by protocol."""
+    from cpr_tpu.experiments import efficiency_pivot, expand_rows, gini
+
+    assert gini([1, 1, 1, 1]) == 0.0
+    assert gini([0, 0, 0, 4]) == pytest.approx(0.75)
+
+    rows = honest_net_rows(
+        protocols=(("nakamoto", {}), ("bad-proto", {})),
+        activation_delays=(60.0, 600.0), n_nodes=5, n_activations=2_000)
+    ex = expand_rows(rows)
+    good = [r for r in ex if not r.get("error")]
+    assert len(good) == 2
+    for r in good:
+        # uniform clique compute: compute gini 0, everyone ~1/5 of work
+        assert r["compute_gini"] == 0.0
+        assert abs(r["activations_weakest"] - 0.2) < 0.05
+        # activations sum to the sim's total
+        acts = [int(x) for x in r["node_activations"].split("|")]
+        assert sum(acts) == r["activations"]
+        # honest play: efficiency near 1, small reward gini
+        assert abs(r["efficiency_weakest"] - 1.0) < 0.25
+        assert r["reward_gini"] < 0.15
+    piv = efficiency_pivot(ex)
+    assert ("nakamoto", 1, "constant") in piv
+    assert set(piv[("nakamoto", 1, "constant")]) == {60.0, 600.0}
+    # error rows pass through expand unexpanded and stay out of the pivot
+    assert not any(k[0] == "bad-proto" for k in piv)
+    write_tsv(ex)
+
+
+def test_withholding_sweep_captures_task_errors():
+    rows = withholding_rows(
+        "nakamoto", policies=["honest", "no-such-policy"],
+        alphas=(0.3,), gammas=(0.5,), episode_len=64, reps=8)
+    bad = [r for r in rows if "error" in r]
+    ok = [r for r in rows if "error" not in r]
+    assert len(bad) == 1 and bad[0]["attack"] == "nakamoto-no-such-policy"
+    assert len(ok) == 1 and "relative_reward" in ok[0]
+
+
 def test_break_even_sm1():
     """SM1 with gamma=0.5 breaks even in the literature around
     alpha~0.25; the search must land in a sane band."""

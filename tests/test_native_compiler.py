@@ -51,7 +51,11 @@ def test_native_flag_variants_match_python():
     for flags in (dict(loop_honest=True, truncate_common_chain=False),
                   dict(collect_garbage="judge"),
                   dict(force_consider_own=True),
-                  dict(reward_common_chain=True)):
+                  dict(reward_common_chain=True),
+                  # height cutoff alone does not bound the space (honest
+                  # play keeps mining); pair it with the dag cutoff so
+                  # the height trigger binds first
+                  dict(traditional_height_cutoff=3)):
         base = dict(alpha=0.3, gamma=0.5, collect_garbage="simple",
                     merge_isomorphic=True, truncate_common_chain=True,
                     dag_size_cutoff=5)
@@ -72,9 +76,18 @@ def test_native_rejects_unknown_protocol():
 def test_native_rejects_unbounded_or_oversized():
     with pytest.raises(RuntimeError, match="unbounded"):
         compile_native("bitcoin", k=0, alpha=0.3, gamma=0.5)
-    with pytest.raises(RuntimeError, match="too large"):
+    # the MAXN=20 bitmask capacity (generic_compiler.cpp:41) must
+    # surface as a clear Python-level error naming the bound
+    with pytest.raises(RuntimeError,
+                       match=r"max 16.*MAXN=20.*Python compiler"):
         compile_native("bitcoin", k=0, alpha=0.3, gamma=0.5,
                        dag_size_cutoff=30)
+    # cutoff 16 (the max) passes validation — full enumeration at 16 is
+    # too big for the test budget, so cap states and expect the cap
+    # error, not the capacity error
+    with pytest.raises(RuntimeError, match="state cap"):
+        compile_native("bitcoin", k=0, alpha=0.3, gamma=0.5,
+                       dag_size_cutoff=16, max_states=2_000)
 
 
 def test_native_state_cap():
