@@ -63,8 +63,10 @@ def get(key: str, **kwargs):
     (rollout/step have static self), so sharing instances shares
     compiled kernels across callers — e.g. across tests in one process.
     Do NOT mutate a returned env (set attributes, wrap in place): every
-    other caller of the same key sees the change.  Wrap it in a new
-    object instead, or call `clear_memo()` first to detach."""
+    other caller of the same key sees the change — including callers
+    that fetched the instance BEFORE any `clear_memo()`.  To customize
+    an env, construct it directly from its class (or wrap it in a new
+    object); clear_memo() only stops FUTURE get() calls from sharing."""
     _ensure_builtin()
     try:
         memo_key = (key, tuple(sorted(kwargs.items())))

@@ -71,8 +71,13 @@ def expand_row(row: dict) -> dict:
     if rreward is None:
         return {"error": "expand: zero total reward"}
     wsg("reward", rreward)
-    with np.errstate(divide="ignore", invalid="ignore"):
+    # per-node zero activations make efficiency = reward/0 undefined for
+    # that node (short runs); keep the other stats and note the omission
+    # rather than spreading inf/nan through the efficiency columns
+    if (ractivations > 0).all():
         wsg("efficiency", rreward / ractivations)
+    else:
+        d["expand_note"] = "efficiency undefined: node with 0 activations"
     d["activations_compute_gini_delta"] = \
         d["activations_gini"] - d["compute_gini"]
     d["reward_activations_gini_delta"] = \

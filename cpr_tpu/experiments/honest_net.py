@@ -56,7 +56,14 @@ def honest_net_rows(protocols=DEFAULT_PROTOCOLS,
                 "head_progress": s.metric("progress"),
                 "n_blocks": n_blocks,
                 "on_chain": on_chain,
-                "orphan_rate": 1.0 - on_chain / max(n_blocks, 1.0),
+                # the reference battery's definition
+                # (cpr_protocols.ml:504-509): PoW not reflected in head
+                # progress, over PoW spent.  1 - on_chain/n_blocks would
+                # count non-PoW appends (tailstorm summaries, bk
+                # proposals) as orphanable and overstate the rate ~40x
+                # for the parallel family.
+                "orphan_rate":
+                    max(0.0, 1.0 - s.metric("progress") / n_activations),
                 "reward_total": sum(rewards),
                 "reward_min": min(rewards),
                 "reward_max": max(rewards),

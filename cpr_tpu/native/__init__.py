@@ -93,7 +93,8 @@ def lib() -> ctypes.CDLL:
 
 _METRICS = {"reward_of": 0, "progress": 1, "sim_time": 2, "n_blocks": 3,
             "head_height": 4, "on_chain": 5, "head_time": 6,
-            "pref_height": 7, "trace_truncated": 8, "activations_of": 9}
+            "pref_height": 7, "trace_truncated": 8, "activations_of": 9,
+            "stuck_count": 10, "stuck_first": 11}
 
 
 class OracleSim:
@@ -109,7 +110,12 @@ class OracleSim:
       ethereum-* — none, honest, fn19, fn19pkel (uncle-bearing
       withholding with per-step uncle-mining rules);
       bk — none, honest, get-ahead (vote withholding with private
-      quorum proposals).
+      quorum proposals);
+      spar — none, honest, selfish;
+      stree/sdag — none, honest, minor-delay, avoid-loss;
+      tailstorm — none, honest, minor-delay, get-ahead, avoid-loss
+      (ParAgent: shared SSZ release scan over withheld descendants,
+      cpr_protocols.ml:478-657's policy battery counterpart).
     """
 
     def __init__(self, protocol: str = "nakamoto", *, k: int = 0,

@@ -108,13 +108,28 @@ struct Sim;
 struct Agent {
   int policy = 0;
   int priv = 0, pub = 0;
+  // released by us but possibly still in flight; vote-family agents
+  // count these as public in their defender models (`pending` messages
+  // in the SSZ spaces are visible-on-release, ssz_tools.ml visibility)
+  std::vector<char> sent;
   virtual ~Agent() {}
   void init(int g) { priv = pub = g; }
   virtual std::vector<int> handle(Sim& s, int b, bool is_pow) = 0;
+  void mark_sent(int b, size_t dag_size) {
+    if ((int)sent.size() <= b) sent.resize(dag_size, 0);
+    sent[b] = 1;
+  }
+  // released-or-delivered from the defenders' point of view (defined
+  // after Sim, which is incomplete here)
+  bool is_public(Sim& s, int b) const;
   // called for EVERY block the release machinery actually sends —
   // including withheld ancestors shared implicitly — so agents that
   // track in-flight releases see the full set
   virtual void note_sent(Sim& s, int b) { (void)s; (void)b; }
+  // in-flight releases: the release machinery treats these as public
+  // already, so a block is not re-sent on every event between its
+  // release and its (delayed) delivery
+  virtual bool sent_already(int b) const { (void)b; return false; }
   // chain-parent common ancestor (heights along parents[0] are
   // sequential, so height-stepping both sides converges)
   template <typename D>
@@ -161,6 +176,10 @@ struct Sim {
   // attacker uncle-mining rule (set per step by EthAgent; the ethereum
   // draft for node 0 filters uncle candidates through it)
   bool atk_mine_own = true, atk_mine_foreign = true;
+  // parallel-family Prolong mining filter (spar_ssz.ml:180-189): when
+  // set, node 0's drafts count only its own votes (`Exclusive);
+  // Proceed clears it back to the inclusive node-0 visibility
+  bool atk_vote_own_only = false;
 
   // bk proposal dedup (simulator.ml:138-158): key -> block id
   std::map<std::string, int> dedup;
@@ -252,6 +271,11 @@ struct Sim {
   }
 
   void send(int src, int b) {  // share a block on all links
+    static const bool dbg = getenv("CPR_ORACLE_DEBUG") != nullptr;
+    if (dbg)
+      fprintf(stderr, "send src=%d b=%d miner=%d vote=%d h=%d t=%.2f\n",
+              src, b, dag.blocks[b].miner, (int)dag.blocks[b].is_vote,
+              dag.blocks[b].height, now);
     record(1, src, b);
     for (int dst = 0; dst < n_nodes; dst++) {
       if (dst == src) continue;
@@ -263,6 +287,7 @@ struct Sim {
 
   // deliver b (parents-visible) to node, then its unlocked descendants
   void deliver(int node, int b);
+  void unlock_children(int node, int b);
   void handle_honest(int node, int b);
   void handle_agent(int b, bool is_pow);
 
@@ -292,6 +317,13 @@ struct Sim {
   void step_event();
   void run(long n_activations);
 };
+
+bool Agent::is_public(Sim& s, int b) const {
+  if (b < (int)sent.size() && sent[b]) return true;
+  for (int n = 1; n < s.n_nodes; n++)
+    if (s.is_visible(n, b)) return true;
+  return false;
+}
 
 // ------------------------------------------------------------- nakamoto
 
@@ -602,12 +634,19 @@ struct ParallelBase : Protocol {
     return x;
   }
 
+  // the agent's Prolong/Proceed mining filter (spar_ssz.ml:180-189)
+  // narrows node 0's draft-time vote view to its own votes
+  static bool vote_counts(Sim& s, int node, int i) {
+    return node != 0 || !s.atk_vote_own_only ||
+           s.dag.blocks[i].miner == 0;
+  }
+
   // visible votes confirming block/summary b, ascending id
   std::vector<int> confirming(Sim& s, int node, int b) const {
     std::vector<int> out;
     for (int i = b + 1; i < (int)s.dag.blocks.size(); i++) {
       if (s.dag.blocks[i].is_vote && s.dag.blocks[i].vote_id == b &&
-          s.is_visible(node, i))
+          s.is_visible(node, i) && vote_counts(s, node, i))
         out.push_back(i);
     }
     return out;
@@ -617,7 +656,7 @@ struct ParallelBase : Protocol {
     int n = 0;
     for (int i = b + 1; i < (int)s.dag.blocks.size(); i++)
       if (s.dag.blocks[i].is_vote && s.dag.blocks[i].vote_id == b &&
-          s.is_visible(node, i))
+          s.is_visible(node, i) && vote_counts(s, node, i))
         n++;
     return n;
   }
@@ -1149,21 +1188,16 @@ struct EthAgent final : Agent {
 struct BkAgent final : Agent {
   // policy: 0 honest, 1 get-ahead
   int k = 1;
-  std::vector<char> sent;  // released by us but possibly still in flight
 
-  bool is_public(Sim& s, int b) {
-    if (b < (int)sent.size() && sent[b]) return true;
-    for (int n = 1; n < s.n_nodes; n++)
-      if (s.is_visible(n, b)) return true;
-    return false;
-  }
-  void mark_sent(Sim& s, int b) {
-    if ((int)sent.size() <= b) sent.resize(s.dag.blocks.size(), 0);
-    sent[b] = 1;
-  }
   // the release machinery shares withheld ancestors implicitly (quorum
   // votes inside a released proposal); count them in-flight too
-  void note_sent(Sim& s, int b) override { mark_sent(s, b); }
+  void note_sent(Sim& s, int b) override {
+    mark_sent(b, s.dag.blocks.size());
+  }
+  // no sent_already override: this agent pre-marks its share list so
+  // pub_better() sees just-released votes as public; the prune would
+  // then cancel the send itself.  Harmless duplicate re-sends are
+  // deduped by the receivers' `known` set.
 
   int public_votes_on(Sim& s, int b) {
     int n = 0;
@@ -1238,7 +1272,7 @@ struct BkAgent final : Agent {
       for (int i = 0; i < (int)held.size() && public_already + i < tgt_v;
            i++)
         share.push_back(held[i]);
-      for (int y : share) mark_sent(s, y);
+      for (int y : share) mark_sent(y, d.blocks.size());
       if (pub_better(s, rel, pub)) pub = rel;
     }
     // one attacker proposal attempt per interaction on the (post-action)
@@ -1248,7 +1282,205 @@ struct BkAgent final : Agent {
     // filter == node-0 visibility)
     for (Block& prop : s.proto->proposals(s, 0, priv)) {
       int id = s.append_plain(0, std::move(prop));
-      if (!s.is_visible(0, id)) s.mark_visible(0, id);
+      if (!s.is_visible(0, id)) {
+        s.mark_visible(0, id);
+        s.unlock_children(0, id);
+      }
+      if (d.blocks[id].height > d.blocks[priv].height) priv = id;
+    }
+    return share;
+  }
+};
+
+// ---------------------------------- parallel-family withholding agent
+
+// One agent for the whole parallel-PoW family (spar/stree/tailstorm/
+// sdag).  Clean-room port of the shared SSZ attack-space shape: the
+// spar-specialized release targeting of spar_ssz.ml:255-295 is a
+// special case of the generic release used by the tree/DAG variants
+// (stree_ssz.ml:272-295, tailstorm_ssz.ml:292-315, sdag_ssz.ml:252-275)
+// — scan the withheld descendants of the common ancestor in append
+// order, accumulating until the simulated defender head (vote filter =
+// public ∪ released-so-far) flips to the attacker's chain: Override
+// releases just enough to flip, Match one item short of flipping, and
+// if nothing flips, release everything.  Policies mirror
+// cpr_tpu/envs/{spar,stree,sdag,tailstorm}.py's jittable policy sets.
+struct ParAgent final : Agent {
+  // policy: 0 honest, 1 selfish (spar_ssz.ml:340-351),
+  //         2 minor-delay (stree_ssz.ml:377-384 shape, shared by
+  //           stree/sdag/tailstorm), 3 get-ahead (tailstorm_ssz.ml),
+  //         4 honest-tailstorm (adopt only when strictly behind),
+  //         5 avoid-loss (confirmed-work compare + Match race)
+  int k = 2;
+
+  void note_sent(Sim& s, int b) override {
+    mark_sent(b, s.dag.blocks.size());
+  }
+  bool sent_already(int b) const override {
+    return b < (int)sent.size() && sent[b];
+  }
+
+  static int last_block(const Dag& d, int x) {
+    while (d.blocks[x].is_vote) x = d.blocks[x].vote_id;
+    return x;
+  }
+  // chain predecessor of a block; handles tailstorm summaries whose
+  // parents are quorum-leaf votes rather than the previous summary
+  static int pred(const Dag& d, int b) {
+    if (d.blocks[b].parents.empty()) return b;  // genesis
+    return last_block(d, d.blocks[b].parents[0]);
+  }
+  static int block_common_anc(const Dag& d, int a, int b) {
+    while (a != b) {
+      if (d.blocks[a].parents.empty() || d.blocks[b].parents.empty())
+        return 0;  // genesis
+      if (d.blocks[a].height >= d.blocks[b].height)
+        a = pred(d, a);
+      else
+        b = pred(d, b);
+    }
+    return a;
+  }
+  // does x's chain run through ca?
+  static bool on_chain_of(const Dag& d, int x, int ca) {
+    int b = last_block(d, x);
+    while (d.blocks[b].height > d.blocks[ca].height) b = pred(d, b);
+    return b == ca;
+  }
+
+  // votes confirming `b` that pass `filt` (public ∪ released set)
+  int filtered_votes(Sim& s, int b, const std::vector<char>& in_rel) {
+    const Dag& d = s.dag;
+    int n = 0;
+    for (int i = b + 1; i < (int)d.blocks.size(); i++)
+      if (d.blocks[i].is_vote && d.blocks[i].vote_id == b &&
+          (is_public(s, i) || (i < (int)in_rel.size() && in_rel[i])))
+        n++;
+    return n;
+  }
+  // defenders' update_head under the filter: strictly better by
+  // (height, confirming votes); the incumbent wins ties
+  bool flips(Sim& s, int cand, const std::vector<char>& in_rel) {
+    const Dag& d = s.dag;
+    if (cand == pub) return false;
+    if (d.blocks[cand].height != d.blocks[pub].height)
+      return d.blocks[cand].height > d.blocks[pub].height;
+    return filtered_votes(s, cand, in_rel) >
+           filtered_votes(s, pub, in_rel);
+  }
+
+  // generic release scan (see header comment); kind 0 Match, 1 Override
+  std::vector<int> release(Sim& s, int ca, int kind) {
+    const Dag& d = s.dag;
+    std::vector<int> rel;
+    std::vector<char> in_rel(d.blocks.size(), 0);
+    for (int x = 0; x < (int)d.blocks.size(); x++) {
+      if (d.blocks[x].miner < 0 || is_public(s, x)) continue;
+      if (!s.is_visible(0, x)) continue;  // not ours / not seen yet
+      if (!on_chain_of(d, x, ca)) continue;
+      rel.push_back(x);
+      in_rel[x] = 1;
+      int cand = last_block(d, x);
+      if (flips(s, cand, in_rel)) {
+        if (kind == 0) {  // Match: maximal non-flipping prefix
+          rel.pop_back();
+          return rel;
+        }
+        pub = cand;  // Override lands at the next prepare; model it now
+        return rel;
+      }
+    }
+    return rel;  // nothing flips: release everything (the SSZ fallback)
+  }
+
+  std::vector<int> handle(Sim& s, int b, bool is_pow) override {
+    Dag& d = s.dag;
+    if (is_pow) {
+      // prepare on ProofOfWork: work on the private chain
+      // (spar_ssz.ml:210-214) — a freshly mined block advances the
+      // private tip; a vote confirms it and leaves the tip in place
+      priv = last_block(d, b);
+    } else {
+      // prepare on Network: simulate the defenders' update_head over
+      // the public view
+      int cand = last_block(d, b);
+      std::vector<char> none;
+      if (flips(s, cand, none)) pub = cand;
+    }
+    int ca = block_common_anc(d, pub, priv);
+    int pub_b = d.blocks[pub].height - d.blocks[ca].height;
+    int priv_b = d.blocks[priv].height - d.blocks[ca].height;
+    // observation vote counts (spar_ssz.ml:226-239): public votes on
+    // the defender tip; node-0-visible (inclusive) votes on the private
+    // tip
+    std::vector<char> none;
+    int pub_v = filtered_votes(s, pub, none);
+    int priv_vi = 0;
+    for (int i = priv + 1; i < (int)d.blocks.size(); i++)
+      if (d.blocks[i].is_vote && d.blocks[i].vote_id == priv &&
+          s.is_visible(0, i))
+        priv_vi++;
+
+    enum { ADOPT, OVERRIDE, MATCH, WAIT };
+    int act;
+    bool prolong = false;
+    switch (policy) {
+      case 1:  // spar selfish (spar_ssz.ml:340-351)
+        if (priv_b < pub_b) act = ADOPT;
+        else if (priv_b == 0 && pub_b == 0) { act = WAIT; prolong = true; }
+        else if (pub_b == 0) act = WAIT;
+        else act = OVERRIDE;
+        break;
+      case 2:  // minor-delay (stree/sdag/tailstorm)
+        if (pub_b > priv_b) act = ADOPT;
+        else if (pub_b == 0) act = WAIT;
+        else act = OVERRIDE;
+        break;
+      case 3:  // tailstorm get-ahead
+        if (pub_b > priv_b) act = ADOPT;
+        else if (pub_b < priv_b) act = OVERRIDE;
+        else act = WAIT;
+        break;
+      case 4:  // tailstorm honest: adopt only when strictly behind
+        act = pub_b > priv_b ? ADOPT : OVERRIDE;
+        break;
+      case 5: {  // avoid-loss (stree/sdag/tailstorm envs): compare
+        // total confirmed work, Match the defender head on a one-block
+        // tie to arm the gamma race
+        int hp = pub_b * k + pub_v, ap = priv_b * k + priv_vi;
+        if (pub_b == 0) act = WAIT;
+        else if (pub_b == 1 && hp == ap) act = MATCH;
+        else if (hp > ap) act = ADOPT;
+        else if (hp == ap - 1) act = OVERRIDE;
+        else if (pub_b < priv_b - 10) act = OVERRIDE;
+        else act = WAIT;
+        break;
+      }
+      default:  // honest (spar/stree/sdag): adopt any public progress
+        act = pub_b > 0 ? ADOPT : OVERRIDE;
+        break;
+    }
+    s.atk_vote_own_only = prolong;
+
+    std::vector<int> share;
+    if (act == ADOPT) {
+      priv = pub;
+    } else if (act == OVERRIDE || act == MATCH) {
+      // the release machinery's note_sent marks each item as it is
+      // actually sent — don't pre-mark, or sent_already() would prune
+      // the send itself
+      share = release(s, ca, act == OVERRIDE ? 1 : 0);
+    }
+    // private summary assembly (tailstorm only: proposals are non-PoW
+    // appends the attacker keeps to itself until released; the quorum
+    // uses node-0 visibility like the env's inclusive Proceed filter)
+    s.preferred[0] = priv;
+    for (Block& prop : s.proto->proposals(s, 0, b)) {
+      int id = s.append_plain(0, std::move(prop));
+      if (!s.is_visible(0, id)) {
+        s.mark_visible(0, id);
+        s.unlock_children(0, id);
+      }
       if (d.blocks[id].height > d.blocks[priv].height) priv = id;
     }
     return share;
@@ -1267,10 +1499,17 @@ void Sim::deliver(int node, int b) {
   } else {
     handle_honest(node, b);
   }
-  // unlock buffered children (dependency-ordered delivery,
-  // simulator.ml:424-450); snapshot the child list first — recursive
-  // delivery can append proposal blocks, growing dag.blocks and the
-  // children vector under a live iterator
+  unlock_children(node, b);
+}
+
+// unlock buffered children (dependency-ordered delivery,
+// simulator.ml:424-450); snapshot the child list first — recursive
+// delivery can append proposal blocks, growing dag.blocks and the
+// children vector under a live iterator.  Called wherever a block
+// becomes visible: normal delivery AND the proposal-dedup path, where a
+// node independently assembles a block it had only buffered children of
+// (an attacker's withheld summary re-derived by a defender).
+void Sim::unlock_children(int node, int b) {
   std::vector<int> kids = dag.blocks[b].children;
   for (int c : kids) {
     if (c < (int)known[node].size() && known[node][c] &&
@@ -1287,6 +1526,7 @@ void Sim::handle_honest(int node, int b) {
       mark_visible(node, id);
       send(node, id);
       preferred[node] = proto->prefer(*this, node, preferred[node], id);
+      unlock_children(node, id);
     }
   }
 }
@@ -1306,7 +1546,7 @@ void Sim::handle_agent(int b, bool is_pow) {
       bool withheld = false;
       for (int n = 1; n < n_nodes; n++)
         if (!is_visible(n, y)) withheld = true;
-      if (!withheld) continue;
+      if (!withheld || agent->sent_already(y)) continue;
       if (std::find(rel.begin(), rel.end(), y) != rel.end()) continue;
       rel.push_back(y);
       for (int p : dag.blocks[y].parents) stack.push_back(p);
@@ -1464,9 +1704,25 @@ void* cpr_oracle_create(const char* protocol, int k, const char* scheme,
       s.agent.reset(a);
       s.agent->policy = pol == "honest" ? 0
                         : pol == "get-ahead" ? 1 : -1;
+    } else if (proto == "spar" || proto == "stree" ||
+               proto == "tailstorm" || proto == "sdag") {
+      auto* a = new ParAgent();
+      a->k = k;
+      s.agent.reset(a);
+      if (proto == "spar")
+        s.agent->policy = pol == "honest" ? 0 : pol == "selfish" ? 1 : -1;
+      else if (proto == "tailstorm")
+        s.agent->policy = pol == "honest" ? 4
+                          : pol == "minor-delay" ? 2
+                          : pol == "get-ahead" ? 3
+                          : pol == "avoid-loss" ? 5 : -1;
+      else  // stree, sdag
+        s.agent->policy = pol == "honest" ? 0
+                          : pol == "minor-delay" ? 2
+                          : pol == "avoid-loss" ? 5 : -1;
     } else {
       delete h;
-      return nullptr;  // withholding agents: nakamoto, ethereum, bk
+      return nullptr;  // no withholding agent for this protocol
     }
     if (s.agent->policy < 0) {
       delete h;
@@ -1516,6 +1772,23 @@ double cpr_oracle_metric(void* hp, int what, int arg) {
     }
     case 8:  // causal trace hit its cap; exported traces are incomplete
       return s.trace_truncated ? 1.0 : 0.0;
+    case 10: {  // diagnostics: blocks node `arg` knows but can't deliver
+      if (arg < 0 || arg >= s.n_nodes) return std::nan("");
+      long n = 0;
+      for (int b = 0; b < (int)s.dag.blocks.size(); b++)
+        if (b < (int)s.known[arg].size() && s.known[arg][b] &&
+            !s.is_visible(arg, b))
+          n++;
+      return (double)n;
+    }
+    case 11: {  // diagnostics: lowest such stuck block id (-1: none)
+      if (arg < 0 || arg >= s.n_nodes) return std::nan("");
+      for (int b = 0; b < (int)s.dag.blocks.size(); b++)
+        if (b < (int)s.known[arg].size() && s.known[arg][b] &&
+            !s.is_visible(arg, b))
+          return (double)b;
+      return -1.0;
+    }
     case 9: {  // activations_of(arg): PoW successes won by node `arg`
       // (csv_runner.ml:77 exports sim.activations per node; every
       // activation mints exactly one pow block, so counting mined pow

@@ -72,6 +72,16 @@ def drive_oracle(path="/tmp/liborc_asan.so"):
         (b"stree", 4, b"discount", b"clique", b"none"),
         (b"sdag", 4, b"constant", b"two_agents", b"none"),
         (b"spar", 4, b"constant", b"clique", b"none"),
+        # parallel-family withholding agent (ParAgent): generic release
+        # scan + dedup/unlock interplay under every policy branch
+        (b"spar", 4, b"constant", b"selfish_mining", b"selfish"),
+        (b"stree", 4, b"discount", b"selfish_mining", b"minor-delay"),
+        (b"sdag", 4, b"constant", b"selfish_mining", b"minor-delay"),
+        (b"tailstorm", 4, b"discount", b"selfish_mining", b"minor-delay"),
+        (b"tailstorm", 4, b"constant", b"selfish_mining", b"get-ahead"),
+        (b"tailstorm", 4, b"constant", b"selfish_mining", b"honest"),
+        (b"stree", 4, b"constant", b"selfish_mining", b"avoid-loss"),
+        (b"tailstorm", 4, b"discount", b"selfish_mining", b"avoid-loss"),
     ]
     for proto, k, sch, topo, pol in cases:
         h = L.cpr_oracle_create(proto, k, sch, topo, 7, 0.35, 0.5, 2,
