@@ -38,12 +38,22 @@ Documented deviations from the reference event-queue simulation:
   network.ml:85-95).
 - Measured against the C++ multi-node oracle's BkAgent
   (tests/test_oracle_equivalence.py): honest play agrees within 0.01
-  for alpha <= 1/3 (drifting to ~0.02 by alpha = 0.4);
-  `get-ahead` revenue differs by up to ~0.05-0.07 in either direction
-  (alpha 0.35-0.45, gamma 0.5, k 1-4) — vote-race and proposal-timing
-  dynamics at event granularity don't collapse cleanly into the
-  one-step-per-interaction model, so the cross-engine tests record the
-  error bar rather than asserting parity for this policy.
+  for alpha <= 1/3 (drifting to ~0.02 by alpha = 0.4).  `get-ahead`
+  carries a STRUCTURAL collapse deviation, characterized at
+  (alpha=0.45, gamma=0.5): oracle - env = +0.0445 at k=1 and -0.0325
+  at k=4.  Decomposition (2026-07, 5-seed oracle runs, 512-env
+  episodes): (a) episode truncation is NOT the cause — env revenue is
+  invariant from 128 to 512 steps (+-0.002); (b) the multi-node/delay
+  component is NOT the cause at moderate gamma — the oracle's
+  two_agents and selfish_mining topologies agree within 0.007 at
+  gamma <= 0.5 (gamma=0.9 diverges ~+0.12: delay-shuffled vote arrival
+  starts flipping defender preferences, which the collapse cannot
+  express — documented out-of-model); (c) the residual is the
+  vote-race/proposal-timing granularity itself (one attacker
+  interaction per step vs event interleaving), opposite in sign
+  between k=1 and k=4.  The cross-engine anchor pins these measured
+  gaps at +-0.02 — a characterized-deviation regression bound, not a
+  parity claim.
 """
 
 from __future__ import annotations

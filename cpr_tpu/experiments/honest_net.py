@@ -44,6 +44,7 @@ def honest_net_rows(protocols=DEFAULT_PROTOCOLS,
             activations = s.activations(n_nodes)
             n_blocks = s.metric("n_blocks")
             on_chain = s.metric("on_chain")
+            progress = s.metric("progress")
             return {
                 "network": f"honest_clique_{n_nodes}",
                 "protocol": proto,
@@ -53,7 +54,7 @@ def honest_net_rows(protocols=DEFAULT_PROTOCOLS,
                 "activations": n_activations,
                 "sim_time": s.metric("sim_time"),
                 "head_height": s.metric("head_height"),
-                "head_progress": s.metric("progress"),
+                "head_progress": progress,
                 "n_blocks": n_blocks,
                 "on_chain": on_chain,
                 # the reference battery's definition
@@ -63,7 +64,7 @@ def honest_net_rows(protocols=DEFAULT_PROTOCOLS,
                 # proposals) as orphanable and overstate the rate ~40x
                 # for the parallel family.
                 "orphan_rate":
-                    max(0.0, 1.0 - s.metric("progress") / n_activations),
+                    max(0.0, 1.0 - progress / n_activations),
                 "reward_total": sum(rewards),
                 "reward_min": min(rewards),
                 "reward_max": max(rewards),

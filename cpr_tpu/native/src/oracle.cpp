@@ -1321,8 +1321,7 @@ struct ParAgent final : Agent {
   }
 
   static int last_block(const Dag& d, int x) {
-    while (d.blocks[x].is_vote) x = d.blocks[x].vote_id;
-    return x;
+    return ParallelBase::last_block(d, x);  // shared chain-walk invariant
   }
   // chain predecessor of a block; handles tailstorm summaries whose
   // parents are quorum-leaf votes rather than the previous summary
@@ -1374,7 +1373,10 @@ struct ParAgent final : Agent {
     const Dag& d = s.dag;
     std::vector<int> rel;
     std::vector<char> in_rel(d.blocks.size(), 0);
-    for (int x = 0; x < (int)d.blocks.size(); x++) {
+    // ids are topological, so everything descending from ca was
+    // appended after it — skip the public prefix (verified: a debug
+    // audit over long runs finds no releasable id <= ca)
+    for (int x = ca + 1; x < (int)d.blocks.size(); x++) {
       if (d.blocks[x].miner < 0 || is_public(s, x)) continue;
       if (!s.is_visible(0, x)) continue;  // not ours / not seen yet
       if (!on_chain_of(d, x, ca)) continue;
