@@ -21,22 +21,21 @@ import sys
 import time
 
 
-def measure_nakamoto(n_envs: int, n_steps: int = 2200, reps: int = 3):
-    """The headline workload: SM1 selfish mining over `n_envs` vmapped
-    episode streams.  Returns (env-steps/sec, SM1 relative revenue) —
-    the one definition shared by the bench and the perf-experiment
-    tooling (tools/tpu_bench_experiments.py), so sweeps there measure
-    exactly what the bench reports."""
+def _measure_episodes(env, policy_name: str, n_envs: int, n_steps: int,
+                      reps: int, max_steps: int):
+    """Shared episode-batch harness: warm one compile, time `reps`
+    batched episode_stats kernels, return (env-steps/sec, attacker
+    relative revenue).  Every episode config below measures through
+    this one definition — also shared with the perf-experiment tooling
+    (tools/tpu_bench_experiments.py), so sweeps there measure exactly
+    what the bench reports."""
     import jax
     import numpy as np
 
-    from cpr_tpu.envs.nakamoto import NakamotoSSZ
     from cpr_tpu.params import make_params
 
-    env = NakamotoSSZ()
-    # scan n_steps past one full episode (max_steps=2016) so stats exist
-    params = make_params(alpha=0.35, gamma=0.5, max_steps=2016)
-    policy = env.policies["sapirshtein-2016-sm1"]
+    params = make_params(alpha=0.35, gamma=0.5, max_steps=max_steps)
+    policy = env.policies[policy_name]
     keys = jax.random.split(jax.random.PRNGKey(0), n_envs)
     fn = jax.jit(jax.vmap(
         lambda k: env.episode_stats(k, params, policy, n_steps)))
@@ -48,6 +47,66 @@ def measure_nakamoto(n_envs: int, n_steps: int = 2200, reps: int = 3):
     atk = np.asarray(stats["episode_reward_attacker"]).mean()
     dfn = np.asarray(stats["episode_reward_defender"]).mean()
     return n_envs * n_steps / dt, atk / (atk + dfn)
+
+
+def measure_nakamoto(n_envs: int, n_steps: int = 2200, reps: int = 3):
+    """The headline workload (BASELINE config 1): SM1 selfish mining
+    over `n_envs` vmapped episode streams; n_steps scans past one full
+    episode (max_steps=2016) so stats exist."""
+    from cpr_tpu.envs.nakamoto import NakamotoSSZ
+
+    return _measure_episodes(NakamotoSSZ(), "sapirshtein-2016-sm1",
+                             n_envs, n_steps, reps, max_steps=2016)
+
+
+def measure_bk(n_envs: int, n_steps: int = 512, reps: int = 3):
+    """BASELINE config 2: Bk k=8 vote-withholding (get-ahead), vmap'd
+    episode batch."""
+    from cpr_tpu.envs.bk import BkSSZ
+
+    env = BkSSZ(k=8, incentive_scheme="constant", max_steps_hint=n_steps)
+    return _measure_episodes(env, "get-ahead", n_envs, n_steps, reps,
+                             max_steps=n_steps - 8)
+
+
+def measure_ethereum(n_envs: int, n_steps: int = 256, reps: int = 3):
+    """BASELINE config 3: Ethereum byzantium uncle-mining attack (FN'19
+    policy), large batched episodes."""
+    from cpr_tpu.envs.ethereum import EthereumSSZ
+
+    env = EthereumSSZ("byzantium", max_steps_hint=n_steps)
+    return _measure_episodes(env, "fn19", n_envs, n_steps, reps,
+                             max_steps=n_steps - 8)
+
+
+def measure_tailstorm_ppo(n_envs: int, rollout_len: int = 128,
+                          reps: int = 2):
+    """BASELINE config 4: Tailstorm selfish-mining PPO — the training
+    driver's actual train_step (rollout with policy-net inference +
+    env.step + auto-reset, then GAE + minibatch updates), measured in
+    env-steps/sec; one call consumes rollout_len * n_envs steps."""
+    import jax
+    import numpy as np
+
+    from cpr_tpu.envs.registry import get_sized
+    from cpr_tpu.params import make_params
+    from cpr_tpu.train.ppo import PPOConfig, make_train
+
+    env = get_sized("tailstorm-8-discount-heuristic", 256)
+    params = make_params(alpha=0.35, gamma=0.5, max_steps=248)
+    cfg = PPOConfig(n_envs=n_envs, n_steps=rollout_len)
+    init_fn, train_step = make_train(env, params, cfg)
+    carry = jax.jit(init_fn)(jax.random.PRNGKey(0))
+    step = jax.jit(train_step)
+    carry, _ = step(carry)  # compile + warm
+    jax.block_until_ready(carry)
+    t0 = time.time()
+    for _ in range(reps):
+        carry, metrics = step(carry)
+        jax.block_until_ready(carry)
+    dt = (time.time() - t0) / reps
+    ent = float(np.asarray(metrics["entropy"]))
+    return n_envs * rollout_len / dt, ent
 
 
 # correctness guard bounds: SM1 revenue near the ES'14 closed form
@@ -83,13 +142,65 @@ def run_bench(platform_hint: str):
     }))
 
 
-def _attempt(timeout: float):
-    """One watchdog-bounded child run.  Returns ("ok", json_line),
+# BASELINE.md target configs 2-4 (config 1 is the headline metric above;
+# config 5, GhostDAG VI, is measured by the capstone tooling).  Sizes
+# follow BASELINE.json; CPU fallbacks shrink so the watchdog always gets
+# a tagged number.
+CONFIGS = {
+    "bk8_withholding": dict(
+        fn="measure_bk", tpu=dict(n_envs=4096), cpu=dict(n_envs=128),
+        guard=(0.05, 0.6), guard_name="get-ahead revenue share"),
+    "ethereum_uncle_attack": dict(
+        fn="measure_ethereum", tpu=dict(n_envs=65536),
+        cpu=dict(n_envs=256), guard=(0.33, 0.55),
+        guard_name="fn19 revenue share"),
+    "tailstorm_ppo_train": dict(
+        fn="measure_tailstorm_ppo", tpu=dict(n_envs=4096),
+        cpu=dict(n_envs=64), guard=(0.0, 2.1),
+        guard_name="policy entropy (2 actions + quorum head)"),
+}
+
+
+def run_configs(platform_hint: str):
+    """Measure configs 2-4, print one JSON line each, and write
+    BENCH_CONFIGS.json next to this file."""
+    import jax
+
+    if platform_hint == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    platform = jax.devices()[0].platform
+    print(f"bench-configs: backend={platform}", file=sys.stderr)
+    out = []
+    for name, spec in CONFIGS.items():
+        kw = spec["cpu"] if platform == "cpu" else spec["tpu"]
+        rate, check = globals()[spec["fn"]](**kw)
+        rate, check = float(rate), float(check)
+        lo, hi = spec["guard"]
+        assert lo < check < hi, \
+            f"{name}: {spec['guard_name']} {check} outside ({lo}, {hi})"
+        row = {
+            "metric": f"{name}_env_steps_per_sec_per_chip",
+            "value": round(rate),
+            "unit": "env-steps/sec/chip",
+            "check": round(check, 4),
+            "backend": platform,
+            **{f"cfg_{k}": v for k, v in kw.items()},
+        }
+        print(json.dumps(row))
+        out.append(row)
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_CONFIGS.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=2)
+
+
+def _attempt(timeout: float, mode: str = "--direct"):
+    """One watchdog-bounded child run.  Returns ("ok", json_lines),
     ("failed", rc), or ("hung", None).  Manual Popen because
     subprocess.run's post-kill wait() is untimed — a child stuck in
     uninterruptible device I/O would hang the parent forever."""
     proc = subprocess.Popen(
-        [sys.executable, os.path.abspath(__file__), "--direct"],
+        [sys.executable, os.path.abspath(__file__), mode],
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
     try:
         out, err = proc.communicate(timeout=timeout)
@@ -103,14 +214,14 @@ def _attempt(timeout: float):
         sys.stderr.write(err or "")
         return "hung", None
     sys.stderr.write(err or "")
-    line = next((ln for ln in (out or "").splitlines()
-                 if ln.startswith("{")), None)
-    if proc.returncode == 0 and line:
-        return "ok", line
+    lines = [ln for ln in (out or "").splitlines() if ln.startswith("{")]
+    if proc.returncode == 0 and lines:
+        return "ok", "\n".join(lines)
     return "failed", proc.returncode
 
 
 def main():
+    configs_mode = "--configs" in sys.argv
     if "--direct" in sys.argv:
         # child mode: let the default (TPU-preferring) backend come up;
         # on a host with no TPU this IS the CPU bench and its result is
@@ -118,16 +229,22 @@ def main():
         # watchdog timeout)
         run_bench("default")
         return
+    if "--direct-configs" in sys.argv:
+        run_configs("default")
+        return
     if os.environ.get("CPR_BENCH_BACKEND") == "cpu":
-        run_bench("cpu")
+        run_configs("cpu") if configs_mode else run_bench("cpu")
         return
     # watchdog: try the TPU in a subprocess so a hung backend cannot
     # stall this process past the driver's patience; a clean failure
     # (e.g. transiently claimed chip) gets one paused retry, a hang
     # (wedged device) goes straight to CPU
     timeout = float(os.environ.get("CPR_BENCH_TPU_TIMEOUT", "360"))
+    mode = "--direct-configs" if configs_mode else "--direct"
+    if configs_mode:
+        timeout *= 2  # three compiles instead of one
     for attempt in range(2):
-        status, payload = _attempt(timeout)
+        status, payload = _attempt(timeout, mode)
         if status == "ok":
             print(payload)
             return
@@ -142,7 +259,7 @@ def main():
     else:
         print("bench: TPU attempts failed, falling back to CPU",
               file=sys.stderr)
-    run_bench("cpu")
+    run_configs("cpu") if configs_mode else run_bench("cpu")
 
 
 if __name__ == "__main__":

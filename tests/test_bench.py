@@ -1,0 +1,35 @@
+"""Bench harness smoke: every BASELINE config measure runs at tiny
+sizes on the CPU mesh and passes its own correctness guard.
+
+The real numbers come from `python bench.py` / `--configs` on the chip
+(driver artifact + BENCH_CONFIGS.json); these tests only keep the
+harness importable and honest — a broken guard or a config that can't
+compile should fail HERE, not in the one driver-run bench window per
+round (the round-2 lesson: bench failures on the chip are expensive).
+"""
+
+import sys
+import os
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import bench  # noqa: E402
+
+
+def test_measure_nakamoto_guard():
+    rate, rel = bench.measure_nakamoto(64, n_steps=2200, reps=1)
+    assert rate > 0
+    assert bench.SM1_GUARD[0] < rel < bench.SM1_GUARD[1], rel
+
+
+@pytest.mark.slow  # compiles the 3 heaviest kernels in the repo
+def test_measure_config_guards():
+    for name, spec in bench.CONFIGS.items():
+        kw = dict(spec["cpu"])
+        kw["n_envs"] = min(kw["n_envs"], 32)
+        rate, check = getattr(bench, spec["fn"])(**kw, reps=1)
+        lo, hi = spec["guard"]
+        assert rate > 0, name
+        assert lo < check < hi, (name, check)
