@@ -66,6 +66,25 @@ class Distribution:
                 jnp.float32)
         raise ValueError(k)
 
+    @property
+    def ev(self) -> float:
+        """Expected value (the R generator's `distance` semantics:
+        every delay distribution is parameterized so its mean is the
+        link distance, create-networks.R:20-33)."""
+        k, p = self.kind, self.params
+        if k == "constant":
+            return float(p[0])
+        if k == "uniform":
+            return (p[0] + p[1]) / 2.0
+        if k == "exponential":
+            return float(p[0])
+        if k == "geometric":
+            return 1.0 / p[0] if p[0] > 0 else float("inf")
+        if k == "discrete":
+            t = sum(p)
+            return sum(i * w for i, w in enumerate(p)) / t if t else 0.0
+        raise ValueError(k)
+
     def to_string(self) -> str:
         fmt = " ".join(_fmt_float(x) for x in self.params)
         return f"{self.kind} {fmt}"

@@ -18,6 +18,7 @@ remain numpy/scipy, like the reference.
 from __future__ import annotations
 
 import math
+import os
 import time
 from dataclasses import dataclass, field
 from functools import partial
@@ -229,6 +230,59 @@ def _vi_loop(src, act, dst, prob, reward, progress, S, A, discount,
                          discount, stop_delta, max_iter)
 
 
+@partial(jax.jit, static_argnums=(6, 7, 11))
+def _vi_chunk(src, act, dst, prob, reward, progress, S, A, discount,
+              value, prog, chunk):
+    """`chunk` unconditional Bellman sweeps as one lax.scan — the
+    device-while-free VI step.  The axon TPU worker has faulted inside
+    the while_loop VI at every size tried (round-2 finding); running
+    fixed-size chunks with HOST-side convergence checks between calls
+    removes the data-dependent device loop from the program entirely,
+    at the cost of up to chunk-1 extra (idempotent-at-fixpoint) sweeps."""
+    sweep = make_vi_sweep(S, A)
+    valid, any_valid = _valid_actions(src, act, prob, S, A)
+
+    # policy rides in the carry (only the final one matters); stacking
+    # it per sweep would materialize chunk x S ints on the memory-tight
+    # device this impl exists for
+    def body(carry, _):
+        value, prog, _ = carry
+        v2, p2, pol = sweep(src, act, dst, prob, reward, progress, valid,
+                            any_valid, discount, value, prog)
+        return (v2, p2, pol), jnp.abs(v2 - value).max()
+
+    pol0 = jnp.full((S,), -1, jnp.int32)
+    (v, p, pol), deltas = jax.lax.scan(
+        body, (value, prog, pol0), None, length=chunk)
+    return v, p, pol, deltas[-1]
+
+
+def vi_chunked(src, act, dst, prob, reward, progress, S, A, discount,
+               stop_delta, max_iter, chunk: int = 16):
+    """Host-driven VI: repeat `_vi_chunk` until the last in-chunk delta
+    drops below stop_delta (or max_iter sweeps ran).  Same fixpoint as
+    vi_while_loop — extra post-convergence sweeps are no-ops on a
+    converged value function."""
+    z = jnp.zeros(S, prob.dtype)
+    value, prog = z, z
+    it = 0
+    delta = jnp.inf
+    pol = None
+    while it < max_iter:
+        # full chunks, then a chunk=1 tail: `chunk` is a static argnum,
+        # so an arbitrary-size tail chunk would compile a fresh program
+        # per distinct max_iter % chunk; the 1-sweep program compiles
+        # once and serves every tail
+        step = chunk if max_iter - it >= chunk else 1
+        value, prog, pol, delta = _vi_chunk(
+            src, act, dst, prob, reward, progress, S, A, discount,
+            value, prog, step)
+        it += step
+        if float(delta) <= float(stop_delta):
+            break
+    return value, prog, pol, delta, it
+
+
 @partial(jax.jit, static_argnums=(6, 9))
 def _pe_loop(src, dst, prob, reward, progress, onpolicy, S, discount, theta,
              max_iter):
@@ -358,17 +412,27 @@ class TensorMDP:
 
     def value_iteration(self, *, max_iter: int = 0, discount: float = 1.0,
                         eps: float | None = None, stop_delta: float | None = None,
-                        verbose: bool = False):
+                        verbose: bool = False, impl: str | None = None):
         """eps-optimal value iteration (reference semantics:
         mdp/lib/explicit_mdp.py:97-177 — double-buffered dense sweep that
         also tracks expected progress and the greedy policy; ties go to
         the lowest action id; states without actions get value 0 and
-        policy -1)."""
+        policy -1).
+
+        impl: "while" (default; lax.while_loop, one device program) or
+        "chunked" (fixed-size scan chunks, host-side convergence check —
+        the axon-TPU fault workaround, see _vi_chunk).  The env var
+        CPR_VI_IMPL overrides the default so on-chip tooling can switch
+        without code changes; both produce the same fixpoint."""
         stop_delta = self.resolve_stop_delta(
             discount=discount, eps=eps, stop_delta=stop_delta, max_iter=max_iter)
         self._check_segment_width()
+        impl = impl or os.environ.get("CPR_VI_IMPL", "while")
+        if impl not in ("while", "chunked"):
+            raise ValueError(f"unknown VI impl '{impl}'")
         t0 = time.time()
-        value, progress, policy, delta, it = _vi_loop(
+        run = _vi_loop if impl == "while" else vi_chunked
+        value, progress, policy, delta, it = run(
             self.src, self.act, self.dst, self.prob, self.reward,
             self.progress, self.n_states, self.n_actions,
             jnp.asarray(discount, self.prob.dtype),

@@ -120,6 +120,121 @@ def random_regular(n: int, degree: int, *, activation_delay: float,
                    dissemination="flooding")
 
 
+def preferential_attachment(n: int, m: int = 2, *,
+                            distribution: str = "constant",
+                            seed: int = 0) -> Network:
+    """Barabási–Albert topology with the reference generator's node and
+    edge attributes (experiments/simulate-topology/create-networks.R):
+    exponential per-node solving rates normalized into compute shares,
+    edge distances uniform in [1, 10], per-edge delay distribution keyed
+    on the distance (constant / uniform +-50% / exponential with the
+    distance as mean), flooding dissemination, and activation_delay set
+    to 2x the mean compute-weighted distance (`net_bias`) so block
+    intervals sit just above the expected message delay."""
+    import random as _random
+
+    assert n >= m + 1 and m >= 1
+    rng = _random.Random(seed)
+    # igraph sample_pa shape: grow from one vertex; each new vertex
+    # attaches m edges to distinct existing vertices with probability
+    # proportional to degree + 1 (zero-appeal keeps isolated targets
+    # reachable)
+    edges: set[tuple[int, int]] = set()
+    degs = [0] * n
+    for i in range(1, n):
+        pool = list(range(i))
+        weights = [degs[j] + 1 for j in pool]
+        targets: set[int] = set()
+        while len(targets) < min(m, i):
+            (j,) = rng.choices(pool, weights=weights)
+            targets.add(j)
+        for j in targets:
+            edges.add((j, i))
+            degs[i] += 1
+            degs[j] += 1
+
+    rates = [rng.expovariate(1.0) for _ in range(n)]
+    total = sum(rates)
+    nodes = [NetNode(r / total) for r in rates]
+    for a, b in sorted(edges):
+        distance = rng.uniform(1.0, 10.0)
+        if distribution == "constant":
+            d = dist.constant(distance)
+        elif distribution == "uniform":
+            d = dist.uniform(0.5 * distance, 1.5 * distance)
+        elif distribution == "exponential":
+            d = dist.exponential(distance)
+        else:
+            raise ValueError(f"unknown distribution '{distribution}'")
+        nodes[a].links.append(Link(b, d))
+        nodes[b].links.append(Link(a, d))
+    net = Network(nodes=nodes, dissemination="flooding")
+    net.activation_delay = 2.0 * sum(
+        s["net_bias"] for s in topology_stats(net)) / n
+    return net
+
+
+def topology_stats(net: Network) -> list[dict]:
+    """Per-node farness / closeness / net_bias over expected link
+    delays (create-networks.R:36-41): farness is the mean shortest-path
+    distance to the other nodes, closeness its inverse, and net_bias
+    the compute-weighted distance — the generator's measure of how far
+    a node sits from the hash power."""
+    import numpy as np
+    from scipy.sparse.csgraph import shortest_path
+
+    n = len(net.nodes)
+    w = np.full((n, n), np.inf)
+    np.fill_diagonal(w, 0.0)
+    for i, nd in enumerate(net.nodes):
+        for ln in nd.links:
+            # scipy's dense csgraph reads 0 as "no edge" (and its
+            # conversion flattens values below ~1e-8 to 0), so a
+            # genuine zero-delay link (two_agents/selfish_mining) must
+            # carry an epsilon — 1e-6 is six orders below real link
+            # distances (1-10) yet survives the conversion
+            ev = max(ln.delay.ev, 1e-6)
+            w[i, ln.dest] = min(w[i, ln.dest], ev)
+    d = shortest_path(w, method="D")
+    compute = np.array([nd.compute for nd in net.nodes])
+    out = []
+    for i in range(n):
+        farness = float(d[i].sum() / max(n - 1, 1))
+        out.append({
+            "farness": farness,
+            "closeness": 1.0 / farness if farness > 0 else float("inf"),
+            "net_bias": float((compute * d[i]).sum()),
+        })
+    return out
+
+
+def write_topology_batch(outdir: str, *, count: int = 10, n: int = 13,
+                         m: int = 2,
+                         distributions=("constant", "uniform",
+                                        "exponential"),
+                         seed: int = 42) -> list[str]:
+    """The create-networks.R batch: `count` preferential-attachment
+    topologies per delay distribution, written as GraphML into
+    `outdir` (consumed by experiments.graphml_runner / Network
+    simulate)."""
+    import os
+
+    os.makedirs(outdir, exist_ok=True)
+    paths = []
+    tag = {"constant": "cns", "uniform": "uni", "exponential": "exp"}
+    for di, distribution in enumerate(distributions):
+        for i in range(count):
+            net = preferential_attachment(
+                n, m, distribution=distribution,
+                seed=seed + i * 31 + di * 1009)
+            path = os.path.join(
+                outdir, f"{i + 1:03d}-{tag[distribution]}-graphml.xml")
+            with open(path, "w") as f:
+                f.write(to_graphml(net))
+            paths.append(path)
+    return paths
+
+
 # -- GraphML round-trip ------------------------------------------------------
 
 

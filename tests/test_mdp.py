@@ -117,6 +117,24 @@ def test_sharded_vi_matches_single_device():
     np.testing.assert_array_equal(sharded["vi_policy"], single["vi_policy"])
 
 
+def test_vi_chunked_impl_matches_while():
+    """The device-while-free VI (chunked scan + host convergence, the
+    axon-TPU fault workaround) reaches the identical fixpoint, policy
+    included; max_iter is honored to within one chunk."""
+    c = Compiler(Fc16BitcoinSM(alpha=0.3, gamma=0.5, maximum_fork_length=10))
+    tm = ptmdp(c.mdp(), horizon=20).tensor()
+    a = tm.value_iteration(stop_delta=1e-9)
+    b = tm.value_iteration(stop_delta=1e-9, impl="chunked")
+    np.testing.assert_allclose(b["vi_value"], a["vi_value"],
+                               rtol=0, atol=1e-12)
+    np.testing.assert_array_equal(b["vi_policy"], a["vi_policy"])
+    assert b["vi_iter"] >= a["vi_iter"]  # overshoots to a chunk multiple
+    fixed = tm.value_iteration(max_iter=7, impl="chunked")
+    assert fixed["vi_iter"] == 7
+    with pytest.raises(ValueError, match="unknown VI impl"):
+        tm.value_iteration(stop_delta=1e-6, impl="nope")
+
+
 def test_vi_eps_guard():
     c = Compiler(Fc16BitcoinSM(alpha=0.3, gamma=0.5, maximum_fork_length=8))
     tm = ptmdp(c.mdp(), horizon=20).tensor()

@@ -42,6 +42,26 @@ def body(c):
 v, i = jax.lax.while_loop(lambda c: c[1] < 50, body,
                           (jnp.ones(1000, jnp.float32), 0))
 print(int(i), float(v.sum()))"""),
+    ("scan_sweep", """
+# the while_loop candidate's scan twin: same segment_sum sweep body,
+# fixed trip count — separates "loop construct" from "sweep body"
+def body(c, _):
+    v = jax.ops.segment_sum(c[jnp.arange(1000) % 100] * 0.5,
+                            jnp.arange(1000) % 100, num_segments=100)[
+        jnp.arange(1000) % 100]
+    return v, None
+v, _ = jax.lax.scan(body, jnp.ones(1000, jnp.float32), None, length=50)
+print(float(v.sum()))"""),
+    ("argmax_inf_while", """
+# masked argmax with -inf inside a while_loop (the _greedy_backup shape)
+def body(c):
+    q, i = c
+    qm = jnp.where(jnp.arange(8) % 2 == 0, q, -jnp.inf)
+    a = jnp.argmax(qm.reshape(64, 8)[:, :], axis=1)
+    return q + a.sum() * 1e-9, i + 1
+q, i = jax.lax.while_loop(lambda c: c[1] < 50, body,
+                          (jnp.ones(512, jnp.float32).reshape(64, 8), 0))
+print(int(i))"""),
     ("vi_fc16_small", """
 from cpr_tpu.mdp import Compiler, ptmdp
 from cpr_tpu.mdp.models import Fc16BitcoinSM
@@ -50,6 +70,24 @@ tm = ptmdp(Compiler(Fc16BitcoinSM(alpha=0.3, gamma=0.5,
            horizon=20).tensor()
 vi = tm.value_iteration(stop_delta=1e-6)
 print(int(vi["vi_iter"]))"""),
+    ("vi_fc16_small_chunked", """
+# the workaround candidate: same sweeps, no device while_loop
+from cpr_tpu.mdp import Compiler, ptmdp
+from cpr_tpu.mdp.models import Fc16BitcoinSM
+tm = ptmdp(Compiler(Fc16BitcoinSM(alpha=0.3, gamma=0.5,
+                                  maximum_fork_length=8)).mdp(),
+           horizon=20).tensor()
+vi = tm.value_iteration(stop_delta=1e-6, impl="chunked")
+print(int(vi["vi_iter"]))"""),
+    ("vi_fc16_pt_chunked", """
+# BASELINE config-5 adjacent size (fc16/PT table), chunked impl
+from cpr_tpu.mdp import Compiler, ptmdp
+from cpr_tpu.mdp.models import Fc16BitcoinSM
+tm = ptmdp(Compiler(Fc16BitcoinSM(alpha=0.33, gamma=0.7,
+                                  maximum_fork_length=25)).mdp(),
+           horizon=60).tensor()
+vi = tm.value_iteration(stop_delta=1e-5, impl="chunked")
+print(int(vi["vi_iter"]), round(float(vi["vi_delta"]), 8))"""),
     ("vi_ghostdag_c5", """
 from cpr_tpu.mdp import ptmdp
 from cpr_tpu.mdp.generic.native import compile_native
@@ -65,6 +103,14 @@ tm = ptmdp(compile_native("ghostdag", k=2, alpha=0.33, gamma=0.5,
                           collect_garbage="simple", dag_size_cutoff=7),
            horizon=100).tensor()
 vi = tm.value_iteration(stop_delta=1e-5)
+print(int(vi["vi_iter"]))"""),
+    ("vi_ghostdag_c7_chunked", """
+from cpr_tpu.mdp import ptmdp
+from cpr_tpu.mdp.generic.native import compile_native
+tm = ptmdp(compile_native("ghostdag", k=2, alpha=0.33, gamma=0.5,
+                          collect_garbage="simple", dag_size_cutoff=7),
+           horizon=100).tensor()
+vi = tm.value_iteration(stop_delta=1e-5, impl="chunked")
 print(int(vi["vi_iter"]))"""),
 ]
 
