@@ -19,7 +19,8 @@ from cpr_tpu.experiments.break_even import break_even
 from cpr_tpu.experiments.measure_rtdp import measure_rtdp_rows
 from cpr_tpu.experiments.analysis import (efficiency_pivot, expand_rows,
                                           gini)
+from cpr_tpu.experiments.rl_eval import aggregate, episode_rows
 
 __all__ = ["write_tsv", "run_task", "honest_net_rows", "withholding_rows",
            "break_even", "measure_rtdp_rows", "expand_rows",
-           "efficiency_pivot", "gini"]
+           "efficiency_pivot", "gini", "episode_rows", "aggregate"]

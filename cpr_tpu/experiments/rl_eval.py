@@ -1,0 +1,139 @@
+"""Policy-evaluation study: per-episode rows + aggregation tables.
+
+Reference counterpart: the rl-eval notebook layer
+(experiments/rl-eval/eval-policies.ipynb — hard-coded and trained
+policies evaluated over (protocol x alpha x gamma) grids into an
+`episodes` DataFrame; rl-results-condensed.ipynb — groupby aggregation
+to relrew mean/std and reward-per-progress per setting;
+find-break-even-points.ipynb — orphans/payoff derivations).
+
+TPU re-design: one jitted kernel per (env, policy) evaluates the whole
+(alpha x gamma) grid x reps lanes and returns only the episode-end info
+columns; episodes are extracted host-side from the done mask, so the
+rows are REAL per-episode observations (the notebooks' episodes.pkl
+granularity), not lane means.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cpr_tpu.envs.registry import get_sized
+from cpr_tpu.params import stack_params
+
+_COLS = ("episode_reward_attacker", "episode_reward_defender",
+         "episode_progress", "episode_n_activations", "episode_sim_time")
+
+
+def _collect(env, policy_fn, keys, params, n_steps):
+    """Jitted rollout collector: only done flags + episode-end columns
+    come back to the host."""
+
+    def one(k, p):
+        _, _, _, done, info = env.rollout(k, p, policy_fn, n_steps)
+        return {"done": done, **{c: info[c] for c in _COLS}}
+
+    fn = jax.jit(jax.vmap(jax.vmap(one, in_axes=(0, None)),
+                          in_axes=(0, 0)))
+    return jax.device_get(fn(keys, params))
+
+
+def episode_rows(protocol_key: str, policies=None, *,
+                 alphas=(0.25, 0.33, 0.45), gammas=(0.5,),
+                 episode_len: int = 128, reps: int = 32, seed: int = 0,
+                 env_kwargs=None, kind: str = "hard-coded",
+                 net_params=None, hidden=(64, 64)):
+    """One row per completed episode, for either the env's hard-coded
+    policies (`kind="hard-coded"`) or a trained ActorCritic checkpoint
+    (`kind="trained"`, pass net_params from driver.load_checkpoint and
+    policies as the label to record)."""
+    env = get_sized(protocol_key, episode_len, **(env_kwargs or {}))
+    grid = [(a, g) for a in alphas for g in gammas]
+    params = stack_params([dict(alpha=a, gamma=g, max_steps=episode_len)
+                           for a, g in grid])
+    keys = jax.random.split(jax.random.PRNGKey(seed), (len(grid), reps))
+    n_steps = episode_len + 8
+
+    if kind == "trained":
+        from cpr_tpu.train.ppo import ActorCritic
+
+        net = ActorCritic(env.n_actions, hidden)
+
+        def greedy(obs):
+            logits, _ = net.apply(net_params, obs)
+            return jnp.argmax(logits, axis=-1)
+
+        policy_map = {str(policies or "trained"): greedy}
+    elif kind == "hard-coded":
+        if policies is None:
+            policies = list(env.policies)
+        elif isinstance(policies, str):
+            policies = [policies]
+        policy_map = {p: env.policies[p] for p in policies}
+    else:
+        raise ValueError(f"unknown kind '{kind}' "
+                         "(expected 'hard-coded' or 'trained')")
+
+    rows = []
+    for pol_name, pol_fn in policy_map.items():
+        out = _collect(env, pol_fn, keys, params, n_steps)
+        done = np.asarray(out["done"], bool)  # [grid, reps, steps]
+        for gi, (a, g) in enumerate(grid):
+            mask = done[gi]
+            vals = {c: np.asarray(out[c])[gi][mask] for c in _COLS}
+            for e in range(mask.sum()):
+                atk = float(vals["episode_reward_attacker"][e])
+                dfn = float(vals["episode_reward_defender"][e])
+                prg = float(vals["episode_progress"][e])
+                acts = float(vals["episode_n_activations"][e])
+                rows.append({
+                    "protocol": protocol_key,
+                    "policy": pol_name,
+                    "kind": kind,
+                    "alpha": a,
+                    "gamma": g,
+                    "episode_len": episode_len,
+                    "episode_relrew":
+                        atk / (atk + dfn) if atk + dfn else 0.0,
+                    "episode_rpp": atk / prg if prg else 0.0,
+                    "episode_progress": prg,
+                    "episode_n_activations": acts,
+                    # find-break-even-points.ipynb's derived columns
+                    "orphans": acts / prg if prg else float("inf"),
+                })
+    return rows
+
+
+_SETTING = ("protocol", "policy", "kind", "alpha", "gamma")
+
+
+def aggregate(rows: list[dict]) -> list[dict]:
+    """rl-results-condensed.ipynb's model table: one row per setting
+    with episode counts and relrew / rpp / orphans statistics."""
+    groups: dict = {}
+    for r in rows:
+        groups.setdefault(tuple(r[k] for k in _SETTING), []).append(r)
+    out = []
+    for key, rs in sorted(groups.items()):
+        relrew = np.array([r["episode_relrew"] for r in rs])
+        rpp = np.array([r["episode_rpp"] for r in rs])
+        orph = np.array([r["orphans"] for r in rs])
+        out.append({
+            **dict(zip(_SETTING, key)),
+            "n": len(rs),
+            "relrew_mean": float(relrew.mean()),
+            "relrew_std": float(relrew.std()),
+            "rpp_mean": float(rpp.mean()),
+            "orphans_mean": float(orph[np.isfinite(orph)].mean())
+            if np.isfinite(orph).any() else float("inf"),
+        })
+    return out
+
+
+def to_dataframe(rows: list[dict]):
+    """episodes.pkl-style DataFrame for the notebook workflow."""
+    import pandas as pd
+
+    return pd.DataFrame(rows)

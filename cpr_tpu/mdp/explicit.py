@@ -230,17 +230,23 @@ def _vi_loop(src, act, dst, prob, reward, progress, S, A, discount,
                          discount, stop_delta, max_iter)
 
 
-@partial(jax.jit, static_argnums=(6, 7, 11))
+@partial(jax.jit, static_argnums=(3, 4))
+def _vi_valid(src, act, prob, S, A):
+    return _valid_actions(src, act, prob, S, A)
+
+
+@partial(jax.jit, static_argnums=(6, 7, 13))
 def _vi_chunk(src, act, dst, prob, reward, progress, S, A, discount,
-              value, prog, chunk):
+              value, prog, valid, any_valid, chunk):
     """`chunk` unconditional Bellman sweeps as one lax.scan — the
     device-while-free VI step.  The axon TPU worker has faulted inside
     the while_loop VI at every size tried (round-2 finding); running
     fixed-size chunks with HOST-side convergence checks between calls
     removes the data-dependent device loop from the program entirely,
-    at the cost of up to chunk-1 extra (idempotent-at-fixpoint) sweeps."""
+    at the cost of up to chunk-1 extra (idempotent-at-fixpoint) sweeps.
+    The loop-invariant valid-action masks come in precomputed
+    (_vi_valid) so per-chunk dispatches don't re-pay that segment_sum."""
     sweep = make_vi_sweep(S, A)
-    valid, any_valid = _valid_actions(src, act, prob, S, A)
 
     # policy rides in the carry (only the final one matters); stacking
     # it per sweep would materialize chunk x S ints on the memory-tight
@@ -265,6 +271,7 @@ def vi_chunked(src, act, dst, prob, reward, progress, S, A, discount,
     converged value function."""
     z = jnp.zeros(S, prob.dtype)
     value, prog = z, z
+    valid, any_valid = _vi_valid(src, act, prob, S, A)
     it = 0
     delta = jnp.inf
     pol = None
@@ -276,7 +283,7 @@ def vi_chunked(src, act, dst, prob, reward, progress, S, A, discount,
         step = chunk if max_iter - it >= chunk else 1
         value, prog, pol, delta = _vi_chunk(
             src, act, dst, prob, reward, progress, S, A, discount,
-            value, prog, step)
+            value, prog, valid, any_valid, step)
         it += step
         if float(delta) <= float(stop_delta):
             break

@@ -147,6 +147,53 @@ def test_measure_mdp_rows():
     write_tsv(rows)
 
 
+def test_rl_eval_episode_rows_and_aggregate(tmp_path):
+    """rl-eval notebook layer: per-episode rows over a grid for a
+    hard-coded policy and a (fresh) trained net, aggregated to the
+    rl-results table shape."""
+    import jax
+    import jax.numpy as jnp
+
+    from cpr_tpu.experiments import aggregate, episode_rows
+    from cpr_tpu.train.ppo import ActorCritic
+    from cpr_tpu.envs.registry import get_sized
+
+    rows = episode_rows("nakamoto",
+                        ["honest", "sapirshtein-2016-sm1"],
+                        alphas=(0.3, 0.45), gammas=(0.5,),
+                        episode_len=128, reps=16)
+    assert {r["policy"] for r in rows} == \
+        {"honest", "sapirshtein-2016-sm1"}
+    assert all(r["kind"] == "hard-coded" for r in rows)
+    # 128-step episodes in a 136-step rollout: ~1 episode per lane
+    assert len(rows) >= 2 * 2 * 16
+
+    agg = aggregate(rows)
+    by = {(r["policy"], r["alpha"]): r for r in agg}
+    honest = by[("honest", 0.3)]
+    assert honest["n"] >= 16
+    assert abs(honest["relrew_mean"] - 0.3) < 0.05
+    assert honest["relrew_std"] >= 0.0
+    assert honest["orphans_mean"] >= 1.0  # activations >= progress
+    # SM1 beats honest at alpha=0.45 in the aggregate, like the
+    # notebooks' model table
+    assert by[("sapirshtein-2016-sm1", 0.45)]["relrew_mean"] > \
+        by[("honest", 0.45)]["relrew_mean"]
+
+    # trained kind: an untrained net's greedy policy still produces
+    # valid episode rows tagged for the trained-vs-hard-coded compare
+    env = get_sized("nakamoto", 128)
+    net = ActorCritic(env.n_actions, (16,))
+    params = net.init(jax.random.PRNGKey(0),
+                      jnp.zeros((1, env.observation_length)))
+    trows = episode_rows("nakamoto", "ppo-seed0", alphas=(0.3,),
+                         gammas=(0.5,), episode_len=128, reps=8,
+                         kind="trained", net_params=params, hidden=(16,))
+    assert trows and all(r["kind"] == "trained" and
+                         r["policy"] == "ppo-seed0" for r in trows)
+    write_tsv(rows + trows)
+
+
 def test_config_yaml_roundtrip(tmp_path):
     cfg = TrainConfig.from_yaml(
         os.path.join(os.path.dirname(__file__), "..", "cpr_tpu", "train",
