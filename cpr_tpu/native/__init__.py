@@ -24,26 +24,33 @@ _LIB = None
 def build_lib(src: str, so: str, opt: str = "-O2") -> None:
     """g++-compile `src` into shared library `so` (skipped when fresh).
 
-    Freshness requires BOTH a newer-than-source .so and an identical
-    compile command recorded in the sidecar stamp (`so`.cmd) — an mtime
-    check alone would serve an -O2 artifact for an -O3 request."""
+    Freshness = the sidecar stamp (`so`.cmd) records the compile
+    command (basenames, so relocation into site-packages keeps a
+    wheel-prebuilt .so fresh — mtimes don't survive wheel round-trips)
+    plus a content hash of the source (so editing the .cpp rebuilds,
+    and an -O2 artifact is never served for an -O3 request)."""
+    import hashlib
+
     cmd = ["g++", opt, "-std=c++17", "-shared", "-fPIC", src, "-o", so]
     stamp = so + ".cmd"
-    cmd_line = " ".join(cmd)
-    if (os.path.exists(so)
-            and os.path.getmtime(so) >= os.path.getmtime(src)):
+    with open(src, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()
+    stamp_line = " ".join(["g++", opt, "-std=c++17", "-shared", "-fPIC",
+                           os.path.basename(src), "-o",
+                           os.path.basename(so), "#", digest])
+    if os.path.exists(so):
         try:
             with open(stamp) as f:
-                if f.read() == cmd_line:
+                if f.read() == stamp_line:
                     return
         except OSError:
             pass  # no/unreadable stamp: rebuild
     r = subprocess.run(cmd, capture_output=True, text=True)
     if r.returncode != 0:
         raise RuntimeError(
-            f"native build failed ({cmd_line}):\n{r.stderr}")
+            f"native build failed ({' '.join(cmd)}):\n{r.stderr}")
     with open(stamp, "w") as f:
-        f.write(cmd_line)
+        f.write(stamp_line)
 
 
 _LOADED: dict = {}
