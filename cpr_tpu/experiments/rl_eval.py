@@ -44,12 +44,16 @@ def episode_rows(protocol_key: str, policies=None, *,
                  alphas=(0.25, 0.33, 0.45), gammas=(0.5,),
                  episode_len: int = 128, reps: int = 32, seed: int = 0,
                  env_kwargs=None, kind: str = "hard-coded",
-                 net_params=None, hidden=(64, 64)):
+                 net_params=None, hidden=(64, 64), env=None):
     """One row per completed episode, for either the env's hard-coded
     policies (`kind="hard-coded"`) or a trained ActorCritic checkpoint
     (`kind="trained"`, pass net_params from driver.load_checkpoint and
-    policies as the label to record)."""
-    env = get_sized(protocol_key, episode_len, **(env_kwargs or {}))
+    policies as the label to record).  Pass `env` to evaluate on the
+    exact env a checkpoint was trained with (e.g. driver.build_env's
+    AssumptionEnv wrapping, whose +2 observation fields the net's first
+    layer expects); protocol_key then only labels the rows."""
+    if env is None:
+        env = get_sized(protocol_key, episode_len, **(env_kwargs or {}))
     grid = [(a, g) for a in alphas for g in gammas]
     params = stack_params([dict(alpha=a, gamma=g, max_steps=episode_len)
                            for a, g in grid])

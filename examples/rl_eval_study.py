@@ -1,0 +1,56 @@
+"""Policy-evaluation study: per-episode rows over an (alpha, gamma)
+grid, aggregated to the rl-results model table — the rl-eval notebook
+pipeline (eval-policies + rl-results-condensed) as one script.
+
+Pass a checkpoint AND its training config to add the trained policy to
+the comparison:
+
+Usage: python examples/rl_eval_study.py [protocol-key] \
+           [ckpt.msgpack config.yaml]
+"""
+
+import _bootstrap  # noqa: F401  (repo-root path + backend pick)
+
+import sys
+
+from cpr_tpu.experiments import aggregate, episode_rows, write_tsv
+
+ALPHAS = (0.25, 0.33, 0.4, 0.45)
+GAMMAS = (0.5,)
+EPISODE_LEN = 256
+REPS = 32
+
+
+def main():
+    key = sys.argv[1] if len(sys.argv) > 1 else "nakamoto"
+    if len(sys.argv) == 3:
+        sys.exit("a checkpoint needs its training config too: "
+                 "rl_eval_study.py <protocol> <ckpt.msgpack> <cfg.yaml>")
+    rows = episode_rows(key, alphas=ALPHAS, gammas=GAMMAS,
+                        episode_len=EPISODE_LEN, reps=REPS)
+    if len(sys.argv) > 3:
+        from cpr_tpu.train.config import TrainConfig
+        from cpr_tpu.train.driver import (build_env, load_checkpoint,
+                                          ppo_config)
+
+        cfg = TrainConfig.from_yaml(sys.argv[3])
+        if cfg.protocol != key:
+            sys.exit(f"checkpoint was trained on '{cfg.protocol}', "
+                     f"not '{key}' — pass matching args")
+        # build_env applies the same wrappers training used (e.g. the
+        # AssumptionEnv +2 observation fields under scheduled alpha),
+        # so the checkpoint's layer shapes match the template
+        env = build_env(cfg)
+        params = load_checkpoint(sys.argv[2], env, cfg)
+        rows += episode_rows(key, sys.argv[2], alphas=ALPHAS,
+                             gammas=GAMMAS, episode_len=EPISODE_LEN,
+                             reps=REPS, kind="trained",
+                             net_params=params,
+                             hidden=ppo_config(cfg).hidden, env=env)
+    table = aggregate(rows)
+    print(write_tsv(table))
+    print(f"# {len(rows)} episodes -> {len(table)} settings")
+
+
+if __name__ == "__main__":
+    main()

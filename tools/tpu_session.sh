@@ -1,0 +1,45 @@
+#!/bin/bash
+# One healthy-chip window, spent in priority order (round-2 lesson:
+# bank the bench BEFORE anything that can wedge the backend).
+#   1. headline bench  -> BENCH_self_r03.json   (the evidence artifact)
+#   2. configs 2-4     -> BENCH_CONFIGS_tpu_r03.json
+#   3. PRNG sweep      -> stdout tee            (read-only perf data)
+#   4. VI bisect       -> LAST: its candidates have crashed the worker
+# Each step is already watchdogged internally (bench.py subprocess
+# pattern / bisect per-candidate children).  Artifacts are written via
+# temp files and only promoted on success with a tpu backend tag, so a
+# failed or CPU-fallback run never clobbers banked evidence.
+set -u
+cd "$(dirname "$0")/.."
+log=tools/tpu_session.log
+echo "=== tpu session $(date +%F_%T) ===" | tee -a "$log"
+
+echo "--- 1. headline bench" | tee -a "$log"
+if python bench.py >/tmp/bench_line.json 2>>"$log"; then
+  tee -a "$log" </tmp/bench_line.json
+  if grep -q '"backend": "\(tpu\|axon\)"' /tmp/bench_line.json; then
+    mv /tmp/bench_line.json BENCH_self_r03.json
+    echo "banked BENCH_self_r03.json" | tee -a "$log"
+  else
+    echo "NOT banked: backend is not tpu" | tee -a "$log"
+  fi
+else
+  echo "bench failed rc=$?" | tee -a "$log"
+fi
+
+echo "--- 2. configs 2-4" | tee -a "$log"
+if python bench.py --configs 2>>"$log" | tee -a "$log" \
+   && grep -q '"backend": "\(tpu\|axon\)"' BENCH_CONFIGS.json; then
+  cp -f BENCH_CONFIGS.json BENCH_CONFIGS_tpu_r03.json
+  echo "banked BENCH_CONFIGS_tpu_r03.json" | tee -a "$log"
+else
+  echo "configs NOT banked (failed or cpu fallback)" | tee -a "$log"
+fi
+
+echo "--- 3. PRNG sweep" | tee -a "$log"
+timeout 900 python tools/tpu_bench_experiments.py 2>>"$log" | tee -a "$log"
+
+echo "--- 4. VI bisect (may wedge the chip; runs last)" | tee -a "$log"
+python tools/tpu_vi_bisect.py 2>>"$log" | tee -a "$log"
+
+echo "=== done $(date +%F_%T) ===" | tee -a "$log"
