@@ -163,6 +163,10 @@ def test_native_parity_randomized_combinations():
             flags.update(truncate_common_chain=False, loop_honest=True)
         elif rng.random() < 0.3:
             flags.update(reward_common_chain=True)
+        if rng.random() < 0.3:
+            # height cutoff alone is unbounded (honest mining keeps
+            # going); layered on the dag cutoff it binds first
+            flags.update(traditional_height_cutoff=3)
         py = Compiler(SingleAgent(get_protocol(proto, **kw), alpha=alpha,
                                   gamma=gamma, **flags)).mdp()
         nat = compile_native(proto, k=k, alpha=alpha, gamma=gamma, **flags)

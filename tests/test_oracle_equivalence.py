@@ -309,6 +309,15 @@ _GRID_GAPS = {
         (0.33, 0.1): -0.077, (0.33, 0.5): -0.066, (0.33, 0.9): -0.064,
         (0.45, 0.1): -0.019, (0.45, 0.5): -0.017, (0.45, 0.9): -0.002,
     },
+    # spar and sdag honest dynamics coincide exactly under shared
+    # seeds in both engines (PoW-proportional rewards, no withholding),
+    # so one table serves both families
+    ("spar", "honest"): {
+        (0.15, 0.1): -0.005, (0.15, 0.5): -0.006, (0.15, 0.9): -0.004,
+        (0.25, 0.1): -0.002, (0.25, 0.5): +0.001, (0.25, 0.9): +0.003,
+        (0.33, 0.1): +0.013, (0.33, 0.5): +0.008, (0.33, 0.9): +0.005,
+        (0.45, 0.1): +0.011, (0.45, 0.5): +0.011, (0.45, 0.9): +0.007,
+    },
     ("tailstorm", "honest"): {
         (0.15, 0.1): -0.004, (0.15, 0.5): -0.005, (0.15, 0.9): -0.004,
         (0.25, 0.1): -0.002, (0.25, 0.5): +0.003, (0.25, 0.9): +0.002,
@@ -332,6 +341,9 @@ _GRID_GAPS = {
      dict(scheme="constant")),
     ("tailstorm", "tailstorm-4-constant-heuristic", "minor-delay",
      dict(scheme="constant")),
+    ("spar", "spar-4-constant", "honest", dict(scheme="constant")),
+    ("sdag", "sdag-4-constant-altruistic", "honest",
+     dict(scheme="constant")),
 ])
 def test_cross_engine_alpha_gamma_grid(oproto, key, policy, okw):
     """(alpha x gamma) grid anchors (VERDICT r2 #7): single-point checks
@@ -343,7 +355,8 @@ def test_cross_engine_alpha_gamma_grid(oproto, key, policy, okw):
     point.  Reference battery shape: cpr_protocols.ml:200-477."""
     from cpr_tpu.experiments import withholding_rows
 
-    gaps = _GRID_GAPS[(oproto, policy)]
+    gaps = _GRID_GAPS.get((oproto, policy)) or \
+        _GRID_GAPS[("spar", policy)]  # sdag honest shares spar's table
     alphas = sorted({a for a, _ in gaps})
     gammas = sorted({g for _, g in gaps})
     rows = withholding_rows(key, policies=[policy], alphas=alphas,
