@@ -263,31 +263,44 @@ def _vi_chunk(src, act, dst, prob, reward, progress, S, A, discount,
     return v, p, pol, deltas[-1]
 
 
+def run_chunk_driver(chunk_step, S, dtype, stop_delta, max_iter,
+                     chunk: int = 16):
+    """Shared host loop for device-while-free VI: call
+    `chunk_step(value, prog, steps) -> (value, prog, pol, delta)` in
+    full chunks with a chunk=1 tail (steps is a static argnum in both
+    impls, so an arbitrary tail size would compile a fresh program per
+    distinct max_iter % chunk; the 1-sweep program compiles once and
+    serves every tail), stopping when the last in-chunk delta drops
+    below stop_delta.  Used by both the single-device vi_chunked and
+    the shard_map'd cpr_tpu.parallel sharded solver."""
+    z = jnp.zeros(S, dtype)
+    value, prog = z, z
+    it = 0
+    delta = jnp.inf
+    pol = None
+    while it < max_iter:
+        step = chunk if max_iter - it >= chunk else 1
+        value, prog, pol, delta = chunk_step(value, prog, step)
+        it += step
+        if float(delta) <= float(stop_delta):
+            break
+    return value, prog, pol, delta, it
+
+
 def vi_chunked(src, act, dst, prob, reward, progress, S, A, discount,
                stop_delta, max_iter, chunk: int = 16):
     """Host-driven VI: repeat `_vi_chunk` until the last in-chunk delta
     drops below stop_delta (or max_iter sweeps ran).  Same fixpoint as
     vi_while_loop — extra post-convergence sweeps are no-ops on a
     converged value function."""
-    z = jnp.zeros(S, prob.dtype)
-    value, prog = z, z
     valid, any_valid = _vi_valid(src, act, prob, S, A)
-    it = 0
-    delta = jnp.inf
-    pol = None
-    while it < max_iter:
-        # full chunks, then a chunk=1 tail: `chunk` is a static argnum,
-        # so an arbitrary-size tail chunk would compile a fresh program
-        # per distinct max_iter % chunk; the 1-sweep program compiles
-        # once and serves every tail
-        step = chunk if max_iter - it >= chunk else 1
-        value, prog, pol, delta = _vi_chunk(
-            src, act, dst, prob, reward, progress, S, A, discount,
-            value, prog, valid, any_valid, step)
-        it += step
-        if float(delta) <= float(stop_delta):
-            break
-    return value, prog, pol, delta, it
+
+    def chunk_step(value, prog, steps):
+        return _vi_chunk(src, act, dst, prob, reward, progress, S, A,
+                         discount, value, prog, valid, any_valid, steps)
+
+    return run_chunk_driver(chunk_step, S, prob.dtype, stop_delta,
+                            max_iter, chunk)
 
 
 @partial(jax.jit, static_argnums=(6, 9))

@@ -22,6 +22,7 @@ slices; the mesh is the only seam.
 
 from __future__ import annotations
 
+import os
 import time
 
 import jax
@@ -29,7 +30,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from cpr_tpu.mdp.explicit import TensorMDP, vi_while_loop
+from cpr_tpu.mdp.explicit import (TensorMDP, _valid_actions, make_vi_sweep,
+                                  run_chunk_driver, vi_while_loop)
 
 __all__ = [
     "default_mesh",
@@ -65,7 +67,8 @@ def sharded_rollout(env, mesh: Mesh, keys, params, policy, n_steps: int,
 def sharded_value_iteration(tm: TensorMDP, mesh: Mesh, *, axis: str = "d",
                             max_iter: int = 0, discount: float = 1.0,
                             eps: float | None = None,
-                            stop_delta: float | None = None):
+                            stop_delta: float | None = None,
+                            impl: str | None = None, chunk: int = 16):
     """Value iteration with the transition table sharded over the mesh.
 
     Each device owns a contiguous transition chunk (padded with
@@ -76,11 +79,18 @@ def sharded_value_iteration(tm: TensorMDP, mesh: Mesh, *, axis: str = "d",
     cross-shard transitions described in SURVEY.md §2.8.
 
     Semantics identical to `TensorMDP.value_iteration` (same greedy
-    backup, same stop rule); returns the same dict.
+    backup, same stop rule); returns the same dict.  `impl` mirrors the
+    single-device option: "while" (default) or "chunked" (fixed-size
+    scan chunks + host-side convergence — the axon-TPU while_loop-fault
+    workaround, needed here too or the capstone's on-chip sharded solve
+    would hit the same fault); CPR_VI_IMPL sets the default.
     """
     stop_delta = tm.resolve_stop_delta(
         discount=discount, eps=eps, stop_delta=stop_delta, max_iter=max_iter)
     tm._check_segment_width()
+    impl = impl or os.environ.get("CPR_VI_IMPL", "while")
+    if impl not in ("while", "chunked"):
+        raise ValueError(f"unknown VI impl '{impl}'")
     t0 = time.time()
     n = mesh.shape[axis]
     S, A = tm.n_states, tm.n_actions
@@ -110,7 +120,47 @@ def sharded_value_iteration(tm: TensorMDP, mesh: Mesh, *, axis: str = "d",
             check_vma=False,
         )(*coo)
 
-    value, progress_v, policy, delta, it = run()
+    def run_chunked():
+        from functools import partial
+
+        @partial(jax.jit, static_argnums=(2,))
+        def chunk_fn(value, prog, steps):
+            def body(src, act, dst, prob, reward, progress, value, prog):
+                psum = lambda x: jax.lax.psum(x, axis)  # noqa: E731
+                sweep = make_vi_sweep(S, A, psum)
+                # valid masks recomputed per chunk call (one extra
+                # psum'd segment-sum per `chunk` sweeps, ~1/chunk
+                # overhead) — hoisting them across shard_map calls
+                # would need a second staged program for little gain
+                valid, any_valid = _valid_actions(src, act, prob, S, A,
+                                                  psum)
+
+                def step(carry, _):
+                    v, p, _ = carry
+                    v2, p2, pol = sweep(src, act, dst, prob, reward,
+                                        progress, valid, any_valid,
+                                        discount, v, p)
+                    return (v2, p2, pol), jnp.abs(v2 - v).max()
+
+                pol0 = jnp.full((S,), -1, jnp.int32)
+                (v, p, pol), deltas = jax.lax.scan(
+                    step, (value, prog, pol0), None, length=steps)
+                return v, p, pol, deltas[-1]
+
+            return jax.shard_map(
+                body, mesh=mesh,
+                in_specs=(P(axis),) * 6 + (P(), P()),
+                out_specs=(P(),) * 4,
+                check_vma=False,
+            )(*coo, value, prog)
+
+        return run_chunk_driver(chunk_fn, S, tm.prob.dtype, stop_delta,
+                                max_iter_, chunk)
+
+    if impl == "while":
+        value, progress_v, policy, delta, it = run()
+    else:
+        value, progress_v, policy, delta, it = run_chunked()
     return dict(
         vi_discount=discount,
         vi_delta=float(delta),

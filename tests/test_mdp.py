@@ -115,6 +115,15 @@ def test_sharded_vi_matches_single_device():
         sharded["vi_value"], single["vi_value"], rtol=1e-6, atol=1e-7
     )
     np.testing.assert_array_equal(sharded["vi_policy"], single["vi_policy"])
+    # the chunked (device-while-free) sharded impl reaches the same
+    # fixpoint — the on-chip capstone path when while_loop faults
+    chunked = sharded_value_iteration(tm, mesh, stop_delta=1e-6,
+                                      impl="chunked")
+    np.testing.assert_allclose(
+        chunked["vi_value"], single["vi_value"], rtol=1e-6, atol=1e-7
+    )
+    np.testing.assert_array_equal(chunked["vi_policy"],
+                                  single["vi_policy"])
 
 
 def test_vi_chunked_impl_matches_while():
