@@ -230,37 +230,58 @@ def _vi_loop(src, act, dst, prob, reward, progress, S, A, discount,
                          discount, stop_delta, max_iter)
 
 
+def resolve_vi_impl(impl: str | None) -> str:
+    """Shared impl selection for the single-device and sharded
+    solvers: explicit arg > CPR_VI_IMPL env > "while"."""
+    impl = impl or os.environ.get("CPR_VI_IMPL", "while")
+    if impl not in ("while", "chunked"):
+        raise ValueError(f"unknown VI impl '{impl}'")
+    return impl
+
+
 @partial(jax.jit, static_argnums=(3, 4))
 def _vi_valid(src, act, prob, S, A):
     return _valid_actions(src, act, prob, S, A)
 
 
-@partial(jax.jit, static_argnums=(6, 7, 13))
-def _vi_chunk(src, act, dst, prob, reward, progress, S, A, discount,
-              value, prog, valid, any_valid, chunk):
-    """`chunk` unconditional Bellman sweeps as one lax.scan — the
+def make_vi_chunk(S: int, A: int, reduce=lambda x: x):
+    """Build the `chunk` unconditional-Bellman-sweeps scan — the
     device-while-free VI step.  The axon TPU worker has faulted inside
     the while_loop VI at every size tried (round-2 finding); running
     fixed-size chunks with HOST-side convergence checks between calls
     removes the data-dependent device loop from the program entirely,
     at the cost of up to chunk-1 extra (idempotent-at-fixpoint) sweeps.
-    The loop-invariant valid-action masks come in precomputed
-    (_vi_valid) so per-chunk dispatches don't re-pay that segment_sum."""
-    sweep = make_vi_sweep(S, A)
+    `reduce` hooks the cross-device psum exactly like make_vi_sweep."""
+    sweep = make_vi_sweep(S, A, reduce)
 
-    # policy rides in the carry (only the final one matters); stacking
-    # it per sweep would materialize chunk x S ints on the memory-tight
-    # device this impl exists for
-    def body(carry, _):
-        value, prog, _ = carry
-        v2, p2, pol = sweep(src, act, dst, prob, reward, progress, valid,
-                            any_valid, discount, value, prog)
-        return (v2, p2, pol), jnp.abs(v2 - value).max()
+    def chunk_body(src, act, dst, prob, reward, progress, valid,
+                   any_valid, discount, value, prog, chunk):
+        # policy rides in the carry (only the final one matters);
+        # stacking it per sweep would materialize chunk x S ints on the
+        # memory-tight device this impl exists for
+        def body(carry, _):
+            value, prog, _ = carry
+            v2, p2, pol = sweep(src, act, dst, prob, reward, progress,
+                                valid, any_valid, discount, value, prog)
+            return (v2, p2, pol), jnp.abs(v2 - value).max()
 
-    pol0 = jnp.full((S,), -1, jnp.int32)
-    (v, p, pol), deltas = jax.lax.scan(
-        body, (value, prog, pol0), None, length=chunk)
-    return v, p, pol, deltas[-1]
+        pol0 = jnp.full((S,), -1, jnp.int32)
+        (v, p, pol), deltas = jax.lax.scan(
+            body, (value, prog, pol0), None, length=chunk)
+        return v, p, pol, deltas[-1]
+
+    return chunk_body
+
+
+@partial(jax.jit, static_argnums=(6, 7, 13))
+def _vi_chunk(src, act, dst, prob, reward, progress, S, A, discount,
+              value, prog, valid, any_valid, chunk):
+    """Jitted single-device chunk step; the loop-invariant valid-action
+    masks come in precomputed (_vi_valid) so per-chunk dispatches don't
+    re-pay that segment_sum."""
+    return make_vi_chunk(S, A)(src, act, dst, prob, reward, progress,
+                               valid, any_valid, discount, value, prog,
+                               chunk)
 
 
 def run_chunk_driver(chunk_step, S, dtype, stop_delta, max_iter,
@@ -447,9 +468,7 @@ class TensorMDP:
         stop_delta = self.resolve_stop_delta(
             discount=discount, eps=eps, stop_delta=stop_delta, max_iter=max_iter)
         self._check_segment_width()
-        impl = impl or os.environ.get("CPR_VI_IMPL", "while")
-        if impl not in ("while", "chunked"):
-            raise ValueError(f"unknown VI impl '{impl}'")
+        impl = resolve_vi_impl(impl)
         t0 = time.time()
         run = _vi_loop if impl == "while" else vi_chunked
         value, progress, policy, delta, it = run(

@@ -84,6 +84,10 @@ struct Protocol {
   virtual long on_chain(const Dag& d, int head) const = 0;
   // winner among node preferences (referee `winner`)
   virtual int winner(Sim& s, const std::vector<int>& prefs) = 0;
+  // protocols whose votes reference the block they confirm in
+  // `vote_id` opt into the Sim's confirmers index (bk overloads
+  // vote_id with the voter/signer id, so the index must stay off)
+  virtual bool votes_confirm_blocks() const { return false; }
 };
 
 // ------------------------------------------------------------ event loop
@@ -166,6 +170,10 @@ struct Sim {
 
   std::vector<std::vector<char>> visible;   // [node][block]
   std::vector<std::vector<char>> known;     // received but maybe buffered
+  // confirmers[b] = ids of votes with vote_id == b, append order —
+  // replaces O(|dag|) scans in the parallel family's confirming-vote
+  // lookups (kept empty unless proto->votes_confirm_blocks())
+  std::vector<std::vector<int>> confirmers;
   std::vector<int> preferred;               // per node
   std::priority_queue<Event> queue;
   long seq = 0;
@@ -291,11 +299,22 @@ struct Sim {
   void handle_honest(int node, int b);
   void handle_agent(int b, bool is_pow);
 
+  void index_confirmer(int id) {
+    const Block& b = dag.blocks[id];
+    if (!b.is_vote || !proto->votes_confirm_blocks()) return;
+    if (b.vote_id < 0 || b.vote_id >= id) return;
+    if ((int)confirmers.size() < (int)dag.blocks.size())
+      confirmers.resize(dag.blocks.size());
+    confirmers[b.vote_id].push_back(id);
+  }
+
   int append_pow(int miner, Block b) {
     b.miner = miner;
     b.pow_hash = rand_u();
     b.time = now;
-    return dag.add(std::move(b));
+    int id = dag.add(std::move(b));
+    index_confirmer(id);
+    return id;
   }
 
   // append-or-dedup for non-PoW proposals
@@ -311,6 +330,7 @@ struct Sim {
     int id = dag.add(std::move(b));
     record(0, miner, id);
     dedup[key] = id;
+    index_confirmer(id);
     return id;
   }
 
@@ -629,6 +649,8 @@ struct ParallelBase : Protocol {
   int k;
   explicit ParallelBase(int k_) : k(k_) {}
 
+  bool votes_confirm_blocks() const override { return true; }
+
   static int last_block(const Dag& d, int x) {
     while (d.blocks[x].is_vote) x = d.blocks[x].vote_id;
     return x;
@@ -641,12 +663,18 @@ struct ParallelBase : Protocol {
            s.dag.blocks[i].miner == 0;
   }
 
+  // ids of votes confirming b (append order) via the Sim's index
+  static const std::vector<int>& confirmer_ids(Sim& s, int b) {
+    static const std::vector<int> empty;
+    if (b < (int)s.confirmers.size()) return s.confirmers[b];
+    return empty;
+  }
+
   // visible votes confirming block/summary b, ascending id
   std::vector<int> confirming(Sim& s, int node, int b) const {
     std::vector<int> out;
-    for (int i = b + 1; i < (int)s.dag.blocks.size(); i++) {
-      if (s.dag.blocks[i].is_vote && s.dag.blocks[i].vote_id == b &&
-          s.is_visible(node, i) && vote_counts(s, node, i))
+    for (int i : confirmer_ids(s, b)) {
+      if (s.is_visible(node, i) && vote_counts(s, node, i))
         out.push_back(i);
     }
     return out;
@@ -654,9 +682,8 @@ struct ParallelBase : Protocol {
 
   int count_confirming(Sim& s, int node, int b) const {
     int n = 0;
-    for (int i = b + 1; i < (int)s.dag.blocks.size(); i++)
-      if (s.dag.blocks[i].is_vote && s.dag.blocks[i].vote_id == b &&
-          s.is_visible(node, i) && vote_counts(s, node, i))
+    for (int i : confirmer_ids(s, b))
+      if (s.is_visible(node, i) && vote_counts(s, node, i))
         n++;
     return n;
   }
@@ -687,10 +714,7 @@ struct ParallelBase : Protocol {
   int winner(Sim& s, const std::vector<int>& prefs) override {
     const Dag& d = s.dag;
     auto votes_all = [&](int b) {
-      int n = 0;
-      for (int i = b + 1; i < (int)d.blocks.size(); i++)
-        if (d.blocks[i].is_vote && d.blocks[i].vote_id == b) n++;
-      return n;
+      return (int)confirmer_ids(s, b).size();
     };
     int best = last_block(d, prefs[0]);
     for (int p : prefs) {
@@ -1349,11 +1373,9 @@ struct ParAgent final : Agent {
 
   // votes confirming `b` that pass `filt` (public ∪ released set)
   int filtered_votes(Sim& s, int b, const std::vector<char>& in_rel) {
-    const Dag& d = s.dag;
     int n = 0;
-    for (int i = b + 1; i < (int)d.blocks.size(); i++)
-      if (d.blocks[i].is_vote && d.blocks[i].vote_id == b &&
-          (is_public(s, i) || (i < (int)in_rel.size() && in_rel[i])))
+    for (int i : ParallelBase::confirmer_ids(s, b))
+      if (is_public(s, i) || (i < (int)in_rel.size() && in_rel[i]))
         n++;
     return n;
   }
@@ -1418,9 +1440,8 @@ struct ParAgent final : Agent {
     std::vector<char> none;
     int pub_v = filtered_votes(s, pub, none);
     int priv_vi = 0;
-    for (int i = priv + 1; i < (int)d.blocks.size(); i++)
-      if (d.blocks[i].is_vote && d.blocks[i].vote_id == priv &&
-          s.is_visible(0, i))
+    for (int i : ParallelBase::confirmer_ids(s, priv))
+      if (s.is_visible(0, i))
         priv_vi++;
 
     enum { ADOPT, OVERRIDE, MATCH, WAIT };

@@ -22,7 +22,6 @@ slices; the mesh is the only seam.
 
 from __future__ import annotations
 
-import os
 import time
 
 import jax
@@ -30,7 +29,10 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from cpr_tpu.mdp.explicit import (TensorMDP, _valid_actions, make_vi_sweep,
+from functools import partial
+
+from cpr_tpu.mdp.explicit import (TensorMDP, _valid_actions,
+                                  make_vi_chunk, resolve_vi_impl,
                                   run_chunk_driver, vi_while_loop)
 
 __all__ = [
@@ -88,9 +90,7 @@ def sharded_value_iteration(tm: TensorMDP, mesh: Mesh, *, axis: str = "d",
     stop_delta = tm.resolve_stop_delta(
         discount=discount, eps=eps, stop_delta=stop_delta, max_iter=max_iter)
     tm._check_segment_width()
-    impl = impl or os.environ.get("CPR_VI_IMPL", "while")
-    if impl not in ("while", "chunked"):
-        raise ValueError(f"unknown VI impl '{impl}'")
+    impl = resolve_vi_impl(impl)
     t0 = time.time()
     n = mesh.shape[axis]
     S, A = tm.n_states, tm.n_actions
@@ -121,31 +121,19 @@ def sharded_value_iteration(tm: TensorMDP, mesh: Mesh, *, axis: str = "d",
         )(*coo)
 
     def run_chunked():
-        from functools import partial
-
         @partial(jax.jit, static_argnums=(2,))
         def chunk_fn(value, prog, steps):
             def body(src, act, dst, prob, reward, progress, value, prog):
                 psum = lambda x: jax.lax.psum(x, axis)  # noqa: E731
-                sweep = make_vi_sweep(S, A, psum)
                 # valid masks recomputed per chunk call (one extra
                 # psum'd segment-sum per `chunk` sweeps, ~1/chunk
                 # overhead) — hoisting them across shard_map calls
                 # would need a second staged program for little gain
                 valid, any_valid = _valid_actions(src, act, prob, S, A,
                                                   psum)
-
-                def step(carry, _):
-                    v, p, _ = carry
-                    v2, p2, pol = sweep(src, act, dst, prob, reward,
-                                        progress, valid, any_valid,
-                                        discount, v, p)
-                    return (v2, p2, pol), jnp.abs(v2 - v).max()
-
-                pol0 = jnp.full((S,), -1, jnp.int32)
-                (v, p, pol), deltas = jax.lax.scan(
-                    step, (value, prog, pol0), None, length=steps)
-                return v, p, pol, deltas[-1]
+                return make_vi_chunk(S, A, psum)(
+                    src, act, dst, prob, reward, progress, valid,
+                    any_valid, discount, value, prog, steps)
 
             return jax.shard_map(
                 body, mesh=mesh,
