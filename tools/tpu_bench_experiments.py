@@ -24,14 +24,18 @@ def main():
 
     n_envs = int(sys.argv[1]) if len(sys.argv) > 1 else 131072
     # prng impl only affects trace-time key types; each run builds a
-    # fresh trace, so one process can sweep both
-    for prng in ("threefry2x32", "rbg"):
+    # fresh trace, so one process can sweep all configs.  partitionable
+    # threefry skips the global-layout key broadcast, which matters for
+    # vmap'd per-env key splitting
+    for prng, part in (("threefry2x32", False), ("threefry2x32", True),
+                       ("rbg", False)):
         jax.config.update("jax_default_prng_impl", prng)
+        jax.config.update("jax_threefry_partitionable", part)
         steps_per_sec, rel = measure_nakamoto(n_envs)
         ok = SM1_GUARD[0] < rel < SM1_GUARD[1]
-        print(f"prng={prng} n_envs={n_envs}: {steps_per_sec / 1e6:.0f}M "
-              f"steps/s (SM1 rel {rel:.4f} guard {'ok' if ok else 'FAIL'})",
-              flush=True)
+        print(f"prng={prng} partitionable={part} n_envs={n_envs}: "
+              f"{steps_per_sec / 1e6:.0f}M steps/s (SM1 rel {rel:.4f} "
+              f"guard {'ok' if ok else 'FAIL'})", flush=True)
 
 
 if __name__ == "__main__":
