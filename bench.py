@@ -114,12 +114,42 @@ def measure_tailstorm_ppo(n_envs: int, rollout_len: int = 128,
 SM1_GUARD = (0.38, 0.45)
 
 
+_PRNG_IMPLS = ("threefry2x32", "rbg")
+
+
+def _prng_choice() -> str:
+    """Validated CPR_BENCH_PRNG value (rbg|threefry2x32[:partitionable])
+    or the default.  Raises early — main() checks this BEFORE spawning
+    watchdogged TPU attempts, so a typo fails fast instead of burning
+    the whole watchdog budget (or silently measuring the wrong PRNG)."""
+    choice = os.environ.get("CPR_BENCH_PRNG", "threefry2x32")
+    impl, _, part = choice.partition(":")
+    if impl not in _PRNG_IMPLS or part not in ("", "partitionable"):
+        raise SystemExit(
+            f"bench: bad CPR_BENCH_PRNG '{choice}' "
+            f"(want rbg|threefry2x32[:partitionable])")
+    return choice
+
+
+def _apply_prng_choice():
+    """Apply the validated PRNG choice — the knob
+    tools/tpu_bench_experiments.py sweeps, so a measured winner folds
+    in without code changes."""
+    import jax
+
+    impl, _, part = _prng_choice().partition(":")
+    jax.config.update("jax_default_prng_impl", impl)
+    if part == "partitionable":
+        jax.config.update("jax_threefry_partitionable", True)
+
+
 def run_bench(platform_hint: str):
     """Measure and print the JSON line on whatever backend comes up."""
     import jax
 
     if platform_hint == "cpu":
         jax.config.update("jax_platforms", "cpu")
+    _apply_prng_choice()
     devs = jax.devices()
     platform = devs[0].platform
     print(f"bench: backend={platform} devices={len(devs)}",
@@ -139,6 +169,7 @@ def run_bench(platform_hint: str):
         "unit": "env-steps/sec/chip",
         "vs_baseline": round(steps_per_sec / 10_000_000, 3),
         "backend": platform,
+        "prng": _prng_choice(),
     }))
 
 
@@ -168,6 +199,7 @@ def run_configs(platform_hint: str):
 
     if platform_hint == "cpu":
         jax.config.update("jax_platforms", "cpu")
+    _apply_prng_choice()
     platform = jax.devices()[0].platform
     print(f"bench-configs: backend={platform}", file=sys.stderr)
     out = []
@@ -184,6 +216,7 @@ def run_configs(platform_hint: str):
             "unit": "env-steps/sec/chip",
             "check": round(check, 4),
             "backend": platform,
+            "prng": _prng_choice(),
             **{f"cfg_{k}": v for k, v in kw.items()},
         }
         print(json.dumps(row))
@@ -221,6 +254,7 @@ def _attempt(timeout: float, mode: str = "--direct"):
 
 
 def main():
+    _prng_choice()  # fail fast on a bad override, before any attempts
     configs_mode = "--configs" in sys.argv
     if "--direct" in sys.argv:
         # child mode: let the default (TPU-preferring) backend come up;
