@@ -14,7 +14,6 @@ from __future__ import annotations
 import time
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 
 from cpr_tpu.envs.registry import get_sized
