@@ -113,6 +113,10 @@ def measure_tailstorm_ppo(n_envs: int, rollout_len: int = 128,
 # (alpha=.35, gamma=.5 -> 0.416)
 SM1_GUARD = (0.38, 0.45)
 
+# child exit code distinguishing a correctness-guard assertion from a
+# device fault / infrastructure failure (any other nonzero rc)
+GUARD_RC = 3
+
 
 _PRNG_IMPLS = ("threefry2x32", "rbg")
 
@@ -178,23 +182,57 @@ def run_bench(platform_hint: str):
 # follow BASELINE.json; CPU fallbacks shrink so the watchdog always gets
 # a tagged number.
 CONFIGS = {
+    # dict order is the measurement order for BOTH paths; ethereum runs
+    # LAST because its 65k-env kernel is the one observed to fault the
+    # TPU device (round-3 session log)
     "bk8_withholding": dict(
         fn="measure_bk", tpu=dict(n_envs=4096), cpu=dict(n_envs=128),
         guard=(0.05, 0.6), guard_name="get-ahead revenue share"),
-    "ethereum_uncle_attack": dict(
-        fn="measure_ethereum", tpu=dict(n_envs=65536),
-        cpu=dict(n_envs=256), guard=(0.33, 0.55),
-        guard_name="fn19 revenue share"),
     "tailstorm_ppo_train": dict(
         fn="measure_tailstorm_ppo", tpu=dict(n_envs=4096),
         cpu=dict(n_envs=64), guard=(0.0, 2.1),
         guard_name="policy entropy (2 actions + quorum head)"),
+    "ethereum_uncle_attack": dict(
+        fn="measure_ethereum", tpu=dict(n_envs=65536),
+        cpu=dict(n_envs=256), guard=(0.33, 0.55),
+        guard_name="fn19 revenue share"),
 }
 
 
+def _measure_config(name: str, platform: str, n_envs_override=None):
+    """Measure one config on the current backend and return its JSON row
+    (guard-checked)."""
+    spec = CONFIGS[name]
+    kw = dict(spec["cpu"] if platform == "cpu" else spec["tpu"])
+    if n_envs_override is not None:
+        kw["n_envs"] = int(n_envs_override)
+    rate, check = globals()[spec["fn"]](**kw)
+    rate, check = float(rate), float(check)
+    lo, hi = spec["guard"]
+    assert lo < check < hi, \
+        f"{name}: {spec['guard_name']} {check} outside ({lo}, {hi})"
+    return {
+        "metric": f"{name}_env_steps_per_sec_per_chip",
+        "value": round(rate),
+        "unit": "env-steps/sec/chip",
+        "check": round(check, 4),
+        "backend": platform,
+        "prng": _prng_choice(),
+        **{f"cfg_{k}": v for k, v in kw.items()},
+    }
+
+
+def _write_configs_json(rows):
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_CONFIGS.json")
+    with open(path, "w") as f:
+        json.dump(rows, f, indent=2)
+
+
 def run_configs(platform_hint: str):
-    """Measure configs 2-4, print one JSON line each, and write
-    BENCH_CONFIGS.json next to this file."""
+    """Measure configs 2-4 in-process (the CPR_BENCH_BACKEND=cpu path),
+    print one JSON line each, and write BENCH_CONFIGS.json next to this
+    file."""
     import jax
 
     if platform_hint == "cpu":
@@ -203,38 +241,147 @@ def run_configs(platform_hint: str):
     platform = jax.devices()[0].platform
     print(f"bench-configs: backend={platform}", file=sys.stderr)
     out = []
-    for name, spec in CONFIGS.items():
-        kw = spec["cpu"] if platform == "cpu" else spec["tpu"]
-        rate, check = globals()[spec["fn"]](**kw)
-        rate, check = float(rate), float(check)
-        lo, hi = spec["guard"]
-        assert lo < check < hi, \
-            f"{name}: {spec['guard_name']} {check} outside ({lo}, {hi})"
-        row = {
-            "metric": f"{name}_env_steps_per_sec_per_chip",
-            "value": round(rate),
-            "unit": "env-steps/sec/chip",
-            "check": round(check, 4),
-            "backend": platform,
-            "prng": _prng_choice(),
-            **{f"cfg_{k}": v for k, v in kw.items()},
-        }
+    for name in CONFIGS:
+        row = _measure_config(name, platform)
         print(json.dumps(row))
         out.append(row)
-    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                        "BENCH_CONFIGS.json")
-    with open(path, "w") as f:
-        json.dump(out, f, indent=2)
+    _write_configs_json(out)
 
 
-def _attempt(timeout: float, mode: str = "--direct"):
+def run_one(name: str):
+    """Child mode: measure a single config on the default backend.
+    Isolation matters: a device fault in one config's kernel must not
+    cost the other configs their numbers (round-3 lesson — the 65k-env
+    ethereum kernel faulted the TPU and took bk's result down with it).
+    CPU is forced via jax.config, not JAX_PLATFORMS: the axon PJRT
+    plugin claims the chip regardless of that env var (observed)."""
+    import jax
+
+    if os.environ.get("CPR_BENCH_BACKEND") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    _apply_prng_choice()
+    platform = jax.devices()[0].platform
+    print(f"bench-one: {name} backend={platform}", file=sys.stderr)
+    override = os.environ.get("CPR_BENCH_NENVS")
+    # the override is a TPU ladder size — never apply it to a CPU
+    # backend (a chip-claim race would otherwise measure CPU at TPU
+    # batch sizes and burn the watchdog)
+    if platform == "cpu":
+        override = None
+    try:
+        row = _measure_config(name, platform,
+                              int(override) if override else None)
+    except AssertionError as e:
+        # distinct rc so the parent can tell a deterministic
+        # correctness-guard failure from a device fault (no retry, no
+        # descent, no CPU masking)
+        print(f"bench-one: guard failed: {e}", file=sys.stderr)
+        sys.exit(GUARD_RC)
+    print(json.dumps(row))
+
+
+# Extra descent rungs below the BASELINE-prescribed size (the first
+# rung always comes from CONFIGS[name]["tpu"]["n_envs"]): on a device
+# FAULT the runner steps down so a size-dependent failure (memory
+# pressure) still yields an on-chip number at a recorded smaller batch.
+CONFIG_DESCENT = {
+    "ethereum_uncle_attack": (16384, 4096),
+}
+
+
+def run_configs_isolated(timeout: float):
+    """Parent mode for configs 2-4 on TPU: one watchdogged subprocess
+    per config x ladder rung, CPU fallback per config, all rows written
+    to BENCH_CONFIGS.json with their own backend tags."""
+    out = []
+    wedged = False  # one hang means a wedged device: stop probing it
+    for name, spec in CONFIGS.items():
+        ladder = (spec["tpu"]["n_envs"],) + CONFIG_DESCENT.get(name, ())
+        row, cpu_row, last = None, None, "no attempt"
+        guard_failed, stop = False, wedged
+        if wedged:
+            last = "device wedged by an earlier config"
+        for n_envs in () if stop else ladder:
+            for retry in range(2):
+                status, payload = _attempt(
+                    timeout, "--direct-one", extra=[name],
+                    env_extra={"CPR_BENCH_NENVS": str(n_envs)})
+                if status == "ok":
+                    cand = json.loads(payload.splitlines()[-1])
+                    if cand.get("backend") == "cpu":
+                        # chip-claim race: the child came up on CPU.
+                        # Not a ladder success, but it IS a valid CPU
+                        # fallback row — keep it, stop probing.
+                        last, cpu_row = "backend came up cpu", cand
+                        stop = True
+                        break
+                    row = cand
+                    break
+                if status == "failed" and payload == GUARD_RC:
+                    # deterministic correctness failure: no retry, no
+                    # descent, and no CPU run to paper over it —
+                    # surface the error row (size is what we REQUESTED;
+                    # the child's stderr names what actually ran)
+                    last = ("correctness guard failed "
+                            f"(requested n_envs={n_envs})")
+                    guard_failed = stop = True
+                    break
+                last = (f"rc={payload}" if status == "failed"
+                        else "hung past watchdog")
+                print(f"bench: {name} n_envs={n_envs} {last}",
+                      file=sys.stderr)
+                if status == "hung":
+                    # wedged device: straight to CPU (main()'s
+                    # policy), for this and all remaining configs
+                    wedged = stop = True
+                    break
+                if n_envs != ladder[-1]:
+                    # a clean failure may be a device fault: when
+                    # descent rungs remain, step down instead of
+                    # re-running the possibly-faulting size (a second
+                    # fault can wedge the chip and kill the ladder)
+                    break
+                if retry == 0:
+                    time.sleep(15.0)  # transient chip claim may clear
+            if row is not None or stop:
+                break
+        if row is None and cpu_row is None and not guard_failed:
+            status, payload = _attempt(
+                timeout, "--direct-one", extra=[name],
+                env_extra={"CPR_BENCH_BACKEND": "cpu"})
+            if status == "ok":
+                cpu_row = json.loads(payload.splitlines()[-1])
+            elif status == "failed" and payload == GUARD_RC:
+                guard_failed = True
+                last = f"{last}; then correctness guard failed on cpu"
+            else:
+                last = (f"{last}; then cpu fallback "
+                        + (f"rc={payload}" if status == "failed"
+                           else "hung past watchdog"))
+        if row is None:
+            if cpu_row is not None:
+                row = dict(cpu_row,
+                           note=f"tpu attempts unsuccessful ({last}); "
+                                f"cpu fallback")
+            else:
+                row = {"metric": f"{name}_env_steps_per_sec_per_chip",
+                       "error": f"attempts failed (last: {last})"}
+        print(json.dumps(row))
+        out.append(row)
+    _write_configs_json(out)
+
+
+def _attempt(timeout: float, mode: str = "--direct", extra=None,
+             env_extra=None):
     """One watchdog-bounded child run.  Returns ("ok", json_lines),
     ("failed", rc), or ("hung", None).  Manual Popen because
     subprocess.run's post-kill wait() is untimed — a child stuck in
     uninterruptible device I/O would hang the parent forever."""
+    env = dict(os.environ, **(env_extra or {}))
     proc = subprocess.Popen(
-        [sys.executable, os.path.abspath(__file__), mode],
-        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+        [sys.executable, os.path.abspath(__file__), mode] + (extra or []),
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env=env)
     try:
         out, err = proc.communicate(timeout=timeout)
     except subprocess.TimeoutExpired:
@@ -263,8 +410,8 @@ def main():
         # watchdog timeout)
         run_bench("default")
         return
-    if "--direct-configs" in sys.argv:
-        run_configs("default")
+    if "--direct-one" in sys.argv:
+        run_one(sys.argv[sys.argv.index("--direct-one") + 1])
         return
     if os.environ.get("CPR_BENCH_BACKEND") == "cpu":
         run_configs("cpu") if configs_mode else run_bench("cpu")
@@ -274,11 +421,13 @@ def main():
     # (e.g. transiently claimed chip) gets one paused retry, a hang
     # (wedged device) goes straight to CPU
     timeout = float(os.environ.get("CPR_BENCH_TPU_TIMEOUT", "360"))
-    mode = "--direct-configs" if configs_mode else "--direct"
     if configs_mode:
-        timeout *= 2  # three compiles instead of one
+        # per-config isolated children (one compile each -> the base
+        # timeout per config is enough)
+        run_configs_isolated(timeout)
+        return
     for attempt in range(2):
-        status, payload = _attempt(timeout, mode)
+        status, payload = _attempt(timeout, "--direct")
         if status == "ok":
             print(payload)
             return
@@ -293,7 +442,7 @@ def main():
     else:
         print("bench: TPU attempts failed, falling back to CPU",
               file=sys.stderr)
-    run_configs("cpu") if configs_mode else run_bench("cpu")
+    run_bench("cpu")  # configs mode returned above
 
 
 if __name__ == "__main__":

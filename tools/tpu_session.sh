@@ -9,7 +9,7 @@
 # pattern / bisect per-candidate children).  Artifacts are written via
 # temp files and only promoted on success with a tpu backend tag, so a
 # failed or CPU-fallback run never clobbers banked evidence.
-set -u
+set -u -o pipefail
 cd "$(dirname "$0")/.."
 log=tools/tpu_session.log
 echo "=== tpu session $(date +%F_%T) ===" | tee -a "$log"
@@ -28,8 +28,12 @@ else
 fi
 
 echo "--- 2. configs 2-4" | tee -a "$log"
+# bank only if EVERY row is on-chip (rows now carry per-config backend
+# tags, so a single tpu row must not bank a partially-CPU artifact);
+# drop any stale artifact first so a crashed run can't re-bank it
+rm -f BENCH_CONFIGS.json
 if python bench.py --configs 2>>"$log" | tee -a "$log" \
-   && grep -q '"backend": "\(tpu\|axon\)"' BENCH_CONFIGS.json; then
+   && python -c 'import json,sys; rows=json.load(open("BENCH_CONFIGS.json")); sys.exit(0 if rows and all(r.get("backend") in ("tpu","axon") for r in rows) else 1)'; then
   cp -f BENCH_CONFIGS.json BENCH_CONFIGS_tpu_r03.json
   echo "banked BENCH_CONFIGS_tpu_r03.json" | tee -a "$log"
 else
