@@ -126,9 +126,15 @@ def _prng_choice() -> str:
     or the default.  Raises early — main() checks this BEFORE spawning
     watchdogged TPU attempts, so a typo fails fast instead of burning
     the whole watchdog budget (or silently measuring the wrong PRNG)."""
-    choice = os.environ.get("CPR_BENCH_PRNG", "threefry2x32")
+    # default = the measured winner of the on-chip PRNG sweep
+    # (tools/tpu_bench_experiments.py, 2026-07-31: threefry 304M,
+    # threefry:partitionable 313M, rbg 311M steps/s at 131072 envs)
+    choice = os.environ.get("CPR_BENCH_PRNG", "threefry2x32:partitionable")
     impl, _, part = choice.partition(":")
-    if impl not in _PRNG_IMPLS or part not in ("", "partitionable"):
+    if impl not in _PRNG_IMPLS or part not in ("", "partitionable") \
+            or (part and impl != "threefry2x32"):
+        # :partitionable is a threefry-only knob — accepting it on rbg
+        # would tag rows with a configuration that changed nothing
         raise SystemExit(
             f"bench: bad CPR_BENCH_PRNG '{choice}' "
             f"(want rbg|threefry2x32[:partitionable])")

@@ -1,0 +1,53 @@
+"""Shared harness for the on-chip fault-bisection tools.
+
+Each candidate snippet runs in its own watchdog-bounded subprocess (the
+bench.py pattern): a crashed worker can wedge backend init for the NEXT
+process, so the parent classifies crash-rc, crash-signature stderr, and
+init-hang separately and stops at the first CRASH/HANG to avoid
+hammering a wedged chip.
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+PRE = "import jax, jax.numpy as jnp\n"
+
+# stderr substrings that mean the device itself crashed (vs a python rc)
+CRASH_SIGNATURES = ("crashed or restarted", "UNAVAILABLE")
+
+
+def run_one(name, code, timeout=300.0):
+    proc = subprocess.Popen(
+        [sys.executable, "-u", "-c", PRE + code], cwd=REPO,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+    t0 = time.time()
+    try:
+        out, err = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        try:
+            proc.communicate(timeout=20)
+        except subprocess.TimeoutExpired:
+            pass
+        return name, "HANG", time.time() - t0, ""
+    status = "ok" if proc.returncode == 0 else f"rc={proc.returncode}"
+    tail = (err.strip().splitlines() or [""])[-1]
+    if any(sig in err for sig in CRASH_SIGNATURES):
+        status = "CRASH"
+    return name, status, time.time() - t0, tail if status != "ok" else out.strip()
+
+
+def run_candidates(candidates, limit=None, timeout=300.0):
+    """Run candidates in order, printing one status line each; stop at
+    the first CRASH/HANG (wedged-chip discipline)."""
+    for name, code in candidates[:limit]:
+        name, status, dt, info = run_one(name, code, timeout=timeout)
+        print(f"{name:24s} {status:8s} {dt:6.1f}s  {info[:100]}", flush=True)
+        if status in ("CRASH", "HANG"):
+            print("stopping: chip likely wedged; wait before re-running",
+                  flush=True)
+            break

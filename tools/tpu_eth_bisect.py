@@ -1,0 +1,106 @@
+"""Bisect the axon-TPU ethereum-env kernel fault, one candidate per child.
+
+The round-3 chip session showed `EthereumSSZ.episode_stats` faulting the
+TPU device at EVERY batch size (65536/16384/4096 envs) while the bk and
+tailstorm DAG-tensor envs ran fine — so the fault is a construct the
+ethereum env uses and they don't, not memory pressure.  Candidates walk
+up the ethereum step: reset, chain_window (the unrolled uncle-window
+ancestor walk), uncle selection, a single step, then scans of growing
+size, with a bk scan as the known-good control.
+
+Same harness discipline as tools/tpu_vi_bisect.py: each candidate runs
+in a watchdog-bounded subprocess; stop at the first CRASH/HANG so a
+wedged chip isn't hammered.
+
+Usage: python tools/tpu_eth_bisect.py [max_candidates]
+"""
+
+import sys
+
+# run as a script from anywhere: the tools dir is sys.path[0] only for
+# direct execution, so resolve it explicitly
+sys.path.insert(0, __import__("os").path.dirname(__import__("os").path.abspath(__file__)))
+from bisect_common import run_candidates  # noqa: E402
+
+ENV = """
+from cpr_tpu.envs.ethereum import EthereumSSZ
+from cpr_tpu.params import make_params
+env = EthereumSSZ("byzantium", max_steps_hint=64)
+params = make_params(alpha=0.35, gamma=0.5, max_steps=56)
+key = jax.random.PRNGKey(0)
+"""
+
+CANDIDATES = [
+    ("baseline_sum", "print(int(jnp.arange(8).sum()))"),
+    ("eth_reset", ENV + """
+state, obs = jax.jit(env.reset)(key, params)
+print(float(jnp.asarray(obs).sum()))"""),
+    ("eth_reset_vmap", ENV + """
+keys = jax.random.split(key, 256)
+state, obs = jax.jit(jax.vmap(lambda k: env.reset(k, params)))(keys)
+print(float(jnp.asarray(obs).sum()))"""),
+    ("eth_chain_window", ENV + """
+state, _ = jax.jit(env.reset)(key, params)
+nua, in_chain = jax.jit(env.chain_window)(state.dag, state.public)
+print(int(nua.sum()), int(in_chain.sum()))"""),
+    ("eth_uncle_select", ENV + """
+state, _ = jax.jit(env.reset)(key, params)
+def f(dag, head):
+    cand = env.uncle_candidates(dag, head, dag.exists(), dag.exists())
+    return env.select_uncles(dag, cand, dag.miner == 0)
+idx, valid = jax.jit(f)(state.dag, state.public)
+print(idx.tolist(), valid.tolist())"""),
+    ("eth_single_step", ENV + """
+state, obs = jax.jit(env.reset)(key, params)
+step = jax.jit(env.step)
+state, obs, r, d, info = step(state, jnp.int32(0), params)
+print(float(r), bool(d))"""),
+    ("eth_32steps_nojit_scan", ENV + """
+# 32 python-loop steps through the jitted single-step kernel: same math
+# as the scan, no lax.scan around it
+state, obs = jax.jit(env.reset)(key, params)
+step = jax.jit(env.step)
+for i in range(32):
+    state, obs, r, d, info = step(state, jnp.int32(i % env.n_actions), params)
+print(float(jnp.asarray(r)))"""),
+    ("eth_scan_1env", ENV + """
+pol = env.policies["fn19"]
+stats = env.episode_stats(key, params, pol, 64)
+print(float(stats["episode_progress"]))"""),
+    ("eth_scan_64env", ENV + """
+pol = env.policies["fn19"]
+keys = jax.random.split(key, 64)
+f = jax.jit(jax.vmap(lambda k: env.episode_stats(k, params, pol, 64)))
+stats = jax.block_until_ready(f(keys))
+print(float(stats["episode_progress"].mean()))"""),
+    ("eth_scan_honest", ENV + """
+# same scan, honest policy: separates "fn19 policy path" from the scan
+pol = env.policies["honest"]
+keys = jax.random.split(key, 64)
+f = jax.jit(jax.vmap(lambda k: env.episode_stats(k, params, pol, 64)))
+stats = jax.block_until_ready(f(keys))
+print(float(stats["episode_progress"].mean()))"""),
+    ("eth_scan_4096_full", ENV + """
+# the failing bench shape (smallest rung): 4096 envs, 256-step hint
+env = EthereumSSZ("byzantium", max_steps_hint=256)
+params = make_params(alpha=0.35, gamma=0.5, max_steps=248)
+pol = env.policies["fn19"]
+keys = jax.random.split(key, 4096)
+f = jax.jit(jax.vmap(lambda k: env.episode_stats(k, params, pol, 256)))
+stats = jax.block_until_ready(f(keys))
+print(float(stats["episode_progress"].mean()))"""),
+    ("bk_scan_64env_control", """
+from cpr_tpu.envs.bk import BkSSZ
+from cpr_tpu.params import make_params
+env = BkSSZ(k=8, incentive_scheme="constant", max_steps_hint=64)
+params = make_params(alpha=0.35, gamma=0.5, max_steps=56)
+pol = env.policies["get-ahead"]
+keys = jax.random.split(jax.random.PRNGKey(0), 64)
+f = jax.jit(jax.vmap(lambda k: env.episode_stats(k, params, pol, 64)))
+stats = jax.block_until_ready(f(keys))
+print(float(stats["episode_progress"].mean()))"""),
+]
+
+if __name__ == "__main__":
+    limit = int(sys.argv[1]) if len(sys.argv) > 1 else None
+    run_candidates(CANDIDATES, limit)

@@ -1,0 +1,67 @@
+"""Stage-2 ethereum-fault bisect: shape grid + construct stubs.
+
+Stage 1 (tools/tpu_eth_bisect.py) showed every construct passes at
+64 envs / capacity 72, and the crash needs the full bench shape
+(4096 envs, max_steps_hint=256 -> capacity 264, 256-step scan).  Stage 2
+separates the axes: env count, DAG capacity, scan length, policy, and —
+at the crashing shape — stubs chain_window / uncle selection to find
+which kernel actually faults.
+
+Usage: python tools/tpu_eth_bisect2.py [max_candidates]
+"""
+
+import sys
+
+# run as a script from anywhere: the tools dir is sys.path[0] only for
+# direct execution, so resolve it explicitly
+sys.path.insert(0, __import__("os").path.dirname(__import__("os").path.abspath(__file__)))
+from bisect_common import run_candidates  # noqa: E402
+
+
+def scan(n_envs, hint, n_steps, policy="fn19", stub=""):
+    return f"""
+from cpr_tpu.envs.ethereum import EthereumSSZ
+from cpr_tpu.params import make_params
+env = EthereumSSZ("byzantium", max_steps_hint={hint})
+params = make_params(alpha=0.35, gamma=0.5, max_steps={hint} - 8)
+{stub}
+pol = env.policies["{policy}"]
+keys = jax.random.split(jax.random.PRNGKey(0), {n_envs})
+f = jax.jit(jax.vmap(lambda k: env.episode_stats(k, params, pol, {n_steps})))
+stats = jax.block_until_ready(f(keys))
+print(float(stats["episode_progress"].mean()))"""
+
+
+STUB_WINDOW = """
+_B = env.capacity
+def _stub_window(dag, head):
+    z = jnp.zeros((_B,), jnp.bool_)
+    return z, z.at[jnp.maximum(head, 0)].set(head >= 0)
+env.chain_window = _stub_window"""
+
+STUB_SELECT = """
+def _stub_select(dag, cand_mask, own_mask):
+    idx = jnp.zeros((env.max_uncles,), jnp.int32)
+    return idx, jnp.zeros((env.max_uncles,), jnp.bool_)
+env.select_uncles = _stub_select"""
+
+CANDIDATES = [
+    # axis: env count at small capacity
+    ("envs4096_hint64", scan(4096, 64, 64)),
+    # axis: capacity at small env count
+    ("envs256_hint256", scan(256, 256, 256)),
+    # axis: middle ground
+    ("envs1024_hint256", scan(1024, 256, 256)),
+    ("envs4096_hint128", scan(4096, 128, 128)),
+    # the crashing shape, honest policy (is it the fn19 path?)
+    ("crash_shape_honest", scan(4096, 256, 256, policy="honest")),
+    # the crashing shape with ethereum-specific kernels stubbed
+    ("crash_shape_stub_window", scan(4096, 256, 256, stub=STUB_WINDOW)),
+    ("crash_shape_stub_select", scan(4096, 256, 256, stub=STUB_SELECT)),
+    # control: the known-crashing shape, unmodified (run LAST)
+    ("crash_shape_control", scan(4096, 256, 256)),
+]
+
+if __name__ == "__main__":
+    limit = int(sys.argv[1]) if len(sys.argv) > 1 else None
+    run_candidates(CANDIDATES, limit)

@@ -8,13 +8,12 @@ healthy; stop at the first crash to avoid wedging it repeatedly.
 Usage: python tools/tpu_vi_bisect.py [max_candidates]
 """
 
-import os
-import subprocess
 import sys
-import time
 
-HERE = os.path.dirname(os.path.abspath(__file__))
-REPO = os.path.dirname(HERE)
+# run as a script from anywhere: the tools dir is sys.path[0] only for
+# direct execution, so resolve it explicitly
+sys.path.insert(0, __import__("os").path.dirname(__import__("os").path.abspath(__file__)))
+from bisect_common import run_candidates  # noqa: E402
 
 CANDIDATES = [
     ("baseline_sum", "print(int(jnp.arange(8).sum()))"),
@@ -114,40 +113,6 @@ vi = tm.value_iteration(stop_delta=1e-5, impl="chunked")
 print(int(vi["vi_iter"]))"""),
 ]
 
-PRE = "import jax, jax.numpy as jnp\n"
-
-
-def run_one(name, code, timeout=240.0):
-    proc = subprocess.Popen(
-        [sys.executable, "-u", "-c", PRE + code], cwd=REPO,
-        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
-    t0 = time.time()
-    try:
-        out, err = proc.communicate(timeout=timeout)
-    except subprocess.TimeoutExpired:
-        proc.kill()
-        try:
-            proc.communicate(timeout=20)
-        except subprocess.TimeoutExpired:
-            pass
-        return name, "HANG", time.time() - t0, ""
-    status = "ok" if proc.returncode == 0 else f"rc={proc.returncode}"
-    tail = (err.strip().splitlines() or [""])[-1]
-    if "crashed or restarted" in err or "UNAVAILABLE" in err:
-        status = "CRASH"
-    return name, status, time.time() - t0, tail if status != "ok" else out.strip()
-
-
-def main():
-    limit = int(sys.argv[1]) if len(sys.argv) > 1 else len(CANDIDATES)
-    for name, code in CANDIDATES[:limit]:
-        name, status, dt, info = run_one(name, code)
-        print(f"{name:20s} {status:8s} {dt:6.1f}s  {info[:100]}", flush=True)
-        if status in ("CRASH", "HANG"):
-            print("stopping: chip likely wedged; wait before re-running",
-                  flush=True)
-            break
-
-
 if __name__ == "__main__":
-    main()
+    limit = int(sys.argv[1]) if len(sys.argv) > 1 else None
+    run_candidates(CANDIDATES, limit, timeout=240.0)
