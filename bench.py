@@ -22,13 +22,15 @@ import time
 
 
 def _measure_episodes(env, policy_name: str, n_envs: int, n_steps: int,
-                      reps: int, max_steps: int):
+                      reps: int, max_steps: int, chunk: int | None = None):
     """Shared episode-batch harness: warm one compile, time `reps`
     batched episode_stats kernels, return (env-steps/sec, attacker
     relative revenue).  Every episode config below measures through
     this one definition — also shared with the perf-experiment tooling
     (tools/tpu_bench_experiments.py), so sweeps there measure exactly
-    what the bench reports."""
+    what the bench reports.  `chunk` splits the episode scan across
+    device calls (axon kills single executions past ~60-75 s; see
+    JaxEnv.make_episode_stats_fn)."""
     import jax
     import numpy as np
 
@@ -37,8 +39,7 @@ def _measure_episodes(env, policy_name: str, n_envs: int, n_steps: int,
     params = make_params(alpha=0.35, gamma=0.5, max_steps=max_steps)
     policy = env.policies[policy_name]
     keys = jax.random.split(jax.random.PRNGKey(0), n_envs)
-    fn = jax.jit(jax.vmap(
-        lambda k: env.episode_stats(k, params, policy, n_steps)))
+    fn = env.make_episode_stats_fn(params, policy, n_steps, chunk=chunk)
     jax.block_until_ready(fn(keys))  # compile
     t0 = time.time()
     for _ in range(reps):
@@ -61,22 +62,25 @@ def measure_nakamoto(n_envs: int, n_steps: int = 2200, reps: int = 3):
 
 def measure_bk(n_envs: int, n_steps: int = 512, reps: int = 3):
     """BASELINE config 2: Bk k=8 vote-withholding (get-ahead), vmap'd
-    episode batch."""
+    episode batch.  chunk=128 keeps each device call ~15 s at 4096 envs
+    (the unchunked 512-step call ran ~60 s — at the worker's ceiling)."""
     from cpr_tpu.envs.bk import BkSSZ
 
     env = BkSSZ(k=8, incentive_scheme="constant", max_steps_hint=n_steps)
     return _measure_episodes(env, "get-ahead", n_envs, n_steps, reps,
-                             max_steps=n_steps - 8)
+                             max_steps=n_steps - 8, chunk=128)
 
 
 def measure_ethereum(n_envs: int, n_steps: int = 256, reps: int = 3):
     """BASELINE config 3: Ethereum byzantium uncle-mining attack (FN'19
-    policy), large batched episodes."""
+    policy), large batched episodes.  chunk=64: the unchunked 256-step
+    scan at >=1024 envs x capacity 264 ran past the axon worker's
+    per-call ceiling and crashed it (tools/tpu_eth_bisect*.py)."""
     from cpr_tpu.envs.ethereum import EthereumSSZ
 
     env = EthereumSSZ("byzantium", max_steps_hint=n_steps)
     return _measure_episodes(env, "fn19", n_envs, n_steps, reps,
-                             max_steps=n_steps - 8)
+                             max_steps=n_steps - 8, chunk=64)
 
 
 def measure_tailstorm_ppo(n_envs: int, rollout_len: int = 128,

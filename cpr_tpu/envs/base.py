@@ -106,16 +106,18 @@ class JaxEnv:
 
     # -- batched rollout helpers ------------------------------------------
 
-    @partial(jax.jit, static_argnums=(0, 3, 4))
-    def rollout(self, key: jax.Array, params: EnvParams, policy: Callable, n_steps: int):
-        """Run one auto-resetting episode stream for `n_steps` env steps.
-
-        Returns per-step (obs, action, reward, done, info) stacked over time.
-        vmap over `key` (and optionally `params`) for batching.
-        """
+    def _stream_init(self, key: jax.Array, params: EnvParams):
+        """Episode-stream prologue shared by `rollout` and the chunked
+        stats driver: split off the reset key and reset.  Both entry
+        points must seed identically for the chunked-equals-unchunked
+        contract to hold."""
         key, k0 = jax.random.split(key)
-        state, obs = self.reset(k0, params)
+        return self.reset(k0, params)
 
+    def _autoreset_body(self, params: EnvParams, policy: Callable):
+        """Scan body of an auto-resetting episode stream (shared by
+        `rollout` and the chunked stats driver so both advance the
+        stream identically)."""
         takes_state = getattr(policy, "takes_state", False)
 
         def body(carry, _):
@@ -135,6 +137,17 @@ class JaxEnv:
             obs_next = jnp.where(done, robs, obs2)
             return (state, obs_next), (obs, action, reward, done, info)
 
+        return body
+
+    @partial(jax.jit, static_argnums=(0, 3, 4))
+    def rollout(self, key: jax.Array, params: EnvParams, policy: Callable, n_steps: int):
+        """Run one auto-resetting episode stream for `n_steps` env steps.
+
+        Returns per-step (obs, action, reward, done, info) stacked over time.
+        vmap over `key` (and optionally `params`) for batching.
+        """
+        state, obs = self._stream_init(key, params)
+        body = self._autoreset_body(params, policy)
         (state, obs), traj = jax.lax.scan(body, (state, obs), None, length=n_steps)
         return traj
 
@@ -149,6 +162,66 @@ class JaxEnv:
         }
         stats["n_episodes"] = done.sum()
         return stats
+
+    def make_episode_stats_fn(self, params: EnvParams, policy: Callable,
+                              n_steps: int, chunk: int | None = None):
+        """Build `fn(keys) -> per-env stats dict` — the batched twin of
+        `episode_stats`, optionally split into multiple device calls of
+        `chunk` env steps each.
+
+        Why chunking exists: the axon TPU worker crashes ("UNAVAILABLE:
+        TPU worker process crashed or restarted") when a SINGLE device
+        execution runs past ~60-75 s — measured with a pure-matmul probe
+        (tools/tpu_limit_probe.py: a 33 s call and 5x25 s calls pass,
+        one ~150 s call kills the worker), after rollout scans at large
+        batch x DAG-capacity crossed the same ceiling in the round-3
+        bench.  One episode scan per call is the right XLA shape only
+        while it fits that budget; past it, the host loop carries the
+        auto-reset stream between per-chunk calls and accumulates the
+        done-masked partial sums — same math as `episode_stats` up to
+        float summation order.
+
+        The jitted pieces are built once here, so calling the returned
+        fn repeatedly (bench reps) does not re-trace.
+        """
+        if chunk is not None and chunk <= 0:
+            raise ValueError(f"chunk must be positive, got {chunk}")
+        if chunk is None or chunk >= n_steps:
+            return jax.jit(jax.vmap(
+                lambda k: self.episode_stats(k, params, policy, n_steps)))
+
+        n_full, rem = divmod(n_steps, chunk)
+        lengths = (chunk,) * n_full + ((rem,) if rem else ())
+        body = self._autoreset_body(params, policy)
+
+        @jax.jit
+        def init(keys):
+            return jax.vmap(lambda k: self._stream_init(k, params))(keys)
+
+        @partial(jax.jit, static_argnums=1)
+        def run_chunk(carry, length):
+            def one(c):
+                c2, (_, _, _, done, info) = jax.lax.scan(
+                    body, c, None, length=length)
+                sums = {k: jnp.where(done, v, 0.0).sum()
+                        for k, v in info.items() if k.startswith("episode_")}
+                return c2, sums, done.sum()
+            return jax.vmap(one)(carry)
+
+        def fn(keys):
+            carry = init(keys)
+            totals, n_done = None, None
+            for length in lengths:
+                carry, sums, d = run_chunk(carry, length)
+                totals = sums if totals is None else {
+                    k: totals[k] + sums[k] for k in totals}
+                n_done = d if n_done is None else n_done + d
+            nd = jnp.maximum(n_done, 1)
+            stats = {k: v / nd for k, v in totals.items()}
+            stats["n_episodes"] = n_done
+            return stats
+
+        return fn
 
 
 def relative_reward(info: dict[str, Any]) -> jax.Array:

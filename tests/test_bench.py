@@ -33,3 +33,27 @@ def test_measure_config_guards():
         lo, hi = spec["guard"]
         assert rate > 0, name
         assert lo < check < hi, (name, check)
+
+
+def test_chunked_episode_stats_matches_unchunked():
+    """The chunked stats driver (the axon per-call-ceiling workaround,
+    JaxEnv.make_episode_stats_fn) must produce the same per-env stats
+    as one vmapped episode_stats call, up to float summation order."""
+    import jax
+    import numpy as np
+
+    from cpr_tpu.envs.ethereum import EthereumSSZ
+    from cpr_tpu.params import make_params
+
+    env = EthereumSSZ("byzantium", max_steps_hint=48)
+    params = make_params(alpha=0.35, gamma=0.5, max_steps=40)
+    pol = env.policies["fn19"]
+    keys = jax.random.split(jax.random.PRNGKey(7), 16)
+    whole = env.make_episode_stats_fn(params, pol, 96)(keys)
+    # chunk boundary NOT dividing n_steps exercises the remainder call
+    parts = env.make_episode_stats_fn(params, pol, 96, chunk=40)(keys)
+    assert set(whole) == set(parts)
+    for k in whole:
+        np.testing.assert_allclose(np.asarray(whole[k]),
+                                   np.asarray(parts[k]), rtol=1e-5,
+                                   err_msg=k)

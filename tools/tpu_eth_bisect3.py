@@ -1,0 +1,37 @@
+"""Stage-3 ethereum-fault bisect: construct stubs at the minimal crasher.
+
+Stage 2 narrowed the fault to needing BOTH axes large: 4096 envs x
+capacity 72 passes, 256 envs x capacity 264 passes, 1024 envs x
+capacity 264 crashes.  Stage 3 works at the minimal crashing shape
+(1024 x hint 256) and toggles one thing at a time: scan length, policy,
+and the ethereum-specific kernels (chain_window, select_uncles).
+Control (unmodified crasher) runs LAST.
+
+Usage: python tools/tpu_eth_bisect3.py [max_candidates]
+"""
+
+import sys
+
+# run as a script from anywhere: the tools dir is sys.path[0] only for
+# direct execution, so resolve it explicitly
+sys.path.insert(0, __import__("os").path.dirname(__import__("os").path.abspath(__file__)))
+from bisect_common import run_candidates  # noqa: E402
+from tpu_eth_bisect2 import scan, STUB_SELECT, STUB_WINDOW  # noqa: E402
+
+CANDIDATES = [
+    # axis: scan length (is the 256-step scan needed, or just the shape?)
+    ("n1024_h256_scan64", scan(1024, 256, 64)),
+    # axis: policy
+    ("n1024_h256_honest", scan(1024, 256, 256, policy="honest")),
+    # axis: ethereum-specific kernels
+    ("n1024_h256_stub_window", scan(1024, 256, 256, stub=STUB_WINDOW)),
+    ("n1024_h256_stub_select", scan(1024, 256, 256, stub=STUB_SELECT)),
+    ("n1024_h256_stub_both", scan(1024, 256, 256,
+                                  stub=STUB_WINDOW + STUB_SELECT)),
+    # control: the known crasher, unmodified (LAST)
+    ("n1024_h256_control", scan(1024, 256, 256)),
+]
+
+if __name__ == "__main__":
+    limit = int(sys.argv[1]) if len(sys.argv) > 1 else None
+    run_candidates(CANDIDATES, limit)

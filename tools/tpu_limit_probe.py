@@ -1,0 +1,74 @@
+"""Probe the per-call device-time ceiling hypothesis on the axon TPU.
+
+Observation across the round-3 bisects: every successful device call
+finished in <= ~76 s; every "UNAVAILABLE: TPU device error" came from a
+call that would have run 100-165 s — regardless of which kernel it was
+(ethereum scans at several shapes/policies, VI while_loops in round 2).
+Hypothesis: the axon worker (or tunnel RPC) enforces a single-execution
+deadline around ~90-120 s; long-running XLA programs are killed and
+surface as device faults.
+
+These candidates use PURE matmul scans (no cpr_tpu code): calibrate the
+per-iteration cost, then run (a) a ~40 s call, (b) a ~150 s call, and
+(c) the same total work as (b) split into five ~30 s calls.  If (a) and
+(c) pass while (b) crashes, the ceiling is per-call device time — and
+the framework-level fix is chunking long scans/solves across calls
+(exactly what the chunked VI impl does).
+
+Usage: python tools/tpu_limit_probe.py [max_candidates]
+"""
+
+import sys
+
+# run as a script from anywhere: the tools dir is sys.path[0] only for
+# direct execution, so resolve it explicitly
+sys.path.insert(0, __import__("os").path.dirname(__import__("os").path.abspath(__file__)))
+from bisect_common import run_candidates  # noqa: E402
+
+CAL = """
+import time
+N = 4096
+x0 = jax.random.normal(jax.random.PRNGKey(0), (N, N), jnp.float32) * 1e-3
+def burn(x, iters):
+    def body(c, _):
+        return c @ c * 1e-3 + x * 1e-6, None
+    out, _ = jax.lax.scan(body, x, None, length=iters)
+    return out.sum()
+b = jax.jit(burn, static_argnums=1)
+# under axon, block_until_ready returns before execution completes
+# (async dispatch over the tunnel) — only a value FETCH truly waits, so
+# all timing here fetches the scalar
+def timed(n):
+    float(b(x0, n))  # warm (each static n compiles separately)
+    t0 = time.time()
+    v = float(b(x0, n))
+    return time.time() - t0
+per = timed(256) / 256
+print(f"calibration: {per*1000:.2f} ms/iter (warm, fetched)", flush=True)
+"""
+
+CANDIDATES = [
+    ("burn_40s_single_call", CAL + """
+n = max(8, int(40.0 / per))
+d = timed(n)
+print(f"ok single {d:.0f}s device-time ({n} iters)")"""),
+    ("burn_150s_five_calls", CAL + """
+n = max(8, int(30.0 / per))
+float(b(x0, n))  # warm
+t0 = time.time()
+for _ in range(5):
+    float(b(x0, n))
+d = time.time() - t0
+print(f"ok split {d:.0f}s total (5 x {n} iters)")"""),
+    # the hypothesized crasher runs LAST; its warm call IS the long call
+    ("burn_150s_single_call", CAL + """
+n = max(8, int(150.0 / per))
+t0 = time.time()
+float(b(x0, n))
+d = time.time() - t0
+print(f"ok single {d:.0f}s incl-compile ({n} iters)")"""),
+]
+
+if __name__ == "__main__":
+    limit = int(sys.argv[1]) if len(sys.argv) > 1 else None
+    run_candidates(CANDIDATES, limit, timeout=420.0)
