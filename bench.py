@@ -60,27 +60,42 @@ def measure_nakamoto(n_envs: int, n_steps: int = 2200, reps: int = 3):
                              n_envs, n_steps, reps, max_steps=2016)
 
 
+def _chunk_scaled(n_envs: int, base_chunk: int, base_envs: int):
+    """`base_chunk` at its measured-good `base_envs`, shrinking
+    proportionally for LARGER batches so per-call device time stays
+    inside the axon worker's ~60-75 s ceiling.  Only shrink — a first
+    attempt at a time-budget formula also GREW bk's chunk 128→183 at
+    its measured batch and halved throughput on chip (mechanism not
+    chased; chunk length is empirical).  Smaller batches get longer
+    chunks naturally via make_episode_stats_fn's chunk>=n_steps
+    unchunked path."""
+    return max(16, base_chunk * base_envs // max(n_envs, base_envs))
+
+
 def measure_bk(n_envs: int, n_steps: int = 512, reps: int = 3):
     """BASELINE config 2: Bk k=8 vote-withholding (get-ahead), vmap'd
-    episode batch.  chunk=128 keeps each device call ~15 s at 4096 envs
-    (the unchunked 512-step call ran ~60 s — at the worker's ceiling)."""
+    episode batch.  chunk 128 @4096 envs measured 35.2k steps/s on chip
+    (the unchunked 512-step call ran ~60 s — at the worker's ceiling;
+    chunk 183 measured 16.4k)."""
     from cpr_tpu.envs.bk import BkSSZ
 
     env = BkSSZ(k=8, incentive_scheme="constant", max_steps_hint=n_steps)
     return _measure_episodes(env, "get-ahead", n_envs, n_steps, reps,
-                             max_steps=n_steps - 8, chunk=128)
+                             max_steps=n_steps - 8,
+                             chunk=_chunk_scaled(n_envs, 128, 4096))
 
 
 def measure_ethereum(n_envs: int, n_steps: int = 256, reps: int = 3):
     """BASELINE config 3: Ethereum byzantium uncle-mining attack (FN'19
-    policy), large batched episodes.  chunk=64: the unchunked 256-step
-    scan at >=1024 envs x capacity 264 ran past the axon worker's
-    per-call ceiling and crashed it (tools/tpu_eth_bisect*.py)."""
+    policy), large batched episodes.  chunk 64 @16384 envs measured
+    41.7k steps/s on chip; 65536 envs exceeds HBM (worker crash at any
+    chunk) and is expected to land via the descent ladder."""
     from cpr_tpu.envs.ethereum import EthereumSSZ
 
     env = EthereumSSZ("byzantium", max_steps_hint=n_steps)
     return _measure_episodes(env, "fn19", n_envs, n_steps, reps,
-                             max_steps=n_steps - 8, chunk=64)
+                             max_steps=n_steps - 8,
+                             chunk=_chunk_scaled(n_envs, 64, 16384))
 
 
 def measure_tailstorm_ppo(n_envs: int, rollout_len: int = 128,
@@ -340,16 +355,32 @@ def run_configs_isolated(timeout: float):
                         else "hung past watchdog")
                 print(f"bench: {name} n_envs={n_envs} {last}",
                       file=sys.stderr)
+                if status == "hung" and n_envs != ladder[-1]:
+                    # a crash can present as an init-hang in the NEXT
+                    # child while the worker restarts; with descent
+                    # rungs left, pause for recovery and step down
+                    # instead of writing the device off
+                    print(f"bench: {name} n_envs={n_envs} hung; "
+                          f"descending after recovery pause",
+                          file=sys.stderr)
+                    time.sleep(60.0)
+                    break
                 if status == "hung":
-                    # wedged device: straight to CPU (main()'s
-                    # policy), for this and all remaining configs
+                    # hang at the final rung: treat as a wedged device
+                    # — straight to CPU (main()'s policy), for this and
+                    # all remaining configs
                     wedged = stop = True
                     break
                 if n_envs != ladder[-1]:
                     # a clean failure may be a device fault: when
                     # descent rungs remain, step down instead of
                     # re-running the possibly-faulting size (a second
-                    # fault can wedge the chip and kill the ladder)
+                    # fault can wedge the chip and kill the ladder) —
+                    # but give the crashed worker time to restart, or
+                    # the next rung fails on a half-recovered backend
+                    # (observed: the post-OOM 16384 rung is flaky when
+                    # probed immediately)
+                    time.sleep(60.0)
                     break
                 if retry == 0:
                     time.sleep(15.0)  # transient chip claim may clear
@@ -432,9 +463,10 @@ def main():
     # (wedged device) goes straight to CPU
     timeout = float(os.environ.get("CPR_BENCH_TPU_TIMEOUT", "360"))
     if configs_mode:
-        # per-config isolated children (one compile each -> the base
-        # timeout per config is enough)
-        run_configs_isolated(timeout)
+        # chunked ethereum legitimately runs ~100 s/rep at 16384 envs:
+        # compile + 3 reps needs more than the single-kernel default,
+        # and a merely-slow config must not be classified as a wedge
+        run_configs_isolated(timeout * 2)
         return
     for attempt in range(2):
         status, payload = _attempt(timeout, "--direct")

@@ -153,6 +153,20 @@ def release(dag: Dag, mask, time) -> Dag:
     )
 
 
+def parents_hit(dag: Dag, mask) -> jnp.ndarray:
+    """(B,) mask of blocks that appear in the parent row of any block in
+    `mask` — the one-hop "scatter child hits onto parent slots" step
+    shared by the ancestor fixpoints below."""
+    B = dag.capacity
+    hits = jnp.zeros((B,), jnp.bool_)
+    for p in range(dag.max_parents):
+        col = dag.parents[:, p]
+        hit = mask & (col >= 0)
+        hits = hits | (
+            jnp.zeros((B,), jnp.bool_).at[jnp.clip(col, 0)].max(hit))
+    return hits
+
+
 def ancestors_mask(dag: Dag, v) -> jnp.ndarray:
     """(B,) mask of v and all its ancestors (fixpoint BFS over the parent
     matrix; the analog of dagtools.ml:73-100 iterate_ancestors). The loop
@@ -163,14 +177,7 @@ def ancestors_mask(dag: Dag, v) -> jnp.ndarray:
 
     def body(state):
         mask, _ = state
-        # blocks whose any child is in mask
-        parent_hits = jnp.zeros((B,), jnp.bool_)
-        for p in range(dag.max_parents):
-            col = dag.parents[:, p]
-            hit = mask & (col >= 0)
-            parent_hits = parent_hits | (
-                jnp.zeros((B,), jnp.bool_).at[jnp.clip(col, 0)].max(hit))
-        new = mask | parent_hits
+        new = mask | parents_hit(dag, mask)
         return new, (new != mask).any()
 
     def cond(state):
@@ -208,6 +215,34 @@ def release_chain(dag: Dag, tip, time) -> Dag:
         return dag, row[0]
 
     dag, _ = jax.lax.while_loop(cond, body, (dag, tip))
+    return dag
+
+
+def release_closure(dag: Dag, tip, time) -> Dag:
+    """`release_chain` plus a visibility-closure fixpoint: any parent
+    referenced by a defender-visible block becomes visible too.
+
+    Matches the reference's fully recursive share (simulator.ml:401-419)
+    even when a released non-precursor parent carries its OWN withheld
+    parent row — e.g. an orphaned ethereum uncle U (made while withheld,
+    including withheld uncle W) later re-included by a new chain block:
+    the chain walk releases U via the row but never walks U, so W needs
+    the closure pass.  The loop exits after a single check in the common
+    case (uncle nesting is rare), so per-step cost stays O(newly
+    released) instead of release_with_ancestors' height-deep fixpoint."""
+    dag = release_chain(dag, tip, time)
+
+    def missing(d):
+        ref = parents_hit(d, d.exists() & d.vis_d)
+        return ref & ~d.vis_d & d.exists()
+
+    def body(carry):
+        d, m = carry
+        d = release(d, m, time)
+        return d, missing(d)
+
+    dag, _ = jax.lax.while_loop(lambda c: c[1].any(), body,
+                                (dag, missing(dag)))
     return dag
 
 

@@ -194,18 +194,39 @@ class JaxEnv:
         lengths = (chunk,) * n_full + ((rem,) if rem else ())
         body = self._autoreset_body(params, policy)
 
+        # derive the accumulator keys/dtypes from THIS env's info dict
+        # (not the INFO_KEYS module constant) so envs with custom info
+        # keep the chunked==unchunked contract
+        def _probe(key):
+            carry = self._stream_init(key, params)
+            _, (_, _, _, _, info) = body(carry, None)
+            return info
+        info_spec = jax.eval_shape(_probe, jax.random.PRNGKey(0))
+        acc_spec = {k: v.dtype for k, v in info_spec.items()
+                    if k.startswith("episode_")}
+
         @jax.jit
         def init(keys):
             return jax.vmap(lambda k: self._stream_init(k, params))(keys)
 
         @partial(jax.jit, static_argnums=1)
         def run_chunk(carry, length):
+            # accumulate the done-masked sums INSIDE the scan carry
+            # instead of stacking per-step info and reducing after:
+            # stacking costs O(n_envs * chunk * |info|) HBM and is what
+            # pushed the 65536-env ethereum config out of memory
             def one(c):
-                c2, (_, _, _, done, info) = jax.lax.scan(
-                    body, c, None, length=length)
-                sums = {k: jnp.where(done, v, 0.0).sum()
-                        for k, v in info.items() if k.startswith("episode_")}
-                return c2, sums, done.sum()
+                def step(acc_carry, _):
+                    c, acc, nd = acc_carry
+                    c2, (_, _, _, done, info) = body(c, None)
+                    acc = {k: acc[k] + jnp.where(done, info[k], 0.0)
+                           for k in acc}
+                    return (c2, acc, nd + done.astype(jnp.int32)), None
+
+                acc0 = {k: jnp.zeros((), dt) for k, dt in acc_spec.items()}
+                (c2, acc, nd), _ = jax.lax.scan(
+                    step, (c, acc0, jnp.int32(0)), None, length=length)
+                return c2, acc, nd
             return jax.vmap(one)(carry)
 
         def fn(keys):

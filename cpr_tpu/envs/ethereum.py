@@ -343,8 +343,15 @@ class EthereumSSZ(JaxEnv):
             | (act == MATCH) | (act == RELEASE1)
         release_tip = jnp.where(do_release, release_tip, jnp.int32(-1))
 
-        released = D.release_with_ancestors(
-            dag, release_tip, state.time)
+        # release_closure, not release_with_ancestors: uncles ride in
+        # the parent row, so the O(newly-released) chain walk plus the
+        # one-check visibility closure (for withheld uncles-of-uncles)
+        # covers the recursive-share set.  The old fixpoint's while_loop
+        # trip count grew with chain height — run unconditionally every
+        # step it made episodes quadratic and pushed large-batch scans
+        # past the axon worker's ~60-75 s per-call ceiling (round-3
+        # bisects, tools/tpu_limit_probe.py).
+        released = D.release_closure(dag, release_tip, state.time)
         dag = jax.tree.map(
             lambda a, b: jnp.where(do_release, a, b), released, dag)
 

@@ -1,0 +1,52 @@
+"""Release-primitive semantics on hand-built DAGs.
+
+The reference's share is fully recursive (simulator.ml:401-419): making a
+block visible shares every withheld ancestor.  `release_chain` covers the
+chain+row-rider case in O(newly released); `release_closure` adds the
+visibility fixpoint needed when a released row-rider carries its OWN
+withheld parents (ethereum uncles-of-uncles)."""
+
+import jax.numpy as jnp
+
+from cpr_tpu.core import dag as D
+
+
+def _nested_uncle_dag():
+    """root <- W (withheld);  U parents [root, W] (withheld);
+    X parents [root, U] (withheld).  Releasing X must transitively
+    reveal U (row rider of X) AND W (row rider of U): the reference's
+    recursive share would."""
+    dag = D.empty(8, 2)
+    dag, root = D.append(dag, jnp.array([D.NONE, D.NONE], jnp.int32),
+                         kind=0, height=0, vis_a=True, vis_d=True,
+                         time=0.0)
+    dag, w = D.append(dag, jnp.array([0, D.NONE], jnp.int32),
+                      kind=0, height=1, vis_a=True, vis_d=False, time=1.0)
+    dag, u = D.append(dag, jnp.array([0, 1], jnp.int32),
+                      kind=0, height=1, vis_a=True, vis_d=False, time=2.0)
+    dag, x = D.append(dag, jnp.array([0, 2], jnp.int32),
+                      kind=0, height=1, vis_a=True, vis_d=False, time=3.0)
+    return dag, root, w, u, x
+
+
+def test_release_closure_reveals_nested_row_riders():
+    dag, root, w, u, x = _nested_uncle_dag()
+    out = D.release_closure(dag, jnp.int32(int(x)), 9.0)
+    assert bool(out.vis_d[x]) and bool(out.vis_d[u]) and bool(out.vis_d[w])
+    # matches the full recursive share
+    ref = D.release_with_ancestors(dag, jnp.int32(int(x)), 9.0)
+    assert (out.vis_d == ref.vis_d).all()
+
+
+def test_release_chain_alone_misses_nested_rider():
+    """Documents WHY release_closure exists: the chain walk releases X's
+    row (revealing U) but never walks U, so W stays withheld."""
+    dag, root, w, u, x = _nested_uncle_dag()
+    out = D.release_chain(dag, jnp.int32(int(x)), 9.0)
+    assert bool(out.vis_d[u]) and not bool(out.vis_d[w])
+
+
+def test_release_closure_noop_on_negative_tip():
+    dag, *_ = _nested_uncle_dag()
+    out = D.release_closure(dag, jnp.int32(-1), 9.0)
+    assert (out.vis_d == dag.vis_d).all()

@@ -95,14 +95,6 @@ tm = ptmdp(compile_native("ghostdag", k=2, alpha=0.33, gamma=0.5,
            horizon=20).tensor()
 vi = tm.value_iteration(stop_delta=1e-6)
 print(int(vi["vi_iter"]))"""),
-    ("vi_ghostdag_c7", """
-from cpr_tpu.mdp import ptmdp
-from cpr_tpu.mdp.generic.native import compile_native
-tm = ptmdp(compile_native("ghostdag", k=2, alpha=0.33, gamma=0.5,
-                          collect_garbage="simple", dag_size_cutoff=7),
-           horizon=100).tensor()
-vi = tm.value_iteration(stop_delta=1e-5)
-print(int(vi["vi_iter"]))"""),
     ("vi_ghostdag_c7_chunked", """
 from cpr_tpu.mdp import ptmdp
 from cpr_tpu.mdp.generic.native import compile_native
@@ -110,6 +102,18 @@ tm = ptmdp(compile_native("ghostdag", k=2, alpha=0.33, gamma=0.5,
                           collect_garbage="simple", dag_size_cutoff=7),
            horizon=100).tensor()
 vi = tm.value_iteration(stop_delta=1e-5, impl="chunked")
+print(int(vi["vi_iter"]))"""),
+    # LAST: the one-call while_loop solve — if the whole solve exceeds
+    # the axon worker's ~60-75 s per-call ceiling it kills the worker
+    # (tools/tpu_limit_probe.py), which is the round-2 "VI kernel
+    # fault" root cause
+    ("vi_ghostdag_c7", """
+from cpr_tpu.mdp import ptmdp
+from cpr_tpu.mdp.generic.native import compile_native
+tm = ptmdp(compile_native("ghostdag", k=2, alpha=0.33, gamma=0.5,
+                          collect_garbage="simple", dag_size_cutoff=7),
+           horizon=100).tensor()
+vi = tm.value_iteration(stop_delta=1e-5)
 print(int(vi["vi_iter"]))"""),
 ]
 
