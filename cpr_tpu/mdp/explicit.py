@@ -285,7 +285,7 @@ def _vi_chunk(src, act, dst, prob, reward, progress, S, A, discount,
 
 
 def run_chunk_driver(chunk_step, S, dtype, stop_delta, max_iter,
-                     chunk: int = 16):
+                     chunk: int = 64):
     """Shared host loop for device-while-free VI: call
     `chunk_step(value, prog, steps) -> (value, prog, pol, delta)` in
     full chunks with a chunk=1 tail (steps is a static argnum in both
@@ -309,7 +309,7 @@ def run_chunk_driver(chunk_step, S, dtype, stop_delta, max_iter,
 
 
 def vi_chunked(src, act, dst, prob, reward, progress, S, A, discount,
-               stop_delta, max_iter, chunk: int = 16):
+               stop_delta, max_iter, chunk: int = 64):
     """Host-driven VI: repeat `_vi_chunk` until the last in-chunk delta
     drops below stop_delta (or max_iter sweeps ran).  Same fixpoint as
     vi_while_loop — extra post-convergence sweeps are no-ops on a

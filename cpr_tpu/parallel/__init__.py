@@ -70,7 +70,7 @@ def sharded_value_iteration(tm: TensorMDP, mesh: Mesh, *, axis: str = "d",
                             max_iter: int = 0, discount: float = 1.0,
                             eps: float | None = None,
                             stop_delta: float | None = None,
-                            impl: str | None = None, chunk: int = 16):
+                            impl: str | None = None, chunk: int = 64):
     """Value iteration with the transition table sharded over the mesh.
 
     Each device owns a contiguous transition chunk (padded with
