@@ -1,0 +1,74 @@
+"""Cost split of the bk step on chip: stub one primitive family at a
+time and measure the warm episode-scan rate.
+
+bk at 4096 envs runs the same ~35k env-steps/s as at 128 envs — fully
+latency-bound on the per-step sequential op chain, so the lever is
+whatever dominates that chain: top_k_by (4x per step), the
+common-ancestor / height-walk while_loops, or release_chain.  Stubs
+break semantics (revenue is ignored); only the rate matters.
+
+Usage: python tools/tpu_bk_profile.py [max_candidates]
+"""
+
+import sys
+
+# run as a script from anywhere: the tools dir is sys.path[0] only for
+# direct execution, so resolve it explicitly
+sys.path.insert(0, __import__("os").path.dirname(__import__("os").path.abspath(__file__)))
+from bisect_common import run_candidates  # noqa: E402
+
+BASE = """
+import time
+from cpr_tpu.core import dag as D
+from cpr_tpu.params import make_params
+{stub}
+from cpr_tpu.envs.bk import BkSSZ
+env = BkSSZ(k=8, incentive_scheme="constant", max_steps_hint=512)
+params = make_params(alpha=0.35, gamma=0.5, max_steps=504)
+pol = env.policies["get-ahead"]
+keys = jax.random.split(jax.random.PRNGKey(0), 4096)
+fn = env.make_episode_stats_fn(params, pol, 128, chunk=128)
+jax.block_until_ready(fn(keys))
+t0 = time.time()
+import numpy as np
+s = fn(keys)
+r = float(np.asarray(s["episode_progress"]).mean())  # force fetch
+dt = time.time() - t0
+print(f"{{4096*128/dt:,.0f}} steps/s (warm)")
+"""
+
+STUB_TOPK = """
+def _stub_topk(score, mask, k, largest=False):
+    idx = jnp.arange(k, dtype=jnp.int32)
+    return idx, mask[idx]
+D.top_k_by = _stub_topk
+"""
+
+STUB_CA = """
+D.common_ancestor_by_height = lambda dag, a, b: jnp.int32(0)
+"""
+
+STUB_WALK = """
+D.walk_back = lambda dag, tip, stop_fn: tip
+D.block_at_height = lambda dag, tip, h, is_block_fn=None: tip
+"""
+
+STUB_RELEASE = """
+D.release_chain = lambda dag, tip, time: D.release(
+    dag, jnp.zeros((dag.capacity,), jnp.bool_).at[jnp.maximum(tip, 0)]
+    .set(tip >= 0), time)
+"""
+
+CANDIDATES = [
+    ("bk_control", BASE.format(stub="")),
+    ("bk_stub_topk", BASE.format(stub=STUB_TOPK)),
+    ("bk_stub_common_anc", BASE.format(stub=STUB_CA)),
+    ("bk_stub_walks", BASE.format(stub=STUB_WALK)),
+    ("bk_stub_release", BASE.format(stub=STUB_RELEASE)),
+    ("bk_stub_all", BASE.format(
+        stub=STUB_TOPK + STUB_CA + STUB_WALK + STUB_RELEASE)),
+]
+
+if __name__ == "__main__":
+    limit = int(sys.argv[1]) if len(sys.argv) > 1 else None
+    run_candidates(CANDIDATES, limit, timeout=420.0)
