@@ -1,8 +1,8 @@
 #!/bin/bash
 # One healthy-chip window, spent in priority order (round-2 lesson:
 # bank the bench BEFORE anything that can wedge the backend).
-#   1. headline bench  -> BENCH_self_r03.json   (the evidence artifact)
-#   2. configs 2-4     -> BENCH_CONFIGS_tpu_r03.json
+#   1. headline bench  -> BENCH_self_${ROUND}.json   (the evidence artifact)
+#   2. configs 2-4     -> BENCH_CONFIGS_tpu_${ROUND}.json
 #   3. PRNG sweep      -> stdout tee            (read-only perf data)
 #   4. VI bisect       -> LAST: its candidates have crashed the worker
 # Each step is already watchdogged internally (bench.py subprocess
@@ -11,6 +11,7 @@
 # failed or CPU-fallback run never clobbers banked evidence.
 set -u -o pipefail
 cd "$(dirname "$0")/.."
+ROUND=${CPR_ROUND:-r03}
 log=tools/tpu_session.log
 echo "=== tpu session $(date +%F_%T) ===" | tee -a "$log"
 
@@ -18,8 +19,8 @@ echo "--- 1. headline bench" | tee -a "$log"
 if python bench.py >/tmp/bench_line.json 2>>"$log"; then
   tee -a "$log" </tmp/bench_line.json
   if grep -q '"backend": "\(tpu\|axon\)"' /tmp/bench_line.json; then
-    mv /tmp/bench_line.json BENCH_self_r03.json
-    echo "banked BENCH_self_r03.json" | tee -a "$log"
+    mv /tmp/bench_line.json BENCH_self_${ROUND}.json
+    echo "banked BENCH_self_${ROUND}.json" | tee -a "$log"
   else
     echo "NOT banked: backend is not tpu" | tee -a "$log"
   fi
@@ -34,8 +35,8 @@ echo "--- 2. configs 2-4" | tee -a "$log"
 rm -f BENCH_CONFIGS.json
 if python bench.py --configs 2>>"$log" | tee -a "$log" \
    && python -c 'import json,sys; rows=json.load(open("BENCH_CONFIGS.json")); sys.exit(0 if rows and all(r.get("backend") in ("tpu","axon") for r in rows) else 1)'; then
-  cp -f BENCH_CONFIGS.json BENCH_CONFIGS_tpu_r03.json
-  echo "banked BENCH_CONFIGS_tpu_r03.json" | tee -a "$log"
+  cp -f BENCH_CONFIGS.json BENCH_CONFIGS_tpu_${ROUND}.json
+  echo "banked BENCH_CONFIGS_tpu_${ROUND}.json" | tee -a "$log"
 else
   echo "configs NOT banked (failed or cpu fallback)" | tee -a "$log"
 fi
