@@ -39,6 +39,7 @@ __all__ = [
     "default_mesh",
     "shard_envs",
     "sharded_value_iteration",
+    "make_sharded_rollout_fn",
     "sharded_rollout",
 ]
 
@@ -56,14 +57,35 @@ def shard_envs(mesh: Mesh, tree, axis: str = "d"):
     return jax.device_put(tree, sharding)
 
 
+def make_sharded_rollout_fn(env, mesh: Mesh, params, policy,
+                            n_steps: int, axis: str = "d",
+                            chunk: int | None = None):
+    """Build `fn(keys) -> stats` running vmap'd `JaxEnv.episode_stats`
+    with the episode batch sharded over the mesh. XLA partitions the
+    whole rollout program; no collectives are needed until the caller
+    aggregates the returned stats.  The jitted pieces are built once —
+    call the returned fn per rep without re-tracing.
+
+    `chunk` splits the episode scan across device calls exactly like
+    the single-device `JaxEnv.make_episode_stats_fn` (sharded inputs
+    keep their placement through the host loop, so each per-chunk call
+    stays mesh-partitioned) — for workers that bound single-execution
+    time (docs/TPU_SESSION_r03.md)."""
+    stats_fn = env.make_episode_stats_fn(params, policy, n_steps,
+                                         chunk=chunk)
+
+    def fn(keys):
+        return stats_fn(shard_envs(mesh, keys, axis))
+
+    return fn
+
+
 def sharded_rollout(env, mesh: Mesh, keys, params, policy, n_steps: int,
-                    axis: str = "d"):
-    """vmap'd `JaxEnv.episode_stats` with the episode batch sharded over
-    the mesh. XLA partitions the whole rollout program; no collectives
-    are needed until the caller aggregates the returned stats."""
-    keys = shard_envs(mesh, keys, axis)
-    fn = jax.jit(jax.vmap(lambda k: env.episode_stats(k, params, policy, n_steps)))
-    return fn(keys)
+                    axis: str = "d", chunk: int | None = None):
+    """One-shot wrapper over `make_sharded_rollout_fn` (build the fn
+    once instead when calling repeatedly)."""
+    return make_sharded_rollout_fn(env, mesh, params, policy, n_steps,
+                                   axis, chunk)(keys)
 
 
 def sharded_value_iteration(tm: TensorMDP, mesh: Mesh, *, axis: str = "d",

@@ -46,3 +46,27 @@ def test_dp_tp_train_step_and_sharded_vi():
     # parameters keep their tp sharding through the update
     kernel = jax.tree_util.tree_leaves(ts.params)[0]
     assert not kernel.sharding.is_fully_replicated or kernel.ndim == 1
+
+
+def test_sharded_rollout_chunked_matches_unchunked():
+    """sharded_rollout with chunk= must agree with the one-call path —
+    and the sharded inputs must stay partitioned through the chunked
+    host loop (the single-device chunk driver's multichip twin)."""
+    from cpr_tpu.envs.nakamoto import NakamotoSSZ
+    from cpr_tpu.params import make_params
+    from cpr_tpu.parallel import default_mesh, sharded_rollout
+
+    env = NakamotoSSZ()
+    params = make_params(alpha=0.35, gamma=0.5, max_steps=24)
+    mesh = default_mesh(devices=jax.devices()[:8])
+    keys = jax.random.split(jax.random.PRNGKey(3), 32)
+    pol = env.policies["sapirshtein-2016-sm1"]
+    whole = sharded_rollout(env, mesh, keys, params, pol, 48)
+    parts = sharded_rollout(env, mesh, keys, params, pol, 48, chunk=20)
+    # the chunked path must keep per-env outputs mesh-partitioned, not
+    # silently replicate them
+    assert not parts["episode_progress"].sharding.is_fully_replicated
+    for k in whole:
+        np.testing.assert_allclose(np.asarray(whole[k]),
+                                   np.asarray(parts[k]), rtol=1e-5,
+                                   err_msg=k)
