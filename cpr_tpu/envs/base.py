@@ -209,7 +209,11 @@ class JaxEnv:
         def init(keys):
             return jax.vmap(lambda k: self._stream_init(k, params))(keys)
 
-        @partial(jax.jit, static_argnums=1)
+        # donate the carry: the host loop never reuses the previous
+        # chunk's carry, and the env state dominates memory at large
+        # batch x capacity (the 65536-env ethereum OOM class) — aliasing
+        # input and output state halves that footprint
+        @partial(jax.jit, static_argnums=1, donate_argnums=0)
         def run_chunk(carry, length):
             # accumulate the done-masked sums INSIDE the scan carry
             # instead of stacking per-step info and reducing after:
