@@ -363,7 +363,7 @@ def run_configs_isolated(timeout: float):
                     print(f"bench: {name} n_envs={n_envs} hung; "
                           f"descending after recovery pause",
                           file=sys.stderr)
-                    time.sleep(60.0)
+                    time.sleep(120.0)
                     break
                 if status == "hung":
                     # hang at the final rung: treat as a wedged device
@@ -371,19 +371,19 @@ def run_configs_isolated(timeout: float):
                     # all remaining configs
                     wedged = stop = True
                     break
-                if n_envs != ladder[-1]:
-                    # a clean failure may be a device fault: when
-                    # descent rungs remain, step down instead of
-                    # re-running the possibly-faulting size (a second
-                    # fault can wedge the chip and kill the ladder) —
-                    # but give the crashed worker time to restart, or
-                    # the next rung fails on a half-recovered backend
-                    # (observed: the post-OOM 16384 rung is flaky when
-                    # probed immediately)
-                    time.sleep(60.0)
+                if n_envs == ladder[0] and len(ladder) > 1:
+                    # prescribed-size fault: never re-run the known
+                    # crasher (a second fault can wedge the chip); pause
+                    # long enough for the worker restart, then descend
+                    time.sleep(120.0)
                     break
-                if retry == 0:
-                    time.sleep(15.0)  # transient chip claim may clear
+                # single-rung configs: brief pause for a transient chip
+                # claim.  Descent rungs: failures here are usually the
+                # half-recovered worker (observed 60 s insufficient
+                # post-crash, twice), so wait longer — both before the
+                # same-rung retry AND after the final retry, so the
+                # NEXT rung never probes a restarting backend either
+                time.sleep(15.0 if n_envs == ladder[0] else 120.0)
             if row is not None or stop:
                 break
         if row is None and cpu_row is None and not guard_failed:
