@@ -88,8 +88,8 @@ def measure_bk(n_envs: int, n_steps: int = 512, reps: int = 3):
 def measure_ethereum(n_envs: int, n_steps: int = 256, reps: int = 3):
     """BASELINE config 3: Ethereum byzantium uncle-mining attack (FN'19
     policy), large batched episodes.  chunk 64 @16384 envs measured
-    41.7k steps/s on chip; 65536 envs exceeds HBM (worker crash at any
-    chunk) and is expected to land via the descent ladder."""
+    41.7k steps/s on chip; 65536 envs kills the axon worker at any
+    chunk length and is no longer probed by default (see CONFIGS)."""
     from cpr_tpu.envs.ethereum import EthereumSSZ
 
     env = EthereumSSZ("byzantium", max_steps_hint=n_steps)
@@ -217,8 +217,18 @@ CONFIGS = {
         fn="measure_tailstorm_ppo", tpu=dict(n_envs=4096),
         cpu=dict(n_envs=64), guard=(0.0, 2.1),
         guard_name="policy entropy (2 actions + quorum head)"),
+    # BASELINE prescribes 65536 envs, but that shape kills the axon
+    # worker at ANY chunk length — with O(1)-memory stat accumulators
+    # and a donated carry — and each crash lengthens the worker's
+    # recovery window, spoiling the rest of the run (round-3 session
+    # log).  Default to the largest size the device demonstrably runs;
+    # probe 65536 explicitly with
+    #   CPR_BENCH_NENVS=65536 python bench.py --direct-one ethereum_uncle_attack
+    # if the worker stack changes (the --configs parent sets
+    # CPR_BENCH_NENVS itself per rung, so the env var only reaches the
+    # child through --direct-one).
     "ethereum_uncle_attack": dict(
-        fn="measure_ethereum", tpu=dict(n_envs=65536),
+        fn="measure_ethereum", tpu=dict(n_envs=16384),
         cpu=dict(n_envs=256), guard=(0.33, 0.55),
         guard_name="fn19 revenue share"),
 }
@@ -305,12 +315,12 @@ def run_one(name: str):
     print(json.dumps(row))
 
 
-# Extra descent rungs below the BASELINE-prescribed size (the first
+# Extra descent rungs below each config's default TPU size (the first
 # rung always comes from CONFIGS[name]["tpu"]["n_envs"]): on a device
 # FAULT the runner steps down so a size-dependent failure (memory
 # pressure) still yields an on-chip number at a recorded smaller batch.
 CONFIG_DESCENT = {
-    "ethereum_uncle_attack": (16384, 4096),
+    "ethereum_uncle_attack": (4096,),
 }
 
 
@@ -371,19 +381,18 @@ def run_configs_isolated(timeout: float):
                     # all remaining configs
                     wedged = stop = True
                     break
-                if n_envs == ladder[0] and len(ladder) > 1:
-                    # prescribed-size fault: never re-run the known
-                    # crasher (a second fault can wedge the chip); pause
-                    # long enough for the worker restart, then descend
-                    time.sleep(120.0)
-                    break
-                # single-rung configs: brief pause for a transient chip
-                # claim.  Descent rungs: failures here are usually the
-                # half-recovered worker (observed 60 s insufficient
-                # post-crash, twice), so wait longer — both before the
-                # same-rung retry AND after the final retry, so the
-                # NEXT rung never probes a restarting backend either
-                time.sleep(15.0 if n_envs == ladder[0] else 120.0)
+                # Every rung gets one same-rung retry: no rung is a
+                # known crasher anymore (the 65536 ethereum shape was
+                # dropped from the ladder), so failures are transient
+                # chip claims (single-rung configs: brief pause) or a
+                # recovering worker after a crash (multi-rung ladders:
+                # observed 60 s insufficient post-crash, twice — wait
+                # longer).  The pause also runs after the final retry
+                # when another rung remains, so descent never probes a
+                # restarting backend; no pause before a CPU fallback,
+                # which does not touch the worker.
+                if retry == 0 or n_envs != ladder[-1]:
+                    time.sleep(15.0 if len(ladder) == 1 else 120.0)
             if row is not None or stop:
                 break
         if row is None and cpu_row is None and not guard_failed:
