@@ -132,9 +132,32 @@ def measure_tailstorm_ppo(n_envs: int, rollout_len: int = 128,
 # (alpha=.35, gamma=.5 -> 0.416)
 SM1_GUARD = (0.38, 0.45)
 
-# child exit code distinguishing a correctness-guard assertion from a
+# child exit code distinguishing a correctness-guard failure from a
 # device fault / infrastructure failure (any other nonzero rc)
 GUARD_RC = 3
+
+
+class GuardFailure(Exception):
+    """A deterministic correctness-guard violation — distinct from
+    AssertionError so assertions raised inside jax internals or env code
+    cannot masquerade as guard failures and suppress the retry/descent
+    ladder (they are infra failures and should be retried)."""
+
+
+def _cpu_baseline(name: str):
+    """Single-core C++-oracle steps/s for `name` from BASELINE_CPU.json
+    (tools/cpu_baseline.py), or None if not banked.  The divisor for
+    every row's vs_cpu_baseline: the reference's execution model is one
+    sim per core, so >1.0 means one chip beats the reference engine's
+    core-for-core rate on that workload."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BASELINE_CPU.json")
+    try:
+        with open(path) as f:
+            cfgs = json.load(f)["configs"]
+        return float(cfgs[name]["single_core_steps_per_sec"])
+    except (OSError, KeyError, ValueError, TypeError):
+        return None
 
 
 _PRNG_IMPLS = ("threefry2x32", "rbg")
@@ -189,9 +212,10 @@ def run_bench(platform_hint: str):
     # compile + memory comfortable at ~98% of peak
     n_envs = 131072 if platform != "cpu" else 512
     steps_per_sec, rel = measure_nakamoto(n_envs)
-    assert SM1_GUARD[0] < rel < SM1_GUARD[1], \
-        f"SM1 revenue {rel} off closed form 0.416"
+    if not SM1_GUARD[0] < rel < SM1_GUARD[1]:
+        raise GuardFailure(f"SM1 revenue {rel} off closed form 0.416")
 
+    base = _cpu_baseline("nakamoto_sm1")
     print(json.dumps({
         "metric": "nakamoto_selfish_mining_env_steps_per_sec_per_chip",
         "value": round(steps_per_sec),
@@ -199,6 +223,8 @@ def run_bench(platform_hint: str):
         "vs_baseline": round(steps_per_sec / 10_000_000, 3),
         "backend": platform,
         "prng": _prng_choice(),
+        **({"vs_cpu_baseline": round(steps_per_sec / base, 3)}
+           if base else {}),
     }))
 
 
@@ -244,8 +270,10 @@ def _measure_config(name: str, platform: str, n_envs_override=None):
     rate, check = globals()[spec["fn"]](**kw)
     rate, check = float(rate), float(check)
     lo, hi = spec["guard"]
-    assert lo < check < hi, \
-        f"{name}: {spec['guard_name']} {check} outside ({lo}, {hi})"
+    if not lo < check < hi:
+        raise GuardFailure(
+            f"{name}: {spec['guard_name']} {check} outside ({lo}, {hi})")
+    base = _cpu_baseline(name)
     return {
         "metric": f"{name}_env_steps_per_sec_per_chip",
         "value": round(rate),
@@ -253,6 +281,7 @@ def _measure_config(name: str, platform: str, n_envs_override=None):
         "check": round(check, 4),
         "backend": platform,
         "prng": _prng_choice(),
+        **({"vs_cpu_baseline": round(rate / base, 3)} if base else {}),
         **{f"cfg_{k}": v for k, v in kw.items()},
     }
 
@@ -306,7 +335,7 @@ def run_one(name: str):
     try:
         row = _measure_config(name, platform,
                               int(override) if override else None)
-    except AssertionError as e:
+    except GuardFailure as e:
         # distinct rc so the parent can tell a deterministic
         # correctness-guard failure from a device fault (no retry, no
         # descent, no CPU masking)
@@ -327,8 +356,15 @@ CONFIG_DESCENT = {
 def run_configs_isolated(timeout: float):
     """Parent mode for configs 2-4 on TPU: one watchdogged subprocess
     per config x ladder rung, CPU fallback per config, all rows written
-    to BENCH_CONFIGS.json with their own backend tags."""
+    to BENCH_CONFIGS.json with their own backend tags.
+
+    Worker-health context: rows measured within ~2-5 min of a worker
+    crash read 2-5x slow (round-3 session log), so every row is stamped
+    quiet_worker=true (no fault observed by this parent) or
+    secs_since_worker_fault, so a recovery-window reading cannot
+    masquerade as a regression in later comparisons."""
     out = []
+    last_fault_ts = None  # any failed/hung child attempt this run
     wedged = False  # one hang means a wedged device: stop probing it
     for name, spec in CONFIGS.items():
         ladder = (spec["tpu"]["n_envs"],) + CONFIG_DESCENT.get(name, ())
@@ -363,6 +399,7 @@ def run_configs_isolated(timeout: float):
                     break
                 last = (f"rc={payload}" if status == "failed"
                         else "hung past watchdog")
+                last_fault_ts = time.time()
                 print(f"bench: {name} n_envs={n_envs} {last}",
                       file=sys.stderr)
                 if status == "hung" and n_envs != ladder[-1]:
@@ -416,6 +453,12 @@ def run_configs_isolated(timeout: float):
             else:
                 row = {"metric": f"{name}_env_steps_per_sec_per_chip",
                        "error": f"attempts failed (last: {last})"}
+        if row.get("backend") == "tpu":
+            if last_fault_ts is None:
+                row["quiet_worker"] = True
+            else:
+                row["secs_since_worker_fault"] = round(
+                    time.time() - last_fault_ts)
         print(json.dumps(row))
         out.append(row)
     _write_configs_json(out)
@@ -458,7 +501,13 @@ def main():
         # on a host with no TPU this IS the CPU bench and its result is
         # relayed as-is (the 512-env CPU run finishes well inside the
         # watchdog timeout)
-        run_bench("default")
+        try:
+            run_bench("default")
+        except GuardFailure as e:
+            # deterministic correctness failure: surface it as GUARD_RC
+            # so the parent neither retries nor masks it with a CPU run
+            print(f"bench: guard failed: {e}", file=sys.stderr)
+            sys.exit(GUARD_RC)
         return
     if "--direct-one" in sys.argv:
         run_one(sys.argv[sys.argv.index("--direct-one") + 1])
@@ -481,6 +530,16 @@ def main():
         status, payload = _attempt(timeout, "--direct")
         if status == "ok":
             print(payload)
+            return
+        if status == "failed" and payload == GUARD_RC:
+            # deterministic correctness-guard failure on the TPU: do
+            # NOT retry or paper over it with a CPU fallback — print an
+            # error row so the failure is visible in the artifact
+            print(json.dumps({
+                "metric":
+                    "nakamoto_selfish_mining_env_steps_per_sec_per_chip",
+                "error": "correctness guard failed on tpu backend",
+            }))
             return
         if status == "hung":
             print(f"bench: TPU attempt hung past {timeout:.0f}s (wedged "

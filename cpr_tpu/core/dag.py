@@ -41,7 +41,22 @@ DEFENDER = 1
 
 @struct.dataclass
 class Dag:
-    parents: jnp.ndarray  # (B, P) int32, NONE-padded
+    # Parent slots as P separate (B,) int32 planes (NONE-padded):
+    # parents[p][b] is block b's p-th parent.  NOT an array — three TPU
+    # layout pathologies killed the matrix forms (round-4 device
+    # profiles at 16k envs): a (B, P) matrix pads P up to 128 lanes
+    # (~14x the logical bytes); a (P, B) matrix fixes padding but its
+    # vmapped column write (dynamic-update-slice) wants a batch-minor
+    # layout while the row reads want batch-major, so XLA keeps TWO
+    # copies alive with ~7 ms transposing copies per scan step.  As
+    # separate planes, writes are the same in-place row scatters as
+    # every other per-slot field and reads are free static picks.
+    parents: tuple
+    # free-form per-slot float32 protocol field written at append time
+    # (bk: leader-vote hash).  Exists so protocols can cache a derived
+    # scalar instead of re-gathering it through the padded parents
+    # matrix every step (leader_hash_all was 102 ms/step at 16k envs).
+    auxf: jnp.ndarray  # (B,) float32
     kind: jnp.ndarray  # (B,) int32, protocol block-type tag
     height: jnp.ndarray  # (B,) int32
     aux: jnp.ndarray  # (B,) int32, protocol field (vote id, depth, ...)
@@ -59,12 +74,18 @@ class Dag:
     overflow: jnp.ndarray  # () bool, capacity exceeded (episode invalid)
 
     @property
+    def parent0(self) -> jnp.ndarray:
+        """(B,) precursor plane (parent slot 0) — the one the chain
+        walks and slot-0 children scans read."""
+        return self.parents[0]
+
+    @property
     def capacity(self) -> int:
-        return self.parents.shape[0]
+        return self.parents[0].shape[-1]
 
     @property
     def max_parents(self) -> int:
-        return self.parents.shape[1]
+        return len(self.parents)
 
     def slots(self):
         """(B,) iota over block slots."""
@@ -78,7 +99,8 @@ def empty(capacity: int, max_parents: int) -> Dag:
     B, P = capacity, max_parents
     f = lambda fill, dt: jnp.full((B,), fill, dt)
     return Dag(
-        parents=jnp.full((B, P), NONE, jnp.int32),
+        parents=tuple(jnp.full((B,), NONE, jnp.int32) for _ in range(P)),
+        auxf=f(0.0, jnp.float32),
         kind=f(0, jnp.int32),
         height=f(0, jnp.int32),
         aux=f(0, jnp.int32),
@@ -99,14 +121,39 @@ def empty(capacity: int, max_parents: int) -> Dag:
 
 def append(dag: Dag, parents, *, kind=0, height=0, aux=0, pow_hash=NO_POW,
            signer=NONE, miner=NONE, vis_a=True, vis_d=True, time=0.0,
-           reward_atk=0.0, reward_def=0.0, progress=None):
+           reward_atk=0.0, reward_def=0.0, progress=None, auxf=0.0):
     """Append one block; returns (dag, index). `parents` is a (P,) int32
     row (NONE-padded); parent slot 0 is the precursor along which
     cumulative rewards accumulate (simulator.ml:377-388). `progress`
     defaults to cum_prog[precursor] + 1 when None-like is passed
     explicitly; pass the absolute progress value otherwise."""
+    dag, idx = append_if(
+        dag, jnp.bool_(True), parents, kind=kind, height=height, aux=aux,
+        pow_hash=pow_hash, signer=signer, miner=miner, vis_a=vis_a,
+        vis_d=vis_d, time=time, reward_atk=reward_atk,
+        reward_def=reward_def, progress=progress, auxf=auxf)
+    return dag, idx
+
+
+def append_if(dag: Dag, cond, parents, *, kind=0, height=0, aux=0,
+              pow_hash=NO_POW, signer=NONE, miner=NONE, vis_a=True,
+              vis_d=True, time=0.0, reward_atk=0.0, reward_def=0.0,
+              progress=None, auxf=0.0):
+    """`append` gated by traced bool `cond`; returns (dag, idx_or_NONE).
+
+    Replaces the append-then-rollback pattern
+    (``dag2, i = append(...); tree.map(where(cond), dag2, dag)``): the
+    full-state select costs two whole-DAG copies per call and, inside a
+    scan, defeats in-place carry updates.  Every field is written with a
+    row-level conditional scatter (see put below) on its own (B,) plane
+    — with parents stored as per-slot planes these are the same cheap
+    in-place updates as every other per-slot field.  (A (P, B) parents
+    MATRIX must not come back here: its vmapped column write wants a
+    batch-minor layout and XLA then keeps a second transposed copy of
+    the matrix alive across the scan, ~7 ms per step at 16k envs —
+    round-4 device profile.)"""
     idx = jnp.minimum(dag.n, dag.capacity - 1)
-    overflow = dag.overflow | (dag.n >= dag.capacity)
+    overflow = dag.overflow | (cond & (dag.n >= dag.capacity))
     p0 = parents[0]
     has_p0 = p0 >= 0
     base = jnp.where(has_p0, p0, 0)
@@ -116,32 +163,70 @@ def append(dag: Dag, parents, *, kind=0, height=0, aux=0, pow_hash=NO_POW,
         cum_prog = jnp.where(has_p0, dag.cum_prog[base], 0.0) + 1.0
     else:
         cum_prog = jnp.asarray(progress, jnp.float32)
+
+    def put(arr, value):
+        # row-level conditional scatter: .at[idx].set is an in-place
+        # carry update inside scans (a one-hot where() here forces a
+        # full read+write of every array per step — measured 1.3x
+        # slower end-to-end on chip; the scatter wins despite TPU's
+        # dislike of dynamic indices)
+        value = jnp.asarray(value, arr.dtype)
+        return arr.at[idx].set(jnp.where(cond, value, arr[idx]))
+
     dag = dag.replace(
-        parents=dag.parents.at[idx].set(parents),
-        kind=dag.kind.at[idx].set(kind),
-        height=dag.height.at[idx].set(height),
-        aux=dag.aux.at[idx].set(aux),
-        pow_hash=dag.pow_hash.at[idx].set(pow_hash),
-        signer=dag.signer.at[idx].set(signer),
-        miner=dag.miner.at[idx].set(miner),
-        vis_a=dag.vis_a.at[idx].set(vis_a),
-        vis_d=dag.vis_d.at[idx].set(vis_d),
-        vis_d_since=dag.vis_d_since.at[idx].set(
-            jnp.where(jnp.asarray(vis_d), jnp.asarray(time, jnp.float32),
-                      jnp.float32(jnp.inf))),
-        born_at=dag.born_at.at[idx].set(time),
-        cum_atk=dag.cum_atk.at[idx].set(cum_atk),
-        cum_def=dag.cum_def.at[idx].set(cum_def),
-        cum_prog=dag.cum_prog.at[idx].set(cum_prog),
-        n=jnp.minimum(dag.n + 1, dag.capacity),
+        parents=tuple(put(plane, parents[p])
+                      for p, plane in enumerate(dag.parents)),
+        auxf=put(dag.auxf, auxf),
+        kind=put(dag.kind, kind),
+        height=put(dag.height, height),
+        aux=put(dag.aux, aux),
+        pow_hash=put(dag.pow_hash, pow_hash),
+        signer=put(dag.signer, signer),
+        miner=put(dag.miner, miner),
+        vis_a=put(dag.vis_a, vis_a),
+        vis_d=put(dag.vis_d, vis_d),
+        vis_d_since=put(dag.vis_d_since,
+                        jnp.where(jnp.asarray(vis_d),
+                                  jnp.asarray(time, jnp.float32),
+                                  jnp.float32(jnp.inf))),
+        born_at=put(dag.born_at, time),
+        cum_atk=put(dag.cum_atk, cum_atk),
+        cum_def=put(dag.cum_def, cum_def),
+        cum_prog=put(dag.cum_prog, cum_prog),
+        n=jnp.minimum(dag.n + cond.astype(jnp.int32), dag.capacity),
         overflow=overflow,
     )
-    return dag, idx
+    return dag, jnp.where(cond, idx, NONE)
+
+
+def select_vis(cond, released: Dag, dag: Dag) -> Dag:
+    """where(cond, released, dag) specialized to what release() can
+    change: the two defender-visibility arrays.  A full-pytree
+    tree.map select copies every DAG field (parents included) twice per
+    call; release never touches anything else, so selecting vis_d /
+    vis_d_since alone keeps the scan carry update in place."""
+    return dag.replace(
+        vis_d=jnp.where(cond, released.vis_d, dag.vis_d),
+        vis_d_since=jnp.where(cond, released.vis_d_since,
+                              dag.vis_d_since),
+    )
 
 
 def children_mask(dag: Dag, v) -> jnp.ndarray:
     """(B,) mask of blocks having v among their parents (dag.ml:44)."""
-    return dag.exists() & (dag.parents == v).any(axis=1)
+    hit = dag.parents[0] == v
+    for plane in dag.parents[1:]:
+        hit = hit | (plane == v)
+    return dag.exists() & hit
+
+
+def children0_mask(dag: Dag, v) -> jnp.ndarray:
+    """(B,) mask of blocks whose PRECURSOR (parent slot 0) is v.  For
+    protocols where every attachment of interest rides slot 0 — bk votes
+    and proposals both precede via slot 0 — this replaces a padded
+    (B, P)-matrix scan with a flat (B,) compare (~10x cheaper on TPU,
+    see Dag.parent0)."""
+    return dag.exists() & (dag.parent0 == v)
 
 
 def release(dag: Dag, mask, time) -> Dag:
@@ -160,7 +245,7 @@ def parents_hit(dag: Dag, mask) -> jnp.ndarray:
     B = dag.capacity
     hits = jnp.zeros((B,), jnp.bool_)
     for p in range(dag.max_parents):
-        col = dag.parents[:, p]
+        col = dag.parents[p]
         hit = mask & (col >= 0)
         hits = hits | (
             jnp.zeros((B,), jnp.bool_).at[jnp.clip(col, 0)].max(hit))
@@ -195,27 +280,55 @@ def release_with_ancestors(dag: Dag, v, time) -> Dag:
 
 def release_chain(dag: Dag, tip, time) -> Dag:
     """Release `tip`, its full parent row, and walk down the precursor
-    chain until an already-defender-visible block. Equivalent to
-    `release_with_ancestors` whenever non-precursor parents (votes) sit
-    directly on precursor-chain blocks — true for all chain+vote protocols
-    here — but costs O(newly released) instead of a full-DAG ancestor
-    fixpoint per call."""
+    chain until a block that was defender-visible BEFORE this call.
+    Equivalent to `release_with_ancestors` whenever non-precursor parents
+    (votes) sit directly on precursor-chain blocks — true for all
+    chain+vote protocols here — but costs O(newly released) instead of a
+    full-DAG ancestor fixpoint per call.
+
+    The stop test uses each next tip's visibility as read before its row
+    was released: releasing block t's parent row marks row[0] visible, so
+    re-reading vis_d after the release would terminate the walk after one
+    iteration and under-release chains withheld deeper than 2.
+
+    The loop carries ONLY the two visibility arrays release() can
+    change; everything else (parents rows, existence) is read from the
+    enclosing dag.  Carrying the whole Dag re-materializes the padded
+    parents matrix every iteration — the dominant cost of withholding
+    steps at large batch on TPU."""
     B = dag.capacity
+    exists = dag.exists()
+    slots = jnp.arange(B, dtype=jnp.int32)
 
     def cond(carry):
-        dag, t = carry
-        return (t >= 0) & ~dag.vis_d[jnp.maximum(t, 0)]
+        _, _, t, t_vis = carry
+        return (t >= 0) & ~t_vis
 
     def body(carry):
-        dag, t = carry
-        row = dag.parents[t]
-        mask = jnp.zeros((B,), jnp.bool_).at[jnp.clip(row, 0)].max(row >= 0)
-        mask = mask.at[t].set(True)
-        dag = release(dag, mask, time)
-        return dag, row[0]
+        vis_d, vis_d_since, t, _ = carry
+        nxt = dag.parent0[t]
+        # pre-release visibility of the next tip: must be read before
+        # release() marks the whole row (nxt included) visible
+        nxt_vis = vis_d[jnp.maximum(nxt, 0)]
+        # release t + its parent row.  The row is read one PARENT SLOT
+        # at a time — dag.parents[p] is a free static slice of the
+        # (P, B) matrix and [t] a scalar gather — because a batched
+        # column gather (parents[:, t]) makes XLA keep a second,
+        # batch-minor copy of the whole matrix alive across the scan
+        # (two ~7 ms transposing copies per step at 16k envs).
+        mask = slots == t
+        for p in range(dag.max_parents):
+            v = dag.parents[p][t]
+            mask = mask | ((slots == v) & (v >= 0))
+        newly = mask & ~vis_d & exists
+        vis_d = vis_d | newly
+        vis_d_since = jnp.where(newly, time, vis_d_since)
+        return vis_d, vis_d_since, nxt, nxt_vis
 
-    dag, _ = jax.lax.while_loop(cond, body, (dag, tip))
-    return dag
+    tip_vis = dag.vis_d[jnp.maximum(tip, 0)]
+    vis_d, vis_d_since, _, _ = jax.lax.while_loop(
+        cond, body, (dag.vis_d, dag.vis_d_since, tip, tip_vis))
+    return dag.replace(vis_d=vis_d, vis_d_since=vis_d_since)
 
 
 def release_closure(dag: Dag, tip, time) -> Dag:
@@ -231,19 +344,26 @@ def release_closure(dag: Dag, tip, time) -> Dag:
     case (uncle nesting is rare), so per-step cost stays O(newly
     released) instead of release_with_ancestors' height-deep fixpoint."""
     dag = release_chain(dag, tip, time)
+    exists = dag.exists()
 
-    def missing(d):
-        ref = parents_hit(d, d.exists() & d.vis_d)
-        return ref & ~d.vis_d & d.exists()
+    def missing(vis_d):
+        # parents referenced by visible blocks but not yet visible
+        ref = parents_hit(dag, exists & vis_d)
+        return ref & ~vis_d & exists
 
     def body(carry):
-        d, m = carry
-        d = release(d, m, time)
-        return d, missing(d)
+        vis_d, vis_d_since, m = carry
+        newly = m & ~vis_d & exists
+        vis_d = vis_d | newly
+        vis_d_since = jnp.where(newly, time, vis_d_since)
+        return vis_d, vis_d_since, missing(vis_d)
 
-    dag, _ = jax.lax.while_loop(lambda c: c[1].any(), body,
-                                (dag, missing(dag)))
-    return dag
+    # the fixpoint, like the chain walk above, carries only the two
+    # visibility arrays (parents_hit reads the matrix from the closure)
+    vis_d, vis_d_since, _ = jax.lax.while_loop(
+        lambda c: c[2].any(), body,
+        (dag.vis_d, dag.vis_d_since, missing(dag.vis_d)))
+    return dag.replace(vis_d=vis_d, vis_d_since=vis_d_since)
 
 
 def walk_back(dag: Dag, tip, stop_fn):
@@ -256,8 +376,7 @@ def walk_back(dag: Dag, tip, stop_fn):
         return (i >= 0) & ~stop_fn(dag, i)
 
     def body(i):
-        nxt = dag.parents[i, 0]
-        return nxt
+        return dag.parent0[i]
 
     return jax.lax.while_loop(cond, body, tip)
 
@@ -289,8 +408,8 @@ def common_ancestor_by_height(dag: Dag, a, b):
         # step the higher one down; on ties step both
         step_x = hx >= hy
         step_y = hy >= hx
-        return (jnp.where(step_x, dag.parents[x, 0], x),
-                jnp.where(step_y, dag.parents[y, 0], y))
+        return (jnp.where(step_x, dag.parent0[x], x),
+                jnp.where(step_y, dag.parent0[y], y))
 
     x, y = jax.lax.while_loop(cond, body, (a, b))
     return x
@@ -299,8 +418,27 @@ def common_ancestor_by_height(dag: Dag, a, b):
 def top_k_by(score, mask, k: int, largest: bool = False):
     """Indices of the k best masked entries by score (ascending by
     default — used for smallest-hash vote selection). Returns (idx, valid)
-    where valid marks real entries (fewer than k may match)."""
-    s = jnp.where(mask, score, jnp.inf if not largest else -jnp.inf)
+    where valid marks real entries (fewer than k may match).
+
+    Small k extracts iteratively (k argmin/argmax passes) instead of
+    lax.top_k: on TPU top_k lowers to a full sort of the capacity-B
+    lane, ~3 ms per call at 16k envs x 520 slots (round-4 device
+    profile) — the extraction loop is ~5x cheaper and keeps top_k's
+    tie-by-lowest-index order (argmin/argmax return the first hit)."""
+    neutral = -jnp.inf if largest else jnp.inf
+    s = jnp.where(mask, score, neutral).astype(jnp.float32)
+    if k <= 16:
+        slots = jnp.arange(s.shape[-1], dtype=jnp.int32)
+        pick = jnp.argmax if largest else jnp.argmin
+        best = jnp.max if largest else jnp.min
+        idxs, valids = [], []
+        for _ in range(k):
+            j = pick(s).astype(jnp.int32)
+            v = best(s)
+            idxs.append(j)
+            valids.append(v != neutral)
+            s = jnp.where(slots == j, neutral, s)
+        return jnp.stack(idxs), jnp.stack(valids)
     if largest:
         vals, idx = jax.lax.top_k(s, k)
         valid = vals > -jnp.inf

@@ -112,6 +112,10 @@ class State:
 
 class BkSSZ(JaxEnv):
     n_actions = 8
+    # a fresh reset populates at most genesis + one first interaction
+    # (the _advance epilogue appends a vote or a defender proposal);
+    # see JaxEnv.reset_dag_rows contract + logical-reset parity test
+    reset_dag_rows = 2
 
     def __init__(self, k: int = 8, incentive_scheme: str = "constant",
                  unit_observation: bool = True, max_steps_hint: int = 256):
@@ -134,22 +138,31 @@ class BkSSZ(JaxEnv):
         return idx_mask & (dag.kind == BLOCK)
 
     def votes_on(self, dag, b, extra_mask=None):
-        """Mask of votes confirming block b (bk.ml:100-103)."""
-        m = D.children_mask(dag, b) & (dag.kind == VOTE)
+        """Mask of votes confirming block b (bk.ml:100-103).  Votes
+        attach to their block via parent slot 0, so the flat-precursor
+        scan suffices (Dag.parent0)."""
+        m = D.children0_mask(dag, b) & (dag.kind == VOTE)
         if extra_mask is not None:
             m = m & extra_mask
         return m
 
     def leader_hash(self, dag, b):
-        """Hash of the block's leader vote (parent slot 1); genesis has
-        none -> +inf == max_pow (bk.ml:205-215)."""
-        v0 = dag.parents[b, 1]
-        return jnp.where(v0 >= 0, dag.pow_hash[jnp.maximum(v0, 0)], D.NO_POW)
+        """Hash of the block's leader vote; genesis has none -> +inf ==
+        max_pow (bk.ml:205-215).  Cached in Dag.auxf at append time —
+        re-gathering it through the padded parents matrix cost
+        ~100 ms/step at 16k envs on chip."""
+        return dag.auxf[b]
 
     def leader_hash_all(self, dag):
-        """(B,) leader hash per block slot."""
-        v0 = dag.parents[:, 1]
-        return jnp.where(v0 >= 0, dag.pow_hash[jnp.clip(v0, 0)], D.NO_POW)
+        """(B,) leader hash per block slot (Dag.auxf cache)."""
+        return dag.auxf
+
+    def row_leader_hash(self, dag, row):
+        """Leader hash of a proposal row before it is appended: the
+        hash of its lead vote (row slot 1; votes are sorted ascending
+        by hash, bk.ml:110-132)."""
+        v0 = row[1]
+        return jnp.where(v0 >= 0, dag.pow_hash[jnp.maximum(v0, 0)], D.NO_POW)
 
     def cmp_blocks(self, dag, x, y, vote_filter_mask):
         """compare_blocks (bk.ml:217-226): height, then filtered confirming
@@ -187,7 +200,7 @@ class BkSSZ(JaxEnv):
         theirs = votes & (dag.aux != voter)
         my_hash = jnp.where(mine, dag.pow_hash, jnp.inf).min()
         # replace_hash: best leader among visible child blocks of b
-        child_blocks = D.children_mask(dag, b) & (dag.kind == BLOCK) & view_mask
+        child_blocks = D.children0_mask(dag, b) & (dag.kind == BLOCK) & view_mask
         replace_hash = jnp.where(
             child_blocks, self.leader_hash_all(dag), jnp.inf).min()
         nvotes = votes.sum()
@@ -237,35 +250,30 @@ class BkSSZ(JaxEnv):
 
     def append_proposal(self, dag, b, voter, vote_filter_mask, view_mask, time):
         """Append a quorum proposal on b if possible; returns
-        (dag, idx_or_-1)."""
+        (dag, idx_or_-1).  Row-level conditional append (D.append_if) —
+        the old append-then-rollback select copied the whole DAG twice
+        per call and dominated the step cost on chip."""
         found, row = self.quorum(dag, b, voter, vote_filter_mask, view_mask)
         atk, dfn = self.reward_of_block(dag, row, voter)
         height = dag.height[b] + 1
-
-        def do_append(dag):
-            dag2, idx = D.append(
-                dag, row, kind=BLOCK, height=height, aux=0,
-                signer=voter, miner=voter,
-                vis_a=True, vis_d=(voter == D.DEFENDER),
-                time=time, reward_atk=atk, reward_def=dfn,
-                progress=(height * self.k).astype(jnp.float32),
-            )
-            return dag2, idx
-
-        dag2, idx = do_append(dag)
-        # roll back if not found: keep original dag
-        dag = jax.tree.map(lambda a, b_: jnp.where(found, a, b_), dag2, dag)
-        return dag, jnp.where(found, idx, D.NONE)
+        return D.append_if(
+            dag, found, row, kind=BLOCK, height=height, aux=0,
+            signer=voter, miner=voter,
+            vis_a=True, vis_d=(voter == D.DEFENDER),
+            time=time, reward_atk=atk, reward_def=dfn,
+            progress=(height * self.k).astype(jnp.float32),
+            auxf=self.row_leader_hash(dag, row),
+        )
 
     # -- env API ----------------------------------------------------------
 
     def reset(self, key: jax.Array, params: EnvParams):
         dag = D.empty(self.capacity, self.max_parents)
-        # genesis block (bk.ml:48)
+        # genesis block (bk.ml:48); no leader vote -> +inf leader hash
         dag, root = D.append(
             dag, jnp.full((self.max_parents,), D.NONE, jnp.int32),
             kind=BLOCK, height=0, miner=D.NONE, vis_a=True, vis_d=True,
-            time=0.0, progress=0.0)
+            time=0.0, progress=0.0, auxf=D.NO_POW)
         z = jnp.int32(0)
         f = jnp.float32(0.0)
         state = State(
@@ -281,64 +289,88 @@ class BkSSZ(JaxEnv):
 
     def last_block(self, dag, x):
         """bk.ml:78-87: the block a vertex belongs to."""
-        return jnp.where(dag.kind[x] == BLOCK, x, dag.parents[x, 0])
+        return jnp.where(dag.kind[x] == BLOCK, x, dag.parent0[x])
 
     def _advance(self, state: State, params: EnvParams) -> State:
         """Produce the next attacker interaction: pending self-append,
-        defender proposal, or one mining draw (engine.ml:108-121 collapsed)."""
+        defender proposal, or one mining draw (engine.ml:108-121
+        collapsed).
+
+        The three cases are merged into ONE conditional row append
+        instead of nested lax.cond branches: under vmap a cond is a
+        select over both branch results, and selecting a whole State
+        (DAG included) copies every array per step — the dominant cost
+        on chip.  All selects here are scalar- or row-level; the RNG key
+        advances every step (iid splits — the same process
+        distribution; the pre-merge code consumed a split only on
+        mining steps)."""
         dag = state.dag
+        has_pending = state.pending_append >= 0
 
-        def with_pending(state):
-            # Append event: private moves to the proposal (bk_ssz.ml:212)
-            return state.replace(
-                private=state.pending_append,
-                event=jnp.int32(EV_APPEND),
-                pending_append=D.NONE,
-            )
+        # defender proposal on its preferred block (honest handler
+        # bk.ml:297-310 via quorum over defender-visible votes)
+        found, prow = self.quorum(dag, state.public, jnp.int32(D.DEFENDER),
+                                  dag.vis_d, dag.vis_d)
+        do_prop = ~has_pending & found
+        do_mine = ~has_pending & ~found
 
-        def without_pending(state):
-            dag = state.dag
-            # defender proposal on its preferred block (honest handler
-            # bk.ml:297-310 via quorum over defender-visible votes)
-            dag2, prop = self.append_proposal(
-                dag, state.public, jnp.int32(D.DEFENDER), dag.vis_d,
-                dag.vis_d, state.time)
+        # mining draw (drawn always, consumed when do_mine)
+        key, k_dt, k_mine, k_hash = jax.random.split(state.key, 4)
+        dt = jax.random.exponential(k_dt) * params.activation_delay
+        time = jnp.where(do_mine, state.time + dt, state.time)
+        attacker = jax.random.uniform(k_mine) < params.alpha
+        powh = jax.random.uniform(k_hash)
+        target = jnp.where(attacker, state.private, state.public)
+        vrow = jnp.full((self.max_parents,), D.NONE, jnp.int32
+                        ).at[0].set(target)
+        miner_v = jnp.where(attacker, D.ATTACKER, D.DEFENDER
+                            ).astype(jnp.int32)
 
-            def defender_proposes(state):
-                public = self.update_head(dag2, state.public, prop, dag2.vis_d)
-                return state.replace(dag=dag2, public=public,
-                                     event=jnp.int32(EV_NETWORK))
-
-            def mine(state):
-                dag = state.dag
-                key, k_dt, k_mine, k_hash = jax.random.split(state.key, 4)
-                dt = jax.random.exponential(k_dt) * params.activation_delay
-                time = state.time + dt
-                attacker = jax.random.uniform(k_mine) < params.alpha
-                powh = jax.random.uniform(k_hash)
-                target = jnp.where(attacker, state.private, state.public)
-                row = jnp.full((self.max_parents,), D.NONE, jnp.int32
-                               ).at[0].set(target)
-                miner = jnp.where(attacker, D.ATTACKER, D.DEFENDER)
-                dag, vote = D.append(
-                    dag, row, kind=VOTE, height=dag.height[target],
-                    aux=miner, pow_hash=powh, miner=miner,
-                    vis_a=True, vis_d=~attacker, time=time,
-                    progress=(dag.height[target] * self.k + 1).astype(jnp.float32))
-                # the defender's own vote lands on its preferred block, so
-                # its preference is unchanged; attacker-release preference
-                # flips happen at delivery time in _apply
-                return state.replace(
-                    dag=dag, public=state.public,
-                    event=jnp.where(attacker, EV_POW, EV_NETWORK).astype(jnp.int32),
-                    time=time, n_activations=state.n_activations + 1,
-                    key=key,
-                )
-
-            return jax.lax.cond(prop >= 0, defender_proposes, mine, state)
-
-        return jax.lax.cond(
-            state.pending_append >= 0, with_pending, without_pending, state)
+        h_prop = dag.height[state.public] + 1
+        h_tgt = dag.height[target]
+        atk, dfn = self.reward_of_block(dag, prow, jnp.int32(D.DEFENDER))
+        dag, idx = D.append_if(
+            dag, do_prop | do_mine,
+            jnp.where(do_prop, prow, vrow),
+            kind=jnp.where(do_prop, BLOCK, VOTE),
+            height=jnp.where(do_prop, h_prop, h_tgt),
+            aux=jnp.where(do_prop, 0, miner_v),
+            pow_hash=jnp.where(do_prop, D.NO_POW, powh),
+            signer=jnp.where(do_prop, D.DEFENDER, D.NONE),
+            miner=jnp.where(do_prop, D.DEFENDER, miner_v),
+            vis_a=True,
+            # defender's proposal is public; a mined vote starts withheld
+            # iff the attacker mined it.  (The defender's own vote lands
+            # on its preferred block, so its preference is unchanged;
+            # attacker-release preference flips happen at delivery time
+            # in _apply.)
+            vis_d=jnp.where(do_prop, True, ~attacker),
+            time=time,
+            reward_atk=jnp.where(do_prop, atk, 0.0),
+            reward_def=jnp.where(do_prop, dfn, 0.0),
+            progress=jnp.where(do_prop, h_prop * self.k,
+                               h_tgt * self.k + 1).astype(jnp.float32),
+            auxf=jnp.where(do_prop, self.row_leader_hash(dag, prow),
+                           D.NO_POW),
+        )
+        public = jnp.where(
+            do_prop,
+            self.update_head(dag, state.public, jnp.maximum(idx, 0),
+                             dag.vis_d),
+            state.public)
+        event = jnp.where(
+            has_pending, EV_APPEND,
+            jnp.where(do_prop, EV_NETWORK,
+                      jnp.where(attacker, EV_POW, EV_NETWORK))
+        ).astype(jnp.int32)
+        return state.replace(
+            dag=dag, public=public,
+            private=jnp.where(has_pending, state.pending_append,
+                              state.private),
+            event=event, pending_append=D.NONE, time=time,
+            n_activations=state.n_activations + do_mine.astype(jnp.int32),
+            key=key,
+        )
 
     def observe(self, state: State):
         """bk_ssz.ml:225-263."""
@@ -391,7 +423,7 @@ class BkSSZ(JaxEnv):
         # child; the reference takes the FIRST child block in insertion
         # order, not the best by leader hash (bk_ssz.ml:294-300), which
         # lowest-slot argmax reproduces exactly
-        child_blocks = D.children_mask(dag, blk) & (dag.kind == BLOCK)
+        child_blocks = D.children0_mask(dag, blk) & (dag.kind == BLOCK)
         has_prop = child_blocks.any()
         first_prop = jnp.argmax(child_blocks)
         use_prop = (tgt_v >= k) & has_prop
@@ -410,8 +442,7 @@ class BkSSZ(JaxEnv):
         # the chosen votes sit directly on the released block's chain, so a
         # flat release covers their ancestry
         released = D.release(released, vote_mask, state.time)
-        dag = jax.tree.map(
-            lambda a, b: jnp.where(is_release, a, b), released, dag)
+        dag = D.select_vis(is_release, released, dag)
 
         # deliver to the simulated defender (bk_ssz.ml:196-205)
         public = jnp.where(

@@ -156,23 +156,31 @@ class EthereumSSZ(JaxEnv):
         return v.astype(jnp.float32)
 
     def chain_window(self, dag, head):
-        """(nua, in_chain) masks for the uncle window at `head`
-        (ethereum.ml:237-246): `nua` = the up-to-6 proper chain ancestors,
+        """(ancestors, in_chain) for the uncle window at `head`
+        (ethereum.ml:237-246): `ancestors` = the up-to-6 proper chain
+        ancestors as scalar slot ids (-1 when the walk ran out),
         `in_chain` = head plus the walked blocks and their included
-        uncles (anc6's uncles excluded, exactly like the reference)."""
-        B = dag.capacity
-        nua = jnp.zeros((B,), jnp.bool_)
-        in_chain = jnp.zeros((B,), jnp.bool_).at[jnp.maximum(head, 0)].set(
-            head >= 0)
+        uncles (anc6's uncles excluded, exactly like the reference).
+
+        Returns scalars instead of a (B,) nua mask so the caller can
+        test "precursor is a window ancestor" with UNCLE_WINDOW scalar
+        compares against parent0 — indexing a nua mask with the whole
+        parent0 array is a batched (B,)->(B,) gather, ~11 ms/step at
+        4096 envs on v5e (round-4 device profile)."""
+        slots = dag.slots()
+        in_chain = (slots == jnp.maximum(head, 0)) & (head >= 0)
+        ancestors = []
         b = head
         for _ in range(UNCLE_WINDOW):
-            row = dag.parents[jnp.maximum(b, 0)]
-            p0 = row[0]
+            bi = jnp.maximum(b, 0)
+            p0 = dag.parent0[bi]
             has = (b >= 0) & (p0 >= 0)
-            nua = nua.at[jnp.clip(p0, 0)].max(has)
-            in_chain = in_chain.at[jnp.clip(row, 0)].max((row >= 0) & has)
-            b = jnp.where(has, p0, jnp.int32(-1))
-        return nua, in_chain
+            ancestors.append(jnp.where(has, p0, jnp.int32(-1)))
+            for plane in dag.parents:
+                v = plane[bi]
+                in_chain = in_chain | ((slots == v) & (v >= 0) & has)
+            b = ancestors[-1]
+        return ancestors, in_chain
 
     def uncle_candidates(self, dag, head, view_mask, filter_mask):
         """Mask of includable uncles for a block on `head`
@@ -180,10 +188,13 @@ class EthereumSSZ(JaxEnv):
         non-uncle ancestors, visible in the miner's view, passing the
         mining-rule filter. Mask semantics dedupe candidates reachable via
         several window blocks."""
-        nua, in_chain = self.chain_window(dag, head)
-        p0 = dag.parents[:, 0]
+        ancestors, in_chain = self.chain_window(dag, head)
+        p0 = dag.parent0
+        on_anc = (p0 == ancestors[0]) & (ancestors[0] >= 0)
+        for a in ancestors[1:]:
+            on_anc = on_anc | ((p0 == a) & (a >= 0))
         return (dag.exists() & view_mask & filter_mask
-                & (p0 >= 0) & nua[jnp.clip(p0, 0)] & ~in_chain)
+                & (p0 >= 0) & on_anc & ~in_chain)
 
     def select_uncles(self, dag, cand_mask, own_mask):
         """Top max_uncles candidates by (own first, lowest preference

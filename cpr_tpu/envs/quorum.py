@@ -45,7 +45,7 @@ def candidate_frame(dag, cand, C: int, vote_kind: int, max_vote_parents: int = 1
     adj = jnp.zeros((C, C), jnp.float32)
     escaped = jnp.zeros((C,), jnp.bool_)
     for p in range(max_vote_parents):
-        par = dag.parents[ci, p]
+        par = dag.parents[p][ci]
         par_is_vote = cvalid & (par >= 0) & (
             dag.kind[jnp.maximum(par, 0)] == vote_kind)
         pos = jnp.clip(jnp.searchsorted(sorted_slots, jnp.maximum(par, 0)),

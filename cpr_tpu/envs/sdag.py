@@ -125,7 +125,7 @@ class SdagSSZ(JaxEnv):
     def prev_block(self, dag, b):
         """A block's parents are votes confirming the previous block
         (sdag.ml:139-172), so the precursor block is parent 0's signer."""
-        p0 = dag.parents[b, 0]
+        p0 = dag.parent0[b]
         return jnp.where(p0 >= 0, self.last_block(dag, jnp.maximum(p0, 0)),
                          jnp.int32(-1))
 

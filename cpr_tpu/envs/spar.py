@@ -291,7 +291,7 @@ class SparSSZ(JaxEnv):
         # proposal fast path (spar_ssz.ml:283-291): if quorum-many votes
         # requested, prefer an existing block child (first in DAG order)
         child_blocks = (dag.exists() & (dag.kind == BLOCK)
-                        & (dag.parents[:, 0] == blk))
+                        & (dag.parent0 == blk))
         has_prop = child_blocks.any()
         first_prop = jnp.argmax(child_blocks)
         use_prop = (tgt_v >= k) & has_prop

@@ -198,7 +198,7 @@ class StreeSSZ(JaxEnv):
         for _ in range(self.C_MAX):
             valid = (cur >= 0) & (dag.kind[jnp.maximum(cur, 0)] == VOTE)
             closure = closure.at[jnp.maximum(cur, 0)].max(valid)
-            cur = jnp.where(valid, dag.parents[jnp.maximum(cur, 0), 0], -1)
+            cur = jnp.where(valid, dag.parent0[jnp.maximum(cur, 0)], -1)
         depth0 = dag.aux[jnp.maximum(leaves_row[0], 0)]
         r = jnp.where(discount, (depth0 + 1).astype(jnp.float32) / self.k,
                       1.0)
@@ -360,7 +360,7 @@ class StreeSSZ(JaxEnv):
         stale = Q.stale_after_adopt(
             dag, public, state.stale, is_adopt, self.release_scan,
             self.STALE_WALK, lambda d, i: self.last_block(d, i),
-            lambda d, i: d.parents[i, 0])
+            lambda d, i: d.parent0[i])
 
         # match race target: last block of the deepest released vertex,
         # armed only when a flipping prefix exists

@@ -171,7 +171,7 @@ class TailstormSSZ(JaxEnv):
         """Summary preceding s on the chain: the deepest quorum leaf's
         summary (tailstorm.ml:196 precursor, followed to the next
         summary). -1 for genesis."""
-        p0 = dag.parents[s, 0]
+        p0 = dag.parent0[s]
         return jnp.where(p0 >= 0, self.last_summary(dag, jnp.maximum(p0, 0)),
                          jnp.int32(-1))
 
@@ -208,7 +208,7 @@ class TailstormSSZ(JaxEnv):
         for _ in range(self.D_MAX):
             cols.append(cur)
             c = jnp.maximum(cur, 0)
-            nxt = dag.parents[c, 0]
+            nxt = dag.parent0[c]
             ok = (cur >= 0) & (nxt >= 0) & is_vote[jnp.maximum(nxt, 0)]
             cur = jnp.where(ok, nxt, -1)
         return jnp.stack(cols, axis=1)
@@ -322,9 +322,11 @@ class TailstormSSZ(JaxEnv):
                                  view_mask)
         atk, dfn = self.summary_reward(dag, row)
         height = dag.height[b] + 1
+        row_eq = dag.parents[0] == row[0]
+        for p in range(1, len(dag.parents)):
+            row_eq = row_eq & (dag.parents[p] == row[p])
         dup_mask = (dag.exists() & (dag.kind == SUMMARY)
-                    & (dag.height == height)
-                    & (dag.parents == row[None, :]).all(axis=1))
+                    & (dag.height == height) & row_eq)
         dup = jnp.where(dup_mask.any(),
                         jnp.argmax(dup_mask), D.NONE).astype(jnp.int32)
         fresh = found & (dup < 0)

@@ -40,7 +40,7 @@ class DagView:
 def view_of_env_state(dag) -> DagView:
     """DagView of a JAX env's Dag pytree (cpr_tpu.core.dag.Dag)."""
     n = int(dag.n)
-    parents = np.asarray(dag.parents)[:n]
+    parents = np.stack([np.asarray(p) for p in dag.parents], axis=1)[:n]
     view = DagView()
     fields = {
         "kind": np.asarray(dag.kind)[:n],

@@ -81,3 +81,27 @@ def test_observation_bounds():
         hi = np.asarray(env.high)
         o = np.asarray(obs)
         assert (o >= lo - 1e-6).all() and (o <= hi + 1e-6).all(), key
+
+
+def test_logical_reset_matches_full_select():
+    """The O(reset_dag_rows) logical DAG reset in auto-reset streams
+    (JaxEnv.select_reset) must be trajectory-identical to the full
+    tree.map select: slots >= reset_dag_rows are dead after a reset
+    (exists()-masked until an append rewrites every field), so only the
+    first rows plus (n, overflow) carry state across the boundary."""
+    from cpr_tpu.envs.bk import BkSSZ
+
+    env = BkSSZ(k=4, incentive_scheme="constant", max_steps_hint=64)
+    assert env.reset_dag_rows is not None
+    params = make_params(alpha=0.4, gamma=0.5, max_steps=12)
+    policy = env.policies["get-ahead"]
+    keys = jax.random.split(jax.random.PRNGKey(3), 16)
+    # >= 4 episode boundaries per stream at max_steps=12
+    fast = jax.vmap(lambda k: env.rollout(k, params, policy, 50))(keys)
+    env.reset_dag_rows = None  # force the always-safe full select
+    try:
+        full = jax.vmap(lambda k: env.rollout(k, params, policy, 50))(keys)
+    finally:
+        env.reset_dag_rows = type(env).reset_dag_rows
+    for a, b in zip(jax.tree.leaves(fast), jax.tree.leaves(full)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

@@ -48,7 +48,7 @@ def test_dag_structure_invariants(env):
     dag = state.dag
     n = int(dag.n)
     assert not bool(dag.overflow)
-    parents = np.asarray(dag.parents)[:n]
+    parents = np.stack([np.asarray(q) for q in dag.parents], axis=1)[:n]
     kind = np.asarray(dag.kind)[:n]
     height = np.asarray(dag.height)[:n]
     signer = np.asarray(dag.signer)[:n]

@@ -1,0 +1,103 @@
+"""Bank CPU baselines for the BASELINE target configs (VERDICT r3 #1).
+
+The reference publishes no numbers (BASELINE.md), so the defensible
+stand-in for "the reference engine on CPU" is this repo's own C++
+discrete-event oracle — the same simulation semantics as the reference's
+OCaml engine (protocol agents, per-node views, flooding), compiled
+native, driven by activations.  One activation == one env step in the
+SSZ attack spaces (each step assigns one PoW puzzle solution), so
+oracle activations/sec is directly comparable to the gym envs'
+env-steps/sec (reference metric shape:
+gym/ocaml/test/test_benchmark.py:13-23 measures episode wall-time for
+the same loop).
+
+Two rates per config:
+  - single_core: one OracleSim on one core — the reference's execution
+    model (one sim task = one process; csv_runner.ml parallelizes only
+    across tasks).
+  - socket: cpu_count() independent sims in parallel processes — the
+    fairest "whole host vs one chip" comparison.
+
+Writes BASELINE_CPU.json next to the repo root; bench.py reads it to
+stamp a vs_cpu_baseline field into every BENCH_CONFIGS row.
+
+Usage: python tools/cpu_baseline.py [--quick]
+"""
+
+import json
+import multiprocessing as mp
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+# (protocol, k, scheme, attacker_policy) per BASELINE config; alpha/gamma
+# match the bench configs (0.35 / 0.5, selfish_mining topology)
+ORACLE_CONFIGS = {
+    "nakamoto_sm1": ("nakamoto", 0, "", "sapirshtein-2016-sm1"),
+    "bk8_withholding": ("bk", 8, "constant", "get-ahead"),
+    "ethereum_uncle_attack": ("ethereum-byzantium", 0, "", "fn19"),
+    "tailstorm_ppo_train": ("tailstorm", 8, "", "get-ahead"),
+}
+
+
+def _rate_one(args):
+    (protocol, k, scheme, policy), n, seed = args
+    from cpr_tpu.native import OracleSim
+
+    s = OracleSim(protocol=protocol, k=k, scheme=scheme,
+                  topology="selfish_mining", alpha=0.35, gamma=0.5,
+                  attacker_policy=policy, seed=seed)
+    s.run(max(n // 20, 1000))  # warm caches / allocator
+    t0 = time.time()
+    s.run(n)
+    dt = time.time() - t0
+    s.close()
+    return n / dt
+
+
+def measure(name, n=200_000, workers=None):
+    spec = ORACLE_CONFIGS[name]
+    single = _rate_one((spec, n, 1))
+    workers = workers or (os.cpu_count() or 1)
+    row = {"single_core_steps_per_sec": round(single),
+           "socket_workers": workers}
+    if workers == 1:
+        # single-core host: the socket rate IS the single-core rate; a
+        # 1-worker pool would only measure spawn/import overhead
+        row["socket_steps_per_sec_sum"] = round(single)
+        return row
+    with mp.get_context("spawn").Pool(workers) as pool:
+        rates = pool.map(_rate_one,
+                         [(spec, n, 100 + i) for i in range(workers)])
+    # sum of independent per-worker warm rates (excludes pool startup;
+    # the honest steady-state aggregate for long sweeps)
+    row["socket_steps_per_sec_sum"] = round(sum(rates))
+    return row
+
+
+def main():
+    quick = "--quick" in sys.argv
+    n = 50_000 if quick else 200_000
+    out = {
+        "hardware": f"{os.cpu_count()}-core host CPU (single socket)",
+        "engine": "cpr_tpu C++ oracle (native/src/oracle.cpp), -O2",
+        "topology": "selfish_mining alpha=0.35 gamma=0.5",
+        "metric": "activations/sec == env-steps/sec (SSZ attack space)",
+        "configs": {},
+    }
+    for name in ORACLE_CONFIGS:
+        row = measure(name, n=n)
+        out["configs"][name] = row
+        print(json.dumps({"config": name, **row}), flush=True)
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "..", "BASELINE_CPU.json")
+    with open(os.path.abspath(path), "w") as f:
+        json.dump(out, f, indent=2)
+    print(f"wrote {os.path.abspath(path)}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

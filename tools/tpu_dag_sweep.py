@@ -1,0 +1,124 @@
+"""Batch-scaling sweep for the DAG-family bench configs (VERDICT r3 #1).
+
+Round-3 profiling (docs/TPU_SESSION_r03.md) showed DAG env steps are
+latency-bound (~0.4-0.5 ms/op) with batch size nearly free — so the
+aggregate env-steps/s should scale with n_envs until bandwidth binds.
+This tool measures one (config, n_envs, n_steps) point per invocation
+with separate phase timings (build/compile/per-rep) printed unbuffered,
+so a watchdogged driver can see WHERE time went when a point blows a
+timeout (compile growth vs execution growth vs a wedged worker).
+
+Usage: python tools/tpu_dag_sweep.py <bk|ethereum|tailstorm> <n_envs>
+           [n_steps] [chunk]
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+
+def log(msg):
+    print(f"[{time.strftime('%H:%M:%S')}] {msg}", file=sys.stderr,
+          flush=True)
+
+
+def measure_env(env, policy_name, n_envs, n_steps, max_steps, chunk, reps=2):
+    import jax
+    import numpy as np
+
+    from cpr_tpu.params import make_params
+
+    params = make_params(alpha=0.35, gamma=0.5, max_steps=max_steps)
+    policy = env.policies[policy_name]
+    keys = jax.random.split(jax.random.PRNGKey(0), n_envs)
+    t0 = time.time()
+    fn = env.make_episode_stats_fn(params, policy, n_steps, chunk=chunk)
+    log(f"built fn in {time.time() - t0:.1f}s; compiling "
+        f"(n_envs={n_envs} n_steps={n_steps} chunk={chunk} "
+        f"capacity={env.capacity})")
+    t0 = time.time()
+    stats = jax.block_until_ready(fn(keys))
+    compile_s = time.time() - t0
+    log(f"compile+first run {compile_s:.1f}s")
+    rep_s = []
+    for r in range(reps):
+        t0 = time.time()
+        stats = jax.block_until_ready(fn(keys))
+        rep_s.append(time.time() - t0)
+        log(f"rep {r}: {rep_s[-1]:.1f}s "
+            f"({n_envs * n_steps / rep_s[-1]:.0f} steps/s)")
+    atk = np.asarray(stats["episode_reward_attacker"]).mean()
+    dfn = np.asarray(stats["episode_reward_defender"]).mean()
+    rate = n_envs * n_steps / min(rep_s)
+    return rate, atk / (atk + dfn), compile_s, min(rep_s)
+
+
+def main():
+    config, n_envs = sys.argv[1], int(sys.argv[2])
+    n_steps = int(sys.argv[3]) if len(sys.argv) > 3 else 0
+    chunk = int(sys.argv[4]) if len(sys.argv) > 4 else 0
+
+    import jax
+    jax.config.update("jax_default_prng_impl", "threefry2x32")
+    jax.config.update("jax_threefry_partitionable", True)
+    log(f"backend={jax.devices()[0].platform}")
+
+    if config == "bk":
+        from cpr_tpu.envs.bk import BkSSZ
+        n_steps = n_steps or 256
+        env = BkSSZ(k=8, incentive_scheme="constant",
+                    max_steps_hint=n_steps)
+        rate, check, compile_s, rep_s = measure_env(
+            env, "get-ahead", n_envs, n_steps, n_steps - 8, chunk or None)
+    elif config == "ethereum":
+        from cpr_tpu.envs.ethereum import EthereumSSZ
+        n_steps = n_steps or 256
+        env = EthereumSSZ("byzantium", max_steps_hint=n_steps)
+        rate, check, compile_s, rep_s = measure_env(
+            env, "fn19", n_envs, n_steps, n_steps - 8, chunk or None)
+    elif config == "tailstorm":
+        import numpy as np
+        from cpr_tpu.envs.registry import get_sized
+        from cpr_tpu.params import make_params
+        from cpr_tpu.train.ppo import PPOConfig, make_train
+
+        rollout = n_steps or 128
+        env = get_sized("tailstorm-8-discount-heuristic", 256)
+        params = make_params(alpha=0.35, gamma=0.5, max_steps=248)
+        cfg = PPOConfig(n_envs=n_envs, n_steps=rollout)
+        init_fn, train_step = make_train(env, params, cfg)
+        t0 = time.time()
+        carry = jax.jit(init_fn)(jax.random.PRNGKey(0))
+        step = jax.jit(train_step)
+        carry, _ = step(carry)
+        jax.block_until_ready(carry)
+        compile_s = time.time() - t0
+        log(f"compile+first {compile_s:.1f}s")
+        rep_ts = []
+        for r in range(2):
+            t0 = time.time()
+            carry, metrics = step(carry)
+            jax.block_until_ready(carry)
+            rep_ts.append(time.time() - t0)
+            log(f"rep {r}: {rep_ts[-1]:.1f}s "
+                f"({n_envs * rollout / rep_ts[-1]:.0f} steps/s)")
+        rep_s = min(rep_ts)
+        rate = n_envs * rollout / rep_s
+        check = float(np.asarray(metrics["entropy"]))
+    else:
+        raise SystemExit(f"unknown config {config}")
+
+    print(json.dumps({
+        "config": config, "n_envs": n_envs, "n_steps": n_steps,
+        "chunk": chunk or None, "steps_per_sec": round(rate),
+        "check": round(float(check), 4), "compile_s": round(compile_s, 1),
+        "rep_s": round(rep_s, 1),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()
