@@ -72,30 +72,34 @@ def _chunk_scaled(n_envs: int, base_chunk: int, base_envs: int):
     return max(16, base_chunk * base_envs // max(n_envs, base_envs))
 
 
-def measure_bk(n_envs: int, n_steps: int = 512, reps: int = 3):
+def measure_bk(n_envs: int, n_steps: int = 256, reps: int = 3):
     """BASELINE config 2: Bk k=8 vote-withholding (get-ahead), vmap'd
-    episode batch.  chunk 128 @4096 envs measured 35.2k steps/s on chip
-    (the unchunked 512-step call ran ~60 s — at the worker's ceiling;
-    chunk 183 measured 16.4k)."""
+    episode batch.  Round-4 sweep (tools/tpu_dag_sweep.py): the
+    aggregate rate peaks at 4096 envs x 256-step episodes (capacity
+    520), ~350-360k steps/s on chip, unchunked (one rep runs ~3 s, far
+    inside the worker's ~60-75 s per-call ceiling); 8k/16k/32k envs
+    measure LOWER (336k/315k/268k)."""
     from cpr_tpu.envs.bk import BkSSZ
 
     env = BkSSZ(k=8, incentive_scheme="constant", max_steps_hint=n_steps)
+    chunk = None if n_envs <= 4096 else _chunk_scaled(n_envs, 256, 4096)
     return _measure_episodes(env, "get-ahead", n_envs, n_steps, reps,
-                             max_steps=n_steps - 8,
-                             chunk=_chunk_scaled(n_envs, 128, 4096))
+                             max_steps=n_steps - 8, chunk=chunk)
 
 
-def measure_ethereum(n_envs: int, n_steps: int = 256, reps: int = 3):
+def measure_ethereum(n_envs: int, n_steps: int = 4096, reps: int = 2):
     """BASELINE config 3: Ethereum byzantium uncle-mining attack (FN'19
-    policy), large batched episodes.  chunk 64 @16384 envs measured
-    41.7k steps/s on chip; 65536 envs kills the axon worker at any
-    chunk length and is no longer probed by default (see CONFIGS)."""
+    policy), 65k batched episodes.  The 65k figure is EPISODES, not
+    envs: 4096 envs is the measured-fastest batch (round-4 sweep: 120k
+    steps/s vs 114k at 8192 envs; the old 16384-env shape measured 42k,
+    and 65536 envs killed the axon worker), so this config runs 4096
+    auto-resetting streams for 4096 steps in 256-step chunks —
+    4096 * 4096 / 248 ~ 67k completed episodes per rep."""
     from cpr_tpu.envs.ethereum import EthereumSSZ
 
-    env = EthereumSSZ("byzantium", max_steps_hint=n_steps)
+    env = EthereumSSZ("byzantium", max_steps_hint=256)
     return _measure_episodes(env, "fn19", n_envs, n_steps, reps,
-                             max_steps=n_steps - 8,
-                             chunk=_chunk_scaled(n_envs, 64, 16384))
+                             max_steps=248, chunk=256)
 
 
 def measure_tailstorm_ppo(n_envs: int, rollout_len: int = 128,
@@ -233,9 +237,10 @@ def run_bench(platform_hint: str):
 # follow BASELINE.json; CPU fallbacks shrink so the watchdog always gets
 # a tagged number.
 CONFIGS = {
-    # dict order is the measurement order for BOTH paths; ethereum runs
-    # LAST because its 65k-env kernel is the one observed to fault the
-    # TPU device (round-3 session log)
+    # dict order is the measurement order for BOTH paths; every TPU
+    # size below is the round-4 sweep winner (tools/tpu_dag_sweep.py):
+    # the aggregate DAG-env rate PEAKS at 4096 envs and declines at
+    # larger batches, so "bigger batch" is no longer the default
     "bk8_withholding": dict(
         fn="measure_bk", tpu=dict(n_envs=4096), cpu=dict(n_envs=128),
         guard=(0.05, 0.6), guard_name="get-ahead revenue share"),
@@ -243,19 +248,14 @@ CONFIGS = {
         fn="measure_tailstorm_ppo", tpu=dict(n_envs=4096),
         cpu=dict(n_envs=64), guard=(0.0, 2.1),
         guard_name="policy entropy (2 actions + quorum head)"),
-    # BASELINE prescribes 65536 envs, but that shape kills the axon
-    # worker at ANY chunk length — with O(1)-memory stat accumulators
-    # and a donated carry — and each crash lengthens the worker's
-    # recovery window, spoiling the rest of the run (round-3 session
-    # log).  Default to the largest size the device demonstrably runs;
-    # probe 65536 explicitly with
-    #   CPR_BENCH_NENVS=65536 python bench.py --direct-one ethereum_uncle_attack
-    # if the worker stack changes (the --configs parent sets
-    # CPR_BENCH_NENVS itself per rung, so the env var only reaches the
-    # child through --direct-one).
+    # BASELINE config 3 prescribes 65k batched EPISODES: delivered as
+    # 4096 auto-resetting streams x 4096 steps (~67k episodes/rep, see
+    # measure_ethereum).  The literal 65536-env shape killed the axon
+    # worker at any chunk length (round-3 session log) and measured
+    # 3x slower per step at 16384 envs than at 4096 anyway.
     "ethereum_uncle_attack": dict(
-        fn="measure_ethereum", tpu=dict(n_envs=16384),
-        cpu=dict(n_envs=256), guard=(0.33, 0.55),
+        fn="measure_ethereum", tpu=dict(n_envs=4096),
+        cpu=dict(n_envs=256, n_steps=1024), guard=(0.33, 0.55),
         guard_name="fn19 revenue share"),
 }
 
@@ -349,7 +349,7 @@ def run_one(name: str):
 # FAULT the runner steps down so a size-dependent failure (memory
 # pressure) still yields an on-chip number at a recorded smaller batch.
 CONFIG_DESCENT = {
-    "ethereum_uncle_attack": (4096,),
+    "ethereum_uncle_attack": (2048,),
 }
 
 

@@ -52,11 +52,20 @@ class Dag:
     # separate planes, writes are the same in-place row scatters as
     # every other per-slot field and reads are free static picks.
     parents: tuple
-    # free-form per-slot float32 protocol field written at append time
-    # (bk: leader-vote hash).  Exists so protocols can cache a derived
-    # scalar instead of re-gathering it through the padded parents
-    # matrix every step (leader_hash_all was 102 ms/step at 16k envs).
+    # free-form per-slot float32 protocol fields written at append time
+    # (bk: auxf = leader-vote hash; tailstorm: auxf/auxg = the summary's
+    # own attacker/defender coinbase).  Exist so protocols can cache a
+    # derived scalar instead of re-gathering it through parent
+    # indirections every step (bk's leader-hash re-gather was
+    # 102 ms/step at 16k envs).
     auxf: jnp.ndarray  # (B,) float32
+    auxg: jnp.ndarray  # (B,) float32
+    # free-form per-slot int32 protocol pointer written at append time
+    # (tailstorm: the summary this summary extends; sdag: a block's
+    # previous block).  Caches one level of parent indirection so chain
+    # walks cost one gather per level instead of three (parent0 ->
+    # kind -> signer).
+    aux2: jnp.ndarray  # (B,) int32, NONE when unused
     kind: jnp.ndarray  # (B,) int32, protocol block-type tag
     height: jnp.ndarray  # (B,) int32
     aux: jnp.ndarray  # (B,) int32, protocol field (vote id, depth, ...)
@@ -101,6 +110,8 @@ def empty(capacity: int, max_parents: int) -> Dag:
     return Dag(
         parents=tuple(jnp.full((B,), NONE, jnp.int32) for _ in range(P)),
         auxf=f(0.0, jnp.float32),
+        auxg=f(0.0, jnp.float32),
+        aux2=f(NONE, jnp.int32),
         kind=f(0, jnp.int32),
         height=f(0, jnp.int32),
         aux=f(0, jnp.int32),
@@ -121,7 +132,8 @@ def empty(capacity: int, max_parents: int) -> Dag:
 
 def append(dag: Dag, parents, *, kind=0, height=0, aux=0, pow_hash=NO_POW,
            signer=NONE, miner=NONE, vis_a=True, vis_d=True, time=0.0,
-           reward_atk=0.0, reward_def=0.0, progress=None, auxf=0.0):
+           reward_atk=0.0, reward_def=0.0, progress=None, auxf=0.0,
+           auxg=0.0, aux2=NONE):
     """Append one block; returns (dag, index). `parents` is a (P,) int32
     row (NONE-padded); parent slot 0 is the precursor along which
     cumulative rewards accumulate (simulator.ml:377-388). `progress`
@@ -131,14 +143,15 @@ def append(dag: Dag, parents, *, kind=0, height=0, aux=0, pow_hash=NO_POW,
         dag, jnp.bool_(True), parents, kind=kind, height=height, aux=aux,
         pow_hash=pow_hash, signer=signer, miner=miner, vis_a=vis_a,
         vis_d=vis_d, time=time, reward_atk=reward_atk,
-        reward_def=reward_def, progress=progress, auxf=auxf)
+        reward_def=reward_def, progress=progress, auxf=auxf, auxg=auxg,
+        aux2=aux2)
     return dag, idx
 
 
 def append_if(dag: Dag, cond, parents, *, kind=0, height=0, aux=0,
               pow_hash=NO_POW, signer=NONE, miner=NONE, vis_a=True,
               vis_d=True, time=0.0, reward_atk=0.0, reward_def=0.0,
-              progress=None, auxf=0.0):
+              progress=None, auxf=0.0, auxg=0.0, aux2=NONE):
     """`append` gated by traced bool `cond`; returns (dag, idx_or_NONE).
 
     Replaces the append-then-rollback pattern
@@ -177,6 +190,8 @@ def append_if(dag: Dag, cond, parents, *, kind=0, height=0, aux=0,
         parents=tuple(put(plane, parents[p])
                       for p, plane in enumerate(dag.parents)),
         auxf=put(dag.auxf, auxf),
+        auxg=put(dag.auxg, auxg),
+        aux2=put(dag.aux2, aux2),
         kind=put(dag.kind, kind),
         height=put(dag.height, height),
         aux=put(dag.aux, aux),
@@ -249,6 +264,24 @@ def parents_hit(dag: Dag, mask) -> jnp.ndarray:
         hit = mask & (col >= 0)
         hits = hits | (
             jnp.zeros((B,), jnp.bool_).at[jnp.clip(col, 0)].max(hit))
+    return hits
+
+
+def parents_hit_dense(dag: Dag, mask) -> jnp.ndarray:
+    """parents_hit via a dense (B, B) compare per plane instead of a
+    batched scatter.  On TPU a vmapped scatter with a (B,)-wide index
+    vector serializes (~9 ms per plane at 4096 envs x B=264 — round-4
+    device profile); the dense compare is plain elementwise work and an
+    any-reduce, ~10x cheaper for small-capacity DAGs.  O(B^2) per plane:
+    use only where B^2 x P stays modest (ethereum's release closure at
+    B=264, P=3); the scatter form wins for big-B x many-plane DAGs."""
+    slots = jnp.arange(dag.capacity, dtype=jnp.int32)
+    hits = jnp.zeros((dag.capacity,), jnp.bool_)
+    for p in range(dag.max_parents):
+        col = dag.parents[p]
+        m = mask & (col >= 0)
+        hits = hits | (m[:, None] & (col[:, None] == slots[None, :])
+                       ).any(axis=0)
     return hits
 
 
@@ -348,7 +381,7 @@ def release_closure(dag: Dag, tip, time) -> Dag:
 
     def missing(vis_d):
         # parents referenced by visible blocks but not yet visible
-        ref = parents_hit(dag, exists & vis_d)
+        ref = parents_hit_dense(dag, exists & vis_d)
         return ref & ~vis_d & exists
 
     def body(carry):

@@ -39,21 +39,26 @@ Documented deviations from the reference event-queue simulation:
 - Measured against the C++ multi-node oracle's BkAgent
   (tests/test_oracle_equivalence.py): honest play agrees within 0.01
   for alpha <= 1/3 (drifting to ~0.02 by alpha = 0.4).  `get-ahead`
-  carries a STRUCTURAL collapse deviation, characterized at
-  (alpha=0.45, gamma=0.5): oracle - env = +0.0445 at k=1 and -0.0325
-  at k=4.  Decomposition (2026-07, 5-seed oracle runs, 512-env
-  episodes): (a) episode truncation is NOT the cause — env revenue is
-  invariant from 128 to 512 steps (+-0.002); (b) the multi-node/delay
-  component is NOT the cause at moderate gamma — the oracle's
-  two_agents and selfish_mining topologies agree within 0.007 at
-  gamma <= 0.5 (gamma=0.9 diverges ~+0.12: delay-shuffled vote arrival
-  starts flipping defender preferences, which the collapse cannot
-  express — documented out-of-model); (c) the residual is the
-  vote-race/proposal-timing granularity itself (one attacker
-  interaction per step vs event interleaving), opposite in sign
-  between k=1 and k=4.  The cross-engine anchor pins these measured
-  gaps at +-0.02 — a characterized-deviation regression bound, not a
-  parity claim.
+  carries a STRUCTURAL deviation, characterized at (alpha=0.45,
+  gamma=0.5): oracle - env = +0.0445 at k=1 and -0.0325 at k=4.
+  Decomposition (rounds 3-4, tools/bk_gap_decompose.py): (a) episode
+  truncation is NOT the cause — env revenue is invariant from 128 to
+  512 steps (+-0.002); (b) the multi-node/delay component is NOT the
+  cause at moderate gamma — the oracle's two_agents and selfish_mining
+  topologies agree within 0.007 at gamma <= 0.5 (gamma=0.9 diverges
+  ~+0.12: delay-shuffled vote arrival starts flipping defender
+  preferences — documented out-of-model); (c) the k=1 gap IS
+  gym-vs-simulator interaction granularity: the gym engine
+  (engine.ml:97-273, which this env implements) gives the attacker a
+  separate `Append` interaction right after its own proposal lands,
+  while the simulator's event-driven agent re-acts only at the next
+  event — grafting Append granularity onto the oracle
+  ("get-ahead-appendint") closes 95% of the k=1 gap
+  (test_bk_gym_granularity_parity pins the matched-granularity
+  agreement at <=0.015); (d) the k=4 residual is NOT granularity
+  (the graft moves it away from zero) — it is the multi-defender
+  vote-race during release propagation, inexpressible in the 2-party
+  collapse; its anchor stays a pinned characterized gap at +-0.02.
 """
 
 from __future__ import annotations

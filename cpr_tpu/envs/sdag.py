@@ -122,12 +122,15 @@ class SdagSSZ(JaxEnv):
     def last_block(self, dag, x):
         return jnp.where(dag.kind[x] == BLOCK, x, dag.signer[x])
 
+    def last_block_all(self, dag):
+        """(B,) last_block per slot, elementwise (no gather)."""
+        return jnp.where(dag.kind == BLOCK, dag.slots(), dag.signer)
+
     def prev_block(self, dag, b):
-        """A block's parents are votes confirming the previous block
-        (sdag.ml:139-172), so the precursor block is parent 0's signer."""
-        p0 = dag.parent0[b]
-        return jnp.where(p0 >= 0, self.last_block(dag, jnp.maximum(p0, 0)),
-                         jnp.int32(-1))
+        """A block's previous block (sdag.ml:139-172: parent 0's signer).
+        Cached in Dag.aux2 at append time — the walked form cost three
+        chained gathers per chain level."""
+        return dag.aux2[b]
 
     def block_lca(self, dag, a, b):
         """Common ancestor along the block chain (heights drop by 1 per
@@ -213,15 +216,15 @@ class SdagSSZ(JaxEnv):
         the selected set (finalize_quorum, sdag.ml:366-377), -1 padded."""
         cand = self.confirming(dag, b) & vote_filter_mask & view_mask
         own = dag.miner == voter
-        cidx, cvalid, abits = Q.candidate_frame(
+        cidx, cvalid, abits, oh = Q.candidate_frame(
             dag, cand, self.C_MAX, VOTE, max_vote_parents=self.max_parents)
         if self.subblock_selection == "altruistic":
             seen = jnp.where(voter == D.ATTACKER, dag.born_at,
                              dag.vis_d_since)
             n, S, _, _ = Q.quorum_altruistic(
-                dag, cidx, cvalid, abits, own, seen, dag.aux, self.q)
+                dag, cidx, cvalid, abits, oh, own, seen, dag.aux, self.q)
         else:
-            own_c = own[jnp.maximum(cidx, 0)]
+            own_c = (Q.oh_gather(oh, own) > 0.5)
             S, n = self._select_heuristic(cidx, cvalid, abits, own_c)
         # true leaves: x in S with no other S-member having x in its
         # closure (column count == 1)
@@ -273,7 +276,10 @@ class SdagSSZ(JaxEnv):
             vis_d=(miner == D.DEFENDER), time=time,
             reward_atk=jnp.where(full, atk, 0.0),
             reward_def=jnp.where(full, dfn, 0.0),
-            progress=progress)
+            progress=progress,
+            # blocks cache their previous block (prev_block); votes
+            # keep NONE (their chain queries go through signer)
+            aux2=jnp.where(full, head, D.NONE))
         return dag, idx, full
 
     # -- env API (mirrors cpr_tpu.envs.stree) -------------------------------
@@ -363,7 +369,7 @@ class SdagSSZ(JaxEnv):
         cands = dag.exists() & ~dag.vis_d & ~state.stale
         return Q.prefix_release_sets(
             dag, state.public, state.private, cands, self.release_scan,
-            lambda d, i: self.last_block(d, i), self.cmp_blocks)
+            self.last_block_all(dag), self.cmp_blocks)
 
     def _apply(self, state: State, action) -> State:
         dag = state.dag
@@ -378,15 +384,14 @@ class SdagSSZ(JaxEnv):
                          jnp.where(is_match, match_set,
                                    jnp.zeros_like(match_set)))
         released = D.release(dag, mask, state.time)
-        dag = jax.tree.map(
-            lambda a, b: jnp.where(is_release, a, b), released, dag)
+        dag = D.select_vis(is_release, released, dag)
 
         public = jnp.where(is_override & found, new_head, state.public)
         private = jnp.where(is_adopt, public, state.private)
 
         stale = Q.stale_after_adopt(
             dag, public, state.stale, is_adopt, self.release_scan,
-            self.STALE_WALK, lambda d, i: self.last_block(d, i),
+            self.STALE_WALK, self.last_block_all(dag),
             lambda d, i: self.prev_block(d, i))
 
         rel_tip = jnp.where(match_set, dag.slots(), -1).max()

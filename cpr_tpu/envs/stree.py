@@ -136,6 +136,10 @@ class StreeSSZ(JaxEnv):
     def last_block(self, dag, x):
         return jnp.where(dag.kind[x] == BLOCK, x, dag.signer[x])
 
+    def last_block_all(self, dag):
+        """(B,) last_block per slot, elementwise (no gather)."""
+        return jnp.where(dag.kind == BLOCK, dag.slots(), dag.signer)
+
     def vote_score(self, dag):
         """compare_votes_in_block (stree.ml:96-100): depth desc, ties in
         DAG (slot) order."""
@@ -160,12 +164,12 @@ class StreeSSZ(JaxEnv):
         """k-1 sized vote-closure selection (stree.ml:383-486)."""
         cand = self.confirming(dag, b) & vote_filter_mask & view_mask
         own = dag.miner == voter
-        cidx, cvalid, abits = Q.candidate_frame(dag, cand, self.C_MAX, VOTE)
+        cidx, cvalid, abits, oh = Q.candidate_frame(dag, cand, self.C_MAX, VOTE)
         if self.subblock_selection == "altruistic":
             seen = jnp.where(voter == D.ATTACKER, dag.born_at,
                              dag.vis_d_since)
             n, _, leaves_c, n_cand = Q.quorum_altruistic(
-                dag, cidx, cvalid, abits, own, seen, dag.aux, self.q)
+                dag, cidx, cvalid, abits, oh, own, seen, dag.aux, self.q)
             found = (n == self.q) & (n_cand >= self.q)
         elif self.subblock_selection == "optimal":
             # stree pays discount r = (depth+1)/k and also pays the
@@ -173,7 +177,7 @@ class StreeSSZ(JaxEnv):
             # depth_plus=1 and miner_share=1; leaf preference follows
             # this env's vote_score so punish pays the scored branch
             found, leaves_c = Q.quorum_optimal_or_heuristic(
-                dag, cidx, cvalid, abits, own, dag.aux, self.q,
+                dag, cidx, cvalid, abits, oh, own, dag.aux, self.q,
                 self.opt_window, self.opt_combos, k=self.k,
                 discount=self.incentive_scheme in ("discount", "hybrid"),
                 punish=self.incentive_scheme in ("punish", "hybrid"),
@@ -181,7 +185,7 @@ class StreeSSZ(JaxEnv):
                 miner_share=1)
         else:
             found, leaves_c = Q.quorum_heuristic(
-                dag, cidx, cvalid, abits, own, self.q)
+                dag, cidx, cvalid, abits, oh, own, self.q)
         row = Q.leaves_to_row(dag, cidx, leaves_c, cvalid, self.q,
                               self.vote_score(dag))
         return found, row
@@ -335,7 +339,7 @@ class StreeSSZ(JaxEnv):
         cands = dag.exists() & ~dag.vis_d & ~state.stale
         return Q.prefix_release_sets(
             dag, state.public, state.private, cands, self.release_scan,
-            lambda d, i: self.last_block(d, i), self.cmp_blocks)
+            self.last_block_all(dag), self.cmp_blocks)
 
     def _apply(self, state: State, action) -> State:
         """stree_ssz.ml:272-314."""
@@ -351,15 +355,14 @@ class StreeSSZ(JaxEnv):
                          jnp.where(is_match, match_set,
                                    jnp.zeros_like(match_set)))
         released = D.release(dag, mask, state.time)
-        dag = jax.tree.map(
-            lambda a, b: jnp.where(is_release, a, b), released, dag)
+        dag = D.select_vis(is_release, released, dag)
 
         public = jnp.where(is_override & found, new_head, state.public)
         private = jnp.where(is_adopt, public, state.private)
 
         stale = Q.stale_after_adopt(
             dag, public, state.stale, is_adopt, self.release_scan,
-            self.STALE_WALK, lambda d, i: self.last_block(d, i),
+            self.STALE_WALK, self.last_block_all(dag),
             lambda d, i: d.parent0[i])
 
         # match race target: last block of the deepest released vertex,

@@ -167,13 +167,18 @@ class TailstormSSZ(JaxEnv):
         """tailstorm.ml:113-121."""
         return jnp.where(dag.kind[x] == SUMMARY, x, dag.signer[x])
 
+    def last_summary_all(self, dag):
+        """(B,) last_summary of every slot, elementwise — indexing with
+        dag.slots() compiles to a full batched gather (~13 ms/step at
+        4096 envs), where() on the columns is free."""
+        return jnp.where(dag.kind == SUMMARY, dag.slots(), dag.signer)
+
     def prev_summary(self, dag, s):
-        """Summary preceding s on the chain: the deepest quorum leaf's
-        summary (tailstorm.ml:196 precursor, followed to the next
-        summary). -1 for genesis."""
-        p0 = dag.parent0[s]
-        return jnp.where(p0 >= 0, self.last_summary(dag, jnp.maximum(p0, 0)),
-                         jnp.int32(-1))
+        """Summary preceding s on the chain (tailstorm.ml:196 precursor,
+        followed to the next summary). -1 for genesis.  Cached in
+        Dag.aux2 at append time: the walked form (parent0 -> kind ->
+        signer) cost three chained gathers per chain level."""
+        return dag.aux2[s]
 
     def summary_lca(self, dag, a, b):
         """Common ancestor of two summaries along the summary chain
@@ -230,11 +235,10 @@ class TailstormSSZ(JaxEnv):
 
     def own_reward(self, dag, s, my):
         """The summary's own coinbase share for party `my` — used as the
-        update_head tiebreak (tailstorm.ml:539-549). Delta of the
-        cumulative column across the precursor summary."""
-        cum = jnp.where(my == D.ATTACKER, dag.cum_atk, dag.cum_def)
-        prev = self.prev_summary(dag, s)
-        return cum[s] - jnp.where(prev >= 0, cum[jnp.maximum(prev, 0)], 0.0)
+        update_head tiebreak (tailstorm.ml:539-549).  Cached per slot in
+        Dag.auxf (attacker) / Dag.auxg (defender) at append time — the
+        cumulative-column delta needed a prev_summary walk per read."""
+        return jnp.where(my == D.ATTACKER, dag.auxf[s], dag.auxg[s])
 
     def cmp_summaries(self, dag, x, y, vote_filter_mask, my):
         """compare_blocks (tailstorm.ml:539-549): height, then filtered
@@ -267,18 +271,18 @@ class TailstormSSZ(JaxEnv):
         beyond C_MAX drops the newest candidates."""
         cand = self.confirming(dag, b) & vote_filter_mask & view_mask
         own = dag.miner == voter
-        cidx, cvalid, abits = Q.candidate_frame(dag, cand, self.C_MAX, VOTE)
+        cidx, cvalid, abits, oh = Q.candidate_frame(dag, cand, self.C_MAX, VOTE)
         if self.subblock_selection == "altruistic":
             seen = jnp.where(voter == D.ATTACKER, dag.born_at,
                              dag.vis_d_since)
             n, _, leaves_c, n_cand = Q.quorum_altruistic(
-                dag, cidx, cvalid, abits, own, seen, dag.aux, self.k)
+                dag, cidx, cvalid, abits, oh, own, seen, dag.aux, self.k)
             found = (n == self.k) & (n_cand >= self.k)
         elif self.subblock_selection == "optimal":
             # tailstorm pays discount r = depth/k and pays votes only
             # (no summary-miner share, tailstorm.ml:204-218)
             found, leaves_c = Q.quorum_optimal_or_heuristic(
-                dag, cidx, cvalid, abits, own, dag.aux, self.k,
+                dag, cidx, cvalid, abits, oh, own, dag.aux, self.k,
                 self.opt_window, self.opt_combos, k=self.k,
                 discount=self.incentive_scheme in ("discount", "hybrid"),
                 punish=self.incentive_scheme in ("punish", "hybrid"),
@@ -287,25 +291,38 @@ class TailstormSSZ(JaxEnv):
                 miner_share=0)
         else:
             found, leaves_c = Q.quorum_heuristic(
-                dag, cidx, cvalid, abits, own, self.k)
+                dag, cidx, cvalid, abits, oh, own, self.k)
         score = dag.aux.astype(jnp.float32) - dag.pow_hash  # depth - hash
         row = Q.leaves_to_row(dag, cidx, leaves_c, cvalid, self.k, score)
-        return found, row
+        return found, row, (cidx, cvalid, abits, oh, leaves_c)
 
-    def summary_reward(self, dag, row):
-        """Coinbase of a summary draft (tailstorm.ml:204-227)."""
+    def summary_reward(self, dag, row, frame):
+        """Coinbase of a summary draft (tailstorm.ml:204-227), computed
+        on the candidate frame: the quorum's closure requirement means
+        every selected vote's ancestors sit inside the frame, so the
+        closure is a union of abits rows and the miner counts are frame-
+        local matmul gathers — the old per-leaf vote_ancestors walk was
+        D_MAX batched gathers per call."""
         discount = self.incentive_scheme in ("discount", "hybrid")
         punish = self.incentive_scheme in ("punish", "hybrid")
-        B = dag.capacity
-        leaves = row[:1] if punish else row
-        anc = self.vote_ancestors(dag, leaves)
-        closure = jnp.zeros((B,), jnp.bool_)
-        for i in range(anc.shape[0]):
-            closure = self.mark_closure(anc[i], closure)
+        cidx, cvalid, abits, oh, leaves_c = frame
+        if punish:
+            # only the best-score leaf's branch is paid; row[0] is that
+            # leaf (leaves_to_row sorts by the same score)
+            score_c = jnp.where(
+                cvalid, Q.oh_gather(
+                    oh, dag.aux.astype(jnp.float32) - dag.pow_hash),
+                -jnp.inf)
+            j = jnp.argmax(jnp.where(leaves_c, score_c, -jnp.inf))
+            sel = abits[j] & leaves_c.any()
+        else:
+            sel = (leaves_c[:, None] & abits).any(axis=0)
+        own_att = Q.oh_gather(oh, dag.miner == D.ATTACKER) > 0.5
+        own_def = Q.oh_gather(oh, dag.miner == D.DEFENDER) > 0.5
         depth0 = dag.aux[jnp.maximum(row[0], 0)]
         r = jnp.where(discount, depth0.astype(jnp.float32) / self.k, 1.0)
-        atk = r * (closure & (dag.miner == D.ATTACKER)).sum()
-        dfn = r * (closure & (dag.miner == D.DEFENDER)).sum()
+        atk = r * (sel & own_att).sum()
+        dfn = r * (sel & own_def).sum()
         return atk, dfn
 
     def append_summary(self, dag, b, voter, vote_filter_mask, view_mask,
@@ -318,9 +335,9 @@ class TailstormSSZ(JaxEnv):
         (simulator.ml:138-158 — redundant appends return the existing
         vertex and trigger no events). Rows are canonical (sorted by
         depth desc, hash asc), so row equality == quorum equality."""
-        found, row = self.quorum(dag, b, voter, vote_filter_mask,
-                                 view_mask)
-        atk, dfn = self.summary_reward(dag, row)
+        found, row, frame = self.quorum(dag, b, voter, vote_filter_mask,
+                                        view_mask)
+        atk, dfn = self.summary_reward(dag, row, frame)
         height = dag.height[b] + 1
         row_eq = dag.parents[0] == row[0]
         for p in range(1, len(dag.parents)):
@@ -330,14 +347,14 @@ class TailstormSSZ(JaxEnv):
         dup = jnp.where(dup_mask.any(),
                         jnp.argmax(dup_mask), D.NONE).astype(jnp.int32)
         fresh = found & (dup < 0)
-        dag2, idx = D.append(
-            dag, row, kind=SUMMARY, height=height, aux=0,
+        dag, idx = D.append_if(
+            dag, fresh, row, kind=SUMMARY, height=height, aux=0,
             signer=D.NONE, miner=voter,
             vis_a=True, vis_d=(voter == D.DEFENDER),
             time=time, reward_atk=atk, reward_def=dfn,
             progress=(height * self.k).astype(jnp.float32),
+            auxf=atk, auxg=dfn, aux2=b,
         )
-        dag = jax.tree.map(lambda a, b_: jnp.where(fresh, a, b_), dag2, dag)
         out = jnp.where(fresh, idx, jnp.where(found, dup, D.NONE))
         return dag, out, fresh
 
@@ -507,17 +524,15 @@ class TailstormSSZ(JaxEnv):
         the defender's own summary reward (tailstorm.ml:539-549)."""
         dag = state.dag
 
-        def extra(dag_, sids):
-            return self.own_reward(dag_, sids, jnp.int32(D.DEFENDER))
-
         def cmp(dag_, x, y, mask):
             return self.cmp_summaries(dag_, x, y, mask,
                                       jnp.int32(D.DEFENDER))
 
         cands = dag.exists() & ~dag.vis_d & ~state.stale
+        last_all = self.last_summary_all(dag)
         return Q.prefix_release_sets(
             dag, state.public, state.private, cands, self.release_scan,
-            lambda d, i: self.last_summary(d, i), cmp, extra_key=extra)
+            last_all, cmp, extra_all=dag.auxg)
 
     def _apply(self, state: State, action) -> State:
         """tailstorm_ssz.ml:292-350."""
@@ -532,8 +547,7 @@ class TailstormSSZ(JaxEnv):
         mask = jnp.where(is_override, override_set,
                          jnp.where(is_match, match_set, jnp.zeros_like(match_set)))
         released = D.release(dag, mask, state.time)
-        dag = jax.tree.map(
-            lambda a, b: jnp.where(is_release, a, b), released, dag)
+        dag = D.select_vis(is_release, released, dag)
 
         # deliver to the simulated defender
         public = jnp.where(is_override & found, new_head, state.public)
@@ -541,7 +555,7 @@ class TailstormSSZ(JaxEnv):
         def_dirty = state.def_dirty | (is_release & mask.any())
         stale = Q.stale_after_adopt(
             dag, public, state.stale, is_adopt, self.release_scan,
-            self.STALE_WALK, lambda d, i: self.last_summary(d, i),
+            self.STALE_WALK, self.last_summary_all(dag),
             lambda d, i: self.prev_summary(d, i))
 
         # match race target: deepest released summary's chain tip; armed

@@ -22,6 +22,10 @@ DEFAULT_PROTOCOLS = (
     ("bk", dict(k=4, scheme="constant")),
     ("bk", dict(k=8, scheme="constant")),
     ("bk", dict(k=8, scheme="block")),
+    # tailstorm rows feed the reference report's second pivot
+    # (honest_net.py:68-75: reward-activations gini delta)
+    ("tailstorm", dict(k=8, scheme="constant")),
+    ("tailstorm", dict(k=8, scheme="discount")),
 )
 
 DEFAULT_ACTIVATION_DELAYS = (30.0, 60.0, 120.0, 300.0, 600.0)

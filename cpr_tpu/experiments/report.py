@@ -1,0 +1,91 @@
+"""Executable reports: the reference's end-consumption tables as plain
+functions + rendered text, no notebook runtime required.
+
+Reference counterparts:
+- experiments/simulate/honest_net.py:35-77 — expand the honest-net TSV
+  into gini/weakest/strongest stats and print two pivots
+  (efficiency_weakest and tailstorm's reward-activations gini delta by
+  block interval x (protocol, k, scheme)),
+- experiments/rl-eval/rl-results-condensed.ipynb — the policy-vs-alpha
+  model table of attacker relative revenue,
+- mdp/justfile:1-8's numbered-notebook pipeline, which consumes the
+  same artifacts.
+
+Each report returns structured data (dict pivots / row lists) AND a
+rendered text table, and optionally writes the expanded TSVs the
+reference writes — so `python examples/report_study.py` reproduces the
+reference's tables end-to-end from a fresh sweep.
+"""
+
+from __future__ import annotations
+
+from cpr_tpu.experiments.analysis import efficiency_pivot, expand_rows
+from cpr_tpu.experiments.honest_net import honest_net_rows
+from cpr_tpu.experiments.rl_eval import aggregate, episode_rows
+from cpr_tpu.experiments.sweep import write_tsv
+
+
+def render_pivot(pivot: dict, index_name: str, value_name: str) -> str:
+    """Nested {col_key: {index: value}} dict -> aligned text table."""
+    cols = sorted(pivot.keys(), key=str)
+    idx = sorted({i for col in pivot.values() for i in col})
+    head = [index_name] + [str(c) for c in cols]
+    lines = ["\t".join(head)]
+    for i in idx:
+        cells = [str(i)]
+        for c in cols:
+            v = pivot[c].get(i)
+            cells.append("-" if v is None else f"{v:.4f}")
+        lines.append("\t".join(cells))
+    return "\n".join(lines) + f"\n[{value_name}]"
+
+
+def honest_net_report(rows=None, *, out_tsv=None, **sweep_kwargs):
+    """The honest_net.py report end-to-end: sweep (or take rows),
+    expand per-node arrays into gini/weakest/strongest stats, build the
+    reference's two pivots, optionally write the expanded TSV.
+
+    Returns (expanded_rows, pivots, text) where pivots maps the pivot
+    name to the {(protocol, k, scheme): {activation_delay: value}}
+    nested dict (honest_net.py:63-75's two print() pivots)."""
+    if rows is None:
+        rows = honest_net_rows(**sweep_kwargs)
+    expanded = expand_rows(rows)
+    pivots = {
+        "efficiency_weakest": efficiency_pivot(
+            expanded, value="efficiency_weakest"),
+        "tailstorm_reward_activations_gini_delta": efficiency_pivot(
+            [r for r in expanded if "tailstorm" in str(r["protocol"])],
+            value="reward_activations_gini_delta"),
+    }
+    text = "\n\n".join(
+        render_pivot(p, "activation_delay", name)
+        for name, p in pivots.items() if p)
+    if out_tsv:
+        write_tsv(expanded, out_tsv)
+    return expanded, pivots, text
+
+
+def rl_eval_report(protocol_key: str = "nakamoto", *, out_tsv=None,
+                   **eval_kwargs):
+    """The rl-results-condensed model table end-to-end: per-episode
+    eval rows for every built-in policy over an alpha grid, aggregated
+    to mean attacker relative revenue per (policy, alpha, gamma).
+
+    Returns (episode_rows, table_rows, text); table_rows are the
+    aggregate() records (policy, alpha, gamma, episodes, relative
+    revenue mean/std), the condensed table the reference's rl-eval
+    notebooks end on."""
+    rows = episode_rows(protocol_key, **eval_kwargs)
+    table = aggregate(rows)
+    cols = ("protocol", "policy", "kind", "alpha", "gamma", "n",
+            "relrew_mean", "relrew_std", "rpp_mean", "orphans_mean")
+    lines = ["\t".join(cols)]
+    for r in table:
+        lines.append("\t".join(
+            f"{r[c]:.4f}" if isinstance(r[c], float) else str(r[c])
+            for c in cols))
+    text = "\n".join(lines)
+    if out_tsv:
+        write_tsv(table, out_tsv)
+    return rows, table, text

@@ -1210,7 +1210,11 @@ struct EthAgent final : Agent {
 // Override releases the private block at the target height plus just
 // enough withheld votes to flip the defenders' preference.
 struct BkAgent final : Agent {
-  // policy: 0 honest, 1 get-ahead
+  // policy: 0 honest, 1 get-ahead,
+  //         2 get-ahead + gym-style Append interactions (the agent
+  //           re-runs its action logic right after appending a
+  //           proposal, at unchanged simulation time — the reference
+  //           gym engine's `Append` event granularity)
   int k = 1;
 
   // the release machinery shares withheld ancestors implicitly (quorum
@@ -1253,64 +1257,77 @@ struct BkAgent final : Agent {
       // defender proposals can also beat the private tip outright
       if (d.blocks[cand].height > d.blocks[priv].height) priv = cand;
     }
-    int ca = common_anc(d, pub, priv);
-    int pub_b = d.blocks[pub].height - d.blocks[ca].height;
-    int priv_b = d.blocks[priv].height - d.blocks[ca].height;
-
-    enum { ADOPT, OVERRIDE, WAIT };
-    int act;
-    if (policy == 0)  // honest (bk_ssz.ml:349-352)
-      act = pub_b > priv_b ? ADOPT : OVERRIDE;
-    else  // get-ahead (bk_ssz.ml:354-360)
-      act = pub_b > priv_b ? ADOPT : (pub_b < priv_b ? OVERRIDE : WAIT);
 
     std::vector<int> share;
-    if (act == ADOPT) {
-      priv = pub;
-    } else if (act == OVERRIDE) {
-      // release targeting (bk_ssz.ml:271-283)
-      int nv_pub = public_votes_on(s, pub);
-      int tgt_h = d.blocks[pub].height + (nv_pub >= k ? 1 : 0);
-      int tgt_v = nv_pub >= k ? 0 : nv_pub + 1;
-      int blk = priv;
-      while (d.blocks[blk].height > tgt_h && d.blocks[blk].miner >= 0)
-        blk = d.blocks[blk].parents[0];
-      int rel = blk;
-      if (tgt_v >= k) {  // prefer an existing proposal child
-        for (int c : d.blocks[blk].children)
-          if (!d.blocks[c].is_vote) {
-            rel = c;
-            tgt_v = 0;
-            break;
-          }
+    // policy 2 re-runs the action after appending its own proposal —
+    // the gym engine's `Append` interaction at unchanged sim time; 1+k
+    // bounds the cascade (one proposal can complete per quorum height)
+    int rounds = policy == 2 ? 1 + k : 1;
+    for (int round = 0; round < rounds; round++) {
+      int ca = common_anc(d, pub, priv);
+      int pub_b = d.blocks[pub].height - d.blocks[ca].height;
+      int priv_b = d.blocks[priv].height - d.blocks[ca].height;
+
+      enum { ADOPT, OVERRIDE, WAIT };
+      int act;
+      if (policy == 0)  // honest (bk_ssz.ml:349-352)
+        act = pub_b > priv_b ? ADOPT : OVERRIDE;
+      else  // get-ahead (bk_ssz.ml:354-360)
+        act = pub_b > priv_b ? ADOPT : (pub_b < priv_b ? OVERRIDE : WAIT);
+
+      if (act == ADOPT) {
+        priv = pub;
+      } else if (act == OVERRIDE) {
+        // release targeting (bk_ssz.ml:271-283)
+        int nv_pub = public_votes_on(s, pub);
+        int tgt_h = d.blocks[pub].height + (nv_pub >= k ? 1 : 0);
+        int tgt_v = nv_pub >= k ? 0 : nv_pub + 1;
+        int blk = priv;
+        while (d.blocks[blk].height > tgt_h && d.blocks[blk].miner >= 0)
+          blk = d.blocks[blk].parents[0];
+        int rel = blk;
+        if (tgt_v >= k) {  // prefer an existing proposal child
+          for (int c : d.blocks[blk].children)
+            if (!d.blocks[c].is_vote) {
+              rel = c;
+              tgt_v = 0;
+              break;
+            }
+        }
+        share.push_back(rel);
+        // + earliest-seen withheld votes on the released block
+        std::vector<int> held;
+        for (int c : d.blocks[rel].children)
+          if (d.blocks[c].is_vote && !is_public(s, c)) held.push_back(c);
+        std::stable_sort(held.begin(), held.end(), [&](int a, int c) {
+          return d.blocks[a].time < d.blocks[c].time;
+        });
+        int public_already = public_votes_on(s, rel);
+        int taken = 0;
+        for (int i = 0; i < (int)held.size() && public_already + taken < tgt_v;
+             i++, taken++)
+          share.push_back(held[i]);
+        for (int y : share) mark_sent(y, d.blocks.size());
+        if (pub_better(s, rel, pub)) pub = rel;
       }
-      share.push_back(rel);
-      // + earliest-seen withheld votes on the released block
-      std::vector<int> held;
-      for (int c : d.blocks[rel].children)
-        if (d.blocks[c].is_vote && !is_public(s, c)) held.push_back(c);
-      std::stable_sort(held.begin(), held.end(), [&](int a, int c) {
-        return d.blocks[a].time < d.blocks[c].time;
-      });
-      int public_already = public_votes_on(s, rel);
-      for (int i = 0; i < (int)held.size() && public_already + i < tgt_v;
-           i++)
-        share.push_back(held[i]);
-      for (int y : share) mark_sent(y, d.blocks.size());
-      if (pub_better(s, rel, pub)) pub = rel;
-    }
-    // one attacker proposal attempt per interaction on the (post-action)
-    // private tip, like the env's append_proposal at the end of _apply —
-    // a defender vote can complete an attacker-led quorum, so this must
-    // run on every event, not just own PoW (Proceed's inclusive vote
-    // filter == node-0 visibility)
-    for (Block& prop : s.proto->proposals(s, 0, priv)) {
-      int id = s.append_plain(0, std::move(prop));
-      if (!s.is_visible(0, id)) {
-        s.mark_visible(0, id);
-        s.unlock_children(0, id);
+      // one attacker proposal attempt per interaction on the
+      // (post-action) private tip, like the env's append_proposal at the
+      // end of _apply — a defender vote can complete an attacker-led
+      // quorum, so this must run on every event, not just own PoW
+      // (Proceed's inclusive vote filter == node-0 visibility)
+      bool appended = false;
+      for (Block& prop : s.proto->proposals(s, 0, priv)) {
+        int id = s.append_plain(0, std::move(prop));
+        if (!s.is_visible(0, id)) {
+          s.mark_visible(0, id);
+          s.unlock_children(0, id);
+        }
+        if (d.blocks[id].height > d.blocks[priv].height) {
+          priv = id;
+          appended = true;
+        }
       }
-      if (d.blocks[id].height > d.blocks[priv].height) priv = id;
+      if (!appended) break;
     }
     return share;
   }
@@ -1725,8 +1742,16 @@ void* cpr_oracle_create(const char* protocol, int k, const char* scheme,
       auto* a = new BkAgent();
       a->k = k;
       s.agent.reset(a);
-      s.agent->policy = pol == "honest" ? 0
-                        : pol == "get-ahead" ? 1 : -1;
+      // "-appendint": gym-engine interaction granularity — the agent
+      // re-acts immediately after appending its own proposal (the
+      // engine's `Append` interaction, engine.ml:97-273), instead of
+      // waiting for the next simulation event.  Used by the
+      // gym-vs-simulator deviation decomposition
+      // (tools/bk_gap_decompose.py), not a reference behavior.
+      s.agent->policy = pol == "honest"              ? 0
+                        : pol == "get-ahead"         ? 1
+                        : pol == "get-ahead-appendint" ? 2
+                                                     : -1;
     } else if (proto == "spar" || proto == "stree" ||
                proto == "tailstorm" || proto == "sdag") {
       auto* a = new ParAgent();

@@ -1,0 +1,43 @@
+"""End-to-end report study: reproduce the reference's honest-net pivots
+and the rl-eval condensed model table from fresh sweeps (the numbered-
+notebook consumption layer as one executable —
+experiments/simulate/honest_net.py:35-77 and
+experiments/rl-eval/rl-results-condensed.ipynb).
+
+Usage: python examples/report_study.py [out_dir] [protocol-key]
+"""
+
+import _bootstrap  # noqa: F401  (repo-root path + backend pick)
+
+import os
+import sys
+
+from cpr_tpu.experiments.report import honest_net_report, rl_eval_report
+
+
+def main():
+    out_dir = sys.argv[1] if len(sys.argv) > 1 else None
+    key = sys.argv[2] if len(sys.argv) > 2 else "nakamoto"
+    if out_dir:
+        os.makedirs(out_dir, exist_ok=True)
+
+    hn_tsv = os.path.join(out_dir, "honest_net_expanded.tsv") \
+        if out_dir else None
+    _, _, text = honest_net_report(out_tsv=hn_tsv,
+                                   n_activations=5_000)
+    print("== honest-net pivots (honest_net.py:62-75) ==")
+    print(text or "(no rows)")
+
+    rl_tsv = os.path.join(out_dir, "rl_results_condensed.tsv") \
+        if out_dir else None
+    _, _, text = rl_eval_report(key, out_tsv=rl_tsv,
+                                alphas=(0.25, 0.33, 0.4, 0.45),
+                                episode_len=256, reps=16)
+    print("\n== rl-results condensed model table ==")
+    print(text)
+    if out_dir:
+        print(f"\nwrote TSVs to {out_dir}/")
+
+
+if __name__ == "__main__":
+    main()

@@ -61,7 +61,14 @@ def main():
         print(f"device RTDP: {time.time() - t0:.1f}s; revenue >= "
               f"{rev:.4f} (lower bound; honest = 0.3)")
         return
-    vi = sharded_value_iteration(tm, default_mesh(), stop_delta=1e-6)
+    # chunked VI always: the while-loop impl runs one unbounded device
+    # execution, and the axon TPU worker kills any single execution
+    # past ~60-75 s (tools/tpu_limit_probe.py) — exactly what a
+    # multi-thousand-sweep solve is.  Chunk sized so a call stays far
+    # inside the ceiling even at cutoff 8's 5.27M rows (~1-5 sweeps/s).
+    chunk = 16 if mdp.n_transitions > 1_000_000 else 64
+    vi = sharded_value_iteration(tm, default_mesh(), stop_delta=1e-6,
+                                 impl="chunked", chunk=chunk)
     rev = tm.start_value(vi["vi_value"]) / tm.start_value(
         vi["vi_progress"])
     print(f"sharded VI: {int(vi['vi_iter'])} sweeps in "

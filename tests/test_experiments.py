@@ -328,3 +328,41 @@ def test_config_battery_trains_each_family():
             eval=cfg.eval.model_copy(update=dict(freq=100))))
         params, history, rows = train_from_config(cfg, n_updates=1)
         assert np.isfinite(history[-1]["mean_step_reward"]), name
+
+
+def test_report_layer_tables():
+    """The executable report layer (cpr_tpu.experiments.report)
+    reproduces the reference's end tables with the expected shape:
+    honest_net.py:62-75's two pivots and the rl-results-condensed
+    model table."""
+    from cpr_tpu.experiments.report import (honest_net_report,
+                                            render_pivot,
+                                            rl_eval_report)
+
+    protos = (("nakamoto", {}),
+              ("bk", dict(k=4, scheme="constant")),
+              ("tailstorm", dict(k=4, scheme="discount")))
+    delays = (30.0, 120.0)
+    expanded, pivots, text = honest_net_report(
+        protocols=protos, activation_delays=delays, n_nodes=5,
+        n_activations=600)
+    assert len(expanded) == len(protos) * len(delays)
+    eff = pivots["efficiency_weakest"]
+    # one pivot column per protocol config, one cell per delay
+    assert len(eff) == len(protos)
+    for col in eff.values():
+        assert set(col) == set(delays)
+        for v in col.values():
+            assert 0.0 <= v <= 2.0
+    tail = pivots["tailstorm_reward_activations_gini_delta"]
+    assert len(tail) == 1 and set(next(iter(tail.values()))) == set(delays)
+    assert "efficiency_weakest" in text
+
+    rows, table, text2 = rl_eval_report(
+        "nakamoto", alphas=(0.25, 0.4), episode_len=64, reps=4)
+    policies = {r["policy"] for r in table}
+    assert len(policies) >= 2  # the env's hard-coded policy battery
+    assert {r["alpha"] for r in table} == {0.25, 0.4}
+    for r in table:
+        assert r["n"] >= 1 and 0.0 <= r["relrew_mean"] <= 1.0
+    assert text2.splitlines()[0].startswith("protocol\tpolicy")

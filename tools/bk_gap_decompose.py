@@ -1,0 +1,85 @@
+"""Mechanism decomposition of the bk get-ahead cross-engine gap
+(VERDICT r3 weak #4 / next #5).
+
+The pinned deviation (tests/test_oracle_equivalence.py): at alpha=0.45,
+gamma=0.5 the C++ simulator's BkAgent earns oracle - env = +0.0445 at
+k=1 and -0.0325 at k=4 relative revenue vs the JAX env.
+
+Hypothesis under test: the gap is GYM-vs-SIMULATOR interaction
+granularity, present in the reference too — the gym engine
+(engine.ml:97-273) gives the attacker a separate `Append` interaction
+immediately after its own proposal is appended (same simulated time), so
+a gym policy reacts one event EARLIER than the simulator's event-driven
+agent, which only re-acts at the next PoW/delivery event.  The JAX env
+implements gym semantics; the oracle implements simulator semantics.
+
+Experiment: BkAgent policy "get-ahead-appendint" re-runs its action
+logic after appending a proposal (at unchanged sim time) — the gym
+granularity grafted onto the simulator.  If the hypothesis holds, the
+appendint oracle moves toward the env number at k=1 (where proposals
+complete on every vote and the extra interaction fires constantly).
+
+Usage: python tools/bk_gap_decompose.py [acts] [n_envs]
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+
+def oracle_share(policy, k, alpha, acts, seeds=5):
+    from cpr_tpu.native import OracleSim
+
+    vals = []
+    for seed in range(seeds):
+        s = OracleSim(protocol="bk", k=k, scheme="constant",
+                      topology="selfish_mining", alpha=alpha, gamma=0.5,
+                      attacker_policy=policy, seed=seed + 1)
+        s.run(acts)
+        # attacker share over ALL nodes (the defender cloud has
+        # ceil(1/(1-gamma)) members, not one)
+        r = s.rewards(8)
+        s.close()
+        vals.append(r[0] / max(sum(r), 1e-9))
+    m = sum(vals) / len(vals)
+    sd = (sum((v - m) ** 2 for v in vals) / max(len(vals) - 1, 1)) ** 0.5
+    return m, sd
+
+
+def env_share(k, alpha, n_envs, max_steps=192):
+    import jax
+    import numpy as np
+
+    from cpr_tpu.envs.bk import BkSSZ
+    from cpr_tpu.params import make_params
+
+    env = BkSSZ(k=k, incentive_scheme="constant", max_steps_hint=max_steps)
+    params = make_params(alpha=alpha, gamma=0.5, max_steps=max_steps)
+    keys = jax.random.split(jax.random.PRNGKey(0), n_envs)
+    f = jax.jit(jax.vmap(lambda key: env.episode_stats(
+        key, params, env.policies["get-ahead"], max_steps + 32)))
+    st = jax.block_until_ready(f(keys))
+    a = np.asarray(st["episode_reward_attacker"]).mean()
+    d = np.asarray(st["episode_reward_defender"]).mean()
+    return a / (a + d)
+
+
+def main():
+    acts = int(sys.argv[1]) if len(sys.argv) > 1 else 60_000
+    n_envs = int(sys.argv[2]) if len(sys.argv) > 2 else 512
+    alpha = 0.45
+    for k in (1, 4):
+        o, o_sd = oracle_share("get-ahead", k, alpha, acts)
+        oa, oa_sd = oracle_share("get-ahead-appendint", k, alpha, acts)
+        j = env_share(k, alpha, n_envs)
+        closed = abs(oa - j) / max(abs(o - j), 1e-9)
+        print(f"k={k}: oracle={o:.4f}(sd {o_sd:.4f})  "
+              f"oracle+appendint={oa:.4f}(sd {oa_sd:.4f})  env={j:.4f}  "
+              f"gap {o - j:+.4f} -> {oa - j:+.4f} "
+              f"({(1 - closed) * 100:.0f}% closed)", flush=True)
+
+
+if __name__ == "__main__":
+    main()
