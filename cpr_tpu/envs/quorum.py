@@ -42,9 +42,24 @@ def oh_gather(oh, arr):
     inf on withheld slots, pow_hash is NO_POW=inf on non-PoW slots).
     Candidates themselves always carry finite values, so zeroing the
     out-of-frame infs is lossless; rows for invalid candidates read 0
-    and must be masked by the caller."""
+    and must be masked by the caller.
+
+    Precision HIGHEST is load-bearing: TPU matmuls default to bf16
+    operand truncation, which rounds integer values above 256 — slot
+    ids up to capacity (520 at default hints) would come back off by
+    one or two ON CHIP while every CPU test stays exact."""
     arr = arr.astype(jnp.float32)
-    return oh @ jnp.where(jnp.isfinite(arr), arr, 0.0)
+    return jnp.matmul(oh, jnp.where(jnp.isfinite(arr), arr, 0.0),
+                      precision=jax.lax.Precision.HIGHEST)
+
+
+def last_of_kind_all(dag, kind: int):
+    """(B,) block/summary of every vertex, elementwise: a vertex of
+    `kind` is its own block; anything else stores its block in the
+    signer column (the shared convention of the parallel-PoW family).
+    Consumed by prefix_release_sets/stale_after_adopt as `last_all` —
+    indexing with dag.slots() would compile to a real batched gather."""
+    return jnp.where(dag.kind == kind, dag.slots(), dag.signer)
 
 
 def candidate_frame(dag, cand, C: int, vote_kind: int, max_vote_parents: int = 1):
@@ -350,7 +365,13 @@ def prefix_release_sets(dag, public, private, cands, R: int, last_all,
     h_pub = dag.height[public]
     flip = (h_lb > h_pub) | ((h_lb == h_pub) & (nconf > npub))
     if extra_all is not None:
-        e_lb = rg(extra_all)
+        # the tiebreak reads at each candidate's BLOCK (lb), not the
+        # candidate slot itself: vote slots carry the field's default
+        # (tailstorm votes append auxg=0), so an rg(extra_all) gather
+        # at the candidate would zero the tiebreak for vote candidates
+        lboh = ((lb[:, None] == dag.slots()[None, :])
+                & rvalid[:, None]).astype(jnp.float32)
+        e_lb = oh_gather(lboh, extra_all)
         e_pub = extra_all[jnp.maximum(public, 0)]
         flip = flip | ((h_lb == h_pub) & (nconf == npub) & (e_lb > e_pub))
     flip = flip & (lb != public) & rvalid

@@ -123,8 +123,8 @@ class SdagSSZ(JaxEnv):
         return jnp.where(dag.kind[x] == BLOCK, x, dag.signer[x])
 
     def last_block_all(self, dag):
-        """(B,) last_block per slot, elementwise (no gather)."""
-        return jnp.where(dag.kind == BLOCK, dag.slots(), dag.signer)
+        """(B,) last_block per slot (Q.last_of_kind_all)."""
+        return Q.last_of_kind_all(dag, BLOCK)
 
     def prev_block(self, dag, b):
         """A block's previous block (sdag.ml:139-172: parent 0's signer).

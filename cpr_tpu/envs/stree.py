@@ -137,8 +137,8 @@ class StreeSSZ(JaxEnv):
         return jnp.where(dag.kind[x] == BLOCK, x, dag.signer[x])
 
     def last_block_all(self, dag):
-        """(B,) last_block per slot, elementwise (no gather)."""
-        return jnp.where(dag.kind == BLOCK, dag.slots(), dag.signer)
+        """(B,) last_block per slot (Q.last_of_kind_all)."""
+        return Q.last_of_kind_all(dag, BLOCK)
 
     def vote_score(self, dag):
         """compare_votes_in_block (stree.ml:96-100): depth desc, ties in

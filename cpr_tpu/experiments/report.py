@@ -66,6 +66,40 @@ def honest_net_report(rows=None, *, out_tsv=None, **sweep_kwargs):
     return expanded, pivots, text
 
 
+def train_report(metrics_jsonl: str, *, every: int = 1):
+    """Training-run report over the driver's metrics.jsonl (the
+    replacement for the reference's live W&B panels,
+    experiments/train/ppo.py:296-374): the learning curve as
+    (update, step_reward, entropy, pg_loss) rows plus the per-alpha
+    eval table of the final eval pass.
+
+    Returns (curve_rows, eval_rows, text)."""
+    import json
+
+    curve, evals = [], []
+    with open(metrics_jsonl) as f:
+        for line in f:
+            r = json.loads(line)
+            (evals if r.get("eval") is True else curve).append(r)
+    curve = curve[::max(every, 1)]
+    last_update = max((r.get("update") for r in evals
+                       if r.get("update") is not None), default=None)
+    final_eval = [r for r in evals if r.get("update") == last_update]
+    lines = ["update\tmean_step_reward\tentropy\tpg_loss"]
+    for r in curve:
+        lines.append(f"{r.get('update', '-')}\t"
+                     f"{r.get('mean_step_reward', float('nan')):.5f}\t"
+                     f"{r.get('entropy', float('nan')):.3f}\t"
+                     f"{r.get('pg_loss', float('nan')):.2e}")
+    lines.append("")
+    lines.append("final eval (update %s):" % last_update)
+    lines.append("alpha\tgamma\trelative_reward")
+    for r in sorted(final_eval, key=lambda r: (r["alpha"], r["gamma"])):
+        lines.append(f"{r['alpha']}\t{r['gamma']}\t"
+                     f"{r['relative_reward']:.4f}")
+    return curve, final_eval, "\n".join(lines)
+
+
 def rl_eval_report(protocol_key: str = "nakamoto", *, out_tsv=None,
                    **eval_kwargs):
     """The rl-results-condensed model table end-to-end: per-episode

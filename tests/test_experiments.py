@@ -366,3 +366,19 @@ def test_report_layer_tables():
     for r in table:
         assert r["n"] >= 1 and 0.0 <= r["relrew_mean"] <= 1.0
     assert text2.splitlines()[0].startswith("protocol\tpolicy")
+
+
+def test_train_report_shape(tmp_path):
+    import json
+
+    p = tmp_path / "metrics.jsonl"
+    rows = [{"update": i, "mean_step_reward": 0.1, "entropy": 1.0,
+             "pg_loss": -1e-4} for i in range(4)]
+    rows += [{"eval": True, "update": 3, "alpha": a, "gamma": 0.5,
+              "relative_reward": a + 0.05} for a in (0.25, 0.35)]
+    p.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+    from cpr_tpu.experiments.report import train_report
+    curve, final_eval, text = train_report(str(p))
+    assert len(curve) == 4 and len(final_eval) == 2
+    assert text.splitlines()[0].startswith("update\t")
+    assert "0.3000" in text and "0.4000" in text

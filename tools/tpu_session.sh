@@ -11,7 +11,7 @@
 # failed or CPU-fallback run never clobbers banked evidence.
 set -u -o pipefail
 cd "$(dirname "$0")/.."
-ROUND=${CPR_ROUND:-r03}
+ROUND=${CPR_ROUND:-r04}
 log=tools/tpu_session.log
 echo "=== tpu session $(date +%F_%T) ===" | tee -a "$log"
 
