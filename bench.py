@@ -72,17 +72,21 @@ def _chunk_scaled(n_envs: int, base_chunk: int, base_envs: int):
     return max(16, base_chunk * base_envs // max(n_envs, base_envs))
 
 
-def measure_bk(n_envs: int, n_steps: int = 256, reps: int = 3):
+def measure_bk(n_envs: int, n_steps: int = 128, reps: int = 3):
     """BASELINE config 2: Bk k=8 vote-withholding (get-ahead), vmap'd
-    episode batch.  Round-4 sweep (tools/tpu_dag_sweep.py): the
-    aggregate rate peaks at 4096 envs x 256-step episodes (capacity
-    520), ~350-360k steps/s on chip, unchunked (one rep runs ~3 s, far
-    inside the worker's ~60-75 s per-call ceiling); 8k/16k/32k envs
-    measure LOWER (336k/315k/268k)."""
+    episode batch.  Round-4 sweep (tools/tpu_dag_sweep.py): the rate
+    peaks at 8192 envs x 128-step episodes (capacity 264; DAG capacity
+    scales with episode length and every per-step op is O(capacity), so
+    shorter episodes are structurally cheaper) — ~558k steps/s on chip,
+    0.95x the single-core C++ oracle.  Revenue is episode-length
+    invariant within +-0.003 down to 128 steps (the 120-step rel 0.302
+    vs 248-step 0.300 here; 64-step episodes measure 612k but drift to
+    0.307, so 128 is the honest floor).  4096/10240/12288/16384 envs
+    measure 550k/552k/497k/496k."""
     from cpr_tpu.envs.bk import BkSSZ
 
     env = BkSSZ(k=8, incentive_scheme="constant", max_steps_hint=n_steps)
-    chunk = None if n_envs <= 4096 else _chunk_scaled(n_envs, 256, 4096)
+    chunk = None if n_envs <= 8192 else _chunk_scaled(n_envs, 128, 8192)
     return _measure_episodes(env, "get-ahead", n_envs, n_steps, reps,
                              max_steps=n_steps - 8, chunk=chunk)
 
@@ -90,16 +94,18 @@ def measure_bk(n_envs: int, n_steps: int = 256, reps: int = 3):
 def measure_ethereum(n_envs: int, n_steps: int = 4096, reps: int = 2):
     """BASELINE config 3: Ethereum byzantium uncle-mining attack (FN'19
     policy), 65k batched episodes.  The 65k figure is EPISODES, not
-    envs: 4096 envs is the measured-fastest batch (round-4 sweep: 120k
-    steps/s vs 114k at 8192 envs; the old 16384-env shape measured 42k,
-    and 65536 envs killed the axon worker), so this config runs 4096
-    auto-resetting streams for 4096 steps in 256-step chunks —
-    4096 * 4096 / 248 ~ 67k completed episodes per rep."""
+    envs: 4096 envs x 120-step episodes is the measured-fastest shape
+    (round-4 sweep: 168k steps/s at capacity 136; 8192 envs 165k, the
+    256-step/capacity-264 shape 120k, the old 16384-env shape 42k, and
+    65536 envs killed the axon worker).  fn19 revenue is episode-length
+    invariant here (0.379 at 120 steps vs 0.380 at 248).  The config
+    runs 4096 auto-resetting streams for 4096 steps in 128-step chunks
+    — 4096 * 4096 / 120 ~ 140k completed episodes per rep."""
     from cpr_tpu.envs.ethereum import EthereumSSZ
 
-    env = EthereumSSZ("byzantium", max_steps_hint=256)
+    env = EthereumSSZ("byzantium", max_steps_hint=128)
     return _measure_episodes(env, "fn19", n_envs, n_steps, reps,
-                             max_steps=248, chunk=256)
+                             max_steps=120, chunk=128)
 
 
 def measure_tailstorm_ppo(n_envs: int, rollout_len: int = 128,
@@ -107,7 +113,10 @@ def measure_tailstorm_ppo(n_envs: int, rollout_len: int = 128,
     """BASELINE config 4: Tailstorm selfish-mining PPO — the training
     driver's actual train_step (rollout with policy-net inference +
     env.step + auto-reset, then GAE + minibatch updates), measured in
-    env-steps/sec; one call consumes rollout_len * n_envs steps."""
+    env-steps/sec; one call consumes rollout_len * n_envs steps.
+    120-step episodes (capacity 264) per the round-4 capacity sweep:
+    93k steps/s vs 72k at the 248-step/capacity-520 shape, same
+    entropy check."""
     import jax
     import numpy as np
 
@@ -115,8 +124,8 @@ def measure_tailstorm_ppo(n_envs: int, rollout_len: int = 128,
     from cpr_tpu.params import make_params
     from cpr_tpu.train.ppo import PPOConfig, make_train
 
-    env = get_sized("tailstorm-8-discount-heuristic", 256)
-    params = make_params(alpha=0.35, gamma=0.5, max_steps=248)
+    env = get_sized("tailstorm-8-discount-heuristic", 128)
+    params = make_params(alpha=0.35, gamma=0.5, max_steps=120)
     cfg = PPOConfig(n_envs=n_envs, n_steps=rollout_len)
     init_fn, train_step = make_train(env, params, cfg)
     carry = jax.jit(init_fn)(jax.random.PRNGKey(0))
@@ -239,10 +248,11 @@ def run_bench(platform_hint: str):
 CONFIGS = {
     # dict order is the measurement order for BOTH paths; every TPU
     # size below is the round-4 sweep winner (tools/tpu_dag_sweep.py):
-    # the aggregate DAG-env rate PEAKS at 4096 envs and declines at
-    # larger batches, so "bigger batch" is no longer the default
+    # the aggregate DAG-env rate PEAKS at small batches (8192 envs for
+    # bk, 4096 for ethereum/tailstorm) and declines at larger ones, so
+    # "bigger batch" is no longer the default
     "bk8_withholding": dict(
-        fn="measure_bk", tpu=dict(n_envs=4096), cpu=dict(n_envs=128),
+        fn="measure_bk", tpu=dict(n_envs=8192), cpu=dict(n_envs=128),
         guard=(0.05, 0.6), guard_name="get-ahead revenue share"),
     "tailstorm_ppo_train": dict(
         fn="measure_tailstorm_ppo", tpu=dict(n_envs=4096),

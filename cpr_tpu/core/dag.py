@@ -448,6 +448,16 @@ def common_ancestor_by_height(dag: Dag, a, b):
     return x
 
 
+def mask_of(idx, valid, B: int) -> jnp.ndarray:
+    """(B,) bool mask with idx[i] set where valid[i] — the scatter-free
+    form of ``zeros.at[idx].max(valid)``.  On TPU a vmapped scatter
+    with a (k,)-index vector costs ~0.3 ms/step at 4096 envs (round-4
+    device profile); the (k, B) one-hot compare + any-reduce is plain
+    elementwise work."""
+    slots = jnp.arange(B, dtype=jnp.int32)
+    return ((idx[:, None] == slots[None, :]) & valid[:, None]).any(axis=0)
+
+
 def top_k_by(score, mask, k: int, largest: bool = False):
     """Indices of the k best masked entries by score (ascending by
     default — used for smallest-hash vote selection). Returns (idx, valid)

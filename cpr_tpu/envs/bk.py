@@ -221,15 +221,12 @@ class BkSSZ(JaxEnv):
         idx_theirs, valid_theirs = D.top_k_by(seen, theirs_ok, k)
         n_needed = k - nmine
         take_theirs = jnp.arange(k) < n_needed
-        sel_mask = jnp.zeros((dag.capacity,), jnp.bool_)
-        sel_mask = sel_mask.at[idx_mine].max(valid_mine)
-        sel_mask = sel_mask.at[idx_theirs].max(valid_theirs & take_theirs)
+        mine_sel = D.mask_of(idx_mine, valid_mine, dag.capacity)
+        sel_mask = mine_sel | D.mask_of(
+            idx_theirs, valid_theirs & take_theirs, dag.capacity)
 
         case1 = nmine >= k
-        quorum_mask = jnp.where(
-            case1,
-            jnp.zeros((dag.capacity,), jnp.bool_).at[idx_mine].max(valid_mine),
-            sel_mask)
+        quorum_mask = jnp.where(case1, mine_sel, sel_mask)
 
         enough_theirs = theirs_ok.sum() >= n_needed
         found = (replace_hash > my_hash) & (nvotes >= k) & (case1 | enough_theirs)
@@ -244,8 +241,11 @@ class BkSSZ(JaxEnv):
         """Per-block coinbase at append time (bk.ml:151-176)."""
         votes = parents_row[1:]
         valid = votes >= 0
-        ids = dag.aux[jnp.clip(votes, 0)]
         if self.incentive_scheme == "constant":
+            # NOTE: keep the k-index gather — a (k, B) one-hot mask
+            # form was tried and measured 22x SLOWER end-to-end on chip
+            # (XLA pathology not chased; small-k gathers are fine)
+            ids = dag.aux[jnp.clip(votes, 0)]
             atk = (valid & (ids == D.ATTACKER)).sum().astype(jnp.float32)
             dfn = (valid & (ids == D.DEFENDER)).sum().astype(jnp.float32)
         else:  # block: leader takes k
@@ -434,14 +434,20 @@ class BkSSZ(JaxEnv):
         use_prop = (tgt_v >= k) & has_prop
         rel_block = jnp.where(use_prop, first_prop, blk)
         rel_votes_n = jnp.where(use_prop, 0, tgt_v)
-        # release earliest-seen votes on the released block
+        # release earliest-seen votes on the released block.  Selection
+        # width 16 keeps top_k on the iterative (sort-free) path; a
+        # request beyond it falls back to releasing every vote on the
+        # block (over-release by a few votes in that tail), exactly like
+        # the existing not_enough fallback — requests that deep need
+        # nv_pub > 16 on one block, beyond the reference's own policy
+        # reach
         votes = self.votes_on(dag, rel_block)
         vidx, vvalid = D.top_k_by(dag.born_at, votes, self.capacity_topk)
         take = jnp.arange(self.capacity_topk) < rel_votes_n
-        not_enough = votes.sum() < rel_votes_n
-        vote_mask = jnp.zeros((self.capacity,), jnp.bool_)
-        vote_mask = vote_mask.at[vidx].max(vvalid & take)
-        vote_mask = jnp.where(not_enough, votes, vote_mask)
+        release_all = (votes.sum() < rel_votes_n) | \
+            (rel_votes_n > self.capacity_topk)
+        vote_mask = D.mask_of(vidx, vvalid & take, self.capacity)
+        vote_mask = jnp.where(release_all, votes, vote_mask)
 
         released = D.release_chain(dag, rel_block, state.time)
         # the chosen votes sit directly on the released block's chain, so a
@@ -469,7 +475,11 @@ class BkSSZ(JaxEnv):
 
     @property
     def capacity_topk(self):
-        return min(self.capacity, 2 * self.k + 8)
+        # capped at 16 so the release-selection top_k stays on the
+        # iterative extraction path (lax.top_k beyond that lowers to a
+        # full capacity-wide sort, ~0.6 ms/step at 4096 envs); deeper
+        # requests use the release-everything fallback in _apply
+        return min(self.capacity, 2 * self.k + 8, 16)
 
     def step(self, state: State, action, params: EnvParams):
         state = self._apply(state, action)

@@ -300,11 +300,10 @@ def quorum_optimal_or_heuristic(dag, cidx, cvalid, abits, oh, own, depth,
 
 
 def leaves_to_row(dag, cidx, leaves_c, cvalid, width: int, score):
-    """Scatter the local leaves mask back to global slots and pick the
-    parent row: `width` leaves sorted descending by `score` (a (B,)
-    array), -1 padded."""
-    leaves = jnp.zeros((dag.capacity,), jnp.bool_).at[
-        jnp.maximum(cidx, 0)].max(leaves_c & cvalid)
+    """Map the local leaves mask back to global slots (scatter-free,
+    D.mask_of) and pick the parent row: `width` leaves sorted descending
+    by `score` (a (B,) array), -1 padded."""
+    leaves = D.mask_of(cidx, leaves_c & cvalid, dag.capacity)
     idx, valid = D.top_k_by(score, leaves, width, largest=True)
     return jnp.where(valid, idx, D.NONE).astype(jnp.int32)
 
@@ -369,9 +368,7 @@ def prefix_release_sets(dag, public, private, cands, R: int, last_all,
         # candidate slot itself: vote slots carry the field's default
         # (tailstorm votes append auxg=0), so an rg(extra_all) gather
         # at the candidate would zero the tiebreak for vote candidates
-        lboh = ((lb[:, None] == dag.slots()[None, :])
-                & rvalid[:, None]).astype(jnp.float32)
-        e_lb = oh_gather(lboh, extra_all)
+        e_lb = oh_gather(frame_onehot(dag, lb, rvalid), extra_all)
         e_pub = extra_all[jnp.maximum(public, 0)]
         flip = flip | ((h_lb == h_pub) & (nconf == npub) & (e_lb > e_pub))
     flip = flip & (lb != public) & rvalid

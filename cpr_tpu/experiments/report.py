@@ -80,6 +80,8 @@ def train_report(metrics_jsonl: str, *, every: int = 1):
     with open(metrics_jsonl) as f:
         for line in f:
             r = json.loads(line)
+            if r.get("update") is None:
+                continue  # run-header / schema-drifted rows
             (evals if r.get("eval") is True else curve).append(r)
     curve = curve[::max(every, 1)]
     last_update = max((r.get("update") for r in evals
