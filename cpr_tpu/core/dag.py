@@ -66,6 +66,17 @@ class Dag:
     # walks cost one gather per level instead of three (parent0 ->
     # kind -> signer).
     aux2: jnp.ndarray  # (B,) int32, NONE when unused
+    # binary-lifting jump pointers along the precursor chain: the
+    # 2nd/4th/8th/16th ancestor of each slot (NONE past the root).
+    # Ancestors never change in an append-only DAG, so each is O(1) at
+    # append time (anc2[new] = parent0[p0], anc4[new] = anc2[anc2[new]],
+    # ...).  walk_back uses them to jump: under vmap a walk runs the
+    # MAX trip count over the whole batch (~30+ under withholding
+    # policies), which dominated the ethereum step.
+    anc2: jnp.ndarray  # (B,) int32
+    anc4: jnp.ndarray  # (B,) int32
+    anc8: jnp.ndarray  # (B,) int32
+    anc16: jnp.ndarray  # (B,) int32
     kind: jnp.ndarray  # (B,) int32, protocol block-type tag
     height: jnp.ndarray  # (B,) int32
     aux: jnp.ndarray  # (B,) int32, protocol field (vote id, depth, ...)
@@ -104,14 +115,25 @@ class Dag:
         return self.slots() < self.n
 
 
-def empty(capacity: int, max_parents: int) -> Dag:
+def empty(capacity: int, max_parents: int, lift: bool = False) -> Dag:
+    """`lift=True` materializes the binary-lifting ancestor planes
+    (anc2..anc16) for O(log) walk_back jumps; off they are zero-length
+    placeholders and appends skip their maintenance — the extra four
+    row writes per append cost more than short walks save (bk measured
+    -17% with lift on; ethereum's deep release walks gain).  Lift
+    requires height to increment by exactly 1 along parent slot 0 (see
+    common_ancestor_by_height) and monotone walk_back stop predicates
+    (see walk_back's contract)."""
     B, P = capacity, max_parents
+    LB = B if lift else 0
     f = lambda fill, dt: jnp.full((B,), fill, dt)
+    g = lambda: jnp.full((LB,), NONE, jnp.int32)
     return Dag(
         parents=tuple(jnp.full((B,), NONE, jnp.int32) for _ in range(P)),
         auxf=f(0.0, jnp.float32),
         auxg=f(0.0, jnp.float32),
         aux2=f(NONE, jnp.int32),
+        anc2=g(), anc4=g(), anc8=g(), anc16=g(),
         kind=f(0, jnp.int32),
         height=f(0, jnp.int32),
         aux=f(0, jnp.int32),
@@ -186,12 +208,28 @@ def append_if(dag: Dag, cond, parents, *, kind=0, height=0, aux=0,
         value = jnp.asarray(value, arr.dtype)
         return arr.at[idx].set(jnp.where(cond, value, arr[idx]))
 
+    if dag.anc2.shape[0]:  # lifted DAG (static): maintain jump planes
+        # ancestors of the new block already exist and never change, so
+        # each level is one scalar gather through the previous plane
+        def hop(plane, v):
+            return jnp.where(v >= 0, plane[jnp.maximum(v, 0)], NONE)
+
+        v2 = hop(dag.parents[0], p0)
+        v4 = hop(dag.anc2, v2)
+        v8 = hop(dag.anc4, v4)
+        v16 = hop(dag.anc8, v8)
+        anc = dict(anc2=put(dag.anc2, v2), anc4=put(dag.anc4, v4),
+                   anc8=put(dag.anc8, v8), anc16=put(dag.anc16, v16))
+    else:
+        anc = {}
+
     dag = dag.replace(
         parents=tuple(put(plane, parents[p])
                       for p, plane in enumerate(dag.parents)),
         auxf=put(dag.auxf, auxf),
         auxg=put(dag.auxg, auxg),
         aux2=put(dag.aux2, aux2),
+        **anc,
         kind=put(dag.kind, kind),
         height=put(dag.height, height),
         aux=put(dag.aux, aux),
@@ -400,49 +438,136 @@ def release_closure(dag: Dag, tip, time) -> Dag:
 
 
 def walk_back(dag: Dag, tip, stop_fn):
-    """Follow parent slot 0 from `tip` while not stop_fn(dag, idx).
-    Terminates at the root (parent -1) at the latest — <= DAG height
-    iterations; the chain-walk primitive behind `last_block`, height
-    targeting, and common ancestors."""
+    """Follow parent slot 0 from `tip` while not stop_fn(dag, idx),
+    returning the first chain node where stop_fn holds (or -1 past the
+    root).
+
+    CONTRACT: stop_fn must be MONOTONE along the precursor chain (once
+    true at a node, true at every chain ancestor) — true for the height
+    and preference targets every caller uses.  That licenses binary
+    lifting: each iteration takes the largest anc2/4/8/16 jump whose
+    LANDING node does not yet satisfy stop_fn, else one parent0 step —
+    O(log depth) iterations instead of O(depth).  Under vmap the trip
+    count is the max over the batch (~30+ under withholding policies),
+    which made the linear walk the dominant cost of the ethereum step
+    (round-4 device profile)."""
 
     def cond(i):
         return (i >= 0) & ~stop_fn(dag, i)
 
-    def body(i):
-        return dag.parent0[i]
+    if dag.anc2.shape[0]:  # lifted DAG (static): jump walk
+
+        def ok(j):
+            # candidate jump target j is usable iff it exists and has
+            # not passed the stop boundary
+            return (j >= 0) & ~stop_fn(dag, jnp.maximum(j, 0))
+
+        def body(i):
+            j16 = dag.anc16[i]
+            j8 = dag.anc8[i]
+            j4 = dag.anc4[i]
+            j2 = dag.anc2[i]
+            return jnp.where(
+                ok(j16), j16,
+                jnp.where(ok(j8), j8,
+                          jnp.where(ok(j4), j4,
+                                    jnp.where(ok(j2), j2,
+                                              dag.parent0[i]))))
+    else:
+
+        def body(i):
+            return dag.parent0[i]
 
     return jax.lax.while_loop(cond, body, tip)
 
 
 def block_at_height(dag: Dag, tip, target_height, is_block_fn=None):
     """Walk the precursor chain from `tip` down to the first block with
-    height <= target_height (nakamoto_ssz.ml:238-247, bk_ssz.ml:283-291)."""
+    height <= target_height (nakamoto_ssz.ml:238-247, bk_ssz.ml:283-291).
+
+    `is_block_fn` makes the stop predicate NON-monotone along the chain
+    (false-then-true is possible below the height boundary), which the
+    lifted walk_back's jump contract forbids — that combination walks
+    linearly instead."""
     def stop(dag, i):
         ok = dag.height[i] <= target_height
         if is_block_fn is not None:
             ok = ok & is_block_fn(dag, i)
         return ok
 
+    if is_block_fn is not None and dag.anc2.shape[0]:
+        # linear walk: jumps could overshoot the first satisfying block
+        def cond(i):
+            return (i >= 0) & ~stop(dag, i)
+
+        return jax.lax.while_loop(cond, lambda i: dag.parent0[i], tip)
     return walk_back(dag, tip, stop)
 
 
 def common_ancestor_by_height(dag: Dag, a, b):
     """Common ancestor of two chain tips linked via parent slot 0, using
     heights to synchronize the walk (dagtools.ml:102-121, re-shaped as a
-    height-indexed two-pointer loop)."""
+    height-indexed two-pointer loop; on a lifted DAG the walk jumps via
+    the anc planes — equalize by the largest power <= the height
+    difference, then descend both tips one level wherever their
+    J-ancestors differ, the classic binary-lifting LCA).
+
+    LIFTED-DAG PRECONDITION: height must increment by exactly 1 along
+    parent slot 0 (true for ethereum, the only lifted env) — the
+    equalize phase equates "jump J ancestors" with "drop J height
+    units"; a protocol with height jumps > 1 along the precursor must
+    not enable empty(lift=True)."""
 
     def cond(state):
         x, y = state
         return (x != y) & (x >= 0) & (y >= 0)
 
-    def body(state):
-        x, y = state
-        hx, hy = dag.height[x], dag.height[y]
-        # step the higher one down; on ties step both
-        step_x = hx >= hy
-        step_y = hy >= hx
-        return (jnp.where(step_x, dag.parent0[x], x),
-                jnp.where(step_y, dag.parent0[y], y))
+    if dag.anc2.shape[0]:  # lifted DAG (static)
+
+        def body(state):
+            x, y = state
+            hx, hy = dag.height[x], dag.height[y]
+            d = hx - hy
+
+            def down(i, dist):
+                # largest jump <= dist that stays on the chain
+                j16, j8 = dag.anc16[i], dag.anc8[i]
+                j4, j2 = dag.anc4[i], dag.anc2[i]
+                return jnp.where(
+                    (dist >= 16) & (j16 >= 0), j16,
+                    jnp.where((dist >= 8) & (j8 >= 0), j8,
+                              jnp.where((dist >= 4) & (j4 >= 0), j4,
+                                        jnp.where((dist >= 2) & (j2 >= 0),
+                                                  j2, dag.parent0[i]))))
+
+            # equal heights: largest level whose ancestors still differ
+            # keeps both tips strictly below the common ancestor
+            x16, y16 = dag.anc16[x], dag.anc16[y]
+            x8, y8 = dag.anc8[x], dag.anc8[y]
+            x4, y4 = dag.anc4[x], dag.anc4[y]
+            x2, y2 = dag.anc2[x], dag.anc2[y]
+            u16 = (x16 >= 0) & (y16 >= 0) & (x16 != y16)
+            u8 = (x8 >= 0) & (y8 >= 0) & (x8 != y8)
+            u4 = (x4 >= 0) & (y4 >= 0) & (x4 != y4)
+            u2 = (x2 >= 0) & (y2 >= 0) & (x2 != y2)
+            eq_x = jnp.where(u16, x16, jnp.where(u8, x8, jnp.where(
+                u4, x4, jnp.where(u2, x2, dag.parent0[x]))))
+            eq_y = jnp.where(u16, y16, jnp.where(u8, y8, jnp.where(
+                u4, y4, jnp.where(u2, y2, dag.parent0[y]))))
+
+            new_x = jnp.where(d > 0, down(x, d), jnp.where(d < 0, x, eq_x))
+            new_y = jnp.where(d < 0, down(y, -d), jnp.where(d > 0, y, eq_y))
+            return new_x, new_y
+    else:
+
+        def body(state):
+            x, y = state
+            hx, hy = dag.height[x], dag.height[y]
+            # step the higher one down; on ties step both
+            step_x = hx >= hy
+            step_y = hy >= hx
+            return (jnp.where(step_x, dag.parent0[x], x),
+                    jnp.where(step_y, dag.parent0[y], y))
 
     x, y = jax.lax.while_loop(cond, body, (a, b))
     return x

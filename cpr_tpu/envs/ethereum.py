@@ -251,7 +251,7 @@ class EthereumSSZ(JaxEnv):
     # -- env API -----------------------------------------------------------
 
     def reset(self, key: jax.Array, params: EnvParams):
-        dag = D.empty(self.capacity, self.max_parents)
+        dag = D.empty(self.capacity, self.max_parents, lift=True)
         dag, root = D.append(
             dag, jnp.full((self.max_parents,), D.NONE, jnp.int32),
             kind=0, height=0, aux=0, miner=D.NONE, vis_a=True, vis_d=True,

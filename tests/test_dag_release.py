@@ -50,3 +50,45 @@ def test_release_closure_noop_on_negative_tip():
     dag, *_ = _nested_uncle_dag()
     out = D.release_closure(dag, jnp.int32(-1), 9.0)
     assert (out.vis_d == dag.vis_d).all()
+
+
+def test_lifted_walks_match_linear():
+    """Property test: lifted (binary-jump) walk_back and LCA equal the
+    linear implementations on random unit-height-increment chain forests
+    — the fast-tier guard for the jump logic (the lifted user, ethereum,
+    is otherwise only covered by the slow tier)."""
+    import numpy as np
+
+    rng = np.random.default_rng(7)
+    for trial in range(5):
+        B, P = 96, 3
+        dU = D.empty(B, P)
+        dL = D.empty(B, P, lift=True)
+        row0 = jnp.full((P,), D.NONE, jnp.int32)
+        tips = []  # (slot, height)
+
+        def app(d, parent, h):
+            row = row0 if parent < 0 else row0.at[0].set(parent)
+            d, i = D.append(d, row, height=h)
+            return d, int(i)
+
+        dU, r = app(dU, -1, 0)
+        dL, _ = app(dL, -1, 0)
+        tips.append((r, 0))
+        for _ in range(70):
+            p, h = tips[rng.integers(len(tips))]
+            dU, i = app(dU, p, h + 1)
+            dL, _ = app(dL, p, h + 1)
+            tips.append((i, h + 1))
+        slots = [s for s, _ in tips]
+        for _ in range(12):
+            a, b = rng.choice(slots, 2)
+            caU = int(D.common_ancestor_by_height(dU, jnp.int32(a),
+                                                  jnp.int32(b)))
+            caL = int(D.common_ancestor_by_height(dL, jnp.int32(a),
+                                                  jnp.int32(b)))
+            assert caU == caL, (trial, a, b, caU, caL)
+            tgt = int(rng.integers(0, 40))
+            wU = int(D.block_at_height(dU, jnp.int32(a), tgt))
+            wL = int(D.block_at_height(dL, jnp.int32(a), tgt))
+            assert wU == wL, (trial, a, tgt, wU, wL)
