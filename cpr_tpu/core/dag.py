@@ -77,6 +77,31 @@ class Dag:
     anc4: jnp.ndarray  # (B,) int32
     anc8: jnp.ndarray  # (B,) int32
     anc16: jnp.ndarray  # (B,) int32
+    # ring-window occupancy (O(active-set) mode, zero-length when off):
+    # slot s holds the block with global id gid[s]; appends claim slot
+    # n % W, overwriting the W-th-oldest block.  The reference's event
+    # loop only ever touches the live fork (simulator/lib/simulator.ml:
+    # 421-533, dag.ml:28 append) — the ring is the tensor analog: every
+    # per-step O(capacity) op shrinks to O(window) regardless of
+    # episode length.  `live_floor` is the env-maintained retirement
+    # frontier (lowest gid that may still be dereferenced; everything
+    # below is retired like the reference's finalized history), and
+    # evicting a block at/above it raises `overflow` — the same
+    # episode-invalid semantics as capacity overflow in full mode.
+    gid: jnp.ndarray  # (W,) int32, occupant global id (NONE = never used)
+    live_floor: jnp.ndarray  # () int32, lowest still-referenceable gid
+    # incremental ancestry bitmask planes (zero-length when off):
+    # chain[x] marks x and its ancestors along the designated chain
+    # pointer (parent slot 0 unless append passes chain_parent);
+    # closure[x] marks x and the full recursive parent-row closure (the
+    # simulator's recursive share set, simulator.ml:401-419).  Both rows
+    # are written once at append (ancestors never change in an
+    # append-only DAG), so every chain walk / release fixpoint that was
+    # a lax.while_loop of per-iteration gathers (batch-MAX trip counts;
+    # 68% of the ethereum step in the round-4/5 device profiles)
+    # becomes ONE masked reduction over the (W,) row.
+    chain: jnp.ndarray  # (W, W) bool
+    closure: jnp.ndarray  # (W, W) bool
     kind: jnp.ndarray  # (B,) int32, protocol block-type tag
     height: jnp.ndarray  # (B,) int32
     aux: jnp.ndarray  # (B,) int32, protocol field (vote id, depth, ...)
@@ -92,6 +117,14 @@ class Dag:
     cum_prog: jnp.ndarray  # (B,) float32, progress at this block
     n: jnp.ndarray  # () int32, number of blocks
     overflow: jnp.ndarray  # () bool, capacity exceeded (episode invalid)
+
+    @property
+    def is_ring(self) -> bool:
+        return self.gid.shape[0] > 0
+
+    @property
+    def has_masks(self) -> bool:
+        return self.chain.shape[0] > 0
 
     @property
     def parent0(self) -> jnp.ndarray:
@@ -112,10 +145,26 @@ class Dag:
         return jnp.arange(self.capacity, dtype=jnp.int32)
 
     def exists(self):
+        """Mask of slots holding a live block.  Ring mode: gid < n
+        rejects stale occupants surviving a logical reset (a claimed
+        slot's gid is always in [n - W, n), while stale slots hold gids
+        from a PREVIOUS episode that the current count has not reached
+        — see JaxEnv.reset_dag_rows)."""
+        if self.is_ring:
+            return (self.gid >= 0) & (self.gid < self.n)
         return self.slots() < self.n
 
+    def age_key(self):
+        """(B,) int32 insertion-order key (smaller = appended earlier).
+        Full mode appends slots in order so the slot id IS the age; the
+        ring wraps, so ordering must use the occupant gid.  Use this
+        wherever 'first/last appended' matters (candidate-frame
+        compaction order, release prefixes, newest-released tips)."""
+        return self.gid if self.is_ring else self.slots()
 
-def empty(capacity: int, max_parents: int, lift: bool = False) -> Dag:
+
+def empty(capacity: int, max_parents: int, lift: bool = False,
+          ring: bool = False, anc_masks: bool = False) -> Dag:
     """`lift=True` materializes the binary-lifting ancestor planes
     (anc2..anc16) for O(log) walk_back jumps; off they are zero-length
     placeholders and appends skip their maintenance — the extra four
@@ -123,13 +172,30 @@ def empty(capacity: int, max_parents: int, lift: bool = False) -> Dag:
     -17% with lift on; ethereum's deep release walks gain).  Lift
     requires height to increment by exactly 1 along parent slot 0 (see
     common_ancestor_by_height) and monotone walk_back stop predicates
-    (see walk_back's contract)."""
+    (see walk_back's contract).
+
+    `ring=True` turns the capacity into a sliding window over the W
+    most recent blocks (see Dag.gid): appends wrap, and the env must
+    keep `live_floor` at the retirement frontier (retire_below) so
+    evictions of still-referenced blocks raise `overflow`.  Not
+    combinable with `lift` — a jump target below the floor would read
+    a reused slot's new occupant.
+
+    `anc_masks=True` materializes the incremental chain/closure
+    ancestry planes (see Dag.chain/closure and the *_mask queries)."""
     B, P = capacity, max_parents
+    assert not (ring and lift), "ring + lift: jumps could land on reused slots"
     LB = B if lift else 0
+    RB = B if ring else 0
+    MB = B if anc_masks else 0
     f = lambda fill, dt: jnp.full((B,), fill, dt)
     g = lambda: jnp.full((LB,), NONE, jnp.int32)
     return Dag(
         parents=tuple(jnp.full((B,), NONE, jnp.int32) for _ in range(P)),
+        gid=jnp.full((RB,), NONE, jnp.int32),
+        live_floor=jnp.int32(0),
+        chain=jnp.zeros((MB, MB), jnp.bool_),
+        closure=jnp.zeros((MB, MB), jnp.bool_),
         auxf=f(0.0, jnp.float32),
         auxg=f(0.0, jnp.float32),
         aux2=f(NONE, jnp.int32),
@@ -155,7 +221,7 @@ def empty(capacity: int, max_parents: int, lift: bool = False) -> Dag:
 def append(dag: Dag, parents, *, kind=0, height=0, aux=0, pow_hash=NO_POW,
            signer=NONE, miner=NONE, vis_a=True, vis_d=True, time=0.0,
            reward_atk=0.0, reward_def=0.0, progress=None, auxf=0.0,
-           auxg=0.0, aux2=NONE):
+           auxg=0.0, aux2=NONE, chain_parent=None):
     """Append one block; returns (dag, index). `parents` is a (P,) int32
     row (NONE-padded); parent slot 0 is the precursor along which
     cumulative rewards accumulate (simulator.ml:377-388). `progress`
@@ -166,14 +232,15 @@ def append(dag: Dag, parents, *, kind=0, height=0, aux=0, pow_hash=NO_POW,
         pow_hash=pow_hash, signer=signer, miner=miner, vis_a=vis_a,
         vis_d=vis_d, time=time, reward_atk=reward_atk,
         reward_def=reward_def, progress=progress, auxf=auxf, auxg=auxg,
-        aux2=aux2)
+        aux2=aux2, chain_parent=chain_parent)
     return dag, idx
 
 
 def append_if(dag: Dag, cond, parents, *, kind=0, height=0, aux=0,
               pow_hash=NO_POW, signer=NONE, miner=NONE, vis_a=True,
               vis_d=True, time=0.0, reward_atk=0.0, reward_def=0.0,
-              progress=None, auxf=0.0, auxg=0.0, aux2=NONE):
+              progress=None, auxf=0.0, auxg=0.0, aux2=NONE,
+              chain_parent=None):
     """`append` gated by traced bool `cond`; returns (dag, idx_or_NONE).
 
     Replaces the append-then-rollback pattern
@@ -187,8 +254,22 @@ def append_if(dag: Dag, cond, parents, *, kind=0, height=0, aux=0,
     batch-minor layout and XLA then keeps a second transposed copy of
     the matrix alive across the scan, ~7 ms per step at 16k envs —
     round-4 device profile.)"""
-    idx = jnp.minimum(dag.n, dag.capacity - 1)
-    overflow = dag.overflow | (cond & (dag.n >= dag.capacity))
+    # `chain_parent` names the block the chain-ancestry plane follows
+    # (defaults to parent slot 0); protocols whose linear history is
+    # not the precursor pass their own pointer (tailstorm: the summary
+    # this summary extends)
+    if dag.is_ring:
+        idx = jax.lax.rem(dag.n, jnp.int32(dag.capacity))
+        # evicting a live block at/above the retirement frontier means
+        # the window was too small for this fork — episode invalid,
+        # same semantics as running out of capacity in full mode
+        evicted = dag.gid[idx]
+        overflow = dag.overflow | (
+            cond & (evicted >= 0) & (evicted < dag.n)
+            & (evicted >= dag.live_floor))
+    else:
+        idx = jnp.minimum(dag.n, dag.capacity - 1)
+        overflow = dag.overflow | (cond & (dag.n >= dag.capacity))
     p0 = parents[0]
     has_p0 = p0 >= 0
     base = jnp.where(has_p0, p0, 0)
@@ -223,6 +304,23 @@ def append_if(dag: Dag, cond, parents, *, kind=0, height=0, aux=0,
     else:
         anc = {}
 
+    if dag.is_ring:
+        anc["gid"] = put(dag.gid, dag.n)
+
+    if dag.has_masks:
+        # ancestry rows: ancestors never change in an append-only DAG,
+        # so one row write per plane at append replaces every later
+        # walk/fixpoint with a masked reduction (see chain_mask /
+        # closure_mask / common_ancestor_masked / release_masked)
+        new_bit = jnp.arange(dag.capacity, dtype=jnp.int32) == idx
+        cp = parents[0] if chain_parent is None else chain_parent
+        crow = new_bit | _valid_row(dag, dag.chain, cp)
+        orow = new_bit
+        for p in range(dag.max_parents):
+            orow = orow | _valid_row(dag, dag.closure, parents[p])
+        anc["chain"] = put(dag.chain, crow)
+        anc["closure"] = put(dag.closure, orow)
+
     dag = dag.replace(
         parents=tuple(put(plane, parents[p])
                       for p, plane in enumerate(dag.parents)),
@@ -246,10 +344,94 @@ def append_if(dag: Dag, cond, parents, *, kind=0, height=0, aux=0,
         cum_atk=put(dag.cum_atk, cum_atk),
         cum_def=put(dag.cum_def, cum_def),
         cum_prog=put(dag.cum_prog, cum_prog),
-        n=jnp.minimum(dag.n + cond.astype(jnp.int32), dag.capacity),
+        # ring mode: n is the total append count (gids keep growing);
+        # full mode clamps so idx stays pinned at the last slot
+        n=(dag.n + cond.astype(jnp.int32) if dag.is_ring
+           else jnp.minimum(dag.n + cond.astype(jnp.int32), dag.capacity)),
         overflow=overflow,
     )
     return dag, jnp.where(cond, idx, NONE)
+
+
+def retire_below(dag: Dag, floor_gid) -> Dag:
+    """Raise the ring retirement frontier to `floor_gid` (monotone).
+    Envs call this once per step with the gid of their common-ancestor
+    frontier — everything strictly below it is finalized history that
+    only lives on in the cumulative reward/progress columns, exactly
+    like the reference only ever touches the live fork
+    (simulator.ml:421-533).  No-op in full mode."""
+    if not dag.is_ring:
+        return dag
+    return dag.replace(
+        live_floor=jnp.maximum(dag.live_floor,
+                               jnp.asarray(floor_gid, jnp.int32)))
+
+
+def _valid_row(dag: Dag, plane, x):
+    """(B,) bits of `plane[x]` that still refer to their original
+    blocks: in ring mode a slot reclaimed after x's append carries a
+    larger occupant gid, so the occupant-gid filter removes exactly the
+    stale columns (same argument as append's inherit)."""
+    xi = jnp.maximum(x, 0)
+    row = jnp.where(x >= 0, plane[xi], False)
+    if dag.is_ring:
+        row = row & (dag.gid <= dag.gid[xi]) & (dag.gid >= 0)
+    return row
+
+
+def chain_mask(dag: Dag, x) -> jnp.ndarray:
+    """(B,) mask of x and its ancestors along the chain pointer (the
+    incremental twin of walking parent slot 0 / the env's chain_parent;
+    requires empty(anc_masks=True))."""
+    return _valid_row(dag, dag.chain, x)
+
+
+def closure_mask(dag: Dag, x) -> jnp.ndarray:
+    """(B,) mask of x and its full recursive parent-row closure — the
+    simulator's recursive share set (simulator.ml:401-419), O(B) per
+    query instead of an ancestor fixpoint."""
+    return _valid_row(dag, dag.closure, x)
+
+
+def release_masked(dag: Dag, tip, time) -> Dag:
+    """release_with_ancestors via the closure plane: one row read, no
+    while loop.  Equivalent because 'defender-visible implies ancestors
+    visible' holds inductively (honest nodes mine on visible blocks;
+    every release goes through a recursive share), so re-releasing the
+    already-visible part of the closure is a no-op."""
+    return release(dag, closure_mask(dag, tip), time)
+
+
+def common_ancestor_masked(dag: Dag, a, b):
+    """Common ancestor of two chain tips via one row intersection: the
+    deepest shared element is the one of maximum height (heights are
+    strictly increasing along a chain).  Masked twin of
+    common_ancestor_by_height (dagtools.ml:102-121)."""
+    m = chain_mask(dag, a) & chain_mask(dag, b)
+    best = jnp.argmax(jnp.where(m, dag.height, -1)).astype(jnp.int32)
+    return jnp.where(m.any(), best, NONE)
+
+
+def chain_first_at_most(dag: Dag, tip, values, target, extra_mask=None):
+    """First block walking the chain down from `tip` whose `values`
+    entry is <= target (optionally also satisfying `extra_mask`) — the
+    masked twin of walk_back/block_at_height for monotone-nonincreasing
+    `values` (height, cumulative work): the first satisfying block on
+    the way down is the highest-height satisfying chain member."""
+    m = chain_mask(dag, tip) & (values <= target)
+    if extra_mask is not None:
+        m = m & extra_mask
+    best = jnp.argmax(jnp.where(m, dag.height, -1)).astype(jnp.int32)
+    return jnp.where(m.any(), best, NONE)
+
+
+def first_by_age(dag: Dag, mask):
+    """Index of the earliest-appended block in `mask` (insertion order;
+    NONE if empty).  Replaces lowest-slot argmax where 'first' must
+    mean age — in ring mode slot order wraps."""
+    key = jnp.where(mask, dag.age_key(), jnp.int32(2**30))
+    best = jnp.argmin(key).astype(jnp.int32)
+    return jnp.where(mask.any(), best, NONE)
 
 
 def select_vis(cond, released: Dag, dag: Dag) -> Dag:

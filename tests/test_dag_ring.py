@@ -1,0 +1,221 @@
+"""Ring-window + ancestry-mask DAG core (cpr_tpu.core.dag).
+
+The ring window is the O(active-set) state representation: slot =
+gid mod W, with an env-maintained retirement frontier.  The ancestry
+planes replace every while-loop walk with one masked reduction.  Both
+must agree exactly with the full-capacity walk-based forms on live
+blocks — these tests drive a randomized fork process (mine on either
+preference, adopt/override, multi-parent proposals, releases) through
+a ring dag and a full dag in lockstep and compare every query.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cpr_tpu.core import dag as D
+
+W = 16  # ring window
+BIG = 256  # full-capacity twin
+P = 3  # parent row width
+
+
+def row(*xs):
+    r = np.full((P,), -1, np.int32)
+    for i, x in enumerate(xs):
+        r[i] = x
+    return jnp.asarray(r)
+
+
+def drive(seed, n_steps=70, ring=True):
+    """Random fork process; returns (dag, gid_of_slot fn, log).
+
+    Maintains pub/priv preferences; appends blocks/votes; adopts or
+    overrides to advance the common ancestor; keeps the ring floor at
+    the CA's gid.  All indices handled as slots in the dag under test;
+    the log records (gid, parent_gids) so twins can be aligned."""
+    rng = np.random.default_rng(seed)
+    cap = W if ring else BIG
+    dag = D.empty(cap, P, ring=ring, anc_masks=True)
+    dag, root = D.append(dag, row(), kind=0, height=0, time=0.0,
+                         progress=0.0)
+    pub = priv = int(root)
+    gid_at = {0: int(root)}  # gid -> slot in THIS dag
+    slot_gid = {int(root): 0}
+    n = 1
+    votes = {0: []}  # gid of block -> vote gids
+    pub_g = priv_g = 0
+
+    def slot(g):
+        return gid_at[g]
+
+    ca_gid = 0
+    for t in range(n_steps):
+        r = rng.random()
+        time = float(t + 1)
+        if n - ca_gid > W - 6:
+            # window pressure: resolve the fork (a real policy adopts or
+            # overrides; an env would otherwise end the episode on
+            # overflow) — forces the CA frontier forward in both twins
+            r = 0.85
+        if r < 0.55:
+            # mine a block on one preference
+            on_pub = rng.random() < 0.5
+            base_g = pub_g if on_pub else priv_g
+            vs = votes.get(base_g, [])[:2]
+            parents = row(slot(base_g), *[slot(v) for v in vs])
+            h = 1 + int(np.asarray(dag.height[slot(base_g)]))
+            dag, idx = D.append(
+                dag, parents, kind=0, height=h,
+                miner=(0 if on_pub else 1), time=time,
+                reward_atk=rng.random(), reward_def=rng.random(),
+                vis_d=bool(on_pub))
+            g = n
+            gid_at[g] = int(idx)
+            n += 1
+            votes[g] = []
+            if on_pub:
+                pub_g = g
+            else:
+                priv_g = g
+        elif r < 0.8:
+            # vote on a preference tip (kind 1, non-chain append)
+            on_pub = rng.random() < 0.5
+            base_g = pub_g if on_pub else priv_g
+            dag, idx = D.append(
+                dag, row(slot(base_g)), kind=1,
+                height=int(np.asarray(dag.height[slot(base_g)])),
+                time=time, vis_d=bool(on_pub))
+            g = n
+            gid_at[g] = int(idx)
+            n += 1
+            votes.setdefault(base_g, []).append(g)
+        elif r < 0.9:
+            # adopt / override: advances the common ancestor
+            if rng.random() < 0.5:
+                priv_g = pub_g
+            else:
+                dag = D.release_masked(dag, jnp.int32(slot(priv_g)), time)
+                pub_g = priv_g
+        else:
+            dag = D.release_masked(dag, jnp.int32(slot(priv_g)), time)
+        # retire below the CA like the envs do
+        ca = D.common_ancestor_masked(dag, jnp.int32(slot(pub_g)),
+                                      jnp.int32(slot(priv_g)))
+        assert int(ca) >= 0
+        if ring:
+            ca_gid = int(dag.gid[int(ca)])
+            dag = D.retire_below(dag, jnp.int32(ca_gid))
+        else:
+            ca_gid = int(ca)  # full mode: slot == gid
+        assert not bool(dag.overflow), f"unexpected overflow at t={t}"
+    return dag, gid_at, (pub_g, priv_g, n)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_ring_matches_full(seed):
+    """Every live-window query agrees between ring and full mode."""
+    rdag, rmap, (pub_g, priv_g, n) = drive(seed, ring=True)
+    fdag, fmap, (pub_g2, priv_g2, n2) = drive(seed, ring=False)
+    assert (pub_g, priv_g, n) == (pub_g2, priv_g2, n2)
+
+    lo = max(0, n - W)
+    live = [g for g in range(lo, n)]
+
+    def rmask_gids(mask):
+        return {g for g in live if bool(mask[rmap[g]])}
+
+    def fmask_gids(mask):
+        return {g for g in live if bool(mask[fmap[g]])}
+
+    # per-slot fields agree on live blocks
+    for field in ("kind", "height", "miner", "vis_d", "cum_atk",
+                  "cum_def", "born_at"):
+        rv = np.asarray(getattr(rdag, field))
+        fv = np.asarray(getattr(fdag, field))
+        for g in live:
+            assert rv[rmap[g]] == fv[fmap[g]], (field, g)
+
+    # exists: ring live set == full's top-W slice
+    rex = np.asarray(rdag.exists())
+    assert {g for g in live if rex[rmap[g]]} == set(live)
+
+    for g in live:
+        r_ch = rmask_gids(np.asarray(D.chain_mask(rdag, jnp.int32(rmap[g]))))
+        f_ch = fmask_gids(np.asarray(D.chain_mask(fdag, jnp.int32(fmap[g]))))
+        assert r_ch == f_ch, ("chain", g)
+        r_cl = rmask_gids(np.asarray(D.closure_mask(rdag, jnp.int32(rmap[g]))))
+        f_cl = fmask_gids(np.asarray(D.closure_mask(fdag, jnp.int32(fmap[g]))))
+        assert r_cl == f_cl, ("closure", g)
+
+    # CA of the two preferences agrees (by gid)
+    rca = int(D.common_ancestor_masked(
+        rdag, jnp.int32(rmap[pub_g]), jnp.int32(rmap[priv_g])))
+    fca = int(D.common_ancestor_masked(
+        fdag, jnp.int32(fmap[pub_g]), jnp.int32(fmap[priv_g])))
+    assert int(rdag.gid[rca]) == fca  # full mode: slot == gid
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_masked_queries_match_walks(seed):
+    """On a full-capacity dag the masked queries equal the while-loop
+    walk forms they replace."""
+    dag, gmap, (pub_g, priv_g, n) = drive(seed, ring=False)
+    pub, priv = gmap[pub_g], gmap[priv_g]
+
+    # closure_mask == ancestors_mask (the fixpoint BFS)
+    for g in range(0, n, 7):
+        got = np.asarray(D.closure_mask(dag, jnp.int32(gmap[g])))
+        want = np.asarray(D.ancestors_mask(dag, jnp.int32(gmap[g])))
+        np.testing.assert_array_equal(got, want)
+
+    # CA == the height-synchronized two-pointer walk
+    got = int(D.common_ancestor_masked(dag, jnp.int32(pub), jnp.int32(priv)))
+    want = int(D.common_ancestor_by_height(dag, jnp.int32(pub),
+                                           jnp.int32(priv)))
+    assert got == want
+
+    # chain_first_at_most == block_at_height (blocks only: kind == 0)
+    is_block = dag.kind == 0
+    for tgt in range(0, int(dag.height[priv]) + 1, 2):
+        got = int(D.chain_first_at_most(
+            dag, jnp.int32(priv), dag.height, jnp.int32(tgt), is_block))
+        want = int(D.block_at_height(
+            dag, jnp.int32(priv), jnp.int32(tgt),
+            lambda d, i: d.kind[i] == 0))
+        assert got == want, tgt
+
+    # release_masked == release_with_ancestors
+    got = D.release_masked(dag, jnp.int32(priv), 999.0)
+    want = D.release_with_ancestors(dag, jnp.int32(priv), 999.0)
+    np.testing.assert_array_equal(np.asarray(got.vis_d),
+                                  np.asarray(want.vis_d))
+    np.testing.assert_array_equal(np.asarray(got.vis_d_since),
+                                  np.asarray(want.vis_d_since))
+
+
+def test_ring_overflow_on_deep_fork():
+    """A fork deeper than the window must flag overflow, not corrupt."""
+    dag = D.empty(8, 1, ring=True)
+    dag, root = D.append(dag, jnp.array([-1], jnp.int32), height=0)
+    tip = root
+    # never retire anything: floor stays 0, so the 9th append evicts a
+    # live block
+    for h in range(1, 9):
+        dag, tip = D.append(dag, jnp.array([int(tip)], jnp.int32), height=h)
+    assert bool(dag.overflow)
+
+
+def test_ring_first_by_age_wraps():
+    dag = D.empty(4, 1, ring=True)
+    dag, a = D.append(dag, jnp.array([-1], jnp.int32), height=0)
+    tip = a
+    for h in range(1, 6):
+        dag = D.retire_below(dag, dag.n - 2)
+        dag, tip = D.append(dag, jnp.array([int(tip)], jnp.int32), height=h)
+    assert not bool(dag.overflow)
+    # live gids are 2..5 at slots 2,3,0,1; earliest live == gid 2
+    mask = dag.exists()
+    first = int(D.first_by_age(dag, mask))
+    assert int(dag.gid[first]) == 2

@@ -1,0 +1,145 @@
+"""Device-profile one DAG-family bench config and print the top ops.
+
+Captures a `jax.profiler.trace` of warm bench-shape reps (the axon
+worker returns real per-op device timelines — docs/TPU_SESSION_r04.md),
+parses the chrome-trace json.gz, and aggregates device-lane op time by
+HLO op name so a perf round starts from evidence, not guesses.
+
+Usage: python tools/tpu_profile_env.py <bk|ethereum|tailstorm> [n_envs]
+           [top_n]
+"""
+
+import glob
+import gzip
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+
+def log(msg):
+    print(f"[{time.strftime('%H:%M:%S')}] {msg}", file=sys.stderr,
+          flush=True)
+
+
+def build(config, n_envs):
+    """Return (fn, keys, n_steps) — one warmable chunked call per rep,
+    matching bench.py's shapes."""
+    import jax
+
+    from cpr_tpu.params import make_params
+
+    if config == "bk":
+        from cpr_tpu.envs.bk import BkSSZ
+        env = BkSSZ(k=8, incentive_scheme="constant", max_steps_hint=128)
+        params = make_params(alpha=0.35, gamma=0.5, max_steps=120)
+        fn = env.make_episode_stats_fn(params, env.policies["get-ahead"],
+                                       128, chunk=128)
+        n_steps = 128
+    elif config == "ethereum":
+        from cpr_tpu.envs.ethereum import EthereumSSZ
+        env = EthereumSSZ("byzantium", max_steps_hint=128)
+        params = make_params(alpha=0.35, gamma=0.5, max_steps=120)
+        fn = env.make_episode_stats_fn(params, env.policies["fn19"],
+                                       128, chunk=128)
+        n_steps = 128
+    elif config == "tailstorm":
+        from cpr_tpu.envs.registry import get_sized
+        from cpr_tpu.train.ppo import PPOConfig, make_train
+        env = get_sized("tailstorm-8-discount-heuristic", 128)
+        params = make_params(alpha=0.35, gamma=0.5, max_steps=120)
+        cfg = PPOConfig(n_envs=n_envs, n_steps=128)
+        init_fn, train_step = make_train(env, params, cfg)
+        carry = jax.jit(init_fn)(jax.random.PRNGKey(0))
+        step = jax.jit(train_step)
+        state = {"carry": carry}
+
+        def fn(_keys):
+            state["carry"], m = step(state["carry"])
+            return m
+
+        return fn, None, 128
+    else:
+        raise SystemExit(f"unknown config {config}")
+    keys = jax.random.split(jax.random.PRNGKey(0), n_envs)
+    return fn, keys, n_steps
+
+
+def fetch(out):
+    import numpy as np
+
+    leaves = [v for v in (out.values() if isinstance(out, dict) else [out])]
+    return float(np.asarray(leaves[0]).reshape(-1)[0])
+
+
+def summarize(trace_dir, top_n):
+    """Aggregate device-lane events from the newest trace.json.gz."""
+    paths = sorted(glob.glob(os.path.join(
+        trace_dir, "**", "*.trace.json.gz"), recursive=True),
+        key=os.path.getmtime)
+    if not paths:
+        log(f"no trace under {trace_dir}")
+        return
+    with gzip.open(paths[-1], "rt") as f:
+        data = json.load(f)
+    events = data.get("traceEvents", [])
+    # device lanes: pid names containing "TPU"/"Device"; host lanes are
+    # python/runtime noise
+    dev_pids = set()
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            name = e.get("args", {}).get("name", "")
+            if "TPU" in name or "Device" in name or "/device:" in name:
+                dev_pids.add(e.get("pid"))
+    agg = {}
+    total = 0.0
+    for e in events:
+        if e.get("ph") != "X" or e.get("pid") not in dev_pids:
+            continue
+        dur = float(e.get("dur", 0.0))
+        name = e.get("name", "?")
+        total += dur
+        a = agg.setdefault(name, [0.0, 0])
+        a[0] += dur
+        a[1] += 1
+    rows = sorted(agg.items(), key=lambda kv: -kv[1][0])[:top_n]
+    print(f"device total {total / 1e3:.1f} ms across {len(agg)} op names")
+    for name, (dur, cnt) in rows:
+        print(f"{dur / 1e3:9.2f} ms {cnt:6d}x  {100 * dur / total:5.1f}%  "
+              f"{name[:110]}")
+
+
+def main():
+    if len(sys.argv) < 2:
+        raise SystemExit(__doc__)
+    config = sys.argv[1]
+    n_envs = int(sys.argv[2]) if len(sys.argv) > 2 else (
+        8192 if config == "bk" else 4096)
+    top_n = int(sys.argv[3]) if len(sys.argv) > 3 else 40
+
+    import jax
+
+    fn, keys, n_steps = build(config, n_envs)
+    log(f"compiling {config} n_envs={n_envs}")
+    t0 = time.time()
+    fetch(fn(keys) if keys is not None else fn(None))
+    log(f"compile+first {time.time() - t0:.1f}s; warm rep...")
+    t0 = time.time()
+    fetch(fn(keys) if keys is not None else fn(None))
+    dt = time.time() - t0
+    log(f"warm rep {dt:.2f}s = {n_envs * n_steps / dt:,.0f} steps/s")
+
+    trace_dir = os.environ.get("CPR_TRACE_DIR") or tempfile.mkdtemp(
+        prefix=f"trace_{config}_")
+    log(f"tracing into {trace_dir}")
+    with jax.profiler.trace(trace_dir):
+        fetch(fn(keys) if keys is not None else fn(None))
+    summarize(trace_dir, top_n)
+
+
+if __name__ == "__main__":
+    main()
