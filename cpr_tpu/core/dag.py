@@ -434,6 +434,29 @@ def first_by_age(dag: Dag, mask):
     return jnp.where(mask.any(), best, NONE)
 
 
+def last_by_age(dag: Dag, mask):
+    """Index of the latest-appended block in `mask` (NONE if empty) —
+    the wrap-safe form of `where(mask, slots, -1).max()`."""
+    key = jnp.where(mask, dag.age_key(), jnp.int32(-1))
+    best = jnp.argmax(key).astype(jnp.int32)
+    return jnp.where(mask.any(), best, NONE)
+
+
+def descendants_mask(dag: Dag, a) -> jnp.ndarray:
+    """(B,) mask of blocks having `a` on their chain-ancestry row (a
+    included) — one column read of the chain plane.  Replaces bounded
+    descent walks ('does x's chain pass through a?').  Ring staleness:
+    a row's bit at column a refers to a PREVIOUS occupant iff the
+    current occupant is younger than the row owner, so requiring the
+    row owner to be at least as young as `a` keeps exactly the bits
+    that mean the current occupant."""
+    ai = jnp.maximum(a, 0)
+    col = jnp.where(a >= 0, dag.chain[:, ai], False)
+    if dag.is_ring:
+        col = col & (dag.gid >= dag.gid[ai])
+    return col & dag.exists()
+
+
 def select_vis(cond, released: Dag, dag: Dag) -> Dag:
     """where(cond, released, dag) specialized to what release() can
     change: the two defender-visibility arrays.  A full-pytree
@@ -447,12 +470,25 @@ def select_vis(cond, released: Dag, dag: Dag) -> Dag:
     )
 
 
+def newer_than(dag: Dag, v) -> jnp.ndarray:
+    """(B,) mask of blocks appended AFTER v — the ring guard for every
+    stored-pointer equality query.  After a wrap, a stale row's slot
+    pointer aliases the slot's NEW occupant (a vote of a retired block
+    r still resident when r's slot is reclaimed by x would read as a
+    child of x); genuine referrers are always younger than their
+    target, and stale rows always predate the reclaimer, so the age
+    compare separates them exactly.  All-true in full mode."""
+    if not dag.is_ring:
+        return jnp.ones((dag.capacity,), jnp.bool_)
+    return dag.gid > dag.gid[jnp.maximum(v, 0)]
+
+
 def children_mask(dag: Dag, v) -> jnp.ndarray:
     """(B,) mask of blocks having v among their parents (dag.ml:44)."""
     hit = dag.parents[0] == v
     for plane in dag.parents[1:]:
         hit = hit | (plane == v)
-    return dag.exists() & hit
+    return dag.exists() & hit & newer_than(dag, v)
 
 
 def children0_mask(dag: Dag, v) -> jnp.ndarray:
@@ -461,7 +497,7 @@ def children0_mask(dag: Dag, v) -> jnp.ndarray:
     and proposals both precede via slot 0 — this replaces a padded
     (B, P)-matrix scan with a flat (B,) compare (~10x cheaper on TPU,
     see Dag.parent0)."""
-    return dag.exists() & (dag.parent0 == v)
+    return dag.exists() & (dag.parent0 == v) & newer_than(dag, v)
 
 
 def release(dag: Dag, mask, time) -> Dag:

@@ -123,7 +123,8 @@ class BkSSZ(JaxEnv):
     reset_dag_rows = 2
 
     def __init__(self, k: int = 8, incentive_scheme: str = "constant",
-                 unit_observation: bool = True, max_steps_hint: int = 256):
+                 unit_observation: bool = True, max_steps_hint: int = 256,
+                 window: int | None = None):
         assert incentive_scheme in ("constant", "block")
         self.k = k
         self.incentive_scheme = incentive_scheme
@@ -131,6 +132,16 @@ class BkSSZ(JaxEnv):
         # <= 2 appends per step (attacker proposal + PoW/defender
         # proposal); floored at k so quorum top_k always fits
         self.capacity = max(2 * max_steps_hint + 8, k + 8)
+        # O(active-set) mode: a ring window of `window` slots replaces
+        # the episode-length-proportional capacity — per-step cost
+        # becomes O(window) like the reference's event loop only ever
+        # touching the live fork (simulator.ml:421-533).  The window
+        # must cover the fork plus its votes ((k+1) slots per withheld
+        # block); a deeper fork overflows and ends the episode, exactly
+        # like capacity exhaustion in full mode.
+        if window is not None:
+            self.capacity = max(window, k + 8)
+        self.ring = window is not None
         self.max_parents = k + 1
         self.fields = obs_fields(k)
         self.observation_length = len(self.fields)
@@ -273,7 +284,11 @@ class BkSSZ(JaxEnv):
     # -- env API ----------------------------------------------------------
 
     def reset(self, key: jax.Array, params: EnvParams):
-        dag = D.empty(self.capacity, self.max_parents)
+        # anc_masks: the chain/closure rows replace the three per-step
+        # while-loop walks (common ancestor, height target, release
+        # chain) with masked reductions
+        dag = D.empty(self.capacity, self.max_parents,
+                      ring=self.ring, anc_masks=True)
         # genesis block (bk.ml:48); no leader vote -> +inf leader hash
         dag, root = D.append(
             dag, jnp.full((self.max_parents,), D.NONE, jnp.int32),
@@ -380,7 +395,8 @@ class BkSSZ(JaxEnv):
     def observe(self, state: State):
         """bk_ssz.ml:225-263."""
         dag = state.dag
-        ca = D.common_ancestor_by_height(dag, state.public, state.private)
+        ca = jnp.maximum(
+            D.common_ancestor_masked(dag, state.public, state.private), 0)
         pub_votes = self.votes_on(dag, state.public, dag.vis_d).sum()
         priv_inc = self.votes_on(dag, state.private).sum()
         priv_exc = self.votes_on(dag, state.private,
@@ -421,16 +437,18 @@ class BkSSZ(JaxEnv):
         tgt_v = jnp.where(is_match, nv_pub,
                           jnp.where(nv_pub >= k, 0, nv_pub + 1))
 
-        # walk private chain of blocks down to target height
-        blk = D.block_at_height(dag, state.private, tgt_h)
+        # private chain block at the target height: one masked reduction
+        # over the ancestry row (block chains ride parent slot 0, so the
+        # chain plane holds exactly the private block chain)
+        blk = D.chain_first_at_most(dag, state.private, dag.height, tgt_h)
         blk = jnp.maximum(blk, 0)
         # if quorum-size votes requested, prefer an existing proposal
         # child; the reference takes the FIRST child block in insertion
-        # order, not the best by leader hash (bk_ssz.ml:294-300), which
-        # lowest-slot argmax reproduces exactly
+        # order, not the best by leader hash (bk_ssz.ml:294-300) —
+        # insertion order is the age key (slot order wraps in a ring)
         child_blocks = D.children0_mask(dag, blk) & (dag.kind == BLOCK)
         has_prop = child_blocks.any()
-        first_prop = jnp.argmax(child_blocks)
+        first_prop = jnp.maximum(D.first_by_age(dag, child_blocks), 0)
         use_prop = (tgt_v >= k) & has_prop
         rel_block = jnp.where(use_prop, first_prop, blk)
         rel_votes_n = jnp.where(use_prop, 0, tgt_v)
@@ -449,9 +467,10 @@ class BkSSZ(JaxEnv):
         vote_mask = D.mask_of(vidx, vvalid & take, self.capacity)
         vote_mask = jnp.where(release_all, votes, vote_mask)
 
-        released = D.release_chain(dag, rel_block, state.time)
-        # the chosen votes sit directly on the released block's chain, so a
-        # flat release covers their ancestry
+        # recursive share via the closure row (was a while-loop chain
+        # walk); the chosen votes sit directly on the released block's
+        # chain, so a flat release covers their ancestry
+        released = D.release_masked(dag, rel_block, state.time)
         released = D.release(released, vote_mask, state.time)
         dag = D.select_vis(is_release, released, dag)
 
@@ -486,6 +505,15 @@ class BkSSZ(JaxEnv):
         state = self._advance(state, params)
         state = state.replace(steps=state.steps + 1)
         dag = state.dag
+
+        if self.ring:
+            # retire everything below the preference fork: every later
+            # read starts at public/private/pending (all descendants of
+            # their common ancestor) or at votes hanging on the fork
+            # (appended after the CA, so gid-above it)
+            ca = D.common_ancestor_masked(dag, state.public, state.private)
+            dag = D.retire_below(dag, dag.gid[jnp.maximum(ca, 0)])
+            state = state.replace(dag=dag)
 
         # winner over [attacker pref, defender pref]; ties attacker first
         # (engine.ml:196-206; referee compare: height then all votes,

@@ -182,13 +182,17 @@ class EthereumSSZ(JaxEnv):
             b = ancestors[-1]
         return ancestors, in_chain
 
-    def uncle_candidates(self, dag, head, view_mask, filter_mask):
+    def uncle_candidates(self, dag, head, view_mask, filter_mask,
+                         window=None):
         """Mask of includable uncles for a block on `head`
         (ethereum.ml:252-268): not in chain, chain parent among the
         non-uncle ancestors, visible in the miner's view, passing the
         mining-rule filter. Mask semantics dedupe candidates reachable via
-        several window blocks."""
-        ancestors, in_chain = self.chain_window(dag, head)
+        several window blocks.  `window` takes a precomputed
+        chain_window(dag, head) so callers probing several filters at
+        the same head (observe's inclusive/exclusive counts) pay for the
+        6-level walk once."""
+        ancestors, in_chain = window or self.chain_window(dag, head)
         p0 = dag.parent0
         on_anc = (p0 == ancestors[0]) & (ancestors[0] >= 0)
         for a in ancestors[1:]:
@@ -251,7 +255,12 @@ class EthereumSSZ(JaxEnv):
     # -- env API -----------------------------------------------------------
 
     def reset(self, key: jax.Array, params: EnvParams):
-        dag = D.empty(self.capacity, self.max_parents, lift=True)
+        # anc_masks, not lift: the incremental ancestry rows turn every
+        # per-step walk (two common-ancestor walks, the release-target
+        # walk, the release chain+closure fixpoint — 68% of the step in
+        # the round-5 device profile) into one masked reduction; the
+        # binary-lifting jump walk they replace is dead weight here
+        dag = D.empty(self.capacity, self.max_parents, anc_masks=True)
         dag, root = D.append(
             dag, jnp.full((self.max_parents,), D.NONE, jnp.int32),
             kind=0, height=0, aux=0, miner=D.NONE, vis_a=True, vis_d=True,
@@ -320,13 +329,14 @@ class EthereumSSZ(JaxEnv):
         more than 1 per block (uncles), so the walk may stop strictly
         below `target` and release an already-public block — the
         reference's release_upto has exactly the same stop rule and
-        behavior; Override is then a deliberate no-op."""
-        pref_all = self.pref_all(dag)
+        behavior; Override is then a deliberate no-op.
 
-        def stop(dag_, i):
-            return pref_all[i] <= target
-
-        return D.walk_back(dag, private, stop)
+        Preference is monotone nonincreasing down the chain (height and
+        cumulative work both are), so the first satisfying block on the
+        way down is the highest-height satisfying chain member — one
+        masked reduction over the ancestry row instead of a walk."""
+        return D.chain_first_at_most(dag, private, self.pref_all(dag),
+                                     target)
 
     def _apply(self, state: State, action) -> State:
         """ethereum_ssz.ml:398-429."""
@@ -338,7 +348,8 @@ class EthereumSSZ(JaxEnv):
 
         is_adopt = (act == ADOPT_DISCARD) | (act == ADOPT_RELEASE)
         pub_pref = self.pref(dag, state.public)
-        ca = D.common_ancestor_by_height(dag, state.public, state.private)
+        ca = D.common_ancestor_masked(dag, state.public, state.private)
+        ca = jnp.maximum(ca, 0)
         # non-walking actions get a huge target so the walk stops at the
         # private tip immediately instead of running to genesis
         target = jnp.where(
@@ -354,17 +365,14 @@ class EthereumSSZ(JaxEnv):
             | (act == MATCH) | (act == RELEASE1)
         release_tip = jnp.where(do_release, release_tip, jnp.int32(-1))
 
-        # release_closure, not release_with_ancestors: uncles ride in
-        # the parent row, so the O(newly-released) chain walk plus the
-        # one-check visibility closure (for withheld uncles-of-uncles)
-        # covers the recursive-share set.  The old fixpoint's while_loop
-        # trip count grew with chain height — run unconditionally every
-        # step it made episodes quadratic and pushed large-batch scans
-        # past the axon worker's ~60-75 s per-call ceiling (round-3
-        # bisects, tools/tpu_limit_probe.py).
-        released = D.release_closure(dag, release_tip, state.time)
-        dag = jax.tree.map(
-            lambda a, b: jnp.where(do_release, a, b), released, dag)
+        # the recursive share (simulator.ml:401-419) is one closure-row
+        # read: the incremental ancestry plane covers chain ancestors,
+        # uncles, and withheld uncles-of-uncles alike — no chain walk,
+        # no visibility fixpoint (round-5 profile: those while loops
+        # were 68% of the step).  select_vis, not a full-tree select:
+        # release only touches the two defender-visibility arrays.
+        released = D.release_masked(dag, release_tip, state.time)
+        dag = D.select_vis(do_release, released, dag)
 
         # deliver the released tip to the defender cloud
         public = jnp.where(
@@ -392,23 +400,26 @@ class EthereumSSZ(JaxEnv):
     def observe(self, state: State):
         """ethereum_ssz.ml:364-396."""
         dag = state.dag
-        ca = D.common_ancestor_by_height(dag, state.public, state.private)
+        ca = jnp.maximum(
+            D.common_ancestor_masked(dag, state.public, state.private), 0)
         ph = dag.height[state.public] - dag.height[ca]
         pw = dag.aux[state.public] - dag.aux[ca]
         ah = dag.height[state.private] - dag.height[ca]
         aw = dag.aux[state.private] - dag.aux[ca]
-        # orphan counts are draft uncle counts, capped by max_uncles
+        # orphan counts are draft uncle counts, capped by max_uncles;
+        # the inclusive/exclusive pair shares one private-head window
+        win_priv = self.chain_window(dag, state.private)
         pub_orph = jnp.minimum(
             self.uncle_candidates(dag, state.public, dag.vis_a,
                                   dag.vis_d).sum(),
             self.max_uncles)
         inc = jnp.minimum(
             self.uncle_candidates(dag, state.private, dag.vis_a,
-                                  dag.miner >= 0).sum(),
+                                  dag.miner >= 0, win_priv).sum(),
             self.max_uncles)
         exc = jnp.minimum(
             self.uncle_candidates(dag, state.private, dag.vis_a,
-                                  dag.miner == D.ATTACKER).sum(),
+                                  dag.miner == D.ATTACKER, win_priv).sum(),
             self.max_uncles)
         return obslib.encode(
             OBS_FIELDS,

@@ -76,9 +76,39 @@ def candidate_frame(dag, cand, C: int, vote_kind: int, max_vote_parents: int = 1
     frame_onehot matrix for candidate-local gathers.
     """
     assert C < (1 << 8), "composite sort keys reserve 8 bits for C-sized fields"
-    cidx, cvalid = D.top_k_by(dag.slots().astype(jnp.float32), cand, C)
+    cidx, cvalid = D.top_k_by(dag.age_key().astype(jnp.float32), cand, C)
     cidx = jnp.where(cvalid, cidx, -1)
     oh = frame_onehot(dag, cidx, cvalid)
+
+    if dag.has_masks:
+        # the ancestor relation is already materialized: a candidate's
+        # vote ancestors are its closure-plane row restricted to votes
+        # of the same block (votes store their block in `signer`, so
+        # deeper blocks' votes — also in the closure — drop out).  Two
+        # one-hot matmuls replace the per-parent adjacency build plus
+        # the log-doubling closure (three 5.3 ms calls per step at 4096
+        # envs in the round-5 tailstorm device profile).  bf16 operands
+        # are exact here: one-hot rows make every entry 0 or 1.
+        rows = jnp.matmul(oh.astype(jnp.bfloat16),
+                          dag.closure.astype(jnp.bfloat16)) > 0.5
+        if dag.is_ring:
+            gid_c = oh_gather(oh, dag.gid).astype(jnp.int32)
+            rows = rows & (dag.gid[None, :] <= gid_c[:, None])
+        sig_c = jnp.where(cvalid,
+                          oh_gather(oh, dag.signer).astype(jnp.int32), -2)
+        anc_votes = (rows & (dag.kind == vote_kind)[None, :]
+                     & (dag.signer[None, :] == sig_c[:, None]))
+        frame_mask = D.mask_of(cidx, cvalid, dag.capacity)
+        # reachability runs through filtered child traversals
+        # (tailstorm.ml:509-531): an out-of-frame vote ancestor makes
+        # the whole branch unreachable (escape propagates transitively
+        # through the closure, so one test per candidate suffices)
+        escaped = (anc_votes & ~frame_mask[None, :]).any(axis=1)
+        cvalid = cvalid & ~escaped
+        abits = (jnp.matmul(anc_votes.astype(jnp.bfloat16),
+                            oh.astype(jnp.bfloat16).T) > 0.5)
+        abits = abits & cvalid[:, None] & cvalid[None, :]
+        return cidx, cvalid, abits, oh
 
     adj = jnp.zeros((C, C), jnp.float32)
     escaped = jnp.zeros((C,), jnp.bool_)
@@ -336,7 +366,7 @@ def prefix_release_sets(dag, public, private, cands, R: int, last_all,
 
     Returns (override_set, match_set, found, new_head).
     """
-    ridx, rvalid = D.top_k_by(dag.slots().astype(jnp.float32), cands, R)
+    ridx, rvalid = D.top_k_by(dag.age_key().astype(jnp.float32), cands, R)
     roh = frame_onehot(dag, ridx, rvalid)
 
     def rg(arr):
@@ -348,14 +378,24 @@ def prefix_release_sets(dag, public, private, cands, R: int, last_all,
     # in all three envs votes (and only votes) store their block/summary
     # in the signer column, so signer >= 0 identifies confirming votes
     is_conf = dag.exists() & (dag.signer >= 0)
-    conf_vis = ((is_conf & dag.vis_d)[:, None]
-                & (dag.signer[:, None] == lb[None, :])).sum(axis=0)
+    conf_rows = ((is_conf & dag.vis_d)[:, None]
+                 & (dag.signer[:, None] == lb[None, :]))
+    if dag.is_ring:
+        # ring wrap: a retired summary's still-resident votes alias the
+        # reclaimed slot's new occupant; genuine confirmers are younger
+        # than their summary (same guard as D.newer_than, vectorized
+        # over the candidate summaries)
+        gid_lb = oh_gather(frame_onehot(dag, lb, rvalid),
+                           dag.gid).astype(jnp.int32)
+        conf_rows = conf_rows & (dag.gid[:, None] > gid_lb[None, :])
+    conf_vis = conf_rows.sum(axis=0)
     cand_vote = (csig >= 0) & rvalid
     cmat = cand_vote[:, None] & (csig[:, None] == lb[None, :])
     leq = jnp.triu(jnp.ones((R, R), jnp.bool_))
     nconf = conf_vis + (cmat & leq).sum(axis=0)
 
-    pub_vis = (is_conf & dag.vis_d & (dag.signer == public)).sum()
+    pub_vis = (is_conf & dag.vis_d & (dag.signer == public)
+               & D.newer_than(dag, public)).sum()
     npub = pub_vis + jnp.cumsum(cand_vote & (csig == public))
 
     # every vertex is appended with its block/summary's height, so
@@ -398,9 +438,18 @@ def stale_after_adopt(dag, public, stale, is_adopt, R: int, walk: int,
     chain down `walk` levels (deeper withheld branches above the adopted
     head cannot exist: the attacker adopts because it is behind).
     `last_all` is the same precomputed (B,) block/summary array as in
-    prefix_release_sets."""
+    prefix_release_sets.
+
+    With ancestry masks the descent test is one chain-plane column
+    read (does x's chain pass through `public`?) — no compaction, no
+    per-level gathers, and no `walk` depth bound (the bound was safe
+    only because deeper withheld branches cannot exist; the column is
+    exact at any depth)."""
     withheld = ~dag.vis_d & dag.exists() & ~stale
-    widx, wvalid = D.top_k_by(dag.slots().astype(jnp.float32), withheld, R)
+    if dag.has_masks:
+        keep_mask = D.descendants_mask(dag, public)
+        return jnp.where(is_adopt, stale | (withheld & ~keep_mask), stale)
+    widx, wvalid = D.top_k_by(dag.age_key().astype(jnp.float32), withheld, R)
     woh = frame_onehot(dag, widx, wvalid)
     cur = jnp.where(wvalid, oh_gather(woh, last_all).astype(jnp.int32), -1)
     keeps = jnp.zeros_like(wvalid)

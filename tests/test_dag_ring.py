@@ -207,6 +207,55 @@ def test_ring_overflow_on_deep_fork():
     assert bool(dag.overflow)
 
 
+def test_bk_ring_episode_matches_full():
+    """A windowed bk env replays a full-capacity episode bit-for-bit:
+    same keys, same policy, identical episode stats.  The window (64)
+    is chosen WELL BELOW the per-episode append count (~1.2 per step x
+    120 steps), so every episode wraps the ring 1-2x — the regime where
+    reclaimed slots alias stale rows (the ghost-vote class the
+    newer_than guards exist for); bit-equality across 24 streams would
+    catch one ghost vote changing one quorum."""
+    from cpr_tpu.envs.bk import BkSSZ
+    from cpr_tpu.params import make_params
+
+    params = make_params(alpha=0.3, gamma=0.5, max_steps=120)
+    keys = jax.random.split(jax.random.PRNGKey(1), 24)
+    outs = []
+    for env in (BkSSZ(k=4, max_steps_hint=128),
+                BkSSZ(k=4, max_steps_hint=128, window=64)):
+        assert (env.capacity == 64) == env.ring
+        fn = jax.jit(jax.vmap(lambda k: env.episode_stats(
+            k, params, env.policies["get-ahead"], 128)))
+        outs.append(jax.block_until_ready(fn(keys)))
+    full, ring = outs
+    for key in sorted(full):
+        np.testing.assert_array_equal(
+            np.asarray(full[key]), np.asarray(ring[key]), err_msg=key)
+
+
+def test_tailstorm_ring_episode_matches_full():
+    """Windowed tailstorm replays full-capacity episodes bit-for-bit
+    (quorum frames, release prefixes, and stale bits all order by age
+    key).  Window 48 < ~1.1 appends/step x 96 steps, so every episode
+    wraps the ring — exercising slot reuse under the confirming/dup
+    newer_than guards."""
+    from cpr_tpu.envs.tailstorm import TailstormSSZ
+    from cpr_tpu.params import make_params
+
+    params = make_params(alpha=0.3, gamma=0.5, max_steps=96)
+    keys = jax.random.split(jax.random.PRNGKey(2), 16)
+    outs = []
+    for env in (TailstormSSZ(k=4, max_steps_hint=104),
+                TailstormSSZ(k=4, max_steps_hint=104, window=48)):
+        fn = jax.jit(jax.vmap(lambda k: env.episode_stats(
+            k, params, env.policies["get-ahead"], 104)))
+        outs.append(jax.block_until_ready(fn(keys)))
+    full, ring = outs
+    for key in sorted(full):
+        np.testing.assert_array_equal(
+            np.asarray(full[key]), np.asarray(ring[key]), err_msg=key)
+
+
 def test_ring_first_by_age_wraps():
     dag = D.empty(4, 1, ring=True)
     dag, a = D.append(dag, jnp.array([-1], jnp.int32), height=0)
