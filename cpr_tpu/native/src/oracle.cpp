@@ -170,6 +170,9 @@ struct Sim {
 
   std::vector<std::vector<char>> visible;   // [node][block]
   std::vector<std::vector<char>> known;     // received but maybe buffered
+  // when the node first saw each block (visible_since in the reference
+  // views, simulator.ml:2-10) — the altruistic quorum sorts by it
+  std::vector<std::vector<double>> visible_at;
   // confirmers[b] = ids of votes with vote_id == b, append order —
   // replaces O(|dag|) scans in the parallel family's confirming-vote
   // lookups (kept empty unless proto->votes_confirm_blocks())
@@ -219,6 +222,7 @@ struct Sim {
     int g = dag.add(proto->genesis());
     visible.assign(n_nodes, {});
     known.assign(n_nodes, {});
+    visible_at.assign(n_nodes, {});
     preferred.assign(n_nodes, g);
     for (int i = 0; i < n_nodes; i++) mark_visible(i, g);
     schedule_activation();
@@ -227,10 +231,18 @@ struct Sim {
   void mark_visible(int node, int b) {
     auto& v = visible[node];
     auto& k = known[node];
+    auto& t = visible_at[node];
     if ((int)v.size() <= b) v.resize(dag.blocks.size(), 0);
     if ((int)k.size() <= b) k.resize(dag.blocks.size(), 0);
+    if ((int)t.size() <= b) t.resize(dag.blocks.size(), 0.0);
+    if (!v[b]) t[b] = now;
     v[b] = 1;
     k[b] = 1;
+  }
+
+  double seen_at(int node, int b) const {
+    const auto& t = visible_at[node];
+    return b < (int)t.size() ? t[b] : 0.0;
   }
 
   bool is_visible(int node, int b) const {
@@ -647,7 +659,18 @@ struct Bk final : Protocol {
 
 struct ParallelBase : Protocol {
   int k;
+  // sub-block selection: 0 heuristic, 1 altruistic, 2 optimal
+  // (tailstorm.ml:271-313 / :329-380 / :418-506; parsed from the
+  // scheme string's ":selector" suffix in cpr_oracle_create)
+  int selector = 0;
   explicit ParallelBase(int k_) : k(k_) {}
+
+  // selector dispatch shared by stree drafts and tailstorm proposals;
+  // the optimal scorer needs the scheme knobs (see optimal_quorum)
+  std::vector<int> select_quorum(Sim& s, const Dag& d,
+                                 const std::vector<int>& cands, int node,
+                                 int q, bool discount, bool punish,
+                                 int depth_plus, int miner_share);
 
   bool votes_confirm_blocks() const override { return true; }
 
@@ -854,8 +877,153 @@ static std::vector<int> quorum_leaves(const Dag& d, std::vector<int> sel) {
   return leaves;
 }
 
+// longest-branch-first quorum (tailstorm.ml:271-313 altruistic_quorum):
+// candidates sorted by (depth desc, own first, first-seen asc), each
+// candidate's fresh closure joins iff the quorum still fits; succeeds
+// only when exactly q votes assemble (and >= q candidates existed).
+static std::vector<int> altruistic_quorum(Sim& s, const Dag& d,
+                                          const std::vector<int>& cands,
+                                          int me, int q) {
+  if ((int)cands.size() < q) return {};
+  std::vector<int> sorted = cands;
+  std::sort(sorted.begin(), sorted.end(), [&](int a, int b) {
+    if (d.blocks[a].work != d.blocks[b].work)
+      return d.blocks[a].work > d.blocks[b].work;  // depth desc
+    bool ma = d.blocks[a].miner == me, mb = d.blocks[b].miner == me;
+    if (ma != mb) return ma;  // own first
+    return s.seen_at(me, a) < s.seen_at(me, b);  // earlier-seen first
+  });
+  std::vector<int> sel;
+  auto in_sel = [&](int v) {
+    return std::find(sel.begin(), sel.end(), v) != sel.end();
+  };
+  int n = 0;
+  for (int hd : sorted) {
+    if (n == q) break;
+    std::vector<int> fresh;
+    for (int v : vote_closure(d, hd))
+      if (!in_sel(v)) fresh.push_back(v);
+    if (fresh.empty() || n + (int)fresh.size() > q) continue;
+    for (int v : fresh) sel.push_back(v);
+    n = (int)sel.size();
+  }
+  if (n != q) return {};
+  return sel;
+}
+
+static long n_choose_k_capped(long n, long k, long cap) {
+  if (k > n) return 0;
+  long r = 1;
+  for (long i = 1; i <= k; i++) {
+    r = r * (n - k + i) / i;
+    if (r > cap) return cap + 1;
+  }
+  return r;
+}
+
+// exhaustive reward-optimal quorum (tailstorm.ml:418-506): enumerate
+// every size-q choice of the confirming votes in ascending id (= DAG
+// partial) order, keep the closure-closed ones, score the draft's own
+// reward under the incentive scheme, first maximum wins.  More than
+// `max_options` combinations sets *fallback (the reference's 100-cap
+// heuristic fallback, tailstorm.ml:426-428).  depth_plus/miner_share
+// mirror the env scorer (cpr_tpu/envs/quorum.py quorum_optimal):
+// tailstorm pays votes only with r = depth/k; stree pays (depth+1)/k
+// and includes the block itself.
+static std::vector<int> optimal_quorum(const Dag& d,
+                                       const std::vector<int>& cands_in,
+                                       int me, int q, bool discount,
+                                       bool punish, int depth_plus,
+                                       int miner_share, int k,
+                                       bool* fallback) {
+  *fallback = false;
+  std::vector<int> cands = cands_in;
+  std::sort(cands.begin(), cands.end());
+  int n = (int)cands.size();
+  if (n_choose_k_capped(n, q, 100) > 100) {
+    *fallback = true;
+    return {};
+  }
+  if (n < q || q < 1) return {};
+  std::vector<int> idx(q);
+  for (int i = 0; i < q; i++) idx[i] = i;
+  std::vector<int> best;
+  double best_score = -1.0;
+  while (true) {
+    // connectivity: every chosen vote's vote-parents must be chosen
+    std::vector<char> chosen(n, 0);
+    for (int i : idx) chosen[i] = 1;
+    auto pos = [&](int v) {
+      auto it = std::lower_bound(cands.begin(), cands.end(), v);
+      return it != cands.end() && *it == v ? (int)(it - cands.begin())
+                                           : -1;
+    };
+    bool ok = true;
+    for (int i : idx) {
+      for (int p : d.blocks[cands[i]].parents) {
+        if (!d.blocks[p].is_vote) continue;
+        int j = pos(p);
+        if (j < 0 || !chosen[j]) {
+          ok = false;
+          break;
+        }
+      }
+      if (!ok) break;
+    }
+    if (ok) {
+      std::vector<int> sel;
+      for (int i : idx) sel.push_back(cands[i]);
+      std::vector<int> leaves = quorum_leaves(d, sel);
+      int depth_first = leaves.empty() ? 0 : d.blocks[leaves[0]].work;
+      double r = discount ? (double)(depth_first + depth_plus) / k : 1.0;
+      std::vector<int> paid =
+          punish && !leaves.empty() ? vote_closure(d, leaves[0]) : sel;
+      int own = miner_share;
+      for (int v : paid)
+        if (d.blocks[v].miner == me) own++;
+      double score = r * own;
+      if (score > best_score) {
+        best_score = score;
+        best = sel;
+      }
+    }
+    // next combination (lexicographic ascending)
+    int i = q - 1;
+    while (i >= 0 && idx[i] == n - q + i) i--;
+    if (i < 0) break;
+    idx[i]++;
+    for (int j = i + 1; j < q; j++) idx[j] = idx[j - 1] + 1;
+  }
+  return best;
+}
+
+std::vector<int> ParallelBase::select_quorum(Sim& s, const Dag& d,
+                                             const std::vector<int>& cands,
+                                             int node, int q,
+                                             bool discount, bool punish,
+                                             int depth_plus,
+                                             int miner_share) {
+  if (selector == 1) return altruistic_quorum(s, d, cands, node, q);
+  if (selector == 2) {
+    bool fb = false;
+    std::vector<int> sel =
+        optimal_quorum(d, cands, node, q, discount, punish, depth_plus,
+                       miner_share, k, &fb);
+    if (!fb) return sel;
+    // over the option cap: the reference falls back to the heuristic
+  }
+  return heuristic_quorum(d, cands, node, q);
+}
+
 struct Stree final : ParallelBase {
-  int scheme;  // 0 constant, 1 discount, 2 punish, 3 hybrid
+  // 0 constant, 1 discount, 2 punish, 3 hybrid, 4 block.
+  // `block` is Tailstorm/ll June's extra scheme (the whole k to the
+  // summary's miner, tailstorm_june.ml:177 constant_block) — the June
+  // variant IS Stree's structure (PoW summaries carrying k-1
+  // depth-labelled votes), kept by the reference to reproduce W&B run
+  // 257 (tailstorm_june.ml:3-9); protocol key "tailstormjune" maps
+  // here with the scheme menu extended.
+  int scheme;
   Stree(int k_, int sch) : ParallelBase(k_), scheme(sch) {}
 
   Block genesis() const override { return Block{}; }
@@ -864,7 +1032,9 @@ struct Stree final : ParallelBase {
     const Dag& d = s.dag;
     int pref = last_block(d, preferred);
     std::vector<int> cands = confirming(s, node, pref);
-    std::vector<int> sel = heuristic_quorum(d, cands, node, k - 1);
+    std::vector<int> sel = select_quorum(
+        s, d, cands, node, k - 1, scheme == 1 || scheme == 3,
+        scheme == 2 || scheme == 3, /*depth_plus=*/1, /*miner_share=*/1);
     if (!sel.empty() || k == 1) {
       std::vector<int> leaves = quorum_leaves(d, sel);
       Block blk;
@@ -894,6 +1064,12 @@ struct Stree final : ParallelBase {
 
   void rewards(const Dag& d, int head,
                std::vector<double>& per_miner) const override {
+    if (scheme == 4) {  // june `Block: summary miner collects k
+      for (int b = last_block(d, head); d.blocks[b].miner >= 0;
+           b = last_block(d, d.blocks[b].parents[0]))
+        per_miner[d.blocks[b].miner] += (double)k;
+      return;
+    }
     bool discount = scheme == 1 || scheme == 3;
     bool punish = scheme == 2 || scheme == 3;
     for (int b = last_block(d, head); d.blocks[b].miner >= 0;
@@ -961,7 +1137,9 @@ struct Tailstorm final : ParallelBase {
     // only worthwhile when it can become the preferred tip
     if (d.blocks[summ].height + 1 < d.blocks[pref].height) return {};
     std::vector<int> cands = confirming(s, node, summ);
-    std::vector<int> sel = heuristic_quorum(d, cands, node, k);
+    std::vector<int> sel = select_quorum(
+        s, d, cands, node, k, scheme == 1 || scheme == 3,
+        scheme == 2 || scheme == 3, /*depth_plus=*/0, /*miner_share=*/0);
     if (sel.empty() && k > 0) return {};
     std::vector<int> leaves = quorum_leaves(d, sel);
     Block blk;
@@ -1665,6 +1843,18 @@ void* cpr_oracle_create(const char* protocol, int k, const char* scheme,
   s.activation_delay = activation_delay;
 
   std::string proto(protocol), topo(topology), sch(scheme ? scheme : "");
+  // the scheme string may carry a sub-block selector suffix
+  // ("discount:optimal"); default heuristic (oracle parity with the
+  // env registry's tailstorm/stree selector option)
+  int selector = 0;
+  {
+    auto pos = sch.find(':');
+    if (pos != std::string::npos) {
+      std::string sel = sch.substr(pos + 1);
+      sch = sch.substr(0, pos);
+      selector = sel == "altruistic" ? 1 : sel == "optimal" ? 2 : 0;
+    }
+  }
   if (proto == "nakamoto") {
     s.proto.reset(new Nakamoto());
   } else if (proto == "ethereum-whitepaper") {
@@ -1675,13 +1865,19 @@ void* cpr_oracle_create(const char* protocol, int k, const char* scheme,
     s.proto.reset(new Bk(k, sch == "block"));
   } else if (proto == "spar") {
     s.proto.reset(new Spar(k, sch == "block"));
-  } else if (proto == "stree" || proto == "tailstorm") {
+  } else if (proto == "stree" || proto == "tailstorm" ||
+             proto == "tailstormjune") {
     int scheme = sch == "discount" ? 1 : sch == "punish" ? 2
-                 : sch == "hybrid" ? 3 : 0;
-    if (proto == "stree")
-      s.proto.reset(new Stree(k, scheme));
-    else
-      s.proto.reset(new Tailstorm(k, scheme));
+                 : sch == "hybrid" ? 3
+                 : sch == "block" ? 4 : 0;
+    ParallelBase* p;
+    if (proto == "tailstorm")
+      p = new Tailstorm(k, scheme);
+    else  // stree; tailstormjune IS stree's structure + the block
+          // scheme (tailstorm_june.ml:3-9, see Stree::scheme)
+      p = new Stree(k, scheme);
+    p->selector = selector;
+    s.proto.reset(p);
   } else if (proto == "sdag") {
     s.proto.reset(new Sdag(k, sch == "discount"));
   } else {
@@ -1753,7 +1949,8 @@ void* cpr_oracle_create(const char* protocol, int k, const char* scheme,
                         : pol == "get-ahead-appendint" ? 2
                                                      : -1;
     } else if (proto == "spar" || proto == "stree" ||
-               proto == "tailstorm" || proto == "sdag") {
+               proto == "tailstorm" || proto == "sdag" ||
+               proto == "tailstormjune") {
       auto* a = new ParAgent();
       a->k = k;
       s.agent.reset(a);

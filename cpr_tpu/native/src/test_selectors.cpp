@@ -1,0 +1,204 @@
+// Unit test for the oracle's three sub-block selectors
+// (tailstorm.ml:271-313 altruistic, :329-380 heuristic, :418-506
+// optimal): build crafted vote forests where the selections MUST
+// differ, and check the own-reward ordering optimal >= heuristic >=
+// altruistic on randomized forests — the property a silently
+// suboptimal search would break.
+//
+// Build+run (tests/test_native_selectors.py drives this):
+//   g++ -O1 -std=c++17 test_selectors.cpp -o test_selectors && ./test_selectors
+
+#include "oracle.cpp"
+
+#include <cstdio>
+
+using std::vector;
+
+namespace {
+
+// a minimal Sim with per-node seen times for altruistic's sort
+Sim make_sim(int n_nodes) {
+  Sim s;
+  s.n_nodes = n_nodes;
+  s.visible.assign(n_nodes, {});
+  s.known.assign(n_nodes, {});
+  s.visible_at.assign(n_nodes, {});
+  return s;
+}
+
+int add_vote(Sim& s, int parent, int depth, int miner, double hash,
+             double t) {
+  Block v;
+  v.parents = {parent};
+  v.is_vote = true;
+  v.vote_id = 0;  // confirms the genesis summary
+  v.work = depth;
+  v.miner = miner;
+  v.pow_hash = hash;
+  s.now = t;
+  int id = s.dag.add(v);
+  for (int n = 0; n < s.n_nodes; n++) s.mark_visible(n, id);
+  return id;
+}
+
+double own_reward(const Dag& d, const vector<int>& sel, int me,
+                  bool discount, bool punish, int depth_plus,
+                  int miner_share, int k) {
+  if (sel.empty()) return -1.0;
+  vector<int> leaves = quorum_leaves(d, sel);
+  int depth_first = leaves.empty() ? 0 : d.blocks[leaves[0]].work;
+  double r = discount ? (double)(depth_first + depth_plus) / k : 1.0;
+  vector<int> paid =
+      punish && !leaves.empty() ? vote_closure(d, leaves[0]) : sel;
+  int own = miner_share;
+  for (int v : paid)
+    if (d.blocks[v].miner == me) own++;
+  return r * own;
+}
+
+int failures = 0;
+
+void expect(bool ok, const char* what) {
+  if (!ok) {
+    std::printf("FAIL: %s\n", what);
+    failures++;
+  }
+}
+
+// Crafted forest, k=3, me=0: branch A = three foreign votes (depth
+// 1-2-3), branch B = two own votes (depth 1-2), lone own vote C
+// (depth 1).  Altruistic (longest first) must take A; heuristic and
+// optimal (own-reward first) must take B+C.
+void test_crafted() {
+  Sim s = make_sim(2);
+  s.dag.add(Block{});  // genesis summary, id 0
+  int a1 = add_vote(s, 0, 1, 1, 0.10, 1.0);
+  int a2 = add_vote(s, a1, 2, 1, 0.11, 2.0);
+  int a3 = add_vote(s, a2, 3, 1, 0.12, 3.0);
+  int b1 = add_vote(s, 0, 1, 0, 0.20, 4.0);
+  int b2 = add_vote(s, b1, 2, 0, 0.21, 5.0);
+  int c1 = add_vote(s, 0, 1, 0, 0.30, 6.0);
+  vector<int> cands = {a1, a2, a3, b1, b2, c1};
+  const int q = 3, k = 3, me = 0;
+
+  vector<int> alt = altruistic_quorum(s, s.dag, cands, me, q);
+  vector<int> heu = heuristic_quorum(s.dag, cands, me, q);
+  bool fb = false;
+  vector<int> opt = optimal_quorum(s.dag, cands, me, q, false, false, 0,
+                                   0, k, &fb);
+  expect(!fb, "crafted: optimal under option cap");
+  auto has = [](const vector<int>& v, int x) {
+    return std::find(v.begin(), v.end(), x) != v.end();
+  };
+  expect(alt.size() == 3 && has(alt, a3), "altruistic takes the deepest branch");
+  expect(heu.size() == 3 && has(heu, b2) && has(heu, c1),
+         "heuristic takes the own branches");
+  expect(opt.size() == 3 && has(opt, b2) && has(opt, c1),
+         "optimal takes the own branches");
+  double ra = own_reward(s.dag, alt, me, false, false, 0, 0, k);
+  double rh = own_reward(s.dag, heu, me, false, false, 0, 0, k);
+  double ro = own_reward(s.dag, opt, me, false, false, 0, 0, k);
+  expect(ra == 0.0 && rh == 3.0 && ro == 3.0, "crafted own rewards");
+}
+
+// Discount tiebreak: optimal may prefer a DEEPER quorum with fewer own
+// votes when the discount factor pays for it; the heuristic (constant-
+// reward assumption, tailstorm.ml:329-335) cannot see that.
+void test_discount_sensitivity() {
+  Sim s = make_sim(2);
+  s.dag.add(Block{});
+  // branch A: foreign d1 -> own d2 -> own d3 (depth 3, own 2)
+  int a1 = add_vote(s, 0, 1, 1, 0.10, 1.0);
+  int a2 = add_vote(s, a1, 2, 0, 0.11, 2.0);
+  int a3 = add_vote(s, a2, 3, 0, 0.12, 3.0);
+  // three lone own votes (depth 1, own 3)
+  int b = add_vote(s, 0, 1, 0, 0.20, 4.0);
+  int c = add_vote(s, 0, 1, 0, 0.30, 5.0);
+  int e = add_vote(s, 0, 1, 0, 0.40, 6.0);
+  vector<int> cands = {a1, a2, a3, b, c, e};
+  const int q = 3, k = 3, me = 0;
+  bool fb = false;
+  // constant: lone own votes win (3 x 1 > 2 x 1)
+  vector<int> opt_c = optimal_quorum(s.dag, cands, me, q, false, false,
+                                     0, 0, k, &fb);
+  // discount: deep branch wins (3/3 * 2 = 2 > 1/3 * 3 = 1)
+  vector<int> opt_d = optimal_quorum(s.dag, cands, me, q, true, false,
+                                     0, 0, k, &fb);
+  double rc = own_reward(s.dag, opt_c, me, false, false, 0, 0, k);
+  double rd = own_reward(s.dag, opt_d, me, true, false, 0, 0, k);
+  expect(rc == 3.0, "optimal/constant picks lone own votes");
+  auto has = [](const vector<int>& v, int x) {
+    return std::find(v.begin(), v.end(), x) != v.end();
+  };
+  expect(has(opt_d, a3) && rd == 2.0,
+         "optimal/discount pays for the deep branch");
+}
+
+// Randomized forests: optimal's own reward must dominate both other
+// selectors under every scheme combination (the ordering property a
+// silently suboptimal enumeration would break), and every selector
+// must return a closed, correctly sized set.
+void test_reward_ordering() {
+  std::mt19937_64 rng(42);
+  for (int trial = 0; trial < 300; trial++) {
+    Sim s = make_sim(2);
+    s.dag.add(Block{});
+    int q = 2 + (int)(rng() % 3);  // 2..4
+    int k = q;
+    int n = q + (int)(rng() % 5);  // q .. q+4
+    vector<int> ids;
+    for (int i = 0; i < n; i++) {
+      // parent: genesis or any earlier vote (keeps depths consistent)
+      int parent = 0, depth = 1;
+      if (!ids.empty() && rng() % 2) {
+        parent = ids[rng() % ids.size()];
+        depth = s.dag.blocks[parent].work + 1;
+      }
+      int miner = (int)(rng() % 2);
+      double hash = (double)(rng() % 1000) / 1000.0;
+      ids.push_back(add_vote(s, parent, depth, miner, hash, (double)i));
+    }
+    for (int scheme = 0; scheme < 4; scheme++) {
+      bool discount = scheme == 1 || scheme == 3;
+      bool punish = scheme == 2 || scheme == 3;
+      vector<int> alt = altruistic_quorum(s, s.dag, ids, 0, q);
+      vector<int> heu = heuristic_quorum(s.dag, ids, 0, q);
+      bool fb = false;
+      vector<int> opt = optimal_quorum(s.dag, ids, 0, q, discount,
+                                       punish, 0, 0, k, &fb);
+      if (fb) continue;
+      double ro = own_reward(s.dag, opt, 0, discount, punish, 0, 0, k);
+      double rh = own_reward(s.dag, heu, 0, discount, punish, 0, 0, k);
+      double ra = own_reward(s.dag, alt, 0, discount, punish, 0, 0, k);
+      // feasibility must agree: all three find a quorum or none does
+      // (any q-subset that is closed exists independently of selector)
+      if (!opt.empty()) {
+        expect((int)opt.size() == q, "optimal size == q");
+        expect(ro + 1e-9 >= rh, "optimal >= heuristic own reward");
+        expect(ro + 1e-9 >= ra, "optimal >= altruistic own reward");
+      }
+      for (const vector<int>& sel : {alt, heu, opt}) {
+        // closure-closed: every member's vote parents are members
+        for (int v : sel)
+          for (int p : s.dag.blocks[v].parents)
+            if (s.dag.blocks[p].is_vote)
+              expect(std::find(sel.begin(), sel.end(), p) != sel.end(),
+                     "selection is closure-closed");
+      }
+    }
+  }
+}
+
+}  // namespace
+
+int main() {
+  test_crafted();
+  test_discount_sensitivity();
+  test_reward_ordering();
+  if (failures) {
+    std::printf("%d failures\n", failures);
+    return 1;
+  }
+  std::printf("selectors ok\n");
+  return 0;
+}

@@ -1,0 +1,49 @@
+"""Oracle sub-block selectors (tailstorm.ml:271-313 altruistic,
+:329-380 heuristic, :418-506 optimal).
+
+Drives the standalone C++ unit binary (native/src/test_selectors.cpp),
+which builds crafted vote forests where the three selections MUST
+differ and checks the own-reward ordering optimal >= heuristic >=
+altruistic over 300 randomized forests x 4 incentive schemes — the
+property a silently suboptimal enumeration would break.  Env-side
+twins live in tests/test_quorum_selectors.py (same ordering property
+on the env's candidate-frame machinery).
+"""
+
+import os
+import subprocess
+import sys
+
+SRC = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "cpr_tpu", "native", "src")
+
+
+def test_selector_unit_battery(tmp_path):
+    exe = tmp_path / "test_selectors"
+    subprocess.run(
+        ["g++", "-O1", "-std=c++17", "test_selectors.cpp", "-o", str(exe)],
+        cwd=SRC, check=True, capture_output=True, text=True)
+    out = subprocess.run([str(exe)], check=True, capture_output=True,
+                         text=True)
+    assert "selectors ok" in out.stdout, out.stdout
+
+
+def test_oracle_accepts_selector_suffix():
+    """The scheme string's ':selector' suffix parses and runs for both
+    protocols (API contract used by the cross-engine anchors)."""
+    from cpr_tpu import native
+
+    for proto in ("tailstorm", "stree"):
+        for sel in ("discount", "discount:altruistic", "discount:optimal"):
+            o = native.OracleSim(proto, k=3, scheme=sel,
+                                 topology="two_agents", alpha=0.3,
+                                 gamma=0.5, seed=3)
+            o.run(500)
+            r = o.rewards(2)
+            assert r[0] + r[1] > 0
+            o.close()
+
+
+if __name__ == "__main__":
+    sys.exit(subprocess.call(
+        [sys.executable, "-m", "pytest", "-x", "-q", __file__]))

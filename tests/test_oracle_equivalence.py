@@ -122,10 +122,30 @@ def test_bk_honest_cross_engine():
      dict(k=4, scheme="constant")),
     ("tailstorm", "tailstorm", "tailstorm-4-discount-heuristic",
      dict(k=4, scheme="discount")),
-    pytest.param("tailstormjune", "stree", "tailstormjune-4-discount",
-                 dict(k=4, scheme="discount"),
+    pytest.param("tailstormjune", "tailstormjune",
+                 "tailstormjune-4-discount", dict(k=4, scheme="discount"),
                  marks=pytest.mark.slow),  # heaviest compile; tailstorm
     # stays fast as the family's cross-engine representative
+    # june's own `block` scheme (the whole k to the summary miner,
+    # tailstorm_june.ml:177): an anchor at june's own key that fails if
+    # the +block reward scheme drifts on either engine
+    pytest.param("tailstormjune", "tailstormjune", "tailstormjune-4-block",
+                 dict(k=4, scheme="block"), marks=pytest.mark.slow),
+    # selector cross-engine anchors (VERDICT r4 #4): the oracle now
+    # implements altruistic/optimal sub-block selection
+    # (tailstorm.ml:271-313, :418-506); honest dynamics must agree at
+    # each selector's own registry key
+    pytest.param("stree", "stree", "stree-4-constant-optimal",
+                 dict(k=4, scheme="constant:optimal"),
+                 marks=pytest.mark.slow),
+    pytest.param("tailstorm", "tailstorm",
+                 "tailstorm-4-discount-altruistic",
+                 dict(k=4, scheme="discount:altruistic"),
+                 marks=pytest.mark.slow),
+    pytest.param("tailstorm", "tailstorm",
+                 "tailstorm-4-discount-optimal",
+                 dict(k=4, scheme="discount:optimal"),
+                 marks=pytest.mark.slow),
 ])
 def test_parallel_family_honest_cross_engine(family, oracle_proto, key,
                                              okw):
@@ -263,30 +283,50 @@ def test_bk_gym_granularity_parity():
     assert abs(o - j) < 0.015, (o, j, o - j)
 
 
-@pytest.mark.parametrize("proto,key,policy,alpha,tol,profitable", [
+@pytest.mark.parametrize("proto,key,policy,alpha,tol,profitable,okw", [
     # measured cross-engine gaps (20k-act oracle vs 128-env JAX, stable
     # from 128 to 512 steps, so NOT truncation bias): the 2-party
     # collapse treats vote races one interaction at a time, which
     # shifts withholding revenue by 0.01-0.055 depending on family —
     # same class of deviation as the documented bk get-ahead bound.
-    ("spar", "spar-4-constant", "selfish", 0.45, 0.035, True),
+    ("spar", "spar-4-constant", "selfish", 0.45, 0.035, True, None),
     pytest.param("spar", "spar-4-constant", "selfish", 0.30, 0.03, False,
+                 None,
                  marks=pytest.mark.slow),  # unprofitable region agrees too
     ("tailstorm", "tailstorm-4-constant-heuristic", "minor-delay", 0.45,
-     0.05, True),
+     0.05, True, None),
     pytest.param("stree", "stree-4-constant-heuristic", "minor-delay",
-                 0.45, 0.05, True, marks=pytest.mark.slow),
+                 0.45, 0.05, True, None, marks=pytest.mark.slow),
     pytest.param("sdag", "sdag-4-constant-altruistic", "minor-delay",
-                 0.45, 0.07, True, marks=pytest.mark.slow),
+                 0.45, 0.07, True, None, marks=pytest.mark.slow),
     pytest.param("tailstorm", "tailstorm-4-constant-heuristic",
-                 "get-ahead", 0.30, 0.07, False,
+                 "get-ahead", 0.30, 0.07, False, None,
                  marks=pytest.mark.slow),
     # avoid-loss exercises the Match release path (gamma race arming)
     pytest.param("stree", "stree-4-constant-heuristic", "avoid-loss",
-                 0.45, 0.06, True, marks=pytest.mark.slow),
+                 0.45, 0.06, True, None, marks=pytest.mark.slow),
+    # selector anchors under ATTACK (VERDICT r4 #4): both engines run
+    # the same non-default sub-block selection while withholding
+    pytest.param("tailstorm", "tailstorm-4-constant-altruistic",
+                 "minor-delay", 0.45, 0.05, True,
+                 {"scheme": "constant:altruistic"},
+                 marks=pytest.mark.slow),
+    pytest.param("tailstorm", "tailstorm-4-constant-optimal",
+                 "minor-delay", 0.45, 0.05, True,
+                 {"scheme": "constant:optimal"},
+                 marks=pytest.mark.slow),
+    pytest.param("stree", "stree-4-constant-optimal", "minor-delay",
+                 0.45, 0.05, True, {"scheme": "constant:optimal"},
+                 marks=pytest.mark.slow),
+    # june's +block scheme under attack: reward concentration on
+    # summary miners changes withholding payoffs; both engines must
+    # agree at june's own key
+    pytest.param("tailstormjune", "tailstormjune-4-block", "minor-delay",
+                 0.45, 0.06, True, {"scheme": "block"},
+                 marks=pytest.mark.slow),
 ])
 def test_parallel_family_attacker_cross_engine(proto, key, policy, alpha,
-                                               tol, profitable):
+                                               tol, profitable, okw):
     """Withholding-attack anchors for the parallel-PoW family: the
     oracle's ParAgent (generic SSZ release scan, oracle.cpp) vs the
     JAX attack envs' hard-coded policies — the reference validates
@@ -295,7 +335,7 @@ def test_parallel_family_attacker_cross_engine(proto, key, policy, alpha,
     from cpr_tpu.envs import registry
 
     o = oracle_share(proto, alpha=alpha, gamma=0.5, policy=policy,
-                     activations=30_000, k=4)
+                     activations=30_000, k=4, **(okw or {}))
     env = registry.get_sized(key, 128)
     j = jax_share(env, alpha=alpha, gamma=0.5, policy=policy,
                   n_envs=128, max_steps=128)
