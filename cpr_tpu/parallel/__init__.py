@@ -92,7 +92,8 @@ def sharded_value_iteration(tm: TensorMDP, mesh: Mesh, *, axis: str = "d",
                             max_iter: int = 0, discount: float = 1.0,
                             eps: float | None = None,
                             stop_delta: float | None = None,
-                            impl: str | None = None, chunk: int = 64):
+                            impl: str | None = None, chunk: int = 64,
+                            accel_m: int = 0):
     """Value iteration with the transition table sharded over the mesh.
 
     Each device owns a contiguous transition chunk (padded with
@@ -107,7 +108,10 @@ def sharded_value_iteration(tm: TensorMDP, mesh: Mesh, *, axis: str = "d",
     single-device option: "while" (default) or "chunked" (fixed-size
     scan chunks + host-side convergence — the axon-TPU while_loop-fault
     workaround, needed here too or the capstone's on-chip sharded solve
-    would hit the same fault); CPR_VI_IMPL sets the default.
+    would hit the same fault); CPR_VI_IMPL sets the default.  `accel_m`
+    opts the chunked impl into Anderson acceleration between chunks
+    (explicit.run_chunk_driver — ~5x fewer sweeps on the fc16 PT-MDP,
+    same fixpoint to stop_delta; the GhostDAG capstone turns it on).
     """
     stop_delta = tm.resolve_stop_delta(
         discount=discount, eps=eps, stop_delta=stop_delta, max_iter=max_iter)
@@ -165,7 +169,7 @@ def sharded_value_iteration(tm: TensorMDP, mesh: Mesh, *, axis: str = "d",
             )(*coo, value, prog)
 
         return run_chunk_driver(chunk_fn, S, tm.prob.dtype, stop_delta,
-                                max_iter_, chunk)
+                                max_iter_, chunk, accel_m=accel_m)
 
     if impl == "while":
         value, progress_v, policy, delta, it = run()

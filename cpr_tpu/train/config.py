@@ -38,6 +38,9 @@ class PPOBlock(BaseModel):
     n_layers: int = 2
     layer_size: int = 64
     anneal_lr: bool = False
+    # KL-adaptive early stop (sb3 target_kl): skip remaining minibatch
+    # updates once approx KL > 1.5 * target_kl.  None = off.
+    target_kl: float | None = None
 
 
 class EvalBlock(BaseModel):
@@ -64,6 +67,12 @@ class TrainConfig(BaseModel):
     n_envs: int = 256
     total_updates: int = 200
     seed: int = 0
+    # best-checkpoint revert-on-collapse: after an eval scoring below
+    # `revert_frac` x the best score so far, training restarts from the
+    # best checkpoint (fresh optimizer state).  Together with target_kl
+    # this keeps the FINAL policy near its peak instead of decaying into
+    # the never-release attractor (docs/TRAIN_DAG_r04.md).  None = off.
+    revert_frac: float | None = None
     ppo: PPOBlock = PPOBlock()
     eval: EvalBlock = EvalBlock()
 

@@ -94,7 +94,8 @@ def ppo_config(cfg: TrainConfig) -> PPOConfig:
         entropy_coef=p.ent_coef, vf_coef=p.vf_coef,
         update_epochs=p.update_epochs, n_minibatches=p.n_minibatches,
         hidden=tuple([p.layer_size] * p.n_layers),
-        anneal_lr=p.anneal_lr, total_updates=cfg.total_updates)
+        anneal_lr=p.anneal_lr, total_updates=cfg.total_updates,
+        target_kl=p.target_kl)
 
 
 def build_env(cfg: TrainConfig):
@@ -210,6 +211,7 @@ def train_from_config(cfg: TrainConfig, *, out_dir: str | None = None,
 
     total = n_updates if n_updates is not None else cfg.total_updates
     history, eval_rows, best = [], [], -np.inf
+    best_params = None
     metrics_log = None
     if out_dir is not None:
         os.makedirs(out_dir, exist_ok=True)
@@ -252,9 +254,27 @@ def train_from_config(cfg: TrainConfig, *, out_dir: str | None = None,
                                     carry[0].params, meta)
                     if score > best:
                         best = score
+                        best_params = carry[0].params
                         save_checkpoint(os.path.join(out_dir,
                                                      "best-model.msgpack"),
                                         carry[0].params, meta)
+                    elif (cfg.revert_frac is not None
+                          and best_params is not None
+                          and score < cfg.revert_frac * best):
+                        # collapse: restart from the best checkpoint
+                        # with fresh optimizer state, so one bad policy
+                        # step cannot drag the run into the
+                        # never-release attractor for good
+                        ts = carry[0]
+                        ts = ts.replace(
+                            params=best_params,
+                            opt_state=ts.tx.init(best_params))
+                        carry = (ts,) + tuple(carry[1:])
+                        if metrics_log is not None:
+                            metrics_log.write(json.dumps(
+                                {"revert": True, "update": i + 1,
+                                 "score": score, "best": best}) + "\n")
+                            metrics_log.flush()
     finally:
         if metrics_log is not None:
             metrics_log.close()

@@ -43,6 +43,14 @@ class PPOConfig:
     hidden: tuple[int, ...] = (64, 64)  # sb3 MlpPolicy default net_arch
     anneal_lr: bool = False
     total_updates: int = 1000  # for lr annealing
+    # KL-adaptive early stop (sb3 target_kl; reference runs relied on
+    # sb3's stability machinery, experiments/train/ppo.py:296-374):
+    # once the approximate KL to the rollout policy exceeds
+    # 1.5 * target_kl, the remaining minibatch updates of this
+    # train_step are skipped.  Guards the collapse mode where one large
+    # policy step jumps into the never-release attractor
+    # (docs/TRAIN_DAG_r04.md).  None = off.
+    target_kl: float | None = None
 
 
 class ActorCritic(nn.Module):
@@ -188,12 +196,26 @@ def make_train(env: JaxEnv, env_params: EnvParams, cfg: PPOConfig,
             (value - target) ** 2, (v_clipped - target) ** 2).mean()
         entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
         total = pg_loss + cfg.vf_coef * v_loss - cfg.entropy_coef * entropy
-        return total, dict(pg_loss=pg_loss, v_loss=v_loss, entropy=entropy)
+        # Schulman's low-variance KL estimator: E[(r - 1) - log r]
+        logratio = logp - batch.logp
+        approx_kl = ((jnp.exp(logratio) - 1.0) - logratio).mean()
+        return total, dict(pg_loss=pg_loss, v_loss=v_loss, entropy=entropy,
+                           approx_kl=approx_kl)
 
-    def update_minibatch(ts, mb):
+    def update_minibatch(ts, cont, mb):
+        """One minibatch step, gated by the KL early-stop flag: once a
+        minibatch's approximate KL crosses 1.5 * target_kl, this and
+        every later minibatch of the train_step become no-ops (the sb3
+        target_kl contract, applied at minibatch granularity)."""
         batch, adv, target = mb
         grads, metrics = jax.grad(loss_fn, has_aux=True)(ts.params, batch, adv, target)
-        return ts.apply_gradients(grads=grads), metrics
+        if cfg.target_kl is None:
+            return ts.apply_gradients(grads=grads), cont, metrics
+        cont = cont & (metrics["approx_kl"] <= 1.5 * cfg.target_kl)
+        new_ts = ts.apply_gradients(grads=grads)
+        ts = jax.tree.map(lambda a, b: jnp.where(cont, a, b), new_ts, ts)
+        metrics["kl_stop"] = (~cont).astype(jnp.float32)
+        return ts, cont, metrics
 
     def train_step(carry):
         """One PPO update: rollout cfg.n_steps x cfg.n_envs, GAE,
@@ -210,23 +232,26 @@ def make_train(env: JaxEnv, env_params: EnvParams, cfg: PPOConfig,
         targets_f = targets.reshape(-1)
 
         def epoch(carry, _):
-            ts, key = carry
+            ts, cont, key = carry
             key, k_perm = jax.random.split(key)
             mb_size = cfg.n_steps * cfg.n_envs // cfg.n_minibatches
             perm = jax.random.permutation(
                 k_perm, cfg.n_steps * cfg.n_envs
             ).reshape(cfg.n_minibatches, mb_size)
 
-            def one_mb(ts, idx):
+            def one_mb(carry, idx):
+                ts, cont = carry
                 take = lambda x: x[idx]
                 mb = (jax.tree.map(take, flat), take(advs_f), take(targets_f))
-                return update_minibatch(ts, mb)
+                ts, cont, metrics = update_minibatch(ts, cont, mb)
+                return (ts, cont), metrics
 
-            ts, metrics = jax.lax.scan(one_mb, ts, perm)
-            return (ts, key), metrics
+            (ts, cont), metrics = jax.lax.scan(one_mb, (ts, cont), perm)
+            return (ts, cont, key), metrics
 
-        (ts, key), metrics = jax.lax.scan(
-            epoch, (ts, key), None, length=cfg.update_epochs)
+        (ts, _, key), metrics = jax.lax.scan(
+            epoch, (ts, jnp.bool_(True), key), None,
+            length=cfg.update_epochs)
         metrics = jax.tree.map(lambda x: x.mean(), metrics)
         metrics["mean_step_reward"] = traj.reward.mean()
         metrics["episode_reward_attacker"] = (

@@ -67,8 +67,11 @@ def main():
     # multi-thousand-sweep solve is.  Chunk sized so a call stays far
     # inside the ceiling even at cutoff 8's 5.27M rows (~1-5 sweeps/s).
     chunk = 16 if mdp.n_transitions > 1_000_000 else 64
+    # Anderson acceleration between chunks (VERDICT r4 #7): ~5x fewer
+    # sweeps at the same fixpoint — the cutoff-8 solve was 3568 plain
+    # Jacobi sweeps / 1817 s on one v5e chip
     vi = sharded_value_iteration(tm, default_mesh(), stop_delta=1e-6,
-                                 impl="chunked", chunk=chunk)
+                                 impl="chunked", chunk=chunk, accel_m=3)
     rev = tm.start_value(vi["vi_value"]) / tm.start_value(
         vi["vi_progress"])
     print(f"sharded VI: {int(vi['vi_iter'])} sweeps in "

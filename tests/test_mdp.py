@@ -4,6 +4,8 @@ mdp/lib/models/aft20barzur_test.py), parameter remapping, and the
 env <-> MDP equivalence check (the analog of the reference's cross-engine
 validation strategy, SURVEY.md §4)."""
 
+import os
+
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -142,6 +144,52 @@ def test_vi_chunked_impl_matches_while():
     assert fixed["vi_iter"] == 7
     with pytest.raises(ValueError, match="unknown VI impl"):
         tm.value_iteration(stop_delta=1e-6, impl="nope")
+
+
+_ANDERSON_SNIPPET = """
+import jax, numpy as np, jax.numpy as jnp
+jax.config.update("jax_platforms", "cpu")
+from cpr_tpu.mdp import Compiler, ptmdp
+from cpr_tpu.mdp.models.bitcoin_sm import Fc16BitcoinSM
+from cpr_tpu.mdp.explicit import vi_chunked
+c = Compiler(Fc16BitcoinSM(alpha=0.35, gamma=0.5, maximum_fork_length=16))
+tm = ptmdp(c.mdp(), horizon=100).tensor()
+ref = tm.value_iteration(stop_delta=1e-7)
+value, prog, pol, delta, it = vi_chunked(
+    tm.src, tm.act, tm.dst, tm.prob, tm.reward, tm.progress,
+    tm.n_states, tm.n_actions, jnp.float32(1.0), jnp.float32(1e-7),
+    1 << 30, accel_m=3)
+rev_ref = tm.start_value(ref["vi_value"]) / tm.start_value(ref["vi_progress"])
+rev_acc = float(tm.start_value(np.asarray(value))
+                / tm.start_value(np.asarray(prog)))
+print("RESULT", it, ref["vi_iter"], abs(rev_acc - rev_ref))
+"""
+
+
+def test_vi_anderson_acceleration():
+    """Anderson-accelerated chunked VI (the GhostDAG-capstone solver
+    path, VERDICT r4 #7) reaches the while-loop fixpoint within the
+    stop tolerance in SUBSTANTIALLY fewer sweeps.  Runs in a
+    subprocess with PRODUCTION XLA flags: under the suite's
+    xla_backend_optimization_level=0 the f32 residuals are noisy
+    enough that the safeguard keeps falling back to plain sweeps and
+    the speedup shrinks to ~1.2x (measured), which would make the
+    assertion meaningless for the real solver config."""
+    import subprocess
+    import sys
+
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    out = subprocess.run([sys.executable, "-c", _ANDERSON_SNIPPET],
+                         capture_output=True, text=True, check=True,
+                         env=env, cwd=os.path.dirname(
+                             os.path.dirname(os.path.abspath(__file__))))
+    line = [ln for ln in out.stdout.splitlines()
+            if ln.startswith("RESULT")][0]
+    _, it, ref_it, drift = line.split()
+    # measured 576 vs 1899 sweeps, drift 1.8e-6; assert conservative
+    # bounds so numeric jitter cannot flake the test
+    assert float(drift) < 1e-5, line
+    assert int(it) < int(ref_it) / 2, line
 
 
 def test_vi_eps_guard():
