@@ -55,10 +55,16 @@ Documented deviations from the reference event-queue simulation:
   event — grafting Append granularity onto the oracle
   ("get-ahead-appendint") closes 95% of the k=1 gap
   (test_bk_gym_granularity_parity pins the matched-granularity
-  agreement at <=0.015); (d) the k=4 residual is NOT granularity
-  (the graft moves it away from zero) — it is the multi-defender
-  vote-race during release propagation, inexpressible in the 2-party
-  collapse; its anchor stays a pinned characterized gap at +-0.02.
+  agreement at <=0.015); (d) the k=4 residual is DELIVERY-BATCH
+  granularity (round-5 decomposition): the event-loop defender runs
+  its handler per delivered vertex and can propose mid-release on a
+  partial vote set, while this collapse applies a release atomically
+  and attempts one defender proposal per delivery batch — NOT a
+  multi-defender race (the single-defender oracle shows the same
+  gap).  Grafting atomic delivery onto the oracle
+  ("get-ahead-atomicrel") closes the k=4 gap to ~0.002
+  (test_bk_k4_delivery_batch_parity, pinned <= 0.015); the ungrafted
+  anchor keeps its characterized +-0.02 pin.
 """
 
 from __future__ import annotations

@@ -195,6 +195,18 @@ struct Sim {
   // bk proposal dedup (simulator.ml:138-158): key -> block id
   std::map<std::string, int> dedup;
 
+  // atomic-release graft (decomposition tooling, not reference
+  // behavior): deliver a whole release batch to a node BEFORE running
+  // its honest handler once — the JAX envs' collapse applies a release
+  // atomically and lets the defender cloud attempt ONE proposal per
+  // delivery batch, while the event loop runs the handler per item
+  // (a defender can propose mid-release on a partial vote set).
+  // Enabled by the *-atomicrel agent policies.
+  bool atomic_release = false;
+  std::vector<char> in_batch;               // block id -> current batch
+  std::vector<int> batch_pending;           // per node
+  std::vector<std::vector<int>> batch_items;  // per node, arrival order
+
   // structured causal trace (log.ml:1-26): (time, kind, node, block);
   // kinds: 0 append, 1 share, 2 receive, 3 learn.  Bounded so long runs
   // don't exhaust memory; `trace_truncated` reports the overflow.
@@ -307,6 +319,8 @@ struct Sim {
 
   // deliver b (parents-visible) to node, then its unlocked descendants
   void deliver(int node, int b);
+  void flush_batch(int node);
+  bool batch_complete(int node) const;
   void unlock_children(int node, int b);
   void handle_honest(int node, int b);
   void handle_agent(int b, bool is_pow);
@@ -1707,13 +1721,50 @@ struct ParAgent final : Agent {
 
 // -------------------------------------------------------- sim internals
 
+void Sim::flush_batch(int node) {
+  // apply the buffered preference updates in arrival order, then run
+  // the honest handler ONCE (the env collapse's
+  // one-proposal-per-delivery-batch semantics).  Items that became
+  // visible through the proposal-dedup path were handled at dedup
+  // time and are not buffered here.
+  if (node >= (int)batch_pending.size() || batch_pending[node] <= 0)
+    return;
+  batch_pending[node] = 0;
+  if (batch_items[node].empty()) return;
+  int last = batch_items[node].back();
+  for (int x : batch_items[node])
+    preferred[node] = proto->prefer(*this, node, preferred[node], x);
+  batch_items[node].clear();
+  handle_honest(node, last);
+}
+
+bool Sim::batch_complete(int node) const {
+  // completeness by VISIBILITY, not by a delivery counter: a batch
+  // block can become visible through the proposal-dedup path
+  // (unlock_children's re-derivation scenario), whose queued delivery
+  // event then early-returns without ever decrementing a counter
+  for (int y = 0; y < (int)in_batch.size(); y++)
+    if (in_batch[y] && !is_visible(node, y)) return false;
+  return true;
+}
+
 void Sim::deliver(int node, int b) {
-  if (is_visible(node, b)) return;
+  if (is_visible(node, b)) {
+    // a deduped batch item's queued delivery still advances the batch
+    if (atomic_release && node != 0 && batch_complete(node))
+      flush_batch(node);
+    return;
+  }
   mark_visible(node, b);
   record(3, node, b);
   if (flooding && dag.blocks[b].miner != node) send(node, b);
   if (node == 0 && agent) {
     handle_agent(b, false);
+  } else if (atomic_release && node < (int)batch_pending.size()
+             && batch_pending[node] > 0 && b < (int)in_batch.size()
+             && in_batch[b]) {
+    batch_items[node].push_back(b);
+    if (batch_complete(node)) flush_batch(node);
   } else {
     handle_honest(node, b);
   }
@@ -1770,6 +1821,21 @@ void Sim::handle_agent(int b, bool is_pow) {
       for (int p : dag.blocks[y].parents) stack.push_back(p);
     }
     std::sort(rel.begin(), rel.end());  // ids are topological
+    if (atomic_release && !rel.empty()) {
+      // a new release while a previous batch is still in flight
+      // (delayed topologies) must not drop buffered handling — flush
+      // each node's old batch first
+      for (int n = 1; n < n_nodes; n++) flush_batch(n);
+      // register the batch before the sends: per node, the honest
+      // handler waits until every batch item is visible
+      in_batch.assign(dag.blocks.size(), 0);
+      for (int y : rel) in_batch[y] = 1;
+      batch_pending.assign(n_nodes, 0);
+      batch_items.assign(n_nodes, {});
+      for (int n = 1; n < n_nodes; n++)
+        for (int y : rel)
+          if (!is_visible(n, y)) batch_pending[n]++;
+    }
     for (int y : rel) {
       agent->note_sent(*this, y);
       send(0, y);
@@ -1947,7 +2013,11 @@ void* cpr_oracle_create(const char* protocol, int k, const char* scheme,
       s.agent->policy = pol == "honest"              ? 0
                         : pol == "get-ahead"         ? 1
                         : pol == "get-ahead-appendint" ? 2
+                        : pol == "get-ahead-atomicrel" ? 3
                                                      : -1;
+      // the atomic-release graft (see Sim::atomic_release): policy 3
+      // is get-ahead with env-collapse delivery-batch semantics
+      if (s.agent->policy == 3) s.atomic_release = true;
     } else if (proto == "spar" || proto == "stree" ||
                proto == "tailstorm" || proto == "sdag" ||
                proto == "tailstormjune") {

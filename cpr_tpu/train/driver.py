@@ -244,37 +244,41 @@ def train_from_config(cfg: TrainConfig, *, out_dir: str | None = None,
                         metrics_log.write(
                             json.dumps({"eval": True, **r}) + "\n")
                     metrics_log.flush()
+                # best/revert tracking is independent of checkpointing
+                # (revert_frac must protect out_dir-less programmatic
+                # runs too); only the file writes need out_dir
+                score = float(np.mean(
+                    [r["relative_reward"] for r in rows]))
+                meta = dict(update=i + 1, score=score,
+                            protocol=cfg.protocol)
                 if out_dir is not None:
-                    score = float(np.mean(
-                        [r["relative_reward"] for r in rows]))
-                    meta = dict(update=i + 1, score=score,
-                                protocol=cfg.protocol)
                     save_checkpoint(os.path.join(out_dir,
                                                  "last-model.msgpack"),
                                     carry[0].params, meta)
-                    if score > best:
-                        best = score
-                        best_params = carry[0].params
+                if score > best:
+                    best = score
+                    best_params = carry[0].params
+                    if out_dir is not None:
                         save_checkpoint(os.path.join(out_dir,
                                                      "best-model.msgpack"),
                                         carry[0].params, meta)
-                    elif (cfg.revert_frac is not None
-                          and best_params is not None
-                          and score < cfg.revert_frac * best):
-                        # collapse: restart from the best checkpoint
-                        # with fresh optimizer state, so one bad policy
-                        # step cannot drag the run into the
-                        # never-release attractor for good
-                        ts = carry[0]
-                        ts = ts.replace(
-                            params=best_params,
-                            opt_state=ts.tx.init(best_params))
-                        carry = (ts,) + tuple(carry[1:])
-                        if metrics_log is not None:
-                            metrics_log.write(json.dumps(
-                                {"revert": True, "update": i + 1,
-                                 "score": score, "best": best}) + "\n")
-                            metrics_log.flush()
+                elif (cfg.revert_frac is not None
+                      and best_params is not None
+                      and score < cfg.revert_frac * best):
+                    # collapse: restart from the best checkpoint with
+                    # fresh optimizer state, so one bad policy step
+                    # cannot drag the run into the never-release
+                    # attractor for good
+                    ts = carry[0]
+                    ts = ts.replace(
+                        params=best_params,
+                        opt_state=ts.tx.init(best_params))
+                    carry = (ts,) + tuple(carry[1:])
+                    if metrics_log is not None:
+                        metrics_log.write(json.dumps(
+                            {"revert": True, "update": i + 1,
+                             "score": score, "best": best}) + "\n")
+                        metrics_log.flush()
     finally:
         if metrics_log is not None:
             metrics_log.close()

@@ -19,7 +19,7 @@ import bench  # noqa: E402
 
 
 def test_measure_nakamoto_guard():
-    rate, rel = bench.measure_nakamoto(64, n_steps=2200, reps=1)
+    rate, rel, _ = bench.measure_nakamoto(64, n_steps=2200, reps=1)
     assert rate > 0
     assert bench.SM1_GUARD[0] < rel < bench.SM1_GUARD[1], rel
 
@@ -29,7 +29,7 @@ def test_measure_config_guards():
     for name, spec in bench.CONFIGS.items():
         kw = dict(spec["cpu"])
         kw["n_envs"] = min(kw["n_envs"], 32)
-        rate, check = getattr(bench, spec["fn"])(**kw, reps=1)
+        rate, check, _extras = getattr(bench, spec["fn"])(**kw, reps=1)
         lo, hi = spec["guard"]
         assert rate > 0, name
         assert lo < check < hi, (name, check)

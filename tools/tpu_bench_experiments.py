@@ -31,7 +31,7 @@ def main():
                        ("rbg", False)):
         jax.config.update("jax_default_prng_impl", prng)
         jax.config.update("jax_threefry_partitionable", part)
-        steps_per_sec, rel = measure_nakamoto(n_envs)
+        steps_per_sec, rel, _ = measure_nakamoto(n_envs)
         ok = SM1_GUARD[0] < rel < SM1_GUARD[1]
         print(f"prng={prng} partitionable={part} n_envs={n_envs}: "
               f"{steps_per_sec / 1e6:.0f}M steps/s (SM1 rel {rel:.4f} "

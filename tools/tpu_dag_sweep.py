@@ -67,11 +67,14 @@ def main():
     jax.config.update("jax_threefry_partitionable", True)
     log(f"backend={jax.devices()[0].platform}")
 
+    # opt-in ring window for the active-set shapes (bench.py decides
+    # the production value; the sweep honors the same knob)
+    window = int(os.environ.get("CPR_WINDOW", "0")) or None
     if config == "bk":
         from cpr_tpu.envs.bk import BkSSZ
         n_steps = n_steps or 256
         env = BkSSZ(k=8, incentive_scheme="constant",
-                    max_steps_hint=n_steps)
+                    max_steps_hint=n_steps, window=window)
         rate, check, compile_s, rep_s = measure_env(
             env, "get-ahead", n_envs, n_steps, n_steps - 8, chunk or None)
     elif config == "ethereum":
@@ -82,13 +85,14 @@ def main():
             env, "fn19", n_envs, n_steps, n_steps - 8, chunk or None)
     elif config == "tailstorm":
         import numpy as np
-        from cpr_tpu.envs.registry import get_sized
+        from cpr_tpu.envs.tailstorm import TailstormSSZ
         from cpr_tpu.params import make_params
         from cpr_tpu.train.ppo import PPOConfig, make_train
 
         rollout = n_steps or 128
-        env = get_sized("tailstorm-8-discount-heuristic", 256)
-        params = make_params(alpha=0.35, gamma=0.5, max_steps=248)
+        env = TailstormSSZ(k=8, incentive_scheme="discount",
+                           max_steps_hint=128, window=window)
+        params = make_params(alpha=0.35, gamma=0.5, max_steps=120)
         cfg = PPOConfig(n_envs=n_envs, n_steps=rollout)
         init_fn, train_step = make_train(env, params, cfg)
         t0 = time.time()

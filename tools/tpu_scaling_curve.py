@@ -1,0 +1,90 @@
+"""Batch-scaling curve for the DAG-family bench configs (VERDICT r4 #2).
+
+Measures aggregate env-steps/s at a ladder of batch sizes per config
+(one watchdogged subprocess per point, the bisect_common pattern — a
+crashed worker must not take the whole curve down) and writes
+BENCH_SCALING_<round>.json.  Round-4 context: the aggregate rate PEAKED
+at 4-8k envs and DECLINED beyond — upside-down for a throughput device;
+the active-set redesign shrinks per-step bytes so the curve should now
+be monotone to >=32k envs (the verdict's done-criterion) or the point
+of genuine HBM saturation.
+
+Usage: python tools/tpu_scaling_curve.py [bk|ethereum|tailstorm ...]
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+LADDER = (1024, 4096, 8192, 16384, 32768, 65536)
+
+# per-config: (n_steps, chunk) at bench shapes (bench.py CONFIGS)
+SHAPES = {
+    "bk": (128, 128),
+    "ethereum": (128, 128),
+    "tailstorm": (128, None),  # PPO train step manages its own scan
+}
+
+
+def measure_point(config, n_envs, timeout=600.0):
+    """One subprocess measurement via tools/tpu_dag_sweep.py."""
+    n_steps, chunk = SHAPES[config]
+    cmd = [sys.executable, os.path.join("tools", "tpu_dag_sweep.py"),
+           config, str(n_envs), str(n_steps)]
+    if chunk:
+        cmd.append(str(chunk))
+    proc = subprocess.Popen(cmd, cwd=REPO, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True)
+    try:
+        out, err = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        try:
+            proc.communicate(timeout=20)
+        except subprocess.TimeoutExpired:
+            pass
+        return {"n_envs": n_envs, "error": "hung"}
+    sys.stderr.write(err or "")
+    lines = [ln for ln in (out or "").splitlines() if ln.startswith("{")]
+    if proc.returncode != 0 or not lines:
+        return {"n_envs": n_envs, "error": f"rc={proc.returncode}"}
+    row = json.loads(lines[-1])
+    row["n_envs"] = n_envs
+    return row
+
+
+def main():
+    configs = sys.argv[1:] or list(SHAPES)
+    rnd = os.environ.get("CPR_ROUND", "r05")
+    path = os.path.join(REPO, f"BENCH_SCALING_{rnd}.json")
+    curves = {}
+    if os.path.exists(path):
+        with open(path) as f:
+            curves = json.load(f)
+    for config in configs:
+        rows = curves.setdefault(config, [])
+        done = {r.get("n_envs") for r in rows if not r.get("error")}
+        for n_envs in LADDER:
+            if n_envs in done:
+                continue
+            t0 = time.time()
+            row = measure_point(config, n_envs)
+            print(f"{config} @ {n_envs}: "
+                  f"{row.get('steps_per_sec', row.get('error'))} "
+                  f"({time.time() - t0:.0f}s)", flush=True)
+            rows[:] = [r for r in rows if r.get("n_envs") != n_envs]
+            rows.append(row)
+            with open(path, "w") as f:
+                json.dump(curves, f, indent=2)
+            if row.get("error") == "hung":
+                print("wedged device? stopping this config", flush=True)
+                break
+    print(f"wrote {path}")
+
+
+if __name__ == "__main__":
+    main()
