@@ -150,7 +150,14 @@ def measure_bk(n_envs: int, n_steps: int = 128, reps: int = 3):
     measure 550k/552k/497k/496k."""
     from cpr_tpu.envs.bk import BkSSZ
 
-    env = BkSSZ(k=8, incentive_scheme="constant", max_steps_hint=n_steps)
+    # active-set ring window (round-5 redesign): per-step cost is
+    # O(window), not O(2 x episode_len); 128 slots cover a ~14-deep
+    # fork with k=8 votes (bit-for-bit episode parity vs full capacity
+    # on CPU, tests/test_dag_ring.py; the revenue guard re-checks on
+    # chip).  CPR_BK_WINDOW=0 falls back to full capacity.
+    window = int(os.environ.get("CPR_BK_WINDOW", "128")) or None
+    env = BkSSZ(k=8, incentive_scheme="constant", max_steps_hint=n_steps,
+                window=window)
     chunk = None if n_envs <= 8192 else _chunk_scaled(n_envs, 128, 8192)
     return _measure_episodes(env, "get-ahead", n_envs, n_steps, reps,
                              max_steps=n_steps - 8, chunk=chunk)
@@ -189,7 +196,11 @@ def measure_tailstorm_ppo(n_envs: int, rollout_len: int = 128,
     from cpr_tpu.params import make_params
     from cpr_tpu.train.ppo import PPOConfig, make_train
 
-    env = get_sized("tailstorm-8-discount-heuristic", 128)
+    # active-set ring window (see measure_bk); CPR_TS_WINDOW=0 -> full.
+    # get_sized forwards kwargs, so the bench measures exactly the
+    # registered key's config (memo key includes the kwargs)
+    window = int(os.environ.get("CPR_TS_WINDOW", "128")) or None
+    env = get_sized("tailstorm-8-discount-heuristic", 128, window=window)
     params = make_params(alpha=0.35, gamma=0.5, max_steps=120)
     cfg = PPOConfig(n_envs=n_envs, n_steps=rollout_len)
     init_fn, train_step = make_train(env, params, cfg)

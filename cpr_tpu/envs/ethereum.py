@@ -105,13 +105,19 @@ class EthereumSSZ(JaxEnv):
     n_actions = 6 * N_UNCLE_RULES
     obs_fields = OBS_FIELDS
     observation_length = len(OBS_FIELDS)
+    # a fresh reset populates genesis + one _mine block; the logical
+    # reset (JaxEnv.reset_dag_rows contract) matters doubly here since
+    # the ancestry planes are (B, B) — a full-tree select per auto-reset
+    # step would copy them wholesale
+    reset_dag_rows = 2
 
     def __init__(self, preset: str = "byzantium", *,
                  preference: str | None = None, progress: str | None = None,
                  max_uncles: int | None = None,
                  incentive_scheme: str | None = None,
                  uncle_cap: int = 6, unit_observation: bool = True,
-                 strict_match: bool = True, max_steps_hint: int = 256):
+                 strict_match: bool = True, max_steps_hint: int = 256,
+                 window: int | None = None):
         # presets (ethereum.ml:12-24; behavioral mapping, see module doc)
         if preset == "whitepaper":
             defaults = dict(preference="work", progress="height",
@@ -136,6 +142,15 @@ class EthereumSSZ(JaxEnv):
         self.strict_match = strict_match
         # one block append per step + the reset draw
         self.capacity = max_steps_hint + 8
+        # O(active-set) ring: per-step cost becomes O(window); the
+        # window must cover the fork PLUS the 6-generation uncle
+        # lookback below its common ancestor (the step retires below
+        # height ca-7).  One block per step, so ~window steps of fork
+        # + lookback fit; deeper forks overflow like capacity
+        # exhaustion in full mode.
+        if window is not None:
+            self.capacity = max(window, UNCLE_WINDOW + 10)
+        self.ring = window is not None
         self.max_parents = 1 + self.max_uncles
         self.low, self.high = obslib.low_high(OBS_FIELDS, unit_observation)
         self.policies = self._make_policies()
@@ -178,7 +193,15 @@ class EthereumSSZ(JaxEnv):
             ancestors.append(jnp.where(has, p0, jnp.int32(-1)))
             for plane in dag.parents:
                 v = plane[bi]
-                in_chain = in_chain | ((slots == v) & (v >= 0) & has)
+                ok = (slots == v) & (v >= 0) & has
+                if dag.is_ring:
+                    # a stored uncle pointer may reach below the
+                    # retirement floor; once that slot is reclaimed the
+                    # new occupant (younger than bi) must not be marked
+                    # in-chain
+                    ok = ok & (dag.gid[jnp.maximum(v, 0)]
+                               <= dag.gid[bi])
+                in_chain = in_chain | ok
             b = ancestors[-1]
         return ancestors, in_chain
 
@@ -194,9 +217,14 @@ class EthereumSSZ(JaxEnv):
         6-level walk once."""
         ancestors, in_chain = window or self.chain_window(dag, head)
         p0 = dag.parent0
-        on_anc = (p0 == ancestors[0]) & (ancestors[0] >= 0)
+        # newer_than: a stale row's p0 aliasing a reclaimed ancestor
+        # slot must not read as an uncle candidate (ring wrap; all-true
+        # in full mode)
+        on_anc = ((p0 == ancestors[0]) & (ancestors[0] >= 0)
+                  & D.newer_than(dag, ancestors[0]))
         for a in ancestors[1:]:
-            on_anc = on_anc | ((p0 == a) & (a >= 0))
+            on_anc = on_anc | ((p0 == a) & (a >= 0)
+                               & D.newer_than(dag, a))
         return (dag.exists() & view_mask & filter_mask
                 & (p0 >= 0) & on_anc & ~in_chain)
 
@@ -260,7 +288,8 @@ class EthereumSSZ(JaxEnv):
         # walk, the release chain+closure fixpoint — 68% of the step in
         # the round-5 device profile) into one masked reduction; the
         # binary-lifting jump walk they replace is dead weight here
-        dag = D.empty(self.capacity, self.max_parents, anc_masks=True)
+        dag = D.empty(self.capacity, self.max_parents, anc_masks=True,
+                      ring=self.ring)
         dag, root = D.append(
             dag, jnp.full((self.max_parents,), D.NONE, jnp.int32),
             kind=0, height=0, aux=0, miner=D.NONE, vis_a=True, vis_d=True,
@@ -433,6 +462,26 @@ class EthereumSSZ(JaxEnv):
         state = self._mine(state, params)
         state = state.replace(steps=state.steps + 1)
         dag = state.dag
+
+        if self.ring:
+            # retire below the uncle window's floor: candidates may sit
+            # up to UNCLE_WINDOW generations below the head, so keep
+            # one extra height of slack under the fork's common
+            # ancestor; a race tip whose block retires ends the race
+            ca = jnp.maximum(
+                D.common_ancestor_masked(dag, state.public,
+                                         state.private), 0)
+            anchor = D.chain_first_at_most(
+                dag, ca, dag.height, dag.height[ca] - UNCLE_WINDOW - 1)
+            dag = D.retire_below(
+                dag, jnp.where(anchor >= 0,
+                               dag.gid[jnp.maximum(anchor, 0)], 0))
+            race_tip = jnp.where(
+                (state.race_tip >= 0)
+                & (dag.gid[jnp.maximum(state.race_tip, 0)]
+                   < dag.live_floor),
+                jnp.int32(-1), state.race_tip)
+            state = state.replace(dag=dag, race_tip=race_tip)
 
         # winner over [attacker pref, defender pref], ties to the attacker
         # (ethereum.ml:159-162; node 0 first, engine.ml:196-206)

@@ -121,6 +121,10 @@ class State:
 
 class TailstormSSZ(JaxEnv):
     n_actions = 8
+    # fresh reset = genesis + one _advance append (a vote: def_dirty
+    # starts False); the logical reset avoids full-tree selects of the
+    # (B, B) ancestry planes per auto-reset step (JaxEnv.reset_dag_rows)
+    reset_dag_rows = 2
 
     def __init__(self, k: int = 8, incentive_scheme: str = "discount",
                  subblock_selection: str = "heuristic",
@@ -619,7 +623,14 @@ class TailstormSSZ(JaxEnv):
             prev = self.prev_summary(dag, lca)
             anchor = jnp.where(prev >= 0, jnp.maximum(prev, 0), lca)
             dag = D.retire_below(dag, dag.gid[anchor])
-            state = state.replace(dag=dag)
+            # a match race whose target summary retires is dead — the
+            # slot may be reclaimed and must never be compared again
+            match_tgt = jnp.where(
+                (state.match_tgt >= 0)
+                & (dag.gid[jnp.maximum(state.match_tgt, 0)]
+                   < dag.live_floor),
+                D.NONE, state.match_tgt)
+            state = state.replace(dag=dag, match_tgt=match_tgt)
 
         # winner: compare_summaries = (height, confirming votes), ties to
         # the attacker (engine.ml:196-206; tailstorm.ml:183-194)

@@ -256,6 +256,28 @@ def test_tailstorm_ring_episode_matches_full():
             np.asarray(full[key]), np.asarray(ring[key]), err_msg=key)
 
 
+def test_ethereum_ring_episode_matches_full():
+    """Windowed ethereum replays full-capacity episodes bit-for-bit;
+    window 64 < ~1 append/step x 120 steps, so episodes wrap the ring
+    (uncle candidates + race tips under the newer_than guards and the
+    uncle-window retirement floor)."""
+    from cpr_tpu.envs.ethereum import EthereumSSZ
+    from cpr_tpu.params import make_params
+
+    params = make_params(alpha=0.35, gamma=0.5, max_steps=120)
+    keys = jax.random.split(jax.random.PRNGKey(3), 16)
+    outs = []
+    for env in (EthereumSSZ("byzantium", max_steps_hint=128),
+                EthereumSSZ("byzantium", max_steps_hint=128, window=64)):
+        fn = jax.jit(jax.vmap(lambda k: env.episode_stats(
+            k, params, env.policies["fn19"], 128)))
+        outs.append(jax.block_until_ready(fn(keys)))
+    full, ring = outs
+    for key in sorted(full):
+        np.testing.assert_array_equal(
+            np.asarray(full[key]), np.asarray(ring[key]), err_msg=key)
+
+
 def test_ring_first_by_age_wraps():
     dag = D.empty(4, 1, ring=True)
     dag, a = D.append(dag, jnp.array([-1], jnp.int32), height=0)

@@ -2,16 +2,17 @@
 # One healthy-chip window, spent in priority order (round-2 lesson:
 # bank the bench BEFORE anything that can wedge the backend).
 #   1. headline bench  -> BENCH_self_${ROUND}.json   (the evidence artifact)
-#   2. configs 2-4     -> BENCH_CONFIGS_tpu_${ROUND}.json
-#   3. PRNG sweep      -> stdout tee            (read-only perf data)
-#   4. VI bisect       -> LAST: its candidates have crashed the worker
+#   2. configs 2-4     -> BENCH_CONFIGS_tpu_${ROUND}.json  (active-set rows)
+#   3. scaling curve   -> BENCH_SCALING_${ROUND}.json (VERDICT r4 #2)
+#   4. PPO training    -> runs/${ROUND}-tailstorm-a45/  (VERDICT r4 #3)
+#   5. capstone VI     -> docs/CAPSTONE timing with Anderson (VERDICT r4 #7)
 # Each step is already watchdogged internally (bench.py subprocess
-# pattern / bisect per-candidate children).  Artifacts are written via
-# temp files and only promoted on success with a tpu backend tag, so a
-# failed or CPU-fallback run never clobbers banked evidence.
+# pattern / per-point children).  Artifacts are written via temp files
+# and only promoted on success with a tpu backend tag, so a failed or
+# CPU-fallback run never clobbers banked evidence.
 set -u -o pipefail
 cd "$(dirname "$0")/.."
-ROUND=${CPR_ROUND:-r04}
+ROUND=${CPR_ROUND:-r05}
 log=tools/tpu_session.log
 echo "=== tpu session $(date +%F_%T) ===" | tee -a "$log"
 
@@ -41,10 +42,17 @@ else
   echo "configs NOT banked (failed or cpu fallback)" | tee -a "$log"
 fi
 
-echo "--- 3. PRNG sweep" | tee -a "$log"
-timeout 900 python tools/tpu_bench_experiments.py 2>>"$log" | tee -a "$log"
+echo "--- 3. batch-scaling curve" | tee -a "$log"
+timeout 3600 python tools/tpu_scaling_curve.py 2>>"$log" | tee -a "$log"
 
-echo "--- 4. VI bisect (may wedge the chip; runs last)" | tee -a "$log"
-python tools/tpu_vi_bisect.py 2>>"$log" | tee -a "$log"
+echo "--- 4. PPO training (collapse-protected, VERDICT r4 #3)" | tee -a "$log"
+timeout 5400 python examples/train_ppo.py \
+  cpr_tpu/train/configs/tailstorm-8-discount-a45-r5.yaml \
+  runs/${ROUND}-tailstorm-a45 800 2>>"$log" | tee -a "$log" \
+  || echo "training step failed/timeout" | tee -a "$log"
+
+echo "--- 5. GhostDAG capstone (Anderson-accelerated)" | tee -a "$log"
+timeout 2400 python examples/solve_ghostdag_mdp.py 8 2>>"$log" | tee -a "$log" \
+  || echo "capstone failed/timeout" | tee -a "$log"
 
 echo "=== done $(date +%F_%T) ===" | tee -a "$log"
