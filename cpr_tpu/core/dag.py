@@ -425,6 +425,19 @@ def chain_first_at_most(dag: Dag, tip, values, target, extra_mask=None):
     return jnp.where(m.any(), best, NONE)
 
 
+def drop_if_retired(dag: Dag, idx):
+    """NONE if the block at slot `idx` has retired below the ring
+    floor, else `idx` unchanged.  For env-state slot pointers (race
+    tips, match targets) that may outlive the fork: call immediately
+    after retire_below, while the occupant is still the original block
+    — after a reclaim the gid compare would read the NEW occupant.
+    No-op in full mode."""
+    if not dag.is_ring:
+        return idx
+    retired = (idx >= 0) & (dag.gid[jnp.maximum(idx, 0)] < dag.live_floor)
+    return jnp.where(retired, NONE, idx)
+
+
 def first_by_age(dag: Dag, mask):
     """Index of the earliest-appended block in `mask` (insertion order;
     NONE if empty).  Replaces lowest-slot argmax where 'first' must

@@ -476,12 +476,8 @@ class EthereumSSZ(JaxEnv):
             dag = D.retire_below(
                 dag, jnp.where(anchor >= 0,
                                dag.gid[jnp.maximum(anchor, 0)], 0))
-            race_tip = jnp.where(
-                (state.race_tip >= 0)
-                & (dag.gid[jnp.maximum(state.race_tip, 0)]
-                   < dag.live_floor),
-                jnp.int32(-1), state.race_tip)
-            state = state.replace(dag=dag, race_tip=race_tip)
+            state = state.replace(
+                dag=dag, race_tip=D.drop_if_retired(dag, state.race_tip))
 
         # winner over [attacker pref, defender pref], ties to the attacker
         # (ethereum.ml:159-162; node 0 first, engine.ml:196-206)

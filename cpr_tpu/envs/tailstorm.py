@@ -625,12 +625,9 @@ class TailstormSSZ(JaxEnv):
             dag = D.retire_below(dag, dag.gid[anchor])
             # a match race whose target summary retires is dead — the
             # slot may be reclaimed and must never be compared again
-            match_tgt = jnp.where(
-                (state.match_tgt >= 0)
-                & (dag.gid[jnp.maximum(state.match_tgt, 0)]
-                   < dag.live_floor),
-                D.NONE, state.match_tgt)
-            state = state.replace(dag=dag, match_tgt=match_tgt)
+            state = state.replace(
+                dag=dag,
+                match_tgt=D.drop_if_retired(dag, state.match_tgt))
 
         # winner: compare_summaries = (height, confirming votes), ties to
         # the attacker (engine.ml:196-206; tailstorm.ml:183-194)
