@@ -50,6 +50,16 @@ timeout 5400 python examples/train_ppo.py \
   cpr_tpu/train/configs/tailstorm-8-discount-a45-r5.yaml \
   runs/${ROUND}-tailstorm-a45 800 2>>"$log" | tee -a "$log" \
   || echo "training step failed/timeout" | tee -a "$log"
+# trained-policy per-alpha model table from the FINAL policy (the
+# verdict's done-criterion is the LAST checkpoint, not a rescued peak)
+if [ -f runs/${ROUND}-tailstorm-a45/last-model.msgpack ]; then
+  timeout 1800 python examples/rl_eval_study.py \
+    tailstorm-8-discount-heuristic \
+    runs/${ROUND}-tailstorm-a45/last-model.msgpack \
+    cpr_tpu/train/configs/tailstorm-8-discount-a45-r5.yaml \
+    > runs/${ROUND}-tailstorm-a45/rl_eval_model_table.tsv \
+    2>>"$log" && echo "banked rl_eval_model_table.tsv" | tee -a "$log"
+fi
 
 echo "--- 5. GhostDAG capstone (Anderson-accelerated)" | tee -a "$log"
 timeout 2400 python examples/solve_ghostdag_mdp.py 8 2>>"$log" | tee -a "$log" \
