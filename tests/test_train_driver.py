@@ -1,0 +1,57 @@
+"""Training-driver control logic (fast tier, deterministic).
+
+The collapse protections (VERDICT r4 #3) must be testable without a
+real collapse — the nakamoto CPU demo stayed stable even at 20x
+learning rate — so the driver's revert path is driven with scripted
+eval scores and verified down to the restored parameters.
+"""
+
+import json
+
+import jax
+import numpy as np
+
+
+def test_driver_revert_restores_best_params(monkeypatch, tmp_path):
+    """Best-checkpoint revert-on-collapse fires on a scripted collapse
+    and RESTORES the best parameters: with scores [0.5, 0.1] over two
+    updates, the final revert happens right before the loop ends, so
+    train_from_config must return the exact parameters the best
+    (first) eval saw — not the drifted collapsed ones."""
+    from cpr_tpu.train import driver as drv
+    from cpr_tpu.train.config import TrainConfig
+
+    scores = iter([0.5, 0.1])  # update 1 is best; update 2 collapses
+    calls = []
+
+    def fake_eval(env, cfg, net_params, **kw):
+        s = next(scores)
+        calls.append((s, net_params))
+        return [dict(alpha=0.4, gamma=0.5, relative_reward=s,
+                     reward_per_progress=s, episode_progress=1.0)]
+
+    monkeypatch.setattr(drv, "evaluate_per_alpha", fake_eval)
+    cfg = TrainConfig(
+        protocol="nakamoto", alpha=0.4, episode_len=16, n_envs=8,
+        total_updates=2, revert_frac=0.8,
+        ppo=dict(n_steps=8, n_minibatches=2, update_epochs=1, lr=1e-3),
+        eval=dict(freq=1, start_at_iteration=0))
+    params, hist, rows = drv.train_from_config(
+        cfg, out_dir=str(tmp_path), n_updates=2)
+
+    reverts = [json.loads(ln) for ln in
+               open(tmp_path / "metrics.jsonl") if '"revert"' in ln]
+    assert len(reverts) == 1 and reverts[0]["best"] == 0.5, reverts
+
+    best_seen = calls[0][1]
+    collapsed_seen = calls[1][1]
+    # training genuinely drifted between evals...
+    drifted = any(
+        not np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(jax.tree_util.tree_leaves(best_seen),
+                        jax.tree_util.tree_leaves(collapsed_seen)))
+    assert drifted
+    # ...and the revert restored the best checkpoint bit-for-bit
+    for a, b in zip(jax.tree_util.tree_leaves(best_seen),
+                    jax.tree_util.tree_leaves(params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
