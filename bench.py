@@ -159,8 +159,10 @@ def measure_bk(n_envs: int, n_steps: int = 128, reps: int = 3):
     env = BkSSZ(k=8, incentive_scheme="constant", max_steps_hint=n_steps,
                 window=window)
     chunk = None if n_envs <= 8192 else _chunk_scaled(n_envs, 128, 8192)
-    return _measure_episodes(env, "get-ahead", n_envs, n_steps, reps,
-                             max_steps=n_steps - 8, chunk=chunk)
+    rate, rel, extras = _measure_episodes(
+        env, "get-ahead", n_envs, n_steps, reps,
+        max_steps=n_steps - 8, chunk=chunk)
+    return rate, rel, dict(extras, window=window or 0)
 
 
 def measure_ethereum(n_envs: int, n_steps: int = 4096, reps: int = 2):
@@ -175,9 +177,14 @@ def measure_ethereum(n_envs: int, n_steps: int = 4096, reps: int = 2):
     — 4096 * 4096 / 120 ~ 140k completed episodes per rep."""
     from cpr_tpu.envs.ethereum import EthereumSSZ
 
-    env = EthereumSSZ("byzantium", max_steps_hint=128)
-    return _measure_episodes(env, "fn19", n_envs, n_steps, reps,
-                             max_steps=120, chunk=128)
+    # active-set ring window (see measure_bk): per-step cost is
+    # O(window); 128 slots cover the fn19 fork plus the 6-generation
+    # uncle lookback.  CPR_ETH_WINDOW=0 falls back to full capacity.
+    window = int(os.environ.get("CPR_ETH_WINDOW", "128")) or None
+    env = EthereumSSZ("byzantium", max_steps_hint=128, window=window)
+    rate, rel, extras = _measure_episodes(
+        env, "fn19", n_envs, n_steps, reps, max_steps=120, chunk=128)
+    return rate, rel, dict(extras, window=window or 0)
 
 
 def measure_tailstorm_ppo(n_envs: int, rollout_len: int = 128,
@@ -215,7 +222,7 @@ def measure_tailstorm_ppo(n_envs: int, rollout_len: int = 128,
     dt = (time.time() - t0) / reps
     ent = float(np.asarray(metrics["entropy"]))
     extras = _roofline(train_step, (carry,), n_envs * rollout_len)
-    return n_envs * rollout_len / dt, ent, extras
+    return n_envs * rollout_len / dt, ent, dict(extras, window=window or 0)
 
 
 # correctness guard bounds: SM1 revenue near the ES'14 closed form

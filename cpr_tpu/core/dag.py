@@ -28,6 +28,8 @@ recursive share of withheld ancestors, simulator.ml:401-419, is
 
 from __future__ import annotations
 
+import warnings
+
 import jax
 import jax.numpy as jnp
 from flax import struct
@@ -182,9 +184,20 @@ def empty(capacity: int, max_parents: int, lift: bool = False,
     a reused slot's new occupant.
 
     `anc_masks=True` materializes the incremental chain/closure
-    ancestry planes (see Dag.chain/closure and the *_mask queries)."""
+    ancestry planes (see Dag.chain/closure and the *_mask queries).
+    The planes are O(B^2) per env — 2*B^2 bytes that vmap multiplies by
+    the batch size (at B=2048 that is 8 MiB/env, 8 GiB at 1k envs) —
+    so they are meant for ring windows, where B is the small active-set
+    window, not the episode length."""
     B, P = capacity, max_parents
     assert not (ring and lift), "ring + lift: jumps could land on reused slots"
+    if anc_masks and not ring and B > 2048:
+        warnings.warn(
+            f"anc_masks=True at capacity {B} materializes two ({B}, {B}) "
+            f"planes ({2 * B * B / 2**20:.0f} MiB per env, scaled by the "
+            "vmap batch). Use a ring window (which bounds the planes to "
+            "the active set) or anc_masks=False with the walk-based "
+            "queries.", stacklevel=2)
     LB = B if lift else 0
     RB = B if ring else 0
     MB = B if anc_masks else 0

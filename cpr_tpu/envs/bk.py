@@ -130,7 +130,8 @@ class BkSSZ(JaxEnv):
 
     def __init__(self, k: int = 8, incentive_scheme: str = "constant",
                  unit_observation: bool = True, max_steps_hint: int = 256,
-                 window: int | None = None):
+                 window: int | None = None,
+                 anc_masks: bool | None = None):
         assert incentive_scheme in ("constant", "block")
         self.k = k
         self.incentive_scheme = incentive_scheme
@@ -148,6 +149,16 @@ class BkSSZ(JaxEnv):
         if window is not None:
             self.capacity = max(window, k + 8)
         self.ring = window is not None
+        # ancestry planes default ON only in ring mode: there they are
+        # O(window^2) and replace every walk with a masked reduction; in
+        # full mode they are O(episode_len^2) per env — a silent memory
+        # blowup under vmap — so full mode defaults to the walk-based
+        # queries (O(B) state).  Ring REQUIRES the planes: retire/
+        # staleness logic reads masked common ancestors, and a walk in
+        # a ring could traverse reclaimed slots.
+        self.anc_masks = self.ring if anc_masks is None else anc_masks
+        assert self.anc_masks or not self.ring, \
+            "ring windows require anc_masks (walks could cross reclaimed slots)"
         self.max_parents = k + 1
         self.fields = obs_fields(k)
         self.observation_length = len(self.fields)
@@ -292,9 +303,10 @@ class BkSSZ(JaxEnv):
     def reset(self, key: jax.Array, params: EnvParams):
         # anc_masks: the chain/closure rows replace the three per-step
         # while-loop walks (common ancestor, height target, release
-        # chain) with masked reductions
+        # chain) with masked reductions; gated because the planes are
+        # quadratic in capacity (see __init__)
         dag = D.empty(self.capacity, self.max_parents,
-                      ring=self.ring, anc_masks=True)
+                      ring=self.ring, anc_masks=self.anc_masks)
         # genesis block (bk.ml:48); no leader vote -> +inf leader hash
         dag, root = D.append(
             dag, jnp.full((self.max_parents,), D.NONE, jnp.int32),
@@ -316,6 +328,14 @@ class BkSSZ(JaxEnv):
     def last_block(self, dag, x):
         """bk.ml:78-87: the block a vertex belongs to."""
         return jnp.where(dag.kind[x] == BLOCK, x, dag.parent0[x])
+
+    def common_ancestor(self, dag, a, b):
+        """Preference-fork common ancestor: one chain-row intersection
+        with ancestry planes, else the height-synchronized walk (the
+        pre-plane path, reclaim-safe only in full mode)."""
+        if dag.has_masks:
+            return D.common_ancestor_masked(dag, a, b)
+        return D.common_ancestor_by_height(dag, a, b)
 
     def _advance(self, state: State, params: EnvParams) -> State:
         """Produce the next attacker interaction: pending self-append,
@@ -402,7 +422,7 @@ class BkSSZ(JaxEnv):
         """bk_ssz.ml:225-263."""
         dag = state.dag
         ca = jnp.maximum(
-            D.common_ancestor_masked(dag, state.public, state.private), 0)
+            self.common_ancestor(dag, state.public, state.private), 0)
         pub_votes = self.votes_on(dag, state.public, dag.vis_d).sum()
         priv_inc = self.votes_on(dag, state.private).sum()
         priv_exc = self.votes_on(dag, state.private,
@@ -445,8 +465,13 @@ class BkSSZ(JaxEnv):
 
         # private chain block at the target height: one masked reduction
         # over the ancestry row (block chains ride parent slot 0, so the
-        # chain plane holds exactly the private block chain)
-        blk = D.chain_first_at_most(dag, state.private, dag.height, tgt_h)
+        # chain plane holds exactly the private block chain); full mode
+        # walks the precursor chain instead
+        if dag.has_masks:
+            blk = D.chain_first_at_most(dag, state.private, dag.height,
+                                        tgt_h)
+        else:
+            blk = D.block_at_height(dag, state.private, tgt_h)
         blk = jnp.maximum(blk, 0)
         # if quorum-size votes requested, prefer an existing proposal
         # child; the reference takes the FIRST child block in insertion
@@ -475,8 +500,12 @@ class BkSSZ(JaxEnv):
 
         # recursive share via the closure row (was a while-loop chain
         # walk); the chosen votes sit directly on the released block's
-        # chain, so a flat release covers their ancestry
-        released = D.release_masked(dag, rel_block, state.time)
+        # chain, so a flat release covers their ancestry.  Full mode
+        # keeps the chain walk (bounded by the withheld depth).
+        if dag.has_masks:
+            released = D.release_masked(dag, rel_block, state.time)
+        else:
+            released = D.release_chain(dag, rel_block, state.time)
         released = D.release(released, vote_mask, state.time)
         dag = D.select_vis(is_release, released, dag)
 

@@ -117,7 +117,8 @@ class EthereumSSZ(JaxEnv):
                  incentive_scheme: str | None = None,
                  uncle_cap: int = 6, unit_observation: bool = True,
                  strict_match: bool = True, max_steps_hint: int = 256,
-                 window: int | None = None):
+                 window: int | None = None,
+                 anc_masks: bool | None = None):
         # presets (ethereum.ml:12-24; behavioral mapping, see module doc)
         if preset == "whitepaper":
             defaults = dict(preference="work", progress="height",
@@ -151,6 +152,13 @@ class EthereumSSZ(JaxEnv):
         if window is not None:
             self.capacity = max(window, UNCLE_WINDOW + 10)
         self.ring = window is not None
+        # ancestry planes are (capacity, capacity): default ON only in
+        # ring mode, where capacity is the small active-set window and
+        # the retire logic needs the masked queries.  Full mode falls
+        # back to the lifted jump walks, keeping state O(capacity).
+        self.anc_masks = self.ring if anc_masks is None else anc_masks
+        assert self.anc_masks or not self.ring, \
+            "ring windows require anc_masks (walks could cross reclaimed slots)"
         self.max_parents = 1 + self.max_uncles
         self.low, self.high = obslib.low_high(OBS_FIELDS, unit_observation)
         self.policies = self._make_policies()
@@ -280,15 +288,23 @@ class EthereumSSZ(JaxEnv):
         better = self.pref(dag, candidate) > self.pref(dag, old)
         return jnp.where(better, candidate, old)
 
+    def common_ancestor(self, dag, a, b):
+        """Chain LCA: masked row intersection when the ancestry planes
+        exist, else the (lifted) height-synchronized walk."""
+        if dag.has_masks:
+            return D.common_ancestor_masked(dag, a, b)
+        return D.common_ancestor_by_height(dag, a, b)
+
     # -- env API -----------------------------------------------------------
 
     def reset(self, key: jax.Array, params: EnvParams):
-        # anc_masks, not lift: the incremental ancestry rows turn every
+        # with anc_masks, the incremental ancestry rows turn every
         # per-step walk (two common-ancestor walks, the release-target
         # walk, the release chain+closure fixpoint — 68% of the step in
-        # the round-5 device profile) into one masked reduction; the
-        # binary-lifting jump walk they replace is dead weight here
-        dag = D.empty(self.capacity, self.max_parents, anc_masks=True,
+        # the round-5 device profile) into one masked reduction; without
+        # them, binary lifting keeps those walks O(log depth)
+        dag = D.empty(self.capacity, self.max_parents,
+                      anc_masks=self.anc_masks, lift=not self.anc_masks,
                       ring=self.ring)
         dag, root = D.append(
             dag, jnp.full((self.max_parents,), D.NONE, jnp.int32),
@@ -363,9 +379,13 @@ class EthereumSSZ(JaxEnv):
         Preference is monotone nonincreasing down the chain (height and
         cumulative work both are), so the first satisfying block on the
         way down is the highest-height satisfying chain member — one
-        masked reduction over the ancestry row instead of a walk."""
-        return D.chain_first_at_most(dag, private, self.pref_all(dag),
-                                     target)
+        masked reduction over the ancestry row when the planes exist,
+        else a (lifted) monotone walk."""
+        if dag.has_masks:
+            return D.chain_first_at_most(dag, private, self.pref_all(dag),
+                                         target)
+        return D.walk_back(dag, private,
+                           lambda d, i: self.pref(d, i) <= target)
 
     def _apply(self, state: State, action) -> State:
         """ethereum_ssz.ml:398-429."""
@@ -377,7 +397,7 @@ class EthereumSSZ(JaxEnv):
 
         is_adopt = (act == ADOPT_DISCARD) | (act == ADOPT_RELEASE)
         pub_pref = self.pref(dag, state.public)
-        ca = D.common_ancestor_masked(dag, state.public, state.private)
+        ca = self.common_ancestor(dag, state.public, state.private)
         ca = jnp.maximum(ca, 0)
         # non-walking actions get a huge target so the walk stops at the
         # private tip immediately instead of running to genesis
@@ -394,13 +414,17 @@ class EthereumSSZ(JaxEnv):
             | (act == MATCH) | (act == RELEASE1)
         release_tip = jnp.where(do_release, release_tip, jnp.int32(-1))
 
-        # the recursive share (simulator.ml:401-419) is one closure-row
-        # read: the incremental ancestry plane covers chain ancestors,
-        # uncles, and withheld uncles-of-uncles alike — no chain walk,
-        # no visibility fixpoint (round-5 profile: those while loops
-        # were 68% of the step).  select_vis, not a full-tree select:
-        # release only touches the two defender-visibility arrays.
-        released = D.release_masked(dag, release_tip, state.time)
+        # the recursive share (simulator.ml:401-419): with planes, one
+        # closure-row read covers chain ancestors, uncles, and withheld
+        # uncles-of-uncles alike — no chain walk, no visibility fixpoint
+        # (round-5 profile: those while loops were 68% of the step);
+        # without planes, the chain walk plus closure fixpoint.
+        # select_vis, not a full-tree select: release only touches the
+        # two defender-visibility arrays.
+        if dag.has_masks:
+            released = D.release_masked(dag, release_tip, state.time)
+        else:
+            released = D.release_closure(dag, release_tip, state.time)
         dag = D.select_vis(do_release, released, dag)
 
         # deliver the released tip to the defender cloud
@@ -430,7 +454,7 @@ class EthereumSSZ(JaxEnv):
         """ethereum_ssz.ml:364-396."""
         dag = state.dag
         ca = jnp.maximum(
-            D.common_ancestor_masked(dag, state.public, state.private), 0)
+            self.common_ancestor(dag, state.public, state.private), 0)
         ph = dag.height[state.public] - dag.height[ca]
         pw = dag.aux[state.public] - dag.aux[ca]
         ah = dag.height[state.private] - dag.height[ca]

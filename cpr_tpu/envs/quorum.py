@@ -98,6 +98,17 @@ def candidate_frame(dag, cand, C: int, vote_kind: int, max_vote_parents: int = 1
                           oh_gather(oh, dag.signer).astype(jnp.int32), -2)
         anc_votes = (rows & (dag.kind == vote_kind)[None, :]
                      & (dag.signer[None, :] == sig_c[:, None]))
+        if dag.is_ring:
+            # the signer match above compares SLOT ids: after a wrap, a
+            # still-resident vote of the signer slot's previous occupant
+            # aliases sig_c and reads as an (out-of-frame) vote ancestor,
+            # escaping the whole branch. Genuine confirmers are younger
+            # than their block (the D.newer_than argument, vectorized
+            # over the candidate blocks, same guard as
+            # prefix_release_sets' conf_rows).
+            gid_sig = oh_gather(frame_onehot(dag, sig_c, cvalid),
+                                dag.gid).astype(jnp.int32)
+            anc_votes = anc_votes & (dag.gid[None, :] > gid_sig[:, None])
         frame_mask = D.mask_of(cidx, cvalid, dag.capacity)
         # reachability runs through filtered child traversals
         # (tailstorm.ml:509-531): an out-of-frame vote ancestor makes

@@ -58,6 +58,11 @@ def get(key: str, **kwargs):
     """Instantiate the env for `key` — either a registered family name
     with explicit kwargs, or a full protocol key parsed by `parse_key`.
 
+    kwargs forward to the env constructor, so the performance knobs
+    every DAG env shares flow through here: `window=<int>` turns on the
+    O(active-set) ring mode and `anc_masks=<bool>` overrides the
+    ancestry-plane default (ON in ring mode, OFF at full capacity).
+
     Identical (key, kwargs) return the SAME env object: envs are
     immutable config holders, and jit caches key on the env instance
     (rollout/step have static self), so sharing instances shares

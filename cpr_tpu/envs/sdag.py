@@ -86,11 +86,15 @@ class State:
 
 class SdagSSZ(JaxEnv):
     n_actions = 8
+    # a fresh reset populates genesis + one _mine append; see
+    # JaxEnv.reset_dag_rows contract
+    reset_dag_rows = 2
 
     def __init__(self, k: int = 8, incentive_scheme: str = "constant",
                  subblock_selection: str = "heuristic",
                  unit_observation: bool = True, max_steps_hint: int = 256,
-                 release_scan: int = 128):
+                 release_scan: int = 128, window: int | None = None,
+                 anc_masks: bool | None = None):
         assert k >= 2  # sdag.ml:3-24 requires k >= 2
         assert incentive_scheme in INCENTIVE_SCHEMES
         assert subblock_selection in SUBBLOCK_SELECTIONS
@@ -104,6 +108,19 @@ class SdagSSZ(JaxEnv):
         # one PoW append per step; floored at the candidate window so
         # small hints with large k still hold a full quorum frame
         self.capacity = max(max_steps_hint + 8, self.C_MAX)
+        # O(active-set) ring mode (see bk.py): the window must cover the
+        # live fork with its vote sub-DAGs (k slots per withheld block)
+        # and the C_MAX quorum-candidate frame; evicting a live slot
+        # raises overflow like capacity exhaustion in full mode
+        if window is not None:
+            self.capacity = max(window, self.C_MAX)
+        self.ring = window is not None
+        # ancestry planes: ON by default only in ring mode (quadratic in
+        # capacity; ring retire logic needs the masked queries), full
+        # mode keeps the O(B) walk-based queries
+        self.anc_masks = self.ring if anc_masks is None else anc_masks
+        assert self.anc_masks or not self.ring, \
+            "ring windows require anc_masks (walks could cross reclaimed slots)"
         self.STALE_WALK = 4
         self.release_scan = min(release_scan, self.capacity)
         self.fields = obs_fields(k)
@@ -114,7 +131,10 @@ class SdagSSZ(JaxEnv):
     # -- protocol primitives (sdag.ml) -------------------------------------
 
     def confirming(self, dag, b, extra_mask=None):
-        m = dag.exists() & (dag.kind == VOTE) & (dag.signer == b)
+        # newer_than guards ring reuse: a reclaimed slot could carry a
+        # stale signer equal to b's slot index (no-op in full mode)
+        m = (dag.exists() & (dag.kind == VOTE) & (dag.signer == b)
+             & D.newer_than(dag, b))
         if extra_mask is not None:
             m = m & extra_mask
         return m
@@ -135,6 +155,11 @@ class SdagSSZ(JaxEnv):
     def block_lca(self, dag, a, b):
         """Common ancestor along the block chain (heights drop by 1 per
         prev_block step)."""
+        if dag.has_masks:
+            # the chain plane follows prev_block for blocks (appends pass
+            # chain_parent=head), so the masked query is exact and cannot
+            # cross reclaimed ring slots
+            return jnp.maximum(D.common_ancestor_masked(dag, a, b), 0)
 
         def cond(state):
             x, y = state
@@ -150,9 +175,13 @@ class SdagSSZ(JaxEnv):
         return jnp.maximum(x, 0)
 
     def vote_score(self, dag):
-        """compare_votes_in_block: vote number desc, DAG order on ties."""
-        return (dag.aux.astype(jnp.float32)
-                - dag.slots().astype(jnp.float32) / self.capacity)
+        """compare_votes_in_block: vote number desc, DAG order on ties.
+        The tiebreak uses append age relative to the retirement frontier:
+        live gids satisfy gid - live_floor in [0, capacity), so the
+        fraction stays in [0, 1) across ring wraps (in full mode it
+        reduces to the old slots()/capacity form)."""
+        age = (dag.age_key() - dag.live_floor).astype(jnp.float32)
+        return dag.aux.astype(jnp.float32) - age / self.capacity
 
     def cmp_blocks(self, dag, x, y, vote_filter_mask):
         """sdag.ml:399-413: height then filtered confirming votes; the
@@ -279,13 +308,18 @@ class SdagSSZ(JaxEnv):
             progress=progress,
             # blocks cache their previous block (prev_block); votes
             # keep NONE (their chain queries go through signer)
-            aux2=jnp.where(full, head, D.NONE))
+            aux2=jnp.where(full, head, D.NONE),
+            # point the chain plane at the block chain: a block's
+            # parent0 is a leaf vote, so block_lca's masked path needs
+            # the explicit prev-block pointer (votes keep parent0)
+            chain_parent=jnp.where(full, head, row[0]))
         return dag, idx, full
 
     # -- env API (mirrors cpr_tpu.envs.stree) -------------------------------
 
     def reset(self, key: jax.Array, params: EnvParams):
-        dag = D.empty(self.capacity, self.max_parents)
+        dag = D.empty(self.capacity, self.max_parents, ring=self.ring,
+                      anc_masks=self.anc_masks)
         dag, root = D.append(
             dag, jnp.full((self.max_parents,), D.NONE, jnp.int32),
             kind=BLOCK, height=0, miner=D.NONE, vis_a=True, vis_d=True,
@@ -330,6 +364,9 @@ class SdagSSZ(JaxEnv):
         miner = jnp.where(attacker, D.ATTACKER, D.DEFENDER)
         dag, idx, is_blk = self._mine_one(
             dag, head, view, filt, miner, time, powh)
+        # the append may reclaim a ring slot whose stale bit is set;
+        # the new occupant starts fresh (no-op in full mode)
+        stale = state.stale.at[idx].set(False)
 
         private = jnp.where(attacker & is_blk, idx, state.private)
         public = jnp.where(
@@ -339,6 +376,7 @@ class SdagSSZ(JaxEnv):
                       def_head))
         return state.replace(
             dag=dag, private=private, public=public, race_tip=race_tip,
+            stale=stale,
             event=jnp.where(attacker, EV_POW, EV_NETWORK).astype(jnp.int32),
             time=time, n_activations=state.n_activations + 1, key=key,
         )
@@ -394,7 +432,7 @@ class SdagSSZ(JaxEnv):
             self.STALE_WALK, self.last_block_all(dag),
             lambda d, i: self.prev_block(d, i))
 
-        rel_tip = jnp.where(match_set, dag.slots(), -1).max()
+        rel_tip = D.last_by_age(dag, match_set)
         race_tip = jnp.where(
             is_match & found & (rel_tip >= 0),
             self.last_block(dag, jnp.maximum(rel_tip, 0)),
@@ -409,6 +447,16 @@ class SdagSSZ(JaxEnv):
         state = self._mine(state, params)
         state = state.replace(steps=state.steps + 1)
         dag = state.dag
+
+        if self.ring:
+            # retire everything strictly below the block-chain LCA of the
+            # two heads: the race (both block forks and their vote
+            # sub-DAGs) lives at or above it, so older slots are free to
+            # be reclaimed by the ring
+            ca = self.block_lca(dag, state.public, state.private)
+            dag = D.retire_below(dag, dag.gid[jnp.maximum(ca, 0)])
+            state = state.replace(
+                dag=dag, race_tip=D.drop_if_retired(dag, state.race_tip))
 
         n_pub = self.confirming(dag, state.public).sum()
         n_priv = self.confirming(dag, state.private).sum()

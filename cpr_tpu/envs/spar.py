@@ -85,9 +85,14 @@ class State:
 
 class SparSSZ(JaxEnv):
     n_actions = 8
+    # a fresh reset populates genesis + one _mine append; see
+    # JaxEnv.reset_dag_rows contract
+    reset_dag_rows = 2
 
     def __init__(self, k: int = 8, incentive_scheme: str = "constant",
-                 unit_observation: bool = True, max_steps_hint: int = 256):
+                 unit_observation: bool = True, max_steps_hint: int = 256,
+                 window: int | None = None,
+                 anc_masks: bool | None = None):
         assert k >= 2
         assert incentive_scheme in ("constant", "block")
         self.k = k
@@ -96,6 +101,20 @@ class SparSSZ(JaxEnv):
         # exactly one PoW append per step; floored at the k+8 release
         # window (top_k needs k <= capacity)
         self.capacity = max(max_steps_hint + 8, k + 8)
+        # O(active-set) ring mode (see bk.py): the window replaces the
+        # episode-length-proportional capacity; it must cover the live
+        # fork plus its confirming votes (k slots per withheld block).
+        # A deeper fork evicts a live slot -> overflow ends the episode,
+        # the same semantics as capacity exhaustion in full mode.
+        if window is not None:
+            self.capacity = max(window, k + 8)
+        self.ring = window is not None
+        # ancestry planes: ON by default only in ring mode (quadratic in
+        # capacity; ring retire logic needs the masked queries), full
+        # mode keeps the O(B) walk-based queries
+        self.anc_masks = self.ring if anc_masks is None else anc_masks
+        assert self.anc_masks or not self.ring, \
+            "ring windows require anc_masks (walks could cross reclaimed slots)"
         self.max_parents = k
         self.fields = obs_fields(k)
         self.observation_length = len(self.fields)
@@ -106,11 +125,21 @@ class SparSSZ(JaxEnv):
 
     def confirming(self, dag, b, extra_mask=None):
         """Votes confirming block b (spar.ml:88-91); votes store their
-        block in the `signer` column."""
-        m = dag.exists() & (dag.kind == VOTE) & (dag.signer == b)
+        block in the `signer` column.  newer_than guards the ring wrap:
+        a stale vote whose block slot was reclaimed by b would alias
+        (no-op in full mode)."""
+        m = (dag.exists() & (dag.kind == VOTE) & (dag.signer == b)
+             & D.newer_than(dag, b))
         if extra_mask is not None:
             m = m & extra_mask
         return m
+
+    def common_ancestor(self, dag, a, b):
+        """Masked chain-row intersection with ancestry planes, else the
+        height-synchronized walk (full mode; reclaim-safe there)."""
+        if dag.has_masks:
+            return D.common_ancestor_masked(dag, a, b)
+        return D.common_ancestor_by_height(dag, a, b)
 
     def last_block(self, dag, x):
         """spar.ml:77-84."""
@@ -184,7 +213,8 @@ class SparSSZ(JaxEnv):
     # -- env API ------------------------------------------------------------
 
     def reset(self, key: jax.Array, params: EnvParams):
-        dag = D.empty(self.capacity, self.max_parents)
+        dag = D.empty(self.capacity, self.max_parents,
+                      ring=self.ring, anc_masks=self.anc_masks)
         dag, root = D.append(
             dag, jnp.full((self.max_parents,), D.NONE, jnp.int32),
             kind=BLOCK, height=0, miner=D.NONE, vis_a=True, vis_d=True,
@@ -252,7 +282,8 @@ class SparSSZ(JaxEnv):
     def observe(self, state: State):
         """spar_ssz.ml:226-253."""
         dag = state.dag
-        ca = D.common_ancestor_by_height(dag, state.public, state.private)
+        ca = jnp.maximum(
+            self.common_ancestor(dag, state.public, state.private), 0)
         pub_votes = self.confirming(dag, state.public, dag.vis_d).sum()
         priv_inc = self.confirming(dag, state.private).sum()
         priv_exc = self.confirming(dag, state.private,
@@ -286,14 +317,22 @@ class SparSSZ(JaxEnv):
         tgt_v = jnp.where(is_match, nv_pub,
                           jnp.where(nv_pub >= k, 0, nv_pub + 1))
 
-        blk = D.block_at_height(dag, state.private, tgt_h)
+        # private chain block at the target height: one masked chain-row
+        # reduction with ancestry planes (block chains ride parent slot
+        # 0), a precursor walk in full mode
+        if dag.has_masks:
+            blk = D.chain_first_at_most(dag, state.private, dag.height,
+                                        tgt_h)
+        else:
+            blk = D.block_at_height(dag, state.private, tgt_h)
         blk = jnp.maximum(blk, 0)
         # proposal fast path (spar_ssz.ml:283-291): if quorum-many votes
-        # requested, prefer an existing block child (first in DAG order)
-        child_blocks = (dag.exists() & (dag.kind == BLOCK)
-                        & (dag.parent0 == blk))
+        # requested, prefer an existing block child, FIRST in insertion
+        # order (slot order wraps in a ring — first_by_age is the
+        # wrap-safe lowest-slot argmax)
+        child_blocks = D.children0_mask(dag, blk) & (dag.kind == BLOCK)
         has_prop = child_blocks.any()
-        first_prop = jnp.argmax(child_blocks)
+        first_prop = jnp.maximum(D.first_by_age(dag, child_blocks), 0)
         use_prop = (tgt_v >= k) & has_prop
         rel_block = jnp.where(use_prop, first_prop, blk).astype(jnp.int32)
         rel_votes_n = jnp.where(use_prop, 0, tgt_v)
@@ -306,14 +345,18 @@ class SparSSZ(JaxEnv):
         # the release would silently ship fewer votes than the reference's
         # Compare.first nvotes selection and the override might not bite
         not_enough = (votes.sum() < rel_votes_n) | (rel_votes_n > self.k + 8)
-        vote_mask = jnp.zeros((self.capacity,), jnp.bool_)
-        vote_mask = vote_mask.at[vidx].max(vvalid & take)
+        vote_mask = D.mask_of(vidx, vvalid & take, self.capacity)
         vote_mask = jnp.where(not_enough, votes, vote_mask)
 
-        released = D.release_chain(dag, rel_block, state.time)
+        # recursive share: one closure-row read with ancestry planes,
+        # the bounded chain walk in full mode; the chosen votes sit
+        # directly on the released block, so a flat release covers them
+        if dag.has_masks:
+            released = D.release_masked(dag, rel_block, state.time)
+        else:
+            released = D.release_chain(dag, rel_block, state.time)
         released = D.release(released, vote_mask, state.time)
-        dag = jax.tree.map(
-            lambda a, b: jnp.where(is_release, a, b), released, dag)
+        dag = D.select_vis(is_release, released, dag)
 
         # deliver to the simulated defender; a tie arms the gamma race
         rb = self.last_block(dag, rel_block)
@@ -338,6 +381,17 @@ class SparSSZ(JaxEnv):
         state = self._mine(state, params)
         state = state.replace(steps=state.steps + 1)
         dag = state.dag
+
+        if self.ring:
+            # retire everything below the preference fork: every later
+            # read starts at public/private (descendants of their common
+            # ancestor) or at votes hanging on the fork (appended after
+            # the CA, so gid-above it).  The race tip may outlive the
+            # fork — drop it while its slot still holds the original.
+            ca = D.common_ancestor_masked(dag, state.public, state.private)
+            dag = D.retire_below(dag, dag.gid[jnp.maximum(ca, 0)])
+            state = state.replace(
+                dag=dag, race_tip=D.drop_if_retired(dag, state.race_tip))
 
         # winner (spar.ml:123-128): (height, confirming votes), ties to
         # the attacker (node 0 first in the fold)

@@ -94,11 +94,15 @@ class State:
 
 class StreeSSZ(JaxEnv):
     n_actions = 8
+    # a fresh reset populates genesis + one _mine append; see
+    # JaxEnv.reset_dag_rows contract
+    reset_dag_rows = 2
 
     def __init__(self, k: int = 8, incentive_scheme: str = "constant",
                  subblock_selection: str = "heuristic",
                  unit_observation: bool = True, max_steps_hint: int = 256,
-                 release_scan: int = 128):
+                 release_scan: int = 128, window: int | None = None,
+                 anc_masks: bool | None = None):
         assert k >= 2
         assert incentive_scheme in INCENTIVE_SCHEMES
         assert subblock_selection in SUBBLOCK_SELECTIONS
@@ -118,6 +122,19 @@ class StreeSSZ(JaxEnv):
         # one PoW append per step; floored at the candidate window so
         # small hints with large k still hold a full quorum frame
         self.capacity = max(max_steps_hint + 8, self.C_MAX)
+        # O(active-set) ring mode (see bk.py): the window must cover the
+        # live fork with its vote trees (k slots per withheld block) and
+        # the C_MAX quorum-candidate frame; evicting a live slot raises
+        # overflow like capacity exhaustion in full mode
+        if window is not None:
+            self.capacity = max(window, self.C_MAX)
+        self.ring = window is not None
+        # ancestry planes: ON by default only in ring mode (quadratic in
+        # capacity; ring retire logic needs the masked queries), full
+        # mode keeps the O(B) walk-based queries
+        self.anc_masks = self.ring if anc_masks is None else anc_masks
+        assert self.anc_masks or not self.ring, \
+            "ring windows require anc_masks (walks could cross reclaimed slots)"
         self.STALE_WALK = 4
         self.release_scan = min(release_scan, self.capacity)
         self.fields = obs_fields(k)
@@ -128,7 +145,10 @@ class StreeSSZ(JaxEnv):
     # -- protocol primitives (stree.ml) ------------------------------------
 
     def confirming(self, dag, b, extra_mask=None):
-        m = dag.exists() & (dag.kind == VOTE) & (dag.signer == b)
+        # newer_than: ring-wrap guard against votes of a reclaimed slot's
+        # previous occupant aliasing b (no-op in full mode)
+        m = (dag.exists() & (dag.kind == VOTE) & (dag.signer == b)
+             & D.newer_than(dag, b))
         if extra_mask is not None:
             m = m & extra_mask
         return m
@@ -140,11 +160,24 @@ class StreeSSZ(JaxEnv):
         """(B,) last_block per slot (Q.last_of_kind_all)."""
         return Q.last_of_kind_all(dag, BLOCK)
 
+    def common_ancestor(self, dag, a, b):
+        """Block-chain LCA (blocks precede via parent slot 0): masked
+        chain-row intersection with ancestry planes, else the
+        height-synchronized walk (full mode; reclaim-safe there)."""
+        if dag.has_masks:
+            return D.common_ancestor_masked(dag, a, b)
+        return D.common_ancestor_by_height(dag, a, b)
+
     def vote_score(self, dag):
         """compare_votes_in_block (stree.ml:96-100): depth desc, ties in
-        DAG (slot) order."""
-        return (dag.aux.astype(jnp.float32)
-                - dag.slots().astype(jnp.float32) / self.capacity)
+        DAG (insertion) order.  The tiebreak fraction uses the age key
+        offset by the ring floor — in full mode that is exactly the slot
+        id; in ring mode live gids stay within [floor, floor + W) absent
+        overflow, so the fraction keeps insertion order without
+        interleaving depths.  (Entries outside the live set may fall
+        outside [0, 1); every consumer masks to live candidates.)"""
+        age = (dag.age_key() - dag.live_floor).astype(jnp.float32)
+        return dag.aux.astype(jnp.float32) - age / self.capacity
 
     def cmp_blocks(self, dag, x, y, vote_filter_mask):
         """stree.ml:518-527: height, filtered confirming votes; the
@@ -248,7 +281,8 @@ class StreeSSZ(JaxEnv):
     # -- env API ------------------------------------------------------------
 
     def reset(self, key: jax.Array, params: EnvParams):
-        dag = D.empty(self.capacity, self.max_parents)
+        dag = D.empty(self.capacity, self.max_parents,
+                      ring=self.ring, anc_masks=self.anc_masks)
         dag, root = D.append(
             dag, jnp.full((self.max_parents,), D.NONE, jnp.int32),
             kind=BLOCK, height=0, miner=D.NONE, vis_a=True, vis_d=True,
@@ -294,6 +328,9 @@ class StreeSSZ(JaxEnv):
         miner = jnp.where(attacker, D.ATTACKER, D.DEFENDER)
         dag, idx, is_blk = self._mine_one(
             dag, head, view, filt, miner, time, powh)
+        # the appended slot may be a reclaimed ring slot: clear any stale
+        # bit left by its previous occupant (no-op in full mode)
+        stale = state.stale.at[idx].set(False)
 
         private = jnp.where(attacker & is_blk, idx, state.private)
         public = jnp.where(
@@ -303,6 +340,7 @@ class StreeSSZ(JaxEnv):
                       def_head))
         return state.replace(
             dag=dag, private=private, public=public, race_tip=race_tip,
+            stale=stale,
             event=jnp.where(attacker, EV_POW, EV_NETWORK).astype(jnp.int32),
             time=time, n_activations=state.n_activations + 1, key=key,
         )
@@ -310,7 +348,8 @@ class StreeSSZ(JaxEnv):
     def observe(self, state: State):
         """stree_ssz.ml:242-270."""
         dag = state.dag
-        ca = D.common_ancestor_by_height(dag, state.public, state.private)
+        ca = jnp.maximum(
+            self.common_ancestor(dag, state.public, state.private), 0)
 
         def depth_count(mask):
             return (jnp.where(mask, dag.aux, 0).max(), mask.sum())
@@ -365,9 +404,10 @@ class StreeSSZ(JaxEnv):
             self.STALE_WALK, self.last_block_all(dag),
             lambda d, i: d.parent0[i])
 
-        # match race target: last block of the deepest released vertex,
-        # armed only when a flipping prefix exists
-        rel_tip = jnp.where(match_set, dag.slots(), -1).max()
+        # match race target: last block of the latest-appended released
+        # vertex, armed only when a flipping prefix exists (last_by_age
+        # is the wrap-safe highest-slot max)
+        rel_tip = D.last_by_age(dag, match_set)
         race_tip = jnp.where(
             is_match & found & (rel_tip >= 0),
             self.last_block(dag, jnp.maximum(rel_tip, 0)),
@@ -382,6 +422,18 @@ class StreeSSZ(JaxEnv):
         state = self._mine(state, params)
         state = state.replace(steps=state.steps + 1)
         dag = state.dag
+
+        if self.ring:
+            # retire everything below the block-chain fork: later reads
+            # start at public/private (descendants of their LCA), at
+            # votes hanging on live blocks (appended after them, so
+            # gid-above the LCA), or at withheld release candidates
+            # (mined on the private fork).  The race tip may outlive the
+            # fork — drop it while its slot still holds the original.
+            ca = self.common_ancestor(dag, state.public, state.private)
+            dag = D.retire_below(dag, dag.gid[jnp.maximum(ca, 0)])
+            state = state.replace(
+                dag=dag, race_tip=D.drop_if_retired(dag, state.race_tip))
 
         n_pub = self.confirming(dag, state.public).sum()
         n_priv = self.confirming(dag, state.private).sum()

@@ -129,7 +129,8 @@ class TailstormSSZ(JaxEnv):
     def __init__(self, k: int = 8, incentive_scheme: str = "discount",
                  subblock_selection: str = "heuristic",
                  unit_observation: bool = True, max_steps_hint: int = 256,
-                 release_scan: int = 128, window: int | None = None):
+                 release_scan: int = 128, window: int | None = None,
+                 anc_masks: bool | None = None):
         assert incentive_scheme in INCENTIVE_SCHEMES
         assert subblock_selection in SUBBLOCK_SELECTIONS
         self.k = k
@@ -157,6 +158,14 @@ class TailstormSSZ(JaxEnv):
         if window is not None:
             self.capacity = max(window, self.C_MAX)
         self.ring = window is not None
+        # ancestry planes are quadratic in capacity, so they default ON
+        # only in ring mode (where capacity is the small active-set
+        # window and the retire logic needs the masked queries); full
+        # mode falls back to walk-based LCA / stale descent, keeping
+        # state O(capacity)
+        self.anc_masks = self.ring if anc_masks is None else anc_masks
+        assert self.anc_masks or not self.ring, \
+            "ring windows require anc_masks (walks could cross reclaimed slots)"
         self.STALE_WALK = 4  # summary-chain descent check depth at Adopt
         assert self.C_MAX < (1 << 8), "composite sort keys use 8 bits"
         self.release_scan = min(release_scan, self.capacity)
@@ -196,12 +205,28 @@ class TailstormSSZ(JaxEnv):
 
     def summary_lca(self, dag, a, b):
         """Common ancestor of two summaries along the summary chain
-        (dagtools.ml:102-121): the chain-ancestry plane follows the
-        prev-summary pointer (append_summary passes chain_parent), so
-        the LCA is one row intersection + height argmax instead of the
-        old height-synchronized while loop (~3 ms/step at 4096 envs,
-        round-5 device profile)."""
-        return jnp.maximum(D.common_ancestor_masked(dag, a, b), 0)
+        (dagtools.ml:102-121): with ancestry planes, the chain plane
+        follows the prev-summary pointer (append_summary passes
+        chain_parent), so the LCA is one row intersection + height
+        argmax instead of a height-synchronized while loop (~3 ms/step
+        at 4096 envs, round-5 device profile). Without planes (full
+        mode), walk the cached prev-summary pointers — heights drop by
+        1 per step, so the loop is the standard synchronized descent."""
+        if dag.has_masks:
+            return jnp.maximum(D.common_ancestor_masked(dag, a, b), 0)
+
+        def cond(st):
+            x, y = st
+            return (x != y) & (x >= 0) & (y >= 0)
+
+        def body(st):
+            x, y = st
+            hx, hy = dag.height[x], dag.height[y]
+            return (jnp.where(hx >= hy, self.prev_summary(dag, x), x),
+                    jnp.where(hy >= hx, self.prev_summary(dag, y), y))
+
+        x, _ = jax.lax.while_loop(cond, body, (a, b))
+        return jnp.maximum(x, 0)
 
     def vote_ancestors(self, dag, starts):
         """(C, D_MAX) vote-path matrix: row i lists starts[i] and its vote
@@ -390,11 +415,11 @@ class TailstormSSZ(JaxEnv):
     # -- env API ------------------------------------------------------------
 
     def reset(self, key: jax.Array, params: EnvParams):
-        # anc_masks: summary-chain LCA, stale descent, and the quorum
-        # frame's ancestor matrix all read the incremental ancestry
-        # planes instead of walking
+        # with anc_masks, summary-chain LCA, stale descent, and the
+        # quorum frame's ancestor matrix all read the incremental
+        # ancestry planes instead of walking
         dag = D.empty(self.capacity, self.max_parents,
-                      ring=self.ring, anc_masks=True)
+                      ring=self.ring, anc_masks=self.anc_masks)
         # genesis summary, height 0 (tailstorm.ml:84)
         dag, root = D.append(
             dag, jnp.full((self.max_parents,), D.NONE, jnp.int32),

@@ -944,6 +944,17 @@ static long n_choose_k_capped(long n, long k, long cap) {
 // mirror the env scorer (cpr_tpu/envs/quorum.py quorum_optimal):
 // tailstorm pays votes only with r = depth/k; stree pays (depth+1)/k
 // and includes the block itself.
+//
+// Documented deviation: score TIES resolve in ascending-lexicographic
+// combination order over the id-sorted candidate list (first maximum
+// wins below), whereas the reference enumerates via
+// Combinatorics.iter_n_choose_k, whose emission order follows the
+// candidates' list order (visibility/insertion order).  When several
+// quorums share the maximal reward the two engines can pick different
+// (equally optimal) vote SETS, which later diverges tiebreak-sensitive
+// trajectories; reward totals are unaffected.  The env-side scorer
+// (quorum_optimal's static combo table) shares this tie order, so
+// oracle-vs-env A/B runs stay aligned.
 static std::vector<int> optimal_quorum(const Dag& d,
                                        const std::vector<int>& cands_in,
                                        int me, int q, bool discount,

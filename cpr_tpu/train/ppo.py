@@ -215,6 +215,10 @@ def make_train(env: JaxEnv, env_params: EnvParams, cfg: PPOConfig,
         new_ts = ts.apply_gradients(grads=grads)
         ts = jax.tree.map(lambda a, b: jnp.where(cont, a, b), new_ts, ts)
         metrics["kl_stop"] = (~cont).astype(jnp.float32)
+        # applied marks minibatches whose update actually took effect —
+        # skipped ones still compute losses (lax.scan has no break) and
+        # must not dilute the reported means
+        metrics["applied"] = cont.astype(jnp.float32)
         return ts, cont, metrics
 
     def train_step(carry):
@@ -252,7 +256,19 @@ def make_train(env: JaxEnv, env_params: EnvParams, cfg: PPOConfig,
         (ts, _, key), metrics = jax.lax.scan(
             epoch, (ts, jnp.bool_(True), key), None,
             length=cfg.update_epochs)
-        metrics = jax.tree.map(lambda x: x.mean(), metrics)
+        if cfg.target_kl is None:
+            metrics = jax.tree.map(lambda x: x.mean(), metrics)
+        else:
+            # sb3 stops the epoch loop at the KL breach, so its reported
+            # losses average only the minibatches that ran; here the scan
+            # runs every minibatch as a gated no-op, so the loss metrics
+            # are weighted by `applied` (kl_stop keeps the plain mean:
+            # it IS the skipped fraction)
+            w = metrics.pop("applied")
+            n = jnp.maximum(w.sum(), 1.0)
+            gated = ("pg_loss", "v_loss", "approx_kl")
+            metrics = {k: (v * w).sum() / n if k in gated else v.mean()
+                       for k, v in metrics.items()}
         metrics["mean_step_reward"] = traj.reward.mean()
         metrics["episode_reward_attacker"] = (
             jnp.where(traj.done, traj.info["episode_reward_attacker"], 0.0).sum()

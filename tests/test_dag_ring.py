@@ -278,6 +278,108 @@ def test_ethereum_ring_episode_matches_full():
             np.asarray(full[key]), np.asarray(ring[key]), err_msg=key)
 
 
+def test_spar_ring_episode_matches_full():
+    """Windowed spar replays full-capacity episodes bit-for-bit; one
+    append per step, so window 48 < 96 steps wraps every episode
+    (slot reuse under the confirming newer_than guard and the
+    first-proposer first_by_age tiebreak)."""
+    from cpr_tpu.envs.spar import SparSSZ
+    from cpr_tpu.params import make_params
+
+    params = make_params(alpha=0.3, gamma=0.5, max_steps=96)
+    keys = jax.random.split(jax.random.PRNGKey(4), 16)
+    outs = []
+    for env in (SparSSZ(k=4, max_steps_hint=104),
+                SparSSZ(k=4, max_steps_hint=104, window=48)):
+        fn = jax.jit(jax.vmap(lambda k: env.episode_stats(
+            k, params, env.policies["selfish"], 104)))
+        outs.append(jax.block_until_ready(fn(keys)))
+    full, ring = outs
+    for key in sorted(full):
+        np.testing.assert_array_equal(
+            np.asarray(full[key]), np.asarray(ring[key]), err_msg=key)
+
+
+def test_stree_ring_episode_matches_full():
+    """Windowed stree replays full-capacity episodes bit-for-bit
+    (quorum frames and release prefixes order by age key; vote_score's
+    fractional-age tiebreak is wrap-invariant).  Window 48 < 96 steps
+    at one append per step, so every episode wraps."""
+    from cpr_tpu.envs.stree import StreeSSZ
+    from cpr_tpu.params import make_params
+
+    params = make_params(alpha=0.3, gamma=0.5, max_steps=96)
+    keys = jax.random.split(jax.random.PRNGKey(5), 16)
+    outs = []
+    for env in (StreeSSZ(k=4, max_steps_hint=104),
+                StreeSSZ(k=4, max_steps_hint=104, window=48)):
+        fn = jax.jit(jax.vmap(lambda k: env.episode_stats(
+            k, params, env.policies["override-catchup"], 104)))
+        outs.append(jax.block_until_ready(fn(keys)))
+    full, ring = outs
+    for key in sorted(full):
+        np.testing.assert_array_equal(
+            np.asarray(full[key]), np.asarray(ring[key]), err_msg=key)
+
+
+def test_sdag_ring_episode_matches_full():
+    """Windowed sdag replays full-capacity episodes bit-for-bit (the
+    block chain rides the chain plane via chain_parent=head; block_lca
+    walk vs masked row must agree).  Window 48 < 96 steps at one
+    append per step, so every episode wraps."""
+    from cpr_tpu.envs.sdag import SdagSSZ
+    from cpr_tpu.params import make_params
+
+    params = make_params(alpha=0.3, gamma=0.5, max_steps=96)
+    keys = jax.random.split(jax.random.PRNGKey(6), 16)
+    outs = []
+    for env in (SdagSSZ(k=4, max_steps_hint=104),
+                SdagSSZ(k=4, max_steps_hint=104, window=48)):
+        fn = jax.jit(jax.vmap(lambda k: env.episode_stats(
+            k, params, env.policies["override-catchup"], 104)))
+        outs.append(jax.block_until_ready(fn(keys)))
+    full, ring = outs
+    for key in sorted(full):
+        np.testing.assert_array_equal(
+            np.asarray(full[key]), np.asarray(ring[key]), err_msg=key)
+
+
+def test_full_capacity_envs_have_no_planes():
+    """Memory-footprint regression: at full capacity (window=None) no
+    env state carries the quadratic (B, B) ancestry planes or the ring
+    bookkeeping — state stays O(B) per env.  eval_shape: no arrays are
+    materialized, so the check is free even at large capacity."""
+    from cpr_tpu.envs.bk import BkSSZ
+    from cpr_tpu.envs.ethereum import EthereumSSZ
+    from cpr_tpu.envs.sdag import SdagSSZ
+    from cpr_tpu.envs.spar import SparSSZ
+    from cpr_tpu.envs.stree import StreeSSZ
+    from cpr_tpu.envs.tailstorm import TailstormSSZ
+    from cpr_tpu.params import make_params
+
+    params = make_params(alpha=0.3, gamma=0.5, max_steps=120)
+    key = jax.random.PRNGKey(0)
+    for env in (BkSSZ(k=4, max_steps_hint=128),
+                EthereumSSZ("byzantium", max_steps_hint=128),
+                TailstormSSZ(k=4, max_steps_hint=128),
+                SparSSZ(k=4, max_steps_hint=128),
+                StreeSSZ(k=4, max_steps_hint=128),
+                SdagSSZ(k=4, max_steps_hint=128)):
+        assert not env.ring and not env.anc_masks
+        state, _ = jax.eval_shape(env.reset, key, params)
+        name = type(env).__name__
+        assert state.dag.chain.shape == (0, 0), name
+        assert state.dag.closure.shape == (0, 0), name
+        assert state.dag.gid.shape == (0,), name
+        # ring mode bounds the planes to the window, not the hint
+        wenv = type(env)(**(dict(k=4) if name != "EthereumSSZ"
+                            else dict()), max_steps_hint=128, window=64)
+        wstate, _ = jax.eval_shape(wenv.reset, key, params)
+        W = wenv.capacity
+        assert wstate.dag.chain.shape == (W, W), name
+        assert wstate.dag.closure.shape == (W, W), name
+
+
 def test_ring_first_by_age_wraps():
     dag = D.empty(4, 1, ring=True)
     dag, a = D.append(dag, jnp.array([-1], jnp.int32), height=0)

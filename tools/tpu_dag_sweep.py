@@ -80,7 +80,8 @@ def main():
     elif config == "ethereum":
         from cpr_tpu.envs.ethereum import EthereumSSZ
         n_steps = n_steps or 256
-        env = EthereumSSZ("byzantium", max_steps_hint=n_steps)
+        env = EthereumSSZ("byzantium", max_steps_hint=n_steps,
+                          window=window)
         rate, check, compile_s, rep_s = measure_env(
             env, "fn19", n_envs, n_steps, n_steps - 8, chunk or None)
     elif config == "tailstorm":
@@ -90,6 +91,10 @@ def main():
         from cpr_tpu.train.ppo import PPOConfig, make_train
 
         rollout = n_steps or 128
+        # label bump: the measured shape changed when the ring-window
+        # port landed (capacity floor + plane gating), so rows must not
+        # be compared against pre-ring "tailstorm" BENCH_SCALING rows
+        config = "tailstorm2"
         env = TailstormSSZ(k=8, incentive_scheme="discount",
                            max_steps_hint=128, window=window)
         params = make_params(alpha=0.35, gamma=0.5, max_steps=120)
@@ -118,7 +123,8 @@ def main():
 
     print(json.dumps({
         "config": config, "n_envs": n_envs, "n_steps": n_steps,
-        "chunk": chunk or None, "steps_per_sec": round(rate),
+        "chunk": chunk or None, "window": window or 0,
+        "capacity": env.capacity, "steps_per_sec": round(rate),
         "check": round(float(check), 4), "compile_s": round(compile_s, 1),
         "rep_s": round(rep_s, 1),
     }), flush=True)
