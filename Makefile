@@ -4,7 +4,7 @@
 
 CPU_MESH = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test test-slow bench dryrun sweeps ghostdag train-dummy native asan
+.PHONY: test test-slow bench telemetry-smoke dryrun sweeps ghostdag train-dummy native asan
 
 test:  ## fast tier (< ~8 min on the 1-core host)
 	python -m pytest tests/ -q
@@ -27,6 +27,14 @@ test-slow-split:
 
 bench:  ## one-line JSON benchmark (TPU with CPU fallback)
 	python bench.py
+
+TELEMETRY_SMOKE = /tmp/cpr-telemetry-smoke.jsonl
+
+telemetry-smoke:  ## tiny nakamoto CPU bench with telemetry on, then
+	## schema-validate the JSONL artifact (nonzero exit on violation)
+	rm -f $(TELEMETRY_SMOKE)
+	CPR_BENCH_BACKEND=cpu CPR_TELEMETRY=$(TELEMETRY_SMOKE) python bench.py
+	python tools/trace_summary.py $(TELEMETRY_SMOKE) --validate
 
 dryrun:  ## multi-chip sharding dry run on the virtual CPU mesh
 	$(CPU_MESH) python -c "from __graft_entry__ import dryrun_multichip; dryrun_multichip(8)"

@@ -19,6 +19,7 @@ import jax
 import numpy as np
 
 import cpr_tpu
+from cpr_tpu import telemetry
 from cpr_tpu.envs.registry import get_sized
 from cpr_tpu.params import make_params
 
@@ -60,7 +61,10 @@ def revenue(protocol_key: str, policy: str, *, alpha: float, gamma: float,
         keys = jax.random.split(jax.random.PRNGKey(seed), reps)
         fn = jax.jit(jax.vmap(lambda k: env.episode_stats(
             k, params, env.policies[policy], episode_len + 8)))
-        stats = jax.block_until_ready(fn(keys))
+        with telemetry.current().span(
+                "break_even_revenue",
+                env_steps=reps * episode_len) as sp:
+            stats = sp.fence(fn(keys))
         a = float(np.asarray(stats["episode_reward_attacker"]).mean())
         d = float(np.asarray(stats["episode_reward_defender"]).mean())
         return a / (a + d) if (a + d) else 0.0

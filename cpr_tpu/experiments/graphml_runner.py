@@ -8,12 +8,12 @@ rendered to graphviz dot).
 
 from __future__ import annotations
 
-import time
 from xml.etree import ElementTree as ET
 
 from cpr_tpu import network as netlib
 from cpr_tpu import trace
 from cpr_tpu.envs.registry import parse_key
+from cpr_tpu.telemetry import now
 
 
 def _oracle_args(protocol_key: str):
@@ -32,10 +32,10 @@ def run_graphml(xml_in: str, *, protocol: str = "nakamoto",
     GraphML holding the block DAG, the causal trace, and run metrics."""
     net = netlib.of_graphml(xml_in)
     proto, k, scheme = _oracle_args(protocol)
-    t0 = time.time()
+    t0 = now()
     sim = netlib.simulate(net, protocol=proto, k=k, scheme=scheme,
                           activations=activations, seed=seed)
-    duration = time.time() - t0
+    duration = now() - t0
     view = trace.view_of_oracle(sim)
     out = trace.to_graphml(view)
     root = ET.fromstring(out)

@@ -10,10 +10,9 @@ reference's Mtime counter (csv_runner.ml:65,76).
 
 from __future__ import annotations
 
-import time
-
 from cpr_tpu.experiments.sweep import run_task
 from cpr_tpu.native import OracleSim
+from cpr_tpu.telemetry import now
 
 DEFAULT_PROTOCOLS = (
     ("nakamoto", {}),
@@ -37,7 +36,7 @@ def honest_net_rows(protocols=DEFAULT_PROTOCOLS,
                     propagation_delay: float = 1.0, seed: int = 0):
     """One row per (protocol, activation_delay) honest clique run."""
     def one(proto, kw, ad):
-        t0 = time.time()
+        t0 = now()
         s = OracleSim(proto, topology="clique", n_nodes=n_nodes,
                       activation_delay=ad,
                       propagation_delay=propagation_delay,
@@ -78,7 +77,7 @@ def honest_net_rows(protocols=DEFAULT_PROTOCOLS,
                 "compute": "|".join("1" for _ in range(n_nodes)),
                 "node_activations": "|".join(str(a) for a in activations),
                 "reward": "|".join(f"{r:.6g}" for r in rewards),
-                "machine_duration_s": time.time() - t0,
+                "machine_duration_s": now() - t0,
             }
         finally:
             s.close()

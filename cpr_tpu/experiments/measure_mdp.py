@@ -12,9 +12,8 @@ sweep.
 
 from __future__ import annotations
 
-import time
-
 from cpr_tpu.mdp import Compiler, ptmdp
+from cpr_tpu.telemetry import now
 from cpr_tpu.mdp.explicit import MDP
 from cpr_tpu.mdp.generic import SingleAgent, get_protocol
 from cpr_tpu.mdp.models import Aft20BitcoinSM, Fc16BitcoinSM
@@ -61,11 +60,11 @@ def measure_rows(battery=None, *, horizon=100, stop_delta=1e-6,
     if battery is None:
         battery = model_battery()
     for name, factory in battery:
-        t0 = time.time()
+        t0 = now()
         made = factory()
         table = made if isinstance(made, MDP) else Compiler(made).mdp()
         mdp = ptmdp(table, horizon=horizon)
-        compile_s = time.time() - t0
+        compile_s = now() - t0
         row = {"model": name, "n_states": mdp.n_states,
                "n_transitions": mdp.n_transitions,
                "compile_s": compile_s}
@@ -74,13 +73,13 @@ def measure_rows(battery=None, *, horizon=100, stop_delta=1e-6,
             rows.append(row)
             continue
         tm = mdp.tensor()
-        t0 = time.time()
+        t0 = now()
         if mesh is not None:
             from cpr_tpu.parallel import sharded_value_iteration
             vi = sharded_value_iteration(tm, mesh, stop_delta=stop_delta)
         else:
             vi = tm.value_iteration(stop_delta=stop_delta)
-        row["vi_s"] = time.time() - t0
+        row["vi_s"] = now() - t0
         row["vi_iter"] = int(vi["vi_iter"])
         prog = tm.start_value(vi["vi_progress"])
         row["revenue"] = (float(tm.start_value(vi["vi_value"]) / prog)

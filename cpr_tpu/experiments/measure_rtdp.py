@@ -12,9 +12,8 @@ write_tsv like every other sweep.
 
 from __future__ import annotations
 
-import time
-
 from cpr_tpu.mdp import Compiler, ptmdp
+from cpr_tpu.telemetry import now
 from cpr_tpu.mdp.models import Aft20BitcoinSM, Fc16BitcoinSM
 from cpr_tpu.mdp.rtdp import RTDP
 
@@ -43,12 +42,12 @@ def measure_rtdp_rows(battery=None, *, horizon=30, step_budgets=(50_000,),
         battery = rtdp_battery()
     for name, factory in battery:
         model = factory()  # stateless: RTDP and exact VI share it
-        t0 = time.time()
+        t0 = now()
         tm = ptmdp(Compiler(model).mdp(), horizon=horizon).tensor()
         vi = tm.value_iteration(stop_delta=stop_delta)
         prog = tm.start_value(vi["vi_progress"])
         exact = float(tm.start_value(vi["vi_value"]) / prog) if prog else 0.0
-        vi_s = time.time() - t0
+        vi_s = now() - t0
 
         solver = RTDP(ptmdp_model(model, horizon), eps=eps,
                       eps_honest=eps_honest, es=es, seed=seed)
@@ -56,9 +55,9 @@ def measure_rtdp_rows(battery=None, *, horizon=30, step_budgets=(50_000,),
         dev_v = dev_p = None
         dev_done, dev_s = 0, 0.0
         for budget in sorted(step_budgets):
-            t0 = time.time()
+            t0 = now()
             solver.run(budget - done)
-            rtdp_s += time.time() - t0  # cumulative, like `steps`
+            rtdp_s += now() - t0  # cumulative, like `steps`
             done = budget
             v, g = solver.start_value_and_progress()
             est = v / g if g else 0.0

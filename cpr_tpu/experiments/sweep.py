@@ -9,8 +9,9 @@ header and prints row-major TSV, empty cells for missing keys.
 from __future__ import annotations
 
 import io
-import time
 from typing import Callable, Iterable
+
+from cpr_tpu.telemetry import now
 
 
 def _fmt(v) -> str:
@@ -53,7 +54,7 @@ def run_task(task: Callable[[], list[dict] | dict], ident: dict) -> list[dict]:
     (protocol, alpha, ...) for the error row; successful tasks return
     their row(s) untouched.
     """
-    t0 = time.time()
+    t0 = now()
     try:
         out = task()
         return out if isinstance(out, list) else [out]
@@ -62,4 +63,4 @@ def run_task(task: Callable[[], list[dict] | dict], ident: dict) -> list[dict]:
     except Exception as e:  # noqa: BLE001 — sweep must degrade per-task
         return [{**ident,
                  "error": f"{type(e).__name__}: {e}",
-                 "machine_duration_s": time.time() - t0}]
+                 "machine_duration_s": now() - t0}]

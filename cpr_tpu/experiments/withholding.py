@@ -11,11 +11,10 @@ scalars, so stacking the grid into leading axes and vmapping over
 
 from __future__ import annotations
 
-import time
-
 import jax
 import numpy as np
 
+from cpr_tpu import telemetry
 from cpr_tpu.envs.registry import get_sized
 from cpr_tpu.experiments.sweep import run_task
 from cpr_tpu.params import stack_params
@@ -44,13 +43,15 @@ def withholding_rows(protocol_key: str, policies=None, *,
         jax.random.PRNGKey(seed), (len(grid), reps))
 
     def one(pol):
-        t0 = time.time()
         fn = jax.jit(jax.vmap(jax.vmap(
             lambda k, p: env.episode_stats(
                 k, p, env.policies[pol], episode_len + 8),
             in_axes=(0, None)), in_axes=(0, 0)))
-        stats = jax.block_until_ready(fn(keys, params))
-        dt = time.time() - t0
+        with telemetry.current().span(
+                "withholding", env_steps=len(grid) * reps * episode_len,
+                grid_points=len(grid)) as sp:
+            stats = sp.fence(fn(keys, params))
+        dt = sp.dur_s
         atk = np.asarray(stats["episode_reward_attacker"]).mean(axis=1)
         dfn = np.asarray(stats["episode_reward_defender"]).mean(axis=1)
         prg = np.asarray(stats["episode_progress"]).mean(axis=1)

@@ -19,7 +19,6 @@ from __future__ import annotations
 
 import math
 import os
-import time
 from dataclasses import dataclass, field
 from functools import partial
 
@@ -28,6 +27,8 @@ import jax.numpy as jnp
 import numpy as np
 import scipy.sparse
 import scipy.sparse.linalg
+
+from cpr_tpu.telemetry import now
 
 
 def sum_to_one(xs) -> bool:
@@ -525,7 +526,7 @@ class TensorMDP:
             discount=discount, eps=eps, stop_delta=stop_delta, max_iter=max_iter)
         self._check_segment_width()
         impl = resolve_vi_impl(impl)
-        t0 = time.time()
+        t0 = now()
         run = _vi_loop if impl == "while" else vi_chunked
         value, progress, policy, delta, it = run(
             self.src, self.act, self.dst, self.prob, self.reward,
@@ -545,7 +546,7 @@ class TensorMDP:
             vi_progress=np.asarray(progress),
             vi_iter=int(it),
             vi_max_iter=max_iter,
-            vi_time=time.time() - t0,
+            vi_time=now() - t0,
         )
 
     def policy_evaluation(self, policy, *, theta: float, discount: float = 1.0,
@@ -612,14 +613,14 @@ class TensorMDP:
         z = jnp.zeros(self.n_states, dtype)
         v0 = z if value0 is None else jnp.asarray(value0, dtype)
         p0 = z if progress0 is None else jnp.asarray(progress0, dtype)
-        t0 = time.time()
+        t0 = now()
         V, P = _rtdp_loop(Tdst, Tpack, start_cdf, key, self.n_states,
                           self.n_actions, steps, batch,
                           jnp.asarray(eps, dtype),
                           jnp.asarray(discount, dtype), v0, p0)
         return dict(rtdp_value=np.asarray(V), rtdp_progress=np.asarray(P),
                     rtdp_steps=steps, rtdp_batch=batch,
-                    rtdp_time=time.time() - t0)
+                    rtdp_time=now() - t0)
 
     # -- start-state aggregates -------------------------------------------
 
@@ -695,7 +696,7 @@ class TensorMDP:
     def steady_state(self, policy, *, start_state):
         """Stationary distribution of the policy-induced chain via a sparse
         least-norm solve (mdp/lib/explicit_mdp.py:252-326)."""
-        t0 = time.time()
+        t0 = now()
         mc = self.markov_chain(policy, start_state=start_state)
         prb = mc["prb"]
         n = prb.shape[0]
@@ -716,4 +717,4 @@ class TensorMDP:
             ss[mdp_s] = v[mc_s]
         return dict(ss=ss, ss_reachable=n,
                     ss_nonzero=int((v != 0).sum()),
-                    ss_time=time.time() - t0)
+                    ss_time=now() - t0)

@@ -22,8 +22,6 @@ slices; the mesh is the only seam.
 
 from __future__ import annotations
 
-import time
-
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -34,6 +32,20 @@ from functools import partial
 from cpr_tpu.mdp.explicit import (TensorMDP, _valid_actions,
                                   make_vi_chunk, resolve_vi_impl,
                                   run_chunk_driver, vi_while_loop)
+from cpr_tpu.telemetry import now
+
+
+def _shard_map(body, *, mesh, in_specs, out_specs, check_vma=True):
+    """jax.shard_map across jax versions: the public API (>= 0.6) takes
+    `check_vma`; on older jax the function lives in jax.experimental and
+    the same knob is spelled `check_rep`."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(body, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map
+
+    return shard_map(body, mesh=mesh, in_specs=in_specs,
+                     out_specs=out_specs, check_rep=check_vma)
 
 __all__ = [
     "default_mesh",
@@ -117,7 +129,7 @@ def sharded_value_iteration(tm: TensorMDP, mesh: Mesh, *, axis: str = "d",
         discount=discount, eps=eps, stop_delta=stop_delta, max_iter=max_iter)
     tm._check_segment_width()
     impl = resolve_vi_impl(impl)
-    t0 = time.time()
+    t0 = now()
     n = mesh.shape[axis]
     S, A = tm.n_states, tm.n_actions
     pad = (-tm.src.shape[0]) % n
@@ -139,7 +151,7 @@ def sharded_value_iteration(tm: TensorMDP, mesh: Mesh, *, axis: str = "d",
                 stop_delta, max_iter_,
                 reduce=lambda x: jax.lax.psum(x, axis))
 
-        return jax.shard_map(
+        return _shard_map(
             body, mesh=mesh,
             in_specs=(P(axis),) * 6,
             out_specs=(P(),) * 5,
@@ -161,7 +173,7 @@ def sharded_value_iteration(tm: TensorMDP, mesh: Mesh, *, axis: str = "d",
                     src, act, dst, prob, reward, progress, valid,
                     any_valid, discount, value, prog, steps)
 
-            return jax.shard_map(
+            return _shard_map(
                 body, mesh=mesh,
                 in_specs=(P(axis),) * 6 + (P(), P()),
                 out_specs=(P(),) * 4,
@@ -184,5 +196,5 @@ def sharded_value_iteration(tm: TensorMDP, mesh: Mesh, *, axis: str = "d",
         vi_progress=np.asarray(progress_v),
         vi_iter=int(it),
         vi_max_iter=max_iter,
-        vi_time=time.time() - t0,
+        vi_time=now() - t0,
     )

@@ -1,0 +1,301 @@
+"""JAX-aware runtime telemetry: spans, run manifests, profiler capture.
+
+The reference ships structured *causal* logs (simulator/lib/log.ml —
+mirrored by `cpr_tpu/trace.py`); this module is the *runtime* side the
+reference never needed: on an async-dispatch backend a `time.time()`
+bracket around a kernel call measures dispatch, not execution, and a
+bench artifact without backend/device/window metadata cannot be compared
+against its siblings (the BENCH_r05 CPU-fallback row read as a 306x
+regression because nothing in it said "chip outage").
+
+Three pieces, all host-side and dependency-free at import time:
+
+* `Span` — a context-manager timer on `time.perf_counter` that FENCES
+  with `jax.block_until_ready` on the values registered via
+  `span.fence(...)`, so device work is attributed to the span that
+  launched it, not to whichever later host line happens to block.
+  Spans nest (events carry `path`/`depth`), carry counters
+  (`env_steps=...`), and emit one JSONL event each with derived
+  per-second throughput.
+
+* `run_manifest()` — a self-describing snapshot of the run: backend,
+  device kind/count, `memory_stats()`, jax/jaxlib versions, git SHA,
+  host, argv, and the resolved config (window/ring settings etc.).
+  Every BENCH_* row, sweep, and training run attaches one so artifacts
+  can never be misread out of context.
+
+* `maybe_profile()` — opt-in `jax.profiler` trace capture gated by the
+  `CPR_PROFILE_DIR` env var, replacing the per-tool profiling
+  boilerplate (tools/tpu_profile_env.py and friends).
+
+Event stream: one JSON object per line.  `configure(path)` opens a
+sink explicitly; otherwise the `CPR_TELEMETRY` env var names the file
+and `current()` lazily opens it.  With no sink configured, spans still
+time (drivers read `span.dur_s`) but emit nothing — the disabled path
+is two `perf_counter` calls, well under the <2% overhead budget on the
+nakamoto CPU bench config.
+
+Interval timing anywhere under `cpr_tpu/` must go through `now()` (=
+`time.perf_counter`) or `Span` — never `time.time()`, which is neither
+monotonic nor high-resolution (tests/test_observability.py enforces
+this repo-wide).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+from contextlib import contextmanager
+from datetime import datetime, timezone
+from time import perf_counter as now  # noqa: F401 — re-exported
+
+SCHEMA_VERSION = 1
+TELEMETRY_ENV_VAR = "CPR_TELEMETRY"
+PROFILE_ENV_VAR = "CPR_PROFILE_DIR"
+
+# every span event carries at least these keys (tools/trace_summary.py
+# --validate and the schema tests check against this tuple)
+SPAN_KEYS = ("kind", "name", "path", "depth", "t_start", "t_end",
+             "dur_s")
+
+
+class Span:
+    """One timed region.  Use via `Telemetry.span`:
+
+        with tele.span("measure", env_steps=n) as sp:
+            out = sp.fence(fn(keys))
+
+    On exit the fenced values are passed to `jax.block_until_ready`
+    BEFORE the end timestamp is read, so asynchronously dispatched
+    device work lands inside this span.  Counters become `per_sec`
+    rates in the emitted event.
+    """
+
+    def __init__(self, tele: "Telemetry", name: str, counters: dict):
+        self._tele = tele
+        self.name = name
+        self.counters = dict(counters)
+        self._fenced: list = []
+        self.path = name
+        self.depth = 0
+        self.t_start = self.t_end = self.dur_s = None
+
+    def fence(self, value):
+        """Register a (pytree of) device value(s) to block on at span
+        exit; returns `value` so call sites stay one-liners."""
+        self._fenced.append(value)
+        return value
+
+    def add(self, **counters):
+        """Accumulate counters (e.g. env steps across reps)."""
+        for k, v in counters.items():
+            self.counters[k] = self.counters.get(k, 0) + v
+
+    def __enter__(self):
+        stack = self._tele._stack
+        self.depth = len(stack)
+        self.path = "/".join([s.name for s in stack] + [self.name])
+        stack.append(self)
+        self.t_start = now()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        # on an exception the fenced values may be bogus — skip the
+        # fence (the event still records the failure), else block so
+        # async device work is attributed here
+        if exc_type is None and self._fenced:
+            import jax
+
+            jax.block_until_ready(self._fenced)
+        self.t_end = now()
+        self.dur_s = self.t_end - self.t_start
+        if self._tele._stack and self._tele._stack[-1] is self:
+            self._tele._stack.pop()
+        event = {
+            "kind": "span", "name": self.name, "path": self.path,
+            "depth": self.depth, "t_start": self.t_start,
+            "t_end": self.t_end, "dur_s": self.dur_s,
+        }
+        if self.counters:
+            event["counters"] = self.counters
+            if self.dur_s > 0:
+                event["per_sec"] = {
+                    k: v / self.dur_s for k, v in self.counters.items()
+                    if isinstance(v, (int, float))}
+        if exc_type is not None:
+            event["error"] = f"{exc_type.__name__}: {exc}"
+        self._tele.emit(event)
+        return False
+
+
+class Telemetry:
+    """A JSONL event sink plus the span-nesting stack.  `path=None`
+    disables emission (spans still time)."""
+
+    def __init__(self, path: str | None = None, stream=None):
+        self.path = path
+        self._own = stream is None and path is not None
+        self._sink = stream if stream is not None else (
+            open(path, "a") if path else None)
+        self._stack: list[Span] = []
+
+    @property
+    def enabled(self) -> bool:
+        return self._sink is not None
+
+    def emit(self, event: dict):
+        """Write one event line (no-op when disabled).  Flushed per
+        event: telemetry exists for post-mortems, a crash must not eat
+        the tail of the stream."""
+        if self._sink is None:
+            return
+        self._sink.write(json.dumps(event, default=str) + "\n")
+        self._sink.flush()
+
+    def span(self, name: str, **counters) -> Span:
+        return Span(self, name, counters)
+
+    def event(self, name: str, **fields):
+        """Point event (outages, reverts, phase markers)."""
+        self.emit({"kind": "event", "name": name, "ts": now(), **fields})
+
+    def manifest(self, config: dict | None = None) -> dict:
+        """Emit (and return) a run manifest."""
+        man = run_manifest(config)
+        self.emit(man)
+        return man
+
+    def close(self):
+        if self._sink is not None and self._own:
+            self._sink.close()
+        self._sink = None
+
+
+_NULL = Telemetry()
+_default: Telemetry | None = None
+
+
+def configure(path: str | None = None, stream=None) -> Telemetry:
+    """Install the process-wide default sink (closes any previous one).
+    `configure(None)` disables emission."""
+    global _default
+    if _default is not None and _default is not _NULL:
+        _default.close()
+    _default = Telemetry(path, stream)
+    return _default
+
+
+def current() -> Telemetry:
+    """The default telemetry: the configured sink, else one lazily
+    opened from $CPR_TELEMETRY, else a disabled instance."""
+    global _default
+    if _default is None:
+        path = os.environ.get(TELEMETRY_ENV_VAR)
+        _default = Telemetry(path) if path else _NULL
+    return _default
+
+
+# -- run manifests -----------------------------------------------------------
+
+
+def git_sha() -> str | None:
+    """HEAD SHA of this checkout, or None outside a work tree."""
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "HEAD"],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True, text=True, timeout=10)
+        if out.returncode == 0:
+            return out.stdout.strip()
+    except Exception:  # noqa: BLE001 — manifests are best-effort metadata
+        pass
+    return None
+
+
+_MEM_KEYS = ("bytes_in_use", "peak_bytes_in_use", "bytes_limit",
+             "largest_free_block_bytes")
+
+
+def device_memory_stats() -> dict | None:
+    """Per-device allocator stats (subset of memory_stats keys), or None
+    when the backend exposes none (XLA:CPU)."""
+    import jax
+
+    out = {}
+    for d in jax.devices():
+        try:
+            ms = d.memory_stats()
+        except Exception:  # noqa: BLE001 — not all backends implement it
+            ms = None
+        if ms:
+            out[f"{d.platform}:{d.id}"] = {
+                k: int(ms[k]) for k in _MEM_KEYS if k in ms}
+    return out or None
+
+
+def run_manifest(config: dict | None = None) -> dict:
+    """Self-describing snapshot of this process's runtime: enough that
+    an artifact row can be interpreted with no other context (backend,
+    devices, versions, git SHA, resolved config)."""
+    man: dict = {
+        "kind": "manifest",
+        "schema": SCHEMA_VERSION,
+        "time_utc": datetime.now(timezone.utc).isoformat(
+            timespec="seconds"),
+        "argv": list(sys.argv),
+        "hostname": socket.gethostname(),
+        "python": sys.version.split()[0],
+        "git_sha": git_sha(),
+    }
+    try:
+        import jax
+        import jaxlib
+
+        devs = jax.devices()
+        man["backend"] = devs[0].platform
+        man["device_kind"] = devs[0].device_kind
+        man["device_count"] = len(devs)
+        man["process_count"] = jax.process_count()
+        man["jax_version"] = jax.__version__
+        man["jaxlib_version"] = jaxlib.__version__
+        mem = device_memory_stats()
+        if mem:
+            man["memory_before"] = mem
+    except Exception as e:  # noqa: BLE001 — a manifest must never kill a run
+        man["jax_error"] = repr(e)
+    if config is not None:
+        man["config"] = config
+    return man
+
+
+# -- profiler capture --------------------------------------------------------
+
+
+@contextmanager
+def profile_trace(trace_dir: str):
+    """Explicit `jax.profiler` capture into `trace_dir` (the chrome
+    trace + xplane files land under it)."""
+    import jax
+
+    os.makedirs(trace_dir, exist_ok=True)
+    with jax.profiler.trace(trace_dir):
+        yield trace_dir
+
+
+@contextmanager
+def maybe_profile(label: str = ""):
+    """Opt-in profiler capture: no-op unless $CPR_PROFILE_DIR is set, in
+    which case the trace lands under `$CPR_PROFILE_DIR/<label>`.  Yields
+    the trace dir or None — the shared replacement for the copy-pasted
+    per-tool `jax.profiler.trace` boilerplate."""
+    base = os.environ.get(PROFILE_ENV_VAR)
+    if not base:
+        yield None
+        return
+    dest = os.path.join(base, label) if label else base
+    with profile_trace(dest):
+        current().event("profile_capture", trace_dir=dest, label=label)
+        yield dest

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import json
 import os
+from contextlib import nullcontext
 from typing import Callable
 
 import jax
@@ -20,6 +21,7 @@ import jax.numpy as jnp
 import numpy as np
 from flax import serialization
 
+from cpr_tpu import telemetry
 from cpr_tpu.envs.registry import get_sized
 from cpr_tpu.envs.assumption import AssumptionEnv
 from cpr_tpu.params import stack_params
@@ -213,29 +215,56 @@ def train_from_config(cfg: TrainConfig, *, out_dir: str | None = None,
     history, eval_rows, best = [], [], -np.inf
     best_params = None
     metrics_log = None
+    tele = telemetry.current()
+    steps_per_update = cfg.n_envs * pcfg.n_steps
+    manifest = telemetry.run_manifest(config=dict(
+        protocol=cfg.protocol, seed=cfg.seed, n_envs=cfg.n_envs,
+        episode_len=cfg.episode_len, reward=cfg.reward,
+        n_steps=pcfg.n_steps, total_updates=total))
     if out_dir is not None:
         os.makedirs(out_dir, exist_ok=True)
+        # self-describing run dir: the manifest rides both as its own
+        # file and in the metrics header, so a copied-out metrics.jsonl
+        # still says what backend/config produced it
+        with open(os.path.join(out_dir, "manifest.json"), "w") as f:
+            json.dump(manifest, f, indent=2)
         # JSONL metrics stream (the W&B-run-log analog, ppo.py:180-193):
         # one line per update, eval rows tagged; a header line separates
         # runs appended into the same directory
         metrics_log = open(os.path.join(out_dir, "metrics.jsonl"), "a")
         metrics_log.write(json.dumps(
             {"run": True, "protocol": cfg.protocol, "seed": cfg.seed,
-             "total_updates": total}) + "\n")
+             "total_updates": total, "manifest": manifest}) + "\n")
+        metrics_log.flush()
     try:
         for i in range(total):
-            carry, metrics = step(carry)
-            m = {k: float(v) for k, v in metrics.items()}
+            # CPR_PROFILE_DIR captures ONE warm update (the second: the
+            # first pays compile) instead of the whole run
+            prof = (telemetry.maybe_profile("train_update")
+                    if i == 1 else nullcontext())
+            with prof, tele.span("update",
+                                 env_steps=steps_per_update) as sp:
+                carry, metrics = step(carry)
+                sp.fence(carry)
+                m = {k: float(v) for k, v in metrics.items()}
+            m["wall_s"] = round(sp.dur_s, 6)
+            if sp.dur_s > 0:
+                m["steps_per_sec"] = round(steps_per_update / sp.dur_s)
             history.append(m)
             if metrics_log is not None:
                 metrics_log.write(json.dumps({"update": i + 1, **m}) + "\n")
+                # flushed per update: a crash must not eat the stream's
+                # tail (pre-telemetry, unflushed rows were only safe at
+                # eval points)
+                metrics_log.flush()
             if progress is not None:
                 progress(i, m)
             # the first start_at_iteration updates never evaluate (early
             # deterministic policies are degenerate — cfg_model rationale)
             due = (i + 1) % cfg.eval.freq == 0 or i + 1 == total
             if due and i + 1 > cfg.eval.start_at_iteration:
-                rows = evaluate_per_alpha(env, cfg, carry[0].params)
+                with tele.span("eval"):
+                    rows = evaluate_per_alpha(env, cfg, carry[0].params)
                 for r in rows:
                     r["update"] = i + 1
                 eval_rows.extend(rows)
@@ -274,6 +303,8 @@ def train_from_config(cfg: TrainConfig, *, out_dir: str | None = None,
                         params=best_params,
                         opt_state=ts.tx.init(best_params))
                     carry = (ts,) + tuple(carry[1:])
+                    tele.event("revert", update=i + 1, score=score,
+                               best=best)
                     if metrics_log is not None:
                         metrics_log.write(json.dumps(
                             {"revert": True, "update": i + 1,

@@ -23,6 +23,7 @@ from flax import linen as nn
 from flax import struct
 from flax.training.train_state import TrainState
 
+from cpr_tpu import telemetry
 from cpr_tpu.envs.base import JaxEnv
 from cpr_tpu.params import EnvParams
 
@@ -307,9 +308,17 @@ def train(env, env_params, cfg: PPOConfig, *, n_updates: int, seed: int = 0,
         carry = (ts, env_state, obs, key)
     step = jax.jit(train_step)
     history = []
+    tele = telemetry.current()
+    steps_per_update = cfg.n_envs * cfg.n_steps
     for i in range(n_updates):
-        carry, metrics = step(carry)
-        host_metrics = {k: float(v) for k, v in metrics.items()}
+        with tele.span("update", env_steps=steps_per_update) as sp:
+            carry, metrics = step(carry)
+            sp.fence(carry)
+            host_metrics = {k: float(v) for k, v in metrics.items()}
+        host_metrics["wall_s"] = round(sp.dur_s, 6)
+        if sp.dur_s > 0:
+            host_metrics["steps_per_sec"] = round(
+                steps_per_update / sp.dur_s)
         if progress is not None:
             progress(i, host_metrics)
         history.append(host_metrics)

@@ -1,20 +1,33 @@
 """Observability tests: DAG exports, causal traces, malformed-DAG dumps,
-and the difficulty-adjustment convergence loop.
+the difficulty-adjustment convergence loop, and the runtime telemetry
+layer (spans, manifests, bench outage tagging).
 
 Reference counterparts: log.ml GraphLogger export, dagtools.ml dot/
 GraphML serializers and Exn dump hook, and gym/ocaml/test/test_daa.py.
+The telemetry half has no reference counterpart — it exists because
+async dispatch and chip outages are TPU-runtime problems the event-loop
+simulator never had.
 """
 
 import collections
+import importlib.util
+import json
+import os
+import re
+import sys
+import time
+import tokenize
 from xml.etree import ElementTree as ET
 
 import jax
 import numpy as np
 import pytest
 
-from cpr_tpu import trace
+from cpr_tpu import telemetry, trace
 from cpr_tpu.native import OracleSim
 from cpr_tpu.params import make_params
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 
 def test_env_state_dag_export():
@@ -106,3 +119,188 @@ def test_daa_convergence():
         prs.append(pr)
     observed = float(np.sum(cts) / np.sum(prs))
     assert target - eps < observed < target + eps, observed
+
+
+# -- runtime telemetry (cpr_tpu/telemetry.py) --------------------------------
+
+
+def _events(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f]
+
+
+def test_span_events_parse_and_schema_complete(tmp_path):
+    """Every span event is one JSON line carrying all SPAN_KEYS, with
+    correct nesting (path/depth) and contained, monotonic timestamps."""
+    path = tmp_path / "tele.jsonl"
+    tele = telemetry.Telemetry(str(path))
+    with tele.span("outer", env_steps=100) as outer:
+        with tele.span("inner"):
+            pass
+    tele.event("marker", detail=1)
+    tele.close()
+
+    events = _events(path)  # every line parses
+    spans = [e for e in events if e["kind"] == "span"]
+    assert len(spans) == 2
+    for e in spans:
+        assert all(k in e for k in telemetry.SPAN_KEYS), e
+    inner, outer_ev = spans  # inner exits (and emits) first
+    assert inner["name"] == "inner"
+    assert inner["path"] == "outer/inner" and inner["depth"] == 1
+    assert outer_ev["path"] == "outer" and outer_ev["depth"] == 0
+    # the child's interval nests inside the parent's, all monotonic
+    assert (outer_ev["t_start"] <= inner["t_start"] <= inner["t_end"]
+            <= outer_ev["t_end"])
+    assert outer_ev["dur_s"] == pytest.approx(
+        outer_ev["t_end"] - outer_ev["t_start"])
+    # counters surface as derived rates
+    assert outer_ev["counters"] == {"env_steps": 100}
+    assert outer_ev["per_sec"]["env_steps"] == pytest.approx(
+        100 / outer_ev["dur_s"])
+    assert outer.dur_s == outer_ev["dur_s"]
+    marker = [e for e in events if e["kind"] == "event"]
+    assert marker and marker[0]["name"] == "marker"
+
+
+def test_span_records_error_and_unwinds_stack(tmp_path):
+    path = tmp_path / "tele.jsonl"
+    tele = telemetry.Telemetry(str(path))
+    with pytest.raises(ValueError):
+        with tele.span("boom"):
+            raise ValueError("kaput")
+    tele.close()
+    (ev,) = _events(path)
+    assert ev["error"] == "ValueError: kaput"
+    assert tele._stack == []  # the failed span did not leak nesting
+
+
+def test_manifest_backend_devices_git_sha():
+    man = telemetry.run_manifest(config={"n_envs": 4})
+    assert man["kind"] == "manifest"
+    assert man["schema"] == telemetry.SCHEMA_VERSION
+    assert man["backend"] == "cpu"  # conftest forces the CPU mesh
+    assert man["device_count"] == len(jax.devices())
+    assert man["device_kind"] and man["jax_version"]
+    assert re.fullmatch(r"[0-9a-f]{40}", man["git_sha"])
+    assert man["config"] == {"n_envs": 4}
+
+
+def test_span_fences_async_dispatch():
+    """Device work still in flight at span exit must land INSIDE the
+    span.  jax.block_until_ready blocks on any leaf exposing
+    block_until_ready(), so a leaf that 'completes' ~50ms late is a
+    deterministic stand-in for async dispatch: a fenced span absorbs
+    the wait, an unfenced one exits immediately."""
+
+    class SlowLeaf:
+        def block_until_ready(self):
+            time.sleep(0.05)
+            return self
+
+    tele = telemetry.Telemetry()  # disabled sink; spans still time
+    with tele.span("fenced") as sp:
+        out = sp.fence({"stats": SlowLeaf()})
+    assert isinstance(out["stats"], SlowLeaf)  # passthrough
+    assert sp.dur_s >= 0.05
+    with tele.span("unfenced") as sp:
+        SlowLeaf()
+    assert sp.dur_s < 0.05
+
+
+def test_current_reads_env_var(tmp_path, monkeypatch):
+    path = tmp_path / "env.jsonl"
+    monkeypatch.setattr(telemetry, "_default", None)
+    monkeypatch.setenv(telemetry.TELEMETRY_ENV_VAR, str(path))
+    try:
+        tele = telemetry.current()
+        assert tele.enabled
+        with tele.span("s"):
+            pass
+        assert _events(path)[0]["name"] == "s"
+    finally:
+        telemetry.configure(None)  # don't leak a sink into other tests
+
+
+def test_bench_fallback_rows_carry_outage_fields():
+    """VERDICT weak #1: a CPU-fallback row must say it IS a fallback
+    and what the chip last measured, so a 306x 'regression' reads as an
+    outage.  The banked BENCH_r*.json artifacts in the repo root are
+    the fixture."""
+    import bench
+
+    fields = bench._outage_fields("tpu watchdog timeout after 360s",
+                                  "nakamoto_selfish_mining")
+    assert fields["outage"] is True
+    assert "watchdog" in fields["fallback_reason"]
+    last = fields["last_known_tpu"]
+    assert last is not None, "banked TPU rows exist for the headline"
+    assert last["value"] > 0 and last["unit"]
+    assert re.match(r"BENCH.*\.json", last["source"])
+    assert last["round"] >= 4  # r04 banked the first headline TPU row
+    # a metric never measured on chip degrades to an honest null
+    none = bench._outage_fields("boom", "no_such_metric_prefix")
+    assert none["outage"] is True and none["last_known_tpu"] is None
+
+
+def test_no_wall_clock_interval_timing_in_package():
+    """Interval timing under cpr_tpu/ must use telemetry.now (monotonic
+    perf_counter) or Span — never time.time().  Docstrings/comments may
+    mention the forbidden call (telemetry.py's own policy text does),
+    so only code tokens count."""
+    root = os.path.join(os.path.dirname(__file__), "..", "cpr_tpu")
+    offenders = []
+    for dirpath, _dirs, files in os.walk(root):
+        for fn in sorted(files):
+            if not fn.endswith(".py"):
+                continue
+            p = os.path.join(dirpath, fn)
+            with open(p, "rb") as f:
+                toks = tokenize.tokenize(f.readline)
+                code = " ".join(t.string for t in toks if t.type
+                                not in (tokenize.STRING,
+                                        tokenize.COMMENT))
+            if re.search(r"\btime\s*\.\s*time\s*\(", code):
+                offenders.append(os.path.relpath(p, root))
+    assert not offenders, offenders
+
+
+def _load_trace_summary():
+    path = os.path.join(os.path.dirname(__file__), "..", "tools",
+                        "trace_summary.py")
+    spec = importlib.util.spec_from_file_location("trace_summary", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_trace_summary_validate(tmp_path, capsys):
+    """The artifact validator behind `make telemetry-smoke`: a stream
+    written by the telemetry layer passes; truncated spans and
+    manifest-less streams fail with a nonzero exit."""
+    ts = _load_trace_summary()
+    good = tmp_path / "good.jsonl"
+    tele = telemetry.Telemetry(str(good))
+    with tele.span("compile"):
+        pass
+    with tele.span("measure", env_steps=64):
+        pass
+    tele.manifest(config={"metric": "nakamoto_sm1"})
+    tele.close()
+    events, bad = ts.read_events(str(good))
+    assert ts.validate(events, bad) == []
+    ts.main(["trace_summary", str(good), "--validate"])  # exits 0
+    out = capsys.readouterr().out
+    assert "compile" in out and "env_steps" in out
+
+    lame = tmp_path / "lame.jsonl"
+    lame.write_text(json.dumps({"kind": "span", "name": "x"}) + "\n"
+                    "not json\n")
+    events, bad = ts.read_events(str(lame))
+    errors = ts.validate(events, bad)
+    assert any("missing" in e for e in errors)
+    assert any("not JSON" in e for e in errors)
+    assert any("manifest" in e for e in errors)
+    with pytest.raises(SystemExit) as exc:
+        ts.main(["trace_summary", str(lame), "--validate"])
+    assert exc.value.code == 1

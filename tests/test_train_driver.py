@@ -10,6 +10,7 @@ import json
 
 import jax
 import numpy as np
+import pytest
 
 
 def test_driver_revert_restores_best_params(monkeypatch, tmp_path):
@@ -55,3 +56,49 @@ def test_driver_revert_restores_best_params(monkeypatch, tmp_path):
     for a, b in zip(jax.tree_util.tree_leaves(best_seen),
                     jax.tree_util.tree_leaves(params)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_driver_metrics_stream_is_observable(monkeypatch, tmp_path):
+    """Every update row in metrics.jsonl carries the span-derived
+    wall_s/steps_per_sec, the header line (and manifest.json) embeds
+    the run manifest, and the stream is flushed per update — a reader
+    polling the file mid-run sees every completed update, not just the
+    eval-point batches."""
+    from cpr_tpu.train import driver as drv
+    from cpr_tpu.train.config import TrainConfig
+
+    seen_on_disk = []
+
+    def fake_eval(env, cfg, net_params, **kw):
+        # runs at the last update, AFTER its row was written: whatever
+        # is on disk now proves the per-update flush
+        with open(tmp_path / "metrics.jsonl") as f:
+            seen_on_disk.extend(json.loads(ln) for ln in f)
+        return [dict(alpha=0.4, gamma=0.5, relative_reward=0.3,
+                     reward_per_progress=0.3, episode_progress=1.0)]
+
+    monkeypatch.setattr(drv, "evaluate_per_alpha", fake_eval)
+    cfg = TrainConfig(
+        protocol="nakamoto", alpha=0.4, episode_len=16, n_envs=8,
+        total_updates=2,
+        ppo=dict(n_steps=8, n_minibatches=2, update_epochs=1, lr=1e-3),
+        eval=dict(freq=2, start_at_iteration=0))
+    drv.train_from_config(cfg, out_dir=str(tmp_path), n_updates=2)
+
+    manifest = json.load(open(tmp_path / "manifest.json"))
+    assert manifest["backend"] == "cpu"
+    assert manifest["config"]["protocol"] == "nakamoto"
+
+    lines = [json.loads(ln) for ln in open(tmp_path / "metrics.jsonl")]
+    header = lines[0]
+    assert header["run"] is True
+    assert header["manifest"]["backend"] == "cpu"  # copied-out files
+    updates = [ln for ln in lines if "update" in ln and "entropy" in ln]
+    assert len(updates) == 2
+    for u in updates:
+        assert u["wall_s"] > 0
+        # rate derived from the fenced span over this update's steps
+        assert u["steps_per_sec"] == pytest.approx(
+            8 * 8 / u["wall_s"], rel=0.05)
+    # both update rows (plus the header) were flushed BEFORE eval ran
+    assert len(seen_on_disk) >= 3

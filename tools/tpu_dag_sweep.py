@@ -20,6 +20,9 @@ import time
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 ".."))
 
+from cpr_tpu import telemetry  # noqa: E402
+from cpr_tpu.telemetry import now  # noqa: E402
+
 
 def log(msg):
     print(f"[{time.strftime('%H:%M:%S')}] {msg}", file=sys.stderr,
@@ -32,23 +35,24 @@ def measure_env(env, policy_name, n_envs, n_steps, max_steps, chunk, reps=2):
 
     from cpr_tpu.params import make_params
 
+    tele = telemetry.current()
     params = make_params(alpha=0.35, gamma=0.5, max_steps=max_steps)
     policy = env.policies[policy_name]
     keys = jax.random.split(jax.random.PRNGKey(0), n_envs)
-    t0 = time.time()
+    t0 = now()
     fn = env.make_episode_stats_fn(params, policy, n_steps, chunk=chunk)
-    log(f"built fn in {time.time() - t0:.1f}s; compiling "
+    log(f"built fn in {now() - t0:.1f}s; compiling "
         f"(n_envs={n_envs} n_steps={n_steps} chunk={chunk} "
         f"capacity={env.capacity})")
-    t0 = time.time()
-    stats = jax.block_until_ready(fn(keys))
-    compile_s = time.time() - t0
+    with tele.span("sweep_compile") as sp:
+        stats = sp.fence(fn(keys))
+    compile_s = sp.dur_s
     log(f"compile+first run {compile_s:.1f}s")
     rep_s = []
     for r in range(reps):
-        t0 = time.time()
-        stats = jax.block_until_ready(fn(keys))
-        rep_s.append(time.time() - t0)
+        with tele.span("sweep_rep", env_steps=n_envs * n_steps) as sp:
+            stats = sp.fence(fn(keys))
+        rep_s.append(sp.dur_s)
         log(f"rep {r}: {rep_s[-1]:.1f}s "
             f"({n_envs * n_steps / rep_s[-1]:.0f} steps/s)")
     atk = np.asarray(stats["episode_reward_attacker"]).mean()
@@ -100,19 +104,21 @@ def main():
         params = make_params(alpha=0.35, gamma=0.5, max_steps=120)
         cfg = PPOConfig(n_envs=n_envs, n_steps=rollout)
         init_fn, train_step = make_train(env, params, cfg)
-        t0 = time.time()
-        carry = jax.jit(init_fn)(jax.random.PRNGKey(0))
-        step = jax.jit(train_step)
-        carry, _ = step(carry)
-        jax.block_until_ready(carry)
-        compile_s = time.time() - t0
+        tele = telemetry.current()
+        with tele.span("sweep_compile") as sp:
+            carry = jax.jit(init_fn)(jax.random.PRNGKey(0))
+            step = jax.jit(train_step)
+            carry, _ = step(carry)
+            sp.fence(carry)
+        compile_s = sp.dur_s
         log(f"compile+first {compile_s:.1f}s")
         rep_ts = []
         for r in range(2):
-            t0 = time.time()
-            carry, metrics = step(carry)
-            jax.block_until_ready(carry)
-            rep_ts.append(time.time() - t0)
+            with tele.span("sweep_rep",
+                           env_steps=n_envs * rollout) as sp:
+                carry, metrics = step(carry)
+                sp.fence(carry)
+            rep_ts.append(sp.dur_s)
             log(f"rep {r}: {rep_ts[-1]:.1f}s "
                 f"({n_envs * rollout / rep_ts[-1]:.0f} steps/s)")
         rep_s = min(rep_ts)
@@ -127,6 +133,10 @@ def main():
         "capacity": env.capacity, "steps_per_sec": round(rate),
         "check": round(float(check), 4), "compile_s": round(compile_s, 1),
         "rep_s": round(rep_s, 1),
+        # full provenance so a banked sweep row is self-describing
+        "manifest": telemetry.run_manifest(config=dict(
+            config=config, n_envs=n_envs, n_steps=n_steps,
+            chunk=chunk or None, window=window or 0)),
     }), flush=True)
 
 

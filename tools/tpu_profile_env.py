@@ -20,6 +20,9 @@ import time
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 ".."))
 
+from cpr_tpu import telemetry  # noqa: E402
+from cpr_tpu.telemetry import now  # noqa: E402
+
 
 def log(msg):
     print(f"[{time.strftime('%H:%M:%S')}] {msg}", file=sys.stderr,
@@ -121,22 +124,25 @@ def main():
         8192 if config == "bk" else 4096)
     top_n = int(sys.argv[3]) if len(sys.argv) > 3 else 40
 
-    import jax
-
     fn, keys, n_steps = build(config, n_envs)
+    tele = telemetry.current()
     log(f"compiling {config} n_envs={n_envs}")
-    t0 = time.time()
+    t0 = now()
     fetch(fn(keys) if keys is not None else fn(None))
-    log(f"compile+first {time.time() - t0:.1f}s; warm rep...")
-    t0 = time.time()
-    fetch(fn(keys) if keys is not None else fn(None))
-    dt = time.time() - t0
+    log(f"compile+first {now() - t0:.1f}s; warm rep...")
+    with tele.span("profile_warm_rep",
+                   env_steps=n_envs * n_steps) as sp:
+        sp.fence(fn(keys) if keys is not None else fn(None))
+    dt = sp.dur_s
     log(f"warm rep {dt:.2f}s = {n_envs * n_steps / dt:,.0f} steps/s")
 
-    trace_dir = os.environ.get("CPR_TRACE_DIR") or tempfile.mkdtemp(
-        prefix=f"trace_{config}_")
+    # CPR_PROFILE_DIR (the telemetry-wide knob) wins over the legacy
+    # CPR_TRACE_DIR this tool grew first
+    trace_dir = (os.environ.get(telemetry.PROFILE_ENV_VAR)
+                 or os.environ.get("CPR_TRACE_DIR")
+                 or tempfile.mkdtemp(prefix=f"trace_{config}_"))
     log(f"tracing into {trace_dir}")
-    with jax.profiler.trace(trace_dir):
+    with telemetry.profile_trace(trace_dir):
         fetch(fn(keys) if keys is not None else fn(None))
     summarize(trace_dir, top_n)
 

@@ -1,0 +1,123 @@
+"""Summarize (and validate) a cpr_tpu telemetry JSONL stream.
+
+Reads the event file written via `CPR_TELEMETRY=<path>` (or
+`cpr_tpu.telemetry.configure`), prints per-span aggregates — calls,
+total/mean wall time, share of the total — and a throughput table for
+spans carrying counters (env_steps etc.), plus any manifests and
+outage/revert events.  The post-mortem half of the telemetry layer:
+`bench.py`, the training driver, and the sweeps write the stream; this
+reads it back without re-running anything.
+
+`--validate` additionally checks the artifact is schema-complete
+(every span event carries the SPAN_KEYS, timestamps are monotonic
+non-negative intervals, at least one manifest names its backend) and
+exits nonzero otherwise — `make telemetry-smoke` runs a tiny bench and
+asserts through this mode.
+
+Usage: python tools/trace_summary.py <telemetry.jsonl> [--validate]
+"""
+
+import json
+import os
+import sys
+from collections import defaultdict
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+from cpr_tpu.telemetry import SPAN_KEYS  # noqa: E402
+
+
+def read_events(path):
+    events, bad = [], []
+    with open(path) as f:
+        for i, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                events.append(json.loads(line))
+            except ValueError as e:
+                bad.append(f"line {i}: not JSON ({e})")
+    return events, bad
+
+
+def validate(events, bad):
+    """Schema-completeness errors for `--validate` (empty list = ok)."""
+    errors = list(bad)
+    if not events:
+        errors.append("empty event stream")
+    for i, e in enumerate(events, 1):
+        if not isinstance(e, dict) or "kind" not in e:
+            errors.append(f"event {i}: no 'kind'")
+            continue
+        if e["kind"] == "span":
+            missing = [k for k in SPAN_KEYS if k not in e]
+            if missing:
+                errors.append(f"event {i}: span missing {missing}")
+            elif not (0 <= e["t_start"] <= e["t_end"]
+                      and abs((e["t_end"] - e["t_start"]) - e["dur_s"])
+                      < 1e-6 + 1e-9 * abs(e["dur_s"])):
+                errors.append(f"event {i}: non-monotonic span timestamps")
+    manifests = [e for e in events if e.get("kind") == "manifest"]
+    if not any(m.get("backend") for m in manifests):
+        errors.append("no manifest with a backend field")
+    return errors
+
+
+def summarize(events, out=sys.stdout):
+    spans = [e for e in events if e.get("kind") == "span"]
+    agg = defaultdict(lambda: [0, 0.0])  # path -> [calls, total_s]
+    rates = defaultdict(lambda: defaultdict(lambda: [0.0, 0.0]))
+    for s in spans:
+        a = agg[s.get("path", s.get("name", "?"))]
+        a[0] += 1
+        a[1] += s.get("dur_s", 0.0)
+        for k, v in (s.get("counters") or {}).items():
+            r = rates[s.get("path", "?")][k]
+            r[0] += v
+            r[1] += s.get("dur_s", 0.0)
+    total = sum(a[1] for a in agg.values()) or 1.0
+    print(f"{len(spans)} spans, {len(agg)} distinct paths, "
+          f"{total:.3f} s total span time", file=out)
+    print(f"{'path':<40} {'calls':>6} {'total_s':>10} {'mean_s':>10} "
+          f"{'share':>6}", file=out)
+    for path, (calls, tot) in sorted(agg.items(), key=lambda kv: -kv[1][1]):
+        print(f"{path:<40} {calls:>6} {tot:>10.3f} {tot / calls:>10.3f} "
+              f"{100 * tot / total:>5.1f}%", file=out)
+    if rates:
+        print(f"\n{'path':<40} {'counter':<12} {'total':>14} "
+              f"{'per_sec':>14}", file=out)
+        for path, counters in sorted(rates.items()):
+            for k, (n, dur) in sorted(counters.items()):
+                rate = f"{n / dur:,.0f}" if dur > 0 else "-"
+                print(f"{path:<40} {k:<12} {n:>14,.0f} {rate:>14}",
+                      file=out)
+    for m in (e for e in events if e.get("kind") == "manifest"):
+        cfg = m.get("config") or {}
+        print(f"\nmanifest: backend={m.get('backend')} "
+              f"devices={m.get('device_count')}x{m.get('device_kind')} "
+              f"jax={m.get('jax_version')} git={str(m.get('git_sha'))[:12]} "
+              f"config={json.dumps(cfg, sort_keys=True)}", file=out)
+    for e in (e for e in events if e.get("kind") == "event"):
+        keys = {k: v for k, v in e.items() if k not in ("kind", "ts")}
+        print(f"event: {json.dumps(keys, sort_keys=True)}", file=out)
+
+
+def main(argv):
+    args = [a for a in argv[1:] if not a.startswith("--")]
+    if len(args) != 1:
+        raise SystemExit(__doc__)
+    events, bad = read_events(args[0])
+    if "--validate" in argv:
+        errors = validate(events, bad)
+        if errors:
+            for err in errors:
+                print(f"INVALID: {err}", file=sys.stderr)
+            raise SystemExit(1)
+        print(f"valid: {len(events)} events", file=sys.stderr)
+    summarize(events)
+
+
+if __name__ == "__main__":
+    main(sys.argv)
