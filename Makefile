@@ -30,11 +30,14 @@ bench:  ## one-line JSON benchmark (TPU with CPU fallback)
 
 TELEMETRY_SMOKE = /tmp/cpr-telemetry-smoke.jsonl
 
-telemetry-smoke:  ## tiny nakamoto CPU bench with telemetry on, then
-	## schema-validate the JSONL artifact (nonzero exit on violation)
+telemetry-smoke:  ## tiny nakamoto CPU bench with telemetry + in-graph
+	## device metrics on, then schema-validate the JSONL artifact
+	## (nonzero exit on violation or if the v2 event types are absent)
 	rm -f $(TELEMETRY_SMOKE)
-	CPR_BENCH_BACKEND=cpu CPR_TELEMETRY=$(TELEMETRY_SMOKE) python bench.py
-	python tools/trace_summary.py $(TELEMETRY_SMOKE) --validate
+	CPR_BENCH_BACKEND=cpu CPR_DEVICE_METRICS=1 \
+		CPR_TELEMETRY=$(TELEMETRY_SMOKE) python bench.py
+	python tools/trace_summary.py $(TELEMETRY_SMOKE) --validate \
+		--expect device_metrics,compile
 
 dryrun:  ## multi-chip sharding dry run on the virtual CPU mesh
 	$(CPU_MESH) python -c "from __graft_entry__ import dryrun_multichip; dryrun_multichip(8)"

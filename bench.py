@@ -22,7 +22,7 @@ import subprocess
 import sys
 import time
 
-from cpr_tpu import telemetry
+from cpr_tpu import device_metrics, telemetry
 
 
 # v5e (TPU v5 lite) single-chip peaks for the roofline fields: bf16
@@ -103,13 +103,30 @@ def _measure_episodes(env, policy_name: str, n_envs: int, n_steps: int,
     params = make_params(alpha=0.35, gamma=0.5, max_steps=max_steps)
     policy = env.policies[policy_name]
     keys = jax.random.split(jax.random.PRNGKey(0), n_envs)
-    fn = env.make_episode_stats_fn(params, policy, n_steps, chunk=chunk)
-    with tele.span("compile") as sp:
+    collect = device_metrics.enabled()
+    fn = env.make_episode_stats_fn(params, policy, n_steps, chunk=chunk,
+                                   collect_metrics=collect)
+    spec = getattr(fn, "metrics_spec", None)
+    # compile_watch emits one schema-v2 `compile` event per traced
+    # program (fn name, arg shapes, trace/compile seconds) — so the
+    # trace says WHAT compiled during this span, not just how long
+    with telemetry.compile_watch(), tele.span("compile") as sp:
         sp.fence(fn(keys))  # compile + warmup in one first call
+    acc_total = None
     with tele.span("measure", env_steps=reps * n_envs * n_steps) as sp, \
             telemetry.maybe_profile(label):
         for _ in range(reps):
-            stats = jax.block_until_ready(fn(keys))
+            out = jax.block_until_ready(fn(keys))
+            if collect:
+                stats, acc = out
+                acc_total = (acc if acc_total is None
+                             else spec.merge(acc_total, acc))
+            else:
+                stats = out
+    if collect:
+        # the single host readback of the in-graph accumulator, after
+        # the measured span closed
+        device_metrics.emit(label, spec, acc_total, reps=reps)
     dt = sp.dur_s / reps
     atk = np.asarray(stats["episode_reward_attacker"]).mean()
     dfn = np.asarray(stats["episode_reward_defender"]).mean()
@@ -226,7 +243,7 @@ def measure_tailstorm_ppo(n_envs: int, rollout_len: int = 128,
     tele = telemetry.current()
     carry = jax.jit(init_fn)(jax.random.PRNGKey(0))
     step = jax.jit(train_step)
-    with tele.span("compile") as sp:
+    with telemetry.compile_watch(), tele.span("compile") as sp:
         carry, _ = step(carry)  # compile + warm
         sp.fence(carry)
     with tele.span("measure", env_steps=reps * n_envs * rollout_len) as sp, \
@@ -234,6 +251,11 @@ def measure_tailstorm_ppo(n_envs: int, rollout_len: int = 128,
         for _ in range(reps):
             carry, metrics = step(carry)
             jax.block_until_ready(carry)
+    acc = metrics.pop("device_metrics", None)
+    if acc is not None:
+        # last rep's update accumulator (per-train_step, not cumulative)
+        device_metrics.emit("tailstorm_ppo_train",
+                            train_step.metrics_spec, acc)
     dt = sp.dur_s / reps
     ent = float(np.asarray(metrics["entropy"]))
     extras = _roofline(train_step, (carry,), n_envs * rollout_len)
@@ -319,7 +341,7 @@ def _outage_fields(reason: str, metric_prefix: str):
     # consumers need no key-existence special case
     fields = {"outage": True, "fallback_reason": reason,
               "last_known_tpu": _last_known_tpu(metric_prefix)}
-    telemetry.current().event("outage", reason=reason,
+    telemetry.current().event("tpu_outage", reason=reason,
                               metric_prefix=metric_prefix)
     return fields
 

@@ -197,11 +197,26 @@ def _valid_actions(src, act, prob, S: int, A: int, reduce=lambda x: x):
     return valid, valid.any(axis=1)
 
 
+# residual-trajectory ring length: the while_loop cannot stack a
+# data-dependent number of deltas, so the last VI_RESID_LEN ride in a
+# fixed ring in the carry (one scatter per sweep — noise next to the
+# segment_sum backup).  Converted to chronological order host-side by
+# ring_residuals().
+VI_RESID_LEN = 512
+
+
 def vi_while_loop(src, act, dst, prob, reward, progress, S, A, discount,
-                  stop_delta, max_iter, reduce=lambda x: x):
+                  stop_delta, max_iter, reduce=lambda x: x,
+                  resid_len=VI_RESID_LEN):
     """Shared VI driver: Bellman sweeps until the value delta drops below
     stop_delta or max_iter is hit. `reduce` hooks the cross-device psum
-    for transition-sharded execution."""
+    for transition-sharded execution.
+
+    Returns (value, progress, policy, delta, it, resid): `resid` is the
+    convergence history — the per-sweep value deltas in a ring buffer
+    of `resid_len` (static; 0 disables, giving a (0,) placeholder).
+    Sweep j (1-based) writes slot (j-1) % resid_len; ring_residuals()
+    unrolls it."""
     sweep = make_vi_sweep(S, A, reduce)
     valid, any_valid = _valid_actions(src, act, prob, S, A, reduce)
 
@@ -210,25 +225,62 @@ def vi_while_loop(src, act, dst, prob, reward, progress, S, A, discount,
                      discount, value, prog)
 
     def cond(carry):
-        _, _, _, delta, i = carry
+        _, _, _, delta, i, _ = carry
         return (delta > stop_delta) & (i < max_iter)
 
     def body(carry):
-        value, prog, _, _, i = carry
+        value, prog, _, _, i, resid = carry
         v2, p2, pol = run(value, prog)
-        return v2, p2, pol, jnp.abs(v2 - value).max(), i + 1
+        delta = jnp.abs(v2 - value).max()
+        if resid_len:
+            resid = resid.at[i % resid_len].set(delta)
+        return v2, p2, pol, delta, i + 1, resid
 
     z = jnp.zeros(S, prob.dtype)
     v, p, pol = run(z, z)
     delta = jnp.abs(v - z).max()
-    return jax.lax.while_loop(cond, body, (v, p, pol, delta, 1))
+    resid = jnp.zeros(resid_len, prob.dtype)
+    if resid_len:
+        resid = resid.at[0].set(delta)
+    return jax.lax.while_loop(cond, body, (v, p, pol, delta, 1, resid))
 
 
-@partial(jax.jit, static_argnums=(6, 7, 10))
+def ring_residuals(resid, it: int):
+    """Chronological residual trajectory from a vi_while_loop ring:
+    the deltas of the last min(it, resid_len) sweeps, oldest first."""
+    r = np.asarray(resid)
+    L = len(r)
+    if L == 0 or it <= 0:
+        return np.zeros(0, r.dtype if L else np.float32)
+    if it <= L:
+        return r[:it]
+    return np.roll(r, -(it % L))
+
+
+def vi_residuals_event(impl: str, it: int, resid, stop_delta, delta):
+    """Emit the schema-v2 `vi_residuals` telemetry event for a finished
+    solve (no-op when no sink is active) and return the trajectory as a
+    host array.  The emitted list is capped at the last VI_RESID_LEN
+    sweeps — `truncated` flags solves whose early history was dropped
+    (the while impl's ring already enforces the same cap on device)."""
+    from cpr_tpu import telemetry
+
+    resid = np.asarray(resid)
+    tail = resid[-VI_RESID_LEN:]
+    telemetry.current().event(
+        "vi_residuals", impl=impl, n_sweeps=int(it),
+        residuals=[float(d) for d in tail],
+        truncated=int(it) > len(tail),
+        stop_delta=float(stop_delta), final_delta=float(delta))
+    return resid
+
+
+@partial(jax.jit, static_argnums=(6, 7, 10, 11))
 def _vi_loop(src, act, dst, prob, reward, progress, S, A, discount,
-             stop_delta, max_iter):
+             stop_delta, max_iter, resid_len=VI_RESID_LEN):
     return vi_while_loop(src, act, dst, prob, reward, progress, S, A,
-                         discount, stop_delta, max_iter)
+                         discount, stop_delta, max_iter,
+                         resid_len=resid_len)
 
 
 def resolve_vi_impl(impl: str | None) -> str:
@@ -269,7 +321,9 @@ def make_vi_chunk(S: int, A: int, reduce=lambda x: x):
         pol0 = jnp.full((S,), -1, jnp.int32)
         (v, p, pol), deltas = jax.lax.scan(
             body, (value, prog, pol0), None, length=chunk)
-        return v, p, pol, deltas[-1]
+        # full per-sweep deltas: the convergence history the host
+        # driver already syncs on — (chunk,) floats, not just the last
+        return v, p, pol, deltas
 
     return chunk_body
 
@@ -318,7 +372,7 @@ def _anderson_mix(hist):
 def run_chunk_driver(chunk_step, S, dtype, stop_delta, max_iter,
                      chunk: int = 64, accel_m: int = 0):
     """Shared host loop for device-while-free VI: call
-    `chunk_step(value, prog, steps) -> (value, prog, pol, delta)` in
+    `chunk_step(value, prog, steps) -> (value, prog, pol, deltas)` in
     full chunks with a chunk=1 tail (steps is a static argnum in both
     impls, so an arbitrary tail size would compile a fresh program per
     distinct max_iter % chunk; the 1-sweep program compiles once and
@@ -340,12 +394,17 @@ def run_chunk_driver(chunk_step, S, dtype, stop_delta, max_iter,
     pol = None
     hist: list = []
     prev_delta = None
+    resids: list = []
     while it < max_iter:
         step = chunk if max_iter - it >= chunk else 1
         x_value, x_prog = value, prog
-        g_value, g_prog, pol, delta = chunk_step(value, prog, step)
+        g_value, g_prog, pol, deltas = chunk_step(value, prog, step)
         it += step
         value, prog = g_value, g_prog
+        # the convergence check below already syncs on the chunk, so
+        # pulling the full per-sweep delta vector costs no extra trip
+        resids.append(np.asarray(deltas))
+        delta = deltas[-1]
         if float(delta) <= float(stop_delta):
             break
         # never mix on the way out: a max_iter exit must return the
@@ -360,15 +419,18 @@ def run_chunk_driver(chunk_step, S, dtype, stop_delta, max_iter,
                 if len(hist) >= 2:
                     value, prog = _anderson_mix(hist)
             prev_delta = float(delta)
-    return value, prog, pol, delta, it
+    resid = (np.concatenate(resids) if resids
+             else np.zeros(0, np.dtype(dtype)))
+    return value, prog, pol, delta, it, resid
 
 
 def vi_chunked(src, act, dst, prob, reward, progress, S, A, discount,
                stop_delta, max_iter, chunk: int = 64, accel_m: int = 0):
     """Host-driven VI: repeat `_vi_chunk` until the last in-chunk delta
-    drops below stop_delta (or max_iter sweeps ran).  Same fixpoint as
-    vi_while_loop — extra post-convergence sweeps are no-ops on a
-    converged value function.  `accel_m` opts into Anderson
+    drops below stop_delta (or max_iter sweeps ran).  Same fixpoint and
+    return shape as vi_while_loop (the residual trajectory here is the
+    FULL per-sweep history, not a ring) — extra post-convergence sweeps
+    are no-ops on a converged value function.  `accel_m` opts into Anderson
     acceleration (see run_chunk_driver; ~5x fewer sweeps measured on
     the fc16 PT-MDP, same fixpoint to stop_delta)."""
     valid, any_valid = _vi_valid(src, act, prob, S, A)
@@ -528,13 +590,17 @@ class TensorMDP:
         impl = resolve_vi_impl(impl)
         t0 = now()
         run = _vi_loop if impl == "while" else vi_chunked
-        value, progress, policy, delta, it = run(
+        value, progress, policy, delta, it, resid = run(
             self.src, self.act, self.dst, self.prob, self.reward,
             self.progress, self.n_states, self.n_actions,
             jnp.asarray(discount, self.prob.dtype),
             jnp.asarray(stop_delta, self.prob.dtype),
             max_iter if max_iter > 0 else (1 << 30),
         )
+        if impl == "while":
+            resid = ring_residuals(resid, int(it))
+        resid = vi_residuals_event(impl, int(it), resid, stop_delta,
+                                   delta)
         if verbose:
             print(f"value iteration: {int(it)} sweeps, delta {float(delta):g}")
         return dict(
@@ -546,6 +612,7 @@ class TensorMDP:
             vi_progress=np.asarray(progress),
             vi_iter=int(it),
             vi_max_iter=max_iter,
+            vi_residuals=resid,
             vi_time=now() - t0,
         )
 

@@ -31,7 +31,8 @@ from functools import partial
 
 from cpr_tpu.mdp.explicit import (TensorMDP, _valid_actions,
                                   make_vi_chunk, resolve_vi_impl,
-                                  run_chunk_driver, vi_while_loop)
+                                  ring_residuals, run_chunk_driver,
+                                  vi_residuals_event, vi_while_loop)
 from cpr_tpu.telemetry import now
 
 
@@ -71,7 +72,8 @@ def shard_envs(mesh: Mesh, tree, axis: str = "d"):
 
 def make_sharded_rollout_fn(env, mesh: Mesh, params, policy,
                             n_steps: int, axis: str = "d",
-                            chunk: int | None = None):
+                            chunk: int | None = None,
+                            collect_metrics: bool = False):
     """Build `fn(keys) -> stats` running vmap'd `JaxEnv.episode_stats`
     with the episode batch sharded over the mesh. XLA partitions the
     whole rollout program; no collectives are needed until the caller
@@ -82,9 +84,23 @@ def make_sharded_rollout_fn(env, mesh: Mesh, params, policy,
     the single-device `JaxEnv.make_episode_stats_fn` (sharded inputs
     keep their placement through the host loop, so each per-chunk call
     stays mesh-partitioned) — for workers that bound single-execution
-    time (docs/TPU_SESSION_r03.md)."""
+    time (docs/TPU_SESSION_r03.md).
+
+    `collect_metrics` threads the per-device in-graph metrics
+    accumulator through the sharded rollout exactly as on one device
+    (the env-axis merge is part of the partitioned program, so the
+    accumulator cells come back as replicated scalars — still one
+    readback per call)."""
     stats_fn = env.make_episode_stats_fn(params, policy, n_steps,
-                                         chunk=chunk)
+                                         chunk=chunk,
+                                         collect_metrics=collect_metrics)
+
+    if collect_metrics:
+        def mfn(keys):
+            return stats_fn(shard_envs(mesh, keys, axis))
+
+        mfn.metrics_spec = stats_fn.metrics_spec
+        return mfn
 
     def fn(keys):
         return stats_fn(shard_envs(mesh, keys, axis))
@@ -154,7 +170,7 @@ def sharded_value_iteration(tm: TensorMDP, mesh: Mesh, *, axis: str = "d",
         return _shard_map(
             body, mesh=mesh,
             in_specs=(P(axis),) * 6,
-            out_specs=(P(),) * 5,
+            out_specs=(P(),) * 6,
             check_vma=False,
         )(*coo)
 
@@ -184,9 +200,11 @@ def sharded_value_iteration(tm: TensorMDP, mesh: Mesh, *, axis: str = "d",
                                 max_iter_, chunk, accel_m=accel_m)
 
     if impl == "while":
-        value, progress_v, policy, delta, it = run()
+        value, progress_v, policy, delta, it, resid = run()
+        resid = ring_residuals(resid, int(it))
     else:
-        value, progress_v, policy, delta, it = run_chunked()
+        value, progress_v, policy, delta, it, resid = run_chunked()
+    resid = vi_residuals_event(impl, int(it), resid, stop_delta, delta)
     return dict(
         vi_discount=discount,
         vi_delta=float(delta),
@@ -196,5 +214,6 @@ def sharded_value_iteration(tm: TensorMDP, mesh: Mesh, *, axis: str = "d",
         vi_progress=np.asarray(progress_v),
         vi_iter=int(it),
         vi_max_iter=max_iter,
+        vi_residuals=resid,
         vi_time=now() - t0,
     )

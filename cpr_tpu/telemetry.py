@@ -44,7 +44,9 @@ this repo-wide).
 from __future__ import annotations
 
 import json
+import logging
 import os
+import re
 import socket
 import subprocess
 import sys
@@ -52,14 +54,28 @@ from contextlib import contextmanager
 from datetime import datetime, timezone
 from time import perf_counter as now  # noqa: F401 — re-exported
 
-SCHEMA_VERSION = 1
+SCHEMA_VERSION = 2
 TELEMETRY_ENV_VAR = "CPR_TELEMETRY"
 PROFILE_ENV_VAR = "CPR_PROFILE_DIR"
+CHECKIFY_ENV_VAR = "CPR_CHECKIFY"
+# in-graph metrics gate; canonical reader is cpr_tpu.device_metrics
+# (this module stays jax-free at import, that one does not)
+DEVICE_METRICS_ENV_VAR = "CPR_DEVICE_METRICS"
 
 # every span event carries at least these keys (tools/trace_summary.py
 # --validate and the schema tests check against this tuple)
 SPAN_KEYS = ("kind", "name", "path", "depth", "t_start", "t_end",
              "dur_s")
+
+# schema v2: reserved point-event names -> the fields each must carry
+# (tools/trace_summary.py --validate enforces this; other event names
+# stay free-form exactly as in v1)
+EVENT_FIELDS = {
+    "device_metrics": ("scope", "metrics"),
+    "compile": ("fn", "compile_s"),
+    "vi_residuals": ("impl", "n_sweeps", "residuals"),
+    "tpu_outage": ("reason",),
+}
 
 
 class Span:
@@ -269,6 +285,198 @@ def run_manifest(config: dict | None = None) -> dict:
     if config is not None:
         man["config"] = config
     return man
+
+
+# -- compile observability ---------------------------------------------------
+#
+# jax has no public "a compile happened" callback, but with
+# `jax_log_compiles` on it logs every trace/lower/compile through two
+# private-module loggers in a stable format (verified on jax 0.4.37):
+#
+#   jax._src.interpreters.pxla  WARNING  "Compiling <fn> with global
+#       shapes and types [ShapedArray(float32[4])]. Argument mapping: …"
+#   jax._src.dispatch           WARNING  "Finished tracing +
+#       transforming <fn> for pjit in <t> sec"
+#   jax._src.dispatch           WARNING  "Finished XLA compilation of
+#       jit(<fn>) in <t> sec"
+#
+# Cache hits (same fn, same shapes) log NOTHING — which is exactly the
+# property the retrace regression test needs.  `compile_watch()` turns
+# the flag on, attaches one handler to both loggers, and turns each
+# Compiling/Finished pair into a machine-readable `compile` event.
+# `jax.monitoring` duration listeners (no unregister API) are installed
+# once per process and routed to whichever watchers are active.
+
+_COMPILING_RE = re.compile(
+    r"Compiling (\S+) with global shapes and types (\[.*?\])\.")
+_XLA_DONE_RE = re.compile(
+    r"Finished XLA compilation of (?:jit\()?([^)\s]+)\)? "
+    r"in ([0-9.eE+-]+) sec")
+_TRACE_DONE_RE = re.compile(
+    r"Finished tracing \+ transforming (\S+) for pjit "
+    r"in ([0-9.eE+-]+) sec")
+
+_COMPILE_LOGGERS = ("jax._src.interpreters.pxla", "jax._src.dispatch")
+
+
+class CompileWatcher:
+    """Collects compile events while active inside `compile_watch()`.
+
+    `events` is a list of dicts {fn, arg_shapes, trace_s, compile_s}
+    — one per actual XLA compile (cache hits never log, so never
+    count).  `durations` accumulates the `/jax/core/compile/*`
+    monitoring totals observed while active."""
+
+    def __init__(self):
+        self.events: list[dict] = []
+        self.durations: dict[str, float] = {}
+        self._pending: dict[str, dict] = {}
+        self._trace_s: dict[str, float] = {}
+
+    def count(self, fn: str | None = None) -> int:
+        """Number of compiles seen (optionally for one jitted fn)."""
+        return sum(1 for e in self.events
+                   if fn is None or e["fn"] == fn)
+
+    def by_function(self) -> dict[str, int]:
+        out: dict[str, int] = {}
+        for e in self.events:
+            out[e["fn"]] = out.get(e["fn"], 0) + 1
+        return out
+
+    # -- record handlers (called by the shared log handler) ---------------
+
+    def _on_compiling(self, fn: str, arg_shapes: str):
+        # the trace-done record precedes the Compiling record
+        ev = {"fn": fn, "arg_shapes": arg_shapes,
+              "trace_s": self._trace_s.pop(fn, None), "compile_s": None}
+        self.events.append(ev)
+        self._pending[fn] = ev
+
+    def _on_trace_done(self, fn: str, secs: float):
+        self._trace_s[fn] = secs
+
+    def _on_xla_done(self, fn: str, secs: float) -> dict:
+        ev = self._pending.pop(fn, None)
+        if ev is None:  # Finished without a Compiling record: still real
+            ev = {"fn": fn, "arg_shapes": None, "trace_s": None,
+                  "compile_s": secs}
+            self.events.append(ev)
+        else:
+            ev["compile_s"] = secs
+        return ev
+
+
+_active_watchers: list[CompileWatcher] = []
+_monitoring_installed = False
+
+
+class _CompileLogHandler(logging.Handler):
+    def emit(self, record):  # noqa: A003 — logging.Handler API
+        try:
+            msg = record.getMessage()
+        except Exception:  # noqa: BLE001 — never break the compile path
+            return
+        m = _COMPILING_RE.match(msg)
+        if m:
+            for w in _active_watchers:
+                w._on_compiling(m.group(1), m.group(2))
+            return
+        m = _TRACE_DONE_RE.match(msg)
+        if m:
+            for w in _active_watchers:
+                w._on_trace_done(m.group(1), float(m.group(2)))
+            return
+        m = _XLA_DONE_RE.match(msg)
+        if m:
+            for w in _active_watchers:
+                ev = w._on_xla_done(m.group(1), float(m.group(2)))
+                if getattr(w, "_emit", False):
+                    current().event("compile", **ev)
+
+
+_LOG_HANDLER = _CompileLogHandler(level=logging.WARNING)
+
+
+def _monitoring_callback(event: str, secs: float, **attrs):
+    if not event.startswith("/jax/core/compile"):
+        return
+    for w in _active_watchers:
+        w.durations[event] = w.durations.get(event, 0.0) + secs
+
+
+@contextmanager
+def compile_watch(emit: bool = True):
+    """Capture retrace/compile events while the body runs.
+
+        with telemetry.compile_watch() as cw:
+            fn(x)          # first call: compiles
+            fn(x)          # same shapes: cache hit, NO event
+        assert cw.count("fn") == 1
+
+    Each compile becomes a `compile` point event on the current sink
+    (`emit=False` collects without emitting) and is recorded on the
+    yielded `CompileWatcher` regardless of any sink.  Nests cleanly;
+    `jax_log_compiles` is restored on exit of the outermost watch."""
+    import jax
+
+    global _monitoring_installed
+    w = CompileWatcher()
+    w._emit = emit
+    prev = jax.config.jax_log_compiles
+    prev_prop = {}
+    if not _active_watchers:
+        jax.config.update("jax_log_compiles", True)
+        for name in _COMPILE_LOGGERS:
+            lg = logging.getLogger(name)
+            lg.addHandler(_LOG_HANDLER)
+            # the WARNING-level compile logs exist for this handler,
+            # not for stderr: stop propagation while watching
+            prev_prop[name] = lg.propagate
+            lg.propagate = False
+    if not _monitoring_installed:
+        try:
+            jax.monitoring.register_event_duration_secs_listener(
+                _monitoring_callback)
+        except Exception:  # noqa: BLE001 — durations are best-effort
+            pass
+        _monitoring_installed = True
+    _active_watchers.append(w)
+    try:
+        yield w
+    finally:
+        _active_watchers.remove(w)
+        if not _active_watchers:
+            jax.config.update("jax_log_compiles", prev)
+            for name in _COMPILE_LOGGERS:
+                lg = logging.getLogger(name)
+                lg.removeHandler(_LOG_HANDLER)
+                lg.propagate = prev_prop.get(name, True)
+
+
+def cost_snapshot(fn, *args) -> dict | None:
+    """XLA's compile-time cost model of one jitted call — flops/bytes
+    estimates for the run manifest, so cost regressions are diffable
+    across artifacts.  Costs one EXTRA compile (`lower().compile()`
+    does not share the jit executable cache): call it behind an opt-in
+    gate (CPR_DEVICE_METRICS in train/driver.py), never on a fast
+    path.  Returns None when the backend exposes no analysis."""
+    try:
+        import jax
+
+        ca = jax.jit(fn).lower(*args).compile().cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        if not ca:
+            return None
+        out = {}
+        for k in ("flops", "bytes accessed", "transcendentals",
+                  "optimal_seconds", "utilization operand 0"):
+            if k in ca:
+                out[k.replace(" ", "_")] = float(ca[k])
+        return out or None
+    except Exception:  # noqa: BLE001 — cost model is best-effort metadata
+        return None
 
 
 # -- profiler capture --------------------------------------------------------

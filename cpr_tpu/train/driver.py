@@ -21,12 +21,13 @@ import jax.numpy as jnp
 import numpy as np
 from flax import serialization
 
-from cpr_tpu import telemetry
+from cpr_tpu import device_metrics, telemetry
 from cpr_tpu.envs.registry import get_sized
 from cpr_tpu.envs.assumption import AssumptionEnv
 from cpr_tpu.params import stack_params
 from cpr_tpu.train.config import TrainConfig
-from cpr_tpu.train.ppo import ActorCritic, PPOConfig, make_train
+from cpr_tpu.train.ppo import (ActorCritic, PPOConfig, make_train,
+                               maybe_checkify)
 
 
 # Dense per-progress episodes terminate at target *progress*; max_steps
@@ -209,7 +210,7 @@ def train_from_config(cfg: TrainConfig, *, out_dir: str | None = None,
         env_state = shard_envs(mesh, env_state, "dp")
         obs = shard_envs(mesh, obs, "dp")
         carry = (ts, env_state, obs, key)
-    step = jax.jit(train_step)
+    step = maybe_checkify(train_step)
 
     total = n_updates if n_updates is not None else cfg.total_updates
     history, eval_rows, best = [], [], -np.inf
@@ -221,6 +222,13 @@ def train_from_config(cfg: TrainConfig, *, out_dir: str | None = None,
         protocol=cfg.protocol, seed=cfg.seed, n_envs=cfg.n_envs,
         episode_len=cfg.episode_len, reward=cfg.reward,
         n_steps=pcfg.n_steps, total_updates=total))
+    if device_metrics.enabled():
+        # XLA's own estimate of one update (flops, bytes) into the run
+        # manifest; costs one extra compile, so it rides the same
+        # opt-in as the in-graph metrics
+        cost = telemetry.cost_snapshot(train_step, carry)
+        if cost is not None:
+            manifest["train_step_cost"] = cost
     if out_dir is not None:
         os.makedirs(out_dir, exist_ok=True)
         # self-describing run dir: the manifest rides both as its own
@@ -246,7 +254,12 @@ def train_from_config(cfg: TrainConfig, *, out_dir: str | None = None,
                                  env_steps=steps_per_update) as sp:
                 carry, metrics = step(carry)
                 sp.fence(carry)
+                acc = metrics.pop("device_metrics", None)
                 m = {k: float(v) for k, v in metrics.items()}
+            if acc is not None:
+                device_metrics.emit("ppo_update",
+                                    train_step.metrics_spec, acc,
+                                    update=i + 1)
             m["wall_s"] = round(sp.dur_s, 6)
             if sp.dur_s > 0:
                 m["steps_per_sec"] = round(steps_per_update / sp.dur_s)

@@ -12,6 +12,7 @@ layers over the tensor axis (see `shardings`).
 
 from __future__ import annotations
 
+import os
 from functools import partial
 from typing import Any, Callable
 
@@ -23,7 +24,7 @@ from flax import linen as nn
 from flax import struct
 from flax.training.train_state import TrainState
 
-from cpr_tpu import telemetry
+from cpr_tpu import device_metrics, telemetry
 from cpr_tpu.envs.base import JaxEnv
 from cpr_tpu.params import EnvParams
 
@@ -123,6 +124,11 @@ def make_train(env: JaxEnv, env_params: EnvParams, cfg: PPOConfig,
     """
     net = ActorCritic(env.n_actions, cfg.hidden)
     p_axis = 0 if per_env_params else None
+    # in-graph sentinels/stats (CPR_DEVICE_METRICS=1), read at build
+    # time: the off path stays the exact pre-metrics program (acc=None
+    # threads through the scans as an empty pytree)
+    collect = device_metrics.enabled()
+    mspec = device_metrics.ppo_spec() if collect else None
 
     def lr_schedule(count):
         if not cfg.anneal_lr:
@@ -236,8 +242,16 @@ def make_train(env: JaxEnv, env_params: EnvParams, cfg: PPOConfig,
         advs_f = advs.reshape(-1)
         targets_f = targets.reshape(-1)
 
+        acc = None
+        if collect:
+            # NaN/Inf birth counter on the advantage estimates: GAE is
+            # where a single poisoned reward/value fans out into the
+            # whole update
+            acc = mspec.count(mspec.init(), "nonfinite_advantages",
+                              ~jnp.isfinite(advs_f))
+
         def epoch(carry, _):
-            ts, cont, key = carry
+            ts, cont, key, acc = carry
             key, k_perm = jax.random.split(key)
             mb_size = cfg.n_steps * cfg.n_envs // cfg.n_minibatches
             perm = jax.random.permutation(
@@ -245,17 +259,30 @@ def make_train(env: JaxEnv, env_params: EnvParams, cfg: PPOConfig,
             ).reshape(cfg.n_minibatches, mb_size)
 
             def one_mb(carry, idx):
-                ts, cont = carry
+                ts, cont, acc = carry
                 take = lambda x: x[idx]
                 mb = (jax.tree.map(take, flat), take(advs_f), take(targets_f))
                 ts, cont, metrics = update_minibatch(ts, cont, mb)
-                return (ts, cont), metrics
+                if collect:
+                    acc2 = mspec.count(acc, "minibatches", 1)
+                    nf = (~jnp.isfinite(metrics["pg_loss"])
+                          | ~jnp.isfinite(metrics["v_loss"])
+                          | ~jnp.isfinite(metrics["entropy"]))
+                    acc2 = mspec.count(acc2, "nonfinite_loss", nf)
+                    acc2 = mspec.observe(acc2, "approx_kl",
+                                         metrics["approx_kl"])
+                    if cfg.target_kl is not None:
+                        acc2 = mspec.count(acc2, "minibatches_skipped",
+                                           metrics["applied"] < 0.5)
+                    acc = acc2
+                return (ts, cont, acc), metrics
 
-            (ts, cont), metrics = jax.lax.scan(one_mb, (ts, cont), perm)
-            return (ts, cont, key), metrics
+            (ts, cont, acc), metrics = jax.lax.scan(
+                one_mb, (ts, cont, acc), perm)
+            return (ts, cont, key, acc), metrics
 
-        (ts, _, key), metrics = jax.lax.scan(
-            epoch, (ts, jnp.bool_(True), key), None,
+        (ts, _, key, acc), metrics = jax.lax.scan(
+            epoch, (ts, jnp.bool_(True), key, acc), None,
             length=cfg.update_epochs)
         if cfg.target_kl is None:
             metrics = jax.tree.map(lambda x: x.mean(), metrics)
@@ -278,9 +305,42 @@ def make_train(env: JaxEnv, env_params: EnvParams, cfg: PPOConfig,
             jnp.where(traj.done, traj.info["episode_reward_defender"], 0.0).sum()
             / jnp.maximum(traj.done.sum(), 1))
         metrics["n_episodes"] = traj.done.sum()
+        if collect:
+            # reserved key: callers pop the accumulator before their
+            # float() sweep and summarize it once per telemetry span
+            metrics["device_metrics"] = acc
         return (ts, env_state, obs, key), metrics
 
+    train_step.metrics_spec = mspec
     return init_fn, train_step
+
+
+def maybe_checkify(step_fn):
+    """jit `step_fn`, under checkify float checks when CPR_CHECKIFY=1.
+
+    The opt-in debug mode for silent NaN/Inf births inside the update:
+    checkify instruments every float op in the traced program, and the
+    wrapper syncs on the error payload each call — this is the
+    slow-but-exact complement to the free in-graph sentinels
+    (device_metrics.ppo_spec), not something to leave on in a bench.
+    On error: one `checkify_error` telemetry event, then the usual
+    JaxRuntimeError via err.throw()."""
+    if os.environ.get(telemetry.CHECKIFY_ENV_VAR) != "1":
+        return jax.jit(step_fn)
+    from jax.experimental import checkify
+
+    checked = jax.jit(checkify.checkify(
+        step_fn, errors=checkify.float_checks))
+
+    def step(carry):
+        err, out = checked(carry)
+        msg = err.get()
+        if msg:
+            telemetry.current().event("checkify_error", error=msg)
+            err.throw()
+        return out
+
+    return step
 
 
 def relative_reward_on_done(reward, info, done):
@@ -306,7 +366,7 @@ def train(env, env_params, cfg: PPOConfig, *, n_updates: int, seed: int = 0,
         env_state = shard_envs(mesh, env_state, "dp")
         obs = shard_envs(mesh, obs, "dp")
         carry = (ts, env_state, obs, key)
-    step = jax.jit(train_step)
+    step = maybe_checkify(train_step)
     history = []
     tele = telemetry.current()
     steps_per_update = cfg.n_envs * cfg.n_steps
@@ -314,7 +374,11 @@ def train(env, env_params, cfg: PPOConfig, *, n_updates: int, seed: int = 0,
         with tele.span("update", env_steps=steps_per_update) as sp:
             carry, metrics = step(carry)
             sp.fence(carry)
+            acc = metrics.pop("device_metrics", None)
             host_metrics = {k: float(v) for k, v in metrics.items()}
+        if acc is not None:
+            device_metrics.emit("ppo_update", train_step.metrics_spec,
+                                acc, update=i)
         host_metrics["wall_s"] = round(sp.dur_s, 6)
         if sp.dur_s > 0:
             host_metrics["steps_per_sec"] = round(

@@ -1,0 +1,406 @@
+"""In-graph device metrics tests: the MetricsSpec cell algebra, the
+rollout accumulator threading (chunked == unchunked, zero host syncs
+inside the hot loop under `jax.transfer_guard("disallow")`), the
+compile_watch retrace pin, VI convergence residuals, the PPO numerical
+sentinels with the opt-in checkify mode, and the schema-v2 half of
+tools/trace_summary.py.
+
+These are the proof obligations behind docs/OBSERVABILITY.md's claims:
+one readback per span, no retraces across same-shape bench reps, and
+build-time gating (the off path compiles the pre-metrics program).
+"""
+
+import importlib.util
+import json
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cpr_tpu import device_metrics, telemetry
+from cpr_tpu.device_metrics import MetricsSpec
+from cpr_tpu.params import make_params
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+# -- MetricsSpec cell algebra -------------------------------------------------
+
+
+def test_counter_sums_masks_and_scalars():
+    spec = MetricsSpec().counter("c")
+    acc = spec.init()
+    acc = spec.count(acc, "c", jnp.array([True, False, True]))
+    acc = spec.count(acc, "c", 5)
+    assert spec.summarize(acc)["c"] == 7
+    assert acc["c"].dtype == jnp.int32
+
+
+def test_stats_masked_observation_and_empty_cell():
+    spec = MetricsSpec().stats("s")
+    acc = spec.observe(spec.init(), "s", jnp.array([1.0, 2.0, 3.0, 4.0]),
+                       where=jnp.array([True, False, True, False]))
+    s = spec.summarize(acc)["s"]
+    assert s == {"min": 1.0, "max": 3.0, "sum": 4.0, "count": 2.0,
+                 "mean": 2.0}
+    # a never-observed cell reads as honest Nones, not +-inf
+    empty = spec.summarize(spec.init())["s"]
+    assert empty["count"] == 0.0
+    assert empty["min"] is None and empty["max"] is None \
+        and empty["mean"] is None
+
+
+def test_hist_bins_include_under_and_overflow():
+    spec = MetricsSpec().hist("h", [0.0, 10.0, 20.0])
+    acc = spec.observe_hist(spec.init(), "h",
+                            jnp.array([-5.0, 0.0, 5.0, 10.0, 25.0]))
+    h = spec.summarize(acc)["h"]
+    assert h["edges"] == [0.0, 10.0, 20.0]
+    # [-inf,0) [0,10) [10,20) [20,inf)
+    assert h["counts"] == [1, 2, 1, 1]
+    masked = spec.observe_hist(spec.init(), "h", jnp.array([5.0, 15.0]),
+                               where=jnp.array([True, False]))
+    assert spec.summarize(masked)["h"]["counts"] == [0, 1, 0, 0]
+    with pytest.raises(AssertionError, match="increasing"):
+        MetricsSpec().hist("bad", [1.0, 1.0])
+
+
+def test_merge_and_on_device_axis_reduction():
+    spec = (MetricsSpec().counter("c").stats("s")
+            .hist("h", [2.0]))
+    a = spec.observe(spec.count(spec.init(), "c", 2), "s", 1.0)
+    b = spec.observe(spec.count(spec.init(), "c", 3), "s", 5.0)
+    m = spec.summarize(spec.merge(a, b))
+    assert m["c"] == 5
+    assert m["s"]["min"] == 1.0 and m["s"]["max"] == 5.0 \
+        and m["s"]["mean"] == 3.0
+
+    # vmapped lanes reduce back to scalar cells inside one jitted program
+    def lane(v):
+        acc = spec.count(spec.init(), "c", 1)
+        acc = spec.observe(acc, "s", v)
+        return spec.observe_hist(acc, "h", v)
+
+    out = jax.jit(lambda vs: spec.merge_axis(jax.vmap(lane)(vs), 0))(
+        jnp.array([1.0, 5.0, 3.0]))
+    s = spec.summarize(out)
+    assert s["c"] == 3
+    assert s["s"] == {"min": 1.0, "max": 5.0, "sum": 9.0, "count": 3.0,
+                      "mean": 3.0}
+    assert s["h"]["counts"] == [1, 2]
+
+
+def test_enabled_reads_env_var(monkeypatch):
+    monkeypatch.delenv(device_metrics.ENV_VAR, raising=False)
+    assert not device_metrics.enabled()
+    monkeypatch.setenv(device_metrics.ENV_VAR, "1")
+    assert device_metrics.enabled()
+    monkeypatch.setenv(device_metrics.ENV_VAR, "0")
+    assert not device_metrics.enabled()
+
+
+# -- rollout accumulator threading (envs/base.py) -----------------------------
+
+_N_ENVS, _N_STEPS, _CHUNK = 8, 96, 32
+
+
+@pytest.fixture(scope="module")
+def sm1_metrics_fns():
+    """One build of the unchunked and chunked metrics-collecting stats
+    fns (module-scoped: the jitted pieces compile once for the battery
+    below)."""
+    from cpr_tpu.envs.nakamoto import NakamotoSSZ
+
+    env = NakamotoSSZ()
+    params = make_params(alpha=0.35, gamma=0.5, max_steps=64)
+    policy = env.policies["sapirshtein-2016-sm1"]
+    keys = jax.random.split(jax.random.PRNGKey(0), _N_ENVS)
+    whole = env.make_episode_stats_fn(params, policy, _N_STEPS,
+                                      collect_metrics=True)
+    chunked = env.make_episode_stats_fn(params, policy, _N_STEPS,
+                                        chunk=_CHUNK,
+                                        collect_metrics=True)
+    return whole, chunked, keys
+
+
+def test_rollout_metrics_chunked_matches_unchunked(sm1_metrics_fns):
+    whole, chunked, keys = sm1_metrics_fns
+    stats_w, acc_w = whole(keys)
+    stats_c, acc_c = chunked(keys)
+    mw = whole.metrics_spec.summarize(acc_w)
+    mc = chunked.metrics_spec.summarize(acc_c)
+    assert mw["env_steps"] == mc["env_steps"] == _N_ENVS * _N_STEPS
+    assert mw["episodes"] == mc["episodes"] > 0
+    assert mw["nonfinite_stats"] == mc["nonfinite_stats"] == 0
+    assert mw["nonfinite_obs_boundary"] == \
+        mc["nonfinite_obs_boundary"] == 0
+    # every lane finishes >=1 episode at max_steps=64 in 96 steps, so
+    # every lane's mean episode length feeds the stats cell + hist
+    assert mw["episode_n_steps"]["count"] == \
+        mc["episode_n_steps"]["count"] == _N_ENVS
+    assert mw["episode_n_steps"]["sum"] == pytest.approx(
+        mc["episode_n_steps"]["sum"], rel=1e-5)
+    assert mw["episode_reward_attacker"]["sum"] == pytest.approx(
+        mc["episode_reward_attacker"]["sum"], rel=1e-5)
+    assert mw["episode_n_steps_hist"]["counts"] == \
+        mc["episode_n_steps_hist"]["counts"]
+    assert sum(mc["episode_n_steps_hist"]["counts"]) == _N_ENVS
+    # the episode stats themselves keep the chunked==unchunked contract
+    assert int(stats_w["n_episodes"].sum()) == \
+        int(stats_c["n_episodes"].sum())
+
+
+def test_rollout_with_metrics_folds_per_step_cells():
+    """`rollout(with_metrics=True)` keeps the per-step cell set
+    (rollout_spec): the caller already pays to materialize the
+    trajectory, so the fold over the stacked step axis is free there
+    — unlike the stats drivers, whose cells derive from per-lane
+    aggregates (episode_stats_spec) to keep the bench overhead <2%."""
+    from cpr_tpu.envs.nakamoto import NakamotoSSZ
+
+    env = NakamotoSSZ()
+    params = make_params(alpha=0.35, gamma=0.5, max_steps=16)
+    policy = env.policies["sapirshtein-2016-sm1"]
+    traj, acc = env.rollout(jax.random.PRNGKey(0), params, policy, 48,
+                            True)
+    _, _, reward, done, _ = traj
+    s = device_metrics.rollout_spec().summarize(acc)
+    assert s["env_steps"] == 48
+    assert s["episodes"] == int(done.sum()) > 0
+    assert s["reward"]["count"] == 48.0
+    assert s["reward"]["sum"] == pytest.approx(float(reward.sum()),
+                                               rel=1e-5)
+    assert s["nonfinite_obs"] == 0 and s["nonfinite_reward"] == 0
+    # per-episode (not per-lane-mean) length distribution here
+    assert sum(s["episode_length_hist"]["counts"]) == s["episodes"]
+    assert s["episode_length"]["count"] == float(s["episodes"])
+
+
+def test_chunked_metrics_add_no_transfers_in_hot_loop(sm1_metrics_fns):
+    """docs/OBSERVABILITY.md's headline contract: with metrics enabled,
+    the whole chunked stats call — init, every chunk, finalize — runs
+    without a single host<->device transfer.  The readback
+    (`summarize`) happens after the guard, once."""
+    _, chunked, keys = sm1_metrics_fns
+    jax.block_until_ready(chunked(keys))  # warm: compiles transfer
+    with jax.transfer_guard("disallow"):
+        stats, acc = chunked(keys)
+        jax.block_until_ready((stats, acc))
+    summary = chunked.metrics_spec.summarize(acc)
+    assert summary["env_steps"] == _N_ENVS * _N_STEPS
+
+
+def test_rollout_compiles_once_across_same_shape_calls():
+    """Retrace pin: repeated same-shape calls of a metrics-collecting
+    stats fn hit the executable cache (compile_watch sees exactly one
+    compile); a new batch shape costs exactly one more."""
+    from cpr_tpu.envs.nakamoto import NakamotoSSZ
+
+    env = NakamotoSSZ()
+    params = make_params(alpha=0.35, gamma=0.5, max_steps=24)
+    policy = env.policies["sapirshtein-2016-sm1"]
+    fn = env.make_episode_stats_fn(params, policy, 32,
+                                   collect_metrics=True)
+    keys = jax.random.split(jax.random.PRNGKey(0), 4)
+    with telemetry.compile_watch(emit=False) as w:
+        jax.block_until_ready(fn(keys))
+        jax.block_until_ready(fn(keys))
+    assert w.count() == 1, w.events
+    assert w.events[0]["compile_s"] >= 0.0
+    with telemetry.compile_watch(emit=False) as w2:
+        jax.block_until_ready(fn(jax.random.split(
+            jax.random.PRNGKey(1), 8)))
+    assert w2.count() == 1, w2.events
+
+
+# -- VI convergence residuals (mdp/explicit.py) -------------------------------
+
+
+def test_ring_residuals_unrolls_chronologically():
+    from cpr_tpu.mdp.explicit import ring_residuals
+
+    r = np.arange(1.0, 6.0, dtype=np.float32)
+    np.testing.assert_array_equal(ring_residuals(r, 3), r[:3])
+    # sweeps 1..7 into a 5-ring: slot (j-1) % 5 holds delta j
+    ring = np.zeros(5, np.float32)
+    for j in range(1, 8):
+        ring[(j - 1) % 5] = j
+    np.testing.assert_array_equal(ring_residuals(ring, 7),
+                                  [3.0, 4.0, 5.0, 6.0, 7.0])
+    assert len(ring_residuals(np.zeros(0, np.float32), 9)) == 0
+    assert len(ring_residuals(r, 0)) == 0
+
+
+def test_vi_residuals_returned_and_emitted(tmp_path):
+    from cpr_tpu.mdp import Compiler, ptmdp
+    from cpr_tpu.mdp.models import Fc16BitcoinSM
+
+    c = Compiler(Fc16BitcoinSM(alpha=0.3, gamma=0.5,
+                               maximum_fork_length=10))
+    tm = ptmdp(c.mdp(), horizon=20).tensor()
+    path = tmp_path / "vi.jsonl"
+    telemetry.configure(str(path))
+    try:
+        w = tm.value_iteration(stop_delta=1e-9)
+        ch = tm.value_iteration(stop_delta=1e-9, impl="chunked")
+    finally:
+        telemetry.configure(None)
+
+    rw, rc = w["vi_residuals"], ch["vi_residuals"]
+    # the while impl keeps the last min(it, 512) sweeps; the chunked
+    # impl keeps all of them (the host already syncs on each chunk)
+    assert len(rw) == min(int(w["vi_iter"]), 512)
+    assert len(rc) == int(ch["vi_iter"])
+    assert rw[-1] <= 1e-9 and rc[-1] <= 1e-9  # ends converged
+    assert (rw >= 0).all() and rw[0] > rw[-1]  # contraction, down to 0
+    # same Bellman sweeps -> same per-sweep deltas, either impl
+    n = min(len(rw), len(rc))
+    np.testing.assert_allclose(rc[:n], rw[:n], rtol=1e-5, atol=1e-12)
+
+    with open(path) as f:
+        events = [json.loads(line) for line in f]
+    vi_events = [e for e in events if e.get("name") == "vi_residuals"]
+    assert [e["impl"] for e in vi_events] == ["while", "chunked"]
+    for e, res in zip(vi_events, (w, ch)):
+        assert e["n_sweeps"] == int(res["vi_iter"])
+        assert len(e["residuals"]) == min(e["n_sweeps"], 512)
+        assert e["truncated"] == (e["n_sweeps"] > len(e["residuals"]))
+        assert e["final_delta"] <= e["stop_delta"] == 1e-9
+        missing = [k for k in telemetry.EVENT_FIELDS["vi_residuals"]
+                   if k not in e]
+        assert not missing
+
+
+# -- PPO sentinels + checkify (train/ppo.py) ----------------------------------
+
+
+def _tiny_ppo(env_var_on, monkeypatch, **cfg_kw):
+    from cpr_tpu.envs.nakamoto import NakamotoSSZ
+    from cpr_tpu.train.ppo import PPOConfig, make_train
+
+    if env_var_on:
+        monkeypatch.setenv(device_metrics.ENV_VAR, "1")
+    else:
+        monkeypatch.delenv(device_metrics.ENV_VAR, raising=False)
+    env = NakamotoSSZ()
+    params = make_params(alpha=0.45, gamma=0.9, max_steps=32)
+    cfg = PPOConfig(n_envs=4, n_steps=16, hidden=(8,), update_epochs=2,
+                    n_minibatches=2, **cfg_kw)
+    return make_train(env, params, cfg)
+
+
+def test_ppo_train_step_accumulates_sentinels(monkeypatch):
+    init_fn, train_step = _tiny_ppo(True, monkeypatch)
+    assert train_step.metrics_spec is not None
+    carry, metrics = jax.jit(train_step)(init_fn(jax.random.PRNGKey(0)))
+    acc = metrics.pop("device_metrics")
+    s = train_step.metrics_spec.summarize(acc)
+    assert s["minibatches"] == 4  # update_epochs x n_minibatches
+    assert s["nonfinite_advantages"] == 0 and s["nonfinite_loss"] == 0
+    assert s["minibatches_skipped"] == 0  # no target_kl -> never gated
+    assert s["approx_kl"]["count"] == 4.0
+    assert np.isfinite(s["approx_kl"]["mean"])
+    # the loss metrics themselves stay host-convertible after the pop
+    assert np.isfinite(float(metrics["pg_loss"]))
+
+
+def test_ppo_off_path_has_no_metrics_key(monkeypatch):
+    init_fn, train_step = _tiny_ppo(False, monkeypatch)
+    assert train_step.metrics_spec is None
+    _, metrics = jax.jit(train_step)(init_fn(jax.random.PRNGKey(0)))
+    assert "device_metrics" not in metrics
+
+
+def test_checkify_gate_off_on_and_error_event(tmp_path, monkeypatch):
+    from jax.experimental import checkify
+
+    from cpr_tpu.train.ppo import maybe_checkify
+
+    # off: plain jit passthrough
+    monkeypatch.delenv(telemetry.CHECKIFY_ENV_VAR, raising=False)
+    f = maybe_checkify(lambda x: x * 2.0)
+    assert float(f(jnp.float32(3.0))) == 6.0
+
+    monkeypatch.setenv(telemetry.CHECKIFY_ENV_VAR, "1")
+    path = tmp_path / "checkify.jsonl"
+    telemetry.configure(str(path))
+    try:
+        # on + clean program: transparent
+        g = maybe_checkify(lambda x: x * 2.0)
+        assert float(g(jnp.float32(3.0))) == 6.0
+        # on + poisoned program: telemetry event, then the usual raise
+        bad = maybe_checkify(lambda x: x / jnp.zeros_like(x))
+        with pytest.raises(checkify.JaxRuntimeError, match="zero"):
+            bad(jnp.float32(1.0))
+    finally:
+        telemetry.configure(None)
+    with open(path) as f:
+        events = [json.loads(line) for line in f]
+    (err,) = [e for e in events if e.get("name") == "checkify_error"]
+    assert "zero" in err["error"]
+
+
+# -- trace_summary schema v2 --------------------------------------------------
+
+
+def _load_trace_summary():
+    path = os.path.join(os.path.dirname(__file__), "..", "tools",
+                        "trace_summary.py")
+    spec = importlib.util.spec_from_file_location("trace_summary", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_trace_summary_v2_tables_and_expect(tmp_path, capsys):
+    ts = _load_trace_summary()
+    path = tmp_path / "v2.jsonl"
+    tele = telemetry.Telemetry(str(path))
+    with tele.span("measure", env_steps=10):
+        pass
+    tele.event("compile", fn="run", arg_shapes="[f32[8]]",
+               trace_s=0.1, compile_s=0.5)
+    tele.event("device_metrics", scope="rollout", metrics={
+        "env_steps": 768,
+        "reward": {"min": 0.0, "max": 1.0, "sum": 3.0, "count": 6.0,
+                   "mean": 0.5},
+        "never": {"min": None, "max": None, "sum": 0.0, "count": 0.0,
+                  "mean": None},
+        "hist": {"edges": [1.0, 2.0], "counts": [0, 1, 2]},
+    })
+    tele.event("vi_residuals", impl="while", n_sweeps=3,
+               residuals=[1.0, 0.1, 0.01], truncated=False)
+    tele.event("tpu_outage", reason="watchdog")
+    tele.manifest(config={})
+    tele.close()
+
+    ts.main(["trace_summary", str(path), "--validate", "--expect",
+             "device_metrics,compile,vi_residuals,tpu_outage"])
+    out = capsys.readouterr().out
+    assert "compiled fn" in out
+    assert "device_metrics scope=rollout" in out
+    assert "counts=[0, 1, 2]" in out
+    assert "vi_residuals impl=while" in out and "n_sweeps=3" in out
+    assert '"name": "tpu_outage"' in out  # stays a free-form line
+
+    # a missing expected type fails the artifact
+    with pytest.raises(SystemExit) as exc:
+        ts.main(["trace_summary", str(path), "--validate",
+                 "--expect=no_such_event"])
+    assert exc.value.code == 1
+
+    # a typed event missing its declared fields fails validation
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text(
+        json.dumps({"kind": "event", "name": "compile"}) + "\n"
+        + json.dumps({"kind": "event", "name": "device_metrics",
+                      "scope": "x"}) + "\n"
+        + json.dumps({"kind": "manifest", "backend": "cpu"}) + "\n")
+    events, badlines = ts.read_events(str(bad))
+    errors = ts.validate(events, badlines)
+    assert any("compile missing" in e for e in errors)
+    assert any("device_metrics missing ['metrics']" in e
+               for e in errors)

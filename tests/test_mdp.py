@@ -155,7 +155,7 @@ from cpr_tpu.mdp.explicit import vi_chunked
 c = Compiler(Fc16BitcoinSM(alpha=0.35, gamma=0.5, maximum_fork_length=16))
 tm = ptmdp(c.mdp(), horizon=100).tensor()
 ref = tm.value_iteration(stop_delta=1e-7)
-value, prog, pol, delta, it = vi_chunked(
+value, prog, pol, delta, it, _ = vi_chunked(
     tm.src, tm.act, tm.dst, tm.prob, tm.reward, tm.progress,
     tm.n_states, tm.n_actions, jnp.float32(1.0), jnp.float32(1e-7),
     1 << 30, accel_m=3)

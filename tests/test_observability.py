@@ -243,6 +243,28 @@ def test_bench_fallback_rows_carry_outage_fields():
     assert none["outage"] is True and none["last_known_tpu"] is None
 
 
+def test_bench_fallback_emits_tpu_outage_event(tmp_path):
+    """The same fallback that tags the row also marks the telemetry
+    stream with a schema-v2 `tpu_outage` point event, so a trace read
+    long after the run still explains the backend switch."""
+    import bench
+
+    path = tmp_path / "outage.jsonl"
+    telemetry.configure(str(path))
+    try:
+        bench._outage_fields("tpu watchdog timeout after 360s",
+                             "nakamoto_selfish_mining")
+    finally:
+        telemetry.configure(None)
+    (ev,) = [e for e in _events(path) if e.get("kind") == "event"]
+    assert ev["name"] == "tpu_outage"
+    assert "watchdog" in ev["reason"]
+    assert ev["metric_prefix"] == "nakamoto_selfish_mining"
+    missing = [k for k in telemetry.EVENT_FIELDS["tpu_outage"]
+               if k not in ev]
+    assert not missing
+
+
 def test_no_wall_clock_interval_timing_in_package():
     """Interval timing under cpr_tpu/ must use telemetry.now (monotonic
     perf_counter) or Span — never time.time().  Docstrings/comments may

@@ -3,18 +3,25 @@
 Reads the event file written via `CPR_TELEMETRY=<path>` (or
 `cpr_tpu.telemetry.configure`), prints per-span aggregates — calls,
 total/mean wall time, share of the total — and a throughput table for
-spans carrying counters (env_steps etc.), plus any manifests and
-outage/revert events.  The post-mortem half of the telemetry layer:
-`bench.py`, the training driver, and the sweeps write the stream; this
-reads it back without re-running anything.
+spans carrying counters (env_steps etc.), plus schema-v2 tables for
+`compile` events (per-function retrace counts and compile seconds),
+`device_metrics` events (in-graph counters/stats/histograms), and
+`vi_residuals` convergence trajectories, any manifests, and remaining
+point events (tpu_outage, revert, ...).  The post-mortem half of the
+telemetry layer: `bench.py`, the training driver, and the sweeps write
+the stream; this reads it back without re-running anything.
 
 `--validate` additionally checks the artifact is schema-complete
-(every span event carries the SPAN_KEYS, timestamps are monotonic
-non-negative intervals, at least one manifest names its backend) and
-exits nonzero otherwise — `make telemetry-smoke` runs a tiny bench and
-asserts through this mode.
+(every span event carries the SPAN_KEYS, typed point events carry
+their EVENT_FIELDS, timestamps are monotonic non-negative intervals,
+at least one manifest names its backend) and exits nonzero otherwise —
+`make telemetry-smoke` runs a tiny bench and asserts through this
+mode.  `--expect name[,name...]` (with --validate) further requires at
+least one event of each named type in the stream, so the smoke run
+fails loudly if a producer silently stops emitting.
 
-Usage: python tools/trace_summary.py <telemetry.jsonl> [--validate]
+Usage: python tools/trace_summary.py <telemetry.jsonl>
+           [--validate] [--expect device_metrics,compile]
 """
 
 import json
@@ -25,7 +32,7 @@ from collections import defaultdict
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 ".."))
 
-from cpr_tpu.telemetry import SPAN_KEYS  # noqa: E402
+from cpr_tpu.telemetry import EVENT_FIELDS, SPAN_KEYS  # noqa: E402
 
 
 def read_events(path):
@@ -42,8 +49,9 @@ def read_events(path):
     return events, bad
 
 
-def validate(events, bad):
-    """Schema-completeness errors for `--validate` (empty list = ok)."""
+def validate(events, bad, expect=()):
+    """Schema-completeness errors for `--validate` (empty list = ok).
+    `expect` names event types at least one of which must appear."""
     errors = list(bad)
     if not events:
         errors.append("empty event stream")
@@ -59,9 +67,21 @@ def validate(events, bad):
                       and abs((e["t_end"] - e["t_start"]) - e["dur_s"])
                       < 1e-6 + 1e-9 * abs(e["dur_s"])):
                 errors.append(f"event {i}: non-monotonic span timestamps")
+        elif e["kind"] == "event":
+            # typed point events (schema v2) carry their declared fields
+            required = EVENT_FIELDS.get(e.get("name"))
+            if required:
+                missing = [k for k in required if k not in e]
+                if missing:
+                    errors.append(
+                        f"event {i}: {e['name']} missing {missing}")
     manifests = [e for e in events if e.get("kind") == "manifest"]
     if not any(m.get("backend") for m in manifests):
         errors.append("no manifest with a backend field")
+    names = {e.get("name") for e in events if e.get("kind") == "event"}
+    for want in expect:
+        if want not in names:
+            errors.append(f"expected at least one '{want}' event")
     return errors
 
 
@@ -93,24 +113,91 @@ def summarize(events, out=sys.stdout):
                 rate = f"{n / dur:,.0f}" if dur > 0 else "-"
                 print(f"{path:<40} {k:<12} {n:>14,.0f} {rate:>14}",
                       file=out)
+    _compile_table(events, out)
+    _device_metrics_tables(events, out)
+    _vi_residuals_lines(events, out)
     for m in (e for e in events if e.get("kind") == "manifest"):
         cfg = m.get("config") or {}
         print(f"\nmanifest: backend={m.get('backend')} "
               f"devices={m.get('device_count')}x{m.get('device_kind')} "
               f"jax={m.get('jax_version')} git={str(m.get('git_sha'))[:12]} "
               f"config={json.dumps(cfg, sort_keys=True)}", file=out)
-    for e in (e for e in events if e.get("kind") == "event"):
+    tabled = ("compile", "device_metrics", "vi_residuals")
+    for e in (e for e in events if e.get("kind") == "event"
+              and e.get("name") not in tabled):
         keys = {k: v for k, v in e.items() if k not in ("kind", "ts")}
         print(f"event: {json.dumps(keys, sort_keys=True)}", file=out)
 
 
+def _compile_table(events, out):
+    """Per-function compile/retrace aggregate: `count > 1` for one fn
+    under stable shapes is the retrace smell the compile_watch exists
+    to surface."""
+    comp = [e for e in events if e.get("kind") == "event"
+            and e.get("name") == "compile"]
+    if not comp:
+        return
+    agg = defaultdict(lambda: [0, 0.0, 0.0])
+    for e in comp:
+        a = agg[e.get("fn", "?")]
+        a[0] += 1
+        a[1] += e.get("trace_s") or 0.0
+        a[2] += e.get("compile_s") or 0.0
+    print(f"\n{'compiled fn':<32} {'count':>6} {'trace_s':>9} "
+          f"{'compile_s':>10}", file=out)
+    for fn, (n, tr, co) in sorted(agg.items(), key=lambda kv: -kv[1][2]):
+        print(f"{fn:<32} {n:>6} {tr:>9.3f} {co:>10.3f}", file=out)
+
+
+def _device_metrics_tables(events, out):
+    for e in events:
+        if e.get("kind") != "event" or e.get("name") != "device_metrics":
+            continue
+        print(f"\ndevice_metrics scope={e.get('scope')}", file=out)
+        for k, v in sorted((e.get("metrics") or {}).items()):
+            if isinstance(v, dict) and "counts" in v:
+                print(f"  {k:<24} counts={v['counts']}", file=out)
+            elif isinstance(v, dict):
+                if v.get("count"):
+                    print(f"  {k:<24} n={v['count']:.0f} "
+                          f"min={v['min']:.4g} max={v['max']:.4g} "
+                          f"mean={v['mean']:.4g}", file=out)
+                else:
+                    print(f"  {k:<24} n=0", file=out)
+            else:
+                print(f"  {k:<24} {v}", file=out)
+
+
+def _vi_residuals_lines(events, out):
+    for e in events:
+        if e.get("kind") != "event" or e.get("name") != "vi_residuals":
+            continue
+        r = e.get("residuals") or []
+        head = (f"first={r[0]:.4g} last={r[-1]:.4g} " if r else "")
+        print(f"\nvi_residuals impl={e.get('impl')} "
+              f"n_sweeps={e.get('n_sweeps')} {head}"
+              f"kept={len(r)} truncated={e.get('truncated')}", file=out)
+
+
 def main(argv):
-    args = [a for a in argv[1:] if not a.startswith("--")]
+    argv = list(argv[1:])
+    expect = []
+    if "--expect" in argv:
+        i = argv.index("--expect")
+        if i + 1 >= len(argv):
+            raise SystemExit("--expect needs a comma-separated value")
+        expect = argv[i + 1].split(",")
+        del argv[i:i + 2]
+    for a in list(argv):
+        if a.startswith("--expect="):
+            expect = a.split("=", 1)[1].split(",")
+            argv.remove(a)
+    args = [a for a in argv if not a.startswith("--")]
     if len(args) != 1:
         raise SystemExit(__doc__)
     events, bad = read_events(args[0])
     if "--validate" in argv:
-        errors = validate(events, bad)
+        errors = validate(events, bad, expect=expect)
         if errors:
             for err in errors:
                 print(f"INVALID: {err}", file=sys.stderr)
