@@ -4,7 +4,7 @@
 
 CPU_MESH = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test test-slow bench telemetry-smoke dryrun sweeps ghostdag train-dummy native asan
+.PHONY: test test-slow bench telemetry-smoke resilience-smoke dryrun sweeps ghostdag train-dummy native asan
 
 test:  ## fast tier (< ~8 min on the 1-core host)
 	python -m pytest tests/ -q
@@ -38,6 +38,15 @@ telemetry-smoke:  ## tiny nakamoto CPU bench with telemetry + in-graph
 		CPR_TELEMETRY=$(TELEMETRY_SMOKE) python bench.py
 	python tools/trace_summary.py $(TELEMETRY_SMOKE) --validate \
 		--expect device_metrics,compile
+
+RESILIENCE_SMOKE_DIR = /tmp/cpr-resilience-smoke
+
+resilience-smoke:  ## kill-and-resume determinism proof: tiny CPU train,
+	## inject a crash mid-run, resume, assert the concatenated metrics
+	## history is bit-identical to an uninterrupted run, and validate
+	## the schema-v3 resilience telemetry events
+	rm -rf $(RESILIENCE_SMOKE_DIR)
+	python tools/resilience_smoke.py $(RESILIENCE_SMOKE_DIR)
 
 dryrun:  ## multi-chip sharding dry run on the virtual CPU mesh
 	$(CPU_MESH) python -c "from __graft_entry__ import dryrun_multichip; dryrun_multichip(8)"

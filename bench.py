@@ -23,6 +23,11 @@ import sys
 import time
 
 from cpr_tpu import device_metrics, telemetry
+# GuardFailure moved to the shared resilience layer (same taxonomy as
+# the training/VI retry paths); re-exported here so bench.GuardFailure
+# keeps working for callers and the GUARD_RC child protocol
+from cpr_tpu.resilience import (GuardFailure, TransientFault,
+                                default_classify, with_retries)
 
 
 # v5e (TPU v5 lite) single-chip peaks for the roofline fields: bf16
@@ -271,11 +276,45 @@ SM1_GUARD = (0.38, 0.45)
 GUARD_RC = 3
 
 
-class GuardFailure(Exception):
-    """A deterministic correctness-guard violation — distinct from
-    AssertionError so assertions raised inside jax internals or env code
-    cannot masquerade as guard failures and suppress the retry/descent
-    ladder (they are infra failures and should be retried)."""
+class BenchHang(TransientFault):
+    """Child hung past the watchdog.  Transient in the taxonomy, but
+    `_bench_classify` refuses a same-rung retry: a hang means a wedged
+    device, handled by ladder descent / the straight-to-CPU policy,
+    never by probing the wedged rung again."""
+
+    pass
+
+
+def _bench_classify(exc: BaseException) -> bool:
+    """Retry classifier for the child-process protocol: GuardFailure is
+    deterministic (shared rule), a hang escalates instead of retrying,
+    any other child failure is a transient chip claim worth one paused
+    re-attempt."""
+    if isinstance(exc, BenchHang):
+        return False
+    return default_classify(exc)
+
+
+def _attempt_raising(timeout: float, mode: str = "--direct", extra=None,
+                     env_extra=None) -> str:
+    """`_attempt` with the child's exit status mapped onto the shared
+    failure taxonomy, so `with_retries(_bench_classify)` is the single
+    place deciding what gets retried: rc == GUARD_RC -> GuardFailure
+    (never retried — the invariant that device faults cannot masquerade
+    as guard failures lives in the GUARD_RC exit path of run_one/main),
+    hang -> BenchHang, any other nonzero rc -> TransientFault (.rc
+    carries the code).  Returns the child's JSON lines on success."""
+    status, payload = _attempt(timeout, mode, extra=extra,
+                               env_extra=env_extra)
+    if status == "ok":
+        return payload
+    if status == "failed" and payload == GUARD_RC:
+        raise GuardFailure("child exited GUARD_RC (correctness guard)")
+    if status == "hung":
+        raise BenchHang(f"hung past {timeout:.0f}s watchdog")
+    fault = TransientFault(f"rc={payload}")
+    fault.rc = payload
+    raise fault
 
 
 def _cpu_baseline(name: str):
@@ -580,36 +619,47 @@ def run_configs_isolated(timeout: float):
         if wedged:
             last = "device wedged by an earlier config"
         for n_envs in () if stop else ladder:
-            for retry in range(2):
-                status, payload = _attempt(
-                    timeout, "--direct-one", extra=[name],
-                    env_extra={"CPR_BENCH_NENVS": str(n_envs)})
-                if status == "ok":
-                    cand = json.loads(payload.splitlines()[-1])
-                    if cand.get("backend") == "cpu":
-                        # chip-claim race: the child came up on CPU.
-                        # Not a ladder success, but it IS a valid CPU
-                        # fallback row — keep it, stop probing.
-                        last, cpu_row = "backend came up cpu", cand
-                        stop = True
-                        break
-                    row = cand
-                    break
-                if status == "failed" and payload == GUARD_RC:
-                    # deterministic correctness failure: no retry, no
-                    # descent, and no CPU run to paper over it —
-                    # surface the error row (size is what we REQUESTED;
-                    # the child's stderr names what actually ran)
-                    last = ("correctness guard failed "
-                            f"(requested n_envs={n_envs})")
-                    guard_failed = stop = True
-                    break
-                last = (f"rc={payload}" if status == "failed"
-                        else "hung past watchdog")
+            # Every rung gets one same-rung retry (with_retries
+            # max_attempts=2): no rung is a known crasher anymore (the
+            # 65536 ethereum shape was dropped from the ladder), so
+            # non-hang failures are transient chip claims (single-rung
+            # configs: brief pause) or a recovering worker after a
+            # crash (multi-rung ladders: observed 60 s insufficient
+            # post-crash, twice — wait longer).  Classification lives
+            # in _bench_classify: GuardFailure and hangs never burn the
+            # same-rung retry.
+            pause = 15.0 if len(ladder) == 1 else 120.0
+
+            def _note_fault(attempt, exc, delay, _name=name, _n=n_envs):
+                nonlocal last_fault_ts
+                last_fault_ts = telemetry.now()
+                print(f"bench: {_name} n_envs={_n} {exc}",
+                      file=sys.stderr)
+
+            try:
+                payload = with_retries(
+                    lambda: _attempt_raising(
+                        timeout, "--direct-one", extra=[name],
+                        env_extra={"CPR_BENCH_NENVS": str(n_envs)}),
+                    classify=_bench_classify, max_attempts=2,
+                    base_delay_s=pause, max_delay_s=pause,
+                    jitter_frac=0.0, on_retry=_note_fault,
+                    name=f"bench:{name}")
+            except GuardFailure:
+                # deterministic correctness failure: no retry, no
+                # descent, and no CPU run to paper over it — surface
+                # the error row (size is what we REQUESTED; the child's
+                # stderr names what actually ran)
+                last = ("correctness guard failed "
+                        f"(requested n_envs={n_envs})")
+                guard_failed = stop = True
+                break
+            except BenchHang:
+                last = "hung past watchdog"
                 last_fault_ts = telemetry.now()
                 print(f"bench: {name} n_envs={n_envs} {last}",
                       file=sys.stderr)
-                if status == "hung" and n_envs != ladder[-1]:
+                if n_envs != ladder[-1]:
                     # a crash can present as an init-hang in the NEXT
                     # child while the worker restarts; with descent
                     # rungs left, pause for recovery and step down
@@ -618,27 +668,33 @@ def run_configs_isolated(timeout: float):
                           f"descending after recovery pause",
                           file=sys.stderr)
                     time.sleep(120.0)
-                    break
-                if status == "hung":
-                    # hang at the final rung: treat as a wedged device
-                    # — straight to CPU (main()'s policy), for this and
-                    # all remaining configs
-                    wedged = stop = True
-                    break
-                # Every rung gets one same-rung retry: no rung is a
-                # known crasher anymore (the 65536 ethereum shape was
-                # dropped from the ladder), so failures are transient
-                # chip claims (single-rung configs: brief pause) or a
-                # recovering worker after a crash (multi-rung ladders:
-                # observed 60 s insufficient post-crash, twice — wait
-                # longer).  The pause also runs after the final retry
-                # when another rung remains, so descent never probes a
-                # restarting backend; no pause before a CPU fallback,
-                # which does not touch the worker.
-                if retry == 0 or n_envs != ladder[-1]:
-                    time.sleep(15.0 if len(ladder) == 1 else 120.0)
-            if row is not None or stop:
+                    continue
+                # hang at the final rung: treat as a wedged device —
+                # straight to CPU (main()'s policy), for this and all
+                # remaining configs
+                wedged = stop = True
                 break
+            except TransientFault as e:
+                last = f"rc={e.rc}" if hasattr(e, "rc") else str(e)
+                last_fault_ts = telemetry.now()
+                print(f"bench: {name} n_envs={n_envs} {last}",
+                      file=sys.stderr)
+                if n_envs != ladder[-1]:
+                    # pause before descending too, so descent never
+                    # probes a restarting backend; no pause before a
+                    # CPU fallback, which does not touch the worker
+                    time.sleep(pause)
+                continue
+            cand = json.loads(payload.splitlines()[-1])
+            if cand.get("backend") == "cpu":
+                # chip-claim race: the child came up on CPU.  Not a
+                # ladder success, but it IS a valid CPU fallback row —
+                # keep it, stop probing.
+                last, cpu_row = "backend came up cpu", cand
+                stop = True
+            else:
+                row = cand
+            break
         if row is None and cpu_row is None and not guard_failed:
             status, payload = _attempt(
                 timeout, "--direct-one", extra=[name],
@@ -739,36 +795,40 @@ def main():
         # and a merely-slow config must not be classified as a wedge
         run_configs_isolated(timeout * 2)
         return
+    # shared retry protocol (cpr_tpu/resilience.py): one paused retry
+    # for transient child failures; GuardFailure is never retried and
+    # never masked by a CPU run; a hang skips the retry entirely —
+    # wedged devices go straight to CPU
     fallback_reason = "tpu attempts failed"
-    for attempt in range(2):
-        status, payload = _attempt(timeout, "--direct")
-        if status == "ok":
-            print(payload)
-            return
-        if status == "failed" and payload == GUARD_RC:
-            # deterministic correctness-guard failure on the TPU: do
-            # NOT retry or paper over it with a CPU fallback — print an
-            # error row so the failure is visible in the artifact
-            print(json.dumps({
-                "metric":
-                    "nakamoto_selfish_mining_env_steps_per_sec_per_chip",
-                "error": "correctness guard failed on tpu backend",
-            }))
-            return
-        if status == "hung":
-            print(f"bench: TPU attempt hung past {timeout:.0f}s (wedged "
-                  f"backend?), falling back to CPU", file=sys.stderr)
-            fallback_reason = (f"tpu watchdog timeout after {timeout:.0f}s "
-                               f"(wedged backend?)")
-            break
-        print(f"bench: TPU attempt {attempt + 1} rc={payload}",
-              file=sys.stderr)
-        fallback_reason = f"tpu attempts failed (last rc={payload})"
-        if attempt == 0:
-            time.sleep(15.0)  # transiently claimed chip may free up
-    else:
+    try:
+        print(with_retries(
+            lambda: _attempt_raising(timeout, "--direct"),
+            classify=_bench_classify, max_attempts=2,
+            base_delay_s=15.0, max_delay_s=15.0, jitter_frac=0.0,
+            on_retry=lambda a, e, d: print(
+                f"bench: TPU attempt {a} {e}", file=sys.stderr),
+            name="bench"))
+        return
+    except GuardFailure:
+        # deterministic correctness-guard failure on the TPU: print an
+        # error row so the failure is visible in the artifact
+        print(json.dumps({
+            "metric":
+                "nakamoto_selfish_mining_env_steps_per_sec_per_chip",
+            "error": "correctness guard failed on tpu backend",
+        }))
+        return
+    except BenchHang:
+        print(f"bench: TPU attempt hung past {timeout:.0f}s (wedged "
+              f"backend?), falling back to CPU", file=sys.stderr)
+        fallback_reason = (f"tpu watchdog timeout after {timeout:.0f}s "
+                           f"(wedged backend?)")
+    except TransientFault as e:
         print("bench: TPU attempts failed, falling back to CPU",
               file=sys.stderr)
+        fallback_reason = (f"tpu attempts failed (last rc={e.rc})"
+                           if hasattr(e, "rc")
+                           else f"tpu attempts failed ({e})")
     run_bench("cpu", fallback_reason)  # configs mode returned above
 
 

@@ -370,7 +370,9 @@ def _anderson_mix(hist):
 
 
 def run_chunk_driver(chunk_step, S, dtype, stop_delta, max_iter,
-                     chunk: int = 64, accel_m: int = 0):
+                     chunk: int = 64, accel_m: int = 0,
+                     checkpoint_path: str | None = None,
+                     checkpoint_every: int = 1):
     """Shared host loop for device-while-free VI: call
     `chunk_step(value, prog, steps) -> (value, prog, pol, deltas)` in
     full chunks with a chunk=1 tail (steps is a static argnum in both
@@ -386,7 +388,21 @@ def run_chunk_driver(chunk_step, S, dtype, stop_delta, max_iter,
     still certified by a PLAIN sweep's delta inside the next chunk, so
     a bad extrapolation can slow things down but never corrupt the
     result; the safeguard drops the history whenever the post-mix
-    delta grows."""
+    delta grows.
+
+    `checkpoint_path` makes a multi-hour solve preemption-safe: the
+    post-chunk (value, progress, iteration, residual history) is saved
+    atomically every `checkpoint_every` chunks, an existing file seeds
+    the solve (validated against S/dtype), and the file is deleted on
+    completion — it is crash-recovery scratch, not an artifact.  The
+    checkpoint stores the PLAIN chunk output, so with accel_m=0 a
+    killed-and-resumed solve replays the exact sweep sequence
+    (bit-identical result); with acceleration on, resume drops the
+    mixing history (the fixpoint is unchanged, the path there may
+    differ).  Each chunk dispatch is retried on transient device
+    faults via resilience.with_retries."""
+    from cpr_tpu import resilience, telemetry
+
     z = jnp.zeros(S, dtype)
     value, prog = z, z
     it = 0
@@ -395,17 +411,41 @@ def run_chunk_driver(chunk_step, S, dtype, stop_delta, max_iter,
     hist: list = []
     prev_delta = None
     resids: list = []
+    if checkpoint_path is not None and os.path.exists(checkpoint_path):
+        v0, p0, it, r0 = resilience.load_vi_checkpoint(
+            checkpoint_path, S=S, dtype=dtype)
+        value, prog = jnp.asarray(v0), jnp.asarray(p0)
+        resids = [r0] if r0.size else []
+        telemetry.current().event("resume", path=checkpoint_path,
+                                  update=int(it), scope="vi")
+    chunks_done = 0
     while it < max_iter:
         step = chunk if max_iter - it >= chunk else 1
         x_value, x_prog = value, prog
-        g_value, g_prog, pol, deltas = chunk_step(value, prog, step)
+
+        def one_chunk():
+            resilience.fault_point("vi_chunk")
+            return chunk_step(x_value, x_prog, step)
+
+        g_value, g_prog, pol, deltas = resilience.with_retries(
+            one_chunk, max_attempts=3, base_delay_s=0.2, max_delay_s=5.0,
+            name="vi_chunk")
         it += step
         value, prog = g_value, g_prog
         # the convergence check below already syncs on the chunk, so
         # pulling the full per-sweep delta vector costs no extra trip
         resids.append(np.asarray(deltas))
         delta = deltas[-1]
-        if float(delta) <= float(stop_delta):
+        chunks_done += 1
+        converged = float(delta) <= float(stop_delta)
+        if (checkpoint_path is not None and not converged
+                and chunks_done % checkpoint_every == 0):
+            resilience.save_vi_checkpoint(
+                checkpoint_path, value=value, prog=prog, it=it,
+                resids=resids, stop_delta=float(stop_delta))
+            telemetry.current().event("checkpoint", path=checkpoint_path,
+                                      what="vi", update=int(it))
+        if converged:
             break
         # never mix on the way out: a max_iter exit must return the
         # plain chunk output (delta/policy describe THAT iterate; an
@@ -419,20 +459,32 @@ def run_chunk_driver(chunk_step, S, dtype, stop_delta, max_iter,
                 if len(hist) >= 2:
                     value, prog = _anderson_mix(hist)
             prev_delta = float(delta)
+    if checkpoint_path is not None and os.path.exists(checkpoint_path):
+        # crash-recovery scratch only: a finished solve must not leave
+        # a checkpoint a later (different) solve could seed from
+        os.unlink(checkpoint_path)
+        try:
+            os.unlink(checkpoint_path + ".json")
+        except OSError:
+            pass
     resid = (np.concatenate(resids) if resids
              else np.zeros(0, np.dtype(dtype)))
     return value, prog, pol, delta, it, resid
 
 
 def vi_chunked(src, act, dst, prob, reward, progress, S, A, discount,
-               stop_delta, max_iter, chunk: int = 64, accel_m: int = 0):
+               stop_delta, max_iter, chunk: int = 64, accel_m: int = 0,
+               checkpoint_path: str | None = None,
+               checkpoint_every: int = 1):
     """Host-driven VI: repeat `_vi_chunk` until the last in-chunk delta
     drops below stop_delta (or max_iter sweeps ran).  Same fixpoint and
     return shape as vi_while_loop (the residual trajectory here is the
     FULL per-sweep history, not a ring) — extra post-convergence sweeps
     are no-ops on a converged value function.  `accel_m` opts into Anderson
     acceleration (see run_chunk_driver; ~5x fewer sweeps measured on
-    the fc16 PT-MDP, same fixpoint to stop_delta)."""
+    the fc16 PT-MDP, same fixpoint to stop_delta).  `checkpoint_path`
+    opts into between-chunk crash checkpoints + resume
+    (run_chunk_driver)."""
     valid, any_valid = _vi_valid(src, act, prob, S, A)
 
     def chunk_step(value, prog, steps):
@@ -440,7 +492,9 @@ def vi_chunked(src, act, dst, prob, reward, progress, S, A, discount,
                          discount, value, prog, valid, any_valid, steps)
 
     return run_chunk_driver(chunk_step, S, prob.dtype, stop_delta,
-                            max_iter, chunk, accel_m=accel_m)
+                            max_iter, chunk, accel_m=accel_m,
+                            checkpoint_path=checkpoint_path,
+                            checkpoint_every=checkpoint_every)
 
 
 @partial(jax.jit, static_argnums=(6, 9))
@@ -572,7 +626,9 @@ class TensorMDP:
 
     def value_iteration(self, *, max_iter: int = 0, discount: float = 1.0,
                         eps: float | None = None, stop_delta: float | None = None,
-                        verbose: bool = False, impl: str | None = None):
+                        verbose: bool = False, impl: str | None = None,
+                        checkpoint_path: str | None = None,
+                        checkpoint_every: int = 1):
         """eps-optimal value iteration (reference semantics:
         mdp/lib/explicit_mdp.py:97-177 — double-buffered dense sweep that
         also tracks expected progress and the greedy policy; ties go to
@@ -583,13 +639,24 @@ class TensorMDP:
         "chunked" (fixed-size scan chunks, host-side convergence check —
         the axon-TPU fault workaround, see _vi_chunk).  The env var
         CPR_VI_IMPL overrides the default so on-chip tooling can switch
-        without code changes; both produce the same fixpoint."""
+        without code changes; both produce the same fixpoint.
+
+        checkpoint_path (chunked impl only): save resumable solve state
+        between chunks and seed from an existing file — the while impl
+        is a single device program with no host seam to checkpoint at
+        (docs/RESILIENCE.md)."""
         stop_delta = self.resolve_stop_delta(
             discount=discount, eps=eps, stop_delta=stop_delta, max_iter=max_iter)
         self._check_segment_width()
         impl = resolve_vi_impl(impl)
+        if checkpoint_path is not None and impl == "while":
+            raise ValueError(
+                "checkpoint_path requires impl='chunked': the while impl "
+                "runs as one device program with no between-chunk seam")
         t0 = now()
-        run = _vi_loop if impl == "while" else vi_chunked
+        run = (_vi_loop if impl == "while" else
+               partial(vi_chunked, checkpoint_path=checkpoint_path,
+                       checkpoint_every=checkpoint_every))
         value, progress, policy, delta, it, resid = run(
             self.src, self.act, self.dst, self.prob, self.reward,
             self.progress, self.n_states, self.n_actions,

@@ -121,7 +121,9 @@ def sharded_value_iteration(tm: TensorMDP, mesh: Mesh, *, axis: str = "d",
                             eps: float | None = None,
                             stop_delta: float | None = None,
                             impl: str | None = None, chunk: int = 64,
-                            accel_m: int = 0):
+                            accel_m: int = 0,
+                            checkpoint_path: str | None = None,
+                            checkpoint_every: int = 1):
     """Value iteration with the transition table sharded over the mesh.
 
     Each device owns a contiguous transition chunk (padded with
@@ -140,11 +142,19 @@ def sharded_value_iteration(tm: TensorMDP, mesh: Mesh, *, axis: str = "d",
     opts the chunked impl into Anderson acceleration between chunks
     (explicit.run_chunk_driver — ~5x fewer sweeps on the fc16 PT-MDP,
     same fixpoint to stop_delta; the GhostDAG capstone turns it on).
+    `checkpoint_path` (chunked impl only) opts into between-chunk
+    crash checkpoints + resume — values/policies are replicated, so
+    the host-side checkpoint seam is identical to the single-device
+    driver's (docs/RESILIENCE.md).
     """
     stop_delta = tm.resolve_stop_delta(
         discount=discount, eps=eps, stop_delta=stop_delta, max_iter=max_iter)
     tm._check_segment_width()
     impl = resolve_vi_impl(impl)
+    if checkpoint_path is not None and impl == "while":
+        raise ValueError(
+            "checkpoint_path requires impl='chunked': the while impl "
+            "runs as one device program with no between-chunk seam")
     t0 = now()
     n = mesh.shape[axis]
     S, A = tm.n_states, tm.n_actions
@@ -197,7 +207,9 @@ def sharded_value_iteration(tm: TensorMDP, mesh: Mesh, *, axis: str = "d",
             )(*coo, value, prog)
 
         return run_chunk_driver(chunk_fn, S, tm.prob.dtype, stop_delta,
-                                max_iter_, chunk, accel_m=accel_m)
+                                max_iter_, chunk, accel_m=accel_m,
+                                checkpoint_path=checkpoint_path,
+                                checkpoint_every=checkpoint_every)
 
     if impl == "while":
         value, progress_v, policy, delta, it, resid = run()

@@ -1,0 +1,469 @@
+"""Crash-safety layer: atomic writes, full-state snapshots, retry with
+backoff, preemption handling, and deterministic fault injection.
+
+TPU preemption and device faults are routine at production scale, so
+every long-running loop in the repo (PPO training, chunked VI solves,
+the bench watchdog) funnels its recovery logic through this module:
+
+* **Atomic writes** — `atomic_write_bytes`/`atomic_write_json`: tmp
+  file in the destination directory + fsync + `os.replace`, so a crash
+  mid-write can never leave a half-written artifact under the final
+  name.  A reader sees the old file or the new file, nothing else.
+
+* **Full-state train snapshots** — `save_train_snapshot` /
+  `load_train_snapshot` serialize the ENTIRE train carry (TrainState
+  params + opt_state + step, env state, live observations, PRNG key)
+  plus best/revert bookkeeping via flax msgpack, with the manifest
+  embedded in the payload (a sidecar `.json` rides along for humans,
+  but resume trusts only the atomically-written msgpack — a crash
+  between two file renames cannot produce a torn pair).  Restoring the
+  snapshot and continuing is bit-identical to never having stopped
+  (proven by tests/test_resilience.py and `make resilience-smoke`).
+
+* **Retry/backoff** — `with_retries(fn, classify=...)`: exponential
+  backoff + jitter, a `retry` telemetry event per re-attempt, and a
+  classifier that separates deterministic failures (`GuardFailure` —
+  retrying cannot help and must not mask the signal) from transient
+  device faults (worth re-attempting).  `AssertionError` is
+  deliberately *retryable*: assertions raised inside jax internals are
+  infra failures and must not masquerade as guard failures (bench.py
+  invariant, now shared and under test).
+
+* **Preemption** — `preemption_guard()` installs SIGTERM/SIGINT
+  handlers that set a flag; loops poll `preempt_requested()` between
+  updates, write a final snapshot + `preempt-model.msgpack`, emit a
+  `preempted` event, and return cleanly (TPU preemption-notice
+  semantics: you get seconds, not minutes).
+
+* **Fault injection** — `CPR_FAULT_INJECT="kill@update=7"` (grammar in
+  docs/RESILIENCE.md) arms one-shot faults at named sites
+  (`fault_point("update", i)` in the loops), so every recovery path
+  above is exercised by fast deterministic CPU tests instead of hoping
+  a real outage finds the bugs first.
+
+Import-time this module is jax-free (flax/numpy are imported inside
+the snapshot helpers) so bench.py's parent process can use the retry
+machinery without initializing a backend.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import random
+import signal
+import tempfile
+import threading
+import time
+from contextlib import contextmanager
+from typing import Callable
+
+from cpr_tpu import telemetry
+
+SNAPSHOT_VERSION = 1
+FAULT_ENV_VAR = "CPR_FAULT_INJECT"
+
+# metrics.jsonl keys that legitimately differ between two bit-identical
+# runs (fenced wall time and its derived rate) — stripped by
+# `metrics_fingerprint` before any determinism comparison
+VOLATILE_METRIC_KEYS = ("wall_s", "steps_per_sec")
+
+
+# -- failure taxonomy --------------------------------------------------------
+
+
+class GuardFailure(Exception):
+    """A deterministic correctness-guard violation — distinct from
+    AssertionError so assertions raised inside jax internals or env code
+    cannot masquerade as guard failures and suppress the retry/descent
+    ladder (they are infra failures and should be retried).  Never
+    retried: the same inputs will fail the same way, and a retry would
+    only bury the signal."""
+
+
+class TransientFault(Exception):
+    """A failure worth re-attempting: transient chip claims, I/O
+    hiccups, a recovering worker.  Raisers may attach context (bench
+    attaches the child's return code as `.rc`)."""
+
+
+class InjectedFault(Exception):
+    """Base for faults raised by the CPR_FAULT_INJECT harness."""
+
+
+class InjectedKill(InjectedFault):
+    """Simulated hard kill at a fault point.  Classified fatal (a real
+    SIGKILL cannot be retried from inside the process) so it unwinds
+    the whole loop exactly like the crash it stands in for."""
+
+
+def default_classify(exc: BaseException) -> bool:
+    """Shared retry classifier: True = transient, worth retrying.
+
+    Deterministic failures (GuardFailure) and simulated kills are
+    fatal; everything else derived from Exception — including
+    AssertionError, per the masquerade invariant above — is presumed
+    transient.  with_retries only ever catches Exception, so
+    KeyboardInterrupt/SystemExit always propagate regardless."""
+    return not isinstance(exc, (GuardFailure, InjectedKill))
+
+
+def with_retries(fn: Callable, *, classify: Callable | None = None,
+                 max_attempts: int = 3, base_delay_s: float = 0.5,
+                 max_delay_s: float = 30.0, jitter_frac: float = 0.25,
+                 sleep: Callable = time.sleep, rng=None,
+                 on_retry: Callable | None = None, name: str | None = None):
+    """Call `fn()` with exponential backoff on transient failures.
+
+    Delay before attempt k+1 is `min(base * 2**(k-1), max) * (1 + j)`,
+    j uniform in [0, jitter_frac) — jitter decorrelates retry storms
+    when several workers chase the same recovering device.  Each
+    re-attempt emits a `retry` telemetry event (attempt, delay_s,
+    error) and calls `on_retry(attempt, exc, delay_s)` if given (bench
+    uses it to stamp worker-fault timestamps).  `classify(exc) -> bool`
+    decides retryability (default: `default_classify`); a fatal
+    exception or the last attempt's failure re-raises immediately."""
+    classify = classify or default_classify
+    rand = rng if rng is not None else random.random
+    label = name or getattr(fn, "__name__", "fn")
+    for attempt in range(1, max_attempts + 1):
+        try:
+            return fn()
+        except Exception as exc:  # noqa: BLE001 — classifier decides
+            if attempt >= max_attempts or not classify(exc):
+                raise
+            delay = min(base_delay_s * (2.0 ** (attempt - 1)), max_delay_s)
+            delay *= 1.0 + jitter_frac * rand()
+            telemetry.current().event(
+                "retry", attempt=attempt, delay_s=round(delay, 3),
+                error=f"{type(exc).__name__}: {exc}", site=label)
+            if on_retry is not None:
+                on_retry(attempt, exc, delay)
+            sleep(delay)
+
+
+# -- atomic writes -----------------------------------------------------------
+
+
+def atomic_write_bytes(path: str, data: bytes):
+    """Write `data` to `path` atomically: tmp file in the same
+    directory (os.replace cannot cross filesystems), fsync, rename.
+    On any failure the tmp file is removed and `path` is untouched."""
+    path = os.path.abspath(path)
+    d = os.path.dirname(path)
+    os.makedirs(d or ".", exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, prefix=os.path.basename(path) + ".")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    # best-effort directory fsync so the rename itself is durable
+    try:
+        dfd = os.open(d or ".", os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+    except OSError:
+        pass
+
+
+def atomic_write_json(path: str, obj):
+    atomic_write_bytes(path, (json.dumps(obj, indent=2, default=str)
+                              + "\n").encode())
+
+
+# -- deterministic fault injection -------------------------------------------
+
+_ACTIONS = ("kill", "io_error", "fault", "nan", "preempt")
+_COUNTED_SITES = ("checkpoint", "vi_chunk")  # occurrence-counted sites
+
+
+class FaultSpec:
+    """One armed fault: `action@site=index` (e.g. `kill@update=7`).
+    Sites with an explicit loop index (`update`) match that index;
+    occurrence-counted sites (`checkpoint`, `vi_chunk`) match the n-th
+    time the process passes the site.  One-shot: fires once, then
+    disarms — a resumed run re-entering the same index must not
+    re-fire (the injected crash already happened)."""
+
+    def __init__(self, raw: str):
+        self.raw = raw.strip()
+        try:
+            action_site, idx = self.raw.split("=")
+            self.action, self.site = action_site.split("@")
+            self.index = int(idx)
+        except ValueError:
+            raise ValueError(
+                f"bad fault spec {raw!r}: want action@site=index "
+                f"(e.g. kill@update=7)") from None
+        if self.action not in _ACTIONS:
+            raise ValueError(f"bad fault action {self.action!r}: "
+                             f"one of {_ACTIONS}")
+        self.armed = True
+
+
+def parse_fault_specs(spec: str) -> list[FaultSpec]:
+    """Parse a comma-separated CPR_FAULT_INJECT value."""
+    return [FaultSpec(part) for part in spec.split(",") if part.strip()]
+
+
+class FaultInjector:
+    """Holds the armed specs + per-site occurrence counters."""
+
+    def __init__(self, specs):
+        self.specs = list(specs)
+        self.counts: dict[str, int] = {}
+
+    def fire(self, site: str, index: int | None = None) -> str | None:
+        """Called at a fault point.  Returns the action name for
+        cooperative actions ("nan", "preempt"), None when nothing
+        fires; raises for "kill"/"io_error"/"fault"."""
+        if index is None:
+            index = self.counts.get(site, 0) + 1
+            self.counts[site] = index
+        for s in self.specs:
+            if not (s.armed and s.site == site and s.index == index):
+                continue
+            s.armed = False
+            telemetry.current().event(
+                "fault_injected", spec=s.raw, site=site, index=index)
+            if s.action == "kill":
+                raise InjectedKill(s.raw)
+            if s.action == "io_error":
+                raise OSError(f"injected I/O error ({s.raw})")
+            if s.action == "fault":
+                raise TransientFault(f"injected device fault ({s.raw})")
+            if s.action == "preempt":
+                request_preempt(f"injected ({s.raw})")
+            return s.action
+        return None
+
+
+_injector: FaultInjector | None = None
+_injector_src: str | None = None
+
+
+def injector() -> FaultInjector:
+    """The process-wide injector, rebuilt (counters and armed state
+    reset) whenever the CPR_FAULT_INJECT value changes — so a resumed
+    run that unsets the var runs clean."""
+    global _injector, _injector_src
+    src = os.environ.get(FAULT_ENV_VAR, "")
+    if _injector is None or src != _injector_src:
+        _injector = FaultInjector(parse_fault_specs(src))
+        _injector_src = src
+    return _injector
+
+
+def fault_point(site: str, index: int | None = None) -> str | None:
+    """Mark a named fault-injection site.  `index` pins loop-indexed
+    sites (`update`); counted sites (`checkpoint`, `vi_chunk`) pass
+    None.  Free when CPR_FAULT_INJECT is unset (one dict lookup)."""
+    return injector().fire(site, index)
+
+
+# -- preemption --------------------------------------------------------------
+
+_PREEMPT = {"requested": False, "reason": None}
+
+
+def request_preempt(reason: str = "signal"):
+    _PREEMPT["requested"] = True
+    _PREEMPT["reason"] = reason
+
+
+def preempt_requested() -> bool:
+    return _PREEMPT["requested"]
+
+
+def preempt_reason() -> str | None:
+    return _PREEMPT["reason"]
+
+
+@contextmanager
+def preemption_guard(signals=(signal.SIGTERM, signal.SIGINT)):
+    """Install SIGTERM/SIGINT handlers that request a cooperative stop
+    instead of unwinding mid-update.  The flag is cleared on entry and
+    polled by the training loop between updates; previous handlers are
+    restored on exit.  Off the main thread (where Python forbids
+    signal handlers) this degrades to a plain flag guard — injected
+    `preempt@...` faults still work."""
+    _PREEMPT["requested"] = False
+    _PREEMPT["reason"] = None
+    prev = {}
+    if threading.current_thread() is threading.main_thread():
+        def handler(signum, frame):
+            request_preempt(signal.Signals(signum).name)
+        for s in signals:
+            prev[s] = signal.signal(s, handler)
+    try:
+        yield _PREEMPT
+    finally:
+        for s, h in prev.items():
+            signal.signal(s, h)
+
+
+# -- full-state train snapshots ----------------------------------------------
+#
+# Payload layout (flax msgpack, one atomically-written file):
+#   {"meta": {"version", "update", "has_best", "best"},
+#    "carry": (TrainState, env_state, obs, key),
+#    "best_params": params-shaped tree (== carry params when no best)}
+# The meta rides INSIDE the payload: a sidecar written in a second
+# rename could tear against the payload (new data + old meta claims
+# the wrong update index and silently corrupts the resumed history).
+# The sidecar `.json` exists for humans and tooling only.
+
+
+def _meta_template() -> dict:
+    return {"version": 0, "update": 0, "has_best": 0, "best": 0.0}
+
+
+def save_train_snapshot(path: str, carry, *, update: int,
+                        best: float | None = None, best_params=None,
+                        config: dict | None = None):
+    """Atomically snapshot the full train carry + best/revert state.
+    `best_params=None` (no eval yet) stores the current params with
+    `has_best=0` — flax's from_bytes needs a params-shaped tree either
+    way."""
+    from flax import serialization
+
+    has_best = best_params is not None
+    finite_best = (best is not None and best == best
+                   and best not in (float("inf"), float("-inf")))
+    meta = {"version": SNAPSHOT_VERSION, "update": int(update),
+            "has_best": int(has_best),
+            "best": float(best) if finite_best else 0.0}
+    payload = {"meta": meta, "carry": carry,
+               "best_params": best_params if has_best else carry[0].params}
+    atomic_write_bytes(path, serialization.to_bytes(payload))
+    sidecar = dict(meta, time_utc=telemetry.run_manifest()["time_utc"])
+    if config is not None:
+        sidecar["config"] = config
+    atomic_write_json(path + ".json", sidecar)
+
+
+def load_train_snapshot(path: str, template_carry):
+    """Restore a snapshot into the shape of `template_carry` (a fresh
+    `init_fn(...)` carry for the same config).  Returns
+    (carry, best_params_or_None, meta)."""
+    from flax import serialization
+
+    template = {"meta": _meta_template(), "carry": template_carry,
+                "best_params": template_carry[0].params}
+    with open(path, "rb") as f:
+        restored = serialization.from_bytes(template, f.read())
+    meta = dict(restored["meta"])
+    if meta["version"] != SNAPSHOT_VERSION:
+        raise ValueError(
+            f"snapshot {path} has version {meta['version']}, "
+            f"this build reads version {SNAPSHOT_VERSION}")
+    best_params = restored["best_params"] if meta["has_best"] else None
+    if not meta["has_best"]:
+        meta["best"] = None
+    return restored["carry"], best_params, meta
+
+
+# -- VI solve checkpoints ----------------------------------------------------
+#
+# Long chunked solves checkpoint (value, progress, iteration count,
+# residual history so far) between chunks.  One atomic npz file; the
+# sidecar json is informational.  The checkpoint is crash-recovery
+# scratch: deleted when the solve completes.
+
+
+def save_vi_checkpoint(path: str, *, value, prog, it: int, resids,
+                       stop_delta: float):
+    import numpy as np
+
+    buf = io.BytesIO()
+    np.savez(buf, value=np.asarray(value), prog=np.asarray(prog),
+             it=np.asarray(int(it)),
+             resid=(np.concatenate([np.asarray(r) for r in resids])
+                    if resids else np.zeros(0, np.asarray(value).dtype)),
+             stop_delta=np.asarray(float(stop_delta)))
+    atomic_write_bytes(path, buf.getvalue())
+    atomic_write_json(path + ".json", {
+        "version": SNAPSHOT_VERSION, "it": int(it),
+        "S": int(np.asarray(value).shape[0]),
+        "dtype": str(np.asarray(value).dtype),
+        "stop_delta": float(stop_delta)})
+
+
+def load_vi_checkpoint(path: str, *, S: int, dtype):
+    """Returns (value, prog, it, resid) as numpy, validated against the
+    solve's state-space size and dtype (a checkpoint from a different
+    MDP must not silently seed this solve)."""
+    import numpy as np
+
+    with open(path, "rb") as f:
+        with np.load(io.BytesIO(f.read())) as z:
+            value, prog = z["value"], z["prog"]
+            it, resid = int(z["it"]), z["resid"]
+    if value.shape != (S,):
+        raise ValueError(f"VI checkpoint {path} has S={value.shape}, "
+                         f"solve expects ({S},)")
+    if value.dtype != np.dtype(dtype):
+        raise ValueError(f"VI checkpoint {path} has dtype {value.dtype}, "
+                         f"solve expects {np.dtype(dtype)}")
+    return value, prog, it, resid
+
+
+# -- metrics.jsonl resume helpers --------------------------------------------
+
+
+def trim_metrics_log(path: str, upto: int):
+    """Drop rows logged past update `upto` (the last snapshot): a
+    killed run may have logged updates the snapshot never saw, and the
+    resumed run will re-produce them.  Header lines (`run: true`) and
+    rows at or before `upto` survive.  Atomic rewrite."""
+    if not os.path.exists(path):
+        return
+    keep = []
+    with open(path) as f:
+        for ln in f:
+            ln = ln.strip()
+            if not ln:
+                continue
+            try:
+                row = json.loads(ln)
+            except ValueError:
+                continue
+            if row.get("preempted"):
+                continue  # stale lifecycle marker: the run continues
+            u = row.get("update")
+            if not row.get("run") and u is not None and u > upto:
+                continue
+            keep.append(json.dumps(row))
+    atomic_write_bytes(path, ("\n".join(keep) + "\n" if keep
+                              else "").encode())
+
+
+def metrics_fingerprint(path: str) -> list[dict]:
+    """The determinism-comparable content of a metrics.jsonl stream:
+    every non-header row with the volatile timing keys
+    (`VOLATILE_METRIC_KEYS`) stripped.  Two runs of the same config —
+    one uninterrupted, one killed-and-resumed — must produce equal
+    fingerprints (the resilience acceptance criterion)."""
+    rows = []
+    with open(path) as f:
+        for ln in f:
+            ln = ln.strip()
+            if not ln:
+                continue
+            row = json.loads(ln)
+            if row.get("run") or row.get("preempted"):
+                continue  # headers/lifecycle markers differ by construction
+            rows.append({k: v for k, v in row.items()
+                         if k not in VOLATILE_METRIC_KEYS})
+    return rows

@@ -54,7 +54,7 @@ from contextlib import contextmanager
 from datetime import datetime, timezone
 from time import perf_counter as now  # noqa: F401 — re-exported
 
-SCHEMA_VERSION = 2
+SCHEMA_VERSION = 3
 TELEMETRY_ENV_VAR = "CPR_TELEMETRY"
 PROFILE_ENV_VAR = "CPR_PROFILE_DIR"
 CHECKIFY_ENV_VAR = "CPR_CHECKIFY"
@@ -67,14 +67,21 @@ DEVICE_METRICS_ENV_VAR = "CPR_DEVICE_METRICS"
 SPAN_KEYS = ("kind", "name", "path", "depth", "t_start", "t_end",
              "dur_s")
 
-# schema v2: reserved point-event names -> the fields each must carry
+# schema v2+: reserved point-event names -> the fields each must carry
 # (tools/trace_summary.py --validate enforces this; other event names
-# stay free-form exactly as in v1)
+# stay free-form exactly as in v1).  v3 adds the resilience events
+# (cpr_tpu/resilience.py: retries, checkpoints, resume, preemption,
+# fault injection).
 EVENT_FIELDS = {
     "device_metrics": ("scope", "metrics"),
     "compile": ("fn", "compile_s"),
     "vi_residuals": ("impl", "n_sweeps", "residuals"),
     "tpu_outage": ("reason",),
+    "checkpoint": ("path", "what"),
+    "resume": ("path", "update"),
+    "retry": ("attempt", "delay_s", "error"),
+    "preempted": ("update",),
+    "fault_injected": ("spec", "site"),
 }
 
 

@@ -12,7 +12,9 @@ parameter files.
 from __future__ import annotations
 
 import json
+import math
 import os
+import weakref
 from contextlib import nullcontext
 from typing import Callable
 
@@ -21,7 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 from flax import serialization
 
-from cpr_tpu import device_metrics, telemetry
+from cpr_tpu import device_metrics, resilience, telemetry
 from cpr_tpu.envs.registry import get_sized
 from cpr_tpu.envs.assumption import AssumptionEnv
 from cpr_tpu.params import stack_params
@@ -112,14 +114,21 @@ def build_env(cfg: TrainConfig):
     return env
 
 
-_EVAL_FN_CACHE: dict = {}
+# Keyed by the env OBJECT via weakref, not id(env): a GC'd env's id can
+# be reused by a new env, silently serving a jitted fn closed over the
+# wrong env.  (The cached fn closes over the env, so in practice an
+# entry keeps its key alive — same lifetime as the old id-keyed cache,
+# but an id collision is now structurally impossible.)
+_EVAL_FN_CACHE: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
 
 
 def _eval_fn(env, hidden, episode_len):
     """Jitted (net_params, keys, stacked_params) -> stats, cached so
     repeated evals during one training run compile once."""
-    cache_key = (id(env), hidden, episode_len)
-    fn = _EVAL_FN_CACHE.get(cache_key)
+    per_env = _EVAL_FN_CACHE.get(env)
+    if per_env is None:
+        per_env = _EVAL_FN_CACHE[env] = {}
+    fn = per_env.get((hidden, episode_len))
     if fn is None:
         net = ActorCritic(env.n_actions, hidden)
 
@@ -133,7 +142,7 @@ def _eval_fn(env, hidden, episode_len):
                     k, p, policy, episode_len + 8),
                 in_axes=(0, None)), in_axes=(0, 0))(keys, params)
 
-        fn = _EVAL_FN_CACHE[cache_key] = jax.jit(run)
+        fn = per_env[(hidden, episode_len)] = jax.jit(run)
     return fn
 
 
@@ -170,12 +179,13 @@ def evaluate_per_alpha(env, cfg: TrainConfig, net_params, *,
 
 
 def save_checkpoint(path: str, net_params, meta: dict | None = None):
-    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-    with open(path, "wb") as f:
-        f.write(serialization.to_bytes(net_params))
+    """Atomic params checkpoint: tmp + fsync + os.replace, so
+    best-model.msgpack can never be observed half-written.  The meta
+    sidecar lands BEFORE the model rename: a reader that sees the new
+    model always sees meta at least as new."""
     if meta is not None:
-        with open(path + ".json", "w") as f:
-            json.dump(meta, f)
+        resilience.atomic_write_json(path + ".json", meta)
+    resilience.atomic_write_bytes(path, serialization.to_bytes(net_params))
 
 
 def load_checkpoint(path: str, env, cfg: TrainConfig):
@@ -188,12 +198,25 @@ def load_checkpoint(path: str, env, cfg: TrainConfig):
 
 def train_from_config(cfg: TrainConfig, *, out_dir: str | None = None,
                       n_updates: int | None = None, mesh=None,
-                      progress: Callable | None = None):
+                      progress: Callable | None = None,
+                      resume: bool | str = False,
+                      snapshot_freq: int | None = None):
     """Full training run: returns (net_params, history, eval_rows).
 
     Checkpoints (when out_dir is set): last-model.msgpack after every
     eval, best-model.msgpack when the mean eval relative reward improves
     (ppo.py:429-453 contract).
+
+    Crash safety (docs/RESILIENCE.md): `out_dir/snapshot.msgpack` holds
+    the FULL train carry (params + optimizer state + env state + PRNG
+    key) plus best/revert bookkeeping, written atomically every
+    `snapshot_freq` updates (default: the eval cadence) and at the final
+    update.  `resume=True` (or a snapshot path) restores the carry,
+    trims metrics.jsonl rows the snapshot never saw, and continues —
+    bit-identically to a run that was never interrupted.  SIGTERM/SIGINT
+    between updates snapshot + write `preempt-model.msgpack` and return
+    cleanly.  On resume, `history`/`eval_rows` cover only the resumed
+    segment; metrics.jsonl carries the whole run.
     """
     env = build_env(cfg)
     lane_alphas = cfg.lane_alphas(cfg.n_envs)
@@ -218,10 +241,71 @@ def train_from_config(cfg: TrainConfig, *, out_dir: str | None = None,
     metrics_log = None
     tele = telemetry.current()
     steps_per_update = cfg.n_envs * pcfg.n_steps
-    manifest = telemetry.run_manifest(config=dict(
+    snap_config = dict(
         protocol=cfg.protocol, seed=cfg.seed, n_envs=cfg.n_envs,
         episode_len=cfg.episode_len, reward=cfg.reward,
-        n_steps=pcfg.n_steps, total_updates=total))
+        n_steps=pcfg.n_steps, total_updates=total)
+    manifest = telemetry.run_manifest(config=dict(snap_config))
+    # the stream gets the manifest too (no-op without a sink), so a
+    # CPR_TELEMETRY capture of a training run validates standalone
+    tele.emit(manifest)
+
+    snap_path = (resume if isinstance(resume, str) else
+                 os.path.join(out_dir, "snapshot.msgpack")
+                 if out_dir is not None else None)
+    snap_freq = (snapshot_freq
+                 or int(os.environ.get("CPR_SNAPSHOT_FREQ", "0"))
+                 or cfg.eval.freq)
+
+    def _save_model(path, params, meta, kind):
+        # injected io_error@checkpoint faults land inside the retried
+        # callable, so a transient write failure is re-attempted
+        def write():
+            resilience.fault_point("checkpoint")
+            save_checkpoint(path, params, meta)
+        resilience.with_retries(write, max_attempts=3, base_delay_s=0.1,
+                                max_delay_s=2.0, name=f"save:{kind}")
+        # NB the artifact kind rides as `what`: a point event's `kind`
+        # key is the JSONL record kind ("event") and must not be shadowed
+        tele.event("checkpoint", path=path, what=kind)
+
+    def _save_snapshot(update):
+        def write():
+            resilience.fault_point("checkpoint")
+            resilience.save_train_snapshot(
+                snap_path, carry, update=update, best=best,
+                best_params=best_params, config=snap_config)
+        resilience.with_retries(write, max_attempts=3, base_delay_s=0.1,
+                                max_delay_s=2.0, name="save:snapshot")
+        tele.event("checkpoint", path=snap_path, what="snapshot",
+                   update=update)
+
+    start_update = 0
+    if resume:
+        if snap_path is None:
+            raise ValueError("resume requires out_dir or a snapshot path")
+        # the sidecar is informational, but when present its config
+        # fingerprint guards against resuming under a different config
+        # (shape-compatible mismatches would otherwise pass silently)
+        sidecar = snap_path + ".json"
+        if os.path.exists(sidecar):
+            with open(sidecar) as f:
+                fp = json.load(f).get("config")
+            if fp is not None and fp != snap_config:
+                raise ValueError(
+                    f"snapshot {snap_path} was written by config {fp}, "
+                    f"this run is {snap_config}")
+        carry, best_params, snap_meta = resilience.load_train_snapshot(
+            snap_path, carry)
+        best = snap_meta["best"] if snap_meta["has_best"] else -np.inf
+        start_update = snap_meta["update"]
+        if mesh is not None:
+            from cpr_tpu.parallel import shard_envs
+            ts, env_state, obs, key = carry
+            env_state = shard_envs(mesh, env_state, "dp")
+            obs = shard_envs(mesh, obs, "dp")
+            carry = (ts, env_state, obs, key)
+        tele.event("resume", path=snap_path, update=start_update)
     if device_metrics.enabled():
         # XLA's own estimate of one update (flops, bytes) into the run
         # manifest; costs one extra compile, so it rides the same
@@ -236,16 +320,54 @@ def train_from_config(cfg: TrainConfig, *, out_dir: str | None = None,
         # still says what backend/config produced it
         with open(os.path.join(out_dir, "manifest.json"), "w") as f:
             json.dump(manifest, f, indent=2)
+        metrics_path = os.path.join(out_dir, "metrics.jsonl")
+        if resume:
+            # a killed run may have logged updates past the snapshot;
+            # the resumed run re-produces them, so drop the orphans or
+            # the stream would carry duplicate update numbers
+            resilience.trim_metrics_log(metrics_path, start_update)
         # JSONL metrics stream (the W&B-run-log analog, ppo.py:180-193):
         # one line per update, eval rows tagged; a header line separates
         # runs appended into the same directory
-        metrics_log = open(os.path.join(out_dir, "metrics.jsonl"), "a")
-        metrics_log.write(json.dumps(
-            {"run": True, "protocol": cfg.protocol, "seed": cfg.seed,
-             "total_updates": total, "manifest": manifest}) + "\n")
+        metrics_log = open(metrics_path, "a")
+        header = {"run": True, "protocol": cfg.protocol, "seed": cfg.seed,
+                  "total_updates": total, "manifest": manifest}
+        if resume:
+            header["resumed_from"] = snap_path
+            header["start_update"] = start_update
+        metrics_log.write(json.dumps(header) + "\n")
         metrics_log.flush()
+    preempt_ctx = resilience.preemption_guard()
     try:
-        for i in range(total):
+        preempt_ctx.__enter__()
+        for i in range(start_update, total):
+            # fault-injection site for this update; "nan" poisons the
+            # params so the nonfinite-loss recovery below is testable
+            act = resilience.fault_point("update", i + 1)
+            if act == "nan":
+                ts = carry[0]
+                carry = (ts.replace(params=jax.tree_util.tree_map(
+                    lambda x: jnp.full_like(x, jnp.nan), ts.params)),
+                    ) + tuple(carry[1:])
+            if resilience.preempt_requested():
+                # preemption notice (SIGTERM/SIGINT or injected):
+                # snapshot, drop a params-only preempt-model, exit clean
+                reason = resilience.preempt_reason()
+                if snap_path is not None:
+                    _save_snapshot(i)
+                if out_dir is not None:
+                    _save_model(
+                        os.path.join(out_dir, "preempt-model.msgpack"),
+                        carry[0].params,
+                        dict(update=i, protocol=cfg.protocol,
+                             reason=reason), "preempt")
+                tele.event("preempted", update=i, reason=reason)
+                if metrics_log is not None:
+                    metrics_log.write(json.dumps(
+                        {"preempted": True, "update": i,
+                         "reason": reason}) + "\n")
+                    metrics_log.flush()
+                break
             # CPR_PROFILE_DIR captures ONE warm update (the second: the
             # first pays compile) instead of the whole run
             prof = (telemetry.maybe_profile("train_update")
@@ -272,6 +394,27 @@ def train_from_config(cfg: TrainConfig, *, out_dir: str | None = None,
                 metrics_log.flush()
             if progress is not None:
                 progress(i, m)
+            # nonfinite-loss recovery: a NaN/Inf loss means the params
+            # (or optimizer moments) are already poisoned — restart
+            # from the best checkpoint with fresh optimizer state, same
+            # contract as the eval-score revert below.  Without a best
+            # yet there is nothing safe to restore; the row above keeps
+            # the poisoning visible either way.
+            if (best_params is not None
+                    and any(not math.isfinite(m.get(k, 0.0))
+                            for k in ("pg_loss", "v_loss"))):
+                ts = carry[0]
+                carry = (ts.replace(
+                    params=best_params,
+                    opt_state=ts.tx.init(best_params)),
+                    ) + tuple(carry[1:])
+                tele.event("revert", update=i + 1, score=None, best=best,
+                           reason="nonfinite_loss")
+                if metrics_log is not None:
+                    metrics_log.write(json.dumps(
+                        {"revert": True, "update": i + 1,
+                         "reason": "nonfinite_loss", "best": best}) + "\n")
+                    metrics_log.flush()
             # the first start_at_iteration updates never evaluate (early
             # deterministic policies are degenerate — cfg_model rationale)
             due = (i + 1) % cfg.eval.freq == 0 or i + 1 == total
@@ -294,16 +437,16 @@ def train_from_config(cfg: TrainConfig, *, out_dir: str | None = None,
                 meta = dict(update=i + 1, score=score,
                             protocol=cfg.protocol)
                 if out_dir is not None:
-                    save_checkpoint(os.path.join(out_dir,
-                                                 "last-model.msgpack"),
-                                    carry[0].params, meta)
+                    _save_model(os.path.join(out_dir,
+                                             "last-model.msgpack"),
+                                carry[0].params, meta, "last")
                 if score > best:
                     best = score
                     best_params = carry[0].params
                     if out_dir is not None:
-                        save_checkpoint(os.path.join(out_dir,
-                                                     "best-model.msgpack"),
-                                        carry[0].params, meta)
+                        _save_model(os.path.join(out_dir,
+                                                 "best-model.msgpack"),
+                                    carry[0].params, meta, "best")
                 elif (cfg.revert_frac is not None
                       and best_params is not None
                       and score < cfg.revert_frac * best):
@@ -323,7 +466,16 @@ def train_from_config(cfg: TrainConfig, *, out_dir: str | None = None,
                             {"revert": True, "update": i + 1,
                              "score": score, "best": best}) + "\n")
                         metrics_log.flush()
+            # snapshot AFTER the eval block so best/revert bookkeeping
+            # from this update's eval is inside it; the final update
+            # always snapshots, so resuming a finished run is a no-op
+            if snap_path is not None and (
+                    (i + 1) % snap_freq == 0 or i + 1 == total):
+                _save_snapshot(i + 1)
     finally:
+        # restore the pre-loop SIGTERM/SIGINT handlers even when the
+        # loop unwinds via an exception
+        preempt_ctx.__exit__(None, None, None)
         if metrics_log is not None:
             metrics_log.close()
     return carry[0].params, history, eval_rows

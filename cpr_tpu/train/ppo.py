@@ -24,7 +24,7 @@ from flax import linen as nn
 from flax import struct
 from flax.training.train_state import TrainState
 
-from cpr_tpu import device_metrics, telemetry
+from cpr_tpu import device_metrics, resilience, telemetry
 from cpr_tpu.envs.base import JaxEnv
 from cpr_tpu.params import EnvParams
 
@@ -370,20 +370,33 @@ def train(env, env_params, cfg: PPOConfig, *, n_updates: int, seed: int = 0,
     history = []
     tele = telemetry.current()
     steps_per_update = cfg.n_envs * cfg.n_steps
-    for i in range(n_updates):
-        with tele.span("update", env_steps=steps_per_update) as sp:
-            carry, metrics = step(carry)
-            sp.fence(carry)
-            acc = metrics.pop("device_metrics", None)
-            host_metrics = {k: float(v) for k, v in metrics.items()}
-        if acc is not None:
-            device_metrics.emit("ppo_update", train_step.metrics_spec,
-                                acc, update=i)
-        host_metrics["wall_s"] = round(sp.dur_s, 6)
-        if sp.dur_s > 0:
-            host_metrics["steps_per_sec"] = round(
-                steps_per_update / sp.dur_s)
-        if progress is not None:
-            progress(i, host_metrics)
-        history.append(host_metrics)
+    # the guard clears any stale preempt flag on entry — without it a
+    # previously handled preemption in this process would silently
+    # truncate every later train() call at update 0
+    with resilience.preemption_guard():
+        for i in range(n_updates):
+            # same fault/preemption sites as the config driver, so
+            # harness tests and ops tooling behave identically on the
+            # plain loop (no snapshotting here — use train_from_config
+            # for resumable runs)
+            resilience.fault_point("update", i + 1)
+            if resilience.preempt_requested():
+                tele.event("preempted", update=i,
+                           reason=resilience.preempt_reason())
+                break
+            with tele.span("update", env_steps=steps_per_update) as sp:
+                carry, metrics = step(carry)
+                sp.fence(carry)
+                acc = metrics.pop("device_metrics", None)
+                host_metrics = {k: float(v) for k, v in metrics.items()}
+            if acc is not None:
+                device_metrics.emit("ppo_update", train_step.metrics_spec,
+                                    acc, update=i)
+            host_metrics["wall_s"] = round(sp.dur_s, 6)
+            if sp.dur_s > 0:
+                host_metrics["steps_per_sec"] = round(
+                    steps_per_update / sp.dur_s)
+            if progress is not None:
+                progress(i, host_metrics)
+            history.append(host_metrics)
     return carry[0], history

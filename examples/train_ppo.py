@@ -1,7 +1,10 @@
 """Config-driven PPO training with per-alpha eval and checkpoints.
 
 Usage: python examples/train_ppo.py [config.yaml] [out_dir] [n_updates]
-Defaults to the nakamoto alpha-range config, 20 updates.
+           [--resume]
+Defaults to the nakamoto alpha-range config, 20 updates.  `--resume`
+continues a preempted/crashed run from `<out_dir>/snapshot.msgpack`
+(see docs/RESILIENCE.md).
 """
 
 import _bootstrap  # noqa: F401  (repo-root path + backend pick)
@@ -17,10 +20,12 @@ HERE = os.path.dirname(os.path.abspath(__file__))
 
 
 def main():
-    cfg_path = sys.argv[1] if len(sys.argv) > 1 else os.path.join(
+    argv = [a for a in sys.argv[1:] if a != "--resume"]
+    resume = "--resume" in sys.argv
+    cfg_path = argv[0] if len(argv) > 0 else os.path.join(
         HERE, "..", "cpr_tpu", "train", "configs", "nakamoto.yaml")
-    out_dir = sys.argv[2] if len(sys.argv) > 2 else "runs/example"
-    n_updates = int(sys.argv[3]) if len(sys.argv) > 3 else 20
+    out_dir = argv[1] if len(argv) > 1 else "runs/example"
+    n_updates = int(argv[2]) if len(argv) > 2 else 20
     cfg = TrainConfig.from_yaml(cfg_path)
 
     def progress(i, m):
@@ -28,7 +33,8 @@ def main():
               f"entropy={m['entropy']:.3f}")
 
     params, history, eval_rows = train_from_config(
-        cfg, out_dir=out_dir, n_updates=n_updates, progress=progress)
+        cfg, out_dir=out_dir, n_updates=n_updates, progress=progress,
+        resume=resume)
     print(write_tsv(eval_rows))
     print(f"checkpoints + metrics.jsonl in {out_dir}/")
 

@@ -1,0 +1,589 @@
+"""Crash-safety layer (cpr_tpu/resilience.py) and its wiring.
+
+The acceptance criterion is behavioral, not structural: a run that is
+killed mid-training and resumed must produce a metrics history
+bit-identical to one that was never interrupted, GuardFailure must
+never be retried while transient faults are, and every recovery path
+is driven by the deterministic CPR_FAULT_INJECT harness instead of a
+real outage.  The training-loop tests reuse the exact env/PPO geometry
+of test_train_driver.py so the jitted train step compiles once per
+pytest process.
+"""
+
+import gc
+import json
+import os
+import signal
+
+import numpy as np
+import pytest
+
+from cpr_tpu import resilience, telemetry
+from cpr_tpu.resilience import (FaultSpec, GuardFailure, InjectedKill,
+                                TransientFault, default_classify,
+                                with_retries)
+
+
+# -- retry/backoff -----------------------------------------------------------
+
+
+def test_with_retries_backoff_sequence_and_success():
+    delays, calls = [], []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("transient")
+        return "ok"
+
+    out = with_retries(flaky, max_attempts=4, base_delay_s=0.5,
+                       max_delay_s=10.0, jitter_frac=0.0,
+                       sleep=delays.append)
+    assert out == "ok" and len(calls) == 3
+    assert delays == [0.5, 1.0]  # base * 2**(attempt-1)
+
+
+def test_with_retries_caps_delay_and_jitters_within_bound():
+    delays = []
+
+    def always():
+        raise OSError("x")
+
+    with pytest.raises(OSError):
+        with_retries(always, max_attempts=4, base_delay_s=1.0,
+                     max_delay_s=1.5, jitter_frac=0.25,
+                     sleep=delays.append, rng=lambda: 1.0)
+    # attempts 2/3 would be 2.0/4.0 uncapped; capped at 1.5 then
+    # jittered by the full 25%
+    assert delays == pytest.approx([1.25, 1.875, 1.875])
+
+
+def test_with_retries_guard_failure_never_retried():
+    calls = []
+
+    def guard():
+        calls.append(1)
+        raise GuardFailure("deterministic")
+
+    with pytest.raises(GuardFailure):
+        with_retries(guard, max_attempts=5, sleep=lambda s: None)
+    assert len(calls) == 1
+
+
+def test_with_retries_injected_kill_is_fatal():
+    calls = []
+
+    def kill():
+        calls.append(1)
+        raise InjectedKill("kill@update=1")
+
+    with pytest.raises(InjectedKill):
+        with_retries(kill, max_attempts=5, sleep=lambda s: None)
+    assert len(calls) == 1
+
+
+def test_assertion_error_is_transient_by_classification():
+    """The masquerade invariant: assertions from jax internals are
+    infra failures, not correctness guards — they must retry."""
+    assert default_classify(AssertionError("xla internal")) is True
+    assert default_classify(GuardFailure("rule")) is False
+    assert default_classify(TransientFault("chip claim")) is True
+    assert default_classify(OSError("io")) is True
+
+
+def test_with_retries_emits_retry_events(tmp_path):
+    path = tmp_path / "tele.jsonl"
+    telemetry.configure(str(path))
+    try:
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 2:
+                raise OSError("blip")
+            return 1
+
+        with_retries(flaky, max_attempts=3, base_delay_s=0.01,
+                     jitter_frac=0.0, sleep=lambda s: None, name="unit")
+    finally:
+        telemetry.configure(None)
+    events = [json.loads(ln) for ln in open(path)]
+    retries = [e for e in events if e.get("name") == "retry"]
+    assert len(retries) == 1
+    e = retries[0]
+    assert e["kind"] == "event" and e["site"] == "unit"
+    for k in telemetry.EVENT_FIELDS["retry"]:
+        assert k in e, e
+    assert "OSError" in e["error"]
+
+
+# -- fault-injection grammar -------------------------------------------------
+
+
+def test_fault_spec_grammar():
+    s = FaultSpec("kill@update=7")
+    assert (s.action, s.site, s.index) == ("kill", "update", 7)
+    assert [s.raw for s in resilience.parse_fault_specs(
+        "kill@update=7, io_error@checkpoint=2")] == [
+        "kill@update=7", "io_error@checkpoint=2"]
+    for bad in ("kill@update", "kill=7", "explode@update=7", ""):
+        if bad:
+            with pytest.raises(ValueError):
+                FaultSpec(bad)
+    assert resilience.parse_fault_specs("") == []
+
+
+def test_fault_injector_is_one_shot_and_counts_occurrences():
+    inj = resilience.FaultInjector(
+        resilience.parse_fault_specs("io_error@checkpoint=2"))
+    assert inj.fire("checkpoint") is None  # occurrence 1
+    with pytest.raises(OSError):
+        inj.fire("checkpoint")  # occurrence 2 fires...
+    assert inj.fire("checkpoint") is None  # ...once: spec disarmed
+    # indexed sites: only the pinned loop index matches
+    inj = resilience.FaultInjector(
+        resilience.parse_fault_specs("kill@update=3"))
+    assert inj.fire("update", 2) is None
+    with pytest.raises(InjectedKill):
+        inj.fire("update", 3)
+    assert inj.fire("update", 3) is None
+
+
+def test_injector_rebuilds_when_env_changes(monkeypatch):
+    monkeypatch.setenv(resilience.FAULT_ENV_VAR, "fault@vi_chunk=1")
+    with pytest.raises(TransientFault):
+        resilience.fault_point("vi_chunk")
+    # a resumed run unsets the var: the stale armed state must not
+    # survive the rebuild
+    monkeypatch.delenv(resilience.FAULT_ENV_VAR)
+    assert resilience.fault_point("vi_chunk") is None
+
+
+# -- atomic writes -----------------------------------------------------------
+
+
+def test_atomic_write_failure_leaves_original_intact(tmp_path, monkeypatch):
+    path = tmp_path / "artifact.bin"
+    resilience.atomic_write_bytes(str(path), b"original")
+
+    def boom(src, dst):
+        raise OSError("injected rename failure")
+
+    monkeypatch.setattr(os, "replace", boom)
+    with pytest.raises(OSError):
+        resilience.atomic_write_bytes(str(path), b"replacement")
+    monkeypatch.undo()
+    assert path.read_bytes() == b"original"
+    # the failed attempt's tmp file was cleaned up
+    assert os.listdir(tmp_path) == ["artifact.bin"]
+
+
+def test_save_checkpoint_round_trips_params_and_meta(tmp_path):
+    from flax import serialization
+    from cpr_tpu.train.driver import save_checkpoint
+
+    path = str(tmp_path / "model.msgpack")
+    params = {"w": np.arange(4.0, dtype=np.float32)}
+    save_checkpoint(path, params, meta=dict(update=3, score=0.5))
+    assert json.load(open(path + ".json")) == {"update": 3, "score": 0.5}
+    with open(path, "rb") as f:
+        restored = serialization.from_bytes(
+            {"w": np.zeros(4, np.float32)}, f.read())
+    np.testing.assert_array_equal(restored["w"], params["w"])
+
+
+# -- preemption --------------------------------------------------------------
+
+
+def test_preemption_guard_catches_sigterm_and_restores_handler():
+    before = signal.getsignal(signal.SIGTERM)
+    with resilience.preemption_guard():
+        assert not resilience.preempt_requested()
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert resilience.preempt_requested()
+        assert resilience.preempt_reason() == "SIGTERM"
+    assert signal.getsignal(signal.SIGTERM) is before
+    # re-entry clears the stale flag
+    with resilience.preemption_guard():
+        assert not resilience.preempt_requested()
+
+
+# -- snapshots + metrics-log helpers -----------------------------------------
+
+
+def _fake_carry(fill: float):
+    """A carry-shaped pytree: (obj-with-.params, env_state, obs, key)."""
+    from flax.training import train_state
+    import optax
+
+    ts = train_state.TrainState.create(
+        apply_fn=lambda *a: None,
+        params={"w": np.full(4, fill, np.float32)},
+        tx=optax.adam(1e-3))  # adam: non-trivial opt_state (mu/nu/count)
+    if fill:  # make the optimizer moments distinguishable from init
+        ts = ts.apply_gradients(grads={"w": np.full(4, fill, np.float32)})
+    return (ts, {"height": np.full(2, fill, np.int32)},
+            np.full(3, fill, np.float32), np.arange(2, dtype=np.uint32))
+
+
+def test_train_snapshot_round_trip(tmp_path):
+    path = str(tmp_path / "snap.msgpack")
+    carry = _fake_carry(2.5)
+    best_params = {"w": np.full(4, 9.0, np.float32)}
+    resilience.save_train_snapshot(path, carry, update=7, best=0.625,
+                                   best_params=best_params,
+                                   config={"seed": 0})
+    got, got_best, meta = resilience.load_train_snapshot(
+        path, _fake_carry(0.0))
+    assert meta["update"] == 7 and meta["best"] == 0.625
+    np.testing.assert_array_equal(got[0].params["w"], carry[0].params["w"])
+    # optimizer state (adam moments + step count) restores exactly
+    import jax
+    for a, b in zip(jax.tree_util.tree_leaves(got[0].opt_state),
+                    jax.tree_util.tree_leaves(carry[0].opt_state)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert int(got[0].step) == int(carry[0].step) == 1
+    np.testing.assert_array_equal(got[1]["height"], carry[1]["height"])
+    np.testing.assert_array_equal(got[3], carry[3])
+    np.testing.assert_array_equal(got_best["w"], best_params["w"])
+    assert json.load(open(path + ".json"))["config"] == {"seed": 0}
+
+
+def test_train_snapshot_without_best_and_version_gate(tmp_path, monkeypatch):
+    path = str(tmp_path / "snap.msgpack")
+    resilience.save_train_snapshot(path, _fake_carry(1.0), update=2,
+                                   best=float("-inf"), best_params=None)
+    _, got_best, meta = resilience.load_train_snapshot(
+        path, _fake_carry(0.0))
+    assert got_best is None and meta["best"] is None
+    monkeypatch.setattr(resilience, "SNAPSHOT_VERSION",
+                        resilience.SNAPSHOT_VERSION + 1)
+    with pytest.raises(ValueError, match="version"):
+        resilience.load_train_snapshot(path, _fake_carry(0.0))
+
+
+def test_vi_checkpoint_round_trip_and_validation(tmp_path):
+    path = str(tmp_path / "vi.npz")
+    value = np.linspace(0, 1, 8).astype(np.float32)
+    prog = np.ones(8, np.float32)
+    resilience.save_vi_checkpoint(path, value=value, prog=prog, it=12,
+                                  resids=[np.ones(4, np.float32)],
+                                  stop_delta=1e-6)
+    v, p, it, resid = resilience.load_vi_checkpoint(
+        path, S=8, dtype=np.float32)
+    np.testing.assert_array_equal(v, value)
+    np.testing.assert_array_equal(p, prog)
+    assert it == 12 and resid.shape == (4,)
+    with pytest.raises(ValueError, match="S="):
+        resilience.load_vi_checkpoint(path, S=9, dtype=np.float32)
+    with pytest.raises(ValueError, match="dtype"):
+        resilience.load_vi_checkpoint(path, S=8, dtype=np.float64)
+
+
+def test_trim_metrics_log_and_fingerprint(tmp_path):
+    path = str(tmp_path / "metrics.jsonl")
+    rows = [{"run": True, "total_updates": 4},
+            {"update": 1, "loss": 0.5, "wall_s": 0.1, "steps_per_sec": 10},
+            {"update": 2, "loss": 0.4, "wall_s": 0.2},
+            {"eval": True, "update": 2, "relative_reward": 0.3},
+            {"update": 3, "loss": 0.3},  # orphan past the snapshot
+            {"preempted": True, "update": 3, "reason": "SIGTERM"}]
+    with open(path, "w") as f:
+        f.writelines(json.dumps(r) + "\n" for r in rows)
+    resilience.trim_metrics_log(path, 2)
+    kept = [json.loads(ln) for ln in open(path)]
+    assert [r.get("update") for r in kept] == [None, 1, 2, 2]
+    assert kept[0]["run"] is True
+    # fingerprint: headers + lifecycle rows gone, volatile keys stripped
+    fp = resilience.metrics_fingerprint(path)
+    assert fp == [{"update": 1, "loss": 0.5}, {"update": 2, "loss": 0.4},
+                  {"eval": True, "update": 2, "relative_reward": 0.3}]
+
+
+# -- bench child-process protocol --------------------------------------------
+
+
+def test_bench_attempt_maps_exit_status_to_taxonomy(monkeypatch):
+    import bench
+
+    scripted = {}
+    monkeypatch.setattr(
+        bench, "_attempt",
+        lambda timeout, mode="--direct", extra=None, env_extra=None:
+        scripted["ret"])
+    scripted["ret"] = ("ok", '{"backend": "cpu"}')
+    assert bench._attempt_raising(5.0) == '{"backend": "cpu"}'
+    scripted["ret"] = ("failed", bench.GUARD_RC)
+    with pytest.raises(GuardFailure):
+        bench._attempt_raising(5.0)
+    scripted["ret"] = ("hung", None)
+    with pytest.raises(bench.BenchHang):
+        bench._attempt_raising(5.0)
+    scripted["ret"] = ("failed", 139)
+    with pytest.raises(TransientFault) as ei:
+        bench._attempt_raising(5.0)
+    assert ei.value.rc == 139
+
+
+def test_bench_classifier_guard_and_hang_never_retry():
+    import bench
+
+    assert bench._bench_classify(GuardFailure("rule broken")) is False
+    assert bench._bench_classify(bench.BenchHang("wedged")) is False
+    assert bench._bench_classify(TransientFault("claim")) is True
+    # the masquerade invariant end-to-end: an AssertionError must take
+    # the retry path, never the guard path
+    assert bench._bench_classify(AssertionError("jax internal")) is True
+
+
+def test_bench_retry_counts_under_shared_classifier(monkeypatch):
+    import bench
+
+    calls = []
+
+    def guard_fails(timeout, mode="--direct", extra=None, env_extra=None):
+        calls.append(1)
+        return ("failed", bench.GUARD_RC)
+
+    monkeypatch.setattr(bench, "_attempt", guard_fails)
+    with pytest.raises(GuardFailure):
+        with_retries(lambda: bench._attempt_raising(5.0),
+                     classify=bench._bench_classify, max_attempts=2,
+                     sleep=lambda s: None)
+    assert len(calls) == 1  # guard: no second child spawned
+
+    calls.clear()
+    monkeypatch.setattr(
+        bench, "_attempt",
+        lambda timeout, mode="--direct", extra=None, env_extra=None:
+        (calls.append(1), ("failed", 1))[1])
+    with pytest.raises(TransientFault):
+        with_retries(lambda: bench._attempt_raising(5.0),
+                     classify=bench._bench_classify, max_attempts=2,
+                     base_delay_s=0.0, sleep=lambda s: None)
+    assert len(calls) == 2  # transient: one paused re-attempt
+
+
+# -- chunked-VI checkpoint/resume (host seam, synthetic contraction) ---------
+
+
+def _contraction_step(value, prog, steps):
+    """chunk_step contract stand-in: `steps` Jacobi sweeps of the map
+    v <- (v + 1) / 2 (fixpoint 1), per-sweep max deltas returned."""
+    import jax.numpy as jnp
+
+    deltas = []
+    v = jnp.asarray(value)
+    for _ in range(steps):
+        nv = (v + 1.0) / 2.0
+        deltas.append(jnp.max(jnp.abs(nv - v)))
+        v = nv
+    return v, prog, jnp.zeros_like(v, jnp.int32), jnp.stack(deltas)
+
+
+def _run_vi(checkpoint_path=None):
+    from cpr_tpu.mdp.explicit import run_chunk_driver
+
+    return run_chunk_driver(_contraction_step, 8, np.float32, 1e-4, 64,
+                            chunk=4, checkpoint_path=checkpoint_path)
+
+
+def test_vi_chunk_kill_and_resume_bit_identical(tmp_path, monkeypatch):
+    ref_value, _, _, ref_delta, ref_it, ref_resid = _run_vi()
+    assert float(ref_delta) <= 1e-4 and ref_it == 16
+
+    ck = str(tmp_path / "vi-ck.npz")
+    monkeypatch.setenv(resilience.FAULT_ENV_VAR, "kill@vi_chunk=3")
+    with pytest.raises(InjectedKill):
+        _run_vi(checkpoint_path=ck)
+    assert os.path.exists(ck)  # chunks 1-2 landed before the crash
+
+    monkeypatch.delenv(resilience.FAULT_ENV_VAR)
+    value, _, _, delta, it, resid = _run_vi(checkpoint_path=ck)
+    assert it == ref_it
+    np.testing.assert_array_equal(np.asarray(value), np.asarray(ref_value))
+    np.testing.assert_array_equal(resid, ref_resid)
+    # crash-recovery scratch is deleted once the solve completes
+    assert not os.path.exists(ck) and not os.path.exists(ck + ".json")
+
+
+def test_vi_chunk_transient_fault_is_retried(tmp_path, monkeypatch):
+    ref_value = np.asarray(_run_vi()[0])
+    tele_path = tmp_path / "tele.jsonl"
+    monkeypatch.setenv(resilience.FAULT_ENV_VAR, "fault@vi_chunk=1")
+    telemetry.configure(str(tele_path))
+    try:
+        value, *_ = _run_vi()
+    finally:
+        telemetry.configure(None)
+    np.testing.assert_array_equal(np.asarray(value), ref_value)
+    events = [json.loads(ln) for ln in open(tele_path)]
+    assert any(e.get("name") == "retry" and e.get("site") == "vi_chunk"
+               for e in events)
+    assert any(e.get("name") == "fault_injected" for e in events)
+
+
+def test_while_impl_refuses_checkpoint_path():
+    from cpr_tpu.mdp import Compiler, ptmdp
+    from cpr_tpu.mdp.models import Fc16BitcoinSM
+
+    c = Compiler(Fc16BitcoinSM(alpha=0.25, gamma=0.5,
+                               maximum_fork_length=4))
+    tm = ptmdp(c.mdp(), horizon=10).tensor()
+    with pytest.raises(ValueError, match="while"):
+        tm.value_iteration(stop_delta=1e-4, impl="while",
+                           checkpoint_path="/tmp/nope.npz")
+
+
+# -- training-loop integration (same jit geometry as test_train_driver) ------
+
+
+def _tiny_cfg(**over):
+    from cpr_tpu.train.config import TrainConfig
+
+    kw = dict(protocol="nakamoto", alpha=0.4, episode_len=16, n_envs=8,
+              total_updates=4,
+              ppo=dict(n_steps=8, n_minibatches=2, update_epochs=1,
+                       lr=1e-3),
+              eval=dict(freq=2, start_at_iteration=0))
+    kw.update(over)
+    return TrainConfig(**kw)
+
+
+@pytest.fixture
+def fake_eval(monkeypatch):
+    """Deterministic scripted eval (constant score): keeps the focus on
+    loop control and avoids compiling the eval kernel."""
+    from cpr_tpu.train import driver as drv
+
+    def fn(env, cfg, net_params, **kw):
+        return [dict(alpha=0.4, gamma=0.5, relative_reward=0.3,
+                     reward_per_progress=0.3, episode_progress=1.0)]
+
+    monkeypatch.setattr(drv, "evaluate_per_alpha", fn)
+    return fn
+
+
+def test_kill_and_resume_bit_identical_history(tmp_path, monkeypatch,
+                                               fake_eval):
+    """THE acceptance criterion: kill at update 4 with the last
+    snapshot at update 2, resume, and the full metrics history equals
+    an uninterrupted run's — including the orphan update-3 row the
+    snapshot never saw (trimmed and re-produced)."""
+    from cpr_tpu.train import driver as drv
+
+    a, b = tmp_path / "a", tmp_path / "b"
+    cfg = _tiny_cfg()
+    drv.train_from_config(cfg, out_dir=str(a), snapshot_freq=2)
+
+    monkeypatch.setenv(resilience.FAULT_ENV_VAR, "kill@update=4")
+    with pytest.raises(InjectedKill):
+        drv.train_from_config(cfg, out_dir=str(b), snapshot_freq=2)
+    monkeypatch.delenv(resilience.FAULT_ENV_VAR)
+    # the crash left rows 1-3 but a snapshot at 2: row 3 is an orphan
+    pre = [json.loads(ln) for ln in open(b / "metrics.jsonl")]
+    assert any(r.get("update") == 3 and "eval" not in r for r in pre)
+    assert json.load(open(b / "snapshot.msgpack.json"))["update"] == 2
+
+    params, hist, _ = drv.train_from_config(
+        cfg, out_dir=str(b), snapshot_freq=2, resume=True)
+    assert len(hist) == 2  # resumed segment only: updates 3 and 4
+    fp_a = resilience.metrics_fingerprint(str(a / "metrics.jsonl"))
+    fp_b = resilience.metrics_fingerprint(str(b / "metrics.jsonl"))
+    assert fp_a == fp_b
+    ups = [r["update"] for r in fp_b if "eval" not in r]
+    assert ups == [1, 2, 3, 4]  # no duplicates after the trim
+
+
+def test_resume_rejects_config_mismatch(tmp_path, fake_eval):
+    from cpr_tpu.train import driver as drv
+
+    cfg = _tiny_cfg(total_updates=2)
+    drv.train_from_config(cfg, out_dir=str(tmp_path), snapshot_freq=1)
+    with pytest.raises(ValueError, match="config"):
+        drv.train_from_config(_tiny_cfg(total_updates=2, seed=1),
+                              out_dir=str(tmp_path), resume=True)
+    with pytest.raises(ValueError, match="resume"):
+        drv.train_from_config(cfg, resume=True)  # no out_dir, no path
+
+
+def test_injected_io_error_on_checkpoint_is_retried(tmp_path, monkeypatch,
+                                                    fake_eval):
+    from cpr_tpu.train import driver as drv
+
+    tele_path = tmp_path / "tele.jsonl"
+    monkeypatch.setenv(resilience.FAULT_ENV_VAR, "io_error@checkpoint=1")
+    telemetry.configure(str(tele_path))
+    try:
+        drv.train_from_config(_tiny_cfg(total_updates=2),
+                              out_dir=str(tmp_path / "run"),
+                              snapshot_freq=2)
+    finally:
+        telemetry.configure(None)
+    assert os.path.exists(tmp_path / "run" / "last-model.msgpack")
+    events = [json.loads(ln) for ln in open(tele_path)]
+    assert any(e.get("name") == "retry"
+               and str(e.get("site", "")).startswith("save:")
+               for e in events)
+    assert any(e.get("name") == "fault_injected"
+               and e.get("site") == "checkpoint" for e in events)
+    # artifact kinds ride as `what` (the record `kind` stays "event")
+    kinds = {e.get("what") for e in events
+             if e.get("name") == "checkpoint"}
+    assert {"last", "best", "snapshot"} <= kinds
+
+
+def test_injected_preempt_snapshots_and_exits_clean(tmp_path, monkeypatch,
+                                                    fake_eval):
+    from cpr_tpu.train import driver as drv
+
+    monkeypatch.setenv(resilience.FAULT_ENV_VAR, "preempt@update=2")
+    _, hist, _ = drv.train_from_config(_tiny_cfg(), out_dir=str(tmp_path),
+                                       snapshot_freq=2)
+    monkeypatch.delenv(resilience.FAULT_ENV_VAR)
+    assert len(hist) == 1  # stopped cooperatively before update 2
+    assert os.path.exists(tmp_path / "preempt-model.msgpack")
+    rows = [json.loads(ln) for ln in open(tmp_path / "metrics.jsonl")]
+    pre = [r for r in rows if r.get("preempted")]
+    assert pre and pre[0]["update"] == 1
+    assert json.load(open(tmp_path / "snapshot.msgpack.json"))["update"] == 1
+
+
+def test_injected_nan_triggers_nonfinite_revert(tmp_path, monkeypatch,
+                                                fake_eval):
+    """nan@update=2 poisons the params before update 2; with a best
+    checkpoint from the update-1 eval, the driver must log the
+    poisoned row, revert, and finish with finite parameters."""
+    import jax
+    from cpr_tpu.train import driver as drv
+
+    monkeypatch.setenv(resilience.FAULT_ENV_VAR, "nan@update=2")
+    params, hist, _ = drv.train_from_config(
+        _tiny_cfg(total_updates=3, eval=dict(freq=1, start_at_iteration=0)),
+        out_dir=str(tmp_path), snapshot_freq=3)
+    monkeypatch.delenv(resilience.FAULT_ENV_VAR)
+    rows = [json.loads(ln) for ln in open(tmp_path / "metrics.jsonl")]
+    reverts = [r for r in rows if r.get("revert")]
+    assert reverts and reverts[0]["reason"] == "nonfinite_loss"
+    assert reverts[0]["update"] == 2
+    assert all(np.isfinite(np.asarray(x)).all()
+               for x in jax.tree_util.tree_leaves(params))
+
+
+def test_eval_fn_cache_keyed_by_object_not_id(fake_eval):
+    """Regression: the eval-fn cache was keyed on id(env); a GC'd env's
+    id can be reused, serving a jitted fn closed over the wrong env.
+    The weak-keyed cache cannot hold an entry for a dead env."""
+    from cpr_tpu.train import driver as drv
+
+    class Env:  # stand-in; the cache only needs a weakref-able key
+        pass
+
+    before = len(drv._EVAL_FN_CACHE)
+    e1, e2 = Env(), Env()
+    drv._EVAL_FN_CACHE[e1] = {("h", 16): "fn1"}
+    drv._EVAL_FN_CACHE[e2] = {("h", 16): "fn2"}
+    assert drv._EVAL_FN_CACHE[e1] != drv._EVAL_FN_CACHE[e2]
+    del e1, e2
+    gc.collect()
+    assert len(drv._EVAL_FN_CACHE) == before

@@ -1,0 +1,142 @@
+"""Kill-and-resume determinism smoke (`make resilience-smoke`).
+
+Three tiny CPU training runs of one config, in subprocesses so an
+injected kill dies exactly like a real crash:
+
+  A  — uninterrupted reference, with `io_error@checkpoint=1` injected
+       so the checkpoint-write retry path is exercised and proven
+       harmless to the result;
+  B1 — `kill@update=K` injected: the child crashes mid-run, leaving a
+       snapshot plus orphan metrics rows the snapshot never saw;
+  B2 — resumed from B's snapshot (fault injection off), runs to the
+       end.
+
+Asserts the acceptance criterion of docs/RESILIENCE.md: B1 exited
+nonzero, B2 completed, B's concatenated metrics.jsonl — headers and
+volatile timing keys stripped (resilience.metrics_fingerprint) — is
+bit-identical to A's, and update numbering carries no duplicates.
+Both telemetry streams must then pass
+`tools/trace_summary.py --validate --expect <resilience events>`.
+
+Usage: python tools/resilience_smoke.py [workdir]   (default /tmp/...)
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+from cpr_tpu import resilience  # noqa: E402  (jax-free at import)
+
+TOTAL_UPDATES = 8
+KILL_AT = 8          # snapshot cadence 3 -> last snapshot at 6, update 7
+SNAP_FREQ = 3        # becomes an orphan row that resume must trim
+CFG = dict(
+    protocol="nakamoto", alpha=0.25, gamma=0.5, episode_len=8,
+    n_envs=4, total_updates=TOTAL_UPDATES, seed=0,
+    ppo=dict(n_steps=4, n_minibatches=2, update_epochs=1,
+             layer_size=8, n_layers=1),
+    eval=dict(freq=3, start_at_iteration=0, episodes_per_alpha=2),
+)
+
+
+def _child(out_dir: str, resume: bool):
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from cpr_tpu.train.config import TrainConfig
+    from cpr_tpu.train.driver import train_from_config
+
+    train_from_config(TrainConfig(**CFG), out_dir=out_dir,
+                      resume=resume, snapshot_freq=SNAP_FREQ)
+
+
+def _run_child(out_dir: str, telemetry_path: str, *, resume=False,
+               fault=None):
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               CPR_TELEMETRY=telemetry_path)
+    env.pop(resilience.FAULT_ENV_VAR, None)
+    if fault:
+        env[resilience.FAULT_ENV_VAR] = fault
+    cmd = [sys.executable, os.path.abspath(__file__), "--child", out_dir]
+    if resume:
+        cmd.append("--resume")
+    return subprocess.run(cmd, env=env, capture_output=True, text=True)
+
+
+def _validate_stream(path: str, expect: str):
+    tool = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "trace_summary.py")
+    r = subprocess.run(
+        [sys.executable, tool, path, "--validate", "--expect", expect],
+        capture_output=True, text=True)
+    if r.returncode != 0:
+        sys.stderr.write(r.stdout + r.stderr)
+        raise SystemExit(f"telemetry validation failed for {path}")
+
+
+def main():
+    if "--child" in sys.argv:
+        _child(sys.argv[sys.argv.index("--child") + 1],
+               "--resume" in sys.argv)
+        return
+    work = (sys.argv[1] if len(sys.argv) > 1
+            else "/tmp/cpr-resilience-smoke")
+    os.makedirs(work, exist_ok=True)
+    a_dir, b_dir = os.path.join(work, "a"), os.path.join(work, "b")
+    a_tele, b_tele = (os.path.join(work, "a.jsonl"),
+                      os.path.join(work, "b.jsonl"))
+
+    print("resilience-smoke: run A (uninterrupted, io_error injected "
+          "on checkpoint 1)", file=sys.stderr)
+    r = _run_child(a_dir, a_tele, fault="io_error@checkpoint=1")
+    if r.returncode != 0:
+        sys.stderr.write(r.stderr)
+        raise SystemExit("run A failed")
+
+    print(f"resilience-smoke: run B1 (kill@update={KILL_AT})",
+          file=sys.stderr)
+    r = _run_child(b_dir, b_tele, fault=f"kill@update={KILL_AT}")
+    if r.returncode == 0:
+        raise SystemExit("run B1 was supposed to die from the injected "
+                         "kill, but exited 0")
+    if not os.path.exists(os.path.join(b_dir, "snapshot.msgpack")):
+        sys.stderr.write(r.stderr)
+        raise SystemExit("run B1 left no snapshot")
+
+    print("resilience-smoke: run B2 (resume)", file=sys.stderr)
+    r = _run_child(b_dir, b_tele, resume=True)
+    if r.returncode != 0:
+        sys.stderr.write(r.stderr)
+        raise SystemExit("resume failed")
+
+    fp_a = resilience.metrics_fingerprint(
+        os.path.join(a_dir, "metrics.jsonl"))
+    fp_b = resilience.metrics_fingerprint(
+        os.path.join(b_dir, "metrics.jsonl"))
+    if fp_a != fp_b:
+        for i, (ra, rb) in enumerate(zip(fp_a, fp_b)):
+            if ra != rb:
+                print(f"first divergent row {i}:\n  A: {json.dumps(ra)}"
+                      f"\n  B: {json.dumps(rb)}", file=sys.stderr)
+                break
+        raise SystemExit(
+            f"kill-and-resume history diverged from the uninterrupted "
+            f"run ({len(fp_a)} vs {len(fp_b)} rows)")
+    updates = [row["update"] for row in fp_b if "eval" not in row
+               and "revert" not in row and "update" in row]
+    if updates != sorted(set(updates)):
+        raise SystemExit(f"duplicate/unordered update rows: {updates}")
+
+    _validate_stream(a_tele, "checkpoint,retry,fault_injected")
+    _validate_stream(b_tele, "checkpoint,resume,fault_injected")
+    print(f"resilience-smoke: PASS ({len(fp_a)} comparable rows, "
+          f"updates 1..{TOTAL_UPDATES} bit-identical after "
+          f"kill@update={KILL_AT} + resume)")
+
+
+if __name__ == "__main__":
+    main()

@@ -116,13 +116,15 @@ def summarize(events, out=sys.stdout):
     _compile_table(events, out)
     _device_metrics_tables(events, out)
     _vi_residuals_lines(events, out)
+    _resilience_lines(events, out)
     for m in (e for e in events if e.get("kind") == "manifest"):
         cfg = m.get("config") or {}
         print(f"\nmanifest: backend={m.get('backend')} "
               f"devices={m.get('device_count')}x{m.get('device_kind')} "
               f"jax={m.get('jax_version')} git={str(m.get('git_sha'))[:12]} "
               f"config={json.dumps(cfg, sort_keys=True)}", file=out)
-    tabled = ("compile", "device_metrics", "vi_residuals")
+    tabled = ("compile", "device_metrics", "vi_residuals", "retry",
+              "checkpoint")
     for e in (e for e in events if e.get("kind") == "event"
               and e.get("name") not in tabled):
         keys = {k: v for k, v in e.items() if k not in ("kind", "ts")}
@@ -177,6 +179,32 @@ def _vi_residuals_lines(events, out):
         print(f"\nvi_residuals impl={e.get('impl')} "
               f"n_sweeps={e.get('n_sweeps')} {head}"
               f"kept={len(r)} truncated={e.get('truncated')}", file=out)
+
+
+def _resilience_lines(events, out):
+    """Schema-v3 resilience aggregates: retry counts per call site and
+    checkpoint writes per kind (resume/preempted/fault_injected events
+    stay in the generic dump below — they are rare and each one
+    matters)."""
+    retries = defaultdict(lambda: [0, 0.0])
+    ckpts = defaultdict(int)
+    for e in events:
+        if e.get("kind") != "event":
+            continue
+        if e.get("name") == "retry":
+            a = retries[e.get("site", "?")]
+            a[0] += 1
+            a[1] += e.get("delay_s") or 0.0
+        elif e.get("name") == "checkpoint":
+            ckpts[e.get("what", "?")] += 1
+    if retries:
+        print(f"\n{'retried site':<32} {'retries':>8} "
+              f"{'backoff_s':>10}", file=out)
+        for site, (n, d) in sorted(retries.items(), key=lambda kv: -kv[1][0]):
+            print(f"{site:<32} {n:>8} {d:>10.2f}", file=out)
+    if ckpts:
+        kinds = " ".join(f"{k}={n}" for k, n in sorted(ckpts.items()))
+        print(f"\ncheckpoints written: {kinds}", file=out)
 
 
 def main(argv):
