@@ -4,7 +4,7 @@
 
 CPU_MESH = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test test-slow bench telemetry-smoke resilience-smoke dryrun sweeps ghostdag train-dummy native asan
+.PHONY: test test-slow bench telemetry-smoke netsim-smoke resilience-smoke dryrun sweeps ghostdag train-dummy native asan
 
 test:  ## fast tier (< ~8 min on the 1-core host)
 	python -m pytest tests/ -q
@@ -38,6 +38,19 @@ telemetry-smoke:  ## tiny nakamoto CPU bench with telemetry + in-graph
 		CPR_TELEMETRY=$(TELEMETRY_SMOKE) python bench.py
 	python tools/trace_summary.py $(TELEMETRY_SMOKE) --validate \
 		--expect device_metrics,compile
+
+NETSIM_SMOKE = /tmp/cpr-netsim-smoke.jsonl
+
+netsim-smoke:  ## tiny CPU netsim sweep (both execution modes: the
+	## fused nakamoto scan and the general bk event engine) with
+	## telemetry on, then schema-validate the artifact including the
+	## typed `netsim` point event
+	rm -f $(NETSIM_SMOKE)
+	JAX_PLATFORMS=cpu CPR_DEVICE_METRICS=1 \
+		CPR_TELEMETRY=$(NETSIM_SMOKE) \
+		python examples/netsim_sweep.py --smoke /tmp/cpr-netsim-smoke.tsv
+	python tools/trace_summary.py $(NETSIM_SMOKE) --validate \
+		--expect netsim,device_metrics,compile
 
 RESILIENCE_SMOKE_DIR = /tmp/cpr-resilience-smoke
 

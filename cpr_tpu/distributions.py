@@ -20,6 +20,15 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+# Shared tail clamp for the geometric inverse-CDF on both faces: the
+# host sampler used 1e-300 while the JAX face used 1e-12, so the two
+# engines had different support ceilings for the same declaration
+# (ceil(log u / log(1-p)) at the clamp).  One constant keeps
+# sample(rng) and sample_jax(key) — and netsim's dense delay sampler —
+# on the same bound; tests/test_distributions.py asserts the faces
+# agree on support and mean for every kind.
+GEOM_TAIL_CLAMP = 1e-12
+
 
 @dataclass(frozen=True)
 class Distribution:
@@ -39,7 +48,7 @@ class Distribution:
             if p[0] >= 1.0:
                 return 1.0
             return max(1.0, float(int(np.ceil(
-                np.log(max(rng.random(), 1e-300))
+                np.log(max(rng.random(), GEOM_TAIL_CLAMP))
                 / np.log(1.0 - p[0])))))
         if k == "discrete":
             return float(rng.choices(range(len(p)), weights=p)[0])
@@ -56,7 +65,8 @@ class Distribution:
         if k == "geometric":
             if p[0] >= 1.0:
                 return jnp.float32(1.0)
-            u = jax.random.uniform(key, minval=1e-12, maxval=1.0)
+            u = jax.random.uniform(key, minval=GEOM_TAIL_CLAMP,
+                                   maxval=1.0)
             return jnp.maximum(
                 jnp.ceil(jnp.log(u) / jnp.log(1.0 - p[0])), 1.0)
         if k == "discrete":

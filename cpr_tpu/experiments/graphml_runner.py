@@ -11,9 +11,9 @@ from __future__ import annotations
 from xml.etree import ElementTree as ET
 
 from cpr_tpu import network as netlib
+from cpr_tpu import telemetry
 from cpr_tpu import trace
 from cpr_tpu.envs.registry import parse_key
-from cpr_tpu.telemetry import now
 
 
 def _oracle_args(protocol_key: str):
@@ -32,20 +32,25 @@ def run_graphml(xml_in: str, *, protocol: str = "nakamoto",
     GraphML holding the block DAG, the causal trace, and run metrics."""
     net = netlib.of_graphml(xml_in)
     proto, k, scheme = _oracle_args(protocol)
-    t0 = now()
-    sim = netlib.simulate(net, protocol=proto, k=k, scheme=scheme,
-                          activations=activations, seed=seed)
-    duration = now() - t0
+    tele = telemetry.current()
+    with tele.span("graphml:simulate", activations=activations) as sp:
+        sim = netlib.simulate(net, protocol=proto, k=k, scheme=scheme,
+                              activations=activations, seed=seed)
     view = trace.view_of_oracle(sim)
     out = trace.to_graphml(view)
     root = ET.fromstring(out)
     graph = next(el for el in root if el.tag.endswith("graph"))
+    man = tele.manifest(config=dict(
+        pipe="graphml_runner", protocol=protocol,
+        activations=activations, seed=seed))
     for name, value in [
             ("protocol", protocol),
             ("activations", activations),
             ("sim_time", sim.metric("sim_time")),
             ("head_progress", sim.metric("progress")),
-            ("machine_duration_s", duration)]:
+            ("machine_duration_s", sp.dur_s),
+            ("backend", man.get("backend", "")),
+            ("git_sha", man.get("git_sha", "") or "")]:
         el = ET.SubElement(graph, "data", key=f"run_{name}")
         el.text = str(value)
     sim.close()

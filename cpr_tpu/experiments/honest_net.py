@@ -6,13 +6,26 @@ efficiency rows into TSV.  The reference farms tasks over processes
 (csv_runner.ml:105-131); the oracle is C++ and single tasks are fast, so
 a plain loop suffices — rows carry `machine_duration_s` like the
 reference's Mtime counter (csv_runner.ml:65,76).
+
+Two engines produce the same row schema:
+
+- ``engine="oracle"`` (default): one serial C++ oracle run per
+  (protocol, activation_delay) grid point.
+- ``engine="jax"``: the cpr_tpu.netsim batch engine — all activation
+  delays of a protocol execute as vmapped lanes of ONE device program
+  (protocols netsim doesn't implement degrade to error rows, exactly
+  like an unknown protocol does on the oracle path).
+
+Both paths time their work with telemetry spans and stamp every row
+with fields from `run_manifest()` (engine/backend/git_sha) so a TSV
+artifact is interpretable without the process that wrote it.
 """
 
 from __future__ import annotations
 
+from cpr_tpu import telemetry
 from cpr_tpu.experiments.sweep import run_task
 from cpr_tpu.native import OracleSim
-from cpr_tpu.telemetry import now
 
 DEFAULT_PROTOCOLS = (
     ("nakamoto", {}),
@@ -30,57 +43,81 @@ DEFAULT_PROTOCOLS = (
 DEFAULT_ACTIVATION_DELAYS = (30.0, 60.0, 120.0, 300.0, 600.0)
 
 
-def honest_net_rows(protocols=DEFAULT_PROTOCOLS,
-                    activation_delays=DEFAULT_ACTIVATION_DELAYS,
-                    *, n_nodes: int = 10, n_activations: int = 10_000,
-                    propagation_delay: float = 1.0, seed: int = 0):
-    """One row per (protocol, activation_delay) honest clique run."""
+def _manifest_fields(tele, engine: str, config: dict) -> dict:
+    """Emit a run manifest into the telemetry artifact and return the
+    compact per-row provenance columns derived from it."""
+    man = tele.manifest(config=config)
+    return {
+        "engine": engine,
+        "backend": man.get("backend", ""),
+        "git_sha": man.get("git_sha", "") or "",
+    }
+
+
+def _row(*, n_nodes, proto, kw, ad, n_activations, sim_time,
+         head_height, progress, n_blocks, on_chain, rewards,
+         activations, duration_s, stamp):
+    return {
+        "network": f"honest_clique_{n_nodes}",
+        "protocol": proto,
+        "k": kw.get("k", 1),
+        "incentive_scheme": kw.get("scheme", "constant"),
+        "activation_delay": ad,
+        "activations": n_activations,
+        "sim_time": sim_time,
+        "head_height": head_height,
+        "head_progress": progress,
+        "n_blocks": n_blocks,
+        "on_chain": on_chain,
+        # the reference battery's definition
+        # (cpr_protocols.ml:504-509): PoW not reflected in head
+        # progress, over PoW spent.  1 - on_chain/n_blocks would
+        # count non-PoW appends (tailstorm summaries, bk
+        # proposals) as orphanable and overstate the rate ~40x
+        # for the parallel family.
+        "orphan_rate": max(0.0, 1.0 - progress / n_activations),
+        "reward_total": sum(rewards),
+        "reward_min": min(rewards),
+        "reward_max": max(rewards),
+        # per-node arrays, "|"-joined like the reference TSV
+        # (csv_runner.ml:43-48,77-78); honest cliques weight
+        # compute uniformly (models.ml honest_clique)
+        "compute": "|".join("1" for _ in range(n_nodes)),
+        "node_activations": "|".join(str(a) for a in activations),
+        "reward": "|".join(f"{r:.6g}" for r in rewards),
+        "machine_duration_s": duration_s,
+        **stamp,
+    }
+
+
+def _oracle_rows(protocols, activation_delays, *, n_nodes,
+                 n_activations, propagation_delay, seed, tele, stamp):
     def one(proto, kw, ad):
-        t0 = now()
-        s = OracleSim(proto, topology="clique", n_nodes=n_nodes,
-                      activation_delay=ad,
-                      propagation_delay=propagation_delay,
-                      seed=seed, **kw)
-        try:
-            s.run(n_activations)
-            rewards = s.rewards(n_nodes)
-            activations = s.activations(n_nodes)
-            n_blocks = s.metric("n_blocks")
-            on_chain = s.metric("on_chain")
-            progress = s.metric("progress")
-            return {
-                "network": f"honest_clique_{n_nodes}",
-                "protocol": proto,
-                "k": kw.get("k", 1),
-                "incentive_scheme": kw.get("scheme", "constant"),
-                "activation_delay": ad,
-                "activations": n_activations,
-                "sim_time": s.metric("sim_time"),
-                "head_height": s.metric("head_height"),
-                "head_progress": progress,
-                "n_blocks": n_blocks,
-                "on_chain": on_chain,
-                # the reference battery's definition
-                # (cpr_protocols.ml:504-509): PoW not reflected in head
-                # progress, over PoW spent.  1 - on_chain/n_blocks would
-                # count non-PoW appends (tailstorm summaries, bk
-                # proposals) as orphanable and overstate the rate ~40x
-                # for the parallel family.
-                "orphan_rate":
-                    max(0.0, 1.0 - progress / n_activations),
-                "reward_total": sum(rewards),
-                "reward_min": min(rewards),
-                "reward_max": max(rewards),
-                # per-node arrays, "|"-joined like the reference TSV
-                # (csv_runner.ml:43-48,77-78); honest cliques weight
-                # compute uniformly (models.ml honest_clique)
-                "compute": "|".join("1" for _ in range(n_nodes)),
-                "node_activations": "|".join(str(a) for a in activations),
-                "reward": "|".join(f"{r:.6g}" for r in rewards),
-                "machine_duration_s": now() - t0,
-            }
-        finally:
-            s.close()
+        with tele.span("honest_net:oracle",
+                       activations=n_activations) as sp:
+            s = OracleSim(proto, topology="clique", n_nodes=n_nodes,
+                          activation_delay=ad,
+                          propagation_delay=propagation_delay,
+                          seed=seed, **kw)
+            try:
+                s.run(n_activations)
+                rewards = s.rewards(n_nodes)
+                activations = s.activations(n_nodes)
+                metrics = {name: s.metric(name) for name in (
+                    "sim_time", "head_height", "n_blocks", "on_chain",
+                    "progress")}
+            finally:
+                s.close()
+        return _row(
+            n_nodes=n_nodes, proto=proto, kw=kw, ad=ad,
+            n_activations=n_activations,
+            sim_time=metrics["sim_time"],
+            head_height=metrics["head_height"],
+            progress=metrics["progress"],
+            n_blocks=metrics["n_blocks"],
+            on_chain=metrics["on_chain"],
+            rewards=rewards, activations=activations,
+            duration_s=sp.dur_s, stamp=stamp)
 
     rows = []
     for proto, kw in protocols:
@@ -90,5 +127,79 @@ def honest_net_rows(protocols=DEFAULT_PROTOCOLS,
                 {"network": f"honest_clique_{n_nodes}", "protocol": proto,
                  "k": kw.get("k", 1),
                  "incentive_scheme": kw.get("scheme", "constant"),
-                 "activation_delay": ad}))
+                 "activation_delay": ad, **stamp}))
     return rows
+
+
+def _netsim_rows(protocols, activation_delays, *, n_nodes,
+                 n_activations, propagation_delay, seed, tele, stamp):
+    """One vmapped netsim program per protocol config: each activation
+    delay is a lane, so the whole column of the sweep grid runs as a
+    single device call."""
+    from cpr_tpu import netsim
+    from cpr_tpu.network import symmetric_clique
+
+    delays = [float(a) for a in activation_delays]
+    net = symmetric_clique(n_nodes, activation_delay=delays[0],
+                          propagation_delay=propagation_delay)
+
+    def batch(proto, kw):
+        k = kw.get("k", 1)
+        scheme = kw.get("scheme", "constant")
+        if not netsim.supports(proto, k, scheme):
+            raise ValueError(
+                f"netsim supports protocols {netsim.SUPPORTED_PROTOCOLS}"
+                f", not '{proto}' (k={k}, scheme='{scheme}')")
+        eng = netsim.Engine(net, protocol=proto, k=k, scheme=scheme,
+                            activations=n_activations)
+        with tele.span("honest_net:netsim", lanes=len(delays),
+                       activations=len(delays) * n_activations) as sp:
+            out = eng.run([seed] * len(delays), delays)
+        # amortized per-lane share of the one batched device call
+        share = sp.dur_s / max(len(delays), 1)
+        rows = []
+        for i, ad in enumerate(delays):
+            rewards = [float(r) for r in out["reward"][i]]
+            activations = [int(a) for a in out["node_act"][i]]
+            rows.append(_row(
+                n_nodes=n_nodes, proto=proto, kw=kw, ad=ad,
+                n_activations=n_activations,
+                sim_time=float(out["sim_time"][i]),
+                head_height=int(out["head_height"][i]),
+                progress=float(out["progress"][i]),
+                n_blocks=int(out["n_blocks"][i]),
+                on_chain=float(out["on_chain"][i]),
+                rewards=rewards, activations=activations,
+                duration_s=share, stamp=stamp))
+        return rows
+
+    rows = []
+    for proto, kw in protocols:
+        rows.extend(run_task(
+            lambda p=proto, k=kw: batch(p, k),
+            {"network": f"honest_clique_{n_nodes}", "protocol": proto,
+             "k": kw.get("k", 1),
+             "incentive_scheme": kw.get("scheme", "constant"), **stamp}))
+    return rows
+
+
+def honest_net_rows(protocols=DEFAULT_PROTOCOLS,
+                    activation_delays=DEFAULT_ACTIVATION_DELAYS,
+                    *, n_nodes: int = 10, n_activations: int = 10_000,
+                    propagation_delay: float = 1.0, seed: int = 0,
+                    engine: str = "oracle"):
+    """One row per (protocol, activation_delay) honest clique run."""
+    if engine not in ("oracle", "jax"):
+        raise ValueError(f"engine must be 'oracle' or 'jax', not "
+                         f"'{engine}'")
+    tele = telemetry.current()
+    stamp = _manifest_fields(tele, engine, dict(
+        sweep="honest_net", engine=engine, n_nodes=n_nodes,
+        n_activations=n_activations, seed=seed))
+    impl = _netsim_rows if engine == "jax" else _oracle_rows
+    with tele.span("honest_net:sweep", tasks=len(protocols)
+                   * len(activation_delays)):
+        return impl(protocols, activation_delays, n_nodes=n_nodes,
+                    n_activations=n_activations,
+                    propagation_delay=propagation_delay, seed=seed,
+                    tele=tele, stamp=stamp)

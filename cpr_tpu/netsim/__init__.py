@@ -1,0 +1,29 @@
+"""JAX-native, vmap-batched multi-node network simulator.
+
+The reference's first hot loop — the discrete-event simulator over
+arbitrary topologies (simulator/lib/simulator.ml + network.ml) — exists
+in this repo only as the single-threaded C++ oracle
+(cpr_tpu/native/src/oracle.cpp), so every honest-net sweep runs the
+protocols x activation-delays x seeds grid serially on one host core.
+This package compiles a `network.Network` into dense device arrays and
+drives the honest-node dynamics inside one jitted `lax.while_loop`,
+with `vmap` over lanes carrying independent (seed, activation_delay)
+so a whole sweep grid executes as a single device program.
+
+Semantics follow oracle.cpp (flooding + dedup + parent-gated delivery
++ same-timestamp unlock); statistical parity against the unmodified
+oracle is the correctness anchor (PARITY.md, tests/test_netsim.py).
+See docs/NETSIM.md for the event-engine design, the documented
+approximations, and the capacity limits.
+"""
+
+from cpr_tpu.netsim.compile import (  # noqa: F401
+    CompiledNet, compile_network, sample_delay_matrix, NETSIM_KINDS,
+)
+from cpr_tpu.netsim.engine import (  # noqa: F401
+    Engine, SUPPORTED_PROTOCOLS, grid, supports,
+)
+
+__all__ = ["CompiledNet", "compile_network", "sample_delay_matrix",
+           "NETSIM_KINDS", "Engine", "SUPPORTED_PROTOCOLS", "grid",
+           "supports"]

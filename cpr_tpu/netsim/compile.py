@@ -1,0 +1,99 @@
+"""Compile a `network.Network` into dense device arrays.
+
+Mirrors the row-major (src*n + dst) link encoding the oracle's custom-
+topology C API uses (network.simulate: kind/p0/p1 triples, kind -1 for
+"no link"), but keeps the result on the JAX side: the engine samples a
+whole (N, N) delay matrix per event from the same formulas as
+`Distribution.sample_jax`, so the declaration that drives the host
+oracle drives the in-graph engine too.
+
+The oracle accepts constant/uniform/exponential link delays; netsim
+additionally supports geometric (the `sample_jax` face already does).
+`discrete` link delays are rejected at compile time with a clear
+message — same failure surface as `network.simulate`, but before any
+device work happens.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from cpr_tpu.distributions import GEOM_TAIL_CLAMP
+from cpr_tpu.network import Network
+
+# link-delay kinds the in-graph sampler implements (superset of the
+# oracle's _KINDS: geometric comes for free from the sample_jax face)
+NETSIM_KINDS = {"constant": 0, "uniform": 1, "exponential": 2,
+                "geometric": 3}
+
+
+@dataclass(frozen=True)
+class CompiledNet:
+    """Dense device-ready topology: per-node compute weights plus
+    row-major per-edge (kind, p0, p1) delay planes, kind -1 = no
+    link."""
+    n: int
+    compute: np.ndarray        # (N,) f32, normalized to sum 1
+    kind: np.ndarray           # (N, N) i32, NETSIM_KINDS or -1
+    p0: np.ndarray             # (N, N) f64
+    p1: np.ndarray             # (N, N) f64
+    activation_delay: float
+    flooding: bool
+
+
+def compile_network(net: Network) -> CompiledNet:
+    if net.dissemination not in ("simple", "flooding"):
+        raise ValueError(f"unknown dissemination '{net.dissemination}'")
+    n = len(net.nodes)
+    if n < 2:
+        raise ValueError("netsim needs at least 2 nodes")
+    compute = np.array([nd.compute for nd in net.nodes], np.float64)
+    total = compute.sum()
+    if not (total > 0):
+        raise ValueError("total compute must be positive")
+    kind = np.full((n, n), -1, np.int32)
+    p0 = np.zeros((n, n), np.float64)
+    p1 = np.zeros((n, n), np.float64)
+    for i, nd in enumerate(net.nodes):
+        for link in nd.links:
+            d = link.delay
+            if d.kind not in NETSIM_KINDS:
+                raise ValueError(
+                    f"netsim supports constant/uniform/exponential/"
+                    f"geometric link delays, not '{d.kind}'")
+            kind[i, link.dest] = NETSIM_KINDS[d.kind]
+            p0[i, link.dest] = d.params[0]
+            p1[i, link.dest] = d.params[1] if len(d.params) > 1 else 0.0
+    return CompiledNet(
+        n=n, compute=(compute / total).astype(np.float32), kind=kind,
+        p0=p0, p1=p1, activation_delay=float(net.activation_delay),
+        flooding=net.dissemination == "flooding")
+
+
+def sample_delay_matrix(key, kind, p0, p1, dtype):
+    """One (N, N) draw of every link's delay, matching
+    `Distribution.sample_jax` per kind (elementwise over the dense
+    planes; unlinked entries produce garbage that callers mask via
+    kind >= 0)."""
+    import jax
+    import jax.numpy as jnp
+
+    k_u, k_e = jax.random.split(key)
+    u = jax.random.uniform(key=k_u, shape=kind.shape,
+                           minval=GEOM_TAIL_CLAMP, maxval=1.0,
+                           dtype=dtype)
+    e = jax.random.exponential(k_e, shape=kind.shape, dtype=dtype)
+    const = p0
+    unif = p0 + u * (p1 - p0)
+    expo = e * p0
+    # geometric: trials to first success at prob p0, >= 1; the p0 >= 1
+    # degenerate case collapses to 1 exactly as both Distribution faces
+    log1mp = jnp.log(jnp.clip(1.0 - p0, 1e-300, 1.0))
+    geom = jnp.where(p0 >= 1.0, 1.0,
+                     jnp.maximum(jnp.ceil(jnp.log(u) / log1mp), 1.0))
+    out = jnp.where(kind == 0, const,
+                    jnp.where(kind == 1, unif,
+                              jnp.where(kind == 2, expo, geom)))
+    return out.astype(dtype)

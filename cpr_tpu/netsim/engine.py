@@ -1,0 +1,844 @@
+"""The jittable multi-node discrete-event engine.
+
+One `lax.while_loop` drives a whole honest-node simulation: a shared
+append-only block ledger (dense per-field arrays + per-(node, block)
+visibility bits/times), in-flight messages as a fixed-capacity queue,
+and each step advancing to the min over next-activation vs. earliest
+pending delivery.  `vmap` over lanes carries independent
+(seed, activation_delay) pairs, so a sweep grid is one device program.
+
+Event semantics follow oracle.cpp (the house multi-node engine):
+
+* activation: exponential inter-arrival, compute-weighted miner draw,
+  append the protocol block (nakamoto: child of preference; bk: a vote
+  on the preference), self-visibility, per-destination sampled link
+  delays into the queue.
+* delivery: the earliest queue entry — plus every same-(time, block)
+  sibling, delivered as one wave (a broadcast of one block over equal
+  constant delays collapses to a single step; unequal delays
+  degenerate gracefully to per-event steps).  First arrival marks the
+  block known (dedup); delivery requires the parent visible, else the
+  block parks in a per-node pending buffer and is re-queued at the
+  delivering timestamp once the parent lands (oracle
+  unlock_children).  Flooding re-shares on first delivery.
+* bk proposal: state-triggered — whenever some node's preferred block
+  has a visible quorum (>= k confirming votes), at least one own vote,
+  and a best-own-hash below the best visible replacement, one proposer
+  per step appends a proposal at the current timestamp (no time
+  advance), exactly the oracle's propose-within-the-event behavior.
+  The quorum is selected at proposal time (k smallest own hashes,
+  padded with others' votes of larger hash in append order) and
+  stored, so the reward walk replays the oracle's constant/block
+  schemes exactly.
+* drain: after the activation target, deliveries keep processing only
+  while they precede the next (never-executed) activation — the
+  oracle's run() stops at the first activation event left in its
+  queue, and messages beyond that horizon stay undelivered there too.
+
+Documented approximations vs. the oracle (see docs/NETSIM.md for why
+each is distribution-preserving on the honest grids we check):
+parent-gating on parent0 only (the oracle gates on all parents; bk
+proposals' quorum parents can lag parent0 on non-clique topologies),
+proposals land one engine step after the triggering event at the same
+timestamp, and bk quorum search uses a fixed ledger window after the
+confirmed block (window misses are counted in `win_miss`, asserted 0
+by the parity tests).
+
+Times are float64: at sim_time ~ 6e6 (10k activations x 600s delay)
+the f32 ulp is ~0.5s, enough to distort same-timestamp wave grouping.
+`Engine` enters `jax.experimental.enable_x64()` around every trace
+and call; non-time state stays explicitly i32/f32/bool.
+
+Two execution modes share the Engine front-end:
+
+* `event` — the general `lax.while_loop` above: any protocol, any
+  dissemination, state-dependent message flow (flooding re-shares
+  depend on who hears what first).
+* `scan`  — a fused nakamoto fast path for simple dissemination,
+  where every block is sent exactly once per link at mint, making the
+  whole (activations x nodes) arrival-time matrix state-independent
+  and presampleable; see `_scan_lane_fn`.  Identical statistics (the
+  parity grid runs both), ~10x fewer ops per step, and every op
+  carries the lane axis so vmap actually amortizes XLA:CPU dispatch —
+  this is the mode that makes a batched sweep beat the serial oracle
+  loop on wall-clock.  `mode="auto"` (default) picks it whenever it
+  applies.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+
+from cpr_tpu import telemetry
+from cpr_tpu.netsim.compile import (CompiledNet, compile_network,
+                                    sample_delay_matrix)
+
+SUPPORTED_PROTOCOLS = ("nakamoto", "bk")
+_SCHEMES = ("constant", "block")
+
+
+def supports(protocol: str, k: int = 1, scheme: str = "constant") -> bool:
+    """True when the engine implements this protocol config."""
+    if protocol == "nakamoto":
+        return True
+    return (protocol == "bk" and k >= 1
+            and (scheme or "constant") in _SCHEMES)
+
+
+def _lane_fn(cn: CompiledNet, protocol: str, k: int, scheme: str,
+             activations: int, B: int, M: int, F: int, W: int, S: int):
+    """Build lane(key, activation_delay) -> metrics dict.  All shapes
+    static; closure constants come from the compiled network."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    is_bk = protocol == "bk"
+    N = int(cn.n)
+    A = int(activations)
+    C = N * F + N * N  # per-step push candidates: unlocks + sends
+    ft = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+    i32 = jnp.int32
+    INF = jnp.asarray(jnp.inf, ft)
+
+    kindm = jnp.asarray(cn.kind, i32)
+    p0m = jnp.asarray(cn.p0, ft)
+    p1m = jnp.asarray(cn.p1, ft)
+    has_link = kindm >= 0
+    logw = jnp.log(jnp.asarray(cn.compute, jnp.float32))
+    arangeN = jnp.arange(N, dtype=i32)
+
+    def init(key, activation_delay):
+        key, k0 = jax.random.split(key)
+        first = jax.random.exponential(k0, dtype=ft) * activation_delay
+        st = dict(
+            key=key,
+            now=jnp.asarray(0.0, ft),
+            next_act=first,
+            n_act=jnp.asarray(0, i32),
+            nb=jnp.asarray(1, i32),        # genesis occupies slot 0
+            seq=jnp.asarray(0, i32),
+            steps=jnp.asarray(0, i32),
+            live=jnp.asarray(True, bool),
+            parent0=jnp.full((B,), -1, i32),
+            height=jnp.zeros((B,), i32),
+            miner=jnp.full((B,), -1, i32),
+            powh=jnp.full((B,), 2.0, jnp.float32),
+            pref=jnp.zeros((N,), i32),
+            vis=jnp.zeros((N, B), bool).at[:, 0].set(True),
+            vis_at=jnp.full((N, B), jnp.inf, ft).at[:, 0].set(0.0),
+            known=jnp.zeros((N, B), bool).at[:, 0].set(True),
+            node_act=jnp.zeros((N,), i32),
+            q_time=jnp.full((M,), jnp.inf, ft),
+            q_dst=jnp.zeros((M,), i32),
+            q_blk=jnp.zeros((M,), i32),
+            q_seq=jnp.zeros((M,), i32),
+            pend=jnp.full((N, F), -1, i32),
+            drop_q=jnp.asarray(0, i32),
+            drop_p=jnp.asarray(0, i32),
+            drop_b=jnp.asarray(0, i32),
+        )
+        if is_bk:
+            st.update(
+                is_vote=jnp.zeros((B,), bool),
+                lhash=jnp.full((B,), 2.0, jnp.float32),
+                conf=jnp.zeros((N, B), i32),
+                conf_own=jnp.zeros((N, B), i32),
+                mybest=jnp.full((N, B), 2.0, jnp.float32),
+                repl=jnp.full((N, B), 2.0, jnp.float32),
+                noprop=jnp.zeros((N, B), bool),
+                quorum=jnp.full((B, k), -1, i32),
+                win_miss=jnp.asarray(0, i32),
+            )
+        return st
+
+    def bk_want(pref, conf, conf_own, mybest, repl, noprop):
+        pj = pref
+        cj = conf[arangeN, pj]
+        oj = conf_own[arangeN, pj]
+        mbj = mybest[arangeN, pj]
+        rpj = repl[arangeN, pj]
+        npj = noprop[arangeN, pj]
+        return (cj >= k) & (oj >= 1) & (mbj < rpj) & ~npj
+
+    def body(st, activation_delay):
+        key, k_mine, k_pow, k_next, k_delay = jax.random.split(
+            st["key"], 5)
+        tmin = jnp.min(st["q_time"])
+        has_q = jnp.isfinite(tmin)
+        can_act = st["n_act"] < A
+        if is_bk:
+            want = bk_want(st["pref"], st["conf"], st["conf_own"],
+                           st["mybest"], st["repl"], st["noprop"])
+            is_prop = jnp.any(want)
+        else:
+            is_prop = jnp.asarray(False, bool)
+        act_now = can_act & (st["next_act"] <= tmin)
+        # oracle run(): the drain stops at the first (never-executed)
+        # activation left in the queue — deliveries beyond that
+        # horizon stay in flight
+        recv_ok = has_q & ~(~can_act & (tmin >= st["next_act"]))
+        is_act = ~is_prop & act_now
+        is_recv = ~is_prop & ~act_now & recv_ok
+        now2 = jnp.where(is_act, st["next_act"],
+                         jnp.where(is_recv, tmin, st["now"]))
+
+        # ---- delivery wave: every queue entry at (tmin, b) ----------
+        wave0 = is_recv & (st["q_time"] == tmin)
+        seqs = jnp.where(wave0, st["q_seq"], jnp.asarray(2**31 - 1, i32))
+        i0 = jnp.argmin(seqs)
+        b = jnp.where(is_recv, st["q_blk"][i0], 0)
+        wave = wave0 & (st["q_blk"] == b)
+        dvec = jnp.zeros((N + 1,), bool).at[
+            jnp.where(wave, st["q_dst"], N)].max(True)
+        dmask = dvec[:N]
+        q_time_pop = jnp.where(wave, INF, st["q_time"])
+
+        pb = st["parent0"][b]
+        pbc = jnp.clip(pb, 0)
+        pv = (pb < 0) | st["vis"][:, pbc]            # parent visible
+        fresh = dmask & ~st["known"][:, b]
+        deliver = dmask & ~st["vis"][:, b] & pv
+        blocked = fresh & ~pv
+        known2 = st["known"].at[arangeN, b].max(dmask)
+        vis2 = st["vis"].at[arangeN, b].max(deliver)
+        vis_at2 = st["vis_at"].at[arangeN, b].min(
+            jnp.where(deliver, tmin, INF))
+
+        # first arrival with an invisible parent parks in the pending
+        # buffer (oracle: known-but-buffered); overflow is counted
+        occ = st["pend"] >= 0
+        has_free = ~jnp.all(occ, axis=1)
+        slot = jnp.argmin(occ, axis=1).astype(i32)
+        park = blocked & has_free
+        pend2 = st["pend"].at[arangeN, slot].set(
+            jnp.where(park, b, st["pend"][arangeN, slot]))
+        drop_p2 = st["drop_p"] + jnp.sum(
+            blocked & ~has_free).astype(i32)
+
+        if is_bk:
+            is_v = st["is_vote"][b]
+            dv = deliver & is_v
+            dp = deliver & ~is_v
+            conf2 = st["conf"].at[arangeN, pbc].add(dv.astype(i32))
+            noprop2 = st["noprop"].at[arangeN, pbc].min(~dv)
+            repl2 = st["repl"].at[arangeN, pbc].min(
+                jnp.where(dp, st["lhash"][b], jnp.float32(3.0)))
+            # prefer: candidate = the chain block (vote -> its parent)
+            bb = jnp.where(is_v, pbc, b)
+            hb = st["height"][bb]
+            hp = st["height"][st["pref"]]
+            cb = conf2[arangeN, bb]
+            cp = conf2[arangeN, st["pref"]]
+            lb = st["lhash"][bb]
+            lp = st["lhash"][st["pref"]]
+            better = (hb > hp) | ((hb == hp) & (
+                (cb > cp) | ((cb == cp) & (lb < lp))))
+            pref2 = jnp.where(deliver & better, bb, st["pref"])
+        else:
+            better = st["height"][b] > st["height"][st["pref"]]
+            pref2 = jnp.where(deliver & better, b, st["pref"])
+
+        # unlock: parked children whose parent just became visible are
+        # re-queued at the delivering timestamp (oracle same-time
+        # unlock_children; recursion happens via the re-queued entry)
+        par_p = st["parent0"][jnp.clip(pend2, 0)]
+        vis_par = (par_p < 0) | vis2[arangeN[:, None],
+                                     jnp.clip(par_p, 0)]
+        unl = (pend2 >= 0) & deliver[:, None] & vis_par
+        pend3 = jnp.where(unl, -1, pend2)
+
+        # ---- activation --------------------------------------------
+        m = jax.random.categorical(k_mine, logw).astype(i32)
+        powh_new = jax.random.uniform(k_pow, dtype=jnp.float32)
+        next_act2 = jnp.where(
+            is_act,
+            st["next_act"]
+            + jax.random.exponential(k_next, dtype=ft) * activation_delay,
+            st["next_act"])
+        parent_act = st["pref"][m]
+        h_parent = st["height"][parent_act]
+        n_act2 = st["n_act"] + is_act.astype(i32)
+        node_act2 = st["node_act"].at[
+            jnp.where(is_act, m, N)].add(1)
+
+        # ---- bk proposal (one proposer per step, no time advance) ---
+        if is_bk:
+            jstar = jnp.argmax(want).astype(i32)
+            pjs = st["pref"][jstar]
+            start = jnp.clip(pjs + 1, 0, max(B - W, 0))
+            sl_par = lax.dynamic_slice(st["parent0"], (start,), (W,))
+            sl_iv = lax.dynamic_slice(st["is_vote"], (start,), (W,))
+            sl_ph = lax.dynamic_slice(st["powh"], (start,), (W,))
+            sl_mn = lax.dynamic_slice(st["miner"], (start,), (W,))
+            sl_vs = lax.dynamic_slice(st["vis"][jstar], (start,), (W,))
+            onpar = (sl_par == pjs) & sl_iv & sl_vs
+            mine = onpar & (sl_mn == jstar)
+            theirs = onpar & (sl_mn != jstar)
+            mb = st["mybest"][jstar, pjs]
+            cand = theirs & (sl_ph > mb)
+            n_mine = jnp.sum(mine).astype(i32)
+            n_cand = jnp.sum(cand).astype(i32)
+            feasible = (n_mine >= k) | (n_mine + n_cand >= k)
+            # the incremental tallies are exact; a window that no
+            # longer sees every counted vote is a silent corruption —
+            # count it instead (parity asserts 0)
+            cnt_ok = ((n_mine == st["conf_own"][jstar, pjs])
+                      & (jnp.sum(theirs).astype(i32)
+                         == st["conf"][jstar, pjs]
+                         - st["conf_own"][jstar, pjs]))
+            win_miss2 = st["win_miss"] + (
+                is_prop & ~cnt_ok).astype(i32)
+            ok_prop = is_prop & feasible & (st["nb"] < B)
+            fail = is_prop & ~(feasible & (st["nb"] < B))
+            noprop3 = noprop2.at[jstar, pjs].max(fail)
+            # quorum selection: k smallest own hashes, padded with
+            # candidate votes in append (= ledger index) order
+            mine_ord = jnp.argsort(
+                jnp.where(mine, sl_ph, jnp.float32(3.0)))
+            take_mine = jnp.minimum(n_mine, k)
+            need = jnp.clip(k - n_mine, 0, k)
+            crank = jnp.cumsum(cand.astype(i32))
+            r2i = jnp.zeros((W + 1,), i32).at[
+                jnp.where(cand & (crank <= need), crank, 0)].set(
+                jnp.arange(W, dtype=i32))
+            i_arr = jnp.arange(k, dtype=i32)
+            own_part = start + mine_ord[jnp.clip(i_arr, 0, W - 1)]
+            their_part = start + r2i[
+                jnp.clip(i_arr - take_mine + 1, 0, W)]
+            q_row = jnp.where(i_arr < take_mine, own_part, their_part
+                              ).astype(i32)
+            quorum2 = st["quorum"].at[
+                jnp.where(ok_prop, st["nb"], B)].set(q_row)
+        else:
+            ok_prop = jnp.asarray(False, bool)
+
+        # ---- merged ledger append (activation or proposal) ----------
+        ok_act = is_act & (st["nb"] < B)
+        app = ok_act | ok_prop
+        drop_b2 = st["drop_b"] + (
+            (is_act | ok_prop) & (st["nb"] >= B)).astype(i32)
+        if is_bk:
+            a_parent = jnp.where(is_act, parent_act, pjs)
+            a_height = jnp.where(is_act, h_parent,
+                                 st["height"][pjs] + 1)
+            a_miner = jnp.where(is_act, m, jstar)
+            a_powh = jnp.where(is_act, powh_new, jnp.float32(2.0))
+            a_lhash = jnp.where(is_act, jnp.float32(2.0), mb)
+        else:
+            a_parent = parent_act
+            a_height = h_parent + 1
+            a_miner = m
+            a_powh = powh_new
+        idxs = jnp.where(app, st["nb"], B)    # OOB scatters drop
+        parent3 = st["parent0"].at[idxs].set(a_parent)
+        height3 = st["height"].at[idxs].set(a_height)
+        miner3 = st["miner"].at[idxs].set(a_miner)
+        powh3 = st["powh"].at[idxs].set(a_powh)
+        nb2 = st["nb"] + app.astype(i32)
+
+        src = jnp.where(is_act, m, (jstar if is_bk else m))
+        vis3 = vis2.at[src, idxs].set(True)
+        known3 = known2.at[src, idxs].set(True)
+        vis_at3 = vis_at2.at[src, idxs].min(now2)
+
+        if is_bk:
+            isv3 = st["is_vote"].at[idxs].set(is_act)
+            lhash3 = st["lhash"].at[idxs].set(a_lhash)
+            # vote mint: own tallies + best-own-hash on the parent
+            vidx = jnp.where(ok_act, parent_act, B)
+            conf3 = conf2.at[m, vidx].add(1)
+            conf_own2 = st["conf_own"].at[m, vidx].add(1)
+            mybest2 = st["mybest"].at[m, vidx].min(
+                jnp.where(ok_act, powh_new, jnp.float32(3.0)))
+            noprop4 = noprop3.at[m, vidx].min(False)
+            # proposal: bump own replacement floor, prefer the child
+            pidx = jnp.where(ok_prop, pjs, B)
+            repl3 = repl2.at[jstar, pidx].min(mb)
+            pref3 = pref2.at[jnp.where(ok_prop, jstar, N)].set(
+                st["nb"])
+        else:
+            pref3 = pref2.at[jnp.where(ok_act, m, N)].set(st["nb"])
+
+        # ---- push: unlock re-queues + link sends of one block -------
+        delays = sample_delay_matrix(k_delay, kindm, p0m, p1m, ft)
+        if cn.flooding:
+            flood_src = deliver & (st["miner"][b] != arangeN)
+        else:
+            flood_src = jnp.zeros((N,), bool)
+        send_src = jnp.where(is_recv, flood_src, (arangeN == src) & app)
+        s_valid = send_src[:, None] & has_link
+        s_time = now2 + delays
+        s_blk = jnp.where(is_recv, b, st["nb"])
+
+        c_valid = jnp.concatenate([unl.reshape(-1),
+                                   s_valid.reshape(-1)])
+        c_time = jnp.concatenate([jnp.full((N * F,), 1.0, ft) * now2,
+                                  s_time.reshape(-1)])
+        c_dst = jnp.concatenate([jnp.repeat(arangeN, F),
+                                 jnp.tile(arangeN, N)])
+        c_blk = jnp.concatenate([jnp.clip(pend2.reshape(-1), 0),
+                                 jnp.full((N * N,), 1, i32) * s_blk])
+
+        free = ~jnp.isfinite(q_time_pop)
+        rank = jnp.cumsum(c_valid.astype(i32))
+        n_valid = rank[-1]
+        frank = jnp.cumsum(free.astype(i32))
+        n_free = frank[-1]
+        n_place = jnp.minimum(n_valid, n_free)
+        placed = c_valid & (rank <= n_place)
+        r2c = jnp.zeros((max(C, M) + 1,), i32).at[
+            jnp.where(placed, rank, 0)].set(jnp.arange(C, dtype=i32))
+        fill = free & (frank <= n_place)
+        cidx = r2c[jnp.clip(frank, 0, C)]
+        q_time2 = jnp.where(fill, c_time[cidx], q_time_pop)
+        q_dst2 = jnp.where(fill, c_dst[cidx], st["q_dst"])
+        q_blk2 = jnp.where(fill, c_blk[cidx], st["q_blk"])
+        q_seq2 = jnp.where(fill, st["seq"] + frank, st["q_seq"])
+        seq2 = st["seq"] + n_valid
+        drop_q2 = st["drop_q"] + (n_valid - n_place)
+
+        new = dict(
+            key=key, now=now2, next_act=next_act2, n_act=n_act2,
+            nb=nb2, seq=seq2, steps=st["steps"] + 1,
+            parent0=parent3, height=height3, miner=miner3, powh=powh3,
+            pref=pref3, vis=vis3, vis_at=vis_at3, known=known3,
+            node_act=node_act2, q_time=q_time2, q_dst=q_dst2,
+            q_blk=q_blk2, q_seq=q_seq2, pend=pend3,
+            drop_q=drop_q2, drop_p=drop_p2, drop_b=drop_b2,
+        )
+        if is_bk:
+            new.update(is_vote=isv3, lhash=lhash3, conf=conf3,
+                       conf_own=conf_own2, mybest=mybest2, repl=repl3,
+                       noprop=noprop4, quorum=quorum2,
+                       win_miss=win_miss2)
+            want2 = jnp.any(bk_want(pref3, conf3, conf_own2, mybest2,
+                                    repl3, noprop4))
+        else:
+            want2 = jnp.asarray(False, bool)
+        tmin2 = jnp.min(q_time2)
+        new["live"] = (want2 | (n_act2 < A)
+                       | ((tmin2 < next_act2) & jnp.isfinite(tmin2)))
+        return new
+
+    def finalize(st):
+        height = st["height"]
+        pref = st["pref"]
+        hp = height[pref]
+        if is_bk:
+            votes = jnp.zeros((B,), i32).at[
+                jnp.clip(st["parent0"], 0)].add(
+                st["is_vote"].astype(i32))
+            score = hp.astype(ft) * (A + 1.0) + votes[pref].astype(ft)
+        else:
+            score = hp.astype(ft)
+        head = pref[jnp.argmax(score)]
+        head_height = height[head]
+        if is_bk:
+            progress = head_height * k
+            on_chain = head_height * (k + 1)
+            walk_len = A // max(k, 1) + 3
+        else:
+            progress = head_height
+            on_chain = head_height
+            walk_len = A + 2
+
+        def rstep(carry, _):
+            cur, rew = carry
+            ok = cur > 0
+            cc = jnp.clip(cur, 0)
+            if is_bk:
+                if scheme == "block":
+                    rew = rew.at[jnp.where(ok, st["miner"][cc], N)
+                                 ].add(jnp.float32(k))
+                else:
+                    qr = st["quorum"][cc]
+                    vm = st["miner"][jnp.clip(qr, 0)]
+                    rew = rew.at[jnp.where(ok & (qr >= 0), vm, N)
+                                 ].add(1.0)
+            else:
+                rew = rew.at[jnp.where(ok, st["miner"][cc], N)
+                             ].add(1.0)
+            return (jnp.where(ok, st["parent0"][cc], 0), rew), None
+
+        (_, rewards), _ = lax.scan(
+            rstep, (head, jnp.zeros((N,), jnp.float32)), None,
+            length=walk_len)
+
+        out = dict(
+            head=head, head_height=head_height,
+            progress=jnp.asarray(progress, ft),
+            on_chain=jnp.asarray(on_chain, ft),
+            sim_time=st["now"], n_blocks=st["nb"] - 1,
+            n_act=st["n_act"], node_act=st["node_act"],
+            reward=rewards, steps=st["steps"],
+            drop_q=st["drop_q"], drop_p=st["drop_p"],
+            drop_b=st["drop_b"],
+            exhausted=st["live"] & (st["steps"] >= S),
+        )
+        out["win_miss"] = (st["win_miss"] if is_bk
+                           else jnp.asarray(0, i32))
+        return out
+
+    def lane(key, activation_delay):
+        st = init(key, activation_delay)
+        st = jax.lax.while_loop(
+            lambda s: s["live"] & (s["steps"] < S),
+            partial(body, activation_delay=activation_delay), st)
+        return finalize(st)
+
+    return lane
+
+
+def _scan_lane_fn(cn: CompiledNet, activations: int, L: int):
+    """Fused nakamoto fast path for simple (non-flooding)
+    dissemination: arrival times are state-independent (each block is
+    sent exactly once per link at mint), so activation times, miners,
+    and the whole (A, N) arrival matrix are presampled as dense
+    vectorized draws, and the only sequential part — each miner's
+    preference at its activation instant — runs as a `lax.scan` over
+    activations.
+
+    The scan carry stays O(L) scalars-and-ring — no O(A) or O(L*N)
+    arrays — for two reasons: carried arrays with batched updates
+    defeat XLA's in-place aliasing under vmap (each lane would copy
+    every step), and an all-nodes (L, N) visibility fold per step is
+    pure memory bandwidth that scales linearly with lanes.  Only the
+    current miner's preference matters at each step, and that needs
+    one (L,) arrival column: blocks older than the lookback window are
+    guaranteed-arrived (else `win_miss`, asserted 0 by the parity
+    tests), so their per-node best collapses to a running
+    (hmax_old, first block id achieving it) scalar pair.  Ties among
+    old blocks resolve by mint order — exact for equal-constant-delay
+    grids (first minted arrives first everywhere), a measure-zero-ish
+    documented approximation for random link delays.
+
+    When every off-diagonal link is the same constant delay (the
+    symmetric-clique grids), the column is computed from t/m slices
+    with unbatched indices instead of gathers with batched indices —
+    the only op class whose cost scales per-lane under vmap on
+    XLA:CPU — which is what makes the batched sweep beat the serial
+    oracle loop on wall-clock.  Rewards come from a reverse scan over
+    mint order (parent ids strictly decrease along the chain), not a
+    sequential forward walk."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as _np
+    from jax import lax
+
+    N = int(cn.n)
+    A = int(activations)
+    L = min(int(L), A)          # window cannot exceed the ledger
+    ft = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+    i32 = jnp.int32
+
+    kindm = jnp.asarray(cn.kind, i32)
+    p0m = jnp.asarray(cn.p0, ft)
+    p1m = jnp.asarray(cn.p1, ft)
+    logw = jnp.log(jnp.asarray(cn.compute, jnp.float32))
+    arangeL = jnp.arange(L, dtype=i32)
+    arangeN = jnp.arange(N, dtype=i32)
+
+    # constant-equal-delay specialization: full off-diagonal
+    # connectivity, all links constant with one shared value
+    offdiag = ~_np.eye(N, dtype=bool)
+    uniform_const = (bool(_np.all((cn.kind >= 0) == offdiag))
+                     and bool(_np.all(cn.kind[offdiag] == 0))
+                     and _np.unique(cn.p0[offdiag]).size == 1)
+    D = float(cn.p0[0, 1]) if uniform_const else 0.0
+
+    def lane(key, activation_delay):
+        k_gap, k_mine, k_del = jax.random.split(key, 3)
+        gaps = jax.random.exponential(k_gap, (A + 1,), dtype=ft)
+        t = jnp.cumsum(gaps) * activation_delay  # (A+1,) mints + cutoff
+        m = jax.random.categorical(k_mine, logw, shape=(A,)).astype(i32)
+        if uniform_const:
+            # arrivals are t_i + D off the miner's node; no RNG needed
+            arr = t[:A, None] + jnp.where(
+                arangeN[None, :] == m[:, None], 0.0, D)
+        else:
+            # per-block link delays from the miner's row of the
+            # compiled planes; unlinked pairs never arrive (simple
+            # dissemination: one send per link at mint, no relay)
+            delays = sample_delay_matrix(
+                k_del, kindm[m], p0m[m], p1m[m], ft)      # (A, N)
+            linked = kindm[m] >= 0
+            tm = t[:A, None]
+            arr = jnp.where(linked, tm + delays, jnp.inf)
+            arr = jnp.where(arangeN[None, :] == m[:, None], tm, arr)
+        arr_flat = arr.reshape(A * N)
+        BIG = 2.0 * t[A] + 4.0   # height dominates the (h, -arr) key
+
+        def pref_key(h, a):
+            """Lexicographic (height, earliest-arrival) as one f64 key;
+            exact key ties fall back to the first window index = mint
+            order, matching oracle delivery order for simultaneous
+            arrivals."""
+            return h.astype(ft) * BIG - a
+
+        # ledger ids: genesis 0, activation i -> id i + 1
+        def step(carry, i):
+            ring_h, hmax_old, bidx_old, t_old, m_old = carry
+            t_i = t[i]
+            mi = m[i]
+            start = jnp.maximum(i - L, 0)
+            gidx = start + arangeL                   # activation index
+            h_w = ring_h[gidx % L]
+            if uniform_const:
+                t_w = lax.dynamic_slice(t, (start,), (L,))
+                m_w = lax.dynamic_slice(m, (start,), (L,))
+                col = t_w + jnp.where(m_w == mi, 0.0, D)
+                arr_old = t_old + jnp.where(m_old == mi, 0.0, D)
+            else:
+                col = arr_flat[gidx * N + mi]        # arrivals at miner
+                arr_old = jnp.where(
+                    bidx_old == 0, jnp.asarray(0.0, ft),
+                    arr_flat[jnp.maximum(bidx_old - 1, 0) * N + mi])
+            # the minting row itself has col == t_i (own arrival), and
+            # future rows in a clamped early window have col > t_i, so
+            # strict < is the whole visibility test
+            key_w = jnp.where(col < t_i, pref_key(h_w, col), -jnp.inf)
+            kw = jnp.max(key_w)
+            # first-max selection without batched-index gathers
+            atmax = key_w == kw
+            sel_g = jnp.min(jnp.where(atmax, gidx, A))
+            sel_h = jnp.sum(jnp.where(atmax & (gidx == sel_g), h_w, 0),
+                            dtype=i32)
+            use_old = pref_key(hmax_old, arr_old) >= kw
+            parent = jnp.where(use_old, bidx_old, sel_g + 1)
+            h_i = jnp.where(use_old, hmax_old, sel_h) + 1
+            # the block aging out of the window (same ring slot we
+            # overwrite) folds into the old-best scalars; the
+            # must-have-landed check happens vectorized after the scan
+            r = jnp.maximum(i - L, 0)
+            h_leave = ring_h[i % L]
+            upd_old = (i >= L) & (h_leave > hmax_old)
+            hmax_old = jnp.where(upd_old, h_leave, hmax_old)
+            bidx_old = jnp.where(upd_old, r + 1, bidx_old)
+            t_old = jnp.where(upd_old, t[r], t_old)
+            m_old = jnp.where(upd_old, m[r], m_old)
+            ring_h = ring_h.at[i % L].set(h_i)
+            return (ring_h, hmax_old, bidx_old, t_old, m_old), \
+                (h_i, parent)
+
+        carry0 = (jnp.zeros((L,), i32), jnp.asarray(0, i32),
+                  jnp.asarray(0, i32), jnp.asarray(0.0, ft),
+                  jnp.asarray(-1, i32))
+        (ring_h, hmax_old, bidx_old, _, _), (hs, ps) = lax.scan(
+            step, carry0, jnp.arange(A, dtype=i32), unroll=8)
+        heights = jnp.concatenate([jnp.zeros((1,), i32), hs])
+        # window-overflow detector, hoisted out of the loop: every
+        # block must land everywhere (finite links) before it ages out
+        # at its minting step + L
+        if A > L:
+            miss = jnp.sum(jnp.any(
+                jnp.isfinite(arr[:A - L])
+                & (arr[:A - L] > t[L:A, None]), axis=1)).astype(i32)
+        else:
+            miss = jnp.asarray(0, i32)
+
+        # drain + winner: the oracle delivers what precedes the first
+        # never-executed activation (t[A]); one full per-node fold at
+        # the cutoff (window blocks vs the old-best representative)
+        start = max(A - L, 0)
+        gidx = start + arangeL
+        arr_w = arr[start:start + L]                    # (L, N)
+        h_w = ring_h[gidx % L]
+        key_w = jnp.where(arr_w < t[A],
+                          pref_key(h_w[:, None], arr_w), -jnp.inf)
+        kw = jnp.max(key_w, axis=0)                     # (N,)
+        atmax = key_w == kw[None, :]
+        sel_g = jnp.min(jnp.where(atmax, gidx[:, None], A), axis=0)
+        sel_h = jnp.sum(jnp.where(atmax & (gidx[:, None] == sel_g),
+                                  h_w[:, None], 0), axis=0, dtype=i32)
+        arr_old = jnp.where(bidx_old == 0, jnp.asarray(0.0, ft),
+                            arr[jnp.maximum(bidx_old - 1, 0)])
+        use_old = pref_key(hmax_old, arr_old) >= kw
+        bh = jnp.where(use_old, hmax_old, sel_h)
+        bidx = jnp.where(use_old, bidx_old, sel_g + 1)
+
+        j_star = jnp.argmax(bh)                         # first-max
+        head = bidx[j_star]
+        head_height = jnp.max(bh)
+        # on-chain mask by reverse scan over mint order: parent ids
+        # strictly decrease along the chain, so walking ids A..1 with a
+        # single moving pointer marks exactly the head chain
+        ids = jnp.arange(1, A + 1, dtype=i32)
+
+        def walk(cur, x):
+            idx, par = x
+            hit = idx == cur
+            return jnp.where(hit, par, cur), hit
+
+        _, on_chain = lax.scan(walk, head, (ids, ps), reverse=True)
+        rewards = jnp.zeros((N + 1,), jnp.float32).at[
+            jnp.where(on_chain, m, N)].add(1.0)[:N]
+        node_act = jnp.zeros((N + 1,), i32).at[m].add(1)[:N]
+        finite_arr = jnp.where(jnp.isfinite(arr) & (arr < t[A]),
+                               arr, -jnp.inf)
+        sim_time = jnp.maximum(t[A - 1], jnp.max(finite_arr))
+
+        z = jnp.asarray(0, i32)
+        return dict(
+            head=head, head_height=head_height,
+            progress=head_height.astype(ft),
+            on_chain=head_height.astype(ft),
+            sim_time=sim_time, n_blocks=jnp.asarray(A, i32),
+            n_act=jnp.asarray(A, i32), node_act=node_act,
+            reward=rewards, steps=jnp.asarray(A, i32),
+            drop_q=z, drop_p=z, drop_b=z, win_miss=miss,
+            exhausted=jnp.asarray(False, bool),
+        )
+
+    return lane
+
+
+class Engine:
+    """One compiled netsim program: fixed topology, protocol, and
+    activation target; `run()` executes a batch of lanes (independent
+    seed/activation-delay pairs) as a single jitted, vmapped call.
+
+        eng = Engine(net, protocol="nakamoto", activations=10_000)
+        out = eng.run(seeds=[0, 1, 2], activation_delays=[60.0] * 3)
+
+    Returns numpy arrays keyed like the oracle metrics (progress,
+    on_chain, sim_time, n_blocks, head_height, reward, node_act, ...)
+    with a leading lane axis, plus capacity-overflow counters
+    (drop_q/drop_p/drop_b/win_miss) and the `exhausted` step-cap flag
+    — parity tests assert all of those are zero.
+    """
+
+    def __init__(self, net, *, protocol: str = "nakamoto", k: int = 1,
+                 scheme: str = "constant", activations: int,
+                 block_cap: int | None = None,
+                 queue_cap: int | None = None, pend_cap: int = 8,
+                 window: int | None = None,
+                 max_steps: int | None = None, x64: bool = True,
+                 mode: str = "auto", lookback: int = 32):
+        if protocol not in SUPPORTED_PROTOCOLS:
+            raise ValueError(
+                f"netsim supports protocols {SUPPORTED_PROTOCOLS}, "
+                f"not '{protocol}'")
+        scheme = scheme or "constant"
+        if protocol == "bk" and (k < 1 or scheme not in _SCHEMES):
+            raise ValueError(
+                f"bk needs k >= 1 and scheme in {_SCHEMES} "
+                f"(got k={k}, scheme='{scheme}')")
+        self.net = (net if isinstance(net, CompiledNet)
+                    else compile_network(net))
+        self.protocol = protocol
+        self.k = int(k)
+        self.scheme = scheme
+        self.activations = int(activations)
+        n, a = self.net.n, self.activations
+        if protocol == "bk":
+            # per chain height up to min(N, k) nodes hold own votes
+            # and may each propose (plus replacements) before the
+            # winner propagates — votes + that burst bounds the ledger
+            self.B = block_cap or (
+                a + min(n, self.k) * (a // max(self.k, 1) + 2) + 64)
+        else:
+            self.B = block_cap or a + 2
+        self.M = queue_cap or max(256, 16 * n)
+        self.F = int(pend_cap)
+        self.W = min(self.B, window or max(256, 32 * (self.k + n)))
+        self.S = max_steps or a * (n + 4) + 4096
+        self.x64 = bool(x64)
+        if mode not in ("auto", "event", "scan"):
+            raise ValueError(f"mode must be auto/event/scan, not '{mode}'")
+        scan_ok = protocol == "nakamoto" and not self.net.flooding
+        if mode == "scan" and not scan_ok:
+            raise ValueError(
+                "scan mode needs nakamoto + simple dissemination "
+                "(state-independent arrival times); use mode='event'")
+        self.mode = "scan" if (mode == "auto" and scan_ok) or \
+            mode == "scan" else "event"
+        self.lookback = int(lookback)
+        self._exe = {}          # lane count -> compiled executable
+
+    def _ctx(self):
+        import contextlib
+
+        from jax.experimental import enable_x64
+
+        return enable_x64() if self.x64 else contextlib.nullcontext()
+
+    def _compiled(self, keys, delays):
+        import jax
+
+        L = keys.shape[0]
+        exe = self._exe.get(L)
+        if exe is None:
+            if self.mode == "scan":
+                fn = _scan_lane_fn(self.net, self.activations,
+                                   self.lookback)
+            else:
+                fn = _lane_fn(self.net, self.protocol, self.k,
+                              self.scheme, self.activations, self.B,
+                              self.M, self.F, self.W, self.S)
+            tele = telemetry.current()
+            with telemetry.compile_watch(), \
+                    tele.span("netsim:compile", lanes=L):
+                exe = jax.jit(jax.vmap(fn)).lower(
+                    keys, delays).compile()
+            self._exe[L] = exe
+        return exe
+
+    def run(self, seeds, activation_delays) -> dict:
+        """Execute len(seeds) lanes (paired with activation_delays) as
+        one device program; returns numpy arrays with lane axis 0."""
+        import jax
+        import jax.numpy as jnp
+
+        seeds = list(seeds)
+        delays = list(activation_delays)
+        if len(seeds) != len(delays):
+            raise ValueError("seeds and activation_delays must pair up")
+        L = len(seeds)
+        tele = telemetry.current()
+        with self._ctx():
+            keys = jnp.stack(
+                [jax.random.PRNGKey(s) for s in seeds])
+            dl = jnp.asarray(delays,
+                             jnp.float64 if self.x64 else jnp.float32)
+            exe = self._compiled(keys, dl)
+            with tele.span("netsim:run", lanes=L,
+                           activations=L * self.activations) as sp:
+                out = sp.fence(exe(keys, dl))
+        out = {kk: np.asarray(v) for kk, v in out.items()}
+        tele.event("netsim", protocol=self.protocol, lanes=L,
+                   activations=int(np.sum(out["n_act"])),
+                   steps=int(np.max(out["steps"])),
+                   drops=int(out["drop_q"].sum() + out["drop_p"].sum()
+                             + out["drop_b"].sum()
+                             + out["win_miss"].sum()))
+        self._emit_device_metrics(out)
+        return out
+
+    def _emit_device_metrics(self, out):
+        """Optional in-graph-style cells (CPR_DEVICE_METRICS=1)."""
+        from cpr_tpu import device_metrics as dm
+
+        if not dm.enabled():
+            return
+        spec = (dm.MetricsSpec().counter("steps").counter("queue_drops")
+                .counter("pending_drops").counter("ledger_drops"))
+        acc = spec.init()
+        acc = spec.count(acc, "steps", out["steps"])
+        acc = spec.count(acc, "queue_drops", out["drop_q"])
+        acc = spec.count(acc, "pending_drops", out["drop_p"])
+        acc = spec.count(acc, "ledger_drops", out["drop_b"])
+        dm.emit("netsim", spec, acc, protocol=self.protocol)
+
+
+def grid(seeds, activation_delays):
+    """Cartesian (delay-major) lane grid: returns (seed_list,
+    delay_list) ready for `Engine.run`."""
+    ss, dd = [], []
+    for d in activation_delays:
+        for s in seeds:
+            ss.append(int(s))
+            dd.append(float(d))
+    return ss, dd

@@ -54,7 +54,7 @@ from contextlib import contextmanager
 from datetime import datetime, timezone
 from time import perf_counter as now  # noqa: F401 — re-exported
 
-SCHEMA_VERSION = 3
+SCHEMA_VERSION = 4
 TELEMETRY_ENV_VAR = "CPR_TELEMETRY"
 PROFILE_ENV_VAR = "CPR_PROFILE_DIR"
 CHECKIFY_ENV_VAR = "CPR_CHECKIFY"
@@ -82,6 +82,10 @@ EVENT_FIELDS = {
     "retry": ("attempt", "delay_s", "error"),
     "preempted": ("update",),
     "fault_injected": ("spec", "site"),
+    # v4: one per netsim Engine.run — the vmap-batched network
+    # simulator (cpr_tpu/netsim); `drops` sums every capacity-overflow
+    # counter, so a healthy run reports drops=0
+    "netsim": ("protocol", "lanes", "activations", "steps", "drops"),
 }
 
 

@@ -1,0 +1,40 @@
+"""Honest-network sweep on the JAX netsim engine -> TSV.
+
+Same grid semantics as honest_net_sweep.py, but every protocol's
+activation-delay column runs as vmapped lanes of ONE device program
+(cpr_tpu/netsim).  `make netsim-smoke` runs this tiny with telemetry on
+and schema-validates the artifact (netsim:run spans + the typed
+`netsim` point event).
+
+Usage: python examples/netsim_sweep.py [out.tsv]
+"""
+
+import _bootstrap  # noqa: F401  (repo-root path + backend pick)
+
+import sys
+
+from cpr_tpu.experiments import honest_net_rows, write_tsv
+
+# nakamoto rides the fused scan path, bk the general event engine —
+# the smoke covers both execution modes
+PROTOCOLS = (
+    ("nakamoto", {}),
+    ("bk", dict(k=8, scheme="constant")),
+)
+
+
+def main():
+    small = "--smoke" in sys.argv[1:]
+    args = [a for a in sys.argv[1:] if a != "--smoke"]
+    rows = honest_net_rows(
+        protocols=PROTOCOLS,
+        activation_delays=(30.0, 60.0, 120.0),
+        n_activations=500 if small else 10_000,
+        engine="jax")
+    out = args[0] if args else None
+    text = write_tsv(rows, out)
+    print(text if out is None else f"wrote {len(rows)} rows to {out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -47,6 +47,47 @@ def test_distribution_sampling_moments():
     assert abs(float(js.mean()) - 2.5) < 0.15
 
 
+def test_distribution_faces_agree_on_support_and_mean():
+    """Property over all five kinds: the host face `sample(rng)` and
+    the jitted face `sample_jax(key)` draw from the same distribution —
+    same support bounds (the shared GEOM_TAIL_CLAMP fixes the geometric
+    ceiling, which used to differ between faces) and the declared mean
+    `ev` within sampling tolerance."""
+    cases = (dist.constant(1.5), dist.uniform(1.0, 3.0),
+             dist.exponential(2.0), dist.geometric(0.3),
+             dist.discrete([1.0, 2.0, 3.0]))
+    n = 4000
+    rng = random.Random(1)
+    keys = jax.random.split(jax.random.PRNGKey(1), n)
+    for d in cases:
+        hs = np.array([d.sample(rng) for _ in range(n)])
+        js = np.asarray(jax.vmap(d.sample_jax)(keys), dtype=float)
+        for xs in (hs, js):
+            if d.kind == "constant":
+                assert np.all(xs == 1.5)
+            elif d.kind == "uniform":
+                assert xs.min() >= 1.0 and xs.max() <= 3.0
+            elif d.kind == "exponential":
+                assert xs.min() >= 0.0
+            elif d.kind == "geometric":
+                # integer trial counts, >= 1, capped by the tail clamp
+                cap = np.ceil(np.log(dist.GEOM_TAIL_CLAMP)
+                              / np.log(1.0 - d.params[0]))
+                assert np.all(xs == np.round(xs))
+                assert xs.min() >= 1.0 and xs.max() <= cap
+            else:  # discrete: indices into the weight vector
+                assert set(np.unique(xs)) <= {0.0, 1.0, 2.0}
+            # both faces sit on the declared mean...
+            tol = 0.15 * max(d.ev, 1.0)
+            assert abs(xs.mean() - d.ev) < tol, (d.kind, xs.mean())
+        # ...and therefore on each other
+        assert abs(hs.mean() - js.mean()) < 0.2 * max(d.ev, 1.0), d.kind
+    # degenerate geometric: p >= 1 collapses to exactly 1 on both faces
+    g1 = dist.geometric(1.0)
+    assert g1.sample(rng) == 1.0
+    assert float(g1.sample_jax(keys[0])) == 1.0
+
+
 def test_network_graphml_roundtrip():
     net = netlib.selfish_mining(alpha=0.3, gamma=0.5, defenders=3,
                                 activation_delay=30.0,
@@ -59,6 +100,39 @@ def test_network_graphml_roundtrip():
         assert a.compute == pytest.approx(b.compute)
         assert [(l.dest, l.delay) for l in a.links] == \
             [(l.dest, l.delay) for l in b.links]
+
+
+def _graphml_with_delay(delay_str):
+    net = netlib.symmetric_clique(3, activation_delay=20.0,
+                                  propagation_delay=1.0)
+    return netlib.to_graphml(net).replace("constant 1", delay_str)
+
+
+def test_graphml_delay_kind_error_paths():
+    """Unsupported delay kinds fail with a clear message at the right
+    layer: unknown kinds at parse (of_graphml -> of_string), oracle-
+    unsupported kinds at simulate, netsim-unsupported at compile."""
+    with pytest.raises(ValueError, match="unknown distribution 'warp'"):
+        netlib.of_graphml(_graphml_with_delay("warp 1"))
+    with pytest.raises(ValueError, match="takes 1 parameter"):
+        netlib.of_graphml(_graphml_with_delay("exponential 1 2"))
+    # discrete parses, but neither engine runs it as a link delay
+    net = netlib.of_graphml(_graphml_with_delay("discrete 1 2"))
+    with pytest.raises(ValueError,
+                       match="oracle supports constant/uniform/"
+                             "exponential link delays, not 'discrete'"):
+        netlib.simulate(net, activations=10)
+    from cpr_tpu import netsim
+    with pytest.raises(ValueError,
+                       match="netsim supports constant/uniform/"
+                             "exponential/geometric link delays, "
+                             "not 'discrete'"):
+        netsim.compile_network(net)
+    # geometric: netsim-only — the oracle rejects it, netsim compiles
+    geo = netlib.of_graphml(_graphml_with_delay("geometric 0.5"))
+    with pytest.raises(ValueError, match="not 'geometric'"):
+        netlib.simulate(geo, activations=10)
+    assert netsim.compile_network(geo).n == 3
 
 
 def test_custom_topology_simulation():

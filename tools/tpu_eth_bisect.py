@@ -3,18 +3,31 @@
 The round-3 chip session showed `EthereumSSZ.episode_stats` faulting the
 TPU device at EVERY batch size (65536/16384/4096 envs) while the bk and
 tailstorm DAG-tensor envs ran fine — so the fault is a construct the
-ethereum env uses and they don't, not memory pressure.  Candidates walk
-up the ethereum step: reset, chain_window (the unrolled uncle-window
-ancestor walk), uncle selection, a single step, then scans of growing
-size, with a bk scan as the known-good control.
+ethereum env uses and they don't, not memory pressure.  Three stages
+(historically three scripts; `--stage` selects one, the findings are in
+docs/TPU_SESSION_r03.md):
+
+1. construct walk-up: reset, chain_window (the unrolled uncle-window
+   ancestor walk), uncle selection, a single step, then scans of growing
+   size, with a bk scan as the known-good control.  Finding: every
+   construct passes at 64 envs / capacity 72; the crash needs the full
+   bench shape.
+2. shape grid + construct stubs: separates env count, DAG capacity,
+   scan length, policy; stubs chain_window / select_uncles at the
+   crashing shape.  Finding: the fault needs BOTH axes large (4096 x
+   capacity 72 passes, 256 x 264 passes, 1024 x 264 crashes).
+3. one-at-a-time toggles at the minimal crasher (1024 envs x hint 256):
+   scan length, policy, and each ethereum-specific kernel.  Control
+   (the unmodified crasher) runs LAST.
 
 Same harness discipline as tools/tpu_vi_bisect.py: each candidate runs
 in a watchdog-bounded subprocess; stop at the first CRASH/HANG so a
 wedged chip isn't hammered.
 
-Usage: python tools/tpu_eth_bisect.py [max_candidates]
+Usage: python tools/tpu_eth_bisect.py [--stage {1,2,3}] [max_candidates]
 """
 
+import argparse
 import sys
 
 # run as a script from anywhere: the tools dir is sys.path[0] only for
@@ -30,7 +43,37 @@ params = make_params(alpha=0.35, gamma=0.5, max_steps=56)
 key = jax.random.PRNGKey(0)
 """
 
-CANDIDATES = [
+
+def scan(n_envs, hint, n_steps, policy="fn19", stub=""):
+    """One vmapped episode_stats scan at an arbitrary (envs, capacity,
+    steps, policy) point, optionally with a construct stubbed out."""
+    return f"""
+from cpr_tpu.envs.ethereum import EthereumSSZ
+from cpr_tpu.params import make_params
+env = EthereumSSZ("byzantium", max_steps_hint={hint})
+params = make_params(alpha=0.35, gamma=0.5, max_steps={hint} - 8)
+{stub}
+pol = env.policies["{policy}"]
+keys = jax.random.split(jax.random.PRNGKey(0), {n_envs})
+f = jax.jit(jax.vmap(lambda k: env.episode_stats(k, params, pol, {n_steps})))
+stats = jax.block_until_ready(f(keys))
+print(float(stats["episode_progress"].mean()))"""
+
+
+STUB_WINDOW = """
+_B = env.capacity
+def _stub_window(dag, head):
+    z = jnp.zeros((_B,), jnp.bool_)
+    return z, z.at[jnp.maximum(head, 0)].set(head >= 0)
+env.chain_window = _stub_window"""
+
+STUB_SELECT = """
+def _stub_select(dag, cand_mask, own_mask):
+    idx = jnp.zeros((env.max_uncles,), jnp.int32)
+    return idx, jnp.zeros((env.max_uncles,), jnp.bool_)
+env.select_uncles = _stub_select"""
+
+STAGE1 = [
     ("baseline_sum", "print(int(jnp.arange(8).sum()))"),
     ("eth_reset", ENV + """
 state, obs = jax.jit(env.reset)(key, params)
@@ -67,28 +110,11 @@ print(float(jnp.asarray(r)))"""),
 pol = env.policies["fn19"]
 stats = env.episode_stats(key, params, pol, 64)
 print(float(stats["episode_progress"]))"""),
-    ("eth_scan_64env", ENV + """
-pol = env.policies["fn19"]
-keys = jax.random.split(key, 64)
-f = jax.jit(jax.vmap(lambda k: env.episode_stats(k, params, pol, 64)))
-stats = jax.block_until_ready(f(keys))
-print(float(stats["episode_progress"].mean()))"""),
-    ("eth_scan_honest", ENV + """
-# same scan, honest policy: separates "fn19 policy path" from the scan
-pol = env.policies["honest"]
-keys = jax.random.split(key, 64)
-f = jax.jit(jax.vmap(lambda k: env.episode_stats(k, params, pol, 64)))
-stats = jax.block_until_ready(f(keys))
-print(float(stats["episode_progress"].mean()))"""),
-    ("eth_scan_4096_full", ENV + """
-# the failing bench shape (smallest rung): 4096 envs, 256-step hint
-env = EthereumSSZ("byzantium", max_steps_hint=256)
-params = make_params(alpha=0.35, gamma=0.5, max_steps=248)
-pol = env.policies["fn19"]
-keys = jax.random.split(key, 4096)
-f = jax.jit(jax.vmap(lambda k: env.episode_stats(k, params, pol, 256)))
-stats = jax.block_until_ready(f(keys))
-print(float(stats["episode_progress"].mean()))"""),
+    ("eth_scan_64env", scan(64, 64, 64)),
+    # same scan, honest policy: separates "fn19 policy path" from the scan
+    ("eth_scan_honest", scan(64, 64, 64, policy="honest")),
+    # the failing bench shape (smallest rung): 4096 envs, 256-step hint
+    ("eth_scan_4096_full", scan(4096, 256, 256)),
     ("bk_scan_64env_control", """
 from cpr_tpu.envs.bk import BkSSZ
 from cpr_tpu.params import make_params
@@ -101,6 +127,44 @@ stats = jax.block_until_ready(f(keys))
 print(float(stats["episode_progress"].mean()))"""),
 ]
 
+STAGE2 = [
+    # axis: env count at small capacity
+    ("envs4096_hint64", scan(4096, 64, 64)),
+    # axis: capacity at small env count
+    ("envs256_hint256", scan(256, 256, 256)),
+    # axis: middle ground
+    ("envs1024_hint256", scan(1024, 256, 256)),
+    ("envs4096_hint128", scan(4096, 128, 128)),
+    # the crashing shape, honest policy (is it the fn19 path?)
+    ("crash_shape_honest", scan(4096, 256, 256, policy="honest")),
+    # the crashing shape with ethereum-specific kernels stubbed
+    ("crash_shape_stub_window", scan(4096, 256, 256, stub=STUB_WINDOW)),
+    ("crash_shape_stub_select", scan(4096, 256, 256, stub=STUB_SELECT)),
+    # control: the known-crashing shape, unmodified (run LAST)
+    ("crash_shape_control", scan(4096, 256, 256)),
+]
+
+STAGE3 = [
+    # axis: scan length (is the 256-step scan needed, or just the shape?)
+    ("n1024_h256_scan64", scan(1024, 256, 64)),
+    # axis: policy
+    ("n1024_h256_honest", scan(1024, 256, 256, policy="honest")),
+    # axis: ethereum-specific kernels
+    ("n1024_h256_stub_window", scan(1024, 256, 256, stub=STUB_WINDOW)),
+    ("n1024_h256_stub_select", scan(1024, 256, 256, stub=STUB_SELECT)),
+    ("n1024_h256_stub_both", scan(1024, 256, 256,
+                                  stub=STUB_WINDOW + STUB_SELECT)),
+    # control: the known crasher, unmodified (LAST)
+    ("n1024_h256_control", scan(1024, 256, 256)),
+]
+
+STAGES = {1: STAGE1, 2: STAGE2, 3: STAGE3}
+
 if __name__ == "__main__":
-    limit = int(sys.argv[1]) if len(sys.argv) > 1 else None
-    run_candidates(CANDIDATES, limit)
+    ap = argparse.ArgumentParser(
+        description="staged ethereum-env TPU fault bisection")
+    ap.add_argument("--stage", type=int, choices=sorted(STAGES),
+                    default=1, help="bisection stage (see module doc)")
+    ap.add_argument("max_candidates", type=int, nargs="?", default=None)
+    args = ap.parse_args()
+    run_candidates(STAGES[args.stage], args.max_candidates)
