@@ -19,7 +19,7 @@ import jax
 import numpy as np
 
 import cpr_tpu
-from cpr_tpu import telemetry
+from cpr_tpu import resilience, telemetry
 from cpr_tpu.envs.registry import get_sized
 from cpr_tpu.params import make_params
 
@@ -42,8 +42,9 @@ def _cached(key: dict, compute):
         with open(path) as f:
             return json.load(f)["value"]
     value = compute()
-    with open(path, "w") as f:
-        json.dump({"key": key, "value": value}, f)
+    # atomic: a Ctrl-C mid-dump must not leave a torn cache entry that
+    # poisons every later read of this grid point
+    resilience.atomic_write_json(path, {"key": key, "value": value})
     return value
 
 

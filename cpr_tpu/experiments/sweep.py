@@ -11,6 +11,7 @@ from __future__ import annotations
 import io
 from typing import Callable, Iterable
 
+from cpr_tpu.resilience import atomic_write_text
 from cpr_tpu.telemetry import now
 
 
@@ -39,8 +40,7 @@ def write_tsv(rows: Iterable[dict], path: str | None = None) -> str:
         buf.write("\t".join(_fmt(r.get(c)) for c in cols) + "\n")
     text = buf.getvalue()
     if path is not None:
-        with open(path, "w") as f:
-            f.write(text)
+        atomic_write_text(path, text)
     return text
 
 

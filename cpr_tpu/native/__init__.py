@@ -49,8 +49,12 @@ def build_lib(src: str, so: str, opt: str = "-O2") -> None:
     if r.returncode != 0:
         raise RuntimeError(
             f"native build failed ({' '.join(cmd)}):\n{r.stderr}")
-    with open(stamp, "w") as f:
-        f.write(stamp_line)
+    # atomic: a torn stamp would silently serve a stale .so forever
+    # (lazy import; this module imports nothing from cpr_tpu at the top
+    # so the C++ oracle stays loadable mid-package-init)
+    from cpr_tpu.resilience import atomic_write_text
+
+    atomic_write_text(stamp, stamp_line)
 
 
 _LOADED: dict = {}

@@ -219,6 +219,8 @@ def write_topology_batch(outdir: str, *, count: int = 10, n: int = 13,
     simulate)."""
     import os
 
+    from cpr_tpu.resilience import atomic_write_text
+
     os.makedirs(outdir, exist_ok=True)
     paths = []
     tag = {"constant": "cns", "uniform": "uni", "exponential": "exp"}
@@ -229,8 +231,7 @@ def write_topology_batch(outdir: str, *, count: int = 10, n: int = 13,
                 seed=seed + i * 31 + di * 1009)
             path = os.path.join(
                 outdir, f"{i + 1:03d}-{tag[distribution]}-graphml.xml")
-            with open(path, "w") as f:
-                f.write(to_graphml(net))
+            atomic_write_text(path, to_graphml(net))
             paths.append(path)
     return paths
 

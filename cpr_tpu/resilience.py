@@ -182,6 +182,10 @@ def atomic_write_json(path: str, obj):
                               + "\n").encode())
 
 
+def atomic_write_text(path: str, text: str, encoding: str = "utf-8"):
+    atomic_write_bytes(path, text.encode(encoding))
+
+
 # -- deterministic fault injection -------------------------------------------
 
 _ACTIONS = ("kill", "io_error", "fault", "nan", "preempt")

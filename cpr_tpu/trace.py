@@ -183,7 +183,10 @@ def raise_malformed(view: DagView, message: str):
     set, then raise (dagtools.ml Exn.raise, :227-293)."""
     path = os.environ.get(MALFORMED_ENV_VAR)
     if path:
-        with open(path, "w") as f:
-            f.write(to_dot(view))
+        # lazy import: the forensics dump is the only resilience use in
+        # this module, and trace stays import-light for the ctypes views
+        from cpr_tpu.resilience import atomic_write_text
+
+        atomic_write_text(path, to_dot(view))
         message = f"{message} (DAG dumped to {path})"
     raise MalformedDag(message)

@@ -318,8 +318,8 @@ def train_from_config(cfg: TrainConfig, *, out_dir: str | None = None,
         # self-describing run dir: the manifest rides both as its own
         # file and in the metrics header, so a copied-out metrics.jsonl
         # still says what backend/config produced it
-        with open(os.path.join(out_dir, "manifest.json"), "w") as f:
-            json.dump(manifest, f, indent=2)
+        resilience.atomic_write_json(
+            os.path.join(out_dir, "manifest.json"), manifest)
         metrics_path = os.path.join(out_dir, "metrics.jsonl")
         if resume:
             # a killed run may have logged updates past the snapshot;

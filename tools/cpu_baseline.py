@@ -94,8 +94,11 @@ def main():
         print(json.dumps({"config": name, **row}), flush=True)
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "..", "BASELINE_CPU.json")
-    with open(os.path.abspath(path), "w") as f:
-        json.dump(out, f, indent=2)
+    # lazy import: measure() already pulls cpr_tpu for the oracle, so
+    # the atomic helper costs nothing extra by the time we bank results
+    from cpr_tpu.resilience import atomic_write_json
+
+    atomic_write_json(os.path.abspath(path), out)
     print(f"wrote {os.path.abspath(path)}", file=sys.stderr)
 
 

@@ -16,6 +16,7 @@ import json
 import os
 import subprocess
 import sys
+import tempfile
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -78,8 +79,15 @@ def main():
                   f"({time.time() - t0:.0f}s)", flush=True)
             rows[:] = [r for r in rows if r.get("n_envs") != n_envs]
             rows.append(row)
-            with open(path, "w") as f:
+            # inline tmp+replace (the resilience.atomic_write pattern):
+            # this bank is re-read on resume, so a crash mid-dump would
+            # poison the whole curve — but the parent must stay jax-free
+            # (each child process owns the TPU), so no cpr_tpu import
+            fd, tmp = tempfile.mkstemp(dir=REPO,
+                                       prefix=".bench_scaling.")
+            with os.fdopen(fd, "w") as f:
                 json.dump(curves, f, indent=2)
+            os.replace(tmp, path)
             if row.get("error") == "hung":
                 print("wedged device? stopping this config", flush=True)
                 break
