@@ -57,7 +57,7 @@ def episode_rows(protocol_key: str, policies=None, *,
     grid = [(a, g) for a in alphas for g in gammas]
     params = stack_params([dict(alpha=a, gamma=g, max_steps=episode_len)
                            for a, g in grid])
-    keys = jax.random.split(jax.random.PRNGKey(seed), (len(grid), reps))
+    base_key = jax.random.PRNGKey(seed)
     n_steps = episode_len + 8
 
     if kind == "trained":
@@ -81,7 +81,12 @@ def episode_rows(protocol_key: str, policies=None, *,
                          "(expected 'hard-coded' or 'trained')")
 
     rows = []
-    for pol_name, pol_fn in policy_map.items():
+    for pi, (pol_name, pol_fn) in enumerate(policy_map.items()):
+        # fold_in per policy: every policy used to consume the same key
+        # grid, so their episodes replayed identical activation streams
+        # and the cross-policy comparison shared all its noise
+        keys = jax.random.split(jax.random.fold_in(base_key, pi),
+                                (len(grid), reps))
         out = _collect(env, pol_fn, keys, params, n_steps)
         done = np.asarray(out["done"], bool)  # [grid, reps, steps]
         for gi, (a, g) in enumerate(grid):

@@ -39,10 +39,14 @@ def withholding_rows(protocol_key: str, policies=None, *,
         policies = list(env.policies)
     grid = [(a, g) for a in alphas for g in gammas]
     params = _stack_params(grid, episode_len)
-    keys = jax.random.split(
-        jax.random.PRNGKey(seed), (len(grid), reps))
+    base_key = jax.random.PRNGKey(seed)
 
-    def one(pol):
+    def one(pol, pi):
+        # fold_in per policy: the closure used to capture one shared
+        # key grid, so every policy replayed the identical activation
+        # streams (the key-reuse class jaxlint flags lexically)
+        keys = jax.random.split(jax.random.fold_in(base_key, pi),
+                                (len(grid), reps))
         fn = jax.jit(jax.vmap(jax.vmap(
             lambda k, p: env.episode_stats(
                 k, p, env.policies[pol], episode_len + 8),
@@ -75,8 +79,8 @@ def withholding_rows(protocol_key: str, policies=None, *,
         return out
 
     rows = []
-    for pol in policies:
+    for pi, pol in enumerate(policies):
         rows.extend(run_task(
-            lambda p=pol: one(p),
+            lambda p=pol, i=pi: one(p, i),
             {"protocol": protocol_key, "attack": f"{protocol_key}-{pol}"}))
     return rows
