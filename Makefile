@@ -4,7 +4,13 @@
 
 CPU_MESH = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test test-slow bench telemetry-smoke netsim-smoke resilience-smoke dryrun sweeps ghostdag train-dummy native asan
+.PHONY: lint test test-slow bench telemetry-smoke netsim-smoke resilience-smoke dryrun sweeps ghostdag train-dummy native asan
+
+lint:  ## jaxlint over cpr_tpu/ + tools/ (pure AST, no JAX import,
+	## ~1s); banks the JSON report under runs/ like the smoke flows
+	## bank their artifacts.  Rule catalog: docs/ANALYSIS.md
+	mkdir -p runs
+	python tools/jaxlint.py cpr_tpu tools --output runs/jaxlint.json
 
 test:  ## fast tier (< ~8 min on the 1-core host)
 	python -m pytest tests/ -q

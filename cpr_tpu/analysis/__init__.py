@@ -1,0 +1,39 @@
+"""jaxlint — JAX-aware static analysis over the cpr_tpu codebase.
+
+PRs 1-5 accumulated correctness/perf invariants that lived only in
+prose and one-off tests: spans fence before timestamping, artifacts go
+through `resilience.atomic_write_*`, telemetry point events match the
+typed `EVENT_FIELDS` schema, no wall-clock interval timing in the
+package, and jitted hot loops must not silently retrace or sync.  This
+package turns those invariants into an always-on CI gate: a pure
+AST/tokenize rule engine (no JAX import — linting the repo takes ~1s
+on the 1-core host) with a registry of rules, inline
+`# jaxlint: disable=<rule>` escape hatches, and a JSON baseline for
+grandfathered findings.
+
+Entry points:
+
+* `tools/jaxlint.py` — the CLI (`--format json`, per-rule disables,
+  `--baseline`); `make lint` runs it over `cpr_tpu/` + `tools/` and
+  banks the JSON artifact under `runs/`.
+* `run_lint(paths)` — the in-process API the tier-1 test suite calls
+  (tests/test_jaxlint.py), so every future PR inherits the gate.
+
+Rule catalog and per-rule rationale: docs/ANALYSIS.md.
+
+This module and its submodules import only the standard library:
+keeping the linter importable without initializing a JAX backend is a
+hard requirement (the CLI loads this package without executing
+`cpr_tpu/__init__.py`, which pulls jax via params).
+"""
+
+from cpr_tpu.analysis.core import (  # noqa: F401
+    Finding,
+    LintContext,
+    Rule,
+    SourceFile,
+    iter_source_files,
+    load_baseline,
+    run_lint,
+)
+from cpr_tpu.analysis.rules import RULES, rule_ids  # noqa: F401

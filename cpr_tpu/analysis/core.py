@@ -1,0 +1,242 @@
+"""Rule engine: source model, disable comments, registry, baseline.
+
+Stdlib-only (ast + tokenize + io) — see the package docstring for why
+the no-JAX-at-import property is load-bearing.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import json
+import os
+import tokenize
+from dataclasses import dataclass, field
+
+DISABLE_MARKER = "jaxlint:"
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation at a source location.  `path` is
+    repo-relative POSIX so findings are stable across checkouts (the
+    JSON format and the baseline both key on it)."""
+
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+
+    def key(self) -> tuple:
+        return (self.rule, self.path, self.line)
+
+    def as_dict(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "col": self.col, "message": self.message}
+
+
+class Rule:
+    """Base class: subclasses set `id` (stable kebab-case, the CLI and
+    disable comments use it), `summary`, and `rationale` (which PR's
+    invariant the rule encodes), and implement `check`."""
+
+    id: str = ""
+    summary: str = ""
+    rationale: str = ""
+
+    def check(self, src: "SourceFile", ctx: "LintContext"):
+        raise NotImplementedError
+
+    def finding(self, src: "SourceFile", node, message: str) -> Finding:
+        return Finding(self.id, src.rel, getattr(node, "lineno", 0),
+                       getattr(node, "col_offset", 0), message)
+
+
+def _parse_disables(text: str) -> tuple[dict, set]:
+    """-> (line -> set of rule ids, file-wide set).  Grammar:
+
+        # jaxlint: disable=rule[,rule]            (this line only)
+        # jaxlint: disable-next-line=rule[,rule]  (the following line)
+        # jaxlint: disable-file=rule[,rule]       (whole file)
+
+    An inline disable is the sanctioned escape hatch for a deliberate
+    violation — pair it with a reason in the surrounding comment.
+    """
+    per_line: dict[int, set] = {}
+    per_file: set = set()
+    try:
+        toks = tokenize.generate_tokens(io.StringIO(text).readline)
+        for tok in toks:
+            if tok.type != tokenize.COMMENT:
+                continue
+            body = tok.string.lstrip("#").strip()
+            if not body.startswith(DISABLE_MARKER):
+                continue
+            directive = body[len(DISABLE_MARKER):].strip()
+            # allow trailing prose after the rule list ("— reason")
+            directive = directive.split()[0] if directive else ""
+            for prefix, line in (("disable-file=", None),
+                                 ("disable-next-line=",
+                                  tok.start[0] + 1),
+                                 ("disable=", tok.start[0])):
+                if directive.startswith(prefix):
+                    rules = {r.strip() for r in
+                             directive[len(prefix):].split(",") if r.strip()}
+                    if line is None:
+                        per_file.update(rules)
+                    else:
+                        per_line.setdefault(line, set()).update(rules)
+                    break
+    except tokenize.TokenError:
+        pass  # a syntax error surfaces via ast.parse below instead
+    return per_line, per_file
+
+
+@dataclass
+class SourceFile:
+    """Parsed view of one file: AST, raw text, disable directives, and
+    a child->parent node map (rules need lexical ancestry for loop /
+    decorator / immediate-call context)."""
+
+    path: str  # absolute
+    rel: str   # repo-relative POSIX
+    text: str
+    tree: ast.AST
+    disabled_lines: dict = field(default_factory=dict)
+    disabled_file: set = field(default_factory=set)
+    parents: dict = field(default_factory=dict)
+
+    @classmethod
+    def load(cls, path: str, root: str) -> "SourceFile | None":
+        with open(path, "rb") as f:
+            raw = f.read()
+        try:
+            text = raw.decode("utf-8")
+            tree = ast.parse(text, filename=path)
+        except (SyntaxError, UnicodeDecodeError):
+            return None  # not lintable; other gates own syntax errors
+        per_line, per_file = _parse_disables(text)
+        rel = os.path.relpath(path, root).replace(os.sep, "/")
+        src = cls(path=path, rel=rel, text=text, tree=tree,
+                  disabled_lines=per_line, disabled_file=per_file)
+        for parent in ast.walk(tree):
+            for child in ast.iter_child_nodes(parent):
+                src.parents[child] = parent
+        return src
+
+    def ancestors(self, node):
+        while node in self.parents:
+            node = self.parents[node]
+            yield node
+
+    def suppressed(self, finding: Finding) -> bool:
+        if finding.rule in self.disabled_file or "all" in self.disabled_file:
+            return True
+        rules = self.disabled_lines.get(finding.line, ())
+        return finding.rule in rules or "all" in rules
+
+
+@dataclass
+class LintContext:
+    """Cross-file facts rules resolve lazily: the repo root and the
+    typed EVENT_FIELDS schema read from cpr_tpu/telemetry.py — by AST,
+    not import, so the schema check needs no package (or jax) import."""
+
+    root: str
+    _event_fields: dict | None = None
+
+    def event_fields(self) -> dict:
+        if self._event_fields is None:
+            self._event_fields = _read_event_fields(
+                os.path.join(self.root, "cpr_tpu", "telemetry.py"))
+        return self._event_fields
+
+
+def _read_event_fields(telemetry_path: str) -> dict:
+    """EVENT_FIELDS as a {name: (field, ...)} dict, or {} when the
+    module or the assignment is missing (rule degrades to a no-op
+    rather than inventing a schema)."""
+    try:
+        with open(telemetry_path, "rb") as f:
+            tree = ast.parse(f.read(), filename=telemetry_path)
+    except (OSError, SyntaxError):
+        return {}
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == "EVENT_FIELDS"):
+            try:
+                value = ast.literal_eval(node.value)
+            except ValueError:
+                return {}
+            if isinstance(value, dict):
+                return {str(k): tuple(v) for k, v in value.items()}
+    return {}
+
+
+def iter_source_files(paths, root: str):
+    """Yield absolute paths of .py files under `paths` (files or
+    directories, relative to `root`), skipping caches, in sorted order
+    for deterministic output."""
+    out = []
+    for p in paths:
+        p = p if os.path.isabs(p) else os.path.join(root, p)
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                out.append(p)
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d != "__pycache__"
+                                 and not d.startswith("."))
+            out.extend(os.path.join(dirpath, fn)
+                       for fn in sorted(filenames) if fn.endswith(".py"))
+    return sorted(set(out))
+
+
+def load_baseline(path: str) -> set:
+    """Grandfathered finding keys {(rule, path, line), ...} from a JSON
+    baseline file (format: {"findings": [{rule, path, line}, ...]}) —
+    the gate starts at zero NEW findings even on a tree with known
+    debt.  Regenerate wholesale with `--write-baseline` (line numbers
+    drift; hand-editing is not the workflow)."""
+    with open(path) as f:
+        data = json.load(f)
+    return {(f_["rule"], f_["path"], int(f_["line"]))
+            for f_ in data.get("findings", [])}
+
+
+def run_lint(paths, root: str | None = None, disable=(),
+             baseline: set | None = None) -> list[Finding]:
+    """Lint `paths` with every registered rule except `disable`d ids;
+    findings suppressed inline or present in `baseline` are dropped.
+    Returns findings sorted by (path, line, rule)."""
+    from cpr_tpu.analysis.rules import RULES
+
+    root = os.path.abspath(root or _default_root())
+    disable = set(disable)
+    unknown = disable - {r.id for r in RULES}
+    if unknown:
+        raise ValueError(f"unknown rule id(s): {sorted(unknown)}")
+    ctx = LintContext(root=root)
+    rules = [r for r in RULES if r.id not in disable]
+    findings: list[Finding] = []
+    for path in iter_source_files(paths, root):
+        src = SourceFile.load(path, root)
+        if src is None:
+            continue
+        for rule in rules:
+            for f in rule.check(src, ctx):
+                if src.suppressed(f):
+                    continue
+                if baseline and f.key() in baseline:
+                    continue
+                findings.append(f)
+    return sorted(findings, key=lambda f: (f.path, f.line, f.rule))
+
+
+def _default_root() -> str:
+    # cpr_tpu/analysis/core.py -> repo root two levels up from cpr_tpu/
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))

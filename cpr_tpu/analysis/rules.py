@@ -1,0 +1,582 @@
+"""The jaxlint rule registry.
+
+Each rule encodes an invariant an earlier PR established by hand (the
+motivating PR is named in `rationale`; full catalog with examples in
+docs/ANALYSIS.md).  All rules are pure AST/tokenize — no rule may
+import jax or cpr_tpu runtime modules (cross-module facts like the
+telemetry EVENT_FIELDS schema are read by parsing the source, see
+LintContext.event_fields).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from cpr_tpu.analysis.core import LintContext, Rule, SourceFile
+
+# rule 5's "known hot paths": files whose jitted carry loops the bench
+# trail showed dominate device memory/throughput (BENCH_r03/r04; the
+# 65536-env ethereum OOM class motivated donation in envs/base.py)
+HOT_CARRY_PATHS = (
+    "cpr_tpu/envs/base.py",
+    "cpr_tpu/train/ppo.py",
+    "cpr_tpu/netsim/engine.py",
+)
+HOT_CARRY_PREFIXES = ("cpr_tpu/parallel/",)
+
+_LOOPS = (ast.For, ast.AsyncFor, ast.While)
+_COMPREHENSIONS = (ast.ListComp, ast.SetComp, ast.DictComp,
+                   ast.GeneratorExp)
+_SCOPES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def dotted(node) -> str | None:
+    """'jax.random.split' for Attribute/Name chains, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _is_jit_call(node) -> tuple[bool, ast.Call | None]:
+    """(is a jax.jit construction, the call carrying jit's kwargs) —
+    matches `jax.jit(...)` and `partial(jax.jit, ...)`."""
+    if not isinstance(node, ast.Call):
+        return False, None
+    d = dotted(node.func)
+    if d in ("jax.jit", "jit"):
+        return True, node
+    if d in ("partial", "functools.partial") and node.args:
+        if dotted(node.args[0]) in ("jax.jit", "jit"):
+            return True, node
+    return False, None
+
+
+def _enclosing(src: SourceFile, node, kinds):
+    for anc in src.ancestors(node):
+        if isinstance(anc, kinds):
+            return anc
+    return None
+
+
+class WallClockRule(Rule):
+    id = "wall-clock"
+    summary = ("no time.time()/naive datetime.now() under cpr_tpu/ — "
+               "interval timing goes through telemetry.now or Span")
+    rationale = ("PR 2: on an async-dispatch backend a wall-clock "
+                 "bracket measures dispatch, not execution; time.time "
+                 "is neither monotonic nor high-resolution.  Absorbs "
+                 "the PR-2 tokenize sweep test.")
+
+    _NAIVE = ("datetime.now", "datetime.datetime.now",
+              "datetime.utcnow", "datetime.datetime.utcnow",
+              "datetime.today", "datetime.datetime.today")
+
+    def check(self, src: SourceFile, ctx: LintContext):
+        if not src.rel.startswith("cpr_tpu/"):
+            return
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = dotted(node.func)
+            if d == "time.time":
+                yield self.finding(
+                    src, node,
+                    "time.time() — use telemetry.now (perf_counter) or "
+                    "a fenced Span for intervals")
+            elif (d in self._NAIVE and not node.args
+                  and not node.keywords):
+                yield self.finding(
+                    src, node,
+                    f"naive {d}() — pass an explicit tz "
+                    "(datetime.now(timezone.utc)) for wall-clock "
+                    "metadata; intervals go through telemetry.now")
+
+
+class RawWriteRule(Rule):
+    id = "raw-write"
+    summary = ("no truncating open(path, 'w'/'wb') artifact writes "
+               "outside resilience.py — use resilience.atomic_write_*")
+    rationale = ("PR 4: a crash mid-write must never leave a "
+                 "half-written artifact under its final name; every "
+                 "artifact write goes through tmp+fsync+os.replace.  "
+                 "Append-mode streams (telemetry JSONL) are exempt — "
+                 "appends never truncate.")
+
+    def check(self, src: SourceFile, ctx: LintContext):
+        if src.rel == "cpr_tpu/resilience.py":
+            return
+        for node in ast.walk(src.tree):
+            if not (isinstance(node, ast.Call)
+                    and dotted(node.func) in ("open", "io.open")):
+                continue
+            mode = None
+            if len(node.args) > 1:
+                mode = node.args[1]
+            for kw in node.keywords:
+                if kw.arg == "mode":
+                    mode = kw.value
+            if (isinstance(mode, ast.Constant)
+                    and isinstance(mode.value, str)
+                    and ("w" in mode.value or "x" in mode.value)):
+                yield self.finding(
+                    src, node,
+                    f"raw open(..., {mode.value!r}) — route artifact "
+                    "writes through resilience.atomic_write_bytes/"
+                    "_json/_text so readers never see a torn file")
+
+
+class EventSchemaRule(Rule):
+    id = "event-schema"
+    summary = ("telemetry .event(name, ...) call sites using a typed "
+               "EVENT_FIELDS name must pass every declared field")
+    rationale = ("PR 3: trace_summary --validate enforces the schema "
+                 "on artifacts at runtime; this catches the producer "
+                 "drift statically, before a smoke run has to fail.  "
+                 "EVENT_FIELDS is resolved from cpr_tpu/telemetry.py "
+                 "by AST, cross-module, without importing it.")
+
+    def check(self, src: SourceFile, ctx: LintContext):
+        schema = ctx.event_fields()
+        if not schema:
+            return
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if (isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "event" and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                name = node.args[0].value
+                required = schema.get(name)
+                if not required:
+                    continue
+                kwnames = {kw.arg for kw in node.keywords}
+                if None in kwnames:  # **kwargs: not statically checkable
+                    continue
+                missing = [k for k in required if k not in kwnames]
+                if missing:
+                    yield self.finding(
+                        src, node,
+                        f"typed event '{name}' missing declared "
+                        f"field(s) {missing} (telemetry.EVENT_FIELDS)")
+            elif (isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "emit"
+                    and len(node.args) == 1
+                    and isinstance(node.args[0], ast.Dict)):
+                d = node.args[0]
+                keys = {k.value for k in d.keys
+                        if isinstance(k, ast.Constant)}
+                if len(keys) != len(d.keys):
+                    continue  # dynamic/** keys: not checkable
+                vals = {k.value: v for k, v in zip(d.keys, d.values)
+                        if isinstance(k, ast.Constant)}
+                name_node = vals.get("name")
+                if (vals.get("kind") is None
+                        or not isinstance(name_node, ast.Constant)):
+                    continue
+                required = schema.get(name_node.value)
+                if required:
+                    missing = [k for k in required if k not in keys]
+                    if missing:
+                        yield self.finding(
+                            src, node,
+                            f"typed event '{name_node.value}' emitted "
+                            f"without declared field(s) {missing}")
+
+
+class JitInLoopRule(Rule):
+    id = "jit-in-loop"
+    summary = ("no jax.jit constructed in a loop body or jit-and-"
+               "called in one expression — each construction is a "
+               "fresh cache, so every call retraces")
+    rationale = ("PR 3: the compile_watch retrace pin proved stable "
+                 "call sites compile exactly once; a jit wrapper "
+                 "built per iteration (or per call via "
+                 "`jax.jit(f)(x)`) silently recompiles every time.  "
+                 "Factory functions that build, cache, and return a "
+                 "jitted callable are fine.")
+
+    def check(self, src: SourceFile, ctx: LintContext):
+        for node in ast.walk(src.tree):
+            is_jit, _ = _is_jit_call(node)
+            if not is_jit:
+                continue
+            parent = src.parents.get(node)
+            if isinstance(parent, ast.Call) and parent.func is node:
+                yield self.finding(
+                    src, node,
+                    "jax.jit(f)(...) constructs a fresh jit cache per "
+                    "call — bind the jitted callable once and reuse it")
+                continue
+            for anc in src.ancestors(node):
+                if isinstance(anc, _SCOPES):
+                    # constructed when the enclosing function runs;
+                    # loop ancestry beyond it is the caller's problem
+                    break
+                if isinstance(anc, _LOOPS + _COMPREHENSIONS):
+                    yield self.finding(
+                        src, node,
+                        "jax.jit constructed inside a loop — every "
+                        "iteration gets a fresh cache and retraces; "
+                        "hoist the construction out of the loop")
+                    break
+
+
+_STEPPY = re.compile(r"(^|_)(step|train_step)(_fn)?$")
+_CARRYISH = re.compile(r"(^|_)(carry|state)$")
+
+
+class DonateCarryRule(Rule):
+    id = "donate-carry"
+    summary = ("jitted carry-pytree loops on hot paths must donate "
+               "the carry (donate_argnums) or carry an explicit "
+               "annotated waiver")
+    rationale = ("PR 1/PR 4: aliasing the chunk/train carry halves "
+                 "peak device memory on the 65536-env ethereum class; "
+                 "non-donating hot loops silently double it back.  "
+                 "Scoped to envs/base.py, train/ppo.py, "
+                 "netsim/engine.py, parallel/.")
+
+    def _wrapped_first_param(self, src, jit_call, carrier):
+        """Name of the wrapped callable's first parameter, resolved
+        lexically (decorated def, local def by name, or lambda);
+        None when unresolvable."""
+        parent = src.parents.get(jit_call)
+        if (isinstance(parent, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and jit_call in parent.decorator_list):
+            args = parent.args.args
+            return args[0].arg if args else None
+        target = None
+        if dotted(jit_call.func) in ("jax.jit", "jit") and jit_call.args:
+            target = jit_call.args[0]
+        elif len(jit_call.args) > 1:  # partial(jax.jit, f, ...)
+            target = jit_call.args[1]
+        if isinstance(target, ast.Lambda):
+            args = target.args.args
+            return args[0].arg if args else None
+        if isinstance(target, ast.Name):
+            for n in ast.walk(src.tree):
+                if (isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+                        and n.name == target.id):
+                    args = n.args.args
+                    return args[0].arg if args else None
+            # unresolved (e.g. a function passed in as a parameter):
+            # fall back to the name itself — `jax.jit(step_fn)` on a
+            # hot path is the PPO update loop shape
+            if _STEPPY.search(target.id):
+                return "carry"
+        if isinstance(target, ast.Call):
+            for n in ast.walk(target):
+                if isinstance(n, ast.Name) and _STEPPY.search(n.id):
+                    return "carry"
+        return None
+
+    def check(self, src: SourceFile, ctx: LintContext):
+        if not (src.rel in HOT_CARRY_PATHS
+                or src.rel.startswith(HOT_CARRY_PREFIXES)):
+            return
+        for node in ast.walk(src.tree):
+            is_jit, kw_carrier = _is_jit_call(node)
+            if not is_jit:
+                continue
+            kwnames = {kw.arg for kw in kw_carrier.keywords}
+            if kwnames & {"donate_argnums", "donate_argnames"}:
+                continue
+            first = self._wrapped_first_param(src, node, kw_carrier)
+            if first is not None and _CARRYISH.search(first):
+                yield self.finding(
+                    src, node,
+                    f"jitted hot-path callable takes carry pytree "
+                    f"'{first}' without donate_argnums — the previous "
+                    "carry is dead after the call; donate it (or "
+                    "waive with a reasoned disable if old buffers "
+                    "are deliberately kept, e.g. best/revert aliasing)")
+
+
+_KEY_PRODUCERS = ("jax.random.PRNGKey", "jax.random.key",
+                  "jax.random.split", "jax.random.fold_in",
+                  "jax.random.wrap_key_data",
+                  "random.PRNGKey", "random.split", "random.fold_in",
+                  "jr.PRNGKey", "jr.split", "jr.fold_in")
+
+# fold_in(key, data) derives a fresh stream distinguished by `data`;
+# feeding the same base key to fold_in repeatedly (e.g. with a loop
+# index) is the sanctioned per-iteration idiom, not a reuse
+_FOLD_INS = ("jax.random.fold_in", "random.fold_in", "jr.fold_in")
+
+
+class KeyReuseRule(Rule):
+    id = "key-reuse"
+    summary = ("a PRNG key variable must not feed two sampling calls "
+               "without an intervening split/fold_in rebinding")
+    rationale = ("PR 5 lanes and every vmapped sweep assume "
+                 "statistically independent draws; reusing a consumed "
+                 "key replays the identical stream (the "
+                 "measure_rtdp.py segment bug class).  Indexed "
+                 "sub-keys (keys[i]) are distinct streams and exempt.")
+
+    def check(self, src: SourceFile, ctx: LintContext):
+        scopes = [src.tree] + [
+            n for n in ast.walk(src.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        for scope in scopes:
+            yield from self._check_scope(src, scope)
+
+    # -- per-scope linear dataflow ------------------------------------
+
+    def _check_scope(self, src, scope):
+        body = scope.body if hasattr(scope, "body") else []
+        state: dict[str, dict] = {}
+        findings: list = []
+        self._run(body, state, loops=(), findings=findings, src=src)
+        yield from findings
+
+    def _run(self, stmts, state, loops, findings, src):
+        for st in stmts:
+            self._stmt(st, state, loops, findings, src)
+
+    def _stmt(self, st, state, loops, findings, src):
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.ClassDef)):
+            return  # nested scopes get their own pass
+        if isinstance(st, (ast.For, ast.AsyncFor)):
+            self._expr(st.iter, state, loops, findings, src)
+            self._clear_targets(st.target, state)
+            inner = loops + (id(st),)
+            self._run(st.body, state, inner, findings, src)
+            self._run(st.orelse, state, loops, findings, src)
+            return
+        if isinstance(st, ast.While):
+            self._expr(st.test, state, loops, findings, src)
+            self._run(st.body, state, loops + (id(st),), findings, src)
+            self._run(st.orelse, state, loops, findings, src)
+            return
+        if isinstance(st, ast.If):
+            self._expr(st.test, state, loops, findings, src)
+            snap = {k: dict(v) for k, v in state.items()}
+            self._run(st.body, state, loops, findings, src)
+            after_body = state
+            other = snap
+            self._run(st.orelse, other, loops, findings, src)
+            # merge: a name is "used" if either branch used it
+            for k in set(after_body) | set(other):
+                a, b = after_body.get(k), other.get(k)
+                if a is None or b is None:
+                    after_body.pop(k, None)
+                    continue
+                a["uses"] = max(a["uses"], b["uses"])
+                a["flagged"] = a["flagged"] or b["flagged"]
+            return
+        if isinstance(st, ast.Try):
+            self._run(st.body, state, loops, findings, src)
+            for h in st.handlers:
+                self._run(h.body, state, loops, findings, src)
+            self._run(st.orelse, state, loops, findings, src)
+            self._run(st.finalbody, state, loops, findings, src)
+            return
+        if isinstance(st, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            value = st.value
+            targets = (st.targets if isinstance(st, ast.Assign)
+                       else [st.target])
+            produced = (isinstance(value, ast.Call)
+                        and dotted(value.func) in _KEY_PRODUCERS)
+            tnames = {name for t in targets
+                      for name in self._target_names(t)}
+            if value is not None:
+                # the split-rebind idiom `k, k1 = jax.random.split(k)`
+                # consumes-and-replaces k in one statement: the RHS use
+                # of a name that is also a target is not a reuse
+                self._expr(value, state, loops, findings, src,
+                           exempt=tnames if produced else frozenset())
+            for name in tnames:
+                if produced:
+                    state[name] = {"uses": 0, "loops": loops,
+                                   "flagged": False}
+                else:
+                    state.pop(name, None)
+            return
+        if isinstance(st, ast.With) or isinstance(st, ast.AsyncWith):
+            for item in st.items:
+                self._expr(item.context_expr, state, loops, findings, src)
+                if item.optional_vars is not None:
+                    self._clear_targets(item.optional_vars, state)
+            self._run(st.body, state, loops, findings, src)
+            return
+        # generic statement: walk its expressions
+        for child in ast.iter_child_nodes(st):
+            if isinstance(child, ast.expr):
+                self._expr(child, state, loops, findings, src)
+
+    def _target_names(self, t):
+        if isinstance(t, ast.Name):
+            yield t.id
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                yield from self._target_names(e)
+        elif isinstance(t, ast.Starred):
+            yield from self._target_names(t.value)
+
+    def _clear_targets(self, t, state):
+        for name in self._target_names(t):
+            state.pop(name, None)
+
+    def _expr(self, node, state, loops, findings, src,
+              exempt=frozenset()):
+        """Record key consumptions: tracked Names appearing in call
+        arguments (not func position, not under a Subscript — keys[i]
+        selects a distinct sub-key).  Lambda bodies are skipped —
+        closures are not linear dataflow in the enclosing scope."""
+        stack = [node]
+        while stack:
+            call = stack.pop()
+            if isinstance(call, ast.Lambda):
+                continue
+            stack.extend(ast.iter_child_nodes(call))
+            if isinstance(call, ast.NamedExpr):
+                # walrus rebinding inside an expression
+                if (isinstance(call.value, ast.Call)
+                        and dotted(call.value.func) in _KEY_PRODUCERS
+                        and isinstance(call.target, ast.Name)):
+                    state[call.target.id] = {"uses": 0, "loops": loops,
+                                             "flagged": False}
+            if not isinstance(call, ast.Call):
+                continue
+            if dotted(call.func) in _FOLD_INS:
+                continue  # derivation, not consumption
+            for arg in list(call.args) + [kw.value for kw in call.keywords]:
+                for name_node in self._arg_key_names(arg):
+                    if name_node.id in exempt:
+                        continue
+                    rec = state.get(name_node.id)
+                    if rec is None or rec["flagged"]:
+                        continue
+                    escaped_loop = any(lp not in rec["loops"]
+                                       for lp in loops)
+                    if rec["uses"] >= 1:
+                        rec["flagged"] = True
+                        findings.append(self.finding(
+                            src, name_node,
+                            f"PRNG key '{name_node.id}' consumed again "
+                            "without an intervening "
+                            "jax.random.split/fold_in — the identical "
+                            "stream replays"))
+                    elif escaped_loop:
+                        rec["flagged"] = True
+                        findings.append(self.finding(
+                            src, name_node,
+                            f"PRNG key '{name_node.id}' bound outside "
+                            "this loop is consumed every iteration — "
+                            "fold_in the iteration index or split per "
+                            "iteration"))
+                    else:
+                        rec["uses"] += 1
+
+    def _arg_key_names(self, arg):
+        """Direct Name nodes inside one call argument.  Skips
+        Subscripts (keys[i] is a fresh sub-key), Attributes (key.shape
+        reads metadata, it does not consume), closures, and nested
+        Calls — the outer expression walk visits nested calls itself,
+        so descending here would double-count `f(g(key))`."""
+        stack = [arg]
+        while stack:
+            n = stack.pop()
+            if isinstance(n, ast.Name):
+                yield n
+            elif isinstance(n, (ast.Subscript, ast.Attribute, ast.Call,
+                                ast.Lambda, ast.FunctionDef,
+                                ast.AsyncFunctionDef)):
+                continue
+            else:
+                stack.extend(ast.iter_child_nodes(n))
+
+
+class HostSyncRule(Rule):
+    id = "host-sync"
+    summary = ("no host-sync calls (.item(), float()/int() on traced "
+               "values, np.asarray, device_get, block_until_ready) "
+               "inside lax.scan / while_loop / fori_loop bodies")
+    rationale = ("PR 3: the chunked stats driver passes "
+                 "jax.transfer_guard('disallow') end-to-end; a host "
+                 "sync inside a traced loop body either crashes at "
+                 "trace time or, worse, silently falls back to a "
+                 "per-step device round-trip.")
+
+    _NP_SYNCS = ("np.asarray", "np.array", "numpy.asarray",
+                 "numpy.array", "onp.asarray", "onp.array",
+                 "jax.device_get")
+
+    def _body_functions(self, src):
+        """(body_expr, via) for every callable passed as a traced loop
+        body, resolving Names to same-file defs."""
+        defs: dict[str, list] = {}
+        for n in ast.walk(src.tree):
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs.setdefault(n.name, []).append(n)
+        out = []
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = dotted(node.func) or ""
+            cands = []
+            if d.endswith("lax.scan") and node.args:
+                cands = [node.args[0]]
+            elif d.endswith("lax.while_loop") and len(node.args) >= 2:
+                cands = [node.args[0], node.args[1]]
+            elif d.endswith("lax.fori_loop") and len(node.args) >= 3:
+                cands = [node.args[2]]
+            for c in cands:
+                if isinstance(c, ast.Lambda):
+                    out.append((c, d))
+                elif isinstance(c, ast.Name):
+                    out.extend((fd, d) for fd in defs.get(c.id, ()))
+        return out
+
+    def check(self, src: SourceFile, ctx: LintContext):
+        seen = set()
+        for body, via in self._body_functions(src):
+            for node in ast.walk(body):
+                if not isinstance(node, ast.Call) or id(node) in seen:
+                    continue
+                msg = None
+                d = dotted(node.func)
+                if (isinstance(node.func, ast.Attribute)
+                        and node.func.attr in ("item",
+                                               "block_until_ready")
+                        and not node.args):
+                    msg = f".{node.func.attr}()"
+                elif d in self._NP_SYNCS:
+                    msg = f"{d}(...)"
+                elif (isinstance(node.func, ast.Name)
+                        and node.func.id in ("float", "int", "bool")
+                        and len(node.args) == 1
+                        and not isinstance(node.args[0], ast.Constant)):
+                    msg = f"{node.func.id}(...) on a traced value"
+                if msg:
+                    seen.add(id(node))
+                    yield self.finding(
+                        src, node,
+                        f"host sync {msg} inside a {via} body — "
+                        "traced loop bodies must stay on device "
+                        "(ConcretizationError at best, a silent "
+                        "per-step transfer at worst)")
+
+
+RULES: tuple[Rule, ...] = (
+    WallClockRule(),
+    RawWriteRule(),
+    EventSchemaRule(),
+    JitInLoopRule(),
+    DonateCarryRule(),
+    KeyReuseRule(),
+    HostSyncRule(),
+)
+
+
+def rule_ids() -> tuple[str, ...]:
+    return tuple(r.id for r in RULES)

@@ -325,10 +325,16 @@ def maybe_checkify(step_fn):
     (device_metrics.ppo_spec), not something to leave on in a bench.
     On error: one `checkify_error` telemetry event, then the usual
     JaxRuntimeError via err.throw()."""
+    # donate-carry waived on both jits: train/driver.py keeps live
+    # references INTO the previous carry across updates (best_params
+    # for the revert-on-NaN path aliases carry[0].params), so donating
+    # the carry would hand XLA buffers the revert still needs
     if os.environ.get(telemetry.CHECKIFY_ENV_VAR) != "1":
+        # jaxlint: disable-next-line=donate-carry
         return jax.jit(step_fn)
     from jax.experimental import checkify
 
+    # jaxlint: disable-next-line=donate-carry
     checked = jax.jit(checkify.checkify(
         step_fn, errors=checkify.float_checks))
 

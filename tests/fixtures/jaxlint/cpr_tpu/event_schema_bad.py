@@ -1,0 +1,8 @@
+"""Seeded violations for the event-schema rule (schema: telemetry.py
+in this fixture tree declares compile and retry as typed events)."""
+
+
+def report(tele, fn_name):
+    tele.event("compile", fn=fn_name)  # finding: missing compile_s
+    # finding: missing delay_s, error
+    tele.emit({"kind": "event", "name": "retry", "attempt": 1})

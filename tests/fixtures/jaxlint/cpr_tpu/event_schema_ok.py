@@ -1,0 +1,11 @@
+"""Clean: typed events with every declared field; untyped names and
+dynamic payloads are out of the rule's scope."""
+
+
+def report(tele, fn_name, dt, err, extra):
+    tele.event("compile", fn=fn_name, compile_s=dt)
+    tele.event("compile", fn=fn_name, compile_s=dt, cached=True)
+    tele.event("custom_untyped", whatever=1)
+    tele.event("compile", **extra)  # dynamic kwargs: not checkable
+    tele.emit({"kind": "event", "name": "retry", "attempt": 1,
+               "delay_s": 0.5, "error": err})

@@ -1,0 +1,19 @@
+"""Seeded violations for the host-sync rule."""
+
+import numpy as np
+from jax import lax
+
+
+def scan_mean(xs):
+    def body(carry, x):
+        total = carry + float(x)  # finding: float() on a traced value
+        host = np.asarray(x)  # finding: host transfer in a traced body
+        del host
+        return total, x.item()  # finding: .item() syncs per step
+
+    return lax.scan(body, 0.0, xs)
+
+
+def wait(x):
+    # finding: bool() in the while_loop cond
+    return lax.while_loop(lambda s: bool(s < 4), lambda s: s + 1, x)

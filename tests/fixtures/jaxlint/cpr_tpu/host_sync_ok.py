@@ -1,0 +1,17 @@
+"""Clean: traced bodies stay on device; host syncs happen after."""
+
+import numpy as np
+from jax import lax
+
+
+def scan_mean(xs):
+    def body(carry, x):
+        return carry + x, x
+
+    total, ys = lax.scan(body, 0.0, xs)
+    # syncing AFTER the loop is the sanctioned pattern
+    return float(total), np.asarray(ys)
+
+
+def wait(x):
+    return lax.while_loop(lambda s: s < 4, lambda s: s + 1, x)

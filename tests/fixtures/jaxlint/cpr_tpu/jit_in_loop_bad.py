@@ -1,0 +1,12 @@
+"""Seeded violations for the jit-in-loop rule."""
+
+import jax
+
+
+def sweep(fns, xs):
+    out = []
+    for fn in fns:
+        jitted = jax.jit(fn)  # finding: fresh cache every iteration
+        out.append(jitted(xs))
+    y = jax.jit(lambda v: v + 1)(xs)  # finding: jit-and-call
+    return out, y

@@ -1,0 +1,24 @@
+"""Clean: decorator jits, bind-once-then-call, factory functions."""
+
+import jax
+
+
+@jax.jit
+def step(x):
+    return x + 1
+
+
+def make_runner(fn):
+    # a factory constructs the jit once and returns it; callers reuse
+    # the same cache
+    runner = jax.jit(fn)
+
+    def run(xs):
+        return [runner(x) for x in xs]
+
+    return run
+
+
+def sweep(fn, batches):
+    jitted = jax.jit(fn)
+    return [jitted(b) for b in batches]

@@ -1,0 +1,19 @@
+"""Seeded violations for the key-reuse rule."""
+
+import jax
+
+
+def sample(n):
+    key = jax.random.PRNGKey(0)
+    a = jax.random.normal(key, (n,))
+    b = jax.random.uniform(key, (n,))  # finding: identical stream replays
+    return a, b
+
+
+def rollout(steps, n):
+    key = jax.random.PRNGKey(1)
+    out = []
+    for _ in range(steps):
+        # finding: key bound outside the loop, consumed every iteration
+        out.append(jax.random.normal(key, (n,)))
+    return out

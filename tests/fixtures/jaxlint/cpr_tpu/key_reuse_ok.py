@@ -1,0 +1,29 @@
+"""Clean: split-rebind, per-iteration fold_in, indexed sub-keys."""
+
+import jax
+
+
+def sample(n):
+    key = jax.random.PRNGKey(0)
+    # the split-rebind idiom consumes and replaces the key in one step
+    key, k1, k2 = jax.random.split(key, 3)
+    a = jax.random.normal(k1, (n,))
+    b = jax.random.uniform(k2, (n,))
+    c = jax.random.normal(key, (n,))  # the rebound key is fresh
+    return a, b, c
+
+
+def rollout(steps, n):
+    key = jax.random.PRNGKey(1)
+    out = []
+    for i in range(steps):
+        step_key = jax.random.fold_in(key, i)  # derivation, not reuse
+        out.append(jax.random.normal(step_key, (n,)))
+    return out
+
+
+def batched(n):
+    keys = jax.random.split(jax.random.PRNGKey(2), n)
+    a = jax.random.normal(keys[0])
+    b = jax.random.normal(keys[1])  # indexed sub-keys are distinct
+    return a, b

@@ -1,0 +1,16 @@
+"""Seeded violations for the donate-carry rule (parallel/ is a
+registered hot path)."""
+
+import jax
+
+
+@jax.jit
+def step(carry, x):  # finding: decorated carry loop, no donation
+    return carry, x
+
+
+def make(step_fn):
+    return jax.jit(step_fn)  # finding: step-like name, no donation
+
+
+run = jax.jit(lambda state: state)  # finding: lambda carry-ish param

@@ -1,0 +1,19 @@
+"""Clean: donated carries, and jits whose first arg is not a carry."""
+
+from functools import partial
+
+import jax
+
+
+@partial(jax.jit, donate_argnums=0)
+def step(carry, x):
+    return carry, x
+
+
+@jax.jit
+def evaluate(params, batch):  # not a carry pytree
+    return params, batch
+
+
+run = jax.jit(lambda state: state, donate_argnums=0)
+named = jax.jit(lambda state: state, donate_argnames="state")

@@ -1,0 +1,13 @@
+"""Seeded violations for the raw-write rule."""
+
+import io
+import json
+
+
+def dump(path, obj, blob):
+    with open(path, "w") as f:  # finding: truncating write
+        json.dump(obj, f)
+    with io.open(path, mode="wb") as f:  # finding: mode= keyword
+        f.write(blob)
+    with open(path, "x") as f:  # finding: exclusive create
+        f.write("")

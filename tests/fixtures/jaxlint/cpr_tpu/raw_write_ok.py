@@ -1,0 +1,18 @@
+"""Clean: reads, append streams, atomic helpers, and the escape hatch."""
+
+from cpr_tpu.resilience import atomic_write_json, atomic_write_text
+
+
+def sink(path, line, obj):
+    with open(path) as f:  # read
+        f.read()
+    with open(path, "a") as f:  # append never truncates
+        f.write(line)
+    atomic_write_text(path, line)
+    atomic_write_json(path + ".json", obj)
+    # a deliberate raw write carries a reasoned inline disable
+    # jaxlint: disable-next-line=raw-write
+    with open(path + ".scratch", "w") as f:
+        f.write(line)
+    with open(path + ".scratch2", "w") as f:  # jaxlint: disable=raw-write
+        f.write(line)

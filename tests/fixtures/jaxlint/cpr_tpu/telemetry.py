@@ -1,0 +1,7 @@
+"""Minimal typed-event schema; the event-schema rule resolves this
+EVENT_FIELDS literal cross-module by AST (the file is never imported)."""
+
+EVENT_FIELDS = {
+    "compile": ("fn", "compile_s"),
+    "retry": ("attempt", "delay_s", "error"),
+}

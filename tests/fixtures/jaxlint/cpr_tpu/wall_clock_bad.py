@@ -1,0 +1,11 @@
+"""Seeded violations for the wall-clock rule."""
+
+import time
+from datetime import datetime
+
+
+def measure(fn):
+    t0 = time.time()  # finding: wall-clock interval bracket
+    fn()
+    stamp = datetime.now()  # finding: naive wall-clock stamp
+    return time.time() - t0, stamp

@@ -1,0 +1,11 @@
+"""Clean counterparts: perf_counter intervals, tz-aware stamps."""
+
+import time
+from datetime import datetime, timezone
+
+
+def measure(fn):
+    t0 = time.perf_counter()
+    fn()
+    stamp = datetime.now(timezone.utc)
+    return time.perf_counter() - t0, stamp

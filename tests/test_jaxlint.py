@@ -1,0 +1,149 @@
+"""jaxlint: per-rule fixture tests, CLI contract, and the repo gate.
+
+The fixture tree (tests/fixtures/jaxlint/) is a miniature repo linted
+with its own root, so path-scoped rules (wall-clock's cpr_tpu/ scope,
+raw-write's resilience exemption, donate-carry's hot-path list,
+event-schema's cross-module EVENT_FIELDS resolution) see realistic
+repo-relative paths.  The repo gate at the bottom is the tier-1
+enforcement point: every future PR inherits it.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from cpr_tpu.analysis import run_lint, rule_ids
+from cpr_tpu.analysis.core import LintContext, load_baseline
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXROOT = os.path.join(REPO, "tests", "fixtures", "jaxlint")
+CLI = os.path.join(REPO, "tools", "jaxlint.py")
+
+# rule id -> fixture stem (relative to FIXROOT); <stem>_bad.py seeds
+# violations, <stem>_ok.py exercises the sanctioned idioms
+CASES = {
+    "wall-clock": "cpr_tpu/wall_clock",
+    "raw-write": "cpr_tpu/raw_write",
+    "event-schema": "cpr_tpu/event_schema",
+    "jit-in-loop": "cpr_tpu/jit_in_loop",
+    "donate-carry": "cpr_tpu/parallel/donate",
+    "key-reuse": "cpr_tpu/key_reuse",
+    "host-sync": "cpr_tpu/host_sync",
+}
+
+
+def test_every_rule_has_fixtures():
+    assert set(CASES) == set(rule_ids())
+    for stem in CASES.values():
+        for suffix in ("_bad.py", "_ok.py"):
+            assert os.path.exists(os.path.join(FIXROOT, stem + suffix))
+
+
+@pytest.mark.parametrize("rule", sorted(CASES))
+def test_rule_catches_seeded_violation(rule):
+    path = os.path.join(FIXROOT, CASES[rule] + "_bad.py")
+    found = run_lint([path], root=FIXROOT)
+    assert found, f"{rule} missed its seeded violation"
+    # only the rule under test fires: bad fixtures must not leak
+    # cross-rule noise, or the parametrization stops meaning anything
+    assert {f.rule for f in found} == {rule}
+    assert all(f.path == CASES[rule] + "_bad.py" for f in found)
+    assert all(f.line > 0 and f.message for f in found)
+
+
+@pytest.mark.parametrize("rule", sorted(CASES))
+def test_rule_clean_on_sanctioned_idioms(rule):
+    path = os.path.join(FIXROOT, CASES[rule] + "_ok.py")
+    assert run_lint([path], root=FIXROOT) == []
+
+
+def test_raw_write_exempts_resilience():
+    path = os.path.join(FIXROOT, "cpr_tpu", "resilience.py")
+    assert run_lint([path], root=FIXROOT) == []
+
+
+def test_event_fields_resolved_cross_module_by_ast():
+    schema = LintContext(root=FIXROOT).event_fields()
+    assert schema == {"compile": ("fn", "compile_s"),
+                      "retry": ("attempt", "delay_s", "error")}
+
+
+def test_disable_rule_and_unknown_rule():
+    bad = os.path.join(FIXROOT, CASES["raw-write"] + "_bad.py")
+    assert run_lint([bad], root=FIXROOT, disable=["raw-write"]) == []
+    with pytest.raises(ValueError, match="unknown rule"):
+        run_lint([bad], root=FIXROOT, disable=["no-such-rule"])
+
+
+def test_baseline_grandfathers_existing_findings(tmp_path):
+    bad = os.path.join(FIXROOT, CASES["key-reuse"] + "_bad.py")
+    found = run_lint([bad], root=FIXROOT)
+    assert found
+    bl = tmp_path / "baseline.json"
+    bl.write_text(json.dumps(
+        {"findings": [f.as_dict() for f in found]}))
+    assert run_lint([bad], root=FIXROOT,
+                    baseline=load_baseline(str(bl))) == []
+
+
+# -- CLI contract ------------------------------------------------------------
+
+
+def _cli(*args, env=None):
+    e = dict(os.environ)
+    if env:
+        e.update(env)
+    return subprocess.run([sys.executable, CLI, *args], cwd=REPO,
+                          capture_output=True, text=True, env=e)
+
+
+def test_cli_json_exit_codes_disable_and_baseline(tmp_path):
+    bad = "tests/fixtures/jaxlint/cpr_tpu/raw_write_bad.py"
+    r = _cli(bad, "--format", "json")
+    assert r.returncode == 1
+    report = json.loads(r.stdout)
+    assert report["tool"] == "jaxlint"
+    assert {x["id"] for x in report["rules"]} == set(rule_ids())
+    assert report["findings"]
+    assert all(f["rule"] == "raw-write" for f in report["findings"])
+
+    assert _cli(bad, "--disable", "raw-write").returncode == 0
+    assert _cli(bad, "--disable", "bogus").returncode == 2
+
+    bl = str(tmp_path / "bl.json")
+    assert _cli(bad, "--write-baseline", bl).returncode == 0
+    assert _cli(bad, "--baseline", bl).returncode == 0
+
+    out = str(tmp_path / "report.json")
+    r = _cli(bad, "--output", out)
+    assert r.returncode == 1
+    assert json.loads(open(out).read())["findings"]
+
+
+def test_cli_lints_repo_without_importing_jax(tmp_path):
+    # a poisoned jax on PYTHONPATH turns any jax import into a crash;
+    # the CLI must stay pure-AST (and fast) over the whole repo
+    (tmp_path / "jax.py").write_text(
+        "raise ImportError('jaxlint must not import jax')\n")
+    t0 = time.perf_counter()
+    r = _cli("cpr_tpu", "tools", env={"PYTHONPATH": str(tmp_path)})
+    dt = time.perf_counter() - t0
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert dt < 5.0, f"linter took {dt:.1f}s (budget 5s)"
+
+
+# -- the tier-1 gate ---------------------------------------------------------
+
+
+def test_repo_is_lint_clean():
+    """The gate every future PR inherits: cpr_tpu/ + tools/ lint clean
+    (inline disables must carry reasons; there is no baseline debt).
+    This also owns the PR-2 no-wall-clock invariant, which used to be a
+    bespoke tokenize sweep in test_observability.py."""
+    found = run_lint(["cpr_tpu", "tools"], root=REPO)
+    assert found == [], "\n".join(
+        f"{f.path}:{f.line}: [{f.rule}] {f.message}" for f in found)

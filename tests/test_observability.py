@@ -16,7 +16,6 @@ import os
 import re
 import sys
 import time
-import tokenize
 from xml.etree import ElementTree as ET
 
 import jax
@@ -265,26 +264,9 @@ def test_bench_fallback_emits_tpu_outage_event(tmp_path):
     assert not missing
 
 
-def test_no_wall_clock_interval_timing_in_package():
-    """Interval timing under cpr_tpu/ must use telemetry.now (monotonic
-    perf_counter) or Span — never time.time().  Docstrings/comments may
-    mention the forbidden call (telemetry.py's own policy text does),
-    so only code tokens count."""
-    root = os.path.join(os.path.dirname(__file__), "..", "cpr_tpu")
-    offenders = []
-    for dirpath, _dirs, files in os.walk(root):
-        for fn in sorted(files):
-            if not fn.endswith(".py"):
-                continue
-            p = os.path.join(dirpath, fn)
-            with open(p, "rb") as f:
-                toks = tokenize.tokenize(f.readline)
-                code = " ".join(t.string for t in toks if t.type
-                                not in (tokenize.STRING,
-                                        tokenize.COMMENT))
-            if re.search(r"\btime\s*\.\s*time\s*\(", code):
-                offenders.append(os.path.relpath(p, root))
-    assert not offenders, offenders
+# the no-wall-clock-interval-timing invariant is now owned by the
+# jaxlint wall-clock rule (cpr_tpu/analysis/rules.py), enforced by
+# tests/test_jaxlint.py::test_repo_is_lint_clean
 
 
 def _load_trace_summary():
@@ -326,3 +308,53 @@ def test_trace_summary_validate(tmp_path, capsys):
     with pytest.raises(SystemExit) as exc:
         ts.main(["trace_summary", str(lame), "--validate"])
     assert exc.value.code == 1
+
+
+def test_trace_summary_validate_v4_netsim_event(tmp_path, capsys):
+    """The v4 schema's netsim event (PR 5) round-trips the validator: a
+    fully-typed event passes, including under `--expect netsim`, and
+    dropping a declared field is caught.  (The pre-v4 validation tests
+    above never exercise an event newer than v3.)"""
+    ts = _load_trace_summary()
+    good = tmp_path / "netsim.jsonl"
+    tele = telemetry.Telemetry(str(good))
+    with tele.span("netsim_run"):
+        pass
+    tele.event("netsim", protocol="nakamoto", lanes=8,
+               activations=1024, steps=4096, drops=0)
+    tele.manifest(config={"metric": "netsim_nakamoto"})
+    tele.close()
+    events, bad = ts.read_events(str(good))
+    assert any(e.get("name") == "netsim" for e in events)
+    (man,) = [e for e in events if e.get("kind") == "manifest"]
+    assert man["schema"] >= 4
+    assert ts.validate(events, bad) == []
+    assert ts.validate(events, bad, expect=("netsim",)) == []
+    ts.main(["trace_summary", str(good), "--validate",
+             "--expect", "netsim"])  # exits 0
+    capsys.readouterr()
+
+    lame = tmp_path / "lame.jsonl"
+    lines = []
+    for line in good.read_text().splitlines():
+        e = json.loads(line)
+        if e.get("name") == "netsim":
+            e.pop("drops")
+        lines.append(json.dumps(e))
+    lame.write_text("\n".join(lines) + "\n")
+    events, bad = ts.read_events(str(lame))
+    errors = ts.validate(events, bad)
+    assert any("netsim" in err and "drops" in err for err in errors)
+
+
+def test_malformed_dag_dump_atomic(tmp_path, monkeypatch):
+    """The forensics dump rides the resilience atomic writer: the
+    final name holds the complete dot text and no orphaned tmp
+    sibling survives."""
+    target = tmp_path / "malformed.dot"
+    monkeypatch.setenv(trace.MALFORMED_ENV_VAR, str(target))
+    view = trace.DagView(nodes=[{"id": 0}, {"id": 1}], edges=[(1, 0)])
+    with pytest.raises(trace.MalformedDag, match="dumped to"):
+        trace.raise_malformed(view, "parent id above child")
+    assert target.read_text().startswith("digraph")
+    assert [p.name for p in tmp_path.iterdir()] == ["malformed.dot"]

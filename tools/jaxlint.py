@@ -1,0 +1,133 @@
+"""jaxlint CLI — the repo's JAX-aware static-analysis gate.
+
+    python tools/jaxlint.py cpr_tpu tools                # human output
+    python tools/jaxlint.py cpr_tpu tools --format json  # machine output
+    python tools/jaxlint.py --list-rules                 # rule catalog
+
+Exit codes: 0 = clean (or every finding baselined), 1 = new findings,
+2 = usage error.  Rule catalog and rationale: docs/ANALYSIS.md.
+
+The implementation lives in cpr_tpu/analysis/; this wrapper loads that
+package WITHOUT executing cpr_tpu/__init__.py (which imports jax via
+params), so linting never initializes a JAX backend — pure AST, ~1s
+over the whole repo on the 1-core host, safe to run anywhere including
+hosts with a wedged TPU plugin.
+"""
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_analysis():
+    """Import cpr_tpu.analysis as a package but bypass cpr_tpu's
+    __init__ (keeps the CLI jax-free; tests assert this)."""
+    if "cpr_tpu.analysis" in sys.modules:
+        return sys.modules["cpr_tpu.analysis"]
+    pkg_dir = os.path.join(REPO, "cpr_tpu", "analysis")
+    spec = importlib.util.spec_from_file_location(
+        "cpr_tpu.analysis", os.path.join(pkg_dir, "__init__.py"),
+        submodule_search_locations=[pkg_dir])
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["cpr_tpu.analysis"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="jaxlint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("paths", nargs="*", default=[],
+                    help="files or directories to lint "
+                         "(default: cpr_tpu tools)")
+    ap.add_argument("--format", choices=("text", "json"), default="text",
+                    help="stdout format (json: stable rule ids, one "
+                         "object with findings + rule catalog)")
+    ap.add_argument("--disable", action="append", default=[],
+                    metavar="RULE[,RULE]",
+                    help="skip rule id(s); repeatable")
+    ap.add_argument("--baseline", metavar="FILE",
+                    help="JSON baseline of grandfathered findings "
+                         "(gate fails only on NEW findings)")
+    ap.add_argument("--write-baseline", metavar="FILE",
+                    help="write current findings as a baseline and "
+                         "exit 0")
+    ap.add_argument("--output", metavar="FILE",
+                    help="also write the JSON report to FILE "
+                         "(regardless of --format)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    args = ap.parse_args(argv)
+
+    analysis = _load_analysis()
+    from cpr_tpu.analysis.rules import RULES
+
+    if args.list_rules:
+        for r in RULES:
+            print(f"{r.id:<14} {r.summary}")
+        return 0
+
+    paths = args.paths or ["cpr_tpu", "tools"]
+    disable = [r for part in args.disable for r in part.split(",") if r]
+    baseline = None
+    if args.baseline:
+        try:
+            baseline = analysis.load_baseline(args.baseline)
+        except (OSError, ValueError, KeyError) as e:
+            print(f"jaxlint: bad baseline {args.baseline}: {e}",
+                  file=sys.stderr)
+            return 2
+    try:
+        findings = analysis.run_lint(paths, root=REPO, disable=disable,
+                                     baseline=baseline)
+    except ValueError as e:
+        print(f"jaxlint: {e}", file=sys.stderr)
+        return 2
+
+    report = {
+        "tool": "jaxlint",
+        "version": 1,
+        "paths": paths,
+        "disabled": sorted(disable),
+        "baseline": args.baseline,
+        "rules": [{"id": r.id, "summary": r.summary} for r in RULES
+                  if r.id not in disable],
+        "findings": [f.as_dict() for f in findings],
+    }
+    if args.write_baseline:
+        # lint reports are regenerable scratch, and this CLI must stay
+        # importable without the package (resilience.atomic_write_*
+        # pulls jax via cpr_tpu/__init__)
+        # jaxlint: disable-next-line=raw-write
+        with open(args.write_baseline, "w") as f:
+            json.dump({"findings": [f_.as_dict() for f_ in findings]},
+                      f, indent=2)
+            f.write("\n")
+        print(f"jaxlint: wrote baseline with {len(findings)} "
+              f"finding(s) to {args.write_baseline}", file=sys.stderr)
+        return 0
+    if args.output:
+        # jaxlint: disable-next-line=raw-write — scratch-report exemption
+        with open(args.output, "w") as f:
+            json.dump(report, f, indent=2)
+            f.write("\n")
+    if args.format == "json":
+        json.dump(report, sys.stdout, indent=2)
+        print()
+    else:
+        for f_ in findings:
+            print(f"{f_.path}:{f_.line}:{f_.col}: "
+                  f"[{f_.rule}] {f_.message}")
+        suffix = " (new vs baseline)" if baseline else ""
+        print(f"jaxlint: {len(findings)} finding(s){suffix} in "
+              f"{len(paths)} path(s)", file=sys.stderr)
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -51,6 +51,10 @@ def measure_env(env, policy_name, n_envs, n_steps, max_steps, chunk, reps=2):
     rep_s = []
     for r in range(reps):
         with tele.span("sweep_rep", env_steps=n_envs * n_steps) as sp:
+            # timing reps deliberately replay the identical key batch:
+            # min-over-reps only means something if every rep runs the
+            # exact same work
+            # jaxlint: disable-next-line=key-reuse
             stats = sp.fence(fn(keys))
         rep_s.append(sp.dur_s)
         log(f"rep {r}: {rep_s[-1]:.1f}s "
@@ -106,6 +110,9 @@ def main():
         init_fn, train_step = make_train(env, params, cfg)
         tele = telemetry.current()
         with tele.span("sweep_compile") as sp:
+            # one-shot init: jit(init_fn) is constructed and called
+            # exactly once, so the fresh-cache-per-call hazard is moot
+            # jaxlint: disable-next-line=jit-in-loop
             carry = jax.jit(init_fn)(jax.random.PRNGKey(0))
             step = jax.jit(train_step)
             carry, _ = step(carry)

@@ -57,6 +57,8 @@ def build(config, n_envs):
         params = make_params(alpha=0.35, gamma=0.5, max_steps=120)
         cfg = PPOConfig(n_envs=n_envs, n_steps=128)
         init_fn, train_step = make_train(env, params, cfg)
+        # one-shot init: constructed and called exactly once
+        # jaxlint: disable-next-line=jit-in-loop
         carry = jax.jit(init_fn)(jax.random.PRNGKey(0))
         step = jax.jit(train_step)
         state = {"carry": carry}
