@@ -4,7 +4,7 @@
 
 CPU_MESH = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: lint test test-slow bench telemetry-smoke netsim-smoke resilience-smoke dryrun sweeps ghostdag train-dummy native asan
+.PHONY: lint test test-slow bench perf-gate telemetry-smoke netsim-smoke resilience-smoke dryrun sweeps ghostdag train-dummy native asan
 
 lint:  ## jaxlint over cpr_tpu/ + tools/ (pure AST, no JAX import,
 	## ~1s); banks the JSON report under runs/ like the smoke flows
@@ -34,16 +34,23 @@ test-slow-split:
 bench:  ## one-line JSON benchmark (TPU with CPU fallback)
 	python bench.py
 
+perf-gate:  ## regression gate over the banked bench trail: newest row
+	## per metric x backend vs the best same-backend banked history
+	## (median/MAD band; outage rows never baselines).  Nonzero exit on
+	## any FAIL verdict.  Details: docs/OBSERVABILITY.md
+	python tools/perf_report.py --gate
+
 TELEMETRY_SMOKE = /tmp/cpr-telemetry-smoke.jsonl
 
 telemetry-smoke:  ## tiny nakamoto CPU bench with telemetry + in-graph
 	## device metrics on, then schema-validate the JSONL artifact
-	## (nonzero exit on violation or if the v2 event types are absent)
+	## (nonzero exit on violation or if the v2 event types are absent;
+	## v5 adds the perf_gate verdict the bench self-emits after banking)
 	rm -f $(TELEMETRY_SMOKE)
 	CPR_BENCH_BACKEND=cpu CPR_DEVICE_METRICS=1 \
 		CPR_TELEMETRY=$(TELEMETRY_SMOKE) python bench.py
 	python tools/trace_summary.py $(TELEMETRY_SMOKE) --validate \
-		--expect device_metrics,compile
+		--expect device_metrics,compile,perf_gate
 
 NETSIM_SMOKE = /tmp/cpr-netsim-smoke.jsonl
 

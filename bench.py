@@ -374,13 +374,20 @@ def _cpu_baseline(name: str):
         return None
 
 
-def _last_known_tpu(metric_prefix: str):
+def _last_known_tpu(metric_prefix: str, root: str | None = None):
     """Most recent banked on-chip row whose metric starts with
     `metric_prefix`: scans the BENCH_*.json artifacts next to this file
     (driver rounds carry one parsed row; BENCH_CONFIGS* carry row
     lists), newest round wins.  The context a CPU-fallback row ships so
-    it can never be misread as a regression (VERDICT weak #1)."""
-    root = os.path.dirname(os.path.abspath(__file__))
+    it can never be misread as a regression (VERDICT weak #1).
+
+    Rows tagged `outage` (or carrying a `fallback_reason`/`error`) are
+    never candidates even if they claim backend "tpu": a row banked
+    during a chip outage describes the outage, not the chip — the same
+    exclusion the perf gate's baseline scan applies
+    (cpr_tpu/perf/gate.baseline_rows)."""
+    if root is None:
+        root = os.path.dirname(os.path.abspath(__file__))
     best = None  # (round, row, source file)
     for path in sorted(glob.glob(os.path.join(root, "BENCH*.json"))):
         base = os.path.basename(path)
@@ -399,6 +406,8 @@ def _last_known_tpu(metric_prefix: str):
         for row in rows:
             if (not isinstance(row, dict)
                     or row.get("backend") != "tpu"
+                    or row.get("outage") or row.get("fallback_reason")
+                    or row.get("error")
                     or not str(row.get("metric", "")).startswith(
                         metric_prefix)):
                 continue
@@ -461,6 +470,29 @@ def _apply_prng_choice():
         jax.config.update("jax_threefry_partitionable", True)
 
 
+def _bank_and_gate(row: dict):
+    """Bank one final row into the perf ledger and self-gate it against
+    the banked history (cpr_tpu/perf).  Advisory by construction: the
+    bench's contract is the JSON line on stdout, so a ledger or gate
+    problem prints a warning and never costs the measurement.  Called
+    only where FINAL rows exist — run_bench, run_configs, and the
+    run_configs_isolated parent (run_one children are not final: the
+    parent may still stamp outage/worker-health fields, and banking
+    both shapes would double-count the run)."""
+    try:
+        from cpr_tpu import perf
+
+        result = perf.bank_and_gate(
+            row, root=os.path.dirname(os.path.abspath(__file__)))
+        line = (f"perf-gate: {result['metric']} [{result['backend']}] "
+                f"{result['verdict'].upper()}")
+        if result.get("reason"):
+            line += f" ({result['reason']})"
+        print(line, file=sys.stderr)
+    except Exception as e:  # noqa: BLE001 — advisory, never fatal
+        print(f"perf-gate: skipped ({e})", file=sys.stderr)
+
+
 def run_bench(platform_hint: str, fallback_reason: str | None = None):
     """Measure and print the JSON line on whatever backend comes up.
     `fallback_reason` (set by main()'s watchdog when the TPU attempts
@@ -490,7 +522,7 @@ def run_bench(platform_hint: str, fallback_reason: str | None = None):
         raise GuardFailure(f"SM1 revenue {rel} off closed form 0.416")
 
     base = _cpu_baseline("nakamoto_sm1")
-    print(json.dumps({
+    row = {
         "metric": "nakamoto_selfish_mining_env_steps_per_sec_per_chip",
         "value": round(steps_per_sec),
         "unit": "env-steps/sec/chip",
@@ -505,7 +537,9 @@ def run_bench(platform_hint: str, fallback_reason: str | None = None):
         **(_outage_fields(fallback_reason, "nakamoto_selfish_mining")
            if fallback_reason is not None else {}),
         "manifest": manifest,
-    }))
+    }
+    print(json.dumps(row))
+    _bank_and_gate(row)
 
 
 # BASELINE.md target configs 2-4 (config 1 is the headline metric above;
@@ -602,6 +636,7 @@ def run_configs(platform_hint: str):
     for name in CONFIGS:
         row = _measure_config(name, platform)
         print(json.dumps(row))
+        _bank_and_gate(row)
         out.append(row)
     _write_configs_json(out)
 
@@ -777,6 +812,7 @@ def run_configs_isolated(timeout: float):
                 row["secs_since_worker_fault"] = round(
                     telemetry.now() - last_fault_ts)
         print(json.dumps(row))
+        _bank_and_gate(row)
         out.append(row)
     _write_configs_json(out)
 

@@ -1,0 +1,79 @@
+"""Perf ledger + runtime regression gate over the banked bench trail.
+
+ROADMAP item 5's runtime half: the repo banks a `BENCH_*.json` /
+device-metrics / telemetry trail every driver round, but until this
+package nothing ever read it back — two of five rounds (r02, r05)
+silently delivered CPU numbers, and a same-backend steps/sec drop
+would have sailed through unnoticed.  Following the throughput
+accounting discipline of accelerated-RL systems (arXiv:1803.02811's
+sampler/learner rate tracking), the trail becomes a first-class,
+queryable, *gating* observability surface:
+
+* `ledger` — normalizes every banked bench row (driver-round
+  artifacts, `BENCH_CONFIGS*` row lists, single-row banks), its
+  embedded run manifest, and optionally the span rates of a telemetry
+  trace into one schema-versioned record shape, keyed by
+  metric x backend x config fingerprint, persisted as an append-only
+  JSONL ledger.  All writes go through `resilience.atomic_*` (the
+  jaxlint `raw-write` rule holds with no waivers); ingestion is
+  idempotent (content-addressed `row_id` dedup).
+
+* `gate` — compares a fresh row against the best same-backend banked
+  rows using robust statistics (median/MAD band over the top-k values;
+  `outage`/`fallback_reason` and error rows are never baselines) and
+  emits a typed `perf_gate` telemetry event (schema v5) carrying the
+  pass/warn/fail verdict and the baseline it judged against.  A
+  CPU-fallback row is never gated against a TPU baseline: backends
+  never mix, and an outage row is skipped outright (the `tpu_outage`
+  event already tags it).
+
+Consumers: `bench.py` banks and self-gates every row it prints
+(advisory — the bench must always deliver a number);
+`tools/perf_report.py` renders trend tables / a markdown report and
+returns a nonzero exit code in `--gate` mode (`make perf-gate`);
+docs/OBSERVABILITY.md documents verdict bands and how to bless an
+intentional perf change.
+
+Import-time this package is jax-free (like telemetry/resilience), so
+bench.py's watchdog parent can bank rows without initializing a
+backend.
+"""
+
+from cpr_tpu.perf.gate import (baseline_rows, emit_gate_event, gate_row,
+                               gate_summary)
+from cpr_tpu.perf.ledger import (LEDGER_ENV_VAR, LEDGER_VERSION, Ledger,
+                                 config_fingerprint, default_ledger_path,
+                                 iter_bank_rows, iter_trace_rows,
+                                 normalize_row)
+
+__all__ = [
+    "LEDGER_ENV_VAR",
+    "LEDGER_VERSION",
+    "Ledger",
+    "bank_and_gate",
+    "baseline_rows",
+    "config_fingerprint",
+    "default_ledger_path",
+    "emit_gate_event",
+    "gate_row",
+    "gate_summary",
+    "iter_bank_rows",
+    "iter_trace_rows",
+    "normalize_row",
+]
+
+
+def bank_and_gate(row: dict, root: str, *, source: str = "live",
+                  ledger_path: str | None = None) -> dict:
+    """Bank one fresh bench row and self-gate it: ingest the tracked
+    banks under `root` into the ledger (idempotent), gate `row` against
+    the banked history, append it, and emit the `perf_gate` event.
+    Returns the gate result — the caller decides what a verdict means
+    (bench.py only reports; tools/perf_report.py --gate enforces)."""
+    ledger = Ledger(ledger_path or default_ledger_path(root))
+    ledger.ingest_banks(root)
+    rec = normalize_row(row, source=source)
+    result = gate_row(rec, ledger.records())
+    ledger.append([rec])
+    emit_gate_event(result)
+    return result

@@ -1,0 +1,149 @@
+"""Runtime perf-regression detection over ledger records.
+
+Verdict semantics (docs/OBSERVABILITY.md "Perf ledger & regression
+gate"):
+
+* The baseline is the **best** same-backend, same-metric banked rows —
+  top-k by value, preferring rows with the candidate's exact config
+  fingerprint (falling back to all same-backend rows with
+  `config_drift` flagged, so a batch-size change is still gated but
+  self-describes as not like-for-like).
+* `outage`/`fallback_reason` rows and error rows are **never**
+  baselines: a CPU number delivered during a chip outage is a fact
+  about the outage, not about the code.
+* The band is robust: median/MAD over the baseline pool.  A drop
+  deeper than `max(warn_frac * median, mad_k * 1.4826 * MAD)` warns;
+  deeper than the `fail_frac` analog fails.  The MAD term keeps a
+  noisy history (e.g. a 15x round-over-round improvement trail) from
+  flagging every honest fluctuation; the fractional floor keeps a
+  suspiciously-quiet history from flagging sub-noise jitter.
+* A candidate that is itself an outage/error row is `skip`ped, never
+  judged: gating a CPU-fallback value against anything would re-create
+  exactly the r05 misread the outage tags exist to prevent, and the
+  `tpu_outage` event already marks the stream.  Backends never mix —
+  a CPU row is only ever compared to CPU history.
+
+Every gate emits a typed `perf_gate` telemetry event (schema v5:
+metric, backend, verdict, value, baseline) so the verdict is part of
+the same post-mortem trail the bench rows live in.
+"""
+
+from __future__ import annotations
+
+from cpr_tpu import telemetry
+
+# verdict band defaults: fractions of the baseline median a drop must
+# exceed, and the MAD multiplier that widens the band on noisy history
+WARN_FRAC = 0.10
+FAIL_FRAC = 0.25
+MAD_K = 4.0
+TOP_K = 5
+
+# MAD -> sigma-equivalent scale for normally-distributed noise
+_MAD_SCALE = 1.4826
+
+
+def _median(vals):
+    s = sorted(vals)
+    n = len(s)
+    mid = n // 2
+    return s[mid] if n % 2 else 0.5 * (s[mid - 1] + s[mid])
+
+
+def baseline_rows(records, metric: str, backend) -> list[dict]:
+    """The gate-eligible history for metric x backend: same backend
+    only (a CPU-fallback row is never judged against a TPU baseline),
+    no outage/fallback rows, no error rows, positive numeric value."""
+    return [r for r in records
+            if r.get("metric") == metric and r.get("backend") == backend
+            and not r.get("outage") and not r.get("error")
+            and isinstance(r.get("value"), (int, float))
+            and r["value"] > 0]
+
+
+def gate_row(candidate: dict, history, *, top_k: int = TOP_K,
+             warn_frac: float = WARN_FRAC, fail_frac: float = FAIL_FRAC,
+             mad_k: float = MAD_K) -> dict:
+    """Judge one ledger record against the banked history.  Returns
+    {verdict: pass|warn|fail|skip, metric, backend, value, baseline,
+    config_drift, reason}; `baseline` names the rows judged against
+    (median/mad/n/best/best_source/thresholds) or None."""
+    result = {
+        "metric": candidate.get("metric"),
+        "backend": candidate.get("backend"),
+        "value": candidate.get("value"),
+        "verdict": "pass",
+        "baseline": None,
+        "config_drift": False,
+        "reason": "",
+    }
+    if candidate.get("error"):
+        result.update(verdict="skip",
+                      reason="error row: nothing to gate")
+        return result
+    if candidate.get("outage"):
+        result.update(verdict="skip", reason=(
+            "outage/fallback row: not gated (the tpu_outage tag "
+            "already explains it; a fallback value judged against "
+            "healthy history would only re-create the r05 misread)"))
+        return result
+    if not isinstance(candidate.get("value"), (int, float)):
+        result.update(verdict="skip", reason="row carries no value")
+        return result
+    pool = [r for r in baseline_rows(history, candidate["metric"],
+                                     candidate["backend"])
+            if r.get("row_id") != candidate.get("row_id")]
+    if not pool:
+        result["reason"] = ("no same-backend baseline banked yet "
+                            "(first measurement)")
+        return result
+    same_fp = [r for r in pool
+               if r.get("fingerprint") == candidate.get("fingerprint")]
+    if same_fp:
+        pool = same_fp
+    else:
+        result["config_drift"] = True
+    best = sorted(pool, key=lambda r: -r["value"])[:top_k]
+    vals = [r["value"] for r in best]
+    med = _median(vals)
+    mad = _median([abs(v - med) for v in vals])
+    noise = mad_k * _MAD_SCALE * mad
+    value = float(candidate["value"])
+    warn_below = med - max(warn_frac * med, noise)
+    fail_below = med - max(fail_frac * med, noise)
+    verdict = ("fail" if value < fail_below
+               else "warn" if value < warn_below else "pass")
+    result.update(
+        verdict=verdict,
+        baseline={
+            "median": med, "mad": mad, "n": len(vals),
+            "best": best[0]["value"],
+            "best_source": best[0].get("source"),
+            "best_round": best[0].get("round"),
+            "warn_below": warn_below, "fail_below": fail_below,
+        },
+        reason=(f"value {value:.6g} vs median-of-best {med:.6g} "
+                f"({value / med - 1.0:+.1%}; warn<{warn_below:.6g} "
+                f"fail<{fail_below:.6g}"
+                + (", config drifted from baseline"
+                   if result["config_drift"] else "") + ")"))
+    return result
+
+
+def emit_gate_event(result: dict):
+    """Emit the typed schema-v5 `perf_gate` event for one verdict."""
+    telemetry.current().event(
+        "perf_gate", metric=result["metric"], backend=result["backend"],
+        verdict=result["verdict"], value=result["value"],
+        baseline=result["baseline"], config_drift=result["config_drift"],
+        reason=result["reason"])
+
+
+def gate_summary(results) -> dict:
+    """Tally verdicts: {pass: n, warn: n, fail: n, skip: n, ok: bool}
+    — `ok` is False iff any gate failed (the `--gate` exit code)."""
+    counts = {"pass": 0, "warn": 0, "fail": 0, "skip": 0}
+    for r in results:
+        counts[r["verdict"]] = counts.get(r["verdict"], 0) + 1
+    counts["ok"] = counts["fail"] == 0
+    return counts

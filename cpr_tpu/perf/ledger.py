@@ -1,0 +1,227 @@
+"""The perf ledger: banked bench rows normalized into one record shape.
+
+Three artifact dialects feed it (everything `BENCH*.json` next to
+bench.py):
+
+* driver-round artifacts — `{"n": round, "tail": stderr, "parsed": row}`
+  (one parsed row per round; the stderr tail is kept because pre-PR2
+  rounds ran before CPU-fallback rows carried `outage` tags — a
+  "falling back to CPU" marker in the tail backfills the tag, so r02
+  and r05 can never become CPU baselines),
+* config banks — `BENCH_CONFIGS*.json` row lists (round from the rNN
+  filename suffix; the suffix-less current bank counts as newest),
+* single-row banks — `BENCH_self_r*.json` style one-object files.
+
+`iter_trace_rows` additionally lifts the span rates out of a telemetry
+JSONL trace (`per_sec` counters under the stream's manifest backend),
+so sweep/training traces land on the same trend surface as bench rows.
+
+Ledger records (`ledger: 1`):
+
+    metric, backend, value, unit, check, round, source,
+    outage, fallback_reason, error,
+    config (prng/window/cfg_*), fingerprint (metric x config hash),
+    time_utc / git_sha / device_kind (from the embedded manifest),
+    row_id (content hash — ingestion dedup key)
+
+The ledger file is append-only JSONL: `append` never edits or drops an
+existing line, and every write goes through `resilience.atomic_write_text`
+(tmp+fsync+rename — the jaxlint `raw-write` gate passes with no
+waivers), so a crash mid-bank can never tear the history a later gate
+judges against.
+"""
+
+from __future__ import annotations
+
+import glob
+import hashlib
+import json
+import os
+import re
+
+from cpr_tpu.resilience import atomic_write_text
+
+LEDGER_VERSION = 1
+LEDGER_ENV_VAR = "CPR_PERF_LEDGER"
+
+# fallback_reason stamped onto rows whose artifact predates the outage
+# tagging (PR 2) but whose stderr tail records the backend switch
+INFERRED_FALLBACK = "inferred: artifact stderr tail records a CPU fallback"
+
+_FALLBACK_MARKERS = ("falling back to CPU", "hung past")
+
+
+def default_ledger_path(root: str) -> str:
+    """$CPR_PERF_LEDGER, else `<root>/runs/perf_ledger.jsonl` (scratch:
+    fully regenerable from the tracked banks, so gitignored)."""
+    return (os.environ.get(LEDGER_ENV_VAR)
+            or os.path.join(root, "runs", "perf_ledger.jsonl"))
+
+
+def _digest(obj) -> str:
+    return hashlib.sha1(
+        json.dumps(obj, sort_keys=True, default=str).encode()).hexdigest()[:12]
+
+
+def config_fingerprint(metric: str, config: dict) -> str:
+    """Stable hash of metric x measurement config — the ledger key that
+    decides which banked rows are directly comparable.  A gate across
+    differing fingerprints is still run (same backend trumps same
+    batch size) but flagged `config_drift`."""
+    return _digest({"metric": metric, **config})
+
+
+def normalize_row(row: dict, *, source: str = "live",
+                  rnd: int | None = None, tail_hint: bool = False) -> dict:
+    """One bench row -> one ledger record.  `tail_hint` says the source
+    artifact's stderr tail recorded a CPU fallback (outage backfill for
+    pre-tagging rounds).  Error rows normalize too — the ledger is the
+    full trail, eligibility is the gate's job."""
+    metric = str(row.get("metric") or "")
+    value = row.get("value")
+    outage = bool(row.get("outage"))
+    reason = row.get("fallback_reason")
+    if not outage and tail_hint and row.get("backend") == "cpu":
+        outage, reason = True, INFERRED_FALLBACK
+    config = {k: row[k] for k in sorted(row) if k.startswith("cfg_")}
+    for k in ("prng", "window"):
+        if k in row:
+            config[k] = row[k]
+    man = row.get("manifest") or {}
+    rec = {
+        "ledger": LEDGER_VERSION,
+        "metric": metric,
+        "backend": row.get("backend"),
+        "value": (float(value)
+                  if isinstance(value, (int, float)) else None),
+        "unit": row.get("unit"),
+        "check": row.get("check"),
+        "round": rnd,
+        "source": source,
+        "outage": outage,
+        "fallback_reason": reason,
+        "error": row.get("error"),
+        "config": config,
+        "fingerprint": config_fingerprint(metric, config),
+        "time_utc": man.get("time_utc"),
+        "git_sha": man.get("git_sha"),
+        "device_kind": man.get("device_kind"),
+    }
+    rec["row_id"] = _digest(rec)
+    return rec
+
+
+def _filename_round(base: str) -> int | None:
+    m = re.search(r"r(\d+)", base)
+    return int(m.group(1)) if m else None
+
+
+def iter_bank_rows(root: str):
+    """Yield (row, source, round, tail_hint) for every row banked in
+    the `BENCH*.json` artifacts under `root` (rows without a `metric`
+    key — e.g. a round that produced no parse — are skipped)."""
+    for path in sorted(glob.glob(os.path.join(root, "BENCH*.json"))):
+        base = os.path.basename(path)
+        try:
+            with open(path) as f:
+                data = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if isinstance(data, dict) and "tail" in data:
+            # driver-round artifact: one parsed row + the stderr tail
+            rnd = data.get("n")
+            rnd = int(rnd) if isinstance(rnd, int) else None
+            tail = data.get("tail") or ""
+            hint = any(m in tail for m in _FALLBACK_MARKERS)
+            rows = [data.get("parsed")]
+        else:
+            rnd = _filename_round(base)
+            hint = False
+            rows = data if isinstance(data, list) else [data]
+        for row in rows:
+            if isinstance(row, dict) and row.get("metric"):
+                yield row, base, rnd, hint
+
+
+def iter_trace_rows(path: str):
+    """Yield ledger-shaped rows from a telemetry JSONL trace: one per
+    span carrying `per_sec` counters, metric `<span path>:<counter>`,
+    backend/config taken from the last manifest seen before the span
+    (the stream layout every producer follows)."""
+    base = os.path.basename(path)
+    backend, config = None, {}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                e = json.loads(line)
+            except ValueError:
+                continue
+            if e.get("kind") == "manifest":
+                backend = e.get("backend")
+                config = {k: v for k, v in (e.get("config") or {}).items()
+                          if isinstance(v, (str, int, float, bool))}
+            elif e.get("kind") == "span" and e.get("per_sec"):
+                for counter, rate in e["per_sec"].items():
+                    yield ({"metric": f"{e.get('path')}:{counter}_per_sec",
+                            "backend": backend, "value": rate,
+                            "unit": f"{counter}/sec",
+                            **{f"cfg_{k}": v for k, v in config.items()}},
+                           base)
+
+
+class Ledger:
+    """Append-only JSONL ledger with content-addressed dedup."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def records(self) -> list[dict]:
+        out = []
+        try:
+            with open(self.path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        out.append(json.loads(line))
+                    except ValueError:
+                        continue  # a torn line cannot happen (atomic
+                        # writes) but a hand-edited one must not wedge
+        except OSError:
+            pass
+        return out
+
+    def append(self, records) -> int:
+        """Append the not-yet-banked records (row_id dedup) and return
+        how many were new.  Existing lines are preserved verbatim —
+        the ledger is append-only by construction."""
+        try:
+            with open(self.path) as f:
+                existing = f.read()
+        except OSError:
+            existing = ""
+        seen = {r.get("row_id") for r in self.records()}
+        fresh = [r for r in records
+                 if r.get("row_id") and r["row_id"] not in seen]
+        if not fresh:
+            return 0
+        lines = "".join(json.dumps(r, sort_keys=True) + "\n"
+                        for r in fresh)
+        atomic_write_text(self.path, existing + lines)
+        return len(fresh)
+
+    def ingest_banks(self, root: str) -> int:
+        """Normalize + bank every `BENCH*.json` row under `root`;
+        idempotent (re-running adds nothing)."""
+        return self.append([
+            normalize_row(row, source=src, rnd=rnd, tail_hint=hint)
+            for row, src, rnd, hint in iter_bank_rows(root)])
+
+    def ingest_trace(self, path: str) -> int:
+        """Bank the span rates of one telemetry JSONL trace."""
+        return self.append([normalize_row(row, source=src)
+                            for row, src in iter_trace_rows(path)])

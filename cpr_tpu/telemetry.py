@@ -54,7 +54,7 @@ from contextlib import contextmanager
 from datetime import datetime, timezone
 from time import perf_counter as now  # noqa: F401 — re-exported
 
-SCHEMA_VERSION = 4
+SCHEMA_VERSION = 5
 TELEMETRY_ENV_VAR = "CPR_TELEMETRY"
 PROFILE_ENV_VAR = "CPR_PROFILE_DIR"
 CHECKIFY_ENV_VAR = "CPR_CHECKIFY"
@@ -86,6 +86,10 @@ EVENT_FIELDS = {
     # simulator (cpr_tpu/netsim); `drops` sums every capacity-overflow
     # counter, so a healthy run reports drops=0
     "netsim": ("protocol", "lanes", "activations", "steps", "drops"),
+    # v5: one per perf-regression gate (cpr_tpu/perf): verdict is
+    # pass|warn|fail|skip, baseline names the banked rows judged
+    # against (null when no same-backend history exists)
+    "perf_gate": ("metric", "backend", "verdict", "value", "baseline"),
 }
 
 

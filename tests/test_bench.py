@@ -35,6 +35,41 @@ def test_measure_config_guards():
         assert lo < check < hi, (name, check)
 
 
+def test_last_known_tpu_skips_outage_poisoned_banks(tmp_path):
+    """An outage-tagged row claiming backend "tpu" (banked during a
+    wedge) must never become the last-known-TPU context a fallback row
+    ships — the newest CLEAN round wins even when a poisoned newer
+    round exists."""
+    import json
+
+    def bank(name, n, row):
+        (tmp_path / name).write_text(json.dumps(
+            {"n": n, "tail": "", "parsed": row}))
+
+    bank("BENCH_r03.json", 3, {
+        "metric": "nakamoto_selfish_mining_env_steps_per_sec_per_chip",
+        "backend": "tpu", "value": 305_000_000,
+        "unit": "env-steps/sec/chip"})
+    bank("BENCH_r09.json", 9, {
+        "metric": "nakamoto_selfish_mining_env_steps_per_sec_per_chip",
+        "backend": "tpu", "value": 17, "unit": "env-steps/sec/chip",
+        "outage": True, "fallback_reason": "wedged backend"})
+    best = bench._last_known_tpu("nakamoto_selfish_mining",
+                                 root=str(tmp_path))
+    assert best is not None
+    assert best["round"] == 3 and best["value"] == 305_000_000
+    # error rows are just as ineligible
+    bank("BENCH_r10.json", 10, {
+        "metric": "nakamoto_selfish_mining_env_steps_per_sec_per_chip",
+        "backend": "tpu", "error": "guard failed"})
+    best = bench._last_known_tpu("nakamoto_selfish_mining",
+                                 root=str(tmp_path))
+    assert best["round"] == 3
+    # all-poisoned bank: no baseline rather than a poisoned one
+    assert bench._last_known_tpu("nakamoto_selfish_mining",
+                                 root=str(tmp_path / "empty")) is None
+
+
 def test_chunked_episode_stats_matches_unchunked():
     """The chunked stats driver (the axon per-call-ceiling workaround,
     JaxEnv.make_episode_stats_fn) must produce the same per-env stats

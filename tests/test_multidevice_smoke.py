@@ -1,0 +1,66 @@
+"""Virtual multi-device CPU smoke in a CHILD process (ROADMAP item 1).
+
+The in-suite multichip tests inherit the parent's 8-device virtual
+mesh; this one proves the CI story works from a cold start — a fresh
+process, `XLA_FLAGS=--xla_force_host_platform_device_count=4`, CPU
+forced programmatically (the axon PJRT plugin ignores JAX_PLATFORMS —
+the bench run_one lesson), 4 devices actually present, and the
+mesh-sharded value iteration agreeing with the single-device solve.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+_CHILD = textwrap.dedent("""
+    import json
+
+    import jax
+
+    # programmatic force: JAX_PLATFORMS alone does not stop the axon
+    # plugin from claiming the chip (see bench.run_one)
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from cpr_tpu.mdp import Compiler, ptmdp
+    from cpr_tpu.mdp.models import Fc16BitcoinSM
+    from cpr_tpu.parallel import sharded_value_iteration
+
+    devs = jax.devices()
+    c = Compiler(Fc16BitcoinSM(alpha=0.35, gamma=0.5,
+                               maximum_fork_length=5))
+    tm = ptmdp(c.mdp(), horizon=12).tensor()
+    mesh = Mesh(np.asarray(devs), ("d",))
+    vi = sharded_value_iteration(tm, mesh, stop_delta=1e-6,
+                                 impl="chunked", chunk=8)
+    single = tm.value_iteration(stop_delta=1e-6)
+    print(json.dumps({
+        "platform": devs[0].platform,
+        "device_count": len(devs),
+        "sharded": float(tm.start_value(vi["vi_value"])),
+        "single": float(tm.start_value(single["vi_value"])),
+    }))
+""")
+
+
+def test_four_virtual_devices_sharded_vi_parity():
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=4 "
+                  "--xla_backend_optimization_level=0",
+    )
+    proc = subprocess.run([sys.executable, "-c", _CHILD], env=env,
+                          cwd=REPO, capture_output=True, text=True,
+                          timeout=480)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = json.loads(proc.stdout.splitlines()[-1])
+    assert out["platform"] == "cpu"
+    assert out["device_count"] == 4, out
+    assert abs(out["sharded"] - out["single"]) < 1e-4, out

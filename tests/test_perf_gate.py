@@ -1,0 +1,332 @@
+"""Perf ledger + runtime regression gate (cpr_tpu/perf, PR 7).
+
+Pure-JSON tests: synthetic ledgers with seeded regressions, drifted
+configs, and outage-poisoned histories, plus the acceptance contract
+over the REAL tracked banks — `perf_report --gate` must exit zero on
+the current trail, and a CPU-fallback row must never be judged against
+a TPU baseline.
+"""
+
+import importlib.util
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from cpr_tpu import perf, telemetry  # noqa: E402
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+def _load_tool(name):
+    path = os.path.join(REPO, "tools", f"{name}.py")
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _row(metric="m_env_steps_per_sec_per_chip", backend="tpu",
+         value=100.0, rnd=None, source="synthetic", **extra):
+    """One synthetic ledger record (distinct rounds -> distinct
+    row_ids even at equal values)."""
+    return perf.normalize_row(
+        {"metric": metric, "backend": backend, "value": value, **extra},
+        source=source, rnd=rnd)
+
+
+# -- normalization over the real tracked banks --------------------------------
+
+
+def test_tracked_banks_normalize_with_outage_backfill():
+    """Every BENCH*.json row normalizes; the pre-tagging driver-round
+    CPU fallbacks (r02/r05 — their stderr tails record the backend
+    switch) come out outage-tagged, so they can never be baselines."""
+    rows = list(perf.iter_bank_rows(REPO))
+    assert rows, "tracked BENCH*.json banks missing"
+    recs = [perf.normalize_row(r, source=s, rnd=n, tail_hint=h)
+            for r, s, n, h in rows]
+    assert all(r["row_id"] and r["fingerprint"] for r in recs)
+    driver_cpu = [r for r in recs if r["backend"] == "cpu"
+                  and r["source"].startswith("BENCH_r")]
+    assert driver_cpu, "expected the banked CPU-fallback rounds"
+    assert all(r["outage"] for r in driver_cpu)
+    assert all(r["fallback_reason"] for r in driver_cpu)
+    tpu = [r for r in recs if r["backend"] == "tpu"]
+    assert tpu and not any(r["outage"] for r in tpu)
+
+
+def test_ledger_append_only_and_idempotent(tmp_path):
+    led = perf.Ledger(str(tmp_path / "ledger.jsonl"))
+    recs = [_row(value=v, rnd=i) for i, v in enumerate([100.0, 101.0,
+                                                        102.0])]
+    assert led.append(recs) == 3
+    assert led.append(recs) == 0  # content-addressed dedup
+    with open(led.path) as f:
+        before = f.read()
+    # a foreign line (hand-edit, older writer) survives appends verbatim
+    alien = json.dumps({"ledger": 1, "row_id": "feedc0ffee00",
+                        "metric": "hand_added"})
+    with open(led.path, "a") as f:
+        f.write(alien + "\n")
+    assert led.append([_row(value=103.0, rnd=9)]) == 1
+    with open(led.path) as f:
+        after = f.read()
+    assert after.startswith(before.rstrip("\n") + "\n")
+    assert alien in after
+    assert len(led.records()) == 5
+
+
+def test_ingest_banks_idempotent(tmp_path):
+    led = perf.Ledger(str(tmp_path / "l.jsonl"))
+    assert led.ingest_banks(REPO) > 0
+    assert led.ingest_banks(REPO) == 0
+
+
+# -- gate verdicts on synthetic histories -------------------------------------
+
+
+def test_gate_bands_on_quiet_history():
+    """MAD=0 history: the fractional floors are the band — warn below
+    -10%, fail below -25%, improvements always pass."""
+    hist = [_row(value=100.0, rnd=i) for i in range(5)]
+    for value, verdict in [(98.0, "pass"), (89.0, "warn"),
+                           (74.0, "fail"), (150.0, "pass")]:
+        res = perf.gate_row(_row(value=value), hist)
+        assert res["verdict"] == verdict, (value, res)
+    res = perf.gate_row(_row(value=74.0), hist)
+    assert res["baseline"]["median"] == 100.0
+    assert res["baseline"]["n"] == 5
+    assert not res["config_drift"]
+
+
+def test_noisy_history_widens_band():
+    """A trail that honestly fluctuates (the bk 15x improvement arc)
+    must not flag every fluctuation: the MAD term widens the band past
+    the fractional floor."""
+    hist = [_row(value=v, rnd=i)
+            for i, v in enumerate([100.0, 60.0, 140.0, 80.0, 120.0])]
+    assert perf.gate_row(_row(value=40.0), hist)["verdict"] == "pass"
+
+
+def test_outage_and_error_rows_never_baselines():
+    """Outage-poisoned history: fallback/error rows are excluded even
+    when their values would dominate the top-k pool."""
+    healthy = [_row(value=100.0, rnd=i) for i in range(3)]
+    poison = [_row(value=1000.0, rnd=10 + i, outage=True,
+                   fallback_reason="wedged backend") for i in range(2)]
+    poison.append(_row(value=2000.0, rnd=20, error="guard failed"))
+    res = perf.gate_row(_row(value=95.0), healthy + poison)
+    assert res["verdict"] == "pass"
+    assert res["baseline"]["median"] == 100.0
+    assert res["baseline"]["n"] == 3
+
+
+def test_cpu_fallback_never_judged_against_tpu_baseline():
+    """The acceptance contract: backends never mix.  An untagged CPU
+    row sees no baseline in an all-TPU history (first measurement); a
+    tagged fallback row is skipped outright."""
+    tpu_hist = [_row(value=3e8, rnd=i) for i in range(5)]
+    res = perf.gate_row(_row(backend="cpu", value=1e6), tpu_hist)
+    assert res["verdict"] == "pass"
+    assert res["baseline"] is None
+    assert "first measurement" in res["reason"]
+    res = perf.gate_row(
+        _row(backend="cpu", value=1e6, outage=True,
+             fallback_reason="tpu attempts unsuccessful"), tpu_hist)
+    assert res["verdict"] == "skip"
+    assert res["baseline"] is None
+
+
+def test_error_candidate_and_missing_value_skip():
+    hist = [_row(value=100.0, rnd=i) for i in range(3)]
+    assert perf.gate_row(_row(value=1.0, error="boom"),
+                         hist)["verdict"] == "skip"
+    res = perf.gate_row(
+        perf.normalize_row({"metric": "m_env_steps_per_sec_per_chip",
+                            "backend": "tpu"}), hist)
+    assert res["verdict"] == "skip"
+
+
+def test_config_drift_flagged_and_same_fingerprint_preferred():
+    hist = [_row(value=100.0, rnd=i, cfg_n_envs=8192) for i in range(3)]
+    res = perf.gate_row(_row(value=95.0, cfg_n_envs=4096), hist)
+    assert res["config_drift"] and res["verdict"] == "pass"
+    # once same-fingerprint history exists it wins over the drifted pool
+    mixed = hist + [_row(value=50.0, rnd=9, cfg_n_envs=4096)]
+    res = perf.gate_row(_row(value=48.0, cfg_n_envs=4096), mixed)
+    assert not res["config_drift"]
+    assert res["baseline"]["median"] == 50.0
+
+
+def test_gate_summary_counts():
+    hist = [_row(value=100.0, rnd=i) for i in range(5)]
+    results = [perf.gate_row(_row(value=v), hist)
+               for v in (98.0, 89.0, 74.0)]
+    results.append(perf.gate_row(
+        _row(backend="cpu", value=1.0, outage=True,
+             fallback_reason="x"), hist))
+    s = perf.gate_summary(results)
+    assert (s["pass"], s["warn"], s["fail"], s["skip"]) == (1, 1, 1, 1)
+    assert not s["ok"]
+
+
+# -- the typed perf_gate event (schema v5) ------------------------------------
+
+
+def test_gate_event_validates_and_renders(tmp_path, capsys):
+    """emit_gate_event round-trips trace_summary --validate --expect
+    perf_gate; dropping a declared v5 field is caught."""
+    ts = _load_tool("trace_summary")
+    trace = tmp_path / "t.jsonl"
+    try:
+        telemetry.configure(str(trace))
+        hist = [_row(value=100.0, rnd=i) for i in range(3)]
+        perf.emit_gate_event(perf.gate_row(_row(value=70.0), hist))
+    finally:
+        telemetry.configure(None)
+    with open(trace, "a") as f:
+        f.write(json.dumps({"kind": "manifest", "backend": "cpu",
+                            "schema": telemetry.SCHEMA_VERSION}) + "\n")
+    events, bad = ts.read_events(str(trace))
+    assert ts.validate(events, bad, expect=("perf_gate",)) == []
+    (ev,) = [e for e in events if e.get("name") == "perf_gate"]
+    assert ev["verdict"] == "fail"
+    assert all(k in ev for k in telemetry.EVENT_FIELDS["perf_gate"])
+    ts.main(["trace_summary", str(trace)])
+    out = capsys.readouterr().out
+    assert "perf gate" in out and "fail" in out
+
+    lame = tmp_path / "lame.jsonl"
+    lines = []
+    for line in trace.read_text().splitlines():
+        e = json.loads(line)
+        if e.get("name") == "perf_gate":
+            del e["verdict"]
+        lines.append(json.dumps(e))
+    lame.write_text("\n".join(lines) + "\n")
+    events, bad = ts.read_events(str(lame))
+    errors = ts.validate(events, bad)
+    assert any("perf_gate" in err and "verdict" in err for err in errors)
+    with pytest.raises(SystemExit) as exc:
+        ts.main(["trace_summary", str(lame), "--validate"])
+    assert exc.value.code == 1
+    capsys.readouterr()
+
+
+# -- perf_report: the CLI gate ------------------------------------------------
+
+
+def test_perf_report_gate_exits_zero_on_tracked_banks(capsys):
+    """Acceptance criterion: the gate passes the CURRENT banked trail —
+    the r02/r05 CPU-fallback rows surface as SKIP, never FAIL."""
+    pr = _load_tool("perf_report")
+    assert pr.main(["--root", REPO, "--gate"]) == 0
+    out = capsys.readouterr().out
+    assert "perf-gate: PASS" in out
+    assert "0 fail" in out
+    assert "SKIP" in out  # the banked fallback rows are visible, not gated
+
+
+def test_perf_report_seeded_regression_exits_nonzero(tmp_path, capsys):
+    led = perf.Ledger(str(tmp_path / "l.jsonl"))
+    hist = [_row(value=100.0 + i, rnd=i + 1) for i in range(5)]
+    # newest row (unknown round = live) seeded 60% below the trail
+    led.append(hist + [_row(value=40.0, source="zz_live")])
+    pr = _load_tool("perf_report")
+    assert pr.main([led.path, "--gate"]) == 1
+    out = capsys.readouterr().out
+    assert "FAIL" in out
+    # report-only mode surfaces the same verdict but exits zero
+    assert pr.main([led.path]) == 0
+    capsys.readouterr()
+
+
+def test_perf_report_since_metric_filters_and_markdown(tmp_path, capsys):
+    import argparse
+
+    led = perf.Ledger(str(tmp_path / "l.jsonl"))
+    led.append([
+        _row(metric="aaa_env_steps_per_sec_per_chip", value=100.0, rnd=1),
+        _row(metric="aaa_env_steps_per_sec_per_chip", value=101.0, rnd=4),
+        _row(metric="bbb_env_steps_per_sec_per_chip", value=5.0, rnd=4),
+    ])
+    pr = _load_tool("perf_report")
+    ns = argparse.Namespace(ledger=led.path, root=REPO, trace=None,
+                            since=3, metric="aaa")
+    recs = pr.load_records(ns)
+    assert {r["round"] for r in recs} == {4}
+    assert {r["metric"] for r in recs} == {
+        "aaa_env_steps_per_sec_per_chip"}
+    md = tmp_path / "report.md"
+    assert pr.main([led.path, "--metric", "aaa",
+                    "--markdown", str(md)]) == 0
+    out = capsys.readouterr().out
+    assert "aaa_env" in out and "bbb_env" not in out
+    text = md.read_text()
+    assert "Perf ledger report" in text and "aaa_env" in text
+    # no rows at all: usage-style exit, not a silent pass
+    assert pr.main([str(tmp_path / "nope.jsonl")]) == 2
+    capsys.readouterr()
+
+
+def test_perf_report_reads_trace_rates(tmp_path, capsys):
+    """--trace lifts span per_sec counters into the same trend surface
+    (backend/config from the preceding manifest)."""
+    trace = tmp_path / "run.jsonl"
+    trace.write_text(
+        json.dumps({"kind": "manifest", "backend": "cpu",
+                    "config": {"n_envs": 64}}) + "\n"
+        + json.dumps({"kind": "span", "path": "bench:nakamoto_sm1",
+                      "per_sec": {"env_steps": 123456.0}}) + "\n")
+    rows = list(perf.iter_trace_rows(str(trace)))
+    assert rows
+    rec = perf.normalize_row(rows[0][0], source=rows[0][1])
+    assert rec["metric"] == "bench:nakamoto_sm1:env_steps_per_sec"
+    assert rec["backend"] == "cpu"
+    assert rec["config"].get("cfg_n_envs") == 64
+    pr = _load_tool("perf_report")
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text("")
+    assert pr.main([str(empty), "--trace", str(trace)]) == 0
+    out = capsys.readouterr().out
+    assert "env_steps_per_sec" in out
+
+
+# -- bank_and_gate: the bench's self-gate entry point -------------------------
+
+
+def test_bank_and_gate_roundtrip(tmp_path, monkeypatch):
+    """One call banks the row (idempotently) and returns the verdict
+    against the banked history under `root`."""
+    root = tmp_path
+    bank = [{"metric": "bk8_withholding_env_steps_per_sec_per_chip",
+             "backend": "tpu", "value": 500000 + 1000 * i,
+             "unit": "env-steps/sec/chip", "cfg_n_envs": 8192}
+            for i in range(3)]
+    (root / "BENCH_CONFIGS_tpu_r03.json").write_text(json.dumps(bank))
+    monkeypatch.delenv(perf.LEDGER_ENV_VAR, raising=False)
+    row = dict(bank[0], value=498000)
+    res = perf.bank_and_gate(row, root=str(root))
+    assert res["verdict"] == "pass"
+    assert res["baseline"]["n"] == 3
+    led = perf.Ledger(perf.default_ledger_path(str(root)))
+    assert led.path.startswith(str(root))
+    n_after_first = len(led.records())
+    assert n_after_first == 4  # 3 banked + the live row
+    # same row again: ledger unchanged (dedup), verdict stable
+    res2 = perf.bank_and_gate(row, root=str(root))
+    assert res2["verdict"] == "pass"
+    assert len(led.records()) == n_after_first
+    # a seeded regression against the same bank fails
+    res3 = perf.bank_and_gate(dict(row, value=100000), root=str(root))
+    assert res3["verdict"] == "fail"
+    # an outage fallback row banks but is never judged
+    res4 = perf.bank_and_gate(
+        dict(row, backend="cpu", value=900, outage=True,
+             fallback_reason="tpu attempts unsuccessful"),
+        root=str(root))
+    assert res4["verdict"] == "skip"

@@ -1,0 +1,209 @@
+"""Perf-ledger trend report + runtime regression gate (cpr_tpu/perf).
+
+Reads the banked bench trail — either a persisted ledger JSONL or the
+tracked `BENCH*.json` banks directly — and renders per-metric trend
+tables plus a gate verdict per metric x backend: the newest banked row
+is judged against the best earlier same-backend rows (median/MAD band,
+outage/error rows never baselines; see docs/OBSERVABILITY.md).
+
+    python tools/perf_report.py                      # tracked banks
+    python tools/perf_report.py runs/perf_ledger.jsonl
+    python tools/perf_report.py --gate               # nonzero on fail
+    python tools/perf_report.py --since 3 --metric nakamoto
+    python tools/perf_report.py --markdown runs/perf_report.md
+    python tools/perf_report.py --trace /tmp/run.jsonl   # + span rates
+    make perf-gate                                   # CI entry point
+
+Exit codes: 0 = no failed gate (warn/skip/pass), 1 = at least one
+`fail` verdict in --gate mode, 2 = usage error.  To bless an
+intentional perf change (a config move, an accepted slowdown), bank
+the new row — once it is the newest banked round it IS the candidate,
+and future gates judge against the best history including it; the
+verdict band is against best-banked, so a blessed slower row only
+stops gating once the old fast rows age past --since or the config
+fingerprint moves.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from cpr_tpu import perf  # noqa: E402
+from cpr_tpu.resilience import atomic_write_text  # noqa: E402
+
+
+def _round_rank(rec):
+    """Sort key placing unknown-round rows (the suffix-less current
+    bank, live bench rows) AFTER every numbered round — they are the
+    most recent state of the trail."""
+    rnd = rec.get("round")
+    return (1, 0) if rnd is None else (0, rnd)
+
+
+def load_records(args) -> list[dict]:
+    records = []
+    if args.ledger:
+        records.extend(perf.Ledger(args.ledger).records())
+    else:
+        records.extend(
+            perf.normalize_row(row, source=src, rnd=rnd, tail_hint=hint)
+            for row, src, rnd, hint in perf.iter_bank_rows(args.root))
+    for trace in args.trace or ():
+        records.extend(perf.normalize_row(row, source=src)
+                       for row, src in perf.iter_trace_rows(trace))
+    if args.since is not None:
+        records = [r for r in records
+                   if r.get("round") is None or r["round"] >= args.since]
+    if args.metric:
+        records = [r for r in records
+                   if str(r.get("metric", "")).startswith(args.metric)]
+    return records
+
+
+def gate_all(records) -> list[dict]:
+    """One gate per metric x backend: newest row (by round, unknown
+    rounds newest) is the candidate, everything earlier the history."""
+    groups = {}
+    for r in records:
+        groups.setdefault((r.get("metric"), r.get("backend")), []).append(r)
+    results = []
+    for key in sorted(groups, key=lambda k: (str(k[0]), str(k[1]))):
+        rows = sorted(groups[key],
+                      key=lambda r: (_round_rank(r), str(r.get("source"))))
+        candidate = rows[-1]
+        history = [r for r in records if r is not candidate]
+        results.append(perf.gate_row(candidate, history))
+    return results
+
+
+def _fmt_val(v):
+    if v is None:
+        return "-"
+    return f"{v:,.0f}" if abs(v) >= 1000 else f"{v:.4g}"
+
+
+def _flags(rec):
+    out = []
+    if rec.get("outage"):
+        out.append("outage")
+    if rec.get("error"):
+        out.append("error")
+    return ",".join(out)
+
+
+def trend_lines(records):
+    yield (f"{'metric':<44} {'backend':<7} {'round':>5} {'value':>14} "
+           f"{'check':>8} {'source':<26} flags")
+    key = lambda r: (str(r.get("metric")), str(r.get("backend")),  # noqa: E731
+                     _round_rank(r), str(r.get("source")))
+    for r in sorted(records, key=key):
+        rnd = "-" if r.get("round") is None else r["round"]
+        check = "-" if r.get("check") is None else f"{r['check']:.4g}"
+        yield (f"{r.get('metric', '?'):<44} {str(r.get('backend')):<7} "
+               f"{rnd:>5} {_fmt_val(r.get('value')):>14} {check:>8} "
+               f"{str(r.get('source')):<26} {_flags(r)}")
+
+
+def gate_lines(results):
+    for res in results:
+        base = res.get("baseline")
+        against = ("" if base is None else
+                   f" median={_fmt_val(base['median'])} "
+                   f"best={_fmt_val(base['best'])}"
+                   f"@{base.get('best_source')} n={base['n']}")
+        drift = " [config-drift]" if res.get("config_drift") else ""
+        yield (f"gate: {res['metric']} [{res['backend']}] "
+               f"{res['verdict'].upper()}{drift} "
+               f"value={_fmt_val(res['value'])}{against}")
+        if res["verdict"] != "pass":
+            yield f"      {res['reason']}"
+
+
+def markdown_report(records, results, summary) -> str:
+    lines = ["# Perf ledger report", "",
+             f"{len(records)} ledger rows; gate: "
+             f"{summary['fail']} fail / {summary['warn']} warn / "
+             f"{summary['pass']} pass / {summary['skip']} skip", "",
+             "## Gate verdicts", "",
+             "| metric | backend | verdict | value | baseline median | "
+             "best (source) |", "|---|---|---|---|---|---|"]
+    for res in results:
+        base = res.get("baseline")
+        med = "-" if base is None else _fmt_val(base["median"])
+        best = ("-" if base is None else
+                f"{_fmt_val(base['best'])} ({base.get('best_source')})")
+        drift = " (config drift)" if res.get("config_drift") else ""
+        lines.append(f"| {res['metric']} | {res['backend']} | "
+                     f"{res['verdict']}{drift} | {_fmt_val(res['value'])} "
+                     f"| {med} | {best} |")
+    lines += ["", "## Banked trail", "",
+              "| metric | backend | round | value | check | source | "
+              "flags |", "|---|---|---|---|---|---|---|"]
+    key = lambda r: (str(r.get("metric")), str(r.get("backend")),  # noqa: E731
+                     _round_rank(r), str(r.get("source")))
+    for r in sorted(records, key=key):
+        rnd = "-" if r.get("round") is None else r["round"]
+        check = "-" if r.get("check") is None else f"{r['check']:.4g}"
+        lines.append(f"| {r.get('metric', '?')} | {r.get('backend')} | "
+                     f"{rnd} | {_fmt_val(r.get('value'))} | {check} | "
+                     f"{r.get('source')} | {_flags(r) or '-'} |")
+    return "\n".join(lines) + "\n"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="perf_report", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("ledger", nargs="?",
+                    help="ledger JSONL to read (default: scan the "
+                         "tracked BENCH*.json banks under --root)")
+    ap.add_argument("--root", default=REPO,
+                    help="artifact root holding the BENCH*.json banks")
+    ap.add_argument("--trace", action="append", metavar="JSONL",
+                    help="also lift span rates from a telemetry trace; "
+                         "repeatable")
+    ap.add_argument("--gate", action="store_true",
+                    help="exit 1 when any metric's newest row FAILS "
+                         "against its banked same-backend baseline")
+    ap.add_argument("--since", type=int, metavar="ROUND",
+                    help="only rows banked at round >= ROUND "
+                         "(unknown-round rows are kept)")
+    ap.add_argument("--metric", metavar="PREFIX",
+                    help="only metrics starting with PREFIX")
+    ap.add_argument("--markdown", metavar="FILE",
+                    help="also write the report as markdown (atomic)")
+    args = ap.parse_args(argv)
+
+    try:
+        records = load_records(args)
+    except OSError as e:
+        print(f"perf_report: {e}", file=sys.stderr)
+        return 2
+    if not records:
+        print("perf_report: no ledger rows matched", file=sys.stderr)
+        return 2 if not args.gate else 1
+    results = gate_all(records)
+    summary = perf.gate_summary(results)
+
+    for line in trend_lines(records):
+        print(line)
+    print()
+    for line in gate_lines(results):
+        print(line)
+    print(f"perf-gate: {'PASS' if summary['ok'] else 'FAIL'} "
+          f"({summary['fail']} fail, {summary['warn']} warn, "
+          f"{summary['pass']} pass, {summary['skip']} skip)")
+    if args.markdown:
+        atomic_write_text(args.markdown,
+                          markdown_report(records, results, summary))
+        print(f"perf_report: wrote {args.markdown}", file=sys.stderr)
+    return 0 if (summary["ok"] or not args.gate) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -117,6 +117,7 @@ def summarize(events, out=sys.stdout):
     _device_metrics_tables(events, out)
     _vi_residuals_lines(events, out)
     _resilience_lines(events, out)
+    _perf_gate_lines(events, out)
     for m in (e for e in events if e.get("kind") == "manifest"):
         cfg = m.get("config") or {}
         print(f"\nmanifest: backend={m.get('backend')} "
@@ -124,7 +125,7 @@ def summarize(events, out=sys.stdout):
               f"jax={m.get('jax_version')} git={str(m.get('git_sha'))[:12]} "
               f"config={json.dumps(cfg, sort_keys=True)}", file=out)
     tabled = ("compile", "device_metrics", "vi_residuals", "retry",
-              "checkpoint")
+              "checkpoint", "perf_gate")
     for e in (e for e in events if e.get("kind") == "event"
               and e.get("name") not in tabled):
         keys = {k: v for k, v in e.items() if k not in ("kind", "ts")}
@@ -205,6 +206,26 @@ def _resilience_lines(events, out):
     if ckpts:
         kinds = " ".join(f"{k}={n}" for k, n in sorted(ckpts.items()))
         print(f"\ncheckpoints written: {kinds}", file=out)
+
+
+def _perf_gate_lines(events, out):
+    """Schema-v5 perf-gate verdicts (cpr_tpu/perf): one line per gate,
+    baseline median alongside the judged value so a WARN/FAIL is
+    self-explanatory without opening the ledger."""
+    gates = [e for e in events if e.get("kind") == "event"
+             and e.get("name") == "perf_gate"]
+    if not gates:
+        return
+    print(f"\n{'perf gate metric':<44} {'backend':<7} {'verdict':<7} "
+          f"{'value':>14} {'baseline med':>14}", file=out)
+    for e in gates:
+        base = e.get("baseline") or {}
+        med = base.get("median") if isinstance(base, dict) else None
+        fmt = lambda v: ("-" if not isinstance(v, (int, float))  # noqa: E731
+                         else f"{v:,.0f}")
+        print(f"{str(e.get('metric')):<44} {str(e.get('backend')):<7} "
+              f"{str(e.get('verdict')):<7} {fmt(e.get('value')):>14} "
+              f"{fmt(med):>14}", file=out)
 
 
 def main(argv):
