@@ -4,7 +4,7 @@
 
 CPU_MESH = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: lint test test-slow bench perf-gate telemetry-smoke netsim-smoke resilience-smoke dryrun sweeps ghostdag train-dummy native asan
+.PHONY: lint test test-slow bench perf-gate telemetry-smoke netsim-smoke resilience-smoke supervisor-smoke dryrun sweeps ghostdag train-dummy native asan
 
 lint:  ## jaxlint over cpr_tpu/ + tools/ (pure AST, no JAX import,
 	## ~1s); banks the JSON report under runs/ like the smoke flows
@@ -73,6 +73,16 @@ resilience-smoke:  ## kill-and-resume determinism proof: tiny CPU train,
 	## the schema-v3 resilience telemetry events
 	rm -rf $(RESILIENCE_SMOKE_DIR)
 	python tools/resilience_smoke.py $(RESILIENCE_SMOKE_DIR)
+
+SUPERVISOR_SMOKE_DIR = /tmp/cpr-supervisor-smoke
+
+supervisor-smoke:  ## supervised-subprocess proof: injected hang@probe
+	## (ProbeFailure bounded by probe_timeout) and hang@run (heartbeat
+	## stall < 60s, exactly one probe-gated warm restart, escalation),
+	## then a clean terminal-rung run and schema validation of the
+	## typed v6 `supervisor` event trail
+	rm -rf $(SUPERVISOR_SMOKE_DIR)
+	python tools/supervisor_smoke.py $(SUPERVISOR_SMOKE_DIR)
 
 dryrun:  ## multi-chip sharding dry run on the virtual CPU mesh
 	$(CPU_MESH) python -c "from __graft_entry__ import dryrun_multichip; dryrun_multichip(8)"

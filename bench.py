@@ -9,25 +9,25 @@ chip alone beats the 8-chip goal. The reference publishes no numbers
 (BASELINE.md), so the north star is the only fixed point.
 
 Robustness: a faulted axon backend can HANG rather than raise (observed
-when a large kernel crashed the device), so the TPU attempt runs in a
-watchdog subprocess; on timeout or failure the parent falls back to CPU
-in-process — a number with a visible backend tag always gets printed.
+when a large kernel crashed the device), so every TPU attempt runs
+under `cpr_tpu/supervisor` — heartbeat-watchdogged child, bounded
+probe-before-run, probe-gated warm restart — and on escalation the
+parent falls back to CPU in-process, so a number with a visible
+backend tag always gets printed.
 """
 
 import glob
 import json
 import os
 import re
-import subprocess
 import sys
 import time
 
-from cpr_tpu import device_metrics, telemetry
+from cpr_tpu import device_metrics, supervisor, telemetry
 # GuardFailure moved to the shared resilience layer (same taxonomy as
 # the training/VI retry paths); re-exported here so bench.GuardFailure
 # keeps working for callers and the GUARD_RC child protocol
-from cpr_tpu.resilience import (GuardFailure, TransientFault,
-                                default_classify, with_retries)
+from cpr_tpu.resilience import GuardFailure, TransientFault
 
 
 # v5e (TPU v5 lite) single-chip peaks for the roofline fields: bf16
@@ -317,45 +317,22 @@ SM1_GUARD = (0.38, 0.45)
 GUARD_RC = 3
 
 
-class BenchHang(TransientFault):
-    """Child hung past the watchdog.  Transient in the taxonomy, but
-    `_bench_classify` refuses a same-rung retry: a hang means a wedged
-    device, handled by ladder descent / the straight-to-CPU policy,
-    never by probing the wedged rung again."""
-
-    pass
+def _child_cmd(mode: str, extra=None) -> list:
+    """Command line for one bench child (this file, child mode)."""
+    return ([sys.executable, os.path.abspath(__file__), mode]
+            + (extra or []))
 
 
-def _bench_classify(exc: BaseException) -> bool:
-    """Retry classifier for the child-process protocol: GuardFailure is
-    deterministic (shared rule), a hang escalates instead of retrying,
-    any other child failure is a transient chip claim worth one paused
+def _supervisor_config(timeout: float, **kw) -> "supervisor.SupervisorConfig":
+    """The bench's supervision policy, CPR_SUPERVISOR_* overridable:
+    GUARD_RC children are GuardFailure (never retried, never masked —
+    the invariant that device faults cannot masquerade as guard
+    failures lives in the GUARD_RC exit path of run_one/main), a hang
+    or heartbeat stall earns at most one probe-gated warm restart, any
+    other child failure is a transient chip claim worth one paused
     re-attempt."""
-    if isinstance(exc, BenchHang):
-        return False
-    return default_classify(exc)
-
-
-def _attempt_raising(timeout: float, mode: str = "--direct", extra=None,
-                     env_extra=None) -> str:
-    """`_attempt` with the child's exit status mapped onto the shared
-    failure taxonomy, so `with_retries(_bench_classify)` is the single
-    place deciding what gets retried: rc == GUARD_RC -> GuardFailure
-    (never retried — the invariant that device faults cannot masquerade
-    as guard failures lives in the GUARD_RC exit path of run_one/main),
-    hang -> BenchHang, any other nonzero rc -> TransientFault (.rc
-    carries the code).  Returns the child's JSON lines on success."""
-    status, payload = _attempt(timeout, mode, extra=extra,
-                               env_extra=env_extra)
-    if status == "ok":
-        return payload
-    if status == "failed" and payload == GUARD_RC:
-        raise GuardFailure("child exited GUARD_RC (correctness guard)")
-    if status == "hung":
-        raise BenchHang(f"hung past {timeout:.0f}s watchdog")
-    fault = TransientFault(f"rc={payload}")
-    fault.rc = payload
-    raise fault
+    return supervisor.SupervisorConfig.from_env(
+        wall_timeout_s=timeout, **kw)
 
 
 def _cpu_baseline(name: str):
@@ -497,12 +474,14 @@ def run_bench(platform_hint: str, fallback_reason: str | None = None):
     """Measure and print the JSON line on whatever backend comes up.
     `fallback_reason` (set by main()'s watchdog when the TPU attempts
     died) tags the row as a chip outage rather than a regression."""
-    import jax
+    supervisor.maybe_start_heartbeat()
+    with supervisor.child_phase("init"):
+        import jax
 
-    if platform_hint == "cpu":
-        jax.config.update("jax_platforms", "cpu")
-    _apply_prng_choice()
-    devs = jax.devices()
+        if platform_hint == "cpu":
+            jax.config.update("jax_platforms", "cpu")
+        _apply_prng_choice()
+        devs = jax.devices()
     platform = devs[0].platform
     print(f"bench: backend={platform} devices={len(devs)}",
           file=sys.stderr)
@@ -536,6 +515,10 @@ def run_bench(platform_hint: str, fallback_reason: str | None = None):
            if platform != "cpu" else {}),
         **(_outage_fields(fallback_reason, "nakamoto_selfish_mining")
            if fallback_reason is not None else {}),
+        # a row measured after a warm restart carries the count so the
+        # perf ledger can tag it (CPR_SUPERVISOR_RESTART, parent-set)
+        **({"restart_count": supervisor.restart_count()}
+           if supervisor.restart_count() else {}),
         "manifest": manifest,
     }
     print(json.dumps(row))
@@ -610,6 +593,9 @@ def _measure_config(name: str, platform: str, n_envs_override=None):
         **(_roofline_utilization(extras, rate)
            if platform != "cpu" else {}),
         **{f"cfg_{k}": v for k, v in kw.items()},
+        # see run_bench: post-warm-restart rows self-tag for the ledger
+        **({"restart_count": supervisor.restart_count()}
+           if supervisor.restart_count() else {}),
         "manifest": manifest,
     }
 
@@ -648,12 +634,14 @@ def run_one(name: str):
     ethereum kernel faulted the TPU and took bk's result down with it).
     CPU is forced via jax.config, not JAX_PLATFORMS: the axon PJRT
     plugin claims the chip regardless of that env var (observed)."""
-    import jax
+    supervisor.maybe_start_heartbeat()
+    with supervisor.child_phase("init"):
+        import jax
 
-    if os.environ.get("CPR_BENCH_BACKEND") == "cpu":
-        jax.config.update("jax_platforms", "cpu")
-    _apply_prng_choice()
-    platform = jax.devices()[0].platform
+        if os.environ.get("CPR_BENCH_BACKEND") == "cpu":
+            jax.config.update("jax_platforms", "cpu")
+        _apply_prng_choice()
+        platform = jax.devices()[0].platform
     print(f"bench-one: {name} backend={platform}", file=sys.stderr)
     override = os.environ.get("CPR_BENCH_NENVS")
     # the override is a TPU ladder size — never apply it to a CPU
@@ -683,9 +671,20 @@ CONFIG_DESCENT = {
 
 
 def run_configs_isolated(timeout: float):
-    """Parent mode for configs 2-4 on TPU: one watchdogged subprocess
-    per config x ladder rung, CPU fallback per config, all rows written
-    to BENCH_CONFIGS.json with their own backend tags.
+    """Parent mode for configs 2-4 on TPU: one supervised subprocess
+    per config x ladder rung (cpr_tpu/supervisor: probe-before-run,
+    heartbeat stall detection, probe-gated warm restart), CPU fallback
+    per config, all rows written to BENCH_CONFIGS.json with their own
+    backend tags.
+
+    A hang no longer wedges the whole loop: the old one-strike flag
+    skipped the TPU for every remaining config after a final-rung hang,
+    even when earlier configs had already measured on chip.  Now the
+    failing config records its partial result / CPU fallback and the
+    NEXT config's probe-before-run decides whether the device is worth
+    committing to — a recovered worker keeps measuring, a truly wedged
+    one costs ~probe_timeout per remaining config instead of a full
+    round each.
 
     Worker-health context: rows measured within ~2-5 min of a worker
     crash read 2-5x slow (round-3 session log), so every row is stamped
@@ -694,24 +693,22 @@ def run_configs_isolated(timeout: float):
     masquerade as a regression in later comparisons."""
     out = []
     last_fault_ts = None  # any failed/hung child attempt this run
-    wedged = False  # one hang means a wedged device: stop probing it
     for name, spec in CONFIGS.items():
         ladder = (spec["tpu"]["n_envs"],) + CONFIG_DESCENT.get(name, ())
         row, cpu_row, last = None, None, "no attempt"
-        guard_failed, stop = False, wedged
-        if wedged:
-            last = "device wedged by an earlier config"
-        for n_envs in () if stop else ladder:
-            # Every rung gets one same-rung retry (with_retries
-            # max_attempts=2): no rung is a known crasher anymore (the
-            # 65536 ethereum shape was dropped from the ladder), so
-            # non-hang failures are transient chip claims (single-rung
-            # configs: brief pause) or a recovering worker after a
-            # crash (multi-rung ladders: observed 60 s insufficient
-            # post-crash, twice — wait longer).  Classification lives
-            # in _bench_classify: GuardFailure and hangs never burn the
-            # same-rung retry.
+        guard_failed = False
+        for n_envs in ladder:
+            # Every rung gets one same-rung transient retry: no rung is
+            # a known crasher anymore (the 65536 ethereum shape was
+            # dropped from the ladder), so non-hang failures are
+            # transient chip claims (single-rung configs: brief pause)
+            # or a recovering worker after a crash (multi-rung ladders:
+            # observed 60 s insufficient post-crash, twice — wait
+            # longer).  Hangs/stalls additionally earn one probe-gated
+            # warm restart inside supervise; GuardFailure never burns
+            # any retry.
             pause = 15.0 if len(ladder) == 1 else 120.0
+            rung_cfg = _supervisor_config(timeout, retry_pause_s=pause)
 
             def _note_fault(attempt, exc, delay, _name=name, _n=n_envs):
                 nonlocal last_fault_ts
@@ -720,14 +717,11 @@ def run_configs_isolated(timeout: float):
                       file=sys.stderr)
 
             try:
-                payload = with_retries(
-                    lambda: _attempt_raising(
-                        timeout, "--direct-one", extra=[name],
-                        env_extra={"CPR_BENCH_NENVS": str(n_envs)}),
-                    classify=_bench_classify, max_attempts=2,
-                    base_delay_s=pause, max_delay_s=pause,
-                    jitter_frac=0.0, on_retry=_note_fault,
-                    name=f"bench:{name}")
+                outcome = supervisor.supervise(
+                    _child_cmd("--direct-one", [name]),
+                    site=f"bench:{name}", config=rung_cfg,
+                    env=dict(os.environ, CPR_BENCH_NENVS=str(n_envs)),
+                    guard_rc=GUARD_RC, on_retry=_note_fault)
             except GuardFailure:
                 # deterministic correctness failure: no retry, no
                 # descent, and no CPU run to paper over it — surface
@@ -735,9 +729,20 @@ def run_configs_isolated(timeout: float):
                 # stderr names what actually ran)
                 last = ("correctness guard failed "
                         f"(requested n_envs={n_envs})")
-                guard_failed = stop = True
+                guard_failed = True
                 break
-            except BenchHang:
+            except supervisor.ProbeFailure as e:
+                # the device is not even answering a tiny jit — no
+                # point burning this config's wall budget; straight to
+                # the CPU fallback.  The NEXT config re-probes, so a
+                # recovery is picked up without a wedged-device flag.
+                last = f"device probe failed ({e})"
+                last_fault_ts = telemetry.now()
+                print(f"bench: {name} n_envs={n_envs} {last}",
+                      file=sys.stderr)
+                break
+            except supervisor.SupervisedHang:
+                # hang/stall with the warm-restart budget exhausted
                 last = "hung past watchdog"
                 last_fault_ts = telemetry.now()
                 print(f"bench: {name} n_envs={n_envs} {last}",
@@ -752,10 +757,8 @@ def run_configs_isolated(timeout: float):
                           file=sys.stderr)
                     time.sleep(120.0)
                     continue
-                # hang at the final rung: treat as a wedged device —
-                # straight to CPU (main()'s policy), for this and all
-                # remaining configs
-                wedged = stop = True
+                # final-rung hang: CPU fallback for THIS config only —
+                # the next config's probe decides about the device
                 break
             except TransientFault as e:
                 last = f"rc={e.rc}" if hasattr(e, "rc") else str(e)
@@ -768,29 +771,32 @@ def run_configs_isolated(timeout: float):
                     # CPU fallback, which does not touch the worker
                     time.sleep(pause)
                 continue
-            cand = json.loads(payload.splitlines()[-1])
+            cand = json.loads(outcome.payload.splitlines()[-1])
             if cand.get("backend") == "cpu":
                 # chip-claim race: the child came up on CPU.  Not a
                 # ladder success, but it IS a valid CPU fallback row —
                 # keep it, stop probing.
                 last, cpu_row = "backend came up cpu", cand
-                stop = True
             else:
                 row = cand
             break
         if row is None and cpu_row is None and not guard_failed:
-            status, payload = _attempt(
-                timeout, "--direct-one", extra=[name],
-                env_extra={"CPR_BENCH_BACKEND": "cpu"})
-            if status == "ok":
-                cpu_row = json.loads(payload.splitlines()[-1])
-            elif status == "failed" and payload == GUARD_RC:
+            # CPU rung: wall-clock watchdog only (the CPU child forces
+            # jax_platforms=cpu, so there is no device to stall on and
+            # nothing for a probe to prove)
+            a = supervisor.run_child(
+                _child_cmd("--direct-one", [name]),
+                wall_timeout_s=timeout, quiet_s=None,
+                env=dict(os.environ, CPR_BENCH_BACKEND="cpu"))
+            if a.status == "ok" and a.json_lines:
+                cpu_row = json.loads(a.json_lines[-1])
+            elif a.status == "failed" and a.rc == GUARD_RC:
                 guard_failed = True
                 last = f"{last}; then correctness guard failed on cpu"
+            elif a.status in ("hung", "stalled"):
+                last = f"{last}; then cpu fallback hung past watchdog"
             else:
-                last = (f"{last}; then cpu fallback "
-                        + (f"rc={payload}" if status == "failed"
-                           else "hung past watchdog"))
+                last = f"{last}; then cpu fallback rc={a.rc}"
         if row is None:
             # outage tagging is for device unavailability only — a
             # deterministic guard failure must stay a loud error row,
@@ -817,35 +823,6 @@ def run_configs_isolated(timeout: float):
     _write_configs_json(out)
 
 
-def _attempt(timeout: float, mode: str = "--direct", extra=None,
-             env_extra=None):
-    """One watchdog-bounded child run.  Returns ("ok", json_lines),
-    ("failed", rc), or ("hung", None).  Manual Popen because
-    subprocess.run's post-kill wait() is untimed — a child stuck in
-    uninterruptible device I/O would hang the parent forever."""
-    env = dict(os.environ, **(env_extra or {}))
-    proc = subprocess.Popen(
-        [sys.executable, os.path.abspath(__file__), mode] + (extra or []),
-        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-        env=env)
-    try:
-        out, err = proc.communicate(timeout=timeout)
-    except subprocess.TimeoutExpired:
-        proc.kill()
-        try:
-            out, err = proc.communicate(timeout=10)
-        except subprocess.TimeoutExpired:
-            # unkillable (D-state on the device fd): abandon the child
-            out, err = "", ""
-        sys.stderr.write(err or "")
-        return "hung", None
-    sys.stderr.write(err or "")
-    lines = [ln for ln in (out or "").splitlines() if ln.startswith("{")]
-    if proc.returncode == 0 and lines:
-        return "ok", "\n".join(lines)
-    return "failed", proc.returncode
-
-
 def main():
     _prng_choice()  # fail fast on a bad override, before any attempts
     configs_mode = "--configs" in sys.argv
@@ -868,10 +845,11 @@ def main():
     if os.environ.get("CPR_BENCH_BACKEND") == "cpu":
         run_configs("cpu") if configs_mode else run_bench("cpu")
         return
-    # watchdog: try the TPU in a subprocess so a hung backend cannot
-    # stall this process past the driver's patience; a clean failure
-    # (e.g. transiently claimed chip) gets one paused retry, a hang
-    # (wedged device) goes straight to CPU
+    # supervised TPU attempt (cpr_tpu/supervisor): probe-before-run so
+    # a wedged chip costs ~probe_timeout, heartbeat stall detection so
+    # a wedge mid-run is caught in seconds, one probe-gated warm
+    # restart, one paused retry for transient child failures;
+    # GuardFailure is never retried and never masked by a CPU run
     timeout = float(os.environ.get("CPR_BENCH_TPU_TIMEOUT", "360"))
     if configs_mode:
         # chunked ethereum legitimately runs ~100 s/rep at 16384 envs:
@@ -879,19 +857,13 @@ def main():
         # and a merely-slow config must not be classified as a wedge
         run_configs_isolated(timeout * 2)
         return
-    # shared retry protocol (cpr_tpu/resilience.py): one paused retry
-    # for transient child failures; GuardFailure is never retried and
-    # never masked by a CPU run; a hang skips the retry entirely —
-    # wedged devices go straight to CPU
     fallback_reason = "tpu attempts failed"
     try:
-        print(with_retries(
-            lambda: _attempt_raising(timeout, "--direct"),
-            classify=_bench_classify, max_attempts=2,
-            base_delay_s=15.0, max_delay_s=15.0, jitter_frac=0.0,
+        print(supervisor.supervise(
+            _child_cmd("--direct"), site="bench",
+            config=_supervisor_config(timeout), guard_rc=GUARD_RC,
             on_retry=lambda a, e, d: print(
-                f"bench: TPU attempt {a} {e}", file=sys.stderr),
-            name="bench"))
+                f"bench: TPU attempt {a} {e}", file=sys.stderr)).payload)
         return
     except GuardFailure:
         # deterministic correctness-guard failure on the TPU: print an
@@ -902,11 +874,14 @@ def main():
             "error": "correctness guard failed on tpu backend",
         }))
         return
-    except BenchHang:
-        print(f"bench: TPU attempt hung past {timeout:.0f}s (wedged "
-              f"backend?), falling back to CPU", file=sys.stderr)
-        fallback_reason = (f"tpu watchdog timeout after {timeout:.0f}s "
-                           f"(wedged backend?)")
+    except supervisor.ProbeFailure as e:
+        print(f"bench: device probe failed ({e}), falling back to CPU",
+              file=sys.stderr)
+        fallback_reason = f"device probe failed ({e})"
+    except supervisor.SupervisedHang as e:
+        print(f"bench: TPU attempt hung ({e}), falling back to CPU",
+              file=sys.stderr)
+        fallback_reason = f"tpu watchdog: {e}"
     except TransientFault as e:
         print("bench: TPU attempts failed, falling back to CPU",
               file=sys.stderr)

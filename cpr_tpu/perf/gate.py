@@ -10,7 +10,9 @@ gate"):
   self-describes as not like-for-like).
 * `outage`/`fallback_reason` rows and error rows are **never**
   baselines: a CPU number delivered during a chip outage is a fact
-  about the outage, not about the code.
+  about the outage, not about the code.  Supervisor `probe` rows
+  (cpr_tpu/supervisor health checks) are likewise never baselines and
+  skip the gate entirely — a tiny-jit liveness check measures nothing.
 * The band is robust: median/MAD over the baseline pool.  A drop
   deeper than `max(warn_frac * median, mad_k * 1.4826 * MAD)` warns;
   deeper than the `fail_frac` analog fails.  The MAD term keeps a
@@ -53,10 +55,13 @@ def _median(vals):
 def baseline_rows(records, metric: str, backend) -> list[dict]:
     """The gate-eligible history for metric x backend: same backend
     only (a CPU-fallback row is never judged against a TPU baseline),
-    no outage/fallback rows, no error rows, positive numeric value."""
+    no outage/fallback rows, no error rows, no supervisor probe rows
+    (a tiny-jit health check measures liveness, not throughput),
+    positive numeric value."""
     return [r for r in records
             if r.get("metric") == metric and r.get("backend") == backend
             and not r.get("outage") and not r.get("error")
+            and not r.get("probe")
             and isinstance(r.get("value"), (int, float))
             and r["value"] > 0]
 
@@ -80,6 +85,11 @@ def gate_row(candidate: dict, history, *, top_k: int = TOP_K,
     if candidate.get("error"):
         result.update(verdict="skip",
                       reason="error row: nothing to gate")
+        return result
+    if candidate.get("probe"):
+        result.update(verdict="skip", reason=(
+            "supervisor probe row: a device health check, not a "
+            "measurement — never gated, never a baseline"))
         return result
     if candidate.get("outage"):
         result.update(verdict="skip", reason=(

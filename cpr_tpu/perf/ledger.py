@@ -16,10 +16,15 @@ bench.py):
 JSONL trace (`per_sec` counters under the stream's manifest backend),
 so sweep/training traces land on the same trend surface as bench rows.
 
-Ledger records (`ledger: 1`):
+Ledger records (`ledger: 2` — v2 added the supervisor provenance
+fields `probe` and `restart_count`, which changed every row_id; the
+ledger file is regenerable scratch, so a pre-v2 ledger is simply
+deleted and re-ingested rather than migrated):
 
     metric, backend, value, unit, check, round, source,
     outage, fallback_reason, error,
+    probe (health-check row, never a measurement),
+    restart_count (warm restarts preceding the measuring child),
     config (prng/window/cfg_*), fingerprint (metric x config hash),
     time_utc / git_sha / device_kind (from the embedded manifest),
     row_id (content hash — ingestion dedup key)
@@ -41,7 +46,7 @@ import re
 
 from cpr_tpu.resilience import atomic_write_text
 
-LEDGER_VERSION = 1
+LEDGER_VERSION = 2
 LEDGER_ENV_VAR = "CPR_PERF_LEDGER"
 
 # fallback_reason stamped onto rows whose artifact predates the outage
@@ -101,6 +106,15 @@ def normalize_row(row: dict, *, source: str = "live",
         "outage": outage,
         "fallback_reason": reason,
         "error": row.get("error"),
+        # supervisor provenance (cpr_tpu/supervisor): probe rows are
+        # device health checks, never measurements — the gate skips
+        # them and they can never become baselines; rows measured
+        # after a warm restart carry the count so a recovery-window
+        # number stays distinguishable in the trail
+        "probe": bool(row.get("probe")),
+        "restart_count": (int(row["restart_count"])
+                          if isinstance(row.get("restart_count"),
+                                        (int, float)) else 0),
         "config": config,
         "fingerprint": config_fingerprint(metric, config),
         "time_utc": man.get("time_utc"),

@@ -39,7 +39,10 @@ the bench watchdog) funnels its recovery logic through this module:
   docs/RESILIENCE.md) arms one-shot faults at named sites
   (`fault_point("update", i)` in the loops), so every recovery path
   above is exercised by fast deterministic CPU tests instead of hoping
-  a real outage finds the bugs first.
+  a real outage finds the bugs first.  The `hang` action blocks at the
+  site (CPR_FAULT_HANG_S seconds) instead of raising — the wedge mode
+  the axon backend actually exhibits — so the supervisor's heartbeat
+  stall detection (cpr_tpu/supervisor.py) is provable the same way.
 
 Import-time this module is jax-free (flax/numpy are imported inside
 the snapshot helpers) so bench.py's parent process can use the retry
@@ -188,28 +191,41 @@ def atomic_write_text(path: str, text: str, encoding: str = "utf-8"):
 
 # -- deterministic fault injection -------------------------------------------
 
-_ACTIONS = ("kill", "io_error", "fault", "nan", "preempt")
+_ACTIONS = ("kill", "io_error", "fault", "nan", "preempt", "hang")
 _COUNTED_SITES = ("checkpoint", "vi_chunk")  # occurrence-counted sites
+
+# how long an injected `hang` blocks.  The default approximates a truly
+# wedged process (the supervisor's watchdog must kill the child, exactly
+# as with a real axon wedge); in-process grammar tests set it tiny so
+# `fire` returns and the one-shot/count bookkeeping can be asserted.
+HANG_DURATION_ENV_VAR = "CPR_FAULT_HANG_S"
+_DEFAULT_HANG_S = 3600.0
 
 
 class FaultSpec:
-    """One armed fault: `action@site=index` (e.g. `kill@update=7`).
-    Sites with an explicit loop index (`update`) match that index;
-    occurrence-counted sites (`checkpoint`, `vi_chunk`) match the n-th
-    time the process passes the site.  One-shot: fires once, then
-    disarms — a resumed run re-entering the same index must not
+    """One armed fault: `action@site=index` (e.g. `kill@update=7`), or
+    bare `action@site` for index 1 — the first pass, which is the whole
+    story for sites hit once per process (the supervisor's `probe` and
+    `run`).  Sites with an explicit loop index (`update`) match that
+    index; occurrence-counted sites (`checkpoint`, `vi_chunk`) match
+    the n-th time the process passes the site.  One-shot: fires once,
+    then disarms — a resumed run re-entering the same index must not
     re-fire (the injected crash already happened)."""
 
     def __init__(self, raw: str):
         self.raw = raw.strip()
         try:
-            action_site, idx = self.raw.split("=")
+            if "=" in self.raw:
+                action_site, idx = self.raw.split("=")
+                self.index = int(idx)
+            else:
+                action_site = self.raw
+                self.index = 1
             self.action, self.site = action_site.split("@")
-            self.index = int(idx)
         except ValueError:
             raise ValueError(
-                f"bad fault spec {raw!r}: want action@site=index "
-                f"(e.g. kill@update=7)") from None
+                f"bad fault spec {raw!r}: want action@site[=index] "
+                f"(e.g. kill@update=7, hang@probe)") from None
         if self.action not in _ACTIONS:
             raise ValueError(f"bad fault action {self.action!r}: "
                              f"one of {_ACTIONS}")
@@ -249,6 +265,13 @@ class FaultInjector:
                 raise TransientFault(f"injected device fault ({s.raw})")
             if s.action == "preempt":
                 request_preempt(f"injected ({s.raw})")
+            if s.action == "hang":
+                # a wedged backend neither returns nor raises — block
+                # (the fault_injected event above already hit the sink,
+                # so the trace records WHERE the hang was injected even
+                # though this process is about to be killed)
+                time.sleep(float(os.environ.get(
+                    HANG_DURATION_ENV_VAR, _DEFAULT_HANG_S)))
             return s.action
         return None
 

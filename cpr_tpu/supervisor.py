@@ -1,0 +1,662 @@
+"""Supervised accelerator subprocesses: heartbeat watchdog,
+probe-before-run, and probe-gated warm-restart retry.
+
+ROADMAP item 5's TPU-attempt-hardening half.  The experimental axon
+backend's observed failure mode is a *hang*, not an exception — a
+crashed worker wedges backend init for the next process, and a long
+device call past the worker's ~60-75 s per-call ceiling kills it — so
+every accelerator entry point used to burn a full wall-clock budget
+(360 s in bench.py) before writing the chip off.  This module is the
+shared replacement for the per-tool Popen watchdogs (bench.py's
+`_attempt`, tools/tpu_scaling_curve.py's `measure_point`,
+tools/bisect_common.py), built from four pieces:
+
+* **Heartbeat protocol** — `maybe_start_heartbeat()` in the child
+  starts a daemon thread that writes one JSON line per period to
+  stderr: `{"kind": "hb", "phase": ..., "n_events": ...}` where
+  `phase` is the innermost `child_phase(...)` marker or open telemetry
+  span path, and `n_events` is the telemetry emit counter.  The parent
+  (`run_child`) resets its quiet timer on any non-beat output, on any
+  beat showing *progress* (phase changed or n_events advanced), and on
+  any beat claiming a `slow_ok` phase (compile/measure/... — phases
+  where a minutes-long silent device call is legitimate and only the
+  wall budget applies).  Identical no-progress beats outside those
+  phases — exactly what a wedged device call produces, since the beat
+  thread keeps running while the main thread blocks — do NOT reset it,
+  so the stall is declared after `quiet_s` instead of the wall budget.
+  A child that never beats (or an unparseable beat stream) leaves the
+  monitor unarmed and the parent degrades to wall-clock-only
+  watchdogging; malformed lines never crash the parent.
+
+* **Probe-before-run** — `probe()` runs `python -m cpr_tpu.supervisor
+  --probe` in a bounded subprocess: a tiny jit on whatever backend
+  comes up, one JSON result line.  `supervise()` runs it before
+  committing the real workload, so a wedged chip costs
+  ~`probe_timeout_s`, not a whole measurement round.
+
+* **Warm-restart retry** — `supervise()` maps the child's exit status
+  onto the shared resilience taxonomy (guard rc -> `GuardFailure`,
+  never retried; stall/hang -> `HeartbeatStall`/`SupervisedHang`;
+  other rc -> `TransientFault` with `.rc`) and runs the attempts
+  through `resilience.with_retries`.  A hang is re-attempted only
+  after a fresh probe passes (at most `max_restarts` warm restarts);
+  a failed probe, or exhausted attempts, re-raises so the caller's
+  next rung (ladder descent, CPU fallback) takes over — escalation
+  stays the caller's policy, detection is this module's.
+
+* **Typed telemetry** — every decision emits a schema-v6 `supervisor`
+  event (`action` probe|heartbeat_stall|hang|warm_restart|escalation,
+  `site`, `reason`, timings), rendered by tools/trace_summary.py and
+  consumed by the perf layer (probe rows never become baselines;
+  rows measured after a warm restart carry `restart_count`).
+
+Env knobs (parent side, read by `SupervisorConfig.from_env`):
+`CPR_SUPERVISOR_TIMEOUT` (wall budget per attempt, s),
+`CPR_SUPERVISOR_QUIET` (heartbeat stall interval, s),
+`CPR_SUPERVISOR_HEARTBEAT` (child beat period, s; 0 disables),
+`CPR_SUPERVISOR_PROBE_TIMEOUT`, `CPR_SUPERVISOR_RESTARTS`,
+`CPR_SUPERVISOR_PROBE` (0 skips probe-before-run).  Child side:
+the parent sets `CPR_SUPERVISOR_HEARTBEAT_S` (beat period — its
+presence is what turns beating on) and `CPR_SUPERVISOR_RESTART`
+(how many warm restarts preceded this attempt; `restart_count()`
+reads it so measured rows can self-tag).
+
+Deterministic proof: `CPR_FAULT_INJECT="hang@run=1"` blocks the child
+at its `fault_point("run")` site and `hang@probe=1` blocks the probe
+(cpr_tpu/resilience.py), so stall detection, warm restart, and
+escalation are each exercised by tier-1 tests and
+`make supervisor-smoke` without a wedgeable device.
+
+Import-time this module is jax-free like telemetry/resilience/perf —
+the parent process must never own a backend; only the children (and
+the `--probe` / `--selftest-child` modes of this file) import jax.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import subprocess
+import sys
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+
+from cpr_tpu import telemetry
+from cpr_tpu.resilience import (GuardFailure, TransientFault,
+                                default_classify, fault_point,
+                                with_retries)
+from cpr_tpu.telemetry import now
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+HEARTBEAT_ENV_VAR = "CPR_SUPERVISOR_HEARTBEAT_S"
+RESTART_ENV_VAR = "CPR_SUPERVISOR_RESTART"
+
+# beat phases where a long silent device call is legitimate (substring
+# match): backend bring-up, compiles, and measured kernels can run
+# minutes with no host-side progress — only the wall budget applies.
+# Everything else quiet past `quiet_s` is a stall.
+DEFAULT_SLOW_OK = ("init", "compile", "measure", "bench:", "sweep",
+                   "netsim")
+
+
+# -- failure taxonomy (extends cpr_tpu.resilience) ---------------------------
+
+
+class SupervisedHang(TransientFault):
+    """Child ran past the wall budget.  Transient in the taxonomy, but
+    `supervise` only re-attempts it after a fresh device probe passes —
+    a hang means a possibly-wedged device, never worth blind retry."""
+
+
+class HeartbeatStall(SupervisedHang):
+    """Child's heartbeat showed no progress for `quiet_s` — the fast
+    path to the same verdict as `SupervisedHang`, detected in seconds
+    instead of the wall budget."""
+
+
+class ProbeFailure(TransientFault):
+    """The probe-before-run device health check failed or hung: the
+    workload was never committed.  The caller escalates (CPU rung)."""
+
+
+# -- child side --------------------------------------------------------------
+
+_child_phases: list[str] = []
+_beat_thread: threading.Thread | None = None
+
+
+@contextmanager
+def child_phase(name: str):
+    """Mark a named phase for the heartbeat to report — used around
+    regions that hold no telemetry span but may be legitimately slow
+    and silent (jax import + backend bring-up: `child_phase("init")`,
+    which DEFAULT_SLOW_OK grants the full wall budget)."""
+    _child_phases.append(name)
+    try:
+        yield
+    finally:
+        _child_phases.pop()
+
+
+def current_phase() -> str | None:
+    """What the next beat reports: the innermost `child_phase` marker,
+    else the innermost open telemetry span path, else None.  Read from
+    the beat thread while the main thread pushes/pops — EAFP."""
+    try:
+        return _child_phases[-1]
+    except IndexError:
+        return telemetry.current().span_path()
+
+
+def restart_count() -> int:
+    """How many warm restarts preceded this (child) process — 0 for a
+    first attempt.  Measured rows stamp this so the perf ledger can
+    tag post-restart numbers (`restart_count` ledger field)."""
+    try:
+        return int(os.environ.get(RESTART_ENV_VAR) or 0)
+    except ValueError:
+        return 0
+
+
+def maybe_start_heartbeat(period_s: float | None = None, stream=None):
+    """Start the child-side beat thread if the parent asked for one
+    (CPR_SUPERVISOR_HEARTBEAT_S in the env, or an explicit period).
+    Call it FIRST in child main, before any jax import, so even an
+    init wedge beats.  Idempotent; returns the thread or None.
+
+    The thread is a daemon writing to stderr (the telemetry JSONL
+    protocol piggybacked on the stderr pipe): one beat per period with
+    the current phase and the telemetry emit counter as the progress
+    signal.  It must never touch jax or take locks the main thread
+    holds — json.dumps over five scalars only."""
+    global _beat_thread
+    if period_s is None:
+        raw = os.environ.get(HEARTBEAT_ENV_VAR, "")
+        try:
+            period_s = float(raw) if raw else 0.0
+        except ValueError:
+            period_s = 0.0
+    if period_s <= 0:
+        return None
+    if _beat_thread is not None and _beat_thread.is_alive():
+        return _beat_thread
+
+    def beat():
+        while True:
+            line = json.dumps({
+                "kind": "hb", "t": round(now(), 3),
+                "phase": current_phase(),
+                "n_events": telemetry.current().n_emitted,
+                "pid": os.getpid()})
+            try:
+                out = stream if stream is not None else sys.stderr
+                out.write(line + "\n")
+                out.flush()
+            except (OSError, ValueError):
+                return  # parent gone / stream closed: stop beating
+            time.sleep(period_s)
+
+    _beat_thread = threading.Thread(target=beat, name="cpr-heartbeat",
+                                    daemon=True)
+    _beat_thread.start()
+    return _beat_thread
+
+
+# -- parent side: heartbeat monitor ------------------------------------------
+
+
+class HeartbeatMonitor:
+    """Parses the child's stderr for beats and tracks the quiet timer.
+
+    Activity (= quiet-timer reset) is: any non-beat line, the first
+    beat (arming), a beat whose phase changed or whose n_events
+    advanced, or a beat claiming a slow_ok phase.  Identical
+    no-progress beats outside slow_ok phases are NOT activity — that
+    signature (beat thread alive, main thread frozen) is the stall.
+
+    Defensive by contract: `observe` never raises, whatever bytes the
+    child interleaves (partial JSON, stderr noise, binary junk); an
+    unparseable stream simply never arms the monitor and `stalled`
+    stays False — wall-clock-only degradation, the pre-supervisor
+    behavior."""
+
+    def __init__(self, slow_ok=DEFAULT_SLOW_OK, t0: float | None = None):
+        self.slow_ok = tuple(slow_ok)
+        self.armed = False
+        self.beats = 0
+        self.last_activity = now() if t0 is None else t0
+        self.last_phase: str | None = None
+        self.last_n_events = -1
+
+    def activity(self, t: float | None = None):
+        self.last_activity = now() if t is None else t
+
+    def _slow_ok(self, phase) -> bool:
+        return isinstance(phase, str) and any(
+            pat in phase for pat in self.slow_ok)
+
+    def observe(self, line: str, t: float | None = None) -> bool:
+        """Feed one child stderr line.  Returns True when the line was
+        a heartbeat (consumed — callers should not forward it)."""
+        t = now() if t is None else t
+        beat = None
+        s = line.strip() if isinstance(line, str) else ""
+        if s.startswith("{"):
+            try:
+                obj = json.loads(s)
+            except ValueError:
+                obj = None
+            if isinstance(obj, dict) and obj.get("kind") == "hb":
+                beat = obj
+        if beat is None:
+            self.activity(t)
+            return False
+        self.beats += 1
+        phase = beat.get("phase")
+        n_events = beat.get("n_events")
+        numeric = isinstance(n_events, (int, float))
+        progressed = (phase != self.last_phase
+                      or (numeric and n_events > self.last_n_events))
+        if not self.armed or progressed or self._slow_ok(phase):
+            self.activity(t)
+        self.armed = True
+        self.last_phase = phase if isinstance(phase, str) else None
+        if numeric:
+            self.last_n_events = n_events
+        return True
+
+    def stalled(self, quiet_s: float, t: float | None = None) -> bool:
+        if not self.armed:
+            return False
+        t = now() if t is None else t
+        return (t - self.last_activity) > quiet_s
+
+
+# -- parent side: one watchdogged child --------------------------------------
+
+
+class Attempt:
+    """Result of one `run_child` run.  `status` is "ok" (rc 0),
+    "failed" (nonzero rc, `.rc` set), "stalled" (heartbeat quiet past
+    `quiet_s`, child killed) or "hung" (wall budget exhausted, child
+    killed)."""
+
+    def __init__(self, status: str, rc: int | None, json_lines: list,
+                 stdout: str, stderr_tail: str, dur_s: float,
+                 hb_armed: bool, hb_beats: int,
+                 stall_phase: str | None):
+        self.status = status
+        self.rc = rc
+        self.json_lines = json_lines
+        self.stdout = stdout
+        self.stderr_tail = stderr_tail
+        self.dur_s = dur_s
+        self.hb_armed = hb_armed
+        self.hb_beats = hb_beats
+        self.stall_phase = stall_phase
+
+    @property
+    def payload(self) -> str:
+        return "\n".join(self.json_lines)
+
+
+def _reader(stream, which: str, q: queue.Queue):
+    try:
+        for line in stream:
+            q.put((which, line))
+    except (OSError, ValueError):
+        pass
+    finally:
+        q.put((which, None))
+
+
+def run_child(cmd, *, wall_timeout_s: float, quiet_s: float | None = None,
+              heartbeat_s: float | None = None, env=None, cwd=None,
+              slow_ok=DEFAULT_SLOW_OK, kill_grace_s: float = 10.0,
+              forward_stderr: bool = True) -> Attempt:
+    """Run one child under the watchdog.  Never raises on child
+    misbehavior — the status on the returned `Attempt` says what
+    happened; `supervise` maps it onto the failure taxonomy.
+
+    Output protocol (bench.py's, now shared): result lines are stdout
+    lines starting with "{"; stderr is diagnostics plus (when
+    `heartbeat_s` is set) the beat stream, forwarded live to this
+    process's stderr with beats filtered out.  Manual Popen + kill +
+    bounded reap because subprocess.run's post-kill wait is untimed —
+    a child stuck in uninterruptible device I/O (observed: D-state on
+    the device fd) would hang the parent forever; such a child is
+    abandoned to its daemon readers."""
+    child_env = dict(os.environ if env is None else env)
+    if heartbeat_s:
+        child_env[HEARTBEAT_ENV_VAR] = str(heartbeat_s)
+    else:
+        child_env.pop(HEARTBEAT_ENV_VAR, None)
+    proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True,
+                            errors="replace", env=child_env, cwd=cwd)
+    q: queue.Queue = queue.Queue()
+    for stream, which in ((proc.stdout, "out"), (proc.stderr, "err")):
+        threading.Thread(target=_reader, args=(stream, which, q),
+                         daemon=True).start()
+    start = now()
+    mon = HeartbeatMonitor(slow_ok=slow_ok, t0=start)
+    out_lines: list[str] = []
+    err_tail: deque = deque(maxlen=60)
+    open_streams = 2
+    status = None
+
+    def drain_one(timeout: float) -> bool:
+        nonlocal open_streams
+        try:
+            which, line = q.get(timeout=timeout)
+        except queue.Empty:
+            return False
+        if line is None:
+            open_streams -= 1
+            return True
+        if which == "err":
+            if not mon.observe(line):
+                err_tail.append(line)
+                if forward_stderr:
+                    sys.stderr.write(line)
+        else:
+            mon.activity()
+            out_lines.append(line.rstrip("\n"))
+        return True
+
+    while True:
+        drain_one(0.2)
+        if open_streams == 0 and proc.poll() is not None:
+            status = "ok" if proc.returncode == 0 else "failed"
+            break
+        t = now()
+        if t - start >= wall_timeout_s:
+            status = "hung"
+            break
+        if quiet_s is not None and mon.stalled(quiet_s, t):
+            status = "stalled"
+            break
+    if status in ("hung", "stalled"):
+        proc.kill()
+        try:
+            proc.wait(timeout=kill_grace_s)
+        except subprocess.TimeoutExpired:
+            pass  # unkillable (D-state on the device fd): abandon it
+        # brief drain so diagnostics written before the kill survive
+        reap_until = now() + 1.0
+        while open_streams and now() < reap_until:
+            drain_one(0.1)
+    json_lines = [ln for ln in out_lines if ln.startswith("{")]
+    return Attempt(status, proc.returncode, json_lines,
+                   "\n".join(out_lines), "".join(err_tail),
+                   now() - start, mon.armed, mon.beats, mon.last_phase)
+
+
+# -- probe-before-run --------------------------------------------------------
+
+
+def probe_cmd() -> list:
+    return [sys.executable, "-m", "cpr_tpu.supervisor", "--probe"]
+
+
+def selftest_cmd() -> list:
+    return [sys.executable, "-m", "cpr_tpu.supervisor", "--selftest-child"]
+
+
+def _event(action: str, site: str, reason: str, **extra):
+    telemetry.current().event("supervisor", action=action, site=site,
+                              reason=reason, **extra)
+
+
+def probe(config: "SupervisorConfig | None" = None, *, env=None) -> dict:
+    """Bounded device health check in a fresh subprocess: a tiny jit on
+    whatever backend comes up, one JSON line back.  Returns {ok,
+    status, reason, backend, dur_s} and emits the `supervisor` probe
+    event.  No heartbeat — the probe's whole budget is small, and its
+    own wall timeout is the detector."""
+    cfg = config or SupervisorConfig.from_env()
+    a = run_child(probe_cmd(), wall_timeout_s=cfg.probe_timeout_s,
+                  quiet_s=None, env=env, cwd=_REPO_ROOT,
+                  kill_grace_s=cfg.kill_grace_s)
+    info: dict = {}
+    for ln in a.json_lines:
+        try:
+            obj = json.loads(ln)
+        except ValueError:
+            continue
+        if isinstance(obj, dict) and obj.get("probe"):
+            info = obj
+    ok = a.status == "ok" and bool(info.get("ok"))
+    reason = ("ok" if ok
+              else f"hung past {cfg.probe_timeout_s:g}s"
+              if a.status == "hung"
+              else f"rc={a.rc}" if a.status == "failed"
+              else "exited 0 without a probe row")
+    _event(action="probe", site="device", reason=reason, ok=ok,
+           backend=info.get("backend"), dur_s=round(a.dur_s, 3))
+    return {"ok": ok, "status": a.status, "reason": reason,
+            "backend": info.get("backend"), "dur_s": a.dur_s}
+
+
+# -- supervise: probe + watchdog + warm restart ------------------------------
+
+
+class SupervisorConfig:
+    """Tunables for one supervised workload.  Constructor values are
+    code-level; `from_env()` lets the CPR_SUPERVISOR_* knobs override
+    whatever the call site chose (bad values fail fast, before any
+    watchdog budget is spent)."""
+
+    def __init__(self, *, wall_timeout_s: float = 360.0,
+                 quiet_s: float = 30.0, heartbeat_s: float = 5.0,
+                 probe_timeout_s: float = 45.0, max_restarts: int = 1,
+                 probe_first: bool = True, retry_pause_s: float = 15.0,
+                 transient_attempts: int = 2, kill_grace_s: float = 10.0,
+                 slow_ok=DEFAULT_SLOW_OK):
+        if wall_timeout_s <= 0 or probe_timeout_s <= 0:
+            raise ValueError("supervisor: timeouts must be positive")
+        if max_restarts < 0 or transient_attempts < 1:
+            raise ValueError("supervisor: bad attempt budget")
+        self.wall_timeout_s = float(wall_timeout_s)
+        self.quiet_s = float(quiet_s) if quiet_s else None
+        self.heartbeat_s = float(heartbeat_s) if heartbeat_s else None
+        self.probe_timeout_s = float(probe_timeout_s)
+        self.max_restarts = int(max_restarts)
+        self.probe_first = bool(probe_first)
+        self.retry_pause_s = float(retry_pause_s)
+        self.transient_attempts = int(transient_attempts)
+        self.kill_grace_s = float(kill_grace_s)
+        self.slow_ok = tuple(slow_ok)
+
+    @property
+    def max_attempts(self) -> int:
+        # one budget serving both retry kinds: transient-rc retries and
+        # probe-gated warm restarts (the restart cap is enforced
+        # separately in the classifier)
+        return max(self.transient_attempts, 1 + self.max_restarts)
+
+    @classmethod
+    def from_env(cls, **defaults) -> "SupervisorConfig":
+        def num(var, key, cast=float):
+            raw = os.environ.get(var)
+            if raw is None or raw == "":
+                return
+            try:
+                defaults[key] = cast(raw)
+            except ValueError:
+                raise SystemExit(
+                    f"supervisor: bad {var}={raw!r} (want a number)"
+                ) from None
+        num("CPR_SUPERVISOR_TIMEOUT", "wall_timeout_s")
+        num("CPR_SUPERVISOR_QUIET", "quiet_s")
+        num("CPR_SUPERVISOR_HEARTBEAT", "heartbeat_s")
+        num("CPR_SUPERVISOR_PROBE_TIMEOUT", "probe_timeout_s")
+        num("CPR_SUPERVISOR_RESTARTS", "max_restarts", int)
+        num("CPR_SUPERVISOR_PROBE", "probe_first", lambda v: bool(int(v)))
+        return cls(**defaults)
+
+
+class Outcome:
+    """Successful `supervise` result: the child's JSON payload plus
+    how hard the supervisor had to work for it."""
+
+    def __init__(self, payload: str, restarts: int, attempts: int,
+                 dur_s: float):
+        self.payload = payload
+        self.restarts = restarts
+        self.attempts = attempts
+        self.dur_s = dur_s
+
+
+def supervise(cmd, *, site: str, config: SupervisorConfig | None = None,
+              env=None, cwd=None, guard_rc: int | None = None,
+              require_json: bool = True, on_retry=None,
+              classify=None) -> Outcome:
+    """Run `cmd` supervised: optional probe-before-run, heartbeat +
+    wall watchdog per attempt, transient-rc retry and probe-gated warm
+    restart through `with_retries`.  Raises `GuardFailure` (child
+    exited `guard_rc`; never retried), `ProbeFailure` (device probe
+    failed before/after a hang), `HeartbeatStall`/`SupervisedHang`
+    (hang with restarts exhausted), or `TransientFault` (other child
+    failures, `.rc` attached) — the caller owns the next rung.
+
+    `classify` extends retryability for non-hang exceptions (default:
+    `resilience.default_classify`); `on_retry(attempt, exc, delay)`
+    is forwarded to `with_retries` (bench stamps worker-fault
+    timestamps with it)."""
+    cfg = config or SupervisorConfig.from_env()
+    t0 = now()
+    if cfg.probe_first:
+        pr = probe(cfg, env=env)
+        if not pr["ok"]:
+            _event(action="escalation", site=site,
+                   reason=f"probe-before-run failed ({pr['reason']}); "
+                          f"workload never committed")
+            raise ProbeFailure(
+                f"{site}: device probe failed ({pr['reason']})")
+    state = {"restarts": 0, "attempts": 0}
+
+    def attempt() -> Outcome:
+        state["attempts"] += 1
+        child_env = dict(os.environ if env is None else env)
+        if state["restarts"]:
+            child_env[RESTART_ENV_VAR] = str(state["restarts"])
+        a = run_child(cmd, wall_timeout_s=cfg.wall_timeout_s,
+                      quiet_s=cfg.quiet_s, heartbeat_s=cfg.heartbeat_s,
+                      env=child_env, cwd=cwd, slow_ok=cfg.slow_ok,
+                      kill_grace_s=cfg.kill_grace_s)
+        if a.status == "ok" and (a.json_lines or not require_json):
+            return Outcome(a.payload, state["restarts"],
+                           state["attempts"], now() - t0)
+        if a.status == "ok":
+            fault = TransientFault(
+                f"{site}: child exited 0 with no JSON payload")
+            fault.rc = 0
+            raise fault
+        if a.status == "failed":
+            if guard_rc is not None and a.rc == guard_rc:
+                raise GuardFailure(
+                    f"{site}: child exited guard rc {a.rc}")
+            fault = TransientFault(f"{site}: child rc={a.rc}")
+            fault.rc = a.rc
+            raise fault
+        if a.status == "stalled":
+            _event(action="heartbeat_stall", site=site,
+                   reason=f"no heartbeat progress for {cfg.quiet_s:g}s "
+                          f"(phase={a.stall_phase}); child killed",
+                   dur_s=round(a.dur_s, 3), beats=a.hb_beats)
+            raise HeartbeatStall(
+                f"{site}: heartbeat stall after {a.dur_s:.0f}s "
+                f"(quiet {cfg.quiet_s:g}s, phase={a.stall_phase})")
+        _event(action="hang", site=site,
+               reason=f"wall budget {cfg.wall_timeout_s:g}s exhausted"
+                      + ("" if a.hb_armed else
+                         " (no heartbeat seen: wall-clock-only)"),
+               dur_s=round(a.dur_s, 3))
+        raise SupervisedHang(
+            f"{site}: hung past {cfg.wall_timeout_s:g}s wall budget")
+
+    base_classify = classify or default_classify
+
+    def _classify(exc: BaseException) -> bool:
+        if isinstance(exc, GuardFailure):
+            return False
+        if isinstance(exc, SupervisedHang):
+            # warm restart is probe-gated: a hang only earns another
+            # attempt when a fresh probe proves the device recovered
+            if state["restarts"] >= cfg.max_restarts:
+                return False
+            pr = probe(cfg, env=env)
+            if not pr["ok"]:
+                return False
+            state["restarts"] += 1
+            _event(action="warm_restart", site=site,
+                   reason=f"probe ok ({pr['backend']}) after "
+                          f"{type(exc).__name__}; warm restart "
+                          f"{state['restarts']}/{cfg.max_restarts}")
+            return True
+        return base_classify(exc)
+
+    try:
+        return with_retries(attempt, classify=_classify,
+                            max_attempts=cfg.max_attempts,
+                            base_delay_s=cfg.retry_pause_s,
+                            max_delay_s=cfg.retry_pause_s,
+                            jitter_frac=0.0, on_retry=on_retry,
+                            name=f"supervise:{site}")
+    except GuardFailure:
+        raise  # deterministic: no escalation rung may mask it
+    except Exception as exc:  # noqa: BLE001 — record, then re-raise
+        _event(action="escalation", site=site,
+               reason=f"attempts exhausted ({type(exc).__name__}: "
+                      f"{exc}); caller's next rung takes over",
+               attempts=state["attempts"], restarts=state["restarts"])
+        raise
+
+
+# -- child entry points ------------------------------------------------------
+
+
+def _probe_child():
+    """`python -m cpr_tpu.supervisor --probe`: tiny-jit health check on
+    whatever backend comes up.  The fault point fires BEFORE the jax
+    import so an injected hang@probe costs no bring-up; a real wedge
+    hangs in jax.devices() and the parent's wall timeout catches it."""
+    t0 = now()
+    fault_point("probe")
+    import jax
+
+    devs = jax.devices()
+    # jaxlint: disable-next-line=jit-in-loop — one-shot health check
+    val = float(jax.jit(lambda x: x + 1.0)(1.0))
+    ok = val == 2.0 and len(devs) > 0
+    print(json.dumps({"probe": True, "ok": ok,
+                      "backend": devs[0].platform,
+                      "device_count": len(devs),
+                      "probe_s": round(now() - t0, 3)}), flush=True)
+    if not ok:
+        sys.exit(1)
+
+
+def _selftest_child():
+    """`python -m cpr_tpu.supervisor --selftest-child`: the jax-free
+    stand-in workload for tier-1 tests and `make supervisor-smoke` —
+    beats, passes its `run` fault point (where hang@run blocks), and
+    prints one JSON row."""
+    maybe_start_heartbeat()
+    fault_point("run")
+    print(json.dumps({"selftest": True, "ok": True, "pid": os.getpid(),
+                      "restart_count": restart_count()}), flush=True)
+
+
+if __name__ == "__main__":
+    if "--probe" in sys.argv:
+        _probe_child()
+    elif "--selftest-child" in sys.argv:
+        _selftest_child()
+    else:
+        raise SystemExit(__doc__)

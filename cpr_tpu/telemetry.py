@@ -54,7 +54,7 @@ from contextlib import contextmanager
 from datetime import datetime, timezone
 from time import perf_counter as now  # noqa: F401 — re-exported
 
-SCHEMA_VERSION = 5
+SCHEMA_VERSION = 6
 TELEMETRY_ENV_VAR = "CPR_TELEMETRY"
 PROFILE_ENV_VAR = "CPR_PROFILE_DIR"
 CHECKIFY_ENV_VAR = "CPR_CHECKIFY"
@@ -90,6 +90,10 @@ EVENT_FIELDS = {
     # pass|warn|fail|skip, baseline names the banked rows judged
     # against (null when no same-backend history exists)
     "perf_gate": ("metric", "backend", "verdict", "value", "baseline"),
+    # v6: one per supervisor decision (cpr_tpu/supervisor): action is
+    # probe|heartbeat_stall|hang|warm_restart|escalation, site names the
+    # supervised workload, reason says why (timings ride as extras)
+    "supervisor": ("action", "site", "reason"),
 }
 
 
@@ -172,6 +176,7 @@ class Telemetry:
         self._sink = stream if stream is not None else (
             open(path, "a") if path else None)
         self._stack: list[Span] = []
+        self.n_emitted = 0
 
     @property
     def enabled(self) -> bool:
@@ -181,10 +186,23 @@ class Telemetry:
         """Write one event line (no-op when disabled).  Flushed per
         event: telemetry exists for post-mortems, a crash must not eat
         the tail of the stream."""
+        # counted before the sink check: the supervisor heartbeat reads
+        # this as a progress signal, which must work sink or no sink
+        self.n_emitted += 1
         if self._sink is None:
             return
         self._sink.write(json.dumps(event, default=str) + "\n")
         self._sink.flush()
+
+    def span_path(self) -> str | None:
+        """Innermost open span's path, or None outside any span — the
+        phase label the supervisor heartbeat reports.  Read from the
+        beat thread while the main thread pushes/pops, hence the
+        EAFP access instead of a check-then-index race."""
+        try:
+            return self._stack[-1].path
+        except IndexError:
+            return None
 
     def span(self, name: str, **counters) -> Span:
         return Span(self, name, counters)

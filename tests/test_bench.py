@@ -70,6 +70,61 @@ def test_last_known_tpu_skips_outage_poisoned_banks(tmp_path):
                                  root=str(tmp_path / "empty")) is None
 
 
+def test_final_rung_hang_does_not_wedge_remaining_configs(monkeypatch):
+    """PR-8 regression (the bench hang asymmetry): the old one-strike
+    `wedged` flag wrote the TPU off for EVERY remaining config after a
+    final-rung hang.  Now the hung config takes its CPU fallback and
+    each later config still gets a supervised TPU attempt — whose own
+    probe-before-run is what decides device health."""
+    import json
+
+    from cpr_tpu import supervisor as sup
+
+    sites, cpu_children = [], []
+
+    def fake_supervise(cmd, *, site, config=None, env=None, cwd=None,
+                       guard_rc=None, require_json=True, on_retry=None,
+                       classify=None):
+        sites.append(site)
+        name = site.split(":", 1)[1]
+        if name == "bk8_withholding":  # first config: single-rung ladder
+            raise sup.SupervisedHang(f"{site}: hung past 5s wall budget")
+        row = {"metric": f"{name}_env_steps_per_sec_per_chip",
+               "backend": "tpu", "value": 1000.0,
+               "unit": "env-steps/sec/chip"}
+        return sup.Outcome(json.dumps(row), 0, 1, 0.1)
+
+    def fake_run_child(cmd, *, wall_timeout_s, quiet_s=None, **kw):
+        name = cmd[cmd.index("--direct-one") + 1]
+        cpu_children.append(name)
+        row = {"metric": f"{name}_env_steps_per_sec_per_chip",
+               "backend": "cpu", "value": 10.0,
+               "unit": "env-steps/sec/chip"}
+        line = json.dumps(row)
+        return sup.Attempt("ok", 0, [line], line, "", 0.1, False, 0, None)
+
+    written = {}
+    monkeypatch.setattr(bench.supervisor, "supervise", fake_supervise)
+    monkeypatch.setattr(bench.supervisor, "run_child", fake_run_child)
+    monkeypatch.setattr(bench, "_bank_and_gate", lambda row: None)
+    monkeypatch.setattr(bench, "_write_configs_json",
+                        lambda rows: written.setdefault("rows", rows))
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    bench.run_configs_isolated(5.0)
+
+    # every config earned a TPU attempt despite the first one hanging
+    assert sites == [f"bench:{n}" for n in bench.CONFIGS]
+    assert cpu_children == ["bk8_withholding"]  # fallback for it alone
+    rows = written["rows"]
+    assert len(rows) == len(bench.CONFIGS)
+    assert rows[0]["backend"] == "cpu" and rows[0]["outage"] is True
+    assert "hung past watchdog" in rows[0]["fallback_reason"]
+    assert all(r["backend"] == "tpu" for r in rows[1:])
+    # the hang stamped a fault timestamp, so the later on-chip rows
+    # carry recovery-window context instead of claiming a quiet worker
+    assert all("secs_since_worker_fault" in r for r in rows[1:])
+
+
 def test_chunked_episode_stats_matches_unchunked():
     """The chunked stats driver (the axon per-call-ceiling workaround,
     JaxEnv.make_episode_stats_fn) must produce the same per-env stats

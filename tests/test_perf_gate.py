@@ -125,6 +125,54 @@ def test_outage_and_error_rows_never_baselines():
     assert res["baseline"]["n"] == 3
 
 
+def test_probe_rows_never_baselines_and_never_gated():
+    """Supervisor provenance (ledger v2): a probe row is a device
+    health check, not a measurement — it must neither enter a baseline
+    pool (even with a dominating value) nor be judged itself."""
+    healthy = [_row(value=100.0, rnd=i) for i in range(3)]
+    probes = [_row(value=9000.0, rnd=10 + i, probe=True)
+              for i in range(2)]
+    res = perf.gate_row(_row(value=95.0), healthy + probes)
+    assert res["verdict"] == "pass"
+    assert res["baseline"]["median"] == 100.0
+    assert res["baseline"]["n"] == 3
+    res = perf.gate_row(_row(value=1.0, probe=True), healthy)
+    assert res["verdict"] == "skip" and "probe" in res["reason"]
+    assert res["baseline"] is None
+
+
+def test_restart_count_tagged_but_rows_stay_baseline_eligible():
+    """A row measured after a warm restart is a REAL measurement — it
+    carries `restart_count` for provenance (recovery-window numbers
+    read 2-5x slow) but stays in the baseline pool; junk counts
+    normalize to 0 instead of wedging ingestion."""
+    rec = _row(value=90.0, restart_count=1)
+    assert rec["ledger"] == 2
+    assert rec["restart_count"] == 1 and rec["probe"] is False
+    assert _row(value=1.0, restart_count="two")["restart_count"] == 0
+    hist = ([_row(value=100.0, rnd=i) for i in range(2)]
+            + [_row(value=100.0, rnd=5, restart_count=1)])
+    res = perf.gate_row(_row(value=95.0), hist)
+    assert res["verdict"] == "pass"
+    assert res["baseline"]["n"] == 3  # post-restart row counted
+
+
+def test_synthetic_supervised_trail_gates_clean(tmp_path, monkeypatch):
+    """A trail shaped like one supervised bench round — healthy
+    history, then a probe row and a post-warm-restart measurement —
+    banks and gates without the probe poisoning anything."""
+    led = perf.Ledger(str(tmp_path / "l.jsonl"))
+    led.append([_row(value=100.0 + i, rnd=i + 1) for i in range(3)])
+    led.append([_row(value=1.0, rnd=4, probe=True),
+                _row(value=98.0, rnd=4, restart_count=1)])
+    results = [perf.gate_row(r, led.records())
+               for r in led.records() if r["round"] == 4]
+    verdicts = sorted(r["verdict"] for r in results)
+    assert verdicts == ["pass", "skip"]
+    s = perf.gate_summary(results)
+    assert s["ok"] and s["skip"] == 1
+
+
 def test_cpu_fallback_never_judged_against_tpu_baseline():
     """The acceptance contract: backends never mix.  An untagged CPU
     row sees no baseline in an all-TPU history (first measurement); a

@@ -123,13 +123,17 @@ def test_with_retries_emits_retry_events(tmp_path):
 def test_fault_spec_grammar():
     s = FaultSpec("kill@update=7")
     assert (s.action, s.site, s.index) == ("kill", "update", 7)
+    # bare action@site defaults to index 1 — the whole story for sites
+    # hit once per process (the supervisor's probe/run fault points)
+    s = FaultSpec("hang@probe")
+    assert (s.action, s.site, s.index) == ("hang", "probe", 1)
     assert [s.raw for s in resilience.parse_fault_specs(
-        "kill@update=7, io_error@checkpoint=2")] == [
-        "kill@update=7", "io_error@checkpoint=2"]
-    for bad in ("kill@update", "kill=7", "explode@update=7", ""):
-        if bad:
-            with pytest.raises(ValueError):
-                FaultSpec(bad)
+        "kill@update=7, io_error@checkpoint=2, hang@run")] == [
+        "kill@update=7", "io_error@checkpoint=2", "hang@run"]
+    for bad in ("kill=7", "explode@update=7", "kill@update=x",
+                "kill@a@b=1", "@update=1"):
+        with pytest.raises(ValueError):
+            FaultSpec(bad)
     assert resilience.parse_fault_specs("") == []
 
 
@@ -300,68 +304,51 @@ def test_trim_metrics_log_and_fingerprint(tmp_path):
                   {"eval": True, "update": 2, "relative_reward": 0.3}]
 
 
-# -- bench child-process protocol --------------------------------------------
+# -- the `hang` fault action (PR 8: supervisor's deterministic wedge) --------
+# (the bench child-process protocol itself — status -> taxonomy mapping,
+# guard/hang retry counts — moved to tests/test_supervisor.py with the
+# watchdog, which bench.py now delegates to)
 
 
-def test_bench_attempt_maps_exit_status_to_taxonomy(monkeypatch):
-    import bench
+def test_hang_fire_blocks_then_disarms(monkeypatch):
+    """An injected hang blocks for CPR_FAULT_HANG_S (approximating a
+    wedged backend that neither returns nor raises), then the one-shot
+    disarms — the bookkeeping the warm-restart proof relies on: a
+    RESTARTED child re-fires because its injector counters are fresh,
+    while within one process the site fires once."""
+    import time as _time
 
-    scripted = {}
-    monkeypatch.setattr(
-        bench, "_attempt",
-        lambda timeout, mode="--direct", extra=None, env_extra=None:
-        scripted["ret"])
-    scripted["ret"] = ("ok", '{"backend": "cpu"}')
-    assert bench._attempt_raising(5.0) == '{"backend": "cpu"}'
-    scripted["ret"] = ("failed", bench.GUARD_RC)
-    with pytest.raises(GuardFailure):
-        bench._attempt_raising(5.0)
-    scripted["ret"] = ("hung", None)
-    with pytest.raises(bench.BenchHang):
-        bench._attempt_raising(5.0)
-    scripted["ret"] = ("failed", 139)
-    with pytest.raises(TransientFault) as ei:
-        bench._attempt_raising(5.0)
-    assert ei.value.rc == 139
-
-
-def test_bench_classifier_guard_and_hang_never_retry():
-    import bench
-
-    assert bench._bench_classify(GuardFailure("rule broken")) is False
-    assert bench._bench_classify(bench.BenchHang("wedged")) is False
-    assert bench._bench_classify(TransientFault("claim")) is True
-    # the masquerade invariant end-to-end: an AssertionError must take
-    # the retry path, never the guard path
-    assert bench._bench_classify(AssertionError("jax internal")) is True
+    monkeypatch.setenv(resilience.HANG_DURATION_ENV_VAR, "0.2")
+    inj = resilience.FaultInjector(resilience.parse_fault_specs(
+        "hang@run"))
+    t0 = _time.time()
+    assert inj.fire("run") == "hang"  # cooperative: returns, not raises
+    assert _time.time() - t0 >= 0.15  # actually blocked for the budget
+    assert inj.fire("run") is None  # disarmed
+    # indexed form pins a later occurrence
+    monkeypatch.setenv(resilience.HANG_DURATION_ENV_VAR, "0.01")
+    inj = resilience.FaultInjector(resilience.parse_fault_specs(
+        "hang@run=2"))
+    assert inj.fire("run") is None
+    assert inj.fire("run") == "hang"
 
 
-def test_bench_retry_counts_under_shared_classifier(monkeypatch):
-    import bench
-
-    calls = []
-
-    def guard_fails(timeout, mode="--direct", extra=None, env_extra=None):
-        calls.append(1)
-        return ("failed", bench.GUARD_RC)
-
-    monkeypatch.setattr(bench, "_attempt", guard_fails)
-    with pytest.raises(GuardFailure):
-        with_retries(lambda: bench._attempt_raising(5.0),
-                     classify=bench._bench_classify, max_attempts=2,
-                     sleep=lambda s: None)
-    assert len(calls) == 1  # guard: no second child spawned
-
-    calls.clear()
-    monkeypatch.setattr(
-        bench, "_attempt",
-        lambda timeout, mode="--direct", extra=None, env_extra=None:
-        (calls.append(1), ("failed", 1))[1])
-    with pytest.raises(TransientFault):
-        with_retries(lambda: bench._attempt_raising(5.0),
-                     classify=bench._bench_classify, max_attempts=2,
-                     base_delay_s=0.0, sleep=lambda s: None)
-    assert len(calls) == 2  # transient: one paused re-attempt
+def test_hang_emits_fault_injected_event_before_blocking(
+        tmp_path, monkeypatch):
+    """The fault_injected event must hit the sink BEFORE the block:
+    the hung process is about to be killed, and the trace is how a
+    post-mortem learns where the hang was injected."""
+    monkeypatch.setenv(resilience.HANG_DURATION_ENV_VAR, "0.01")
+    monkeypatch.setenv(resilience.FAULT_ENV_VAR, "hang@mysite")
+    path = tmp_path / "tele.jsonl"
+    telemetry.configure(str(path))
+    try:
+        assert resilience.fault_point("mysite") == "hang"
+    finally:
+        telemetry.configure(None)
+    events = [json.loads(ln) for ln in open(path)]
+    (e,) = [e for e in events if e.get("name") == "fault_injected"]
+    assert e["spec"] == "hang@mysite" and e["site"] == "mysite"
 
 
 # -- chunked-VI checkpoint/resume (host seam, synthetic contraction) ---------

@@ -1,0 +1,430 @@
+"""Device supervisor (cpr_tpu/supervisor, PR 8): heartbeat watchdog,
+probe-before-run, and probe-gated warm restart.
+
+Three layers, cheapest first: pure HeartbeatMonitor parsing (the
+satellite-3 robustness contract — whatever bytes a child interleaves,
+the parent never crashes and at worst degrades to wall-clock-only
+watchdogging), real `run_child` subprocesses over tiny inline scripts
+(stall/hang/ok status mapping without importing jax), `supervise`
+semantics with the probe and the child monkeypatched (taxonomy mapping
+and retry counts — the coverage the old bench._attempt tests held),
+and ONE full-cycle acceptance test over real children with
+CPR_FAULT_INJECT=hang@run: stall detected by heartbeat well under the
+wall budget, exactly one probe-gated warm restart, escalation, typed
+event trail.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from cpr_tpu import resilience, supervisor, telemetry  # noqa: E402
+from cpr_tpu.resilience import GuardFailure, TransientFault  # noqa: E402
+from cpr_tpu.supervisor import (Attempt, HeartbeatMonitor,  # noqa: E402
+                                HeartbeatStall, ProbeFailure,
+                                SupervisedHang, SupervisorConfig)
+
+
+def _beat(phase="work", n_events=1, **extra):
+    return json.dumps({"kind": "hb", "t": 0.0, "phase": phase,
+                       "n_events": n_events, "pid": 1, **extra})
+
+
+# -- HeartbeatMonitor: parser robustness (satellite 3) -----------------------
+
+
+def test_monitor_never_raises_on_junk_and_stays_unarmed():
+    """Malformed output — partial JSON, binary junk, JSON that is not a
+    beat, beats with wrong-typed fields — must never crash `observe`;
+    a stream with no valid beat never arms the monitor, so `stalled`
+    stays False forever (wall-clock-only degradation)."""
+    mon = HeartbeatMonitor(t0=0.0)
+    junk = ['{"kind": "hb"', "Traceback (most recent call last):",
+            "\x00\xffbinary\x01", "", "   ", '{"not": "a beat"}',
+            "[1, 2, 3]", "{broken", '"just a string"']
+    for line in junk:
+        assert mon.observe(line, t=1.0) is False  # forwarded, not eaten
+    assert mon.armed is False and mon.beats == 0
+    assert mon.stalled(0.001, t=1e9) is False
+    # wrong-typed beat fields are still a beat (consumed), still safe
+    assert mon.observe(_beat(phase=1234, n_events="x"), t=2.0) is True
+    assert mon.armed is True and mon.last_phase is None
+
+
+def test_monitor_no_progress_beats_do_not_reset_quiet_timer():
+    """The stall signature: beat thread alive, main thread frozen —
+    identical non-slow_ok beats must NOT count as activity."""
+    mon = HeartbeatMonitor(slow_ok=("compile",), t0=0.0)
+    assert mon.observe(_beat(), t=0.0) is True  # first beat arms
+    for t in (1.0, 2.0, 3.0, 4.0):
+        mon.observe(_beat(), t=t)  # no progress
+    assert mon.beats == 5
+    assert mon.stalled(3.0, t=4.0) is True
+    assert mon.stalled(5.0, t=4.0) is False  # quiet_s not yet exceeded
+
+
+def test_monitor_progress_and_slow_ok_and_noise_reset_timer():
+    mon = HeartbeatMonitor(slow_ok=("compile",), t0=0.0)
+    mon.observe(_beat(n_events=1), t=0.0)
+    mon.observe(_beat(n_events=2), t=5.0)  # n_events advanced
+    assert mon.stalled(3.0, t=6.0) is False
+    mon.observe(_beat(phase="other", n_events=2), t=10.0)  # phase change
+    assert mon.stalled(3.0, t=11.0) is False
+    # slow_ok phase: identical beats keep resetting (substring match)
+    for t in (15.0, 20.0, 25.0):
+        mon.observe(_beat(phase="bench:compile", n_events=2), t=t)
+    assert mon.stalled(3.0, t=26.0) is False
+    # any non-beat child output is activity too
+    mon.observe(_beat(phase="work", n_events=2), t=30.0)
+    mon.observe("some stderr diagnostic\n", t=35.0)
+    assert mon.stalled(3.0, t=36.0) is False
+    assert mon.stalled(3.0, t=40.0) is True
+
+
+# -- child-side helpers ------------------------------------------------------
+
+
+def test_child_phase_nesting_and_restart_count(monkeypatch):
+    assert supervisor.current_phase() is None  # no phase, no open span
+    with supervisor.child_phase("outer"):
+        with supervisor.child_phase("inner"):
+            assert supervisor.current_phase() == "inner"
+        assert supervisor.current_phase() == "outer"
+    assert supervisor.current_phase() is None
+    monkeypatch.delenv(supervisor.RESTART_ENV_VAR, raising=False)
+    assert supervisor.restart_count() == 0
+    monkeypatch.setenv(supervisor.RESTART_ENV_VAR, "2")
+    assert supervisor.restart_count() == 2
+    monkeypatch.setenv(supervisor.RESTART_ENV_VAR, "junk")
+    assert supervisor.restart_count() == 0
+
+
+def test_heartbeat_thread_beats_with_phase_and_is_idempotent(monkeypatch):
+    monkeypatch.delenv(supervisor.HEARTBEAT_ENV_VAR, raising=False)
+    assert supervisor.maybe_start_heartbeat() is None  # env off
+    assert supervisor.maybe_start_heartbeat(0) is None
+
+    lines = []
+
+    class CappedStream:
+        def write(self, s):
+            lines.append(s)
+
+        def flush(self):
+            if len(lines) >= 5:
+                raise OSError("cap reached: stop the beat thread")
+
+    monkeypatch.setattr(supervisor, "_beat_thread", None)
+    with supervisor.child_phase("unit-phase"):
+        t = supervisor.maybe_start_heartbeat(0.05, stream=CappedStream())
+        assert t is not None
+        # idempotent while alive: a second call returns the same thread
+        assert supervisor.maybe_start_heartbeat(0.05) is t
+        t.join(timeout=10.0)
+    assert not t.is_alive()
+    beats = [json.loads(s) for s in lines]
+    assert len(beats) == 5
+    assert all(b["kind"] == "hb" and b["pid"] == os.getpid()
+               for b in beats)
+    assert all(b["phase"] == "unit-phase" for b in beats)
+    monkeypatch.setattr(supervisor, "_beat_thread", None)
+
+
+# -- run_child over real (jax-free) children ---------------------------------
+
+
+def _inline(code: str) -> list:
+    return [sys.executable, "-u", "-c", textwrap.dedent(code)]
+
+
+def test_run_child_ok_collects_json_payload():
+    a = supervisor.run_child(_inline("""
+        import sys
+        print("diagnostic noise")
+        print('{"row": 1}')
+        sys.stderr.write("stderr diagnostic\\n")
+        print('{"row": 2}')
+    """), wall_timeout_s=60.0, quiet_s=None, forward_stderr=False)
+    assert a.status == "ok" and a.rc == 0
+    assert a.json_lines == ['{"row": 1}', '{"row": 2}']
+    assert a.payload == '{"row": 1}\n{"row": 2}'
+    assert "diagnostic noise" in a.stdout
+    assert "stderr diagnostic" in a.stderr_tail
+    assert a.hb_armed is False  # no heartbeat requested
+
+
+def test_run_child_declares_stall_well_under_wall_budget():
+    """A child whose beat thread stays alive while its main thread is
+    frozen (identical non-slow_ok beats) is killed after ~quiet_s, not
+    after the wall budget."""
+    a = supervisor.run_child(_inline("""
+        import json, sys, time
+        while True:
+            sys.stderr.write(json.dumps(
+                {"kind": "hb", "phase": "wedge", "n_events": 1}) + "\\n")
+            sys.stderr.flush()
+            time.sleep(0.1)
+    """), wall_timeout_s=60.0, quiet_s=1.0, kill_grace_s=5.0,
+        forward_stderr=False)
+    assert a.status == "stalled"
+    assert a.dur_s < 20.0  # nowhere near the 60 s wall budget
+    assert a.hb_armed and a.hb_beats >= 2
+    assert a.stall_phase == "wedge"
+
+
+def test_run_child_degrades_to_wall_clock_without_beats():
+    # silent child: never arms, wall budget is the only detector
+    a = supervisor.run_child(_inline("""
+        import time
+        time.sleep(60)
+    """), wall_timeout_s=1.5, quiet_s=0.5, kill_grace_s=5.0,
+        forward_stderr=False)
+    assert a.status == "hung" and a.hb_armed is False
+    # noisy-but-beatless child: every junk line is activity, so the
+    # quiet timer never fires and the wall budget still bounds it
+    a = supervisor.run_child(_inline("""
+        import sys, time
+        while True:
+            sys.stderr.write("{not json, not a beat\\n")
+            sys.stderr.flush()
+            time.sleep(0.2)
+    """), wall_timeout_s=1.5, quiet_s=0.8, kill_grace_s=5.0,
+        forward_stderr=False)
+    assert a.status == "hung" and a.hb_armed is False
+
+
+def test_run_child_reports_failed_rc():
+    a = supervisor.run_child(_inline("raise SystemExit(7)"),
+                             wall_timeout_s=30.0, forward_stderr=False)
+    assert a.status == "failed" and a.rc == 7
+
+
+# -- supervise: taxonomy mapping + retry counts (probe/child faked) ----------
+
+
+def _fake_attempt(status, rc=None, json_lines=(), stall_phase=None,
+                  hb=False):
+    return Attempt(status, rc, list(json_lines),
+                   "\n".join(json_lines), "", 0.01, hb,
+                   3 if hb else 0, stall_phase)
+
+
+def _cfg(**kw):
+    base = dict(wall_timeout_s=5.0, quiet_s=1.0, heartbeat_s=0.2,
+                probe_timeout_s=5.0, max_restarts=1, probe_first=False,
+                retry_pause_s=0.0, transient_attempts=2,
+                kill_grace_s=0.5)
+    base.update(kw)
+    return SupervisorConfig(**base)
+
+
+def test_supervise_guard_rc_never_retried(monkeypatch):
+    calls = []
+    monkeypatch.setattr(supervisor, "run_child",
+                        lambda *a, **k: (calls.append(1),
+                                         _fake_attempt("failed", rc=3))[1])
+    with pytest.raises(GuardFailure):
+        supervisor.supervise(["child"], site="t", config=_cfg(),
+                             guard_rc=3)
+    assert len(calls) == 1  # guard: no second child spawned
+
+
+def test_supervise_transient_rc_retried_once_then_raises(monkeypatch):
+    calls = []
+    monkeypatch.setattr(supervisor, "run_child",
+                        lambda *a, **k: (calls.append(1),
+                                         _fake_attempt("failed", rc=139))[1])
+    with pytest.raises(TransientFault) as ei:
+        supervisor.supervise(["child"], site="t", config=_cfg())
+    assert ei.value.rc == 139
+    assert len(calls) == 2  # transient_attempts=2: one re-attempt
+
+
+def test_supervise_ok_without_json_is_transient_unless_waived(monkeypatch):
+    monkeypatch.setattr(supervisor, "run_child",
+                        lambda *a, **k: _fake_attempt("ok", rc=0))
+    with pytest.raises(TransientFault) as ei:
+        supervisor.supervise(["child"], site="t", config=_cfg())
+    assert ei.value.rc == 0
+    out = supervisor.supervise(["child"], site="t", config=_cfg(),
+                               require_json=False)
+    assert out.payload == "" and out.attempts == 1 and out.restarts == 0
+
+
+def test_supervise_success_returns_payload_and_counts(monkeypatch):
+    monkeypatch.setattr(
+        supervisor, "run_child",
+        lambda *a, **k: _fake_attempt("ok", rc=0,
+                                      json_lines=['{"v": 1}']))
+    out = supervisor.supervise(["child"], site="t", config=_cfg())
+    assert json.loads(out.payload) == {"v": 1}
+    assert out.attempts == 1 and out.restarts == 0
+
+
+def test_supervise_probe_gate_blocks_workload(monkeypatch):
+    ran = []
+    monkeypatch.setattr(supervisor, "run_child",
+                        lambda *a, **k: ran.append(1))
+    monkeypatch.setattr(supervisor, "probe",
+                        lambda cfg, env=None: {"ok": False,
+                                               "status": "hung",
+                                               "reason": "hung past 5s",
+                                               "backend": None,
+                                               "dur_s": 5.0})
+    with pytest.raises(ProbeFailure, match="hung past 5s"):
+        supervisor.supervise(["child"], site="t",
+                             config=_cfg(probe_first=True))
+    assert ran == []  # the workload was never committed
+
+
+def test_supervise_warm_restart_exactly_once_with_event_trail(
+        monkeypatch, tmp_path):
+    """The acceptance shape at unit scale: stall -> probe-gated warm
+    restart (restart env stamped on the retried child) -> stall again
+    -> escalation, with the typed v6 event trail."""
+    envs, probes = [], []
+    monkeypatch.setattr(
+        supervisor, "run_child",
+        lambda *a, **k: (envs.append(k.get("env")),
+                         _fake_attempt("stalled", stall_phase="run",
+                                       hb=True))[1])
+    monkeypatch.setattr(
+        supervisor, "probe",
+        lambda cfg, env=None: (probes.append(1),
+                               {"ok": True, "status": "ok",
+                                "reason": "ok", "backend": "cpu",
+                                "dur_s": 0.1})[1])
+    trace = tmp_path / "t.jsonl"
+    telemetry.configure(str(trace))
+    try:
+        with pytest.raises(HeartbeatStall):
+            supervisor.supervise(["child"], site="t", config=_cfg())
+    finally:
+        telemetry.configure(None)
+    assert len(envs) == 2 and len(probes) == 1
+    assert supervisor.RESTART_ENV_VAR not in envs[0]
+    assert envs[1][supervisor.RESTART_ENV_VAR] == "1"
+    events = [json.loads(ln) for ln in open(trace)]
+    actions = [e["action"] for e in events
+               if e.get("name") == "supervisor"]
+    assert actions == ["heartbeat_stall", "warm_restart",
+                       "heartbeat_stall", "escalation"]
+    for e in events:
+        if e.get("name") == "supervisor":
+            for key in telemetry.EVENT_FIELDS["supervisor"]:
+                assert key in e, e
+
+
+def test_supervise_hang_with_failed_probe_never_restarts(monkeypatch):
+    calls = []
+    monkeypatch.setattr(supervisor, "run_child",
+                        lambda *a, **k: (calls.append(1),
+                                         _fake_attempt("hung"))[1])
+    monkeypatch.setattr(supervisor, "probe",
+                        lambda cfg, env=None: {"ok": False,
+                                               "status": "failed",
+                                               "reason": "rc=1",
+                                               "backend": None,
+                                               "dur_s": 0.1})
+    with pytest.raises(SupervisedHang):
+        supervisor.supervise(["child"], site="t", config=_cfg())
+    assert len(calls) == 1  # wedged device: no blind re-attempt
+
+
+# -- config knobs ------------------------------------------------------------
+
+
+def test_supervisor_config_env_overrides_and_validation(monkeypatch):
+    for var in ("CPR_SUPERVISOR_TIMEOUT", "CPR_SUPERVISOR_QUIET",
+                "CPR_SUPERVISOR_HEARTBEAT", "CPR_SUPERVISOR_PROBE_TIMEOUT",
+                "CPR_SUPERVISOR_RESTARTS", "CPR_SUPERVISOR_PROBE"):
+        monkeypatch.delenv(var, raising=False)
+    cfg = SupervisorConfig.from_env(wall_timeout_s=100.0)
+    assert cfg.wall_timeout_s == 100.0 and cfg.probe_first is True
+    monkeypatch.setenv("CPR_SUPERVISOR_QUIET", "7.5")
+    monkeypatch.setenv("CPR_SUPERVISOR_RESTARTS", "2")
+    monkeypatch.setenv("CPR_SUPERVISOR_PROBE", "0")
+    cfg = SupervisorConfig.from_env(wall_timeout_s=100.0)
+    assert (cfg.quiet_s, cfg.max_restarts, cfg.probe_first) == (7.5, 2,
+                                                                False)
+    assert cfg.max_attempts == 3  # 1 + max_restarts beats transient 2
+    monkeypatch.setenv("CPR_SUPERVISOR_TIMEOUT", "not-a-number")
+    with pytest.raises(SystemExit, match="CPR_SUPERVISOR_TIMEOUT"):
+        SupervisorConfig.from_env()
+    with pytest.raises(ValueError):
+        SupervisorConfig(wall_timeout_s=0.0)
+    with pytest.raises(ValueError):
+        SupervisorConfig(max_restarts=-1)
+
+
+# -- the tier-1 acceptance proof: real children, injected hang ---------------
+
+
+def test_injected_hang_full_cycle_over_real_children(tmp_path):
+    """ISSUE-8 acceptance: with CPR_FAULT_INJECT=hang@run wedging the
+    real selftest child, the heartbeat declares the stall well under
+    the wall budget, a real probe child gates exactly one warm restart,
+    the restarted child re-fires the per-process one-shot and stalls
+    again, and supervise escalates — all visible as typed events."""
+    trace = tmp_path / "supervise.jsonl"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env[resilience.FAULT_ENV_VAR] = "hang@run"
+    env[telemetry.TELEMETRY_ENV_VAR] = str(trace)
+    env.pop(supervisor.HEARTBEAT_ENV_VAR, None)
+    cfg = SupervisorConfig(wall_timeout_s=300.0, quiet_s=2.0,
+                           heartbeat_s=0.2, probe_timeout_s=120.0,
+                           max_restarts=1, retry_pause_s=0.1)
+    telemetry.configure(str(trace))
+    t0 = time.time()
+    try:
+        with pytest.raises(SupervisedHang):
+            supervisor.supervise(supervisor.selftest_cmd(),
+                                 site="t1:wedge", config=cfg, env=env)
+    finally:
+        telemetry.configure(None)
+    elapsed = time.time() - t0
+    # two stall detections at quiet_s=2 plus probe/import overhead:
+    # nowhere near the 2 x 300 s the wall budget alone would burn
+    assert elapsed < 150.0, elapsed
+    events = [json.loads(ln) for ln in open(trace)]
+    sup = [e for e in events if e.get("name") == "supervisor"]
+    actions = [e["action"] for e in sup]
+    assert actions.count("heartbeat_stall") == 2
+    assert actions.count("warm_restart") == 1
+    assert actions.count("escalation") == 1
+    assert actions.count("probe") == 2  # before-run + the restart gate
+    assert all(e["ok"] for e in sup if e["action"] == "probe")
+    # each wedged child logged its injected fault to the shared sink
+    # before blocking (O_APPEND keeps the multi-process lines whole)
+    faults = [e for e in events if e.get("name") == "fault_injected"]
+    assert len(faults) == 2 and all(e["site"] == "run" for e in faults)
+
+
+def test_probe_child_runs_clean_on_cpu():
+    """The real --probe child end-to-end: one bounded subprocess, one
+    JSON verdict line, probe() parses it and emits the typed event."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop(resilience.FAULT_ENV_VAR, None)
+    env.pop(telemetry.TELEMETRY_ENV_VAR, None)
+    out = supervisor.probe(
+        SupervisorConfig(probe_timeout_s=120.0), env=env)
+    assert out["ok"] is True and out["status"] == "ok"
+    assert out["backend"] == "cpu"
+
+
+def test_selftest_child_reports_restart_count():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop(resilience.FAULT_ENV_VAR, None)
+    env[supervisor.RESTART_ENV_VAR] = "1"
+    r = subprocess.run(supervisor.selftest_cmd(), env=env,
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0
+    row = json.loads(r.stdout.strip().splitlines()[-1])
+    assert row["selftest"] is True and row["restart_count"] == 1

@@ -1,18 +1,21 @@
 """Shared harness for the on-chip fault-bisection tools.
 
-Each candidate snippet runs in its own watchdog-bounded subprocess (the
-bench.py pattern): a crashed worker can wedge backend init for the NEXT
-process, so the parent classifies crash-rc, crash-signature stderr, and
-init-hang separately and stops at the first CRASH/HANG to avoid
-hammering a wedged chip.
+Each candidate snippet runs in its own watchdog-bounded subprocess via
+`cpr_tpu/supervisor.run_child` (wall-clock only: candidates are raw
+`-c` snippets with no heartbeat): a crashed worker can wedge backend
+init for the NEXT process, so the parent classifies crash-rc,
+crash-signature stderr, and init-hang separately.  `run_candidates`
+additionally probes the device before the first candidate and stops at
+the first CRASH/HANG to avoid hammering a wedged chip.
 """
 
 import os
-import subprocess
 import sys
-import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from cpr_tpu import supervisor  # noqa: E402
 
 PRE = "import jax, jax.numpy as jnp\n"
 
@@ -21,29 +24,32 @@ CRASH_SIGNATURES = ("crashed or restarted", "UNAVAILABLE")
 
 
 def run_one(name, code, timeout=300.0):
-    proc = subprocess.Popen(
+    a = supervisor.run_child(
         [sys.executable, "-u", "-c", PRE + code], cwd=REPO,
-        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
-    t0 = time.time()
-    try:
-        out, err = proc.communicate(timeout=timeout)
-    except subprocess.TimeoutExpired:
-        proc.kill()
-        try:
-            proc.communicate(timeout=20)
-        except subprocess.TimeoutExpired:
-            pass
-        return name, "HANG", time.time() - t0, ""
-    status = "ok" if proc.returncode == 0 else f"rc={proc.returncode}"
+        wall_timeout_s=timeout, quiet_s=None, forward_stderr=False)
+    if a.status in ("hung", "stalled"):
+        return name, "HANG", a.dur_s, ""
+    status = "ok" if a.status == "ok" else f"rc={a.rc}"
+    err = a.stderr_tail
     tail = (err.strip().splitlines() or [""])[-1]
     if any(sig in err for sig in CRASH_SIGNATURES):
         status = "CRASH"
-    return name, status, time.time() - t0, tail if status != "ok" else out.strip()
+    return (name, status, a.dur_s,
+            tail if status != "ok" else a.stdout.strip())
 
 
 def run_candidates(candidates, limit=None, timeout=300.0):
     """Run candidates in order, printing one status line each; stop at
-    the first CRASH/HANG (wedged-chip discipline)."""
+    the first CRASH/HANG (wedged-chip discipline).  A bounded device
+    probe runs first so a chip wedged by an earlier session costs
+    seconds, not the first candidate's full timeout."""
+    pr = supervisor.probe()
+    print(f"probe: {pr['reason']} [{pr.get('backend')}] "
+          f"{pr['dur_s']:.1f}s", flush=True)
+    if not pr["ok"]:
+        print("stopping: device probe failed; wait before re-running",
+              flush=True)
+        return
     for name, code in candidates[:limit]:
         name, status, dt, info = run_one(name, code, timeout=timeout)
         print(f"{name:24s} {status:8s} {dt:6.1f}s  {info[:100]}", flush=True)

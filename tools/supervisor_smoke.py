@@ -1,0 +1,175 @@
+"""Supervised-subprocess smoke (`make supervisor-smoke`).
+
+Proves the cpr_tpu/supervisor contract end-to-end with deterministic
+fault injection (no wedgeable device required), on three scenarios:
+
+  1  hang@probe  — the probe-before-run child wedges: supervise must
+     raise ProbeFailure in ~probe_timeout seconds without ever
+     committing the workload;
+  2  hang@run    — the workload child wedges at its `run` fault point:
+     the heartbeat watchdog must declare a stall in ~quiet_s (well
+     under the wall budget), a fresh probe must gate exactly one warm
+     restart, the restarted child re-fires the per-process one-shot
+     and stalls again, and supervise escalates;
+  3  the terminal rung — the same workload with injection off must run
+     clean (what bench.py's CPU fallback does after an escalation).
+
+Asserts the ISSUE-8 acceptance criterion: both injected scenarios
+resolve in < 60 s (stall detection is heartbeat-driven, not
+wall-budget-driven), the typed `supervisor` event trail shows exactly
+2 heartbeat_stalls / 1 warm_restart / 1 escalation for scenario 2, and
+the emitted trace passes
+`tools/trace_summary.py --validate --expect supervisor`.
+
+Usage: python tools/supervisor_smoke.py [workdir]   (default /tmp/...)
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+from cpr_tpu import supervisor, telemetry  # noqa: E402
+from cpr_tpu.resilience import FAULT_ENV_VAR  # noqa: E402
+
+# tight-but-safe smoke knobs: quiet_s only needs to beat a few beat
+# periods; probes run the real --probe child (jax import, CPU backend)
+QUIET_S = 3.0
+HEARTBEAT_S = 0.5
+WALL_S = 45.0
+PROBE_TIMEOUT_S = 30.0
+
+
+def _cfg(**kw):
+    base = dict(wall_timeout_s=WALL_S, quiet_s=QUIET_S,
+                heartbeat_s=HEARTBEAT_S, probe_timeout_s=PROBE_TIMEOUT_S,
+                retry_pause_s=0.2)
+    base.update(kw)
+    return supervisor.SupervisorConfig(**base)
+
+
+def _env(fault=None):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop(FAULT_ENV_VAR, None)
+    if fault:
+        env[FAULT_ENV_VAR] = fault
+    return env
+
+
+def _events(path, action=None):
+    out = []
+    with open(path) as f:
+        for line in f:
+            try:
+                e = json.loads(line)
+            except ValueError:
+                continue
+            if e.get("kind") == "event" and e.get("name") == "supervisor" \
+                    and (action is None or e.get("action") == action):
+                out.append(e)
+    return out
+
+
+def _validate_stream(path, expect):
+    tool = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "trace_summary.py")
+    r = subprocess.run(
+        [sys.executable, tool, path, "--validate", "--expect", expect],
+        capture_output=True, text=True)
+    if r.returncode != 0:
+        sys.stderr.write(r.stdout + r.stderr)
+        raise SystemExit(f"telemetry validation failed for {path}")
+
+
+def main():
+    work = (sys.argv[1] if len(sys.argv) > 1
+            else "/tmp/cpr-supervisor-smoke")
+    os.makedirs(work, exist_ok=True)
+    tele_path = os.path.join(work, "supervisor.jsonl")
+    if os.path.exists(tele_path):
+        os.remove(tele_path)
+    os.environ[telemetry.TELEMETRY_ENV_VAR] = tele_path
+    telemetry.configure(tele_path)
+
+    print("supervisor-smoke: scenario 1 (hang@probe -> ProbeFailure, "
+          "bounded by probe_timeout)", file=sys.stderr)
+    t0 = time.time()
+    try:
+        supervisor.supervise(
+            supervisor.selftest_cmd(), site="smoke:probe-wedge",
+            config=_cfg(probe_timeout_s=10.0), env=_env("hang@probe"))
+        raise SystemExit("scenario 1: supervise succeeded despite a "
+                         "wedged probe")
+    except supervisor.ProbeFailure:
+        dt1 = time.time() - t0
+    if dt1 >= 60.0:
+        raise SystemExit(f"scenario 1 took {dt1:.0f}s (want < 60)")
+    print(f"supervisor-smoke: probe wedge detected in {dt1:.1f}s",
+          file=sys.stderr)
+
+    print("supervisor-smoke: scenario 2 (hang@run -> stall, one warm "
+          "restart, escalation)", file=sys.stderr)
+    t0 = time.time()
+    try:
+        supervisor.supervise(
+            supervisor.selftest_cmd(), site="smoke:run-wedge",
+            config=_cfg(), env=_env("hang@run"))
+        raise SystemExit("scenario 2: supervise succeeded despite a "
+                         "wedged workload")
+    except supervisor.SupervisedHang:
+        dt2 = time.time() - t0
+    if dt2 >= 60.0:
+        raise SystemExit(f"scenario 2 took {dt2:.0f}s (want < 60: "
+                         f"stall detection must not burn wall budget)")
+    print(f"supervisor-smoke: stall+restart+escalation in {dt2:.1f}s",
+          file=sys.stderr)
+
+    print("supervisor-smoke: scenario 3 (terminal rung: injection off, "
+          "clean run)", file=sys.stderr)
+    a = supervisor.run_child(supervisor.selftest_cmd(),
+                             wall_timeout_s=WALL_S, quiet_s=QUIET_S,
+                             heartbeat_s=HEARTBEAT_S, env=_env())
+    if a.status != "ok" or not a.json_lines:
+        raise SystemExit(f"scenario 3: clean child failed "
+                         f"(status={a.status} rc={a.rc})")
+
+    # the validated stream needs a backend-bearing manifest; emitted
+    # LAST so the parent stays backend-free while children run (CPU
+    # forced via jax.config — the axon plugin ignores JAX_PLATFORMS)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    telemetry.current().manifest(config=dict(smoke="supervisor"))
+    telemetry.configure(None)
+
+    stalls = _events(tele_path, "heartbeat_stall")
+    restarts = _events(tele_path, "warm_restart")
+    escalations = _events(tele_path, "escalation")
+    probes = _events(tele_path, "probe")
+    run_stalls = [e for e in stalls if e.get("site") == "smoke:run-wedge"]
+    if len(run_stalls) != 2:
+        raise SystemExit(f"want exactly 2 heartbeat_stalls for the "
+                         f"run wedge, got {len(run_stalls)}")
+    if [e.get("site") for e in restarts] != ["smoke:run-wedge"]:
+        raise SystemExit(f"want exactly 1 warm_restart (run wedge), "
+                         f"got {len(restarts)}")
+    if len([e for e in escalations
+            if e.get("site") == "smoke:run-wedge"]) != 1:
+        raise SystemExit("want exactly 1 escalation for the run wedge")
+    if len([e for e in escalations
+            if e.get("site") == "smoke:probe-wedge"]) != 1:
+        raise SystemExit("want exactly 1 escalation for the probe wedge")
+    if len(probes) < 3:  # scenario 1 probe + scenario 2 pre-run + gate
+        raise SystemExit(f"want >= 3 probe events, got {len(probes)}")
+    _validate_stream(tele_path, "supervisor,fault_injected")
+    print(f"supervisor-smoke: PASS (probe wedge {dt1:.1f}s, run wedge "
+          f"{dt2:.1f}s incl. 1 warm restart; trail: {len(probes)} "
+          f"probes, 2 stalls, 1 restart, 2 escalations)")
+
+
+if __name__ == "__main__":
+    main()

@@ -20,7 +20,8 @@ import time
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 ".."))
 
-from cpr_tpu import telemetry  # noqa: E402
+from cpr_tpu import supervisor, telemetry  # noqa: E402
+from cpr_tpu.resilience import fault_point  # noqa: E402
 from cpr_tpu.telemetry import now  # noqa: E402
 
 
@@ -70,10 +71,20 @@ def main():
     n_steps = int(sys.argv[3]) if len(sys.argv) > 3 else 0
     chunk = int(sys.argv[4]) if len(sys.argv) > 4 else 0
 
-    import jax
-    jax.config.update("jax_default_prng_impl", "threefry2x32")
-    jax.config.update("jax_threefry_partitionable", True)
-    log(f"backend={jax.devices()[0].platform}")
+    # supervised-child protocol (cpr_tpu/supervisor): beat before the
+    # jax import so even an init wedge is watchdogged by heartbeat, and
+    # expose the `run` fault site so the smoke harness can wedge this
+    # tool deterministically
+    supervisor.maybe_start_heartbeat()
+    fault_point("run")
+
+    # backend bring-up is legitimately slow and silent — the "init"
+    # phase is slow_ok for the parent's stall rule (wall budget only)
+    with supervisor.child_phase("init"):
+        import jax
+        jax.config.update("jax_default_prng_impl", "threefry2x32")
+        jax.config.update("jax_threefry_partitionable", True)
+        log(f"backend={jax.devices()[0].platform}")
 
     # opt-in ring window for the active-set shapes (bench.py decides
     # the production value; the sweep honors the same knob)

@@ -15,6 +15,11 @@ per-iteration cost, then run (a) a ~40 s call, (b) a ~150 s call, and
 the framework-level fix is chunking long scans/solves across calls
 (exactly what the chunked VI impl does).
 
+Candidates run supervised (bisect_common -> cpr_tpu/supervisor): a
+bounded device probe runs before the first candidate, and each
+candidate is watchdog-bounded, so a wedged chip is detected in seconds
+instead of burning the 420 s candidate timeout.
+
 Usage: python tools/tpu_limit_probe.py [max_candidates]
 """
 

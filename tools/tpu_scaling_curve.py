@@ -1,8 +1,9 @@
 """Batch-scaling curve for the DAG-family bench configs (VERDICT r4 #2).
 
 Measures aggregate env-steps/s at a ladder of batch sizes per config
-(one watchdogged subprocess per point, the bisect_common pattern — a
-crashed worker must not take the whole curve down) and writes
+(one supervised subprocess per point — cpr_tpu/supervisor: heartbeat
+stall detection, probe-before-run, probe-gated warm restart — so a
+crashed worker costs one point, not the whole curve) and writes
 BENCH_SCALING_<round>.json.  Round-4 context: the aggregate rate PEAKED
 at 4-8k envs and DECLINED beyond — upside-down for a throughput device;
 the active-set redesign shrinks per-step bytes so the curve should now
@@ -14,12 +15,17 @@ Usage: python tools/tpu_scaling_curve.py [bk|ethereum|tailstorm ...]
 
 import json
 import os
-import subprocess
 import sys
-import tempfile
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# parent stays backend-free: cpr_tpu imports never initialize a device
+# (each child process owns the TPU), so the shared supervisor and the
+# atomic-write helpers are safe here
+from cpr_tpu import supervisor  # noqa: E402
+from cpr_tpu.resilience import TransientFault, atomic_write_json  # noqa: E402
 
 LADDER = (1024, 4096, 8192, 16384, 32768, 65536)
 
@@ -32,29 +38,33 @@ SHAPES = {
 
 
 def measure_point(config, n_envs, timeout=600.0):
-    """One subprocess measurement via tools/tpu_dag_sweep.py."""
+    """One supervised subprocess measurement via tools/tpu_dag_sweep.py
+    (the child beats, so a wedge is caught by heartbeat stall; a hang
+    earns one probe-gated warm restart before this returns an error
+    row)."""
     n_steps, chunk = SHAPES[config]
     cmd = [sys.executable, os.path.join("tools", "tpu_dag_sweep.py"),
            config, str(n_envs), str(n_steps)]
     if chunk:
         cmd.append(str(chunk))
-    proc = subprocess.Popen(cmd, cwd=REPO, stdout=subprocess.PIPE,
-                            stderr=subprocess.PIPE, text=True)
     try:
-        out, err = proc.communicate(timeout=timeout)
-    except subprocess.TimeoutExpired:
-        proc.kill()
-        try:
-            proc.communicate(timeout=20)
-        except subprocess.TimeoutExpired:
-            pass
+        out = supervisor.supervise(
+            cmd, site=f"scaling:{config}:{n_envs}", cwd=REPO,
+            config=supervisor.SupervisorConfig.from_env(
+                wall_timeout_s=timeout))
+    except supervisor.ProbeFailure:
+        return {"n_envs": n_envs, "error": "hung",
+                "note": "device probe failed before the run"}
+    except supervisor.SupervisedHang:
         return {"n_envs": n_envs, "error": "hung"}
-    sys.stderr.write(err or "")
-    lines = [ln for ln in (out or "").splitlines() if ln.startswith("{")]
-    if proc.returncode != 0 or not lines:
-        return {"n_envs": n_envs, "error": f"rc={proc.returncode}"}
-    row = json.loads(lines[-1])
+    except TransientFault as e:
+        rc = getattr(e, "rc", None)
+        return {"n_envs": n_envs,
+                "error": f"rc={rc}" if rc is not None else str(e)}
+    row = json.loads(out.payload.splitlines()[-1])
     row["n_envs"] = n_envs
+    if out.restarts:
+        row["restart_count"] = out.restarts
     return row
 
 
@@ -79,16 +89,12 @@ def main():
                   f"({time.time() - t0:.0f}s)", flush=True)
             rows[:] = [r for r in rows if r.get("n_envs") != n_envs]
             rows.append(row)
-            # inline tmp+replace (the resilience.atomic_write pattern):
             # this bank is re-read on resume, so a crash mid-dump would
-            # poison the whole curve — but the parent must stay jax-free
-            # (each child process owns the TPU), so no cpr_tpu import
-            fd, tmp = tempfile.mkstemp(dir=REPO,
-                                       prefix=".bench_scaling.")
-            with os.fdopen(fd, "w") as f:
-                json.dump(curves, f, indent=2)
-            os.replace(tmp, path)
+            # poison the whole curve: atomic write only
+            atomic_write_json(path, curves)
             if row.get("error") == "hung":
+                # the supervisor already probed and warm-restarted once;
+                # a hang surviving that means the device is really gone
                 print("wedged device? stopping this config", flush=True)
                 break
     print(f"wrote {path}")

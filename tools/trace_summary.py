@@ -117,6 +117,7 @@ def summarize(events, out=sys.stdout):
     _device_metrics_tables(events, out)
     _vi_residuals_lines(events, out)
     _resilience_lines(events, out)
+    _supervisor_lines(events, out)
     _perf_gate_lines(events, out)
     for m in (e for e in events if e.get("kind") == "manifest"):
         cfg = m.get("config") or {}
@@ -125,7 +126,7 @@ def summarize(events, out=sys.stdout):
               f"jax={m.get('jax_version')} git={str(m.get('git_sha'))[:12]} "
               f"config={json.dumps(cfg, sort_keys=True)}", file=out)
     tabled = ("compile", "device_metrics", "vi_residuals", "retry",
-              "checkpoint", "perf_gate")
+              "checkpoint", "perf_gate", "supervisor")
     for e in (e for e in events if e.get("kind") == "event"
               and e.get("name") not in tabled):
         keys = {k: v for k, v in e.items() if k not in ("kind", "ts")}
@@ -206,6 +207,24 @@ def _resilience_lines(events, out):
     if ckpts:
         kinds = " ".join(f"{k}={n}" for k, n in sorted(ckpts.items()))
         print(f"\ncheckpoints written: {kinds}", file=out)
+
+
+def _supervisor_lines(events, out):
+    """Schema-v6 supervisor decisions (cpr_tpu/supervisor): the
+    chronological probe / stall / warm-restart / escalation trail per
+    supervised site — the story of how a device round degraded (or
+    recovered) reads straight down this table."""
+    evs = [e for e in events if e.get("kind") == "event"
+           and e.get("name") == "supervisor"]
+    if not evs:
+        return
+    print(f"\n{'supervisor action':<18} {'site':<24} {'dur_s':>8} "
+          f"reason", file=out)
+    for e in evs:
+        dur = e.get("dur_s")
+        dur_txt = f"{dur:.1f}" if isinstance(dur, (int, float)) else "-"
+        print(f"{str(e.get('action')):<18} {str(e.get('site')):<24} "
+              f"{dur_txt:>8} {e.get('reason')}", file=out)
 
 
 def _perf_gate_lines(events, out):
