@@ -4,7 +4,7 @@
 
 CPU_MESH = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: lint test test-slow bench perf-gate telemetry-smoke netsim-smoke resilience-smoke supervisor-smoke dryrun sweeps ghostdag train-dummy native asan
+.PHONY: lint test test-slow bench perf-gate telemetry-smoke netsim-smoke resilience-smoke supervisor-smoke serve-smoke dryrun sweeps ghostdag train-dummy native asan
 
 lint:  ## jaxlint over cpr_tpu/ + tools/ (pure AST, no JAX import,
 	## ~1s); banks the JSON report under runs/ like the smoke flows
@@ -83,6 +83,17 @@ supervisor-smoke:  ## supervised-subprocess proof: injected hang@probe
 	## typed v6 `supervisor` event trail
 	rm -rf $(SUPERVISOR_SMOKE_DIR)
 	python tools/supervisor_smoke.py $(SUPERVISOR_SMOKE_DIR)
+
+SERVE_SMOKE_DIR = /tmp/cpr-serve-smoke
+
+serve-smoke:  ## continuous-batching service proof: supervised server
+	## child, ~32 concurrent clients across the policy / interactive /
+	## netsim / break-even endpoints, sustained full-occupancy
+	## throughput within 20% of an equivalent batch rollout(), graceful
+	## SIGTERM drain, v7 `serve` trace validation, and throughput rows
+	## banked + gated in the perf ledger.  Details: docs/SERVING.md
+	rm -rf $(SERVE_SMOKE_DIR)
+	python tools/serve_smoke.py $(SERVE_SMOKE_DIR)
 
 dryrun:  ## multi-chip sharding dry run on the virtual CPU mesh
 	$(CPU_MESH) python -c "from __graft_entry__ import dryrun_multichip; dryrun_multichip(8)"

@@ -22,6 +22,7 @@ HOT_CARRY_PATHS = (
     "cpr_tpu/envs/base.py",
     "cpr_tpu/train/ppo.py",
     "cpr_tpu/netsim/engine.py",
+    "cpr_tpu/serve/engine.py",
 )
 HOT_CARRY_PREFIXES = ("cpr_tpu/parallel/",)
 

@@ -344,6 +344,31 @@ def update_rollout(spec: MetricsSpec, acc: dict, *, reward, done,
     return acc
 
 
+# -- the serving spec ---------------------------------------------------------
+
+
+def serve_spec() -> MetricsSpec:
+    """Cells for the cpr_tpu.serve resident engine: throughput
+    counters (`env_steps`/`episodes`/`bursts`), the `occupancy` spread
+    (fraction of lanes assigned to client sessions, one observation
+    per burst), and the `burst_s` dispatch-latency spread (host wall
+    seconds per resident burst call, folded once at drain from the
+    durations the engine already records for its throughput report).
+
+    Same overhead contract as the stats drivers: the in-graph cells
+    fold ONCE PER BURST from the burst call's own inputs/outputs
+    (occupancy scalar, stacked done column) — nothing new is consumed
+    per step, so the scan-loop program is identical to the metrics-off
+    build."""
+    spec = MetricsSpec()
+    spec.counter("env_steps")
+    spec.counter("episodes")
+    spec.counter("bursts")
+    spec.stats("occupancy")
+    spec.stats("burst_s")
+    return spec
+
+
 # -- the PPO update spec ------------------------------------------------------
 
 

@@ -36,6 +36,13 @@ INFO_KEYS = (
 )
 
 
+def _lane_where(mask, a, b):
+    """Per-lane select with the (n_lanes,) mask broadcast over trailing
+    axes — the splice primitive of the resident lane API."""
+    m = mask.reshape(mask.shape + (1,) * (a.ndim - 1))
+    return jnp.where(m, a, b)
+
+
 class JaxEnv:
     """Abstract jittable environment.
 
@@ -150,6 +157,29 @@ class JaxEnv:
         key, k0 = jax.random.split(key)
         return self.reset(k0, params)
 
+    def _lane_step(self, state, action, params: EnvParams):
+        """One auto-resetting transition of a single episode stream:
+        step, then reset from the post-step PRNG key, then splice the
+        fresh state in where the episode ended.
+
+        This is the unit every driver in the repo advances streams by —
+        `_autoreset_body` (hence `rollout` and both stats drivers) and
+        the resident `step_lanes`/serve programs all call it, which is
+        what makes a resident lane bit-identical to a solo rollout of
+        the same key.
+
+        Returns (state, obs_next, step_obs, reward, done, info) where
+        `obs_next` is the continuation observation (post-reset at done)
+        and `step_obs` is the raw post-step observation (terminal at
+        done — the single-env gym surface returns this one)."""
+        state, obs2, reward, done, info = self.step(state, action, params)
+        # auto-reset, keeping the state PRNG stream
+        rkey = state.key
+        rstate, robs = self.reset(rkey, params)
+        state = self.select_reset(done, rstate, state)
+        obs_next = jnp.where(done, robs, obs2)
+        return state, obs_next, obs2, reward, done, info
+
     def _autoreset_body(self, params: EnvParams, policy: Callable):
         """Scan body of an auto-resetting episode stream (shared by
         `rollout` and the chunked stats driver so both advance the
@@ -171,15 +201,81 @@ class JaxEnv:
             # (used to execute MDP-solver policies that need e.g. the fork
             # relevance flag, which the observation does not expose)
             action = policy(state, obs) if takes_state else policy(obs)
-            state, obs2, reward, done, info = self.step(state, action, params)
-            # auto-reset, keeping the state PRNG stream
-            rkey = state.key
-            rstate, robs = self.reset(rkey, params)
-            state = self.select_reset(done, rstate, state)
-            obs_next = jnp.where(done, robs, obs2)
+            state, obs_next, _, reward, done, info = self._lane_step(
+                state, action, params)
             return (state, obs_next), (obs, action, reward, done, info)
 
         return body
+
+    # -- resident lane API (continuous batching) --------------------------
+    #
+    # The step-wise twin of `rollout`: a block of `n_lanes` independent
+    # auto-resetting episode streams held resident on the device, with
+    # lanes admitted (spliced from a fresh state) and retired (simply
+    # stopped being stepped) on any tick.  cpr_tpu.serve multiplexes
+    # concurrent client sessions onto these lanes; the gym adapters run
+    # on the same programs with constant masks.  All three entry points
+    # are jitted ON THE CLASS (static self), so every Core/BatchedCore/
+    # serve instance over the same registry-memoized env shares one
+    # compiled program instead of re-jitting per instance.
+
+    @partial(jax.jit, static_argnums=0)
+    def init_lanes(self, keys, params: EnvParams):
+        """Fresh per-lane (state, obs) carry from per-lane keys, using
+        the same stream prologue as `rollout` (split, then reset) — a
+        lane admitted with key K therefore replays `rollout(K, ...)`
+        bit-for-bit."""
+        return jax.vmap(lambda k: self._stream_init(k, params))(keys)
+
+    @partial(jax.jit, static_argnums=0)
+    def reset_lanes(self, keys, params: EnvParams):
+        """Fresh per-lane (state, obs) carry via a raw vmapped reset
+        (no prologue split) — the gym adapters' historical seeding."""
+        return jax.vmap(lambda k: self.reset(k, params))(keys)
+
+    @partial(jax.jit, static_argnums=0, donate_argnums=1)
+    def step_lanes(self, carry, actions, admit_mask, fresh_states,
+                   step_mask, params: EnvParams):
+        """Advance the resident lane block one tick.
+
+        carry        -- (state, obs) with leading lane axis; DONATED —
+                        callers must replace their handle with the
+                        returned carry and must not pass buffers
+                        aliasing it as `fresh_states`.
+        actions      -- int32 (n_lanes,); only read where step_mask.
+        admit_mask   -- bool (n_lanes,); lanes spliced from
+                        `fresh_states` BEFORE stepping (admission).
+        fresh_states -- (state, obs) like carry (e.g. from init_lanes /
+                        reset_lanes); only read where admit_mask.
+        step_mask    -- bool (n_lanes,); lanes that execute one
+                        `_lane_step` this tick.  Held lanes (neither
+                        admitted nor stepped) keep their state — PRNG
+                        key included — bit-exactly.
+
+        Returns (carry, (obs, reward, done, info)) where the output
+        `obs` is the raw post-step observation for stepped lanes
+        (terminal at done; the continuation obs lives in the carry) and
+        the post-admission held observation for the rest — so a
+        splice-only call (admit without step) reads the admitted lane's
+        first observation straight from the outputs.  reward/done/info
+        are zero/False/zero outside step_mask."""
+        state, obs = carry
+        fstate, fobs = fresh_states
+        state = jax.tree.map(
+            lambda a, b: _lane_where(admit_mask, a, b), fstate, state)
+        obs = _lane_where(admit_mask, fobs, obs)
+        new_state, obs_next, step_obs, reward, done, info = jax.vmap(
+            lambda s, a: self._lane_step(s, a, params))(state, actions)
+        live = step_mask
+        state = jax.tree.map(
+            lambda a, b: _lane_where(live, a, b), new_state, state)
+        out_obs = _lane_where(live, step_obs, obs)
+        obs = _lane_where(live, obs_next, obs)
+        reward = jnp.where(live, reward, jnp.zeros_like(reward))
+        done = done & live
+        info = {k: jnp.where(live, v, jnp.zeros_like(v))
+                for k, v in info.items()}
+        return (state, obs), (out_obs, reward, done, info)
 
     @partial(jax.jit, static_argnums=(0, 3, 4, 5))
     def rollout(self, key: jax.Array, params: EnvParams, policy: Callable,

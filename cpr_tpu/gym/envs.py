@@ -7,10 +7,16 @@ registered ids (:96,166-192).  The north-star contract is the same:
 external trainer, with the TPU/JAX engine behind the step call.
 
 Where the reference marshals through a CPython extension into the OCaml
-runtime, this adapter jits the env's reset/step once per instance and
+runtime, this adapter drives the env's resident lane API
+(`JaxEnv.step_lanes`, jitted once on the class) with constant masks and
 feeds numpy scalars across — the single-env gym surface is the
 compatibility path; high-throughput training uses the vmap'd rollout
-kernels directly (cpr_tpu.train.ppo) or `BatchedCore` below.
+kernels directly (cpr_tpu.train.ppo) or `BatchedCore` below.  Routing
+both adapters through the one resident program (instead of a fresh
+`jax.jit(proto.step)` per instance) means N adapter instances over the
+same registry-memoized env share a single compiled step — and
+`BatchedCore.step` is one device dispatch with a donated carry instead
+of step-then-maybe-reset double dispatch behind a host sync.
 """
 
 from __future__ import annotations
@@ -59,16 +65,23 @@ class Core(gymnasium.Env):
             defenders=defenders, max_steps=max_steps,
             max_progress=max_progress, max_time=max_time)
 
-        self._reset_fn = jax.jit(proto.reset)
-        self._step_fn = jax.jit(proto.step)
         self._key = jax.random.PRNGKey(seed)
-        self._state = None
+        # width-1 resident lane block: (state, obs) carry + constant
+        # masks (never admit through step_lanes; always step lane 0)
+        self._carry = None
+        self._fresh = None
+        self._no_admit = jnp.zeros(1, bool)
+        self._step_all = jnp.ones(1, bool)
         self.params = None
 
         self.action_space = gymnasium.spaces.Discrete(proto.n_actions)
         self.observation_space = gymnasium.spaces.Box(
             np.asarray(proto.low, np.float64),
             np.asarray(proto.high, np.float64), dtype=np.float64)
+
+    def _state0(self):
+        """Unbatched env state of the single lane (render/policy)."""
+        return jax.tree.map(lambda a: a[0], self._carry[0])
 
     # -- gymnasium API ---------------------------------------------------
 
@@ -78,22 +91,26 @@ class Core(gymnasium.Env):
             self._key = jax.random.PRNGKey(seed)
         self.params = make_params(**self.core_kwargs)
         self._key, k = jax.random.split(self._key)
-        self._state, obs = self._reset_fn(k, self.params)
-        return np.asarray(obs, np.float64), {}
+        # two dispatches of the same program so the fresh template and
+        # the (donated!) carry never share buffers
+        self._fresh = self.jax_env.reset_lanes(k[None], self.params)
+        self._carry = self.jax_env.reset_lanes(k[None], self.params)
+        return np.asarray(self._carry[1][0], np.float64), {}
 
     def step(self, action):
-        self._state, obs, reward, done, info = self._step_fn(
-            self._state, jnp.int32(action), self.params)
-        info = {k: float(v) for k, v in info.items()}
-        return (np.asarray(obs, np.float64), float(reward), bool(done),
-                False, info)
+        self._carry, (obs, reward, done, info) = self.jax_env.step_lanes(
+            self._carry, jnp.asarray([action], jnp.int32), self._no_admit,
+            self._fresh, self._step_all, self.params)
+        info = {k: float(v[0]) for k, v in info.items()}
+        return (np.asarray(obs[0], np.float64), float(reward[0]),
+                bool(done[0]), False, info)
 
     def render(self):
         fields = getattr(self.jax_env, "fields", ())
-        if self._state is None or not fields:
+        if self._carry is None or not fields:
             print(f"<{type(self.jax_env).__name__}: not reset>")
             return
-        obs = np.asarray(self.jax_env.observe(self._state))
+        obs = np.asarray(self.jax_env.observe(self._state0()))
         vals = self.jax_env.decode_obs(obs)
         print(", ".join(f"{f.name}={int(v)}"
                         for f, v in zip(fields, vals)))
@@ -111,7 +128,7 @@ class Core(gymnasium.Env):
                 f"{name} is not a valid policy; choose from "
                 + ", ".join(self.policies()))
         if getattr(fn, "takes_state", False):
-            return int(fn(self._state, jnp.asarray(obs, jnp.float32)))
+            return int(fn(self._state0(), jnp.asarray(obs, jnp.float32)))
         return int(fn(jnp.asarray(obs, jnp.float32)))
 
 
@@ -132,9 +149,10 @@ class BatchedCore(gymnasium.Env):
         self.core_kwargs = self._single.core_kwargs
         self.n_envs = n_envs
         self._key = jax.random.PRNGKey(seed)
-        self._reset_fn = jax.jit(jax.vmap(env.reset, in_axes=(0, None)))
-        self._step_fn = jax.jit(jax.vmap(env.step, in_axes=(0, 0, None)))
-        self._state = None
+        self._carry = None
+        self._fresh = None
+        self._no_admit = jnp.zeros(n_envs, bool)
+        self._step_all = jnp.ones(n_envs, bool)
         self.params = None
         self.action_space = gymnasium.spaces.MultiDiscrete(
             np.full(n_envs, env.n_actions))
@@ -148,23 +166,26 @@ class BatchedCore(gymnasium.Env):
             self._key = jax.random.PRNGKey(seed)
         self.params = make_params(**self.core_kwargs)
         self._key, k = jax.random.split(self._key)
-        self._state, obs = self._reset_fn(
-            jax.random.split(k, self.n_envs), self.params)
-        return np.asarray(obs, np.float64), {}
+        keys = jax.random.split(k, self.n_envs)
+        # distinct buffers: the carry is donated on every step while the
+        # fresh template must stay alive for the (constant-false) admit;
+        # the template is never spliced, so it draws its own folded
+        # stream instead of replaying `keys`
+        self._fresh = self.jax_env.reset_lanes(
+            jax.random.split(jax.random.fold_in(k, 1), self.n_envs),
+            self.params)
+        self._carry = self.jax_env.reset_lanes(keys, self.params)
+        return np.asarray(self._carry[1], np.float64), {}
 
     def step(self, actions):
-        state, obs, reward, done, info = self._step_fn(
-            self._state, jnp.asarray(actions, jnp.int32), self.params)
+        # one resident dispatch: step + per-lane auto-reset fused, each
+        # lane keeping its own PRNG stream (previously: vmapped step,
+        # host sync on done, then a second reset+splice dispatch)
+        self._carry, (_, reward, done, info) = self.jax_env.step_lanes(
+            self._carry, jnp.asarray(actions, jnp.int32), self._no_admit,
+            self._fresh, self._step_all, self.params)
+        obs = self._carry[1]  # continuation obs: post-reset at done
         np_done = np.asarray(done)
-        if np_done.any():
-            # per-lane auto-reset, keeping each lane's PRNG stream
-            rstate, robs = self._reset_fn(state.key, self.params)
-            state = jax.tree.map(
-                lambda a, b: jnp.where(
-                    done.reshape(done.shape + (1,) * (a.ndim - 1)), a, b),
-                rstate, state)
-            obs = jnp.where(done[:, None], robs, obs)
-        self._state = state
         info = {k: np.asarray(v) for k, v in info.items()}
         return (np.asarray(obs, np.float64), np.asarray(reward),
                 np_done, np.zeros_like(np_done), info)

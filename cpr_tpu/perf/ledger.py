@@ -14,7 +14,11 @@ bench.py):
 
 `iter_trace_rows` additionally lifts the span rates out of a telemetry
 JSONL trace (`per_sec` counters under the stream's manifest backend),
-so sweep/training traces land on the same trend surface as bench rows.
+so sweep/training traces land on the same trend surface as bench rows,
+and the drain-time `serve` report events of the serving layer
+(cpr_tpu/serve) as `serve_steps_per_sec` / `serve_occupancy` rows — a
+serving session's sustained throughput is banked and gated exactly
+like a bench row.
 
 Ledger records (`ledger: 2` — v2 added the supervisor provenance
 fields `probe` and `restart_count`, which changed every row_id; the
@@ -157,11 +161,18 @@ def iter_bank_rows(root: str):
                 yield row, base, rnd, hint
 
 
+# serve report detail key -> (ledger metric, unit); rates in a report
+# are over busy (dispatch) wall time — see ResidentEngine.report
+_SERVE_METRICS = (("steps_per_sec", "serve_steps_per_sec", "steps/sec"),
+                  ("occupancy", "serve_occupancy", "fraction"))
+
+
 def iter_trace_rows(path: str):
     """Yield ledger-shaped rows from a telemetry JSONL trace: one per
     span carrying `per_sec` counters, metric `<span path>:<counter>`,
-    backend/config taken from the last manifest seen before the span
-    (the stream layout every producer follows)."""
+    plus two per `serve` report event (the serving layer's drain-time
+    throughput summary); backend/config taken from the last manifest
+    seen before the row (the stream layout every producer follows)."""
     base = os.path.basename(path)
     backend, config = None, {}
     with open(path) as f:
@@ -182,6 +193,17 @@ def iter_trace_rows(path: str):
                     yield ({"metric": f"{e.get('path')}:{counter}_per_sec",
                             "backend": backend, "value": rate,
                             "unit": f"{counter}/sec",
+                            **{f"cfg_{k}": v for k, v in config.items()}},
+                           base)
+            elif (e.get("kind") == "event" and e.get("name") == "serve"
+                  and e.get("action") == "report"):
+                detail = e.get("detail") or {}
+                for key, metric, unit in _SERVE_METRICS:
+                    value = detail.get(key)
+                    if not isinstance(value, (int, float)):
+                        continue
+                    yield ({"metric": metric, "backend": backend,
+                            "value": value, "unit": unit,
                             **{f"cfg_{k}": v for k, v in config.items()}},
                            base)
 

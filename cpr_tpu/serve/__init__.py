@@ -1,0 +1,27 @@
+"""cpr_tpu.serve — a continuously-batched evaluation & policy service.
+
+One jitted, vmapped device program (the resident lane API grown on
+`JaxEnv` in envs/base.py) stays resident for the life of the process;
+an asyncio front-end multiplexes many concurrent client episodes onto
+its lanes via continuous batching — lanes are admitted (spliced from a
+fresh state) and retired on any device tick instead of padding work to
+rollout boundaries.  The sampler/inference decoupling follows
+*Accelerated Methods for Deep RL* (arXiv:1803.02811).
+
+Layers (docs/SERVING.md has the full protocol and ops runbook):
+
+  engine.py    ResidentEngine — owns the donated (state, obs) lane
+               carry and the two resident programs: the interactive
+               `step_lanes` tick and the K-step policy burst (scan with
+               the policy table compiled in via `lax.switch`).
+  scheduler.py LaneScheduler — host-side sessions->lanes placement and
+               the admission queue (backfill source for freed lanes).
+  server.py    asyncio front-end: length-prefixed JSON protocol,
+               trained-policy / netsim / break-even endpoints, serve
+               telemetry, supervisor heartbeats, SIGTERM drain.
+  protocol.py  frame codec + a blocking client for tools and tests.
+"""
+
+from cpr_tpu.serve.engine import ResidentEngine  # noqa: F401
+from cpr_tpu.serve.protocol import ServeClient  # noqa: F401
+from cpr_tpu.serve.scheduler import LaneScheduler  # noqa: F401

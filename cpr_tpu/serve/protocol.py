@@ -1,0 +1,102 @@
+"""Wire protocol for cpr_tpu.serve: length-prefixed JSON frames.
+
+One frame = a 4-byte big-endian unsigned length followed by that many
+bytes of UTF-8 JSON.  Requests and responses are JSON objects; every
+request carries an `op` key, every response an `ok` bool.  The server
+answers frames on one connection strictly in order, so a blocking
+request/response client (`ServeClient`, used by tools/serve_smoke.py
+and the tests) needs no correlation ids.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+
+_HEADER = struct.Struct(">I")
+# generous ceiling: the largest legitimate frame (an interactive step
+# info dict) is well under 1 MB; anything bigger is a framing bug
+MAX_FRAME = 16 << 20
+
+
+class ProtocolError(RuntimeError):
+    pass
+
+
+def pack_frame(obj) -> bytes:
+    body = json.dumps(obj, separators=(",", ":")).encode("utf-8")
+    if len(body) > MAX_FRAME:
+        raise ProtocolError(f"frame of {len(body)} bytes exceeds "
+                            f"MAX_FRAME={MAX_FRAME}")
+    return _HEADER.pack(len(body)) + body
+
+
+def _decode(body: bytes):
+    try:
+        return json.loads(body.decode("utf-8"))
+    except ValueError as e:
+        raise ProtocolError(f"undecodable frame: {e}") from e
+
+
+async def read_frame(reader):
+    """Read one frame from an asyncio StreamReader; None on clean EOF
+    at a frame boundary."""
+    import asyncio
+
+    try:
+        header = await reader.readexactly(_HEADER.size)
+    except asyncio.IncompleteReadError as e:
+        if not e.partial:
+            return None
+        raise ProtocolError("connection closed mid-header") from e
+    (n,) = _HEADER.unpack(header)
+    if n > MAX_FRAME:
+        raise ProtocolError(f"frame length {n} exceeds {MAX_FRAME}")
+    try:
+        body = await reader.readexactly(n)
+    except asyncio.IncompleteReadError as e:
+        raise ProtocolError("connection closed mid-frame") from e
+    return _decode(body)
+
+
+async def write_frame(writer, obj):
+    writer.write(pack_frame(obj))
+    await writer.drain()
+
+
+class ServeClient:
+    """Blocking request/response client over one TCP connection."""
+
+    def __init__(self, host: str, port: int, timeout: float = 60.0):
+        self._sock = socket.create_connection((host, port),
+                                              timeout=timeout)
+
+    def _recv_exact(self, n: int) -> bytes:
+        chunks = []
+        while n:
+            chunk = self._sock.recv(n)
+            if not chunk:
+                raise ProtocolError("server closed the connection")
+            chunks.append(chunk)
+            n -= len(chunk)
+        return b"".join(chunks)
+
+    def request(self, op: str, **fields):
+        self._sock.sendall(pack_frame(dict(fields, op=op)))
+        (n,) = _HEADER.unpack(self._recv_exact(_HEADER.size))
+        if n > MAX_FRAME:
+            raise ProtocolError(f"frame length {n} exceeds {MAX_FRAME}")
+        return _decode(self._recv_exact(n))
+
+    def close(self):
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

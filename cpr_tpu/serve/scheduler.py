@@ -1,0 +1,91 @@
+"""Host-side continuous-batching scheduler: sessions -> lanes.
+
+Deliberately jax-free and asyncio-free (plain data structures, unit
+testable in microseconds): the server's tick loop asks `place()` for
+this tick's admissions, the engine does the device-side splice, and
+`retire()` frees a lane the moment its session completes — the next
+`place()` backfills it from the admission queue.  Lane state never
+survives a retire->admit cycle on the device side either: admission
+splices a wholly fresh `init_lanes` state over the slot (the
+reclaimed-slot aliasing class of bug is structurally excluded, and
+tests/test_serve.py proves it bit-for-bit).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+
+
+class LaneScheduler:
+    """Tracks which session owns which lane plus the FIFO admission
+    queue.  Sessions are opaque objects; identity is `is`."""
+
+    def __init__(self, n_lanes: int):
+        if n_lanes <= 0:
+            raise ValueError(f"n_lanes must be positive, got {n_lanes}")
+        self.n_lanes = n_lanes
+        self._owner: list = [None] * n_lanes
+        self._queue: deque = deque()
+
+    # -- admission queue --------------------------------------------------
+
+    def enqueue(self, session) -> int:
+        """Queue a session for admission; returns its queue position
+        (0 = next to be placed)."""
+        self._queue.append(session)
+        return len(self._queue) - 1
+
+    def cancel(self, session) -> bool:
+        """Drop a not-yet-placed session from the queue."""
+        try:
+            self._queue.remove(session)
+            return True
+        except ValueError:
+            return False
+
+    def place(self) -> list:
+        """Assign queued sessions to free lanes (FIFO x ascending lane
+        id); returns [(lane, session), ...] for this tick's admissions."""
+        placed = []
+        for lane in range(self.n_lanes):
+            if not self._queue:
+                break
+            if self._owner[lane] is None:
+                session = self._queue.popleft()
+                self._owner[lane] = session
+                placed.append((lane, session))
+        return placed
+
+    # -- lane table -------------------------------------------------------
+
+    def owner(self, lane: int):
+        return self._owner[lane]
+
+    def retire(self, lane: int):
+        """Free a lane; returns the session that owned it."""
+        session, self._owner[lane] = self._owner[lane], None
+        return session
+
+    def assigned(self) -> dict:
+        """{lane: session} over currently owned lanes."""
+        return {i: s for i, s in enumerate(self._owner) if s is not None}
+
+    def drain(self) -> list:
+        """Evict everything: returns every queued + placed session (in
+        that order) and leaves the scheduler empty."""
+        evicted = list(self._queue) + [s for s in self._owner
+                                       if s is not None]
+        self._queue.clear()
+        self._owner = [None] * self.n_lanes
+        return evicted
+
+    # -- stats ------------------------------------------------------------
+
+    def n_queued(self) -> int:
+        return len(self._queue)
+
+    def n_assigned(self) -> int:
+        return sum(s is not None for s in self._owner)
+
+    def occupancy(self) -> float:
+        return self.n_assigned() / self.n_lanes

@@ -1,0 +1,478 @@
+"""asyncio front-end of the serving layer.
+
+The tick loop is the only writer of device state: each iteration it
+(1) backfills freed lanes from the admission queue (one `splice`
+dispatch seeds all of this tick's admissions), (2) advances every
+interactive lane with a pending client action (one `step_lanes`
+dispatch), (3) advances every policy-driven lane by one K-step burst
+(one dispatch), completing sessions at their first `done` and retiring
+their lanes.  Connection handlers never touch the device — they
+enqueue sessions / pending actions and await futures the tick loop
+resolves, so continuous batching falls out of plain asyncio ordering.
+
+Endpoints beyond the episode surface (netsim honest-net queries and
+break-even lookups) run their own compiled programs on a single-worker
+executor thread, keeping the tick loop responsive; netsim Engines are
+cached per query shape because constructing one compiles.
+
+Operability: every decision emits a typed v7 `serve` telemetry event;
+the child heartbeats to the supervisor (progress = emitted events, so
+an idle-but-alive server never trips the watchdog); SIGTERM lands in
+`resilience.preemption_guard` and the loop drains gracefully — evict
+queued and in-flight sessions with a `draining` reply, emit the
+throughput `report` event (ingested by the perf ledger) and the
+device-metrics summary, close, exit 0.
+
+Run: `python -m cpr_tpu.serve.server --protocol nakamoto ...`
+(tools/serve_smoke.py supervises exactly this).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import os
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+from cpr_tpu import resilience, telemetry
+from cpr_tpu.serve import protocol as wire
+from cpr_tpu.serve.engine import ResidentEngine
+from cpr_tpu.serve.scheduler import LaneScheduler
+
+
+def _serve_event(action: str, session=None, **detail):
+    """The one `serve` event call site (EVENT_FIELDS['serve'])."""
+    telemetry.current().event("serve", action=action, session=session,
+                              detail=detail)
+
+
+class _Session:
+    __slots__ = ("sid", "kind", "seed", "policy", "policy_id", "lane",
+                 "future", "done")
+
+    def __init__(self, sid, kind, seed, policy, policy_id, future):
+        self.sid = sid
+        self.kind = kind
+        self.seed = seed
+        self.policy = policy
+        self.policy_id = policy_id
+        self.lane = None
+        self.future = future
+        self.done = False
+
+
+class ServeServer:
+    """One engine + scheduler + TCP front-end."""
+
+    def __init__(self, engine: ResidentEngine, *, host: str = "127.0.0.1",
+                 port: int = 0, heartbeat_s: float = 1.0,
+                 idle_sleep_s: float = 0.002, seed_base: int = 1 << 20):
+        self.engine = engine
+        self.sched = LaneScheduler(engine.n_lanes)
+        self.host = host
+        self.port = port  # replaced by the bound port after start()
+        self.heartbeat_s = heartbeat_s
+        self.idle_sleep_s = idle_sleep_s
+        self._sid = itertools.count(1)
+        # server-assigned seeds for seedless sessions, clear of the
+        # small integers clients use for reproducible requests
+        self._seed = itertools.count(seed_base)
+        self._sessions: dict[int, _Session] = {}
+        self._pending: dict[int, tuple] = {}  # lane -> (action, fut, s)
+        self._executor = ThreadPoolExecutor(max_workers=1)
+        self._netsim_engines: dict[tuple, object] = {}
+        self._server = None
+        self._loop_task = None
+        self._draining = False
+        self._drain_reason = None
+
+    # -- lifecycle --------------------------------------------------------
+
+    async def start(self):
+        self._server = await asyncio.start_server(
+            self._handle, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        _serve_event("start", port=self.port,
+                     n_lanes=self.engine.n_lanes,
+                     burst=self.engine.burst,
+                     policies=list(self.engine.policy_names))
+        self._loop_task = asyncio.create_task(self._tick_loop())
+
+    async def serve_until_drained(self):
+        await self._loop_task
+
+    def request_drain(self, reason: str):
+        self._drain_reason = self._drain_reason or reason
+
+    # -- the tick loop ----------------------------------------------------
+
+    async def _tick_loop(self):
+        hb_last = telemetry.now()
+        while True:
+            if resilience.preempt_requested():
+                self.request_drain(
+                    f"preempt:{resilience.preempt_reason()}")
+            if self._drain_reason is not None:
+                await self._drain(self._drain_reason)
+                return
+            progressed = self._tick_once()
+            t = telemetry.now()
+            if t - hb_last >= self.heartbeat_s:
+                # periodic even when idle: emitted events are the
+                # supervisor's progress signal, so an idle server
+                # stays distinguishable from a wedged one
+                hb_last = t
+                _serve_event(
+                    "heartbeat",
+                    queued=self.sched.n_queued(),
+                    occupancy=self.sched.occupancy(),
+                    steps=self.engine.steps,
+                    episodes=self.engine.episodes)
+            await asyncio.sleep(0.0 if progressed else self.idle_sleep_s)
+
+    def _tick_once(self) -> bool:
+        progressed = False
+        # 1. admissions: backfill freed lanes from the queue; one
+        # splice dispatch seeds every admission this tick
+        placed = self.sched.place()
+        if placed:
+            obs_rows = self.engine.splice(
+                {lane: s.seed for lane, s in placed})
+            for lane, s in placed:
+                s.lane = lane
+                _serve_event("admit", s.sid, lane=lane, seed=s.seed,
+                             kind=s.kind)
+                if s.kind == "interactive" and not s.future.done():
+                    s.future.set_result(obs_rows[lane])
+            progressed = True
+        # 2. interactive lanes with a pending client action
+        if self._pending:
+            pending, self._pending = self._pending, {}
+            out = self.engine.tick(
+                {lane: a for lane, (a, _, _) in pending.items()})
+            for lane, (_, fut, s) in pending.items():
+                row = out[lane]
+                if row["done"]:
+                    s.done = True
+                    self._sessions.pop(s.sid, None)
+                    self.sched.retire(lane)
+                    _serve_event(
+                        "complete", s.sid, kind="interactive",
+                        n_steps=row["info"]["episode_n_steps"],
+                        reward=row["info"]["episode_reward_attacker"])
+                if not fut.done():
+                    fut.set_result(row)
+            progressed = True
+        # 3. policy-driven lanes: one burst; complete each session at
+        # its first done (the lane keeps streaming to the end of the
+        # burst — executed steps count toward throughput either way —
+        # then retires and is backfilled next tick)
+        policy_lanes = {lane: s
+                        for lane, s in self.sched.assigned().items()
+                        if s.kind == "policy"}
+        if policy_lanes:
+            out = self.engine.burst_run(
+                {lane: s.policy_id for lane, s in policy_lanes.items()},
+                occupancy=self.sched.occupancy())
+            for lane, s in policy_lanes.items():
+                if not out["done"][lane]:
+                    continue  # episode spans into the next burst
+                att = float(out["episode_reward_attacker"][lane])
+                dfn = float(out["episode_reward_defender"][lane])
+                episode = dict(
+                    reward_attacker=att, reward_defender=dfn,
+                    progress=float(out["episode_progress"][lane]),
+                    n_steps=int(out["episode_n_steps"][lane]),
+                    relative_reward=(att / (att + dfn)
+                                     if (att + dfn) else 0.0))
+                if not s.future.done():
+                    s.future.set_result(dict(
+                        ok=True, session=s.sid, seed=s.seed,
+                        policy=s.policy, episode=episode))
+                self.sched.retire(lane)
+                _serve_event("complete", s.sid, kind="policy",
+                             n_steps=episode["n_steps"],
+                             relative_reward=episode["relative_reward"])
+            progressed = True
+        return progressed
+
+    async def _drain(self, reason: str):
+        self._draining = True
+        _serve_event("drain", reason=reason)
+        refusal = dict(ok=False, error="draining", draining=True)
+        for s in self.sched.drain():
+            if not s.future.done():
+                s.future.set_result(dict(refusal, session=s.sid))
+        for _, fut, _s in self._pending.values():
+            if not fut.done():
+                fut.set_result(dict(refusal))
+        self._pending.clear()
+        self._sessions.clear()
+        report = self.engine.report()
+        _serve_event("report", **report)
+        self.engine.emit_metrics()
+        _serve_event("stop", reason=reason, steps=report["steps"],
+                     episodes=report["episodes"])
+        self._server.close()
+        await self._server.wait_closed()
+        self._executor.shutdown(wait=False)
+
+    # -- connections ------------------------------------------------------
+
+    async def _handle(self, reader, writer):
+        try:
+            while True:
+                req = await wire.read_frame(reader)
+                if req is None:
+                    break
+                try:
+                    resp = await self._dispatch(req)
+                except Exception as e:  # noqa: BLE001 — per-request wall
+                    resp = dict(ok=False,
+                                error=f"{type(e).__name__}: {e}")
+                await wire.write_frame(writer, resp)
+        except (wire.ProtocolError, ConnectionError, asyncio.CancelledError):
+            pass
+        finally:
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except (ConnectionError, OSError):
+                pass
+
+    async def _dispatch(self, req: dict) -> dict:
+        op = req.get("op")
+        if op == "hello":
+            return dict(ok=True, schema=telemetry.SCHEMA_VERSION,
+                        n_lanes=self.engine.n_lanes,
+                        burst=self.engine.burst,
+                        policies=list(self.engine.policy_names))
+        if op == "stats":
+            return dict(ok=True, report=self.engine.report(),
+                        queued=self.sched.n_queued(),
+                        assigned=self.sched.n_assigned(),
+                        occupancy=self.sched.occupancy())
+        if op == "drain":
+            self.request_drain(str(req.get("reason", "client")))
+            return dict(ok=True, draining=True)
+        if op == "episode.run":
+            return await self._op_episode_run(req)
+        if op == "episode.open":
+            return await self._op_episode_open(req)
+        if op == "episode.step":
+            return await self._op_episode_step(req)
+        if op == "episode.close":
+            return self._op_episode_close(req)
+        if op == "netsim.query":
+            out = await self._blocking(self._netsim_query, req)
+            _serve_event("query", endpoint="netsim",
+                         protocol=out.get("protocol"))
+            return out
+        if op in ("break_even.revenue", "break_even.alpha"):
+            out = await self._blocking(self._break_even, req, op)
+            _serve_event("query", endpoint=op,
+                         protocol=req.get("protocol"))
+            return out
+        return dict(ok=False, error=f"unknown op {op!r}")
+
+    def _new_session(self, kind: str, req: dict) -> _Session:
+        if self._draining or self._drain_reason is not None:
+            raise RuntimeError("draining")
+        policy = req.get("policy", "honest")
+        if kind == "policy" and policy not in self.engine.policy_ids:
+            raise ValueError(
+                f"unknown policy {policy!r}; serving "
+                f"{list(self.engine.policy_names)}")
+        seed = int(req["seed"]) if "seed" in req and req["seed"] is not None \
+            else next(self._seed)
+        return _Session(next(self._sid), kind, seed, policy,
+                        self.engine.policy_ids.get(policy),
+                        asyncio.get_running_loop().create_future())
+
+    async def _op_episode_run(self, req):
+        s = self._new_session("policy", req)
+        self.sched.enqueue(s)
+        return await s.future
+
+    async def _op_episode_open(self, req):
+        s = self._new_session("interactive", req)
+        self.sched.enqueue(s)
+        obs = await s.future
+        if isinstance(obs, dict):  # drained before admission
+            return obs
+        self._sessions[s.sid] = s
+        return dict(ok=True, session=s.sid, seed=s.seed,
+                    obs=np.asarray(obs, np.float64).tolist())
+
+    async def _op_episode_step(self, req):
+        s = self._sessions.get(req.get("session"))
+        if s is None or s.lane is None or s.done:
+            return dict(ok=False, error="no such open session")
+        if s.lane in self._pending:
+            return dict(ok=False, error="step already in flight")
+        fut = asyncio.get_running_loop().create_future()
+        self._pending[s.lane] = (int(req["action"]), fut, s)
+        row = await fut
+        if "ok" in row:  # drained refusal
+            return row
+        return dict(ok=True, session=s.sid,
+                    obs=np.asarray(row["obs"], np.float64).tolist(),
+                    reward=row["reward"], done=row["done"],
+                    info=row["info"])
+
+    def _op_episode_close(self, req):
+        s = self._sessions.pop(req.get("session"), None)
+        if s is not None and s.lane is not None and not s.done \
+                and self.sched.owner(s.lane) is s:
+            self.sched.retire(s.lane)
+            _serve_event("complete", s.sid, kind="interactive",
+                         closed=True)
+        return dict(ok=True)
+
+    async def _blocking(self, fn, *args):
+        return await asyncio.get_running_loop().run_in_executor(
+            self._executor, fn, *args)
+
+    # -- query endpoints (executor thread) --------------------------------
+
+    def _netsim_query(self, req: dict) -> dict:
+        from cpr_tpu import netsim
+        from cpr_tpu.network import symmetric_clique
+
+        proto = req.get("protocol", "nakamoto")
+        k = int(req.get("k", 1))
+        scheme = req.get("scheme", "constant")
+        if not netsim.supports(proto, k, scheme):
+            raise ValueError(
+                f"netsim does not support ({proto}, k={k}, {scheme}); "
+                f"supported protocols: {netsim.SUPPORTED_PROTOCOLS}")
+        n_nodes = int(req.get("n_nodes", 10))
+        act_delay = float(req.get("activation_delay", 1.0))
+        prop_delay = float(req.get("propagation_delay", 1.0))
+        n_act = int(req.get("activations", 1000))
+        seed = int(req.get("seed", 0))
+        ckey = (proto, k, scheme, n_nodes, act_delay, prop_delay, n_act)
+        eng = self._netsim_engines.get(ckey)
+        if eng is None:
+            # constructing an Engine compiles its XLA program — cache
+            # per query shape so repeated queries cost one dispatch
+            net = symmetric_clique(n_nodes, activation_delay=act_delay,
+                                   propagation_delay=prop_delay)
+            eng = netsim.Engine(net, protocol=proto, k=k, scheme=scheme,
+                                activations=n_act)
+            self._netsim_engines[ckey] = eng
+        out = eng.run([seed], [act_delay])
+        progress = float(out["progress"][0])
+        return dict(
+            ok=True, protocol=proto, seed=seed,
+            rewards=[float(r) for r in out["reward"][0]],
+            activations=[int(a) for a in out["node_act"][0]],
+            progress=progress,
+            orphan_rate=max(0.0, 1.0 - progress / n_act),
+            sim_time=float(out["sim_time"][0]),
+            head_height=int(out["head_height"][0]),
+            n_blocks=int(out["n_blocks"][0]),
+            on_chain=float(out["on_chain"][0]))
+
+    def _break_even(self, req: dict, op: str) -> dict:
+        # the package re-exports the function under the module's name,
+        # so pull the callables straight from the submodule
+        from cpr_tpu.experiments.break_even import break_even, revenue
+
+        proto = req["protocol"]
+        policy = req["policy"]
+        gamma = float(req["gamma"])
+        episode_len = int(req.get("episode_len", 256))
+        reps = int(req.get("reps", 512))
+        if op == "break_even.revenue":
+            value = revenue(
+                proto, policy, alpha=float(req["alpha"]), gamma=gamma,
+                episode_len=episode_len, reps=reps,
+                seed=int(req.get("seed", 0)))
+            return dict(ok=True, protocol=proto, policy=policy,
+                        revenue=value)
+        value = break_even(
+            proto, policy, gamma=gamma,
+            support=tuple(req.get("support", (0.1, 0.5))),
+            tol=float(req.get("tol", 0.005)),
+            episode_len=episode_len, reps=reps,
+            seed=int(req.get("seed", 0)))
+        return dict(ok=True, protocol=proto, policy=policy, alpha=value)
+
+
+# -- child entry point ----------------------------------------------------
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(
+        description="cpr_tpu serving child (see docs/SERVING.md)")
+    p.add_argument("--protocol", default="nakamoto")
+    p.add_argument("--alpha", type=float, default=0.25)
+    p.add_argument("--gamma", type=float, default=0.5)
+    p.add_argument("--activation-delay", type=float, default=1.0)
+    p.add_argument("--max-steps", type=int, default=256)
+    p.add_argument("--lanes", type=int, default=32)
+    p.add_argument("--burst", type=int, default=256)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--policy-snapshot", default=None,
+                   help="serving snapshot (driver.export_policy_snapshot"
+                        " / train checkpoints); served as policy 'ppo'")
+    p.add_argument("--ready-file", default=None,
+                   help="atomic JSON {host,port,pid} once accepting")
+    p.add_argument("--heartbeat-s", type=float, default=1.0)
+    args = p.parse_args(argv)
+
+    from cpr_tpu import supervisor
+
+    supervisor.maybe_start_heartbeat()
+    with supervisor.child_phase("serve:init"):
+        from cpr_tpu.envs.registry import get_sized
+        from cpr_tpu.params import make_params
+
+        env = get_sized(args.protocol, args.max_steps)
+        params = make_params(alpha=args.alpha, gamma=args.gamma,
+                             activation_delay=args.activation_delay,
+                             max_steps=args.max_steps)
+        extra = {}
+        if args.policy_snapshot:
+            from cpr_tpu.train.driver import load_policy_snapshot
+
+            policy, meta = load_policy_snapshot(args.policy_snapshot)
+            if meta.get("protocol") not in (None, args.protocol):
+                raise SystemExit(
+                    f"snapshot trained on {meta.get('protocol')!r}, "
+                    f"serving {args.protocol!r}")
+            extra["ppo"] = policy
+        engine = ResidentEngine(env, params, n_lanes=args.lanes,
+                                burst=args.burst, extra_policies=extra)
+    with supervisor.child_phase("serve:compile"):
+        engine.start()
+    # backend-bearing manifest BEFORE traffic: the perf ledger
+    # attributes every later serve report row to this record
+    telemetry.current().manifest(config=dict(
+        entry="serve", protocol=args.protocol, n_lanes=args.lanes,
+        burst=args.burst, max_steps=args.max_steps, alpha=args.alpha,
+        gamma=args.gamma))
+
+    async def amain():
+        server = ServeServer(engine, host=args.host, port=args.port,
+                             heartbeat_s=args.heartbeat_s)
+        await server.start()
+        if args.ready_file:
+            resilience.atomic_write_json(
+                args.ready_file,
+                dict(host=args.host, port=server.port, pid=os.getpid()))
+        await server.serve_until_drained()
+
+    with supervisor.child_phase("serve:run"), resilience.preemption_guard():
+        asyncio.run(amain())
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -317,7 +317,7 @@ def _reader(stream, which: str, q: queue.Queue):
 def run_child(cmd, *, wall_timeout_s: float, quiet_s: float | None = None,
               heartbeat_s: float | None = None, env=None, cwd=None,
               slow_ok=DEFAULT_SLOW_OK, kill_grace_s: float = 10.0,
-              forward_stderr: bool = True) -> Attempt:
+              forward_stderr: bool = True, on_start=None) -> Attempt:
     """Run one child under the watchdog.  Never raises on child
     misbehavior — the status on the returned `Attempt` says what
     happened; `supervise` maps it onto the failure taxonomy.
@@ -329,7 +329,13 @@ def run_child(cmd, *, wall_timeout_s: float, quiet_s: float | None = None,
     bounded reap because subprocess.run's post-kill wait is untimed —
     a child stuck in uninterruptible device I/O (observed: D-state on
     the device fd) would hang the parent forever; such a child is
-    abandoned to its daemon readers."""
+    abandoned to its daemon readers.
+
+    `on_start(proc)` (optional) is invoked with the live Popen handle
+    right after spawn — run_child blocks until the child exits, so a
+    caller that must interact with a long-lived child (e.g. SIGTERM a
+    serving process once its clients finish) captures the handle here
+    and signals from another thread."""
     child_env = dict(os.environ if env is None else env)
     if heartbeat_s:
         child_env[HEARTBEAT_ENV_VAR] = str(heartbeat_s)
@@ -338,6 +344,8 @@ def run_child(cmd, *, wall_timeout_s: float, quiet_s: float | None = None,
     proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
                             stderr=subprocess.PIPE, text=True,
                             errors="replace", env=child_env, cwd=cwd)
+    if on_start is not None:
+        on_start(proc)
     q: queue.Queue = queue.Queue()
     for stream, which in ((proc.stdout, "out"), (proc.stderr, "err")):
         threading.Thread(target=_reader, args=(stream, which, q),

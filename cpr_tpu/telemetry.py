@@ -54,7 +54,7 @@ from contextlib import contextmanager
 from datetime import datetime, timezone
 from time import perf_counter as now  # noqa: F401 — re-exported
 
-SCHEMA_VERSION = 6
+SCHEMA_VERSION = 7
 TELEMETRY_ENV_VAR = "CPR_TELEMETRY"
 PROFILE_ENV_VAR = "CPR_PROFILE_DIR"
 CHECKIFY_ENV_VAR = "CPR_CHECKIFY"
@@ -94,6 +94,12 @@ EVENT_FIELDS = {
     # probe|heartbeat_stall|hang|warm_restart|escalation, site names the
     # supervised workload, reason says why (timings ride as extras)
     "supervisor": ("action", "site", "reason"),
+    # v7: one per serving-layer decision (cpr_tpu/serve): action is
+    # start|admit|complete|query|heartbeat|report|drain|stop, session is
+    # the client session id (null for service-scope events), detail is a
+    # free-form dict (lane/seed on admit, steps_per_sec/occupancy on
+    # report — the perf ledger lifts report rows via iter_trace_rows)
+    "serve": ("action", "session", "detail"),
 }
 
 

@@ -196,6 +196,64 @@ def load_checkpoint(path: str, env, cfg: TrainConfig):
         return serialization.from_bytes(template, f.read())
 
 
+def serving_meta(env, cfg: TrainConfig) -> dict:
+    """Net-reconstruction record embedded in every checkpoint meta
+    sidecar: with these fields the msgpack is self-contained — a
+    consumer (cpr_tpu.serve's policy endpoint) rebuilds the ActorCritic
+    and deserializes params without the TrainConfig or the env
+    registry."""
+    return dict(protocol=cfg.protocol,
+                n_actions=int(env.n_actions),
+                observation_length=int(env.observation_length),
+                hidden=list(ppo_config(cfg).hidden),
+                episode_len=int(cfg.episode_len),
+                gamma=float(cfg.gamma))
+
+
+def export_policy_snapshot(path: str, net_params, *, protocol: str,
+                           n_actions: int, observation_length: int,
+                           hidden, **extra):
+    """Write a self-contained serving snapshot (msgpack + JSON meta
+    sidecar, both atomic).  The meta carries everything
+    `load_policy_snapshot` needs; `extra` fields ride along untouched.
+    Training checkpoints written by `train_from_config` satisfy the
+    same contract via `serving_meta`."""
+    meta = dict(protocol=protocol, n_actions=int(n_actions),
+                observation_length=int(observation_length),
+                hidden=[int(h) for h in hidden], **extra)
+    save_checkpoint(path, net_params, meta)
+    return meta
+
+
+def load_policy_snapshot(path: str):
+    """Reconstruct a jittable greedy policy `obs -> action` from a
+    serving snapshot — the `.json` meta sidecar alone defines the net
+    shape, so no TrainConfig or env instance is required.  Returns
+    (policy, meta)."""
+    with open(path + ".json") as f:
+        meta = json.load(f)
+    missing = [k for k in ("n_actions", "observation_length", "hidden")
+               if k not in meta]
+    if missing:
+        raise ValueError(
+            f"{path}.json is not a serving snapshot: missing {missing} "
+            f"(write checkpoints with export_policy_snapshot or a "
+            f"train_from_config recent enough to embed serving_meta)")
+    net = ActorCritic(int(meta["n_actions"]),
+                      tuple(int(h) for h in meta["hidden"]))
+    template = net.init(
+        jax.random.PRNGKey(0),
+        jnp.zeros((1, int(meta["observation_length"]))))
+    with open(path, "rb") as f:
+        params = serialization.from_bytes(template, f.read())
+
+    def policy(obs):
+        logits, _ = net.apply(params, obs)
+        return jnp.argmax(logits, axis=-1)
+
+    return policy, meta
+
+
 def train_from_config(cfg: TrainConfig, *, out_dir: str | None = None,
                       n_updates: int | None = None, mesh=None,
                       progress: Callable | None = None,
@@ -434,8 +492,10 @@ def train_from_config(cfg: TrainConfig, *, out_dir: str | None = None,
                 # runs too); only the file writes need out_dir
                 score = float(np.mean(
                     [r["relative_reward"] for r in rows]))
+                # serving_meta makes the checkpoint loadable by
+                # load_policy_snapshot (cpr_tpu.serve policy endpoint)
                 meta = dict(update=i + 1, score=score,
-                            protocol=cfg.protocol)
+                            **serving_meta(env, cfg))
                 if out_dir is not None:
                     _save_model(os.path.join(out_dir,
                                              "last-model.msgpack"),

@@ -1,0 +1,470 @@
+"""cpr_tpu.serve: resident lane API, continuous batching, and the
+service front-end.
+
+The load-bearing contracts, each proven bit-for-bit where the ISSUE-9
+acceptance demands it:
+
+* `step_lanes` admission replays `rollout()` — a lane admitted
+  mid-flight with seed S produces the identical trajectory to
+  `rollout(PRNGKey(S), ...)`, and lane retire/re-admit never leaks
+  state across sessions sharing a lane;
+* the gym adapters re-expressed over the resident stepper match the
+  legacy per-instance jit paths they replaced (Core step-then-reset,
+  BatchedCore step + host-sync + reset-splice) output-for-output;
+* the in-graph policy burst completes episodes identically to rollout;
+* the asyncio server round-trips all of it over the wire, including a
+  graceful drain, and the serve report rows ingest into the perf
+  ledger and gate (satellite f).
+
+Shapes are kept tiny and constant (nakamoto max_steps=16, 4 lanes,
+burst 8) so every test reuses the same compiled programs.
+"""
+
+import asyncio
+import json
+import queue
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cpr_tpu.envs import registry
+from cpr_tpu.params import make_params
+from cpr_tpu.serve import LaneScheduler, ResidentEngine, ServeClient
+from cpr_tpu.serve import protocol as wire
+
+MAX_STEPS = 16
+N_LANES = 4
+BURST = 8
+
+
+@pytest.fixture(scope="module")
+def env():
+    return registry.get_sized("nakamoto", MAX_STEPS)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return make_params(alpha=0.25, gamma=0.5, max_steps=MAX_STEPS)
+
+
+def _lane_keys(seeds):
+    return jax.vmap(jax.random.PRNGKey)(
+        jnp.asarray(seeds, dtype=jnp.uint32))
+
+
+def _solo(env, params, seed, n_steps):
+    """Reference trajectory: one auto-resetting rollout stream."""
+    obs, action, reward, done, info = env.rollout(
+        jax.random.PRNGKey(seed), params, env.policies["honest"], n_steps)
+    return (np.asarray(obs), np.asarray(action), np.asarray(reward),
+            np.asarray(done), {k: np.asarray(v) for k, v in info.items()})
+
+
+def _masks(n, lanes=None):
+    m = np.zeros(n, bool)
+    for i in (range(n) if lanes is None else lanes):
+        m[i] = True
+    return jnp.asarray(m)
+
+
+# -- resident stepper ------------------------------------------------------
+
+
+def test_mid_flight_admission_is_bit_identical_to_rollout(env, params):
+    """A lane admitted at tick 7 of a busy block replays
+    rollout(PRNGKey(17)) exactly: pre-step obs, actions, rewards,
+    dones, and episode aggregates, across episode boundaries."""
+    honest = env.policies["honest"]
+    honest_v = jax.jit(jax.vmap(honest))
+    carry = env.init_lanes(_lane_keys(range(N_LANES)), params)
+    template = env.init_lanes(_lane_keys(range(N_LANES)), params)
+    no_admit = _masks(N_LANES, [])
+    all_step = _masks(N_LANES)
+    lane, admit_tick, total = 2, 7, 40
+    rows = []
+    for t in range(total):
+        if t == admit_tick:
+            fresh = env.init_lanes(_lane_keys([17] * N_LANES), params)
+            carry, _ = env.step_lanes(
+                carry, jnp.zeros(N_LANES, jnp.int32),
+                _masks(N_LANES, [lane]), fresh, _masks(N_LANES, []),
+                params)
+        pre = np.asarray(carry[1])
+        acts = jnp.asarray(honest_v(jnp.asarray(pre)), jnp.int32)
+        carry, (_, reward, done, info) = env.step_lanes(
+            carry, acts, no_admit, template, all_step, params)
+        rows.append((pre[lane], int(np.asarray(acts)[lane]),
+                     float(reward[lane]), bool(done[lane]),
+                     {k: float(v[lane]) for k, v in info.items()}))
+    n = total - admit_tick
+    obs, action, reward, done, info = _solo(env, params, 17, n)
+    for t, (pre, act, rew, dn, inf) in enumerate(rows[admit_tick:]):
+        assert np.array_equal(pre, obs[t]), f"obs diverged at tick {t}"
+        assert act == int(action[t])
+        assert rew == float(reward[t])
+        assert dn == bool(done[t])
+        for k, v in inf.items():
+            assert v == float(info[k][t]), (k, t)
+
+
+def test_lane_reuse_does_not_leak_state(env, params):
+    """Retire/backfill: the same lane serving seed 5 then seed 9 gives
+    each session the exact solo-rollout trajectory of its own seed —
+    nothing survives the re-admission splice, and held lanes stay
+    bit-frozen."""
+    honest = env.policies["honest"]
+    carry = env.init_lanes(_lane_keys(range(N_LANES)), params)
+    template = env.init_lanes(_lane_keys(range(N_LANES)), params)
+    lane, n = 1, 12
+    held_before = None
+
+    def run_session(carry, seed):
+        fresh = env.init_lanes(_lane_keys([seed] * N_LANES), params)
+        carry, _ = env.step_lanes(
+            carry, jnp.zeros(N_LANES, jnp.int32), _masks(N_LANES, [lane]),
+            fresh, _masks(N_LANES, []), params)
+        rows = []
+        for _ in range(n):
+            pre = np.asarray(carry[1])
+            act = jnp.zeros(N_LANES, jnp.int32).at[lane].set(
+                jnp.asarray(honest(jnp.asarray(pre[lane])), jnp.int32))
+            carry, (_, reward, done, info) = env.step_lanes(
+                carry, act, _masks(N_LANES, []), template,
+                _masks(N_LANES, [lane]), params)
+            rows.append((float(reward[lane]), bool(done[lane]),
+                         float(info["episode_reward_attacker"][lane])))
+        return carry, rows
+
+    carry, first = run_session(carry, 5)
+    held_before = np.asarray(carry[1][3]).copy()
+    carry, second = run_session(carry, 9)
+    assert np.array_equal(held_before, np.asarray(carry[1][3])), \
+        "held lane 3 observation changed while never stepped"
+    for seed, rows in ((5, first), (9, second)):
+        _, _, reward, done, info = _solo(env, params, seed, n)
+        for t, (rew, dn, att) in enumerate(rows):
+            assert rew == float(reward[t]), (seed, t)
+            assert dn == bool(done[t]), (seed, t)
+            assert att == float(info["episode_reward_attacker"][t])
+
+
+# -- gym adapters vs the legacy per-instance jit paths ---------------------
+
+
+def test_batched_core_matches_legacy_step_reset_splice(env, params):
+    """BatchedCore.step (one resident dispatch) vs the path it
+    replaced: vmapped step, host sync on done, then a reset from the
+    post-step lane key spliced in with a full-tree where."""
+    from cpr_tpu.gym import BatchedCore
+
+    n_envs, seed, total = 3, 5, 40
+    core = BatchedCore("nakamoto", n_envs=n_envs, max_steps=MAX_STEPS,
+                       seed=seed)
+    new_obs, _ = core.reset()
+
+    key = jax.random.PRNGKey(seed)
+    key, k = jax.random.split(key)
+    keys = jax.random.split(k, n_envs)
+    state, obs = jax.vmap(lambda kk: env.reset(kk, params))(keys)
+    assert np.array_equal(new_obs, np.asarray(obs, np.float64))
+
+    vstep = jax.jit(lambda s, a: jax.vmap(
+        lambda ss, aa: env.step(ss, aa, params))(s, a))
+    vreset = jax.jit(lambda ks: jax.vmap(
+        lambda kk: env.reset(kk, params))(ks))
+    honest_v = jax.jit(jax.vmap(env.policies["honest"]))
+    for t in range(total):
+        acts = np.asarray(honest_v(jnp.asarray(obs)), np.int32)
+        state, obs2, reward, done, info = vstep(state, jnp.asarray(acts))
+        rstate, robs = vreset(state.key)
+        where = lambda d, a, b: jnp.where(  # noqa: E731
+            d.reshape(d.shape + (1,) * (a.ndim - 1)), a, b)
+        state = jax.tree.map(lambda a, b: where(done, a, b), rstate, state)
+        obs = where(done, robs, obs2)
+
+        n_obs, n_rew, n_done, _, n_info = core.step(acts)
+        assert np.array_equal(n_obs, np.asarray(obs, np.float64)), t
+        assert np.array_equal(n_rew, np.asarray(reward)), t
+        assert np.array_equal(n_done, np.asarray(done)), t
+        for kf, v in n_info.items():
+            assert np.array_equal(v, np.asarray(info[kf])), (kf, t)
+
+
+def test_core_matches_legacy_jit_step_loop(env, params):
+    """Core.step (resident width-1 lane) vs the legacy per-instance
+    jit(reset)/jit(step) loop, through a full episode plus the
+    follow-up reset (same PRNG bookkeeping on both sides)."""
+    from cpr_tpu.gym import Core
+
+    seed = 3
+    core = Core("nakamoto", max_steps=MAX_STEPS, seed=seed)
+    new_obs, _ = core.reset()
+
+    jstep = jax.jit(lambda s, a: env.step(s, a, params))
+    jreset = jax.jit(lambda k: env.reset(k, params))
+    key = jax.random.PRNGKey(seed)
+    key, k = jax.random.split(key)
+    state, obs = jreset(k)
+    assert np.array_equal(new_obs, np.asarray(obs, np.float64))
+
+    done = False
+    steps = 0
+    while not done:
+        act = core.policy(np.asarray(obs), "honest")
+        state, obs, reward, done, info = jstep(state, jnp.asarray(act))
+        n_obs, n_rew, n_done, _, n_info = core.step(act)
+        assert np.array_equal(n_obs, np.asarray(obs, np.float64))
+        assert n_rew == float(reward) and n_done == bool(done)
+        for kf, v in n_info.items():
+            assert v == float(info[kf]), kf
+        steps += 1
+        assert steps <= MAX_STEPS + 1
+    key, k2 = jax.random.split(key)
+    _, obs_r = jreset(k2)
+    new_obs2, _ = core.reset()
+    assert np.array_equal(new_obs2, np.asarray(obs_r, np.float64))
+
+
+# -- the resident engine ---------------------------------------------------
+
+
+def test_engine_burst_completes_episodes_like_rollout(env, params):
+    """In-graph policy bursts: each spliced lane's first completed
+    episode carries the same aggregates as the solo rollout of its
+    seed (actions computed by the same policy inside the program)."""
+    engine = ResidentEngine(env, params, n_lanes=N_LANES, burst=BURST)
+    engine.start()
+    hid = engine.policy_ids["honest"]
+    seeds = {0: 5, 2: 9}
+    obs0 = engine.splice(seeds)
+    assert set(obs0) == set(seeds)
+    bursts = [engine.burst_run({ln: hid for ln in seeds})
+              for _ in range(3 * MAX_STEPS // BURST)]
+    for lane, seed in seeds.items():
+        obs, _, _, s_done, s_info = _solo(env, params, seed, MAX_STEPS + 1)
+        assert np.array_equal(obs0[lane], obs[0]), \
+            f"admitted obs0 mismatch for lane {lane}"
+        # first burst whose first-done register fired for this lane
+        b = next(i for i, o in enumerate(bursts) if o["done"][lane])
+        idx = b * BURST + int(bursts[b]["done_step"][lane])
+        s_idx = int(np.argmax(s_done))
+        assert idx == s_idx
+        assert (bursts[b]["episode_reward_attacker"][lane]
+                == s_info["episode_reward_attacker"][s_idx])
+        assert (bursts[b]["episode_n_steps"][lane]
+                == s_info["episode_n_steps"][s_idx])
+    rep = engine.report()
+    assert rep["steps"] == len(seeds) * 3 * MAX_STEPS
+    assert rep["bursts"] == 3 * MAX_STEPS // BURST
+    assert rep["steps_per_sec"] > 0
+
+
+def test_engine_rejects_empty_policy_table(env, params):
+    class Dummy:
+        policies = {}
+
+    with pytest.raises(ValueError, match="no servable policies"):
+        ResidentEngine(Dummy(), params, n_lanes=2)
+
+
+# -- scheduler -------------------------------------------------------------
+
+
+def test_scheduler_backfill_and_occupancy():
+    sched = LaneScheduler(2)
+    a, b, c = object(), object(), object()
+    assert sched.enqueue(a) == 0 and sched.enqueue(b) == 1
+    assert sched.enqueue(c) == 2
+    assert sched.place() == [(0, a), (1, b)]
+    assert sched.occupancy() == 1.0 and sched.n_queued() == 1
+    assert sched.place() == []  # full: c waits
+    assert sched.retire(0) is a
+    assert sched.place() == [(0, c)]  # backfill into the freed lane
+    assert sched.assigned() == {0: c, 1: b}
+    assert sched.cancel(a) is False  # already placed+retired, not queued
+    evicted = sched.drain()
+    assert set(evicted) == {b, c}
+    assert sched.n_assigned() == 0 and sched.n_queued() == 0
+    with pytest.raises(ValueError):
+        LaneScheduler(0)
+
+
+# -- wire protocol ---------------------------------------------------------
+
+
+def test_protocol_frame_roundtrip_and_eof():
+    obj = {"op": "hello", "xs": [1, 2.5, "s"], "none": None}
+
+    async def run():
+        r = asyncio.StreamReader()
+        r.feed_data(wire.pack_frame(obj))
+        r.feed_eof()
+        return await wire.read_frame(r), await wire.read_frame(r)
+
+    first, second = asyncio.run(run())
+    assert first == obj
+    assert second is None  # clean EOF at a frame boundary
+
+    async def torn():
+        r = asyncio.StreamReader()
+        r.feed_data(wire.pack_frame(obj)[:3])
+        r.feed_eof()
+        return await wire.read_frame(r)
+
+    with pytest.raises(wire.ProtocolError, match="mid-header"):
+        asyncio.run(torn())
+    with pytest.raises(wire.ProtocolError, match="exceeds"):
+        wire.pack_frame({"x": "y" * (wire.MAX_FRAME + 1)})
+
+
+# -- policy snapshots ------------------------------------------------------
+
+
+def test_policy_snapshot_roundtrip(tmp_path, env):
+    from cpr_tpu.train.driver import (export_policy_snapshot,
+                                      load_policy_snapshot)
+    from cpr_tpu.train.ppo import ActorCritic
+
+    hidden = (8,)
+    net = ActorCritic(env.n_actions, hidden)
+    net_params = net.init(jax.random.PRNGKey(1),
+                          jnp.zeros(env.observation_length))
+    path = str(tmp_path / "policy.msgpack")
+    export_policy_snapshot(path, net_params, protocol="nakamoto",
+                           n_actions=env.n_actions,
+                           observation_length=env.observation_length,
+                           hidden=hidden, score=1.25)
+    policy, meta = load_policy_snapshot(path)
+    assert meta["protocol"] == "nakamoto" and meta["score"] == 1.25
+    obs = jnp.linspace(0.0, 1.0, env.observation_length)
+    logits, _ = net.apply(net_params, obs)
+    assert int(policy(obs)) == int(jnp.argmax(logits))
+
+
+# -- server end-to-end -----------------------------------------------------
+
+
+def test_server_end_to_end_over_the_wire(env, params):
+    """In-process server: a seeded policy episode and an interactive
+    episode stepped through the wire both reproduce the solo rollout
+    of their seed; stats report; drain op shuts the loop down."""
+    engine = ResidentEngine(env, params, n_lanes=N_LANES, burst=BURST)
+    engine.start()
+    from cpr_tpu.serve.server import ServeServer
+
+    ports: queue.Queue = queue.Queue()
+
+    def run():
+        async def amain():
+            server = ServeServer(engine, heartbeat_s=0.2,
+                                 idle_sleep_s=0.001)
+            await server.start()
+            ports.put(server.port)
+            await server.serve_until_drained()
+
+        asyncio.run(amain())
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    port = ports.get(timeout=60)
+    honest = env.policies["honest"]
+    try:
+        with ServeClient("127.0.0.1", port, timeout=120) as c:
+            hello = c.request("hello")
+            assert hello["ok"] and hello["n_lanes"] == N_LANES
+            assert "honest" in hello["policies"]
+
+            r = c.request("episode.run", policy="honest", seed=7)
+            assert r["ok"] and r["policy"] == "honest" and r["seed"] == 7
+            _, _, _, done, info = _solo(env, params, 7, MAX_STEPS + 1)
+            idx = int(np.argmax(done))
+            ep = r["episode"]
+            assert ep["reward_attacker"] == float(
+                info["episode_reward_attacker"][idx])
+            assert ep["reward_defender"] == float(
+                info["episode_reward_defender"][idx])
+            assert ep["n_steps"] == int(info["episode_n_steps"][idx])
+
+            o = c.request("episode.open", seed=11)
+            assert o["ok"]
+            obs, _, reward, done, _ = _solo(env, params, 11,
+                                            MAX_STEPS + 1)
+            assert np.array_equal(np.asarray(o["obs"]), obs[0])
+            cur = np.asarray(o["obs"])
+            for step in range(MAX_STEPS + 1):
+                act = int(honest(jnp.asarray(cur)))
+                s = c.request("episode.step", session=o["session"],
+                              action=act)
+                assert s["ok"]
+                assert s["reward"] == float(reward[step]), step
+                assert s["done"] == bool(done[step]), step
+                if s["done"]:
+                    break
+                cur = np.asarray(s["obs"])
+            assert s["done"]
+            dead = c.request("episode.step", session=o["session"],
+                             action=0)
+            assert not dead["ok"] and "session" in dead["error"]
+
+            stats = c.request("stats")
+            assert stats["ok"] and stats["report"]["steps"] > 0
+            assert stats["occupancy"] == 0.0  # everything retired
+            assert c.request("drain")["ok"]
+    finally:
+        t.join(60)
+    assert not t.is_alive(), "server loop did not drain"
+
+
+# -- perf ledger ingestion + gate (satellite f) ----------------------------
+
+
+def test_ledger_ingests_and_gates_serve_rows(tmp_path):
+    from cpr_tpu.perf.gate import gate_row
+    from cpr_tpu.perf.ledger import Ledger
+
+    trace = tmp_path / "serve_trace.jsonl"
+    events = [{"kind": "manifest", "backend": "cpu",
+               "config": {"entry": "serve", "n_lanes": 4, "burst": 8}}]
+    for i, (sps, occ) in enumerate([(1000.0, 0.9), (1010.0, 0.95),
+                                    (1020.0, 1.0)]):
+        events.append({"kind": "event", "name": "serve", "ts": float(i),
+                       "action": "report", "session": None,
+                       "detail": {"steps_per_sec": sps, "occupancy": occ,
+                                  "steps": 4096, "episodes": 64}})
+    trace.write_text("".join(json.dumps(e) + "\n" for e in events))
+
+    ledger = Ledger(str(tmp_path / "ledger.jsonl"))
+    assert ledger.ingest_trace(str(trace)) == 6
+    assert ledger.ingest_trace(str(trace)) == 0  # idempotent
+    recs = ledger.records()
+    sps_rows = [r for r in recs if r["metric"] == "serve_steps_per_sec"]
+    occ_rows = [r for r in recs if r["metric"] == "serve_occupancy"]
+    assert len(sps_rows) == 3 and len(occ_rows) == 3
+    assert all(r["backend"] == "cpu" for r in sps_rows)
+    assert all(r["unit"] == "steps/sec" for r in sps_rows)
+    assert all(r["config"].get("cfg_n_lanes") == 4 for r in sps_rows)
+    assert len({r["fingerprint"] for r in sps_rows}) == 1
+
+    # history: 1000/1010/1020 -> median 1010, tight MAD; a matching
+    # candidate passes, a sagging one warns, a collapsed one fails
+    def candidate(value):
+        c = dict(sps_rows[-1], value=value)
+        c["row_id"] = f"cand-{value}"
+        return c
+
+    assert gate_row(candidate(1015.0), recs)["verdict"] == "pass"
+    assert gate_row(candidate(850.0), recs)["verdict"] == "warn"
+    assert gate_row(candidate(500.0), recs)["verdict"] == "fail"
+    # occupancy rows are baseline-eligible the same way
+    assert gate_row(dict(occ_rows[-1], row_id="c2"),
+                    recs)["verdict"] == "pass"
+
+
+def test_serve_event_schema_declared():
+    from cpr_tpu.telemetry import EVENT_FIELDS, SCHEMA_VERSION
+
+    assert SCHEMA_VERSION >= 7
+    assert EVENT_FIELDS["serve"] == ("action", "session", "detail")

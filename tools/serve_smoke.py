@@ -1,0 +1,371 @@
+"""Serving-layer smoke (`make serve-smoke`).
+
+Proves the cpr_tpu/serve contract end-to-end on CPU, the way
+production would run it — a supervised server child, concurrent
+clients, a graceful SIGTERM drain — and banks the measured throughput:
+
+  1  launch `python -m cpr_tpu.serve.server` under `supervisor.run_child`
+     (heartbeat watchdog live, `on_start` capturing the Popen handle),
+     serving a tiny trained-net snapshot written via
+     `driver.export_policy_snapshot` alongside the scripted policies;
+  2  ~32 concurrent scripted clients across all three endpoint
+     families: seeded + unseeded policy episodes (`episode.run`,
+     scripted and 'ppo'), interactive episodes stepped action-by-action
+     to completion, netsim honest-net queries and break-even lookups;
+  3  a full-occupancy policy flood, with sustained device throughput
+     taken from the stats delta (steps / busy dispatch seconds) and
+     asserted within CPR_SERVE_MIN_FRAC (default 0.8) of an equivalent
+     batch `rollout()` measured in-process afterwards — the ISSUE-9
+     acceptance band;
+  4  SIGTERM: the child must drain (serve `drain`/`report`/`stop`
+     events) and exit 0, the trace must pass
+     `trace_summary --validate --expect serve,device_metrics`, and the
+     report's `serve_steps_per_sec` / `serve_occupancy` rows must
+     ingest into the perf ledger and clear the regression gate.
+
+Usage: python tools/serve_smoke.py [workdir]   (default /tmp/...)
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+ROOT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+sys.path.insert(0, ROOT)
+
+from cpr_tpu import supervisor, telemetry  # noqa: E402
+from cpr_tpu.perf.gate import gate_row, gate_summary  # noqa: E402
+from cpr_tpu.perf.ledger import Ledger  # noqa: E402
+from cpr_tpu.serve.protocol import ServeClient  # noqa: E402
+
+# episode length == burst length: a lane admitted at a burst boundary
+# completes exactly at the burst's last step, so full-occupancy floods
+# waste no post-done device work between retire and backfill
+MAX_STEPS = 512
+LANES = 16
+BURST = 512
+N_CLIENTS = 32
+FLOOD_EPISODES = 512
+BASELINE_STEPS = 512
+READY_TIMEOUT_S = 300.0
+WALL_S = 600.0
+
+
+def _log(msg):
+    print(f"serve-smoke: {msg}", file=sys.stderr)
+
+
+def _child_cmd(workdir, snap):
+    return [sys.executable, "-m", "cpr_tpu.serve.server",
+            "--protocol", "nakamoto", "--max-steps", str(MAX_STEPS),
+            "--lanes", str(LANES), "--burst", str(BURST),
+            "--policy-snapshot", snap, "--heartbeat-s", "0.5",
+            "--ready-file", os.path.join(workdir, "ready.json")]
+
+
+def _child_env(workdir, trace):
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               CPR_TELEMETRY=trace, CPR_DEVICE_METRICS="1",
+               CPR_TPU_CACHE=os.path.join(workdir, "cache"))
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def _write_snapshot(path, env):
+    """A tiny randomly-initialized ActorCritic: the snapshot format and
+    the serving path are what's under test, not the policy quality."""
+    import jax
+    import jax.numpy as jnp
+
+    from cpr_tpu.train.driver import export_policy_snapshot
+    from cpr_tpu.train.ppo import ActorCritic
+
+    hidden = (16,)
+    net = ActorCritic(env.n_actions, hidden)
+    net_params = net.init(jax.random.PRNGKey(0),
+                          jnp.zeros(env.observation_length))
+    export_policy_snapshot(path, net_params, protocol="nakamoto",
+                           n_actions=env.n_actions,
+                           observation_length=env.observation_length,
+                           hidden=hidden)
+
+
+def _wait_ready(path, proc):
+    deadline = time.time() + READY_TIMEOUT_S
+    while time.time() < deadline:
+        if proc.poll() is not None:
+            raise SystemExit(f"server child exited rc={proc.returncode} "
+                             f"before becoming ready")
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            time.sleep(0.25)
+    raise SystemExit(f"server not ready within {READY_TIMEOUT_S:.0f}s")
+
+
+def _policy_client(port, policy, seed):
+    with ServeClient("127.0.0.1", port) as c:
+        req = dict(policy=policy) if seed is None else \
+            dict(policy=policy, seed=seed)
+        r = c.request("episode.run", **req)
+        assert r.get("ok"), f"episode.run({policy}, {seed}): {r}"
+        ep = r["episode"]
+        assert ep["n_steps"] >= 1 and "relative_reward" in ep, r
+        return r
+
+
+def _interactive_client(port, seed):
+    with ServeClient("127.0.0.1", port) as c:
+        r = c.request("episode.open", seed=seed)
+        assert r.get("ok"), f"episode.open: {r}"
+        sid = r["session"]
+        for _ in range(4 * MAX_STEPS):
+            s = c.request("episode.step", session=sid, action=0)
+            assert s.get("ok"), f"episode.step: {s}"
+            if s["done"]:
+                return s
+        raise AssertionError("interactive episode never finished")
+
+
+def _netsim_client(port, proto, k):
+    with ServeClient("127.0.0.1", port) as c:
+        r = c.request("netsim.query", protocol=proto, k=k, n_nodes=5,
+                      activations=300, seed=1)
+        assert r.get("ok"), f"netsim.query: {r}"
+        assert len(r["rewards"]) >= 5 and r["progress"] > 0, r
+        return r
+
+
+def _break_even_client(port, alpha):
+    with ServeClient("127.0.0.1", port) as c:
+        r = c.request("break_even.revenue", protocol="nakamoto",
+                      policy="eyal-sirer-2014", alpha=alpha, gamma=0.5,
+                      reps=4, episode_len=MAX_STEPS)
+        assert r.get("ok"), f"break_even.revenue: {r}"
+        assert 0.0 <= r["revenue"] <= 1.0, r
+        return r
+
+
+def _stats(port):
+    with ServeClient("127.0.0.1", port) as c:
+        r = c.request("stats")
+        assert r.get("ok"), r
+        return r
+
+
+def _mixed_load(port):
+    jobs = []
+    with ThreadPoolExecutor(max_workers=N_CLIENTS) as pool:
+        for i in range(12):
+            policy = ("ppo", "honest", "eyal-sirer-2014")[i % 3]
+            jobs.append(pool.submit(_policy_client, port, policy, i))
+        for _ in range(8):
+            jobs.append(pool.submit(_policy_client, port, "ppo", None))
+        for i in range(8):
+            jobs.append(pool.submit(_interactive_client, port, 100 + i))
+        jobs.append(pool.submit(_netsim_client, port, "nakamoto", 1))
+        jobs.append(pool.submit(_netsim_client, port, "bk", 2))
+        jobs.append(pool.submit(_break_even_client, port, 0.25))
+        jobs.append(pool.submit(_break_even_client, port, 0.35))
+        for j in jobs:
+            j.result()
+    return len(jobs)
+
+
+def _flood_worker(port, seeds):
+    """One persistent connection running sequential seeded episodes —
+    the shape of a real client, and it keeps per-episode TCP churn out
+    of the throughput window."""
+    with ServeClient("127.0.0.1", port) as c:
+        for s in seeds:
+            r = c.request("episode.run", policy="honest", seed=s)
+            assert r.get("ok"), f"flood episode.run(seed={s}): {r}"
+
+
+def _flood(port):
+    """Full-occupancy sustained load: 2x lanes of always-outstanding
+    policy sessions, so every burst backfills from a non-empty queue."""
+    before = _stats(port)["report"]
+    per = FLOOD_EPISODES // N_CLIENTS
+    with ThreadPoolExecutor(max_workers=N_CLIENTS) as pool:
+        jobs = [pool.submit(_flood_worker, port,
+                            range(1000 + w * per, 1000 + (w + 1) * per))
+                for w in range(N_CLIENTS)]
+        for j in jobs:
+            j.result()
+    after = _stats(port)["report"]
+    d_steps = after["steps"] - before["steps"]
+    d_busy = after["busy_s"] - before["busy_s"]
+    if d_steps <= 0 or d_busy <= 0:
+        raise SystemExit(f"flood measured nothing (d_steps={d_steps}, "
+                         f"d_busy={d_busy:.3f}s)")
+    return d_steps / d_busy, after
+
+
+def _baseline_steps_per_sec():
+    """Equivalent batch rollout() on the same env/params/policy shape:
+    LANES keys vmapped, honest policy, best of 3 timed dispatches."""
+    import jax
+    import jax.numpy as jnp
+
+    from cpr_tpu.envs import registry
+    from cpr_tpu.params import make_params
+
+    env = registry.get_sized("nakamoto", MAX_STEPS)
+    params = make_params(alpha=0.25, gamma=0.5, max_steps=MAX_STEPS)
+    policy = env.policies["honest"]
+
+    def batch(keys):
+        return jax.vmap(
+            lambda k: env.rollout(k, params, policy, BASELINE_STEPS)
+        )(keys)
+
+    run = jax.jit(batch)
+    keys = jax.vmap(jax.random.PRNGKey)(
+        jnp.arange(LANES, dtype=jnp.uint32))
+    jax.block_until_ready(run(keys))  # compile outside the timing
+    best = float("inf")
+    for _ in range(3):
+        t0 = telemetry.now()
+        jax.block_until_ready(run(keys))
+        best = min(best, telemetry.now() - t0)
+    return LANES * BASELINE_STEPS / best
+
+
+def _serve_events(trace, action=None):
+    out = []
+    with open(trace) as f:
+        for line in f:
+            try:
+                e = json.loads(line)
+            except ValueError:
+                continue
+            if e.get("kind") == "event" and e.get("name") == "serve" \
+                    and (action is None or e.get("action") == action):
+                out.append(e)
+    return out
+
+
+def _validate_stream(trace):
+    tool = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "trace_summary.py")
+    r = subprocess.run(
+        [sys.executable, tool, trace, "--validate",
+         "--expect", "serve,device_metrics"],
+        capture_output=True, text=True)
+    if r.returncode != 0:
+        sys.stderr.write(r.stdout + r.stderr)
+        raise SystemExit(f"telemetry validation failed for {trace}")
+
+
+def _bank_and_gate(workdir, trace):
+    ledger = Ledger(os.path.join(workdir, "perf_ledger.jsonl"))
+    n = ledger.ingest_trace(trace)
+    records = ledger.records()
+    serve_rows = [r for r in records
+                  if r.get("metric") == "serve_steps_per_sec"]
+    if not serve_rows:
+        raise SystemExit("no serve_steps_per_sec row reached the ledger")
+    results = [gate_row(r, records) for r in serve_rows]
+    summary = gate_summary(results)
+    if not summary["ok"]:
+        raise SystemExit(f"serve throughput gate failed: {results}")
+    return n, serve_rows[-1]["value"], summary
+
+
+def main():
+    work = sys.argv[1] if len(sys.argv) > 1 else "/tmp/cpr-serve-smoke"
+    os.makedirs(work, exist_ok=True)
+    trace = os.path.join(work, "serve.jsonl")
+    if os.path.exists(trace):
+        os.remove(trace)
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from cpr_tpu.envs import registry
+
+    env = registry.get_sized("nakamoto", MAX_STEPS)
+    snap = os.path.join(work, "policy.msgpack")
+    _write_snapshot(snap, env)
+    _log(f"snapshot written: {snap}")
+
+    started = threading.Event()
+    box = {}
+
+    def on_start(proc):
+        box["proc"] = proc
+        started.set()
+
+    def supervise():
+        box["attempt"] = supervisor.run_child(
+            _child_cmd(work, snap), wall_timeout_s=WALL_S, quiet_s=20.0,
+            heartbeat_s=1.0, env=_child_env(work, trace), cwd=ROOT,
+            on_start=on_start)
+
+    child = threading.Thread(target=supervise)
+    child.start()
+    try:
+        if not started.wait(30.0):
+            raise SystemExit("run_child never spawned the server")
+        ready = _wait_ready(os.path.join(work, "ready.json"), box["proc"])
+        port = ready["port"]
+        _log(f"server ready on port {port} (pid {ready['pid']})")
+
+        n_jobs = _mixed_load(port)
+        _log(f"mixed phase: {n_jobs} concurrent clients over "
+             f"policy/interactive/netsim/break-even endpoints all ok")
+        serve_sps, report = _flood(port)
+        _log(f"flood phase: {FLOOD_EPISODES} episodes, sustained "
+             f"{serve_sps:,.0f} steps/s (session total: "
+             f"{report['steps']} steps, occupancy {report['occupancy']:.2f})")
+
+        box["proc"].send_signal(signal.SIGTERM)
+    except BaseException:
+        # don't leave an orphaned server burning the wall budget
+        proc = box.get("proc")
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+        raise
+    child.join(120.0)
+    if child.is_alive():
+        raise SystemExit("server child did not drain within 120s")
+    attempt = box["attempt"]
+    if attempt.status != "ok" or attempt.rc != 0:
+        raise SystemExit(f"server child did not exit cleanly after "
+                         f"SIGTERM (status={attempt.status} "
+                         f"rc={attempt.rc})")
+    _log("SIGTERM drained cleanly (child exit 0)")
+
+    for want in ("start", "admit", "complete", "query", "heartbeat",
+                 "drain", "report", "stop"):
+        if not _serve_events(trace, want):
+            raise SystemExit(f"no serve '{want}' event in the trace")
+    _validate_stream(trace)
+
+    baseline_sps = _baseline_steps_per_sec()
+    min_frac = float(os.environ.get("CPR_SERVE_MIN_FRAC", "0.8"))
+    frac = serve_sps / baseline_sps
+    _log(f"throughput: serve {serve_sps:,.0f} vs batch rollout "
+         f"{baseline_sps:,.0f} steps/s ({frac:.1%}, floor {min_frac:.0%})")
+    if frac < min_frac:
+        raise SystemExit(
+            f"sustained serve throughput {serve_sps:,.0f} steps/s is "
+            f"below {min_frac:.0%} of the equivalent batch rollout "
+            f"({baseline_sps:,.0f} steps/s)")
+
+    n_banked, banked_sps, summary = _bank_and_gate(work, trace)
+    print(f"serve-smoke: PASS (serve {serve_sps:,.0f} steps/s = "
+          f"{frac:.1%} of rollout baseline; banked {n_banked} ledger "
+          f"rows incl. serve_steps_per_sec={banked_sps:,.0f}; "
+          f"gate {summary})")
+
+
+if __name__ == "__main__":
+    main()

@@ -118,6 +118,7 @@ def summarize(events, out=sys.stdout):
     _vi_residuals_lines(events, out)
     _resilience_lines(events, out)
     _supervisor_lines(events, out)
+    _serve_lines(events, out)
     _perf_gate_lines(events, out)
     for m in (e for e in events if e.get("kind") == "manifest"):
         cfg = m.get("config") or {}
@@ -126,7 +127,7 @@ def summarize(events, out=sys.stdout):
               f"jax={m.get('jax_version')} git={str(m.get('git_sha'))[:12]} "
               f"config={json.dumps(cfg, sort_keys=True)}", file=out)
     tabled = ("compile", "device_metrics", "vi_residuals", "retry",
-              "checkpoint", "perf_gate", "supervisor")
+              "checkpoint", "perf_gate", "supervisor", "serve")
     for e in (e for e in events if e.get("kind") == "event"
               and e.get("name") not in tabled):
         keys = {k: v for k, v in e.items() if k not in ("kind", "ts")}
@@ -225,6 +226,36 @@ def _supervisor_lines(events, out):
         dur_txt = f"{dur:.1f}" if isinstance(dur, (int, float)) else "-"
         print(f"{str(e.get('action')):<18} {str(e.get('site')):<24} "
               f"{dur_txt:>8} {e.get('reason')}", file=out)
+
+
+def _serve_lines(events, out):
+    """Schema-v7 serving-layer decisions (cpr_tpu/serve): per-action
+    tallies plus the drain-time report's throughput line, so a serving
+    session's admit/complete churn and sustained steps/sec read off
+    one block without replaying the event stream."""
+    evs = [e for e in events if e.get("kind") == "event"
+           and e.get("name") == "serve"]
+    if not evs:
+        return
+    counts = defaultdict(int)
+    for e in evs:
+        counts[str(e.get("action"))] += 1
+    tally = " ".join(f"{k}={n}" for k, n in sorted(counts.items()))
+    print(f"\nserve events: {tally}", file=out)
+    for e in evs:
+        if e.get("action") != "report":
+            continue
+        d = e.get("detail") or {}
+        sps = d.get("steps_per_sec")
+        occ = d.get("occupancy")
+        sps_txt = f"{sps:,.0f}" if isinstance(sps, (int, float)) else "-"
+        occ_txt = f"{occ:.2f}" if isinstance(occ, (int, float)) else "-"
+        print(f"serve report: steps={d.get('steps')} "
+              f"episodes={d.get('episodes')} bursts={d.get('bursts')} "
+              f"ticks={d.get('ticks')} admitted={d.get('admitted')} "
+              f"steps_per_sec={sps_txt} occupancy={occ_txt} "
+              f"lanes={d.get('n_lanes')} burst={d.get('burst')}",
+              file=out)
 
 
 def _perf_gate_lines(events, out):
