@@ -90,8 +90,10 @@ serve-smoke:  ## continuous-batching service proof: supervised server
 	## child, ~32 concurrent clients across the policy / interactive /
 	## netsim / break-even endpoints, sustained full-occupancy
 	## throughput within 20% of an equivalent batch rollout(), graceful
-	## SIGTERM drain, v7 `serve` trace validation, and throughput rows
-	## banked + gated in the perf ledger.  Details: docs/SERVING.md
+	## SIGTERM drain, v8 `serve`/`request` trace validation, a
+	## trace_stitch pairing of the server and client streams, and
+	## throughput + drain-report p50/p99 latency rows banked + gated in
+	## the perf ledger.  Details: docs/SERVING.md
 	rm -rf $(SERVE_SMOKE_DIR)
 	python tools/serve_smoke.py $(SERVE_SMOKE_DIR)
 

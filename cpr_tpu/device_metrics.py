@@ -347,13 +347,22 @@ def update_rollout(spec: MetricsSpec, acc: dict, *, reward, done,
 # -- the serving spec ---------------------------------------------------------
 
 
+# log-scale edges (seconds) for the per-burst dispatch-latency
+# histogram: half-decade buckets over 10us .. ~316s, bracketing every
+# observed burst wall (CPU smoke ~100ms, TPU sub-ms) with headroom
+_BURST_S_EDGES = tuple(10.0 ** (e / 2.0) for e in range(-10, 6))
+
+
 def serve_spec() -> MetricsSpec:
     """Cells for the cpr_tpu.serve resident engine: throughput
     counters (`env_steps`/`episodes`/`bursts`), the `occupancy` spread
     (fraction of lanes assigned to client sessions, one observation
-    per burst), and the `burst_s` dispatch-latency spread (host wall
-    seconds per resident burst call, folded once at drain from the
-    durations the engine already records for its throughput report).
+    per burst), and the per-burst dispatch latency twice over — the
+    `burst_s` min/max/mean spread plus the `burst_s_hist` log-bucket
+    histogram (so the drain-time device_metrics event carries the
+    latency *distribution*, not just its envelope).  Both fold once at
+    drain from the host walls the engine already records for its
+    throughput report.
 
     Same overhead contract as the stats drivers: the in-graph cells
     fold ONCE PER BURST from the burst call's own inputs/outputs
@@ -366,6 +375,7 @@ def serve_spec() -> MetricsSpec:
     spec.counter("bursts")
     spec.stats("occupancy")
     spec.stats("burst_s")
+    spec.hist("burst_s_hist", _BURST_S_EDGES)
     return spec
 
 

@@ -1,0 +1,160 @@
+"""Host-side latency histograms for the serving SLO plane.
+
+The serving layer (cpr_tpu/serve) needs per-op-family latency
+quantiles — p50/p95/p99 queue wait, service and total time — cheap
+enough to update on every request and to snapshot on every heartbeat.
+Like telemetry/ and perf/, this module is jax-free at import and
+allocation-free on the observe path: a histogram is one fixed vector
+of integer bucket counts over log-scale edges, so `observe` is a
+bisect + increment and `snapshot` is a single pass.
+
+Quantiles are estimated by log-linear interpolation inside the owning
+bucket, clamped to the observed min/max.  With the default edges
+(16 buckets per decade over 1 microsecond .. 1000 seconds) the
+estimate is within ~7% of the true value anywhere in range, which is
+far inside the verdict bands the perf gate applies to the banked
+`serve_p50_s` / `serve_p99_s` rows (cpr_tpu/perf/gate.py).
+
+`LatencyBoard` maps op families ("episode.run", "netsim.query", ...)
+to histograms and is what the server embeds in its `stats` reply,
+`heartbeat` event and drain `report` (docs/SERVING.md).
+"""
+
+from __future__ import annotations
+
+import math
+from bisect import bisect_right
+
+# default edges: log-scale, _PER_DECADE buckets per decade spanning
+# [10**_LO_EXP, 10**_HI_EXP) seconds — wide enough for a sub-10us
+# device dispatch and a multi-minute break-even sweep alike
+_LO_EXP = -6
+_HI_EXP = 3
+_PER_DECADE = 16
+
+
+def default_edges() -> tuple:
+    """The shared log-scale bucket edges (seconds), increasing."""
+    n = (_HI_EXP - _LO_EXP) * _PER_DECADE + 1
+    return tuple(10.0 ** (_LO_EXP + i / _PER_DECADE) for i in range(n))
+
+
+class LatencyHistogram:
+    """Fixed-bucket log-scale histogram of durations in seconds.
+
+    Buckets are `len(edges) + 1` counts: (-inf, e0), [e0, e1), ...,
+    [eN, inf) — underflow and overflow included, like the
+    device_metrics hist cells."""
+
+    __slots__ = ("edges", "counts", "count", "sum_s", "min_s", "max_s")
+
+    def __init__(self, edges=None):
+        self.edges = tuple(edges) if edges is not None else default_edges()
+        if not self.edges or any(b <= a for a, b in
+                                 zip(self.edges, self.edges[1:])):
+            raise ValueError("edges must be non-empty and increasing")
+        self.counts = [0] * (len(self.edges) + 1)
+        self.count = 0
+        self.sum_s = 0.0
+        self.min_s = math.inf
+        self.max_s = -math.inf
+
+    def observe(self, dur_s: float):
+        """Fold one duration (seconds; negatives clamp to 0 — clock
+        skew between stamps must never corrupt the board)."""
+        d = float(dur_s)
+        if not math.isfinite(d):
+            return
+        d = max(0.0, d)
+        self.counts[bisect_right(self.edges, d)] += 1
+        self.count += 1
+        self.sum_s += d
+        self.min_s = min(self.min_s, d)
+        self.max_s = max(self.max_s, d)
+
+    def merge(self, other: "LatencyHistogram"):
+        """Fold another histogram (same edges) into this one."""
+        if other.edges != self.edges:
+            raise ValueError("cannot merge histograms with differing edges")
+        for i, c in enumerate(other.counts):
+            self.counts[i] += c
+        self.count += other.count
+        self.sum_s += other.sum_s
+        self.min_s = min(self.min_s, other.min_s)
+        self.max_s = max(self.max_s, other.max_s)
+
+    def quantile(self, q: float) -> float | None:
+        """Estimated q-quantile (0..1) in seconds, or None when empty.
+        Log-linear interpolation inside the owning bucket, clamped to
+        the observed [min, max] so a one-sample histogram reports the
+        sample, not a bucket edge."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile must be in [0, 1], got {q}")
+        if self.count == 0:
+            return None
+        rank = q * self.count
+        seen = 0.0
+        for i, c in enumerate(self.counts):
+            if c == 0:
+                continue
+            if seen + c >= rank:
+                frac = 0.0 if c == 0 else min(1.0, max(
+                    0.0, (rank - seen) / c))
+                val = self._interp(i, frac)
+                return min(self.max_s, max(self.min_s, val))
+            seen += c
+        return self.max_s
+
+    def _interp(self, bucket: int, frac: float) -> float:
+        # underflow/overflow buckets have one open side: report the
+        # closed edge (clamping to min/max refines it anyway)
+        if bucket == 0:
+            return self.edges[0]
+        if bucket == len(self.edges):
+            return self.edges[-1]
+        lo, hi = self.edges[bucket - 1], self.edges[bucket]
+        if lo <= 0:
+            return lo + frac * (hi - lo)
+        return lo * (hi / lo) ** frac
+
+    def snapshot(self) -> dict:
+        """JSON-ready summary: count/sum/min/max/mean + p50/p95/p99."""
+        if self.count == 0:
+            return {"count": 0}
+        return {
+            "count": self.count,
+            "sum_s": self.sum_s,
+            "min_s": self.min_s,
+            "max_s": self.max_s,
+            "mean_s": self.sum_s / self.count,
+            "p50_s": self.quantile(0.50),
+            "p95_s": self.quantile(0.95),
+            "p99_s": self.quantile(0.99),
+        }
+
+
+class LatencyBoard:
+    """Per-op-family latency histograms, lazily created on first
+    observe (families are dynamic: every serve op plus the engine's
+    device families land here)."""
+
+    def __init__(self, edges=None):
+        self._edges = tuple(edges) if edges is not None else default_edges()
+        self._hists: dict[str, LatencyHistogram] = {}
+
+    def observe(self, family: str, dur_s: float):
+        h = self._hists.get(family)
+        if h is None:
+            h = self._hists[family] = LatencyHistogram(self._edges)
+        h.observe(dur_s)
+
+    def get(self, family: str) -> LatencyHistogram | None:
+        return self._hists.get(family)
+
+    @property
+    def families(self) -> tuple:
+        return tuple(sorted(self._hists))
+
+    def snapshot(self) -> dict:
+        """{family: histogram snapshot} over every family observed."""
+        return {k: self._hists[k].snapshot() for k in self.families}

@@ -44,7 +44,7 @@ from cpr_tpu.perf.gate import (baseline_rows, emit_gate_event, gate_row,
 from cpr_tpu.perf.ledger import (LEDGER_ENV_VAR, LEDGER_VERSION, Ledger,
                                  config_fingerprint, default_ledger_path,
                                  iter_bank_rows, iter_trace_rows,
-                                 normalize_row)
+                                 metric_direction, normalize_row)
 
 __all__ = [
     "LEDGER_ENV_VAR",
@@ -59,6 +59,7 @@ __all__ = [
     "gate_summary",
     "iter_bank_rows",
     "iter_trace_rows",
+    "metric_direction",
     "normalize_row",
 ]
 

@@ -8,6 +8,12 @@ gate"):
   fingerprint (falling back to all same-backend rows with
   `config_drift` flagged, so a batch-size change is still gated but
   self-describes as not like-for-like).
+* Every metric has a **direction** (ledger v3): "higher" is better
+  for throughputs (the default), "lower" for latencies
+  (`serve_p50_s`/`serve_p99_s`, anything `*_s`).  For "lower" the
+  best rows are the *lowest* values and the band flips — a value
+  *above* `med + max(frac * med, noise)` warns/fails, so a p99
+  regression trips the gate exactly like a steps/sec drop.
 * `outage`/`fallback_reason` rows and error rows are **never**
   baselines: a CPU number delivered during a chip outage is a fact
   about the outage, not about the code.  Supervisor `probe` rows
@@ -33,6 +39,7 @@ the same post-mortem trail the bench rows live in.
 from __future__ import annotations
 
 from cpr_tpu import telemetry
+from cpr_tpu.perf.ledger import metric_direction
 
 # verdict band defaults: fractions of the baseline median a drop must
 # exceed, and the MAD multiplier that widens the band on noisy history
@@ -70,13 +77,17 @@ def gate_row(candidate: dict, history, *, top_k: int = TOP_K,
              warn_frac: float = WARN_FRAC, fail_frac: float = FAIL_FRAC,
              mad_k: float = MAD_K) -> dict:
     """Judge one ledger record against the banked history.  Returns
-    {verdict: pass|warn|fail|skip, metric, backend, value, baseline,
-    config_drift, reason}; `baseline` names the rows judged against
-    (median/mad/n/best/best_source/thresholds) or None."""
+    {verdict: pass|warn|fail|skip, metric, backend, value, direction,
+    baseline, config_drift, reason}; `baseline` names the rows judged
+    against (median/mad/n/best/best_source/thresholds) or None."""
+    direction = candidate.get("direction")
+    if direction not in ("higher", "lower"):
+        direction = metric_direction(candidate.get("metric"))
     result = {
         "metric": candidate.get("metric"),
         "backend": candidate.get("backend"),
         "value": candidate.get("value"),
+        "direction": direction,
         "verdict": "pass",
         "baseline": None,
         "config_drift": False,
@@ -113,28 +124,42 @@ def gate_row(candidate: dict, history, *, top_k: int = TOP_K,
         pool = same_fp
     else:
         result["config_drift"] = True
-    best = sorted(pool, key=lambda r: -r["value"])[:top_k]
+    lower = direction == "lower"
+    # "best" is the top of the trail in the metric's own direction:
+    # highest throughputs, lowest latencies
+    best = sorted(pool, key=lambda r: r["value"] if lower
+                  else -r["value"])[:top_k]
     vals = [r["value"] for r in best]
     med = _median(vals)
     mad = _median([abs(v - med) for v in vals])
     noise = mad_k * _MAD_SCALE * mad
     value = float(candidate["value"])
-    warn_below = med - max(warn_frac * med, noise)
-    fail_below = med - max(fail_frac * med, noise)
-    verdict = ("fail" if value < fail_below
-               else "warn" if value < warn_below else "pass")
+    baseline = {
+        "median": med, "mad": mad, "n": len(vals),
+        "best": best[0]["value"],
+        "best_source": best[0].get("source"),
+        "best_round": best[0].get("round"),
+    }
+    if lower:
+        warn_above = med + max(warn_frac * med, noise)
+        fail_above = med + max(fail_frac * med, noise)
+        verdict = ("fail" if value > fail_above
+                   else "warn" if value > warn_above else "pass")
+        baseline.update(warn_above=warn_above, fail_above=fail_above)
+        band_txt = (f"warn>{warn_above:.6g} fail>{fail_above:.6g}")
+    else:
+        warn_below = med - max(warn_frac * med, noise)
+        fail_below = med - max(fail_frac * med, noise)
+        verdict = ("fail" if value < fail_below
+                   else "warn" if value < warn_below else "pass")
+        baseline.update(warn_below=warn_below, fail_below=fail_below)
+        band_txt = (f"warn<{warn_below:.6g} fail<{fail_below:.6g}")
     result.update(
         verdict=verdict,
-        baseline={
-            "median": med, "mad": mad, "n": len(vals),
-            "best": best[0]["value"],
-            "best_source": best[0].get("source"),
-            "best_round": best[0].get("round"),
-            "warn_below": warn_below, "fail_below": fail_below,
-        },
+        baseline=baseline,
         reason=(f"value {value:.6g} vs median-of-best {med:.6g} "
-                f"({value / med - 1.0:+.1%}; warn<{warn_below:.6g} "
-                f"fail<{fail_below:.6g}"
+                f"({value / med - 1.0:+.1%}, {direction} is better; "
+                f"{band_txt}"
                 + (", config drifted from baseline"
                    if result["config_drift"] else "") + ")"))
     return result
@@ -146,7 +171,7 @@ def emit_gate_event(result: dict):
         "perf_gate", metric=result["metric"], backend=result["backend"],
         verdict=result["verdict"], value=result["value"],
         baseline=result["baseline"], config_drift=result["config_drift"],
-        reason=result["reason"])
+        direction=result.get("direction"), reason=result["reason"])
 
 
 def gate_summary(results) -> dict:

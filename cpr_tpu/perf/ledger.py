@@ -20,12 +20,15 @@ and the drain-time `serve` report events of the serving layer
 serving session's sustained throughput is banked and gated exactly
 like a bench row.
 
-Ledger records (`ledger: 2` — v2 added the supervisor provenance
-fields `probe` and `restart_count`, which changed every row_id; the
-ledger file is regenerable scratch, so a pre-v2 ledger is simply
-deleted and re-ingested rather than migrated):
+Ledger records (`ledger: 3` — v3 added the `direction` field so
+lower-is-better metrics (latencies: `serve_p50_s`/`serve_p99_s`) gate
+correctly; like the v2 bump (supervisor provenance) it changed every
+row_id, and the ledger file is regenerable scratch, so a pre-v3
+ledger is simply deleted and re-ingested rather than migrated):
 
     metric, backend, value, unit, check, round, source,
+    direction ("higher" | "lower" — which way is better; inferred
+    from the metric name unless the row says otherwise),
     outage, fallback_reason, error,
     probe (health-check row, never a measurement),
     restart_count (warm restarts preceding the measuring child),
@@ -50,7 +53,7 @@ import re
 
 from cpr_tpu.resilience import atomic_write_text
 
-LEDGER_VERSION = 2
+LEDGER_VERSION = 3
 LEDGER_ENV_VAR = "CPR_PERF_LEDGER"
 
 # fallback_reason stamped onto rows whose artifact predates the outage
@@ -70,6 +73,16 @@ def default_ledger_path(root: str) -> str:
 def _digest(obj) -> str:
     return hashlib.sha1(
         json.dumps(obj, sort_keys=True, default=str).encode()).hexdigest()[:12]
+
+
+def metric_direction(metric) -> str:
+    """Which way is better for a metric: "higher" (throughputs,
+    rates — the default) or "lower" (latencies/durations).  Inference
+    follows the repo's naming convention — `*_s` metrics are seconds
+    (serve_p50_s, serve_p99_s, compile_s...), everything else is a
+    rate or count.  A row's explicit `direction` key overrides this
+    (normalize_row)."""
+    return "lower" if str(metric).endswith("_s") else "higher"
 
 
 def config_fingerprint(metric: str, config: dict) -> str:
@@ -97,10 +110,17 @@ def normalize_row(row: dict, *, source: str = "live",
         if k in row:
             config[k] = row[k]
     man = row.get("manifest") or {}
+    direction = row.get("direction")
+    if direction not in ("higher", "lower"):
+        direction = metric_direction(metric)
     rec = {
         "ledger": LEDGER_VERSION,
         "metric": metric,
         "backend": row.get("backend"),
+        # v3: which way is better — the gate flips its band for
+        # "lower" so a p99 regression fails exactly like a
+        # steps/sec drop (cpr_tpu/perf/gate.py)
+        "direction": direction,
         "value": (float(value)
                   if isinstance(value, (int, float)) else None),
         "unit": row.get("unit"),
@@ -162,17 +182,22 @@ def iter_bank_rows(root: str):
 
 
 # serve report detail key -> (ledger metric, unit); rates in a report
-# are over busy (dispatch) wall time — see ResidentEngine.report
+# are over busy (dispatch) wall time — see ResidentEngine.report —
+# and p50/p99 are the episode.run endpoint's total-latency quantiles
+# (lower-is-better: metric_direction flips the gate band for them)
 _SERVE_METRICS = (("steps_per_sec", "serve_steps_per_sec", "steps/sec"),
-                  ("occupancy", "serve_occupancy", "fraction"))
+                  ("occupancy", "serve_occupancy", "fraction"),
+                  ("p50_s", "serve_p50_s", "seconds"),
+                  ("p99_s", "serve_p99_s", "seconds"))
 
 
 def iter_trace_rows(path: str):
     """Yield ledger-shaped rows from a telemetry JSONL trace: one per
     span carrying `per_sec` counters, metric `<span path>:<counter>`,
-    plus two per `serve` report event (the serving layer's drain-time
-    throughput summary); backend/config taken from the last manifest
-    seen before the row (the stream layout every producer follows)."""
+    plus up to four per `serve` report event (the serving layer's
+    drain-time throughput + latency summary; _SERVE_METRICS);
+    backend/config taken from the last manifest seen before the row
+    (the stream layout every producer follows)."""
     base = os.path.basename(path)
     backend, config = None, {}
     with open(path) as f:

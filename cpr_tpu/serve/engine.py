@@ -318,14 +318,15 @@ class ResidentEngine:
             policies=list(self.policy_names))
 
     def emit_metrics(self, scope: str = "serve"):
-        """Fold the host-recorded burst latencies and emit the
-        device_metrics event (one readback).  No-op when in-graph
-        metrics are off."""
+        """Fold the host-recorded burst latencies — the `burst_s`
+        spread and the `burst_s_hist` log-bucket distribution — and
+        emit the device_metrics event (one readback).  No-op when
+        in-graph metrics are off."""
         if self._macc is None:
             return None
         macc = self._macc
         if self._burst_wall:
-            macc = self._spec.observe(
-                macc, "burst_s",
-                np.asarray(self._burst_wall, np.float32))
+            walls = np.asarray(self._burst_wall, np.float32)
+            macc = self._spec.observe(macc, "burst_s", walls)
+            macc = self._spec.observe_hist(macc, "burst_s_hist", walls)
         return device_metrics.emit(scope, self._spec, macc)

@@ -6,6 +6,15 @@ request carries an `op` key, every response an `ok` bool.  The server
 answers frames on one connection strictly in order, so a blocking
 request/response client (`ServeClient`, used by tools/serve_smoke.py
 and the tests) needs no correlation ids.
+
+Trace context (schema v8): `_trace` is a reserved request field —
+`{"id": <trace_id>, "run": <run_id>, "parent": <span or null>}` —
+which the server propagates into its `request` telemetry event and
+echoes back as `trace_id`, so the client- and server-side events of
+one request correlate across JSONL streams (tools/trace_stitch.py).
+`ServeClient.request` stamps it automatically, times the full
+round-trip, and emits the client-side `request` event (a no-op
+without a telemetry sink).
 """
 
 from __future__ import annotations
@@ -13,6 +22,8 @@ from __future__ import annotations
 import json
 import socket
 import struct
+
+from cpr_tpu import telemetry
 
 _HEADER = struct.Struct(">I")
 # generous ceiling: the largest legitimate frame (an interactive step
@@ -65,6 +76,20 @@ async def write_frame(writer, obj):
     await writer.drain()
 
 
+def _client_request_event(trace_id, op, status, queue_wait_s,
+                          service_s, total_s):
+    """The one client-side `request` event call site
+    (EVENT_FIELDS['request']); the server-side twin lives in
+    server.py.  queue_wait/service are the server's own breakdown
+    copied off the reply; total is the client wall, so
+    `total_s(client) - total_s(server)` is the wire + framing
+    overhead (the `reply` leg in trace_stitch's critical path)."""
+    telemetry.current().event(
+        "request", trace_id=trace_id, op=op, status=status,
+        queue_wait_s=queue_wait_s, service_s=service_s,
+        total_s=total_s, role="client", run=telemetry.run_id())
+
+
 class ServeClient:
     """Blocking request/response client over one TCP connection."""
 
@@ -83,11 +108,26 @@ class ServeClient:
         return b"".join(chunks)
 
     def request(self, op: str, **fields):
-        self._sock.sendall(pack_frame(dict(fields, op=op)))
+        trace_id = telemetry.new_trace_id()
+        t0 = telemetry.now()
+        self._sock.sendall(pack_frame(dict(
+            fields, op=op,
+            _trace=dict(id=trace_id, run=telemetry.run_id(),
+                        parent=None))))
         (n,) = _HEADER.unpack(self._recv_exact(_HEADER.size))
         if n > MAX_FRAME:
             raise ProtocolError(f"frame length {n} exceeds {MAX_FRAME}")
-        return _decode(self._recv_exact(n))
+        resp = _decode(self._recv_exact(n))
+        total_s = telemetry.now() - t0
+        lat = resp.get("latency") if isinstance(resp, dict) else None
+        lat = lat if isinstance(lat, dict) else {}
+        status = ("ok" if resp.get("ok")
+                  else "refused" if resp.get("draining") else "error") \
+            if isinstance(resp, dict) else "error"
+        _client_request_event(trace_id, op, status,
+                              lat.get("queue_wait_s"),
+                              lat.get("service_s"), total_s)
+        return resp
 
     def close(self):
         try:

@@ -15,6 +15,11 @@ from __future__ import annotations
 
 from collections import deque
 
+# interval source shared with the rest of the runtime (perf_counter —
+# monotonic, so queue ages can never go backwards); telemetry is
+# jax-free at import, preserving this module's import weight
+from cpr_tpu.telemetry import now
+
 
 class LaneScheduler:
     """Tracks which session owns which lane plus the FIFO admission
@@ -26,6 +31,9 @@ class LaneScheduler:
         self.n_lanes = n_lanes
         self._owner: list = [None] * n_lanes
         self._queue: deque = deque()
+        # enqueue stamps, parallel to _queue (FIFO: the head is always
+        # the oldest) — the heartbeat's backlog-age signal
+        self._queued_at: deque = deque()
 
     # -- admission queue --------------------------------------------------
 
@@ -33,15 +41,18 @@ class LaneScheduler:
         """Queue a session for admission; returns its queue position
         (0 = next to be placed)."""
         self._queue.append(session)
+        self._queued_at.append(now())
         return len(self._queue) - 1
 
     def cancel(self, session) -> bool:
         """Drop a not-yet-placed session from the queue."""
         try:
-            self._queue.remove(session)
-            return True
+            i = self._queue.index(session)
         except ValueError:
             return False
+        del self._queue[i]
+        del self._queued_at[i]
+        return True
 
     def place(self) -> list:
         """Assign queued sessions to free lanes (FIFO x ascending lane
@@ -52,6 +63,7 @@ class LaneScheduler:
                 break
             if self._owner[lane] is None:
                 session = self._queue.popleft()
+                self._queued_at.popleft()
                 self._owner[lane] = session
                 placed.append((lane, session))
         return placed
@@ -76,6 +88,7 @@ class LaneScheduler:
         evicted = list(self._queue) + [s for s in self._owner
                                        if s is not None]
         self._queue.clear()
+        self._queued_at.clear()
         self._owner = [None] * self.n_lanes
         return evicted
 
@@ -83,6 +96,12 @@ class LaneScheduler:
 
     def n_queued(self) -> int:
         return len(self._queue)
+
+    def oldest_queued_s(self) -> float:
+        """Age (seconds) of the oldest not-yet-placed session, 0.0 on
+        an empty queue — growth here is the first sign admissions are
+        falling behind (surfaced in the heartbeat and stats)."""
+        return now() - self._queued_at[0] if self._queued_at else 0.0
 
     def n_assigned(self) -> int:
         return sum(s is not None for s in self._owner)

@@ -37,6 +37,7 @@ from concurrent.futures import ThreadPoolExecutor
 import numpy as np
 
 from cpr_tpu import resilience, telemetry
+from cpr_tpu.latency import LatencyBoard
 from cpr_tpu.serve import protocol as wire
 from cpr_tpu.serve.engine import ResidentEngine
 from cpr_tpu.serve.scheduler import LaneScheduler
@@ -48,9 +49,30 @@ def _serve_event(action: str, session=None, **detail):
                               detail=detail)
 
 
+def _request_event(trace_id, op, status, queue_wait_s, service_s,
+                   total_s, session, lane, splice_s):
+    """The one server-side `request` event call site
+    (EVENT_FIELDS['request']); the client-side twin lives in
+    protocol.ServeClient.  `role`/`run` correlate streams in
+    tools/trace_stitch.py."""
+    telemetry.current().event(
+        "request", trace_id=trace_id, op=op, status=status,
+        queue_wait_s=queue_wait_s, service_s=service_s, total_s=total_s,
+        role="server", run=telemetry.run_id(), session=session,
+        lane=lane, splice_s=splice_s)
+
+
+def _op_family(op) -> str:
+    """Latency-board family for one op (break_even.* variants share
+    one histogram; everything else is its own family)."""
+    op = str(op)
+    return "break_even" if op.startswith("break_even.") else op
+
+
 class _Session:
     __slots__ = ("sid", "kind", "seed", "policy", "policy_id", "lane",
-                 "future", "done")
+                 "future", "done", "t_enqueue", "t_admit",
+                 "t_first_burst", "t_complete", "splice_s")
 
     def __init__(self, sid, kind, seed, policy, policy_id, future):
         self.sid = sid
@@ -61,6 +83,14 @@ class _Session:
         self.lane = None
         self.future = future
         self.done = False
+        # request-scoped trace stamps (telemetry.now() clock): queued,
+        # admitted (lane spliced), first policy burst dispatched,
+        # session completed — the reply's latency breakdown
+        self.t_enqueue = telemetry.now()
+        self.t_admit = None
+        self.t_first_burst = None
+        self.t_complete = None
+        self.splice_s = None
 
 
 class ServeServer:
@@ -80,8 +110,15 @@ class ServeServer:
         # small integers clients use for reproducible requests
         self._seed = itertools.count(seed_base)
         self._sessions: dict[int, _Session] = {}
-        self._pending: dict[int, tuple] = {}  # lane -> (action, fut, s)
+        # lane -> (action, fut, session, t_requested)
+        self._pending: dict[int, tuple] = {}
         self._executor = ThreadPoolExecutor(max_workers=1)
+        # asyncio futures of executor ops still in flight — drained
+        # before the loop exits so no client hangs on a dropped future
+        self._inflight_exec: set = set()
+        # per-op-family reply latency + per-entry-point device
+        # dispatch walls (the `stats`/`heartbeat`/`report` SLO surface)
+        self.latency = LatencyBoard()
         self._netsim_engines: dict[tuple, object] = {}
         self._server = None
         self._loop_task = None
@@ -129,7 +166,12 @@ class ServeServer:
                     queued=self.sched.n_queued(),
                     occupancy=self.sched.occupancy(),
                     steps=self.engine.steps,
-                    episodes=self.engine.episodes)
+                    episodes=self.engine.episodes,
+                    # backlog age + in-flight op counts: growth here
+                    # shows up before clients start timing out
+                    oldest_queued_s=self.sched.oldest_queued_s(),
+                    pending_steps=len(self._pending),
+                    exec_ops=len(self._inflight_exec))
             await asyncio.sleep(0.0 if progressed else self.idle_sleep_s)
 
     def _tick_once(self) -> bool:
@@ -138,10 +180,15 @@ class ServeServer:
         # splice dispatch seeds every admission this tick
         placed = self.sched.place()
         if placed:
+            t0 = telemetry.now()
             obs_rows = self.engine.splice(
                 {lane: s.seed for lane, s in placed})
+            t1 = telemetry.now()
+            self.latency.observe("device.splice", t1 - t0)
             for lane, s in placed:
                 s.lane = lane
+                s.t_admit = t1
+                s.splice_s = t1 - t0
                 _serve_event("admit", s.sid, lane=lane, seed=s.seed,
                              kind=s.kind)
                 if s.kind == "interactive" and not s.future.done():
@@ -150,12 +197,22 @@ class ServeServer:
         # 2. interactive lanes with a pending client action
         if self._pending:
             pending, self._pending = self._pending, {}
+            t0 = telemetry.now()
             out = self.engine.tick(
-                {lane: a for lane, (a, _, _) in pending.items()})
-            for lane, (_, fut, s) in pending.items():
+                {lane: a for lane, (a, _, _, _) in pending.items()})
+            t1 = telemetry.now()
+            self.latency.observe("device.tick", t1 - t0)
+            for lane, (_, fut, s, t_req) in pending.items():
                 row = out[lane]
+                # the step's own breakdown: waited for this tick's
+                # dispatch, then one shared device tick served it
+                row["latency"] = dict(
+                    queue_wait_s=max(0.0, t0 - t_req),
+                    service_s=t1 - t0,
+                    total_s=max(0.0, t1 - t_req))
                 if row["done"]:
                     s.done = True
+                    s.t_complete = t1
                     self._sessions.pop(s.sid, None)
                     self.sched.retire(lane)
                     _serve_event(
@@ -173,12 +230,19 @@ class ServeServer:
                         for lane, s in self.sched.assigned().items()
                         if s.kind == "policy"}
         if policy_lanes:
+            t0 = telemetry.now()
+            for s in policy_lanes.values():
+                if s.t_first_burst is None:
+                    s.t_first_burst = t0
             out = self.engine.burst_run(
                 {lane: s.policy_id for lane, s in policy_lanes.items()},
                 occupancy=self.sched.occupancy())
+            t1 = telemetry.now()
+            self.latency.observe("device.burst", t1 - t0)
             for lane, s in policy_lanes.items():
                 if not out["done"][lane]:
                     continue  # episode spans into the next burst
+                s.t_complete = t1
                 att = float(out["episode_reward_attacker"][lane])
                 dfn = float(out["episode_reward_defender"][lane])
                 episode = dict(
@@ -198,6 +262,18 @@ class ServeServer:
             progressed = True
         return progressed
 
+    def _session_latency(self, s: _Session) -> dict:
+        """One completed (or refused) session's reply breakdown.
+        Monotonic stamps, clamped at 0 anyway so a reply can never
+        carry a negative latency."""
+        t_end = s.t_complete if s.t_complete is not None \
+            else telemetry.now()
+        t_admit = s.t_admit if s.t_admit is not None else t_end
+        return dict(
+            queue_wait_s=max(0.0, t_admit - s.t_enqueue),
+            service_s=max(0.0, t_end - t_admit),
+            total_s=max(0.0, t_end - s.t_enqueue))
+
     async def _drain(self, reason: str):
         self._draining = True
         _serve_event("drain", reason=reason)
@@ -205,19 +281,35 @@ class ServeServer:
         for s in self.sched.drain():
             if not s.future.done():
                 s.future.set_result(dict(refusal, session=s.sid))
-        for _, fut, _s in self._pending.values():
+        for _, fut, _s, _t in self._pending.values():
             if not fut.done():
                 fut.set_result(dict(refusal))
         self._pending.clear()
         self._sessions.clear()
-        report = self.engine.report()
+        # executor: cancel queued work (each cancelled future resolves
+        # to a draining refusal inside _blocking, so no client ever
+        # hangs on a dropped future), then wait out the op that is
+        # already running on the worker thread — it gets a real reply
+        self._executor.shutdown(wait=False, cancel_futures=True)
+        if self._inflight_exec:
+            await asyncio.wait(list(self._inflight_exec), timeout=60.0)
+            # one short turn so the handlers awaiting those futures
+            # write their replies before the loop winds down
+            await asyncio.sleep(0.05)
+        report = dict(self.engine.report(),
+                      latency=self.latency.snapshot())
+        # headline SLO: the policy-episode endpoint's total-latency
+        # quantiles, lifted into the perf ledger as serve_p50_s /
+        # serve_p99_s rows (perf/ledger.py _SERVE_METRICS)
+        run_lat = report["latency"].get("episode.run") or {}
+        report["p50_s"] = run_lat.get("p50_s")
+        report["p99_s"] = run_lat.get("p99_s")
         _serve_event("report", **report)
         self.engine.emit_metrics()
         _serve_event("stop", reason=reason, steps=report["steps"],
                      episodes=report["episodes"])
         self._server.close()
         await self._server.wait_closed()
-        self._executor.shutdown(wait=False)
 
     # -- connections ------------------------------------------------------
 
@@ -227,11 +319,7 @@ class ServeServer:
                 req = await wire.read_frame(reader)
                 if req is None:
                     break
-                try:
-                    resp = await self._dispatch(req)
-                except Exception as e:  # noqa: BLE001 — per-request wall
-                    resp = dict(ok=False,
-                                error=f"{type(e).__name__}: {e}")
+                resp = await self._serve_request(req)
                 await wire.write_frame(writer, resp)
         except (wire.ProtocolError, ConnectionError, asyncio.CancelledError):
             pass
@@ -242,10 +330,45 @@ class ServeServer:
             except (ConnectionError, OSError):
                 pass
 
+    async def _serve_request(self, req: dict) -> dict:
+        """Dispatch one request with its trace context: stamp receipt,
+        propagate (or mint) the trace id, backfill a wall-clock latency
+        breakdown on ops that carry none of their own, fold the total
+        into the per-family latency board, emit the v8 `request`
+        event, and echo `trace_id` + `latency` in the reply."""
+        trace = req.get("_trace") if isinstance(req.get("_trace"),
+                                                dict) else {}
+        trace_id = trace.get("id") or telemetry.new_trace_id()
+        t_recv = telemetry.now()
+        try:
+            resp = await self._dispatch(req)
+        except Exception as e:  # noqa: BLE001 — per-request wall
+            resp = dict(ok=False, error=f"{type(e).__name__}: {e}")
+        if not isinstance(resp, dict):  # defensive: handlers return dicts
+            resp = dict(ok=False, error="handler returned no dict")
+        lat = resp.get("latency")
+        if not (isinstance(lat, dict) and "total_s" in lat):
+            # immediate ops (hello/stats/executor queries): no queue,
+            # service is the whole wall
+            wall = telemetry.now() - t_recv
+            lat = dict(queue_wait_s=0.0, service_s=wall, total_s=wall)
+            resp["latency"] = lat
+        resp["trace_id"] = trace_id
+        status = ("ok" if resp.get("ok")
+                  else "refused" if resp.get("draining") else "error")
+        op = req.get("op")
+        self.latency.observe(_op_family(op), lat["total_s"])
+        _request_event(trace_id, op, status, lat["queue_wait_s"],
+                       lat["service_s"], lat["total_s"],
+                       resp.get("session"), resp.pop("_lane", None),
+                       resp.pop("_splice_s", None))
+        return resp
+
     async def _dispatch(self, req: dict) -> dict:
         op = req.get("op")
         if op == "hello":
             return dict(ok=True, schema=telemetry.SCHEMA_VERSION,
+                        run=telemetry.run_id(),
                         n_lanes=self.engine.n_lanes,
                         burst=self.engine.burst,
                         policies=list(self.engine.policy_names))
@@ -253,7 +376,14 @@ class ServeServer:
             return dict(ok=True, report=self.engine.report(),
                         queued=self.sched.n_queued(),
                         assigned=self.sched.n_assigned(),
-                        occupancy=self.sched.occupancy())
+                        occupancy=self.sched.occupancy(),
+                        oldest_queued_s=self.sched.oldest_queued_s(),
+                        pending_steps=len(self._pending),
+                        exec_ops=len(self._inflight_exec),
+                        # per-op-family histogram summaries; named
+                        # `latencies` because the singular `latency`
+                        # reply key is the per-request breakdown
+                        latencies=self.latency.snapshot())
         if op == "drain":
             self.request_drain(str(req.get("reason", "client")))
             return dict(ok=True, draining=True)
@@ -294,17 +424,21 @@ class ServeServer:
     async def _op_episode_run(self, req):
         s = self._new_session("policy", req)
         self.sched.enqueue(s)
-        return await s.future
+        resp = await s.future
+        return dict(resp, latency=self._session_latency(s),
+                    _lane=s.lane, _splice_s=s.splice_s)
 
     async def _op_episode_open(self, req):
         s = self._new_session("interactive", req)
         self.sched.enqueue(s)
         obs = await s.future
         if isinstance(obs, dict):  # drained before admission
-            return obs
+            return dict(obs, latency=self._session_latency(s))
         self._sessions[s.sid] = s
         return dict(ok=True, session=s.sid, seed=s.seed,
-                    obs=np.asarray(obs, np.float64).tolist())
+                    obs=np.asarray(obs, np.float64).tolist(),
+                    latency=self._session_latency(s),
+                    _lane=s.lane, _splice_s=s.splice_s)
 
     async def _op_episode_step(self, req):
         s = self._sessions.get(req.get("session"))
@@ -313,14 +447,16 @@ class ServeServer:
         if s.lane in self._pending:
             return dict(ok=False, error="step already in flight")
         fut = asyncio.get_running_loop().create_future()
-        self._pending[s.lane] = (int(req["action"]), fut, s)
+        self._pending[s.lane] = (int(req["action"]), fut, s,
+                                 telemetry.now())
         row = await fut
         if "ok" in row:  # drained refusal
             return row
         return dict(ok=True, session=s.sid,
                     obs=np.asarray(row["obs"], np.float64).tolist(),
                     reward=row["reward"], done=row["done"],
-                    info=row["info"])
+                    info=row["info"], latency=row["latency"],
+                    _lane=s.lane)
 
     def _op_episode_close(self, req):
         s = self._sessions.pop(req.get("session"), None)
@@ -332,8 +468,23 @@ class ServeServer:
         return dict(ok=True)
 
     async def _blocking(self, fn, *args):
-        return await asyncio.get_running_loop().run_in_executor(
+        if self._draining or self._drain_reason is not None:
+            return dict(ok=False, error="draining", draining=True)
+        fut = asyncio.get_running_loop().run_in_executor(
             self._executor, fn, *args)
+        self._inflight_exec.add(fut)
+        try:
+            return await fut
+        except asyncio.CancelledError:
+            # the drain's shutdown(cancel_futures=True) cancelled this
+            # queued work item: the client gets a refusal, never a
+            # silently dropped future.  A cancellation from anywhere
+            # else (e.g. the connection handler) still propagates.
+            if self._draining or self._drain_reason is not None:
+                return dict(ok=False, error="draining", draining=True)
+            raise
+        finally:
+            self._inflight_exec.discard(fut)
 
     # -- query endpoints (executor thread) --------------------------------
 

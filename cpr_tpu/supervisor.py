@@ -337,6 +337,10 @@ def run_child(cmd, *, wall_timeout_s: float, quiet_s: float | None = None,
     serving process once its clients finish) captures the handle here
     and signals from another thread."""
     child_env = dict(os.environ if env is None else env)
+    # trace context: every supervised child inherits this process
+    # tree's run id (minted here on first use), so its telemetry
+    # stream stitches against the parent's (tools/trace_stitch.py)
+    child_env.update(telemetry.trace_env())
     if heartbeat_s:
         child_env[HEARTBEAT_ENV_VAR] = str(heartbeat_s)
     else:

@@ -54,8 +54,11 @@ from contextlib import contextmanager
 from datetime import datetime, timezone
 from time import perf_counter as now  # noqa: F401 — re-exported
 
-SCHEMA_VERSION = 7
+SCHEMA_VERSION = 8
 TELEMETRY_ENV_VAR = "CPR_TELEMETRY"
+# trace context: one run id per process tree, exported so supervisor
+# children and serve clients land their events under the same id
+RUN_ID_ENV_VAR = "CPR_RUN_ID"
 PROFILE_ENV_VAR = "CPR_PROFILE_DIR"
 CHECKIFY_ENV_VAR = "CPR_CHECKIFY"
 # in-graph metrics gate; canonical reader is cpr_tpu.device_metrics
@@ -100,7 +103,57 @@ EVENT_FIELDS = {
     # free-form dict (lane/seed on admit, steps_per_sec/occupancy on
     # report — the perf ledger lifts report rows via iter_trace_rows)
     "serve": ("action", "session", "detail"),
+    # v8: one per serve request, on BOTH sides of the wire (role
+    # "server" in cpr_tpu/serve/server.py, role "client" in
+    # protocol.ServeClient).  trace_id correlates the two streams
+    # (tools/trace_stitch.py); the three latencies are the reply's own
+    # queue_wait/service/total breakdown in seconds.  Extras ride
+    # free-form: role, run, session, lane, splice_s, t_* stamps.
+    "request": ("trace_id", "op", "status", "queue_wait_s",
+                "service_s", "total_s"),
 }
+
+
+# -- trace context -----------------------------------------------------------
+#
+# `now()` is process-relative (perf_counter), so timestamps from two
+# processes can never be compared directly; correlation is by ids —
+# one `run_id` per process tree (minted once, inherited through the
+# environment by supervisor children and smoke clients) and one
+# `trace_id` per serve request (carried across the wire in the
+# protocol's reserved `_trace` field).  Stitching therefore works on
+# durations only (tools/trace_stitch.py).
+
+_run_id: str | None = None
+
+
+def run_id() -> str:
+    """This process tree's run id: inherited from $CPR_RUN_ID when a
+    parent minted one, else minted here and exported so every child
+    spawned after this call lands in the same trace."""
+    global _run_id
+    if _run_id is None:
+        rid = os.environ.get(RUN_ID_ENV_VAR)
+        if not rid:
+            import uuid
+
+            rid = uuid.uuid4().hex[:16]
+            os.environ[RUN_ID_ENV_VAR] = rid
+        _run_id = rid
+    return _run_id
+
+
+def trace_env() -> dict:
+    """The env-var dict that carries the trace context into a child
+    process (merged into the child env by supervisor.run_child)."""
+    return {RUN_ID_ENV_VAR: run_id()}
+
+
+def new_trace_id() -> str:
+    """A fresh per-request trace id (client side of a serve request)."""
+    import uuid
+
+    return uuid.uuid4().hex[:16]
 
 
 class Span:
@@ -298,6 +351,9 @@ def run_manifest(config: dict | None = None) -> dict:
     man: dict = {
         "kind": "manifest",
         "schema": SCHEMA_VERSION,
+        # v8: streams of one supervised run share a run id, which is
+        # how trace_stitch groups server/child/client JSONL files
+        "run": run_id(),
         "time_utc": datetime.now(timezone.utc).isoformat(
             timespec="seconds"),
         "argv": list(sys.argv),

@@ -2,7 +2,9 @@
 in this fixture tree declares compile and retry as typed events)."""
 
 
-def report(tele, fn_name):
+def report(tele, fn_name, tid):
     tele.event("compile", fn=fn_name)  # finding: missing compile_s
     # finding: missing delay_s, error
     tele.emit({"kind": "event", "name": "retry", "attempt": 1})
+    # finding: missing total_s (the v8 request-latency contract)
+    tele.event("request", trace_id=tid, op="episode.run", status="ok")

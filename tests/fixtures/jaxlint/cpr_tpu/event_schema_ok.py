@@ -2,10 +2,12 @@
 dynamic payloads are out of the rule's scope."""
 
 
-def report(tele, fn_name, dt, err, extra):
+def report(tele, fn_name, dt, err, extra, tid):
     tele.event("compile", fn=fn_name, compile_s=dt)
     tele.event("compile", fn=fn_name, compile_s=dt, cached=True)
     tele.event("custom_untyped", whatever=1)
     tele.event("compile", **extra)  # dynamic kwargs: not checkable
     tele.emit({"kind": "event", "name": "retry", "attempt": 1,
                "delay_s": 0.5, "error": err})
+    tele.event("request", trace_id=tid, op="episode.run", status="ok",
+               total_s=dt, role="client")  # extras ride free-form

@@ -4,4 +4,5 @@ EVENT_FIELDS literal cross-module by AST (the file is never imported)."""
 EVENT_FIELDS = {
     "compile": ("fn", "compile_s"),
     "retry": ("attempt", "delay_s", "error"),
+    "request": ("trace_id", "op", "status", "total_s"),
 }

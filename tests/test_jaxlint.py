@@ -68,8 +68,11 @@ def test_raw_write_exempts_resilience():
 
 def test_event_fields_resolved_cross_module_by_ast():
     schema = LintContext(root=FIXROOT).event_fields()
-    assert schema == {"compile": ("fn", "compile_s"),
-                      "retry": ("attempt", "delay_s", "error")}
+    assert schema == {
+        "compile": ("fn", "compile_s"),
+        "retry": ("attempt", "delay_s", "error"),
+        "request": ("trace_id", "op", "status", "total_s"),
+    }
 
 
 def test_disable_rule_and_unknown_rule():

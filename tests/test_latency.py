@@ -1,0 +1,108 @@
+"""cpr_tpu.latency: the histogram/quantile math behind the serving SLO
+plane.  Jax-free host code, so these are plain-math tests: quantile
+estimates are checked against true sample quantiles within the ~7%
+log-bucket error the module documents, and the degenerate shapes
+(empty, single-sample, underflow/overflow, clock skew) are pinned.
+"""
+
+import json
+import math
+
+import pytest
+
+from cpr_tpu.latency import LatencyBoard, LatencyHistogram, default_edges
+
+
+def test_default_edges_are_log_uniform_and_span_the_range():
+    edges = default_edges()
+    assert edges[0] == pytest.approx(1e-6)
+    assert edges[-1] == pytest.approx(1e3)
+    ratios = [b / a for a, b in zip(edges, edges[1:])]
+    assert all(r == pytest.approx(ratios[0], rel=1e-9) for r in ratios)
+    with pytest.raises(ValueError, match="increasing"):
+        LatencyHistogram((1.0, 1.0, 2.0))
+    with pytest.raises(ValueError, match="increasing"):
+        LatencyHistogram(())
+
+
+def test_empty_histogram_is_honest():
+    h = LatencyHistogram()
+    assert h.quantile(0.5) is None
+    assert h.snapshot() == {"count": 0}
+    with pytest.raises(ValueError, match="quantile"):
+        LatencyHistogram().quantile(1.5)
+
+
+def test_single_sample_reports_the_sample_not_a_bucket_edge():
+    h = LatencyHistogram()
+    h.observe(0.0123)
+    for q in (0.0, 0.5, 0.99, 1.0):
+        assert h.quantile(q) == pytest.approx(0.0123)
+    snap = h.snapshot()
+    assert snap["count"] == 1
+    assert snap["mean_s"] == snap["min_s"] == snap["max_s"] \
+        == pytest.approx(0.0123)
+
+
+def test_quantiles_track_true_sample_quantiles_within_bucket_error():
+    h = LatencyHistogram()
+    # log-uniform samples over 1ms..100ms: true q-quantile is
+    # 10**(-3 + 2q); the estimate must stay inside the documented ~7%
+    samples = [10.0 ** (-3.0 + 2.0 * i / 999.0) for i in range(1000)]
+    for s in samples:
+        h.observe(s)
+    for q in (0.10, 0.50, 0.95, 0.99):
+        true = 10.0 ** (-3.0 + 2.0 * q)
+        assert h.quantile(q) == pytest.approx(true, rel=0.08), q
+    # quantiles are monotone in q
+    qs = [h.quantile(q / 20.0) for q in range(21)]
+    assert qs == sorted(qs)
+    snap = h.snapshot()
+    assert snap["p50_s"] <= snap["p95_s"] <= snap["p99_s"]
+    assert snap["count"] == 1000
+    assert snap["mean_s"] == pytest.approx(sum(samples) / 1000.0)
+
+
+def test_clock_skew_and_out_of_range_observations_never_corrupt():
+    h = LatencyHistogram()
+    h.observe(-0.5)  # skewed stamps clamp to 0
+    h.observe(float("nan"))  # skipped outright
+    h.observe(float("inf"))
+    h.observe(1e-9)  # underflow bucket
+    h.observe(1e9)  # overflow bucket
+    assert h.count == 3
+    assert h.min_s == 0.0 and h.max_s == 1e9
+    # estimates stay inside the observed range even for the open-ended
+    # under/overflow buckets
+    assert 0.0 <= h.quantile(0.01) <= h.quantile(0.99) <= 1e9
+
+
+def test_merge_sums_counts_and_rejects_differing_edges():
+    a, b = LatencyHistogram(), LatencyHistogram()
+    for v in (0.001, 0.002, 0.004):
+        a.observe(v)
+    for v in (0.008, 0.016):
+        b.observe(v)
+    a.merge(b)
+    assert a.count == 5
+    assert a.min_s == 0.001 and a.max_s == 0.016
+    assert a.sum_s == pytest.approx(0.031)
+    with pytest.raises(ValueError, match="differing edges"):
+        a.merge(LatencyHistogram((0.1, 1.0)))
+
+
+def test_board_is_lazy_per_family_and_json_ready():
+    board = LatencyBoard()
+    assert board.families == () and board.snapshot() == {}
+    board.observe("episode.run", 0.5)
+    board.observe("episode.run", 0.7)
+    board.observe("device.splice", 0.001)
+    assert board.families == ("device.splice", "episode.run")
+    assert board.get("episode.run").count == 2
+    assert board.get("nope") is None
+    snap = board.snapshot()
+    assert set(snap) == {"device.splice", "episode.run"}
+    assert snap["episode.run"]["count"] == 2
+    assert 0.5 <= snap["episode.run"]["p99_s"] <= 0.7
+    json.dumps(snap)  # the stats/heartbeat/report embedding
+    assert all(math.isfinite(v) for v in snap["episode.run"].values())

@@ -347,6 +347,128 @@ def test_trace_summary_validate_v4_netsim_event(tmp_path, capsys):
     assert any("netsim" in err and "drops" in err for err in errors)
 
 
+def test_trace_summary_validate_v8_request_event(tmp_path, capsys):
+    """The v8 schema's request event (PR 10) round-trips the
+    validator: a fully-typed event passes, including under
+    `--expect request`, and dropping a declared latency field is
+    caught."""
+    ts = _load_trace_summary()
+    good = tmp_path / "request.jsonl"
+    tele = telemetry.Telemetry(str(good))
+    with tele.span("serve"):
+        pass
+    tele.event("request", trace_id="ab12cd34", op="episode.run",
+               status="ok", queue_wait_s=0.1, service_s=0.3,
+               total_s=0.4, role="server", run="r1", session=1,
+               lane=0, splice_s=0.01)
+    tele.manifest(config={"entry": "serve"})
+    tele.close()
+    events, bad = ts.read_events(str(good))
+    (man,) = [e for e in events if e.get("kind") == "manifest"]
+    assert man["schema"] >= 8 and man["run"]
+    assert ts.validate(events, bad) == []
+    assert ts.validate(events, bad, expect=("request",)) == []
+    ts.main(["trace_summary", str(good), "--validate",
+             "--expect", "request"])  # exits 0
+    out = capsys.readouterr().out
+    assert "episode.run" in out and "server" in out
+
+    lame = tmp_path / "lame.jsonl"
+    lines = []
+    for line in good.read_text().splitlines():
+        e = json.loads(line)
+        if e.get("name") == "request":
+            e.pop("total_s")
+        lines.append(json.dumps(e))
+    lame.write_text("\n".join(lines) + "\n")
+    events, bad = ts.read_events(str(lame))
+    errors = ts.validate(events, bad)
+    assert any("request" in err and "total_s" in err for err in errors)
+
+
+def _load_trace_stitch():
+    path = os.path.join(os.path.dirname(__file__), "..", "tools",
+                        "trace_stitch.py")
+    spec = importlib.util.spec_from_file_location("trace_stitch", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _request_line(tele, trace_id, role, run, op="episode.run",
+                  status="ok", queue_wait_s=0.1, service_s=0.3,
+                  total_s=0.4, **extra):
+    tele.event("request", trace_id=trace_id, op=op, status=status,
+               queue_wait_s=queue_wait_s, service_s=service_s,
+               total_s=total_s, role=role, run=run, **extra)
+
+
+def test_trace_stitch_merges_streams_and_tolerates_orphans(tmp_path,
+                                                           capsys):
+    """Satellite d: three streams of one run — the serve server (a
+    supervisor child), the supervising parent, and a client — merge
+    into one trace tree keyed by the shared run id; a trace_id seen on
+    only one side of the wire is kept and marked, never dropped."""
+    stitcher = _load_trace_stitch()
+    run = "deadbeef00112233"
+    server = tmp_path / "server.jsonl"
+    tele = telemetry.Telemetry(str(server))
+    tele.emit({"kind": "manifest", "run": run, "backend": "cpu"})
+    _request_line(tele, "t1", "server", run, splice_s=0.02, lane=0,
+                  queue_wait_s=0.1, service_s=0.3, total_s=0.4)
+    _request_line(tele, "t-server-only", "server", run, op="stats",
+                  queue_wait_s=0.0, service_s=0.001, total_s=0.001)
+    tele.close()
+    parent = tmp_path / "parent.jsonl"
+    tele = telemetry.Telemetry(str(parent))
+    tele.emit({"kind": "manifest", "run": run, "backend": "cpu"})
+    tele.event("supervisor", action="probe", site="serve",
+               reason="startup")
+    tele.close()
+    client = tmp_path / "client.jsonl"
+    tele = telemetry.Telemetry(str(client))
+    tele.emit({"kind": "manifest", "run": run})
+    _request_line(tele, "t1", "client", run, total_s=0.45)
+    _request_line(tele, "t-client-only", "client", run, total_s=0.2)
+    tele.close()
+
+    st = stitcher.stitch([str(server), str(parent), str(client)])
+    assert set(st["runs"]) == {run}
+    assert sorted(st["runs"][run]) == ["client.jsonl", "parent.jsonl",
+                                       "server.jsonl"]
+    by_id = {t["trace_id"]: t for t in st["traces"]}
+    assert len(by_id) == 3 and st["orphans"] == 2
+    t1 = by_id["t1"]
+    assert t1["orphan"] is None and t1["run"] == run
+    bd = t1["breakdown"]
+    assert bd["splice_s"] == pytest.approx(0.02)
+    assert bd["queue_s"] == pytest.approx(0.08)  # wait minus splice
+    assert bd["burst_s"] == pytest.approx(0.3)
+    assert bd["reply_s"] == pytest.approx(0.05)  # client - server
+    assert bd["total_s"] == pytest.approx(0.45)  # the client's wall
+    assert by_id["t-server-only"]["orphan"] == "no-client"
+    assert by_id["t-client-only"]["orphan"] == "no-server"
+    # one-sided traces keep a partial breakdown instead of exploding
+    lonely = by_id["t-client-only"]["breakdown"]
+    assert lonely["burst_s"] is None and lonely["reply_s"] is None
+    assert lonely["total_s"] == pytest.approx(0.2)
+    assert st["ops"]["episode.run"]["two_sided"] == 1
+
+    rc = stitcher.main([str(server), str(parent), str(client)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "t-client-only" in out and "no-server" in out
+    assert f"run {run}" in out
+
+
+def test_trace_stitch_empty_streams_exit_nonzero(tmp_path, capsys):
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text(json.dumps({"kind": "manifest", "run": "r"}) + "\n")
+    stitcher = _load_trace_stitch()
+    assert stitcher.main([str(empty)]) == 1
+    capsys.readouterr()
+
+
 def test_malformed_dag_dump_atomic(tmp_path, monkeypatch):
     """The forensics dump rides the resilience atomic writer: the
     final name holds the complete dot text and no orphaned tmp

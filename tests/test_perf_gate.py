@@ -147,7 +147,7 @@ def test_restart_count_tagged_but_rows_stay_baseline_eligible():
     read 2-5x slow) but stays in the baseline pool; junk counts
     normalize to 0 instead of wedging ingestion."""
     rec = _row(value=90.0, restart_count=1)
-    assert rec["ledger"] == 2
+    assert rec["ledger"] == 3
     assert rec["restart_count"] == 1 and rec["probe"] is False
     assert _row(value=1.0, restart_count="two")["restart_count"] == 0
     hist = ([_row(value=100.0, rnd=i) for i in range(2)]
@@ -208,6 +208,70 @@ def test_config_drift_flagged_and_same_fingerprint_preferred():
     res = perf.gate_row(_row(value=48.0, cfg_n_envs=4096), mixed)
     assert not res["config_drift"]
     assert res["baseline"]["median"] == 50.0
+
+
+def test_ledger_v3_direction_field_and_inference():
+    """Ledger v3: every record carries a gate direction — `*_s`
+    metrics (latencies) are lower-is-better, everything else higher;
+    an explicit row key overrides the name inference, junk falls back
+    to it."""
+    assert perf.metric_direction("serve_p99_s") == "lower"
+    assert perf.metric_direction("compile_s") == "lower"
+    assert perf.metric_direction("serve_steps_per_sec") == "higher"
+    assert perf.metric_direction("serve_occupancy") == "higher"
+    rec = _row(metric="serve_p99_s", value=0.5)
+    assert rec["ledger"] == 3 and rec["direction"] == "lower"
+    assert _row(value=100.0)["direction"] == "higher"
+    rec = perf.normalize_row({"metric": "weird_metric", "backend": "tpu",
+                              "value": 1.0, "direction": "lower"})
+    assert rec["direction"] == "lower"
+    rec = perf.normalize_row({"metric": "x_per_sec", "backend": "tpu",
+                              "value": 1.0, "direction": "sideways"})
+    assert rec["direction"] == "higher"
+
+
+def test_gate_band_flips_for_lower_is_better_metrics():
+    """Satellite a: a serve_p99_s history at a quiet 0.5s — a matching
+    candidate passes, +10%+ warns, +25%+ fails, and an improvement
+    (smaller latency) always passes; the higher-is-better banding of
+    the surrounding tests is untouched."""
+    hist = [_row(metric="serve_p99_s", backend="cpu", value=0.5, rnd=i)
+            for i in range(5)]
+    for value, verdict in [(0.51, "pass"), (0.58, "warn"),
+                           (0.90, "fail"), (0.10, "pass")]:
+        res = perf.gate_row(_row(metric="serve_p99_s", backend="cpu",
+                                 value=value), hist)
+        assert res["verdict"] == verdict, (value, res)
+        assert res["direction"] == "lower"
+    res = perf.gate_row(_row(metric="serve_p99_s", backend="cpu",
+                             value=0.90), hist)
+    assert res["baseline"]["median"] == 0.5
+    assert res["baseline"]["best"] == 0.5
+    assert "fail_above" in res["baseline"]
+    assert "lower is better" in res["reason"]
+
+
+def test_serve_report_latency_rows_ingest_with_direction(tmp_path):
+    """iter_trace_rows lifts the drain report's p50_s/p99_s alongside
+    the throughput rows, direction-stamped for the flipped band."""
+    trace = tmp_path / "t.jsonl"
+    events = [{"kind": "manifest", "backend": "cpu",
+               "config": {"entry": "serve", "n_lanes": 4}},
+              {"kind": "event", "name": "serve", "action": "report",
+               "session": None,
+               "detail": {"steps_per_sec": 1000.0, "occupancy": 0.9,
+                          "p50_s": 0.02, "p99_s": 0.2}}]
+    trace.write_text("".join(json.dumps(e) + "\n" for e in events))
+    rows = {r["metric"]: r for r in
+            (perf.normalize_row(row, source=src)
+             for row, src in perf.iter_trace_rows(str(trace)))}
+    assert set(rows) == {"serve_steps_per_sec", "serve_occupancy",
+                         "serve_p50_s", "serve_p99_s"}
+    assert rows["serve_p50_s"]["value"] == 0.02
+    assert rows["serve_p99_s"]["direction"] == "lower"
+    assert rows["serve_p99_s"]["unit"] == "seconds"
+    assert rows["serve_steps_per_sec"]["direction"] == "higher"
+    assert rows["serve_p99_s"]["config"].get("cfg_n_lanes") == 4
 
 
 def test_gate_summary_counts():
@@ -290,6 +354,27 @@ def test_perf_report_seeded_regression_exits_nonzero(tmp_path, capsys):
     assert "FAIL" in out
     # report-only mode surfaces the same verdict but exits zero
     assert pr.main([led.path]) == 0
+    capsys.readouterr()
+
+
+def test_perf_report_gate_fails_on_p99_regression(tmp_path, capsys):
+    """The ISSUE-10 acceptance: a fresh serve_p99_s row regressing
+    past the banked band FAILs `perf_report --gate` exactly like a
+    steps/sec drop would."""
+    led = perf.Ledger(str(tmp_path / "l.jsonl"))
+    hist = [_row(metric="serve_p99_s", backend="cpu",
+                 value=0.200 + 0.001 * i, rnd=i + 1) for i in range(5)]
+    led.append(hist + [_row(metric="serve_p99_s", backend="cpu",
+                            value=0.400, source="zz_live")])
+    pr = _load_tool("perf_report")
+    assert pr.main([led.path, "--gate"]) == 1
+    out = capsys.readouterr().out
+    assert "FAIL" in out and "lower-is-better" in out
+    # an *improved* (lower) fresh row gates clean
+    led2 = perf.Ledger(str(tmp_path / "l2.jsonl"))
+    led2.append(hist + [_row(metric="serve_p99_s", backend="cpu",
+                             value=0.150, source="zz_live")])
+    assert pr.main([led2.path, "--gate"]) == 0
     capsys.readouterr()
 
 

@@ -379,6 +379,14 @@ def test_server_end_to_end_over_the_wire(env, params):
 
             r = c.request("episode.run", policy="honest", seed=7)
             assert r["ok"] and r["policy"] == "honest" and r["seed"] == 7
+            # v8: every reply carries its own trace id + latency
+            # breakdown; the internal _lane/_splice_s keys never leak
+            assert isinstance(r["trace_id"], str) and r["trace_id"]
+            lat = r["latency"]
+            assert lat["queue_wait_s"] >= 0.0 and lat["service_s"] >= 0.0
+            assert abs(lat["total_s"]
+                       - (lat["queue_wait_s"] + lat["service_s"])) < 1e-6
+            assert "_lane" not in r and "_splice_s" not in r
             _, _, _, done, info = _solo(env, params, 7, MAX_STEPS + 1)
             idx = int(np.argmax(done))
             ep = r["episode"]
@@ -399,6 +407,7 @@ def test_server_end_to_end_over_the_wire(env, params):
                 s = c.request("episode.step", session=o["session"],
                               action=act)
                 assert s["ok"]
+                assert s["latency"]["total_s"] >= 0.0 and "_lane" not in s
                 assert s["reward"] == float(reward[step]), step
                 assert s["done"] == bool(done[step]), step
                 if s["done"]:
@@ -412,10 +421,135 @@ def test_server_end_to_end_over_the_wire(env, params):
             stats = c.request("stats")
             assert stats["ok"] and stats["report"]["steps"] > 0
             assert stats["occupancy"] == 0.0  # everything retired
+            # v8 SLO surface: backlog age, in-flight op counts, and
+            # the per-op-family latency histograms
+            assert stats["oldest_queued_s"] == 0.0
+            assert stats["pending_steps"] == 0
+            assert stats["exec_ops"] == 0
+            fams = stats["latencies"]
+            assert fams["episode.run"]["count"] >= 1
+            assert 0.0 < fams["episode.run"]["p50_s"] \
+                <= fams["episode.run"]["p99_s"]
+            assert fams["episode.step"]["count"] >= MAX_STEPS
             assert c.request("drain")["ok"]
     finally:
         t.join(60)
     assert not t.is_alive(), "server loop did not drain"
+
+
+def _spawn_server(server):
+    """Run one ServeServer loop in a daemon thread; returns (thread,
+    bound port)."""
+    ports: queue.Queue = queue.Queue()
+
+    def run():
+        async def amain():
+            await server.start()
+            ports.put(server.port)
+            await server.serve_until_drained()
+
+        asyncio.run(amain())
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    return t, ports.get(timeout=60)
+
+
+def test_drain_under_load_never_hangs_blocking_clients(env, params):
+    """Satellite b: SIGTERM/drain while executor ops are in flight.
+    The op already running on the worker thread finishes and its
+    client gets the real reply; the op still queued behind it is
+    cancelled by shutdown(cancel_futures=True) and its client gets a
+    draining refusal — nobody hangs on a dropped future."""
+    import time
+    from concurrent.futures import ThreadPoolExecutor
+
+    engine = ResidentEngine(env, params, n_lanes=N_LANES, burst=BURST)
+    engine.start()
+    from cpr_tpu.serve.server import ServeServer
+
+    server = ServeServer(engine, heartbeat_s=0.2, idle_sleep_s=0.001)
+    entered, release = threading.Event(), threading.Event()
+    calls = []
+
+    def slow_query(req):
+        calls.append(req)
+        if len(calls) == 1:
+            entered.set()
+            assert release.wait(30.0), "test never released the op"
+        return dict(ok=True, n=len(calls))
+
+    server._netsim_query = slow_query
+    t, port = _spawn_server(server)
+
+    def query():
+        with ServeClient("127.0.0.1", port, timeout=60) as c:
+            return c.request("netsim.query")
+
+    try:
+        with ThreadPoolExecutor(max_workers=2) as pool:
+            fa = pool.submit(query)
+            assert entered.wait(30.0), "first query never reached the " \
+                                       "executor"
+            fb = pool.submit(query)
+            with ServeClient("127.0.0.1", port, timeout=60) as c:
+                for _ in range(500):  # until both ops are in flight
+                    if c.request("stats")["exec_ops"] >= 2:
+                        break
+                    time.sleep(0.01)
+                else:
+                    raise AssertionError("second query never in flight")
+                assert c.request("drain")["ok"]
+            rb = fb.result(timeout=30)
+            assert not rb.get("ok") and rb.get("draining"), rb
+            assert rb["latency"]["total_s"] >= 0.0  # refusals carry one
+            release.set()
+            ra = fa.result(timeout=30)
+            assert ra.get("ok") and ra["n"] == 1, ra
+    finally:
+        release.set()
+    t.join(60)
+    assert not t.is_alive(), "server loop did not drain under load"
+    assert len(calls) == 1, "the cancelled queued op ran anyway"
+
+
+def test_request_trace_propagates_across_the_wire(env, params, tmp_path):
+    """The client's _trace frame field and the server's reply agree on
+    one trace id, and both sides emit a v8 `request` event carrying
+    it (in-process both land on the same sink)."""
+    from cpr_tpu import telemetry
+    from cpr_tpu.serve.server import ServeServer
+
+    engine = ResidentEngine(env, params, n_lanes=N_LANES, burst=BURST)
+    engine.start()
+    trace = tmp_path / "trace.jsonl"
+    telemetry.configure(str(trace))
+    try:
+        server = ServeServer(engine, heartbeat_s=5.0, idle_sleep_s=0.001)
+        t, port = _spawn_server(server)
+        with ServeClient("127.0.0.1", port, timeout=120) as c:
+            r = c.request("episode.run", policy="honest", seed=3)
+            assert r["ok"]
+            assert c.request("drain")["ok"]
+        t.join(60)
+        assert not t.is_alive()
+    finally:
+        telemetry.configure(None)
+    events = [json.loads(ln) for ln in
+              trace.read_text().splitlines() if ln.strip()]
+    reqs = [e for e in events if e.get("kind") == "event"
+            and e.get("name") == "request"
+            and e.get("op") == "episode.run"]
+    roles = {e["role"] for e in reqs}
+    assert roles == {"server", "client"}
+    by_role = {e["role"]: e for e in reqs}
+    assert (by_role["client"]["trace_id"]
+            == by_role["server"]["trace_id"] == r["trace_id"])
+    assert by_role["client"]["run"] == by_role["server"]["run"]
+    assert by_role["server"]["status"] == "ok"
+    # the client's total includes the wire, so it bounds the server's
+    assert (by_role["client"]["total_s"]
+            >= by_role["server"]["total_s"] > 0.0)
 
 
 # -- perf ledger ingestion + gate (satellite f) ----------------------------
@@ -466,5 +600,8 @@ def test_ledger_ingests_and_gates_serve_rows(tmp_path):
 def test_serve_event_schema_declared():
     from cpr_tpu.telemetry import EVENT_FIELDS, SCHEMA_VERSION
 
-    assert SCHEMA_VERSION >= 7
+    assert SCHEMA_VERSION >= 8
     assert EVENT_FIELDS["serve"] == ("action", "session", "detail")
+    assert EVENT_FIELDS["request"] == (
+        "trace_id", "op", "status", "queue_wait_s", "service_s",
+        "total_s")

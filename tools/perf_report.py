@@ -117,8 +117,10 @@ def gate_lines(results):
                    f"best={_fmt_val(base['best'])}"
                    f"@{base.get('best_source')} n={base['n']}")
         drift = " [config-drift]" if res.get("config_drift") else ""
+        lower = " [lower-is-better]" if res.get("direction") == "lower" \
+            else ""
         yield (f"gate: {res['metric']} [{res['backend']}] "
-               f"{res['verdict'].upper()}{drift} "
+               f"{res['verdict'].upper()}{drift}{lower} "
                f"value={_fmt_val(res['value'])}{against}")
         if res["verdict"] != "pass":
             yield f"      {res['reason']}"

@@ -18,10 +18,21 @@ clients, a graceful SIGTERM drain — and banks the measured throughput:
      batch `rollout()` measured in-process afterwards — the ISSUE-9
      acceptance band;
   4  SIGTERM: the child must drain (serve `drain`/`report`/`stop`
-     events) and exit 0, the trace must pass
-     `trace_summary --validate --expect serve,device_metrics`, and the
-     report's `serve_steps_per_sec` / `serve_occupancy` rows must
-     ingest into the perf ledger and clear the regression gate.
+     events) and exit 0, the drain report must carry sane request
+     latencies (0 < p50_s <= p99_s < wall), the trace must pass
+     `trace_summary --validate --expect serve,device_metrics,request`,
+     and the report's `serve_steps_per_sec` / `serve_p50_s` /
+     `serve_p99_s` rows must ingest into the perf ledger and clear the
+     (direction-aware) regression gate;
+  5  the smoke's own client side writes a second telemetry stream, and
+     `trace_stitch` over server + client streams must pair at least
+     one request trace on both sides of the wire under the shared run
+     id — the end-to-end proof of the v8 trace context.
+
+The <2% tracing-overhead acceptance is enforced by the same
+CPR_SERVE_MIN_FRAC throughput floor as ISSUE 9: the flood runs with
+CPR_TELEMETRY + request events live, so a tracing regression eats
+straight into the measured serve/rollout fraction.
 
 Usage: python tools/serve_smoke.py [workdir]   (default /tmp/...)
 """
@@ -257,34 +268,92 @@ def _validate_stream(trace):
                         "trace_summary.py")
     r = subprocess.run(
         [sys.executable, tool, trace, "--validate",
-         "--expect", "serve,device_metrics"],
+         "--expect", "serve,device_metrics,request"],
         capture_output=True, text=True)
     if r.returncode != 0:
         sys.stderr.write(r.stdout + r.stderr)
         raise SystemExit(f"telemetry validation failed for {trace}")
 
 
+def _check_drain_latency(trace):
+    """The drain report's SLO summary must be present and sane:
+    0 < p50 <= p99 < the wall budget (an episode.run total can never
+    exceed the run itself)."""
+    reports = _serve_events(trace, "report")
+    detail = (reports[-1].get("detail") or {}) if reports else {}
+    p50, p99 = detail.get("p50_s"), detail.get("p99_s")
+    if not (isinstance(p50, (int, float)) and isinstance(p99, (int, float))):
+        raise SystemExit(f"drain report carries no p50_s/p99_s: "
+                         f"{sorted(detail)}")
+    if not 0.0 < p50 <= p99 < WALL_S:
+        raise SystemExit(f"drain report latencies insane: "
+                         f"p50={p50} p99={p99}")
+    return p50, p99
+
+
+def _check_stitch(server_trace, client_trace):
+    """trace_stitch must pair server and client request events under
+    one shared run id — at least one two-sided trace with a full
+    breakdown (queue/burst from the server side, reply from both)."""
+    tools_dir = os.path.dirname(os.path.abspath(__file__))
+    if tools_dir not in sys.path:
+        sys.path.insert(0, tools_dir)
+    import trace_stitch
+
+    st = trace_stitch.stitch([server_trace, client_trace])
+    if len(st["runs"]) != 1:
+        raise SystemExit(f"expected one shared run id across streams, "
+                         f"got {sorted(st['runs'])}")
+    paired = [t for t in st["traces"] if t["orphan"] is None]
+    if not paired:
+        raise SystemExit("trace_stitch paired no request across the "
+                         "server and client streams")
+    full = [t for t in paired
+            if t["breakdown"]["burst_s"] is not None
+            and t["breakdown"]["reply_s"] is not None]
+    if not full:
+        raise SystemExit("no paired trace carries a full critical-path "
+                         "breakdown")
+    return len(paired), len(st["traces"])
+
+
+# ledger metrics the smoke must bank from the drain report; latencies
+# gate with the flipped lower-is-better band (cpr_tpu/perf/gate.py)
+_REQUIRED_METRICS = ("serve_steps_per_sec", "serve_p50_s", "serve_p99_s")
+
+
 def _bank_and_gate(workdir, trace):
     ledger = Ledger(os.path.join(workdir, "perf_ledger.jsonl"))
     n = ledger.ingest_trace(trace)
     records = ledger.records()
-    serve_rows = [r for r in records
-                  if r.get("metric") == "serve_steps_per_sec"]
-    if not serve_rows:
-        raise SystemExit("no serve_steps_per_sec row reached the ledger")
-    results = [gate_row(r, records) for r in serve_rows]
+    results = []
+    for metric in _REQUIRED_METRICS:
+        rows = [r for r in records if r.get("metric") == metric]
+        if not rows:
+            raise SystemExit(f"no {metric} row reached the ledger")
+        results.extend(gate_row(r, records) for r in rows)
     summary = gate_summary(results)
     if not summary["ok"]:
-        raise SystemExit(f"serve throughput gate failed: {results}")
-    return n, serve_rows[-1]["value"], summary
+        raise SystemExit(f"serve perf gate failed: {results}")
+    sps = [r for r in records
+           if r.get("metric") == "serve_steps_per_sec"]
+    return n, sps[-1]["value"], summary
 
 
 def main():
     work = sys.argv[1] if len(sys.argv) > 1 else "/tmp/cpr-serve-smoke"
     os.makedirs(work, exist_ok=True)
     trace = os.path.join(work, "serve.jsonl")
-    if os.path.exists(trace):
-        os.remove(trace)
+    client_trace = os.path.join(work, "client.jsonl")
+    for p in (trace, client_trace):
+        if os.path.exists(p):
+            os.remove(p)
+    # the smoke's own client side is a telemetry producer too: every
+    # ServeClient.request lands a role="client" request event on this
+    # stream, and the manifest stamps the run id the server child
+    # inherits via $CPR_RUN_ID — the two files trace_stitch pairs up
+    telemetry.configure(client_trace)
+    telemetry.current().manifest(dict(role="serve-smoke-client"))
 
     import jax
 
@@ -348,6 +417,12 @@ def main():
         if not _serve_events(trace, want):
             raise SystemExit(f"no serve '{want}' event in the trace")
     _validate_stream(trace)
+    p50, p99 = _check_drain_latency(trace)
+    _log(f"drain report SLO: p50={p50 * 1e3:.1f}ms p99={p99 * 1e3:.1f}ms")
+    telemetry.configure(None)  # close the client sink before stitching
+    paired, total = _check_stitch(trace, client_trace)
+    _log(f"trace_stitch: {paired}/{total} request traces paired "
+         f"across the server and client streams")
 
     baseline_sps = _baseline_steps_per_sec()
     min_frac = float(os.environ.get("CPR_SERVE_MIN_FRAC", "0.8"))
@@ -362,9 +437,10 @@ def main():
 
     n_banked, banked_sps, summary = _bank_and_gate(work, trace)
     print(f"serve-smoke: PASS (serve {serve_sps:,.0f} steps/s = "
-          f"{frac:.1%} of rollout baseline; banked {n_banked} ledger "
-          f"rows incl. serve_steps_per_sec={banked_sps:,.0f}; "
-          f"gate {summary})")
+          f"{frac:.1%} of rollout baseline; p50 {p50 * 1e3:.1f}ms / "
+          f"p99 {p99 * 1e3:.1f}ms; {paired} stitched traces; banked "
+          f"{n_banked} ledger rows incl. serve_steps_per_sec="
+          f"{banked_sps:,.0f}; gate {summary})")
 
 
 if __name__ == "__main__":

@@ -1,0 +1,234 @@
+"""Stitch the telemetry JSONL streams of one run into a trace tree.
+
+A served run writes several event streams: the serve server (often a
+supervisor child), the supervising parent, and any number of clients
+(tools/serve_smoke.py writes one for its client side).  Timestamps are
+`perf_counter` — process-relative, never comparable across files — so
+correlation is by ids: every stream's manifest carries the shared
+`run` id (minted once, inherited via $CPR_RUN_ID), and every schema-v8
+`request` event carries the per-request `trace_id` that the protocol's
+reserved `_trace` frame field ferries across the wire.  This tool
+merges the streams, pairs each trace's server and client sides, and
+prints a per-request critical-path breakdown built from durations
+only:
+
+    queue   server queue_wait_s minus the admission splice
+    splice  device-program admission splice (server splice_s)
+    burst   server-side service time (device bursts / ticks)
+    reply   client total_s minus server total_s — wire + framing +
+            asyncio handoff (needs both sides; "-" on orphans)
+
+A trace seen on only one side is an *orphan* — expected for streams
+captured mid-run (a client stream without the server's, a request
+completed after the server stream was cut) — and is kept, marked, and
+tallied rather than dropped.
+
+Usage: python tools/trace_stitch.py server.jsonl client.jsonl ...
+           [--op PREFIX] [--limit N] [--json]
+
+Exit codes: 0 = stitched something, 1 = no request events found,
+2 = usage/IO error.
+"""
+
+import argparse
+import json
+import os
+import sys
+from collections import defaultdict
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+
+def read_stream(path: str) -> dict:
+    """One JSONL stream -> its run ids (manifests + request extras) and
+    `request` events, each stamped with the stream name and its line
+    order (the only cross-event order that exists within a stream)."""
+    name = os.path.basename(path)
+    runs, requests, n = [], [], 0
+    with open(path) as f:
+        for i, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                e = json.loads(line)
+            except ValueError:
+                continue
+            if not isinstance(e, dict):
+                continue
+            n += 1
+            if e.get("kind") == "manifest" and e.get("run"):
+                if e["run"] not in runs:
+                    runs.append(e["run"])
+            elif e.get("kind") == "event" and e.get("name") == "request":
+                requests.append(dict(e, _stream=name, _line=i))
+    return {"path": path, "name": name, "runs": runs,
+            "requests": requests, "n_events": n}
+
+
+def _num(v):
+    return float(v) if isinstance(v, (int, float)) else None
+
+
+def _breakdown(server: dict | None, client: dict | None) -> dict:
+    """Durations-only critical path of one request.  Every component
+    is None when the side that measures it is missing."""
+    s_total = _num(server.get("total_s")) if server else None
+    c_total = _num(client.get("total_s")) if client else None
+    queue = splice = burst = reply = None
+    if server:
+        wait = _num(server.get("queue_wait_s"))
+        splice = _num(server.get("splice_s"))
+        burst = _num(server.get("service_s"))
+        if wait is not None:
+            queue = max(0.0, wait - (splice or 0.0))
+    if s_total is not None and c_total is not None:
+        reply = max(0.0, c_total - s_total)
+    return {"queue_s": queue, "splice_s": splice, "burst_s": burst,
+            "reply_s": reply,
+            "total_s": c_total if c_total is not None else s_total}
+
+
+def stitch(paths) -> dict:
+    """Merge streams and pair request events by trace_id.  Returns
+    {streams, runs, traces, ops, orphans}; `traces` is a list of
+    {trace_id, run, op, status, server, client, orphan, breakdown}
+    in first-seen order; `ops` aggregates count / two-sided count /
+    orphan count / total-latency sum+max per op."""
+    streams = [read_stream(p) for p in paths]
+    runs: dict[str, list[str]] = {}
+    for st in streams:
+        for rid in st["runs"]:
+            runs.setdefault(rid, []).append(st["name"])
+    by_id: dict[str, dict] = {}
+    order: list[str] = []
+    for st in streams:
+        for e in st["requests"]:
+            tid = str(e.get("trace_id") or f"?{st['name']}:{e['_line']}")
+            t = by_id.get(tid)
+            if t is None:
+                t = by_id[tid] = {"trace_id": tid, "run": None,
+                                  "op": None, "status": None,
+                                  "server": None, "client": None}
+                order.append(tid)
+            role = str(e.get("role") or "unknown")
+            side = "server" if role == "server" else "client"
+            if t[side] is None:  # duplicate events keep the first
+                t[side] = e
+            if t["run"] is None and e.get("run"):
+                t["run"] = e["run"]
+            if t["op"] is None and e.get("op") is not None:
+                t["op"] = str(e["op"])
+            # the server's verdict wins (the client may see "error"
+            # where the server refused); else first seen
+            if side == "server" or t["status"] is None:
+                t["status"] = e.get("status")
+    traces = []
+    ops = defaultdict(lambda: {"n": 0, "two_sided": 0, "orphans": 0,
+                               "sum_total_s": 0.0, "max_total_s": 0.0})
+    for tid in order:
+        t = by_id[tid]
+        orphan = (None if t["server"] and t["client"]
+                  else "no-server" if t["client"] else "no-client")
+        bd = _breakdown(t["server"], t["client"])
+        traces.append(dict(t, orphan=orphan, breakdown=bd))
+        a = ops[t["op"] or "?"]
+        a["n"] += 1
+        a["two_sided"] += orphan is None
+        a["orphans"] += orphan is not None
+        if bd["total_s"] is not None:
+            a["sum_total_s"] += bd["total_s"]
+            a["max_total_s"] = max(a["max_total_s"], bd["total_s"])
+    return {"streams": [{"name": s["name"], "path": s["path"],
+                         "runs": s["runs"], "n_events": s["n_events"],
+                         "n_requests": len(s["requests"])}
+                        for s in streams],
+            "runs": runs,
+            "traces": traces,
+            "ops": dict(sorted(ops.items())),
+            "orphans": sum(1 for t in traces if t["orphan"])}
+
+
+def _fmt_s(v) -> str:
+    return f"{v:.4f}s" if isinstance(v, (int, float)) else "-"
+
+
+def render(st: dict, out=sys.stdout, limit: int | None = None):
+    for s in st["streams"]:
+        runs = ",".join(s["runs"]) or "-"
+        print(f"stream {s['name']}: {s['n_events']} events, "
+              f"{s['n_requests']} requests, run={runs}", file=out)
+    for rid, names in sorted(st["runs"].items()):
+        print(f"run {rid}: {len(names)} streams "
+              f"({', '.join(sorted(set(names)))})", file=out)
+    shown = st["traces"] if limit is None else st["traces"][:limit]
+    for t in shown:
+        bd = t["breakdown"]
+        side = ("both" if t["orphan"] is None
+                else f"orphan:{t['orphan']}")
+        print(f"\ntrace {t['trace_id']}  op={t['op']} "
+              f"status={t['status']} [{side}] "
+              f"total={_fmt_s(bd['total_s'])}", file=out)
+        sess = (t["server"] or {}).get("session") \
+            or (t["client"] or {}).get("session")
+        lane = (t["server"] or {}).get("lane")
+        ctx = " ".join(p for p in (
+            f"session={sess}" if sess is not None else "",
+            f"lane={lane}" if lane is not None else "") if p)
+        if ctx:
+            print(f"  {ctx}", file=out)
+        print(f"  queue   {_fmt_s(bd['queue_s'])}", file=out)
+        print(f"  splice  {_fmt_s(bd['splice_s'])}", file=out)
+        print(f"  burst   {_fmt_s(bd['burst_s'])}", file=out)
+        print(f"  reply   {_fmt_s(bd['reply_s'])}", file=out)
+    if limit is not None and len(st["traces"]) > limit:
+        print(f"\n... {len(st['traces']) - limit} more traces "
+              f"(--limit)", file=out)
+    print(f"\n{'op':<20} {'n':>6} {'two-sided':>9} {'orphans':>8} "
+          f"{'mean_s':>9} {'max_s':>9}", file=out)
+    for op, a in st["ops"].items():
+        mean = a["sum_total_s"] / a["n"] if a["n"] else 0.0
+        print(f"{op:<20} {a['n']:>6} {a['two_sided']:>9} "
+              f"{a['orphans']:>8} {mean:>9.4f} "
+              f"{a['max_total_s']:>9.4f}", file=out)
+    print(f"stitched {len(st['traces'])} traces, "
+          f"{st['orphans']} orphaned", file=out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="trace_stitch", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("streams", nargs="+", metavar="JSONL",
+                    help="telemetry streams of one run (server, "
+                         "supervisor child, clients — any order)")
+    ap.add_argument("--op", metavar="PREFIX",
+                    help="only traces whose op starts with PREFIX")
+    ap.add_argument("--limit", type=int, metavar="N",
+                    help="print at most N trace trees (summary still "
+                         "covers everything)")
+    ap.add_argument("--json", action="store_true",
+                    help="dump the stitched structure as JSON instead "
+                         "of the text tree")
+    args = ap.parse_args(argv)
+    try:
+        st = stitch(args.streams)
+    except OSError as e:
+        print(f"trace_stitch: {e}", file=sys.stderr)
+        return 2
+    if args.op:
+        st["traces"] = [t for t in st["traces"]
+                        if str(t["op"] or "").startswith(args.op)]
+        st["ops"] = {op: a for op, a in st["ops"].items()
+                     if op.startswith(args.op)}
+        st["orphans"] = sum(1 for t in st["traces"] if t["orphan"])
+    if args.json:
+        print(json.dumps(st, indent=2, sort_keys=True, default=str))
+    else:
+        render(st, limit=args.limit)
+    return 0 if any(s["n_requests"] for s in st["streams"]) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

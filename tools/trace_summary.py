@@ -119,6 +119,7 @@ def summarize(events, out=sys.stdout):
     _resilience_lines(events, out)
     _supervisor_lines(events, out)
     _serve_lines(events, out)
+    _request_lines(events, out)
     _perf_gate_lines(events, out)
     for m in (e for e in events if e.get("kind") == "manifest"):
         cfg = m.get("config") or {}
@@ -127,7 +128,8 @@ def summarize(events, out=sys.stdout):
               f"jax={m.get('jax_version')} git={str(m.get('git_sha'))[:12]} "
               f"config={json.dumps(cfg, sort_keys=True)}", file=out)
     tabled = ("compile", "device_metrics", "vi_residuals", "retry",
-              "checkpoint", "perf_gate", "supervisor", "serve")
+              "checkpoint", "perf_gate", "supervisor", "serve",
+              "request")
     for e in (e for e in events if e.get("kind") == "event"
               and e.get("name") not in tabled):
         keys = {k: v for k, v in e.items() if k not in ("kind", "ts")}
@@ -256,6 +258,32 @@ def _serve_lines(events, out):
               f"steps_per_sec={sps_txt} occupancy={occ_txt} "
               f"lanes={d.get('n_lanes')} burst={d.get('burst')}",
               file=out)
+
+
+def _request_lines(events, out):
+    """Schema-v8 per-request latency events (cpr_tpu/serve): one
+    aggregate line per op x role x status with mean/max latency, so a
+    stream with thousands of requests still summarizes in a screen.
+    Per-trace detail is tools/trace_stitch.py's job."""
+    evs = [e for e in events if e.get("kind") == "event"
+           and e.get("name") == "request"]
+    if not evs:
+        return
+    agg = defaultdict(lambda: [0, 0.0, 0.0])  # [n, sum_total, max_total]
+    for e in evs:
+        key = (str(e.get("op")), str(e.get("role")), str(e.get("status")))
+        a = agg[key]
+        a[0] += 1
+        t = e.get("total_s")
+        if isinstance(t, (int, float)):
+            a[1] += t
+            a[2] = max(a[2], t)
+    print(f"\n{'request op':<20} {'role':<7} {'status':<8} {'n':>6} "
+          f"{'mean_s':>9} {'max_s':>9}", file=out)
+    for (op, role, status), (n, tot, mx) in sorted(agg.items()):
+        mean_txt = f"{tot / n:.4f}" if n else "-"
+        print(f"{op:<20} {role:<7} {status:<8} {n:>6} {mean_txt:>9} "
+              f"{mx:>9.4f}", file=out)
 
 
 def _perf_gate_lines(events, out):
