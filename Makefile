@@ -4,7 +4,7 @@
 
 CPU_MESH = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: lint test test-slow bench perf-gate telemetry-smoke netsim-smoke resilience-smoke supervisor-smoke serve-smoke dryrun sweeps ghostdag train-dummy native asan
+.PHONY: lint test test-slow bench perf-gate telemetry-smoke netsim-smoke resilience-smoke supervisor-smoke serve-smoke fleet-smoke dryrun sweeps ghostdag train-dummy native asan
 
 lint:  ## jaxlint over cpr_tpu/ + tools/ (pure AST, no JAX import,
 	## ~1s); banks the JSON report under runs/ like the smoke flows
@@ -96,6 +96,19 @@ serve-smoke:  ## continuous-batching service proof: supervised server
 	## the perf ledger.  Details: docs/SERVING.md
 	rm -rf $(SERVE_SMOKE_DIR)
 	python tools/serve_smoke.py $(SERVE_SMOKE_DIR)
+
+FLEET_SMOKE_DIR = /tmp/cpr-fleet-smoke
+
+fleet-smoke:  ## fleet-resilience chaos proof: router + 2 replicas,
+	## CPR_FAULT_INJECT kills replica 1 at its first burst under a
+	## 32-client flood — zero client hangs, every episode (requeued
+	## ones included) bit-identical to rollout(), in-band queue_full
+	## sheds honored via call_with_retry, warm restart rejoins, then
+	## v9 admission/route validation, a trace_stitch router-hop
+	## pairing, and per-class p99 + shed-rate rows banked + gated.
+	## Details: docs/SERVING.md
+	rm -rf $(FLEET_SMOKE_DIR)
+	python tools/fleet_smoke.py $(FLEET_SMOKE_DIR)
 
 dryrun:  ## multi-chip sharding dry run on the virtual CPU mesh
 	$(CPU_MESH) python -c "from __graft_entry__ import dryrun_multichip; dryrun_multichip(8)"

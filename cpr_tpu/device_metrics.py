@@ -373,6 +373,10 @@ def serve_spec() -> MetricsSpec:
     spec.counter("env_steps")
     spec.counter("episodes")
     spec.counter("bursts")
+    # admission-control refusals (queue_full / slo_breach /
+    # tenant_quota / replica_lost): recorded host-side by the server
+    # via ResidentEngine.record_shed, folded once at drain like burst_s
+    spec.counter("shed_sessions")
     spec.stats("occupancy")
     spec.stats("burst_s")
     spec.hist("burst_s_hist", _BURST_S_EDGES)

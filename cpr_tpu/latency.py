@@ -133,18 +133,38 @@ class LatencyHistogram:
         }
 
 
+# family-cardinality bound: per-tenant / per-class labels make the
+# family space attacker-controlled under multi-tenant traffic, so a
+# board never allocates more than `max_families` histograms — later
+# novel families fold into one shared overflow bucket instead
+OVERFLOW_FAMILY = "__overflow__"
+DEFAULT_MAX_FAMILIES = 64
+
+
 class LatencyBoard:
     """Per-op-family latency histograms, lazily created on first
     observe (families are dynamic: every serve op plus the engine's
-    device families land here)."""
+    device families land here).  Cardinality is bounded: once
+    `max_families` distinct families exist, observations for novel
+    families land in the shared `OVERFLOW_FAMILY` histogram — memory
+    stays O(max_families) however many labels clients invent."""
 
-    def __init__(self, edges=None):
+    def __init__(self, edges=None, max_families: int = DEFAULT_MAX_FAMILIES):
+        if max_families <= 0:
+            raise ValueError(f"max_families must be positive, "
+                             f"got {max_families}")
         self._edges = tuple(edges) if edges is not None else default_edges()
+        self.max_families = max_families
         self._hists: dict[str, LatencyHistogram] = {}
 
     def observe(self, family: str, dur_s: float):
         h = self._hists.get(family)
         if h is None:
+            if (len(self._hists) >= self.max_families
+                    and family != OVERFLOW_FAMILY):
+                # the overflow family itself may be minted past the cap
+                # (it IS the cap's escape hatch)
+                return self.observe(OVERFLOW_FAMILY, dur_s)
             h = self._hists[family] = LatencyHistogram(self._edges)
         h.observe(dur_s)
 

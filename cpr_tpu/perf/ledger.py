@@ -231,6 +231,31 @@ def iter_trace_rows(path: str):
                             "value": value, "unit": unit,
                             **{f"cfg_{k}": v for k, v in config.items()}},
                            base)
+                # per-priority-class tails: serve_p99_s rows tagged
+                # cfg_class so each class gates against its own
+                # banked history (distinct fingerprints)
+                by_class = detail.get("class_p99_s")
+                if isinstance(by_class, dict):
+                    for cls, value in sorted(by_class.items()):
+                        if not isinstance(value, (int, float)):
+                            continue
+                        yield ({"metric": "serve_p99_s",
+                                "backend": backend, "value": value,
+                                "unit": "seconds",
+                                "cfg_class": str(cls),
+                                **{f"cfg_{k}": v
+                                   for k, v in config.items()}},
+                               base)
+                # admission-control shed rate: lower-is-better but the
+                # name carries no `_s` suffix, so the direction rides
+                # explicitly (normalize_row honors it)
+                shed_rate = detail.get("shed_rate")
+                if isinstance(shed_rate, (int, float)):
+                    yield ({"metric": "serve_shed_rate",
+                            "backend": backend, "value": shed_rate,
+                            "unit": "fraction", "direction": "lower",
+                            **{f"cfg_{k}": v for k, v in config.items()}},
+                           base)
 
 
 class Ledger:

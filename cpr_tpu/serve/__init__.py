@@ -17,9 +17,15 @@ Layers (docs/SERVING.md has the full protocol and ops runbook):
   scheduler.py LaneScheduler — host-side sessions->lanes placement and
                the admission queue (backfill source for freed lanes).
   server.py    asyncio front-end: length-prefixed JSON protocol,
-               trained-policy / netsim / break-even endpoints, serve
+               trained-policy / netsim / break-even endpoints,
+               SLO-aware admission control (priority classes, tenant
+               quotas, bounded queue, latency-aware shedding), serve
                telemetry, supervisor heartbeats, SIGTERM drain.
-  protocol.py  frame codec + a blocking client for tools and tests.
+  router.py    multi-replica front-end: N supervised server children,
+               load/priority routing, deterministic seed-replay
+               failover on replica loss.
+  protocol.py  frame codec + a blocking client (with retry_after-aware
+               `call_with_retry`) for tools and tests.
 """
 
 from cpr_tpu.serve.engine import ResidentEngine  # noqa: F401

@@ -106,6 +106,9 @@ class ResidentEngine:
         self.busy_s = 0.0
         self._occ_sum = 0.0
         self._burst_wall: list[float] = []
+        # admission-control refusals, recorded by the server's shed
+        # path; folded into the shed_sessions metrics cell at drain
+        self.sheds = 0
 
     # -- program construction ---------------------------------------------
 
@@ -317,11 +320,16 @@ class ResidentEngine:
             burst=self.burst, n_lanes=self.n_lanes,
             policies=list(self.policy_names))
 
+    def record_shed(self):
+        """Count one admission-control refusal (the server's shed
+        path); surfaces as the shed_sessions device-metrics cell."""
+        self.sheds += 1
+
     def emit_metrics(self, scope: str = "serve"):
         """Fold the host-recorded burst latencies — the `burst_s`
-        spread and the `burst_s_hist` log-bucket distribution — and
-        emit the device_metrics event (one readback).  No-op when
-        in-graph metrics are off."""
+        spread and the `burst_s_hist` log-bucket distribution — plus
+        the shed counter, and emit the device_metrics event (one
+        readback).  No-op when in-graph metrics are off."""
         if self._macc is None:
             return None
         macc = self._macc
@@ -329,4 +337,6 @@ class ResidentEngine:
             walls = np.asarray(self._burst_wall, np.float32)
             macc = self._spec.observe(macc, "burst_s", walls)
             macc = self._spec.observe_hist(macc, "burst_s_hist", walls)
+        if self.sheds:
+            macc = self._spec.count(macc, "shed_sessions", self.sheds)
         return device_metrics.emit(scope, self._spec, macc)

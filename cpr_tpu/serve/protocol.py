@@ -22,8 +22,9 @@ from __future__ import annotations
 import json
 import socket
 import struct
+import time
 
-from cpr_tpu import telemetry
+from cpr_tpu import resilience, telemetry
 
 _HEADER = struct.Struct(">I")
 # generous ceiling: the largest legitimate frame (an interactive step
@@ -33,6 +34,29 @@ MAX_FRAME = 16 << 20
 
 class ProtocolError(RuntimeError):
     pass
+
+
+class ShedRefusal(resilience.TransientFault):
+    """In-band admission-control refusal (`shed: ...` with a
+    `retry_after` hint): transient in the shared taxonomy — the server
+    is up, just loaded, so backing off and retrying is correct."""
+
+    def __init__(self, resp: dict):
+        super().__init__(resp.get("error", "shed"))
+        self.resp = resp
+        try:
+            self.retry_after_s = float(resp.get("retry_after") or 0.0)
+        except (TypeError, ValueError):
+            self.retry_after_s = 0.0
+
+
+class DrainRefusal(RuntimeError):
+    """In-band drain refusal: terminal — this server is going away, so
+    retrying against it is wrong (a router retries elsewhere)."""
+
+    def __init__(self, resp: dict):
+        super().__init__(resp.get("error", "draining"))
+        self.resp = resp
 
 
 def pack_frame(obj) -> bytes:
@@ -94,6 +118,8 @@ class ServeClient:
     """Blocking request/response client over one TCP connection."""
 
     def __init__(self, host: str, port: int, timeout: float = 60.0):
+        self._addr = (host, port)
+        self._timeout = timeout
         self._sock = socket.create_connection((host, port),
                                               timeout=timeout)
 
@@ -122,18 +148,71 @@ class ServeClient:
         lat = resp.get("latency") if isinstance(resp, dict) else None
         lat = lat if isinstance(lat, dict) else {}
         status = ("ok" if resp.get("ok")
-                  else "refused" if resp.get("draining") else "error") \
+                  else "refused" if resp.get("draining")
+                  or resp.get("shed") else "error") \
             if isinstance(resp, dict) else "error"
         _client_request_event(trace_id, op, status,
                               lat.get("queue_wait_s"),
                               lat.get("service_s"), total_s)
         return resp
 
+    def call_with_retry(self, op: str, *, max_attempts: int = 5,
+                        base_delay_s: float = 0.25,
+                        max_delay_s: float = 30.0, sleep=time.sleep,
+                        **fields):
+        """`request` through the shared retry taxonomy
+        (resilience.with_retries): shed refusals are transient and the
+        backoff honors the server's `retry_after` hint (the in-band
+        contract: a shed reply quotes when capacity is expected back),
+        connection loss is transient with an automatic reconnect, and
+        a drain refusal is terminal — `DrainRefusal` propagates, since
+        this server is going away and only a router can retry
+        elsewhere.  Returns the successful reply dict."""
+        hint = {"s": 0.0}
+
+        def attempt():
+            if self._sock is None:
+                self._sock = socket.create_connection(
+                    self._addr, timeout=self._timeout)
+            try:
+                resp = self.request(op, **fields)
+            except (ProtocolError, ConnectionError, OSError):
+                self.close()  # next attempt reconnects
+                raise
+            if isinstance(resp, dict) and not resp.get("ok"):
+                if resp.get("shed"):
+                    raise ShedRefusal(resp)
+                if resp.get("draining"):
+                    raise DrainRefusal(resp)
+            return resp
+
+        def classify(e) -> bool:
+            if isinstance(e, ShedRefusal):
+                hint["s"] = e.retry_after_s
+                return True
+            if isinstance(e, DrainRefusal):
+                return False
+            return resilience.default_classify(e)
+
+        def _sleep(delay_s: float):
+            # the exponential schedule is the floor; a larger server
+            # hint stretches it (still capped), then the hint is spent
+            sleep(min(max_delay_s, max(delay_s, hint["s"])))
+            hint["s"] = 0.0
+
+        return resilience.with_retries(
+            attempt, classify=classify, max_attempts=max_attempts,
+            base_delay_s=base_delay_s, max_delay_s=max_delay_s,
+            sleep=_sleep, name=f"serve:{op}")
+
     def close(self):
+        if self._sock is None:
+            return
         try:
             self._sock.close()
         except OSError:
             pass
+        self._sock = None
 
     def __enter__(self):
         return self

@@ -1,0 +1,620 @@
+"""Multi-replica serve front-end: one router, N supervised children.
+
+`python -m cpr_tpu.serve.router --replicas N ...` launches N copies of
+`cpr_tpu.serve.server` through `supervisor.run_child` (each with the
+heartbeat watchdog, a per-replica telemetry sink, and a per-replica
+`--replica-index` arming the `replica` fault-injection site) and
+speaks the same length-prefixed JSON protocol to clients, so every
+existing client — `ServeClient`, the smokes, the tests — talks to a
+fleet exactly as it talked to one server.
+
+Routing: sessions go to the up replica with the fewest in-flight
+requests (lowest index breaks ties); admission control itself stays in
+the replicas (priority classes, quotas, SLO shedding), whose in-band
+shed refusals pass through to the client untouched.
+
+Failover leans on the PR-9 bit-identity contract: an `episode.run` is
+fully determined by (policy, seed), so the router stamps a seed on
+every seedless run before the first forward, and when a replica dies
+mid-flight it simply re-forwards the same request to a survivor — the
+re-run episode is byte-identical to what the dead replica would have
+returned.  Stateless queries (hello / netsim.query / break_even.*)
+fail over the same way because they are idempotent.  Interactive
+sessions are the documented exception: their lane state lives only in
+the replica that admitted them, so on replica loss the router refuses
+their next request in-band (`shed: replica_lost` with `retry_after`)
+instead of guessing — the client reopens and replays its own actions
+if it wants to resume.
+
+Every decision is a typed v9 `route` telemetry event, and every client
+request is mirrored as a `request` event with role "router", giving
+`tools/trace_stitch.py` the middle segment of the critical path:
+route -> queue -> splice -> burst -> reply.
+
+A replica that exits outside a drain is warm-restarted (up to
+`--max-restarts` times); restarted children run with CPR_FAULT_INJECT
+stripped — the injected fault already fired, and a warm restart runs
+clean, mirroring the resilience module's one-shot contract.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import json
+import os
+import sys
+import threading
+
+from cpr_tpu import resilience, supervisor, telemetry
+from cpr_tpu.serve import protocol as wire
+
+_FWD_ERRORS = (wire.ProtocolError, ConnectionError, OSError)
+
+
+def _route_event(action: str, replica, op, **extra):
+    """The one `route` event call site (EVENT_FIELDS['route'])."""
+    telemetry.current().event("route", action=action, replica=replica,
+                              op=op, **extra)
+
+
+def _admission_event(reason, op, priority, tenant, retry_after_s):
+    """The router-side `admission` call site: fires when the router
+    itself must refuse (no live replica / pinned replica lost) — the
+    same in-band contract as the replicas' shed path."""
+    telemetry.current().event(
+        "admission", reason=reason, op=op, priority=priority,
+        tenant=tenant, retry_after_s=retry_after_s)
+
+
+def _router_request_event(trace_id, op, status, queue_wait_s, service_s,
+                          total_s):
+    """The one router-side `request` event call site: queue_wait_s /
+    service_s are the replica's own breakdown copied off the reply,
+    total_s the router wall — so `total_s(router) - total_s(server)`
+    is the routing hop (trace_stitch's `route` leg)."""
+    telemetry.current().event(
+        "request", trace_id=trace_id, op=op, status=status,
+        queue_wait_s=queue_wait_s, service_s=service_s, total_s=total_s,
+        role="router", run=telemetry.run_id())
+
+
+class _Conn:
+    __slots__ = ("reader", "writer")
+
+    def __init__(self, reader, writer):
+        self.reader = reader
+        self.writer = writer
+
+
+class Replica:
+    """One supervised server child: lifecycle state, its live Popen
+    (for orphan cleanup), and a small pool of persistent connections.
+    A connection is held exclusively for the duration of one forward
+    (the protocol answers in order per connection), so concurrency =
+    pool size, grown on demand."""
+
+    def __init__(self, index: int):
+        self.index = index
+        self.state = "starting"  # starting | up | down
+        self.ready_file = None
+        self.host = None
+        self.port = None
+        self.proc = None
+        self.thread = None
+        self.attempt = None  # supervisor.Attempt once the child exits
+        self.exited = threading.Event()
+        self.inflight = 0
+        self.restarts = 0
+        self._pool: list[_Conn] = []
+
+    async def acquire(self) -> _Conn:
+        if self._pool:
+            return self._pool.pop()
+        reader, writer = await asyncio.open_connection(self.host,
+                                                       self.port)
+        return _Conn(reader, writer)
+
+    def release(self, conn: _Conn, broken: bool = False):
+        if broken:
+            conn.writer.close()
+        else:
+            self._pool.append(conn)
+
+    def close_pool(self):
+        for c in self._pool:
+            c.writer.close()
+        self._pool.clear()
+
+
+class ServeRouter:
+    """Front-end process: spawns/supervises the replicas and routes."""
+
+    def __init__(self, child_args: list, n_replicas: int, *,
+                 workdir: str, host: str = "127.0.0.1", port: int = 0,
+                 ready_file: str | None = None, heartbeat_s: float = 1.0,
+                 wall_s: float = 3600.0, quiet_s: float = 60.0,
+                 max_restarts: int = 1, pick_wait_s: float = 60.0,
+                 seed_base: int = 1 << 21):
+        if n_replicas <= 0:
+            raise ValueError(f"need at least one replica, got {n_replicas}")
+        self.child_args = list(child_args)
+        self.workdir = workdir
+        self.host = host
+        self.port = port  # replaced by the bound port in run()
+        self.ready_file = ready_file
+        self.heartbeat_s = heartbeat_s
+        self.wall_s = wall_s
+        self.quiet_s = quiet_s
+        self.max_restarts = max_restarts
+        self.pick_wait_s = pick_wait_s
+        self.replicas = [Replica(i) for i in range(n_replicas)]
+        # router-stamped seeds live above the servers' own seed base
+        # (1 << 20), so fleet-assigned and replica-assigned seeds can
+        # never collide — and every episode.run that reaches a replica
+        # carries an explicit seed, which is what makes failover replay
+        # deterministic
+        self._seed = itertools.count(seed_base)
+        self._rsid = itertools.count(1)
+        # router session id -> (replica index, replica session id)
+        self._sessions: dict[int, tuple] = {}
+        self._routed = 0
+        self._requeued = 0
+        self._refused = 0
+        self._server = None
+        self._draining = False
+        self._drain_reason = None
+
+    # -- child lifecycle ---------------------------------------------------
+
+    def _child_cmd(self, rep: Replica) -> list:
+        return [sys.executable, "-m", "cpr_tpu.serve.server",
+                *self.child_args,
+                "--host", "127.0.0.1", "--port", "0",
+                "--ready-file", rep.ready_file,
+                "--replica-index", str(rep.index),
+                "--heartbeat-s", str(self.heartbeat_s)]
+
+    def _child_env(self, rep: Replica) -> dict:
+        env = dict(os.environ)
+        sink = env.get(telemetry.TELEMETRY_ENV_VAR)
+        if sink:
+            # per-replica telemetry sinks: two processes appending one
+            # JSONL file would interleave mid-line
+            base, ext = os.path.splitext(sink)
+            env[telemetry.TELEMETRY_ENV_VAR] = \
+                f"{base}.replica{rep.index}{ext or '.jsonl'}"
+        if rep.restarts > 0:
+            # the injected fault already fired in the previous
+            # incarnation; a warm restart runs clean (one-shot contract)
+            env.pop(resilience.FAULT_ENV_VAR, None)
+        return env
+
+    def _spawn(self, rep: Replica):
+        rep.state = "starting"
+        rep.exited.clear()
+        rep.proc = None
+        rep.attempt = None
+        rep.ready_file = os.path.join(
+            self.workdir, f"replica{rep.index}-r{rep.restarts}.json")
+        cmd = self._child_cmd(rep)
+        env = self._child_env(rep)
+
+        def run():
+            try:
+                rep.attempt = supervisor.run_child(
+                    cmd, wall_timeout_s=self.wall_s,
+                    quiet_s=self.quiet_s, heartbeat_s=self.heartbeat_s,
+                    env=env,
+                    on_start=lambda proc: setattr(rep, "proc", proc))
+            finally:
+                rep.exited.set()
+
+        rep.thread = threading.Thread(
+            target=run, name=f"cpr-replica{rep.index}", daemon=True)
+        rep.thread.start()
+
+    def _try_ready(self, rep: Replica):
+        try:
+            with open(rep.ready_file, encoding="utf-8") as f:
+                info = json.load(f)
+            rep.host, rep.port = info["host"], int(info["port"])
+        except (OSError, ValueError, KeyError):
+            return
+        rep.state = "up"
+        _route_event("replica_up", rep.index, None, port=rep.port,
+                     restarts=rep.restarts)
+
+    def _mark_down(self, rep: Replica, reason: str):
+        rep.state = "down"
+        rep.close_pool()
+        # pinned interactive sessions die with their replica: purge
+        # now, refuse in-band at their next request
+        lost = [k for k, v in self._sessions.items() if v[0] == rep.index]
+        for k in lost:
+            self._sessions.pop(k, None)
+        att = rep.attempt
+        _route_event("replica_down", rep.index, None, reason=reason,
+                     status=getattr(att, "status", None),
+                     rc=getattr(att, "rc", None),
+                     lost_sessions=len(lost))
+        if (not self._draining and self._drain_reason is None
+                and rep.restarts < self.max_restarts):
+            rep.restarts += 1
+            self._spawn(rep)
+
+    async def _monitor(self):
+        while True:
+            for rep in self.replicas:
+                if rep.exited.is_set() and rep.state != "down":
+                    att = rep.attempt
+                    self._mark_down(
+                        rep, f"child exited "
+                             f"({getattr(att, 'status', 'unknown')})")
+                elif rep.state == "starting":
+                    self._try_ready(rep)
+            await asyncio.sleep(0.05)
+
+    async def _wait_all_up(self, timeout_s: float = 600.0):
+        deadline = telemetry.now() + timeout_s
+        while telemetry.now() < deadline:
+            if all(r.state == "up" for r in self.replicas):
+                return
+            dead = [r for r in self.replicas
+                    if r.state == "down" and r.restarts >= self.max_restarts]
+            if dead:
+                raise RuntimeError(
+                    f"replica {dead[0].index} failed to start "
+                    f"(status {getattr(dead[0].attempt, 'status', None)})")
+            await asyncio.sleep(0.1)
+        raise RuntimeError("replicas did not come up within "
+                           f"{timeout_s}s")
+
+    # -- routing -----------------------------------------------------------
+
+    def _pick(self, exclude: set) -> Replica | None:
+        up = [r for r in self.replicas
+              if r.state == "up" and r.index not in exclude]
+        if not up:
+            return None
+        return min(up, key=lambda r: (r.inflight, r.index))
+
+    async def _pick_wait(self, exclude: set) -> Replica | None:
+        """Least-loaded up replica; rides out a restart window (some
+        replica still starting) up to pick_wait_s before giving up."""
+        deadline = telemetry.now() + self.pick_wait_s
+        while True:
+            rep = self._pick(exclude)
+            if rep is not None:
+                return rep
+            starting = any(r.state == "starting" and r.index not in exclude
+                           for r in self.replicas)
+            if (not starting or self._drain_reason is not None
+                    or telemetry.now() > deadline):
+                return None
+            await asyncio.sleep(0.1)
+
+    async def _forward(self, rep: Replica, req: dict) -> dict:
+        rep.inflight += 1
+        conn = None
+        try:
+            conn = await rep.acquire()
+            await wire.write_frame(conn.writer, req)
+            resp = await wire.read_frame(conn.reader)
+            if resp is None:
+                raise wire.ProtocolError("replica closed the connection")
+            rep.release(conn)
+            conn = None
+            return resp
+        finally:
+            rep.inflight -= 1
+            if conn is not None:
+                rep.release(conn, broken=True)
+
+    def _refuse(self, reason: str, op, priority=None, tenant=None,
+                replica=None) -> dict:
+        self._refused += 1
+        # a restarting replica is capacity coming back: quote roughly
+        # its bring-up time, else a short poll interval
+        retry_after = 5.0 if any(r.state == "starting"
+                                 for r in self.replicas) else 1.0
+        _route_event("refuse", replica, op, reason=reason)
+        _admission_event(reason, op, priority, tenant, retry_after)
+        return dict(ok=False, error=f"shed: {reason}", shed=True,
+                    reason=reason, retry_after=retry_after)
+
+    async def _route_failover(self, req: dict, op: str) -> dict:
+        """Forward with requeue-on-replica-loss.  Only called for
+        requests that are safe to re-forward: episode.run (fully
+        determined by its stamped seed) and the stateless queries."""
+        tried: set = set()
+        first = True
+        while True:
+            rep = await self._pick_wait(tried)
+            if rep is None:
+                return self._refuse("replica_lost", op,
+                                    req.get("priority"), req.get("tenant"))
+            if first:
+                self._routed += 1
+            else:
+                self._requeued += 1
+            _route_event("route" if first else "requeue", rep.index, op,
+                         seed=req.get("seed"))
+            try:
+                resp = await self._forward(rep, req)
+            except _FWD_ERRORS:
+                tried.add(rep.index)
+                first = False
+                continue
+            if (op == "hello" and isinstance(resp, dict)
+                    and resp.get("ok")):
+                resp["router"] = True
+                resp["replicas"] = sum(r.state == "up"
+                                       for r in self.replicas)
+            return resp
+
+    async def _route_episode_run(self, req: dict) -> dict:
+        if req.get("seed") is None:
+            req["seed"] = next(self._seed)
+        return await self._route_failover(req, "episode.run")
+
+    async def _route_episode_open(self, req: dict) -> dict:
+        tried: set = set()
+        rep = await self._pick_wait(tried)
+        if rep is None:
+            return self._refuse("replica_lost", "episode.open",
+                                req.get("priority"), req.get("tenant"))
+        self._routed += 1
+        _route_event("route", rep.index, "episode.open")
+        try:
+            resp = await self._forward(rep, req)
+        except _FWD_ERRORS:
+            # the lane may or may not have been admitted; the state is
+            # gone either way — refuse, the client reopens
+            return self._refuse("replica_lost", "episode.open",
+                                req.get("priority"), req.get("tenant"),
+                                replica=rep.index)
+        if isinstance(resp, dict) and resp.get("ok") \
+                and "session" in resp:
+            rsid = next(self._rsid)
+            self._sessions[rsid] = (rep.index, resp["session"])
+            resp["session"] = rsid
+        return resp
+
+    async def _route_pinned(self, req: dict, op: str) -> dict:
+        rsid = req.get("session")
+        pin = self._sessions.get(rsid)
+        if pin is None:
+            if op == "episode.close":
+                return dict(ok=True)
+            return dict(ok=False, error="no such open session")
+        idx, sid = pin
+        rep = self.replicas[idx]
+        if rep.state != "up":
+            self._sessions.pop(rsid, None)
+            return self._refuse("replica_lost", op, replica=idx)
+        try:
+            resp = await self._forward(rep, dict(req, session=sid))
+        except _FWD_ERRORS:
+            self._sessions.pop(rsid, None)
+            return self._refuse("replica_lost", op, replica=idx)
+        if isinstance(resp, dict):
+            if resp.get("session") == sid:
+                resp["session"] = rsid
+            if op == "episode.close" or resp.get("done"):
+                self._sessions.pop(rsid, None)
+        return resp
+
+    async def _op_stats(self, req: dict) -> dict:
+        per = {}
+        for rep in self.replicas:
+            if rep.state != "up":
+                per[str(rep.index)] = dict(state=rep.state)
+                continue
+            try:
+                r = await self._forward(rep, dict(op="stats"))
+                r["state"] = "up"
+                per[str(rep.index)] = r
+            except _FWD_ERRORS:
+                per[str(rep.index)] = dict(state="down")
+        return dict(ok=True, router=self.router_stats(), replicas=per)
+
+    def router_stats(self) -> dict:
+        return dict(
+            routed=self._routed, requeued=self._requeued,
+            refused=self._refused, open_sessions=len(self._sessions),
+            replica_state={str(r.index): r.state
+                           for r in self.replicas},
+            restarts={str(r.index): r.restarts for r in self.replicas})
+
+    # -- the front-end server ----------------------------------------------
+
+    async def _handle(self, reader, writer):
+        try:
+            while True:
+                req = await wire.read_frame(reader)
+                if req is None:
+                    break
+                resp = await self._serve_request(req)
+                await wire.write_frame(writer, resp)
+        except (wire.ProtocolError, ConnectionError,
+                asyncio.CancelledError):
+            pass
+        finally:
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except (ConnectionError, OSError):
+                pass
+
+    async def _serve_request(self, req: dict) -> dict:
+        trace = req.get("_trace") if isinstance(req.get("_trace"),
+                                                dict) else {}
+        trace_id = trace.get("id") or telemetry.new_trace_id()
+        # forward the client's trace id verbatim: all three streams
+        # (client / router / replica) share one id per request
+        req["_trace"] = dict(id=trace_id, run=telemetry.run_id(),
+                             parent=trace.get("parent"))
+        t0 = telemetry.now()
+        try:
+            resp = await self._dispatch(req)
+        except Exception as e:  # noqa: BLE001 — per-request wall
+            resp = dict(ok=False, error=f"{type(e).__name__}: {e}")
+        if not isinstance(resp, dict):
+            resp = dict(ok=False, error="handler returned no dict")
+        total_s = telemetry.now() - t0
+        lat = resp.get("latency")
+        if not (isinstance(lat, dict) and "total_s" in lat):
+            lat = dict(queue_wait_s=0.0, service_s=total_s,
+                       total_s=total_s)
+            resp["latency"] = lat
+        resp["trace_id"] = trace_id
+        status = ("ok" if resp.get("ok")
+                  else "refused" if resp.get("draining")
+                  or resp.get("shed") else "error")
+        _router_request_event(trace_id, req.get("op"), status,
+                              lat.get("queue_wait_s"),
+                              lat.get("service_s"), total_s)
+        return resp
+
+    async def _dispatch(self, req: dict) -> dict:
+        op = req.get("op")
+        if op == "drain":
+            self._drain_reason = self._drain_reason or str(
+                req.get("reason", "client"))
+            return dict(ok=True, draining=True)
+        if op == "stats":
+            return await self._op_stats(req)
+        if self._draining or self._drain_reason is not None:
+            if op in ("episode.run", "episode.open"):
+                return dict(ok=False, error="draining", draining=True)
+        if op == "episode.run":
+            return await self._route_episode_run(req)
+        if op == "episode.open":
+            return await self._route_episode_open(req)
+        if op in ("episode.step", "episode.close"):
+            return await self._route_pinned(req, op)
+        # hello / netsim.query / break_even.* / unknown ops: stateless
+        # and idempotent on the replicas, so plain failover forwarding
+        return await self._route_failover(req, op)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def run(self):
+        os.makedirs(self.workdir, exist_ok=True)
+        for rep in self.replicas:
+            self._spawn(rep)
+        monitor = asyncio.create_task(self._monitor())
+        try:
+            self._server = await asyncio.start_server(
+                self._handle, self.host, self.port)
+            self.port = self._server.sockets[0].getsockname()[1]
+            await self._wait_all_up()
+            if self.ready_file:
+                resilience.atomic_write_json(
+                    self.ready_file,
+                    dict(host=self.host, port=self.port,
+                         pid=os.getpid(),
+                         replicas=len(self.replicas)))
+            while (self._drain_reason is None
+                   and not resilience.preempt_requested()):
+                await asyncio.sleep(0.05)
+            reason = self._drain_reason or \
+                f"preempt:{resilience.preempt_reason()}"
+            await self._drain(reason)
+        finally:
+            monitor.cancel()
+            for rep in self.replicas:
+                rep.close_pool()
+                if rep.proc is not None and rep.proc.poll() is None:
+                    rep.proc.kill()
+
+    async def _drain(self, reason: str):
+        self._draining = True
+        _route_event("drain", None, None, reason=reason)
+        for rep in self.replicas:
+            if rep.state != "up":
+                continue
+            try:
+                await self._forward(rep, dict(
+                    op="drain", reason=f"router:{reason}"))
+            except _FWD_ERRORS:
+                pass
+        # bounded wait for the children's own drain -> report -> exit
+        deadline = telemetry.now() + 120.0
+        for rep in self.replicas:
+            while (not rep.exited.is_set()
+                   and telemetry.now() < deadline):
+                await asyncio.sleep(0.1)
+        _route_event("stop", None, None, reason=reason,
+                     **self.router_stats())
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+
+
+# -- entry point -------------------------------------------------------------
+
+
+def main(argv=None) -> int:
+    import argparse
+    import tempfile
+
+    p = argparse.ArgumentParser(
+        description="cpr_tpu serve fleet router (see docs/SERVING.md)")
+    p.add_argument("--replicas", type=int, default=2)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--ready-file", default=None,
+                   help="atomic JSON {host,port,pid,replicas} once "
+                        "every replica is up")
+    p.add_argument("--workdir", default=None,
+                   help="replica ready files (default: a temp dir)")
+    p.add_argument("--heartbeat-s", type=float, default=1.0)
+    p.add_argument("--replica-wall-s", type=float, default=3600.0)
+    p.add_argument("--replica-quiet-s", type=float, default=60.0)
+    p.add_argument("--max-restarts", type=int, default=1,
+                   help="warm restarts per replica outside a drain")
+    # pass-through server geometry/admission flags
+    p.add_argument("--protocol", default="nakamoto")
+    p.add_argument("--alpha", type=float, default=0.25)
+    p.add_argument("--gamma", type=float, default=0.5)
+    p.add_argument("--activation-delay", type=float, default=1.0)
+    p.add_argument("--max-steps", type=int, default=256)
+    p.add_argument("--lanes", type=int, default=32)
+    p.add_argument("--burst", type=int, default=256)
+    p.add_argument("--policy-snapshot", default=None)
+    p.add_argument("--slo-s", type=float, default=None)
+    p.add_argument("--max-queue", type=int, default=None)
+    p.add_argument("--tenant-quota", type=int, default=None)
+    args = p.parse_args(argv)
+
+    workdir = args.workdir or tempfile.mkdtemp(prefix="cpr-router-")
+    child_args = ["--protocol", args.protocol,
+                  "--alpha", str(args.alpha),
+                  "--gamma", str(args.gamma),
+                  "--activation-delay", str(args.activation_delay),
+                  "--max-steps", str(args.max_steps),
+                  "--lanes", str(args.lanes),
+                  "--burst", str(args.burst)]
+    if args.policy_snapshot:
+        child_args += ["--policy-snapshot", args.policy_snapshot]
+    if args.slo_s is not None:
+        child_args += ["--slo-s", str(args.slo_s)]
+    if args.max_queue is not None:
+        child_args += ["--max-queue", str(args.max_queue)]
+    if args.tenant_quota is not None:
+        child_args += ["--tenant-quota", str(args.tenant_quota)]
+
+    router = ServeRouter(
+        child_args, args.replicas, workdir=workdir, host=args.host,
+        port=args.port, ready_file=args.ready_file,
+        heartbeat_s=args.heartbeat_s, wall_s=args.replica_wall_s,
+        quiet_s=args.replica_quiet_s, max_restarts=args.max_restarts)
+    with resilience.preemption_guard():
+        asyncio.run(router.run())
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -40,13 +40,47 @@ from cpr_tpu import resilience, telemetry
 from cpr_tpu.latency import LatencyBoard
 from cpr_tpu.serve import protocol as wire
 from cpr_tpu.serve.engine import ResidentEngine
-from cpr_tpu.serve.scheduler import LaneScheduler
+from cpr_tpu.serve.scheduler import LaneScheduler, QueueFull
+
+# priority classes on the wire: requests say `priority="batch"` (or
+# the class number); lower number places first.  Interactive sessions
+# default to the front, batch traffic is shed first under SLO breach.
+PRIORITY_CLASSES = {"interactive": 0, "normal": 1, "batch": 2}
+_CLASS_NAMES = {v: k for k, v in PRIORITY_CLASSES.items()}
+# SLO budget multiplier per priority class: the shed threshold is
+# slo_s * scale, so batch traffic sheds at half the SLO while
+# interactive traffic rides out twice the SLO before refusal
+_SLO_SCALE = {0: 2.0, 1: 1.0, 2: 0.5}
+
+
+def _priority_of(req: dict, default: int = 1) -> tuple:
+    """(priority int, class name) from a request's `priority` field —
+    a class name or an int (clamped into the known classes)."""
+    raw = req.get("priority", default)
+    if isinstance(raw, str):
+        if raw not in PRIORITY_CLASSES:
+            raise ValueError(
+                f"unknown priority {raw!r}; one of "
+                f"{sorted(PRIORITY_CLASSES)} or 0..{len(_SLO_SCALE) - 1}")
+        p = PRIORITY_CLASSES[raw]
+    else:
+        p = min(max(int(raw), 0), len(_SLO_SCALE) - 1)
+    return p, _CLASS_NAMES[p]
 
 
 def _serve_event(action: str, session=None, **detail):
     """The one `serve` event call site (EVENT_FIELDS['serve'])."""
     telemetry.current().event("serve", action=action, session=session,
                               detail=detail)
+
+
+def _admission_event(reason, op, priority, tenant, retry_after_s):
+    """The one `admission` event call site (EVENT_FIELDS['admission']):
+    fires per shed refusal only — admitted sessions stay on the v7
+    serve admit trail."""
+    telemetry.current().event(
+        "admission", reason=reason, op=op, priority=priority,
+        tenant=tenant, retry_after_s=retry_after_s)
 
 
 def _request_event(trace_id, op, status, queue_wait_s, service_s,
@@ -72,9 +106,11 @@ def _op_family(op) -> str:
 class _Session:
     __slots__ = ("sid", "kind", "seed", "policy", "policy_id", "lane",
                  "future", "done", "t_enqueue", "t_admit",
-                 "t_first_burst", "t_complete", "splice_s")
+                 "t_first_burst", "t_complete", "splice_s",
+                 "priority", "cls", "tenant")
 
-    def __init__(self, sid, kind, seed, policy, policy_id, future):
+    def __init__(self, sid, kind, seed, policy, policy_id, future,
+                 priority=1, cls="normal", tenant=None):
         self.sid = sid
         self.kind = kind
         self.seed = seed
@@ -83,6 +119,9 @@ class _Session:
         self.lane = None
         self.future = future
         self.done = False
+        self.priority = priority
+        self.cls = cls
+        self.tenant = tenant
         # request-scoped trace stamps (telemetry.now() clock): queued,
         # admitted (lane spliced), first policy burst dispatched,
         # session completed — the reply's latency breakdown
@@ -98,9 +137,25 @@ class ServeServer:
 
     def __init__(self, engine: ResidentEngine, *, host: str = "127.0.0.1",
                  port: int = 0, heartbeat_s: float = 1.0,
-                 idle_sleep_s: float = 0.002, seed_base: int = 1 << 20):
+                 idle_sleep_s: float = 0.002, seed_base: int = 1 << 20,
+                 slo_s: float | None = None,
+                 max_queued: int | None = None,
+                 tenant_quota: int | None = None,
+                 replica_index: int | None = None):
         self.engine = engine
-        self.sched = LaneScheduler(engine.n_lanes)
+        # bounded queue by default: 8x the lane count is ~8 bursts of
+        # backlog, past which queueing only manufactures SLO misses —
+        # shed instead.  Explicit <= 0 restores the unbounded queue.
+        if max_queued is None:
+            max_queued = 8 * engine.n_lanes
+        elif max_queued <= 0:
+            max_queued = None
+        self.slo_s = slo_s
+        self.replica_index = replica_index
+        self.sched = LaneScheduler(engine.n_lanes, max_queued=max_queued,
+                                   tenant_quota=tenant_quota)
+        self._sheds = 0
+        self._shed_reasons: dict[str, int] = {}
         self.host = host
         self.port = port  # replaced by the bound port after start()
         self.heartbeat_s = heartbeat_s
@@ -171,7 +226,8 @@ class ServeServer:
                     # shows up before clients start timing out
                     oldest_queued_s=self.sched.oldest_queued_s(),
                     pending_steps=len(self._pending),
-                    exec_ops=len(self._inflight_exec))
+                    exec_ops=len(self._inflight_exec),
+                    sheds=self._sheds)
             await asyncio.sleep(0.0 if progressed else self.idle_sleep_s)
 
     def _tick_once(self) -> bool:
@@ -259,6 +315,14 @@ class ServeServer:
                 _serve_event("complete", s.sid, kind="policy",
                              n_steps=episode["n_steps"],
                              relative_reward=episode["relative_reward"])
+            # chaos seam for the fleet smoke: a replica-tagged server
+            # checks the injector after each completed burst, so
+            # CPR_FAULT_INJECT="kill@replica=<i>" deterministically
+            # kills exactly replica i at its first burst under load
+            # (and hang@replica wedges its tick loop, which the
+            # supervisor's quiet watchdog catches)
+            if self.replica_index is not None:
+                resilience.fault_point("replica", self.replica_index)
             progressed = True
         return progressed
 
@@ -304,6 +368,17 @@ class ServeServer:
         run_lat = report["latency"].get("episode.run") or {}
         report["p50_s"] = run_lat.get("p50_s")
         report["p99_s"] = run_lat.get("p99_s")
+        # per-priority-class tails + the shed accounting: the ledger
+        # lifts class_p99_s into cfg_class-tagged serve_p99_s rows and
+        # shed_rate into a lower-is-better serve_shed_rate row
+        report["class_p99_s"] = {
+            fam.split(":", 1)[1]: report["latency"][fam].get("p99_s")
+            for fam in report["latency"]
+            if fam.startswith("episode.run:")}
+        report["sheds"] = self._sheds
+        report["shed_reasons"] = dict(self._shed_reasons)
+        denom = self._sheds + self.engine.admitted
+        report["shed_rate"] = self._sheds / denom if denom else 0.0
         _serve_event("report", **report)
         self.engine.emit_metrics()
         _serve_event("stop", reason=reason, steps=report["steps"],
@@ -355,9 +430,16 @@ class ServeServer:
             resp["latency"] = lat
         resp["trace_id"] = trace_id
         status = ("ok" if resp.get("ok")
-                  else "refused" if resp.get("draining") else "error")
+                  else "refused" if resp.get("draining")
+                  or resp.get("shed") else "error")
         op = req.get("op")
+        cls = resp.pop("_class", None)
         self.latency.observe(_op_family(op), lat["total_s"])
+        if cls is not None:
+            # per-priority-class tail latency: the drain report lifts
+            # these into per-class serve_p99_s ledger rows
+            self.latency.observe(f"{_op_family(op)}:{cls}",
+                                 lat["total_s"])
         _request_event(trace_id, op, status, lat["queue_wait_s"],
                        lat["service_s"], lat["total_s"],
                        resp.get("session"), resp.pop("_lane", None),
@@ -380,6 +462,8 @@ class ServeServer:
                         oldest_queued_s=self.sched.oldest_queued_s(),
                         pending_steps=len(self._pending),
                         exec_ops=len(self._inflight_exec),
+                        sheds=self._sheds,
+                        shed_reasons=dict(self._shed_reasons),
                         # per-op-family histogram summaries; named
                         # `latencies` because the singular `latency`
                         # reply key is the per-request breakdown
@@ -407,7 +491,54 @@ class ServeServer:
             return out
         return dict(ok=False, error=f"unknown op {op!r}")
 
-    def _new_session(self, kind: str, req: dict) -> _Session:
+    # -- admission control -------------------------------------------------
+
+    def _retry_after_s(self) -> float:
+        """Latency-aware backoff hint for a shed reply: the backlog's
+        estimated drain time (queue depth x the episode.run p50 from
+        the latency board, spread over the lanes), clamped to
+        [0.1, 30] seconds.  Before any episode has completed, the SLO
+        itself (or 1s) stands in for the per-episode estimate."""
+        h = self.latency.get("episode.run")
+        per = h.quantile(0.5) if h is not None and h.count else None
+        if per is None:
+            per = self.slo_s if self.slo_s is not None else 1.0
+        est = (self.sched.n_queued() + 1) * per / max(1, self.engine.n_lanes)
+        return round(min(30.0, max(0.1, est)), 3)
+
+    def _shed(self, reason: str, op: str, cls: str, tenant) -> dict:
+        """One shed decision: count it, emit the typed v9 `admission`
+        event, and build the in-band refusal (the connection stays up;
+        `retry_after` tells the client when to come back)."""
+        retry_after = self._retry_after_s()
+        self._sheds += 1
+        self._shed_reasons[reason] = self._shed_reasons.get(reason, 0) + 1
+        self.engine.record_shed()
+        _admission_event(reason, op, cls, tenant, retry_after)
+        return dict(ok=False, error=f"shed: {reason}", shed=True,
+                    reason=reason, retry_after=retry_after)
+
+    def _admission_check(self, op: str, priority: int, cls: str,
+                         tenant) -> dict | None:
+        """Shed refusal for a new session, or None to admit.  Checked
+        before the session exists: a shed request never consumes a
+        sid/seed, so the seed sequence of admitted traffic is
+        unperturbed by load."""
+        if (self.sched.max_queued is not None
+                and self.sched.n_queued() >= self.sched.max_queued):
+            return self._shed("queue_full", op, cls, tenant)
+        if (self.sched.tenant_quota is not None and tenant is not None
+                and self.sched.tenant_load(tenant)
+                >= self.sched.tenant_quota):
+            return self._shed("tenant_quota", op, cls, tenant)
+        if self.slo_s is not None:
+            budget = self.slo_s * _SLO_SCALE[priority]
+            if self.sched.oldest_queued_s() > budget:
+                return self._shed("slo_breach", op, cls, tenant)
+        return None
+
+    def _new_session(self, kind: str, req: dict, priority: int = 1,
+                     cls: str = "normal") -> _Session:
         if self._draining or self._drain_reason is not None:
             raise RuntimeError("draining")
         policy = req.get("policy", "honest")
@@ -417,20 +548,41 @@ class ServeServer:
                 f"{list(self.engine.policy_names)}")
         seed = int(req["seed"]) if "seed" in req and req["seed"] is not None \
             else next(self._seed)
+        tenant = req.get("tenant")
         return _Session(next(self._sid), kind, seed, policy,
                         self.engine.policy_ids.get(policy),
-                        asyncio.get_running_loop().create_future())
+                        asyncio.get_running_loop().create_future(),
+                        priority=priority, cls=cls,
+                        tenant=str(tenant) if tenant is not None else None)
 
     async def _op_episode_run(self, req):
-        s = self._new_session("policy", req)
-        self.sched.enqueue(s)
+        prio, cls = _priority_of(req, default=1)
+        tenant = req.get("tenant")
+        tenant = str(tenant) if tenant is not None else None
+        refusal = self._admission_check("episode.run", prio, cls, tenant)
+        if refusal is not None:
+            return refusal
+        s = self._new_session("policy", req, prio, cls)
+        try:
+            self.sched.enqueue(s, priority=prio, tenant=s.tenant)
+        except QueueFull:
+            return self._shed("queue_full", "episode.run", cls, s.tenant)
         resp = await s.future
         return dict(resp, latency=self._session_latency(s),
-                    _lane=s.lane, _splice_s=s.splice_s)
+                    _lane=s.lane, _splice_s=s.splice_s, _class=s.cls)
 
     async def _op_episode_open(self, req):
-        s = self._new_session("interactive", req)
-        self.sched.enqueue(s)
+        prio, cls = _priority_of(req, default=PRIORITY_CLASSES["interactive"])
+        tenant = req.get("tenant")
+        tenant = str(tenant) if tenant is not None else None
+        refusal = self._admission_check("episode.open", prio, cls, tenant)
+        if refusal is not None:
+            return refusal
+        s = self._new_session("interactive", req, prio, cls)
+        try:
+            self.sched.enqueue(s, priority=prio, tenant=s.tenant)
+        except QueueFull:
+            return self._shed("queue_full", "episode.open", cls, s.tenant)
         obs = await s.future
         if isinstance(obs, dict):  # drained before admission
             return dict(obs, latency=self._session_latency(s))
@@ -576,6 +728,18 @@ def main(argv=None) -> int:
     p.add_argument("--ready-file", default=None,
                    help="atomic JSON {host,port,pid} once accepting")
     p.add_argument("--heartbeat-s", type=float, default=1.0)
+    p.add_argument("--slo-s", type=float, default=None,
+                   help="shed new sessions in-band when oldest_queued_s"
+                        " breaches this (scaled per priority class);"
+                        " default: no SLO shedding")
+    p.add_argument("--max-queue", type=int, default=None,
+                   help="admission queue bound (default 8x lanes;"
+                        " <= 0 for unbounded)")
+    p.add_argument("--tenant-quota", type=int, default=None,
+                   help="max lanes+queue slots one tenant may hold")
+    p.add_argument("--replica-index", type=int, default=None,
+                   help="fleet replica id (set by serve.router); arms"
+                        " the per-replica fault-injection site")
     args = p.parse_args(argv)
 
     from cpr_tpu import supervisor
@@ -612,7 +776,10 @@ def main(argv=None) -> int:
 
     async def amain():
         server = ServeServer(engine, host=args.host, port=args.port,
-                             heartbeat_s=args.heartbeat_s)
+                             heartbeat_s=args.heartbeat_s,
+                             slo_s=args.slo_s, max_queued=args.max_queue,
+                             tenant_quota=args.tenant_quota,
+                             replica_index=args.replica_index)
         await server.start()
         if args.ready_file:
             resilience.atomic_write_json(

@@ -54,7 +54,7 @@ from contextlib import contextmanager
 from datetime import datetime, timezone
 from time import perf_counter as now  # noqa: F401 — re-exported
 
-SCHEMA_VERSION = 8
+SCHEMA_VERSION = 9
 TELEMETRY_ENV_VAR = "CPR_TELEMETRY"
 # trace context: one run id per process tree, exported so supervisor
 # children and serve clients land their events under the same id
@@ -111,6 +111,21 @@ EVENT_FIELDS = {
     # free-form: role, run, session, lane, splice_s, t_* stamps.
     "request": ("trace_id", "op", "status", "queue_wait_s",
                 "service_s", "total_s"),
+    # v9: one per admission-control refusal (cpr_tpu/serve/server.py)
+    # — admitted sessions stay on the v7 serve admit trail, so this
+    # event only fires when a session is shed.  reason is
+    # queue_full|slo_breach|tenant_quota|replica_lost, priority is the
+    # request's class name, tenant the quota key (null for untagged
+    # traffic), retry_after_s the in-band backoff hint the refusal
+    # reply carries.
+    "admission": ("reason", "op", "priority", "tenant",
+                  "retry_after_s"),
+    # v9: one per router decision (cpr_tpu/serve/router.py): action is
+    # route|requeue|refuse|replica_up|replica_down, replica the target
+    # replica index (null when no replica was involved), op the wire op
+    # being routed (null for lifecycle actions).  Extras ride
+    # free-form: session, seed, reason, restarts.
+    "route": ("action", "replica", "op"),
 }
 
 

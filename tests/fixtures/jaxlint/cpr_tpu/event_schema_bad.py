@@ -8,3 +8,8 @@ def report(tele, fn_name, tid):
     tele.emit({"kind": "event", "name": "retry", "attempt": 1})
     # finding: missing total_s (the v8 request-latency contract)
     tele.event("request", trace_id=tid, op="episode.run", status="ok")
+    # finding: missing priority, tenant, retry_after_s (v9 admission)
+    tele.event("admission", reason="queue_full", op="episode.run")
+    # finding: missing op (v9 route)
+    tele.emit({"kind": "event", "name": "route", "action": "requeue",
+               "replica": 1})

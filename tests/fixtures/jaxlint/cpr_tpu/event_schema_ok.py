@@ -11,3 +11,7 @@ def report(tele, fn_name, dt, err, extra, tid):
                "delay_s": 0.5, "error": err})
     tele.event("request", trace_id=tid, op="episode.run", status="ok",
                total_s=dt, role="client")  # extras ride free-form
+    tele.event("admission", reason="slo_breach", op="episode.run",
+               priority=1, tenant=None, retry_after_s=dt)
+    tele.emit({"kind": "event", "name": "route", "action": "route",
+               "replica": 0, "op": "episode.run", "seed": 7})

@@ -5,4 +5,7 @@ EVENT_FIELDS = {
     "compile": ("fn", "compile_s"),
     "retry": ("attempt", "delay_s", "error"),
     "request": ("trace_id", "op", "status", "total_s"),
+    "admission": ("reason", "op", "priority", "tenant",
+                  "retry_after_s"),
+    "route": ("action", "replica", "op"),
 }

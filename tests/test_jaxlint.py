@@ -72,6 +72,9 @@ def test_event_fields_resolved_cross_module_by_ast():
         "compile": ("fn", "compile_s"),
         "retry": ("attempt", "delay_s", "error"),
         "request": ("trace_id", "op", "status", "total_s"),
+        "admission": ("reason", "op", "priority", "tenant",
+                      "retry_after_s"),
+        "route": ("action", "replica", "op"),
     }
 
 

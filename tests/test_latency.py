@@ -10,7 +10,8 @@ import math
 
 import pytest
 
-from cpr_tpu.latency import LatencyBoard, LatencyHistogram, default_edges
+from cpr_tpu.latency import (OVERFLOW_FAMILY, LatencyBoard,
+                             LatencyHistogram, default_edges)
 
 
 def test_default_edges_are_log_uniform_and_span_the_range():
@@ -106,3 +107,29 @@ def test_board_is_lazy_per_family_and_json_ready():
     assert 0.5 <= snap["episode.run"]["p99_s"] <= 0.7
     json.dumps(snap)  # the stats/heartbeat/report embedding
     assert all(math.isfinite(v) for v in snap["episode.run"].values())
+
+
+def test_board_family_cardinality_is_bounded():
+    """Satellite 2: unbounded family names (a tenant id or trace id
+    leaking into the family string) must not grow the board without
+    limit — novel families past the cap pool into OVERFLOW_FAMILY,
+    while already-minted families keep observing normally."""
+    board = LatencyBoard(max_families=3)
+    for i in range(3):
+        board.observe(f"fam{i}", 0.01)
+    assert len(board.families) == 3
+    # the flood: 50 novel names all land in the one overflow family
+    for i in range(50):
+        board.observe(f"leak-{i}", 0.02)
+    fams = board.families
+    assert len(fams) == 4  # 3 real + overflow, never 53
+    assert OVERFLOW_FAMILY in fams
+    assert board.get(OVERFLOW_FAMILY).count == 50
+    assert board.get("leak-7") is None
+    # established families are unaffected by the flood
+    board.observe("fam1", 0.03)
+    assert board.get("fam1").count == 2
+    snap = board.snapshot()
+    assert snap[OVERFLOW_FAMILY]["count"] == 50
+    with pytest.raises(ValueError, match="max_families"):
+        LatencyBoard(max_families=0)

@@ -461,6 +461,59 @@ def test_trace_stitch_merges_streams_and_tolerates_orphans(tmp_path,
     assert f"run {run}" in out
 
 
+def test_trace_stitch_router_hop_segment(tmp_path, capsys):
+    """Satellite 4: a role="router" stream is an optional third side —
+    a three-sided trace gains the route leg (router total minus server
+    total) and the reply leg is measured against the router's wall,
+    while traces without a router event keep the exact two-sided
+    breakdown (single-process streams stitch unchanged)."""
+    stitcher = _load_trace_stitch()
+    run = "feedface00112233"
+    server = tmp_path / "server.jsonl"
+    tele = telemetry.Telemetry(str(server))
+    tele.emit({"kind": "manifest", "run": run, "backend": "cpu"})
+    _request_line(tele, "t1", "server", run, splice_s=0.02,
+                  queue_wait_s=0.1, service_s=0.3, total_s=0.4)
+    _request_line(tele, "t2", "server", run, total_s=0.4)
+    tele.close()
+    router = tmp_path / "router.jsonl"
+    tele = telemetry.Telemetry(str(router))
+    tele.emit({"kind": "manifest", "run": run, "backend": "cpu"})
+    _request_line(tele, "t1", "router", run, total_s=0.5)
+    # a router-only trace (its server stream was cut mid-run)
+    _request_line(tele, "t-router-only", "router", run, total_s=0.1)
+    tele.close()
+    client = tmp_path / "client.jsonl"
+    tele = telemetry.Telemetry(str(client))
+    tele.emit({"kind": "manifest", "run": run})
+    _request_line(tele, "t1", "client", run, total_s=0.56)
+    _request_line(tele, "t2", "client", run, total_s=0.45)
+    tele.close()
+
+    st = stitcher.stitch([str(server), str(router), str(client)])
+    by_id = {t["trace_id"]: t for t in st["traces"]}
+    t1 = by_id["t1"]
+    assert t1["orphan"] is None
+    bd = t1["breakdown"]
+    assert bd["route_s"] == pytest.approx(0.1)  # router - server
+    assert bd["queue_s"] == pytest.approx(0.08)
+    assert bd["burst_s"] == pytest.approx(0.3)
+    # the reply leg is past the router, the furthest-upstream total
+    assert bd["reply_s"] == pytest.approx(0.06)
+    assert bd["total_s"] == pytest.approx(0.56)
+    # routerless trace on the same streams: exact two-sided breakdown
+    bd2 = by_id["t2"]["breakdown"]
+    assert bd2["route_s"] is None
+    assert bd2["reply_s"] == pytest.approx(0.05)
+    # router-only = orphan (no server side to split against)
+    assert by_id["t-router-only"]["orphan"] == "no-client"
+    assert by_id["t-router-only"]["breakdown"]["route_s"] is None
+
+    assert stitcher.main([str(server), str(router), str(client)]) == 0
+    out = capsys.readouterr().out
+    assert "route" in out
+
+
 def test_trace_stitch_empty_streams_exit_nonzero(tmp_path, capsys):
     empty = tmp_path / "empty.jsonl"
     empty.write_text(json.dumps({"kind": "manifest", "run": "r"}) + "\n")

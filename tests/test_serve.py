@@ -292,6 +292,56 @@ def test_scheduler_backfill_and_occupancy():
         LaneScheduler(0)
 
 
+def test_scheduler_priority_ordering_and_cancel_edge_cases():
+    """Satellite 3: priority classes order placement (FIFO within a
+    class), cancel is exact about what it can drop, drain evicts
+    queued-then-placed, and the bounded queue raises QueueFull."""
+    from cpr_tpu.serve.scheduler import QueueFull
+
+    sched = LaneScheduler(2, max_queued=4)
+    a, b, c, d = object(), object(), object(), object()
+    assert sched.enqueue(a, priority=2) == 0
+    assert sched.enqueue(b, priority=1) == 0  # ahead of batch a
+    assert sched.enqueue(c, priority=1) == 1  # FIFO within class 1
+    assert sched.enqueue(d, priority=0) == 0  # interactive: the front
+    assert sched.place() == [(0, d), (1, b)]
+    # cancel: unknown session, already-placed session -> False;
+    # still-queued session -> True
+    assert sched.cancel(object()) is False
+    assert sched.cancel(d) is False
+    assert sched.cancel(c) is True
+    assert sched.n_queued() == 1  # only a remains
+    # drain evicts queued first, then placed (ascending lane id)
+    assert sched.drain() == [a, d, b]
+    assert sched.n_queued() == 0 and sched.n_assigned() == 0
+    # the bound: 4 queued, the 5th raises instead of growing
+    for i in range(4):
+        sched.enqueue(object())
+    with pytest.raises(QueueFull, match="capacity"):
+        sched.enqueue(object())
+
+
+def test_scheduler_tenant_quota_skips_without_blocking():
+    """A tenant at quota stays queued (aging normally) while sessions
+    of other tenants behind it still place; a same-tick retire frees
+    the quota and the next place() backfills the parked session."""
+    sched = LaneScheduler(2, tenant_quota=1)
+    a, b, c = object(), object(), object()
+    sched.enqueue(a, tenant="t")
+    sched.enqueue(b, tenant="t")
+    sched.enqueue(c, tenant="u")
+    # a holds t's one lane; b is at quota and parked; c jumps past it
+    assert sched.place() == [(0, a), (1, c)]
+    assert sched.n_queued() == 1
+    assert sched.tenant_load("t") == 2  # one lane held + one queued
+    assert sched.tenant_load("u") == 1
+    assert sched.tenant_load(None) == 0
+    # retire -> same-tick backfill: freeing t's lane admits b
+    assert sched.retire(0) is a
+    assert sched.place() == [(0, b)]
+    assert sched.tenant_load("t") == 1
+
+
 # -- wire protocol ---------------------------------------------------------
 
 
@@ -550,6 +600,292 @@ def test_request_trace_propagates_across_the_wire(env, params, tmp_path):
     # the client's total includes the wire, so it bounds the server's
     assert (by_role["client"]["total_s"]
             >= by_role["server"]["total_s"] > 0.0)
+
+
+# -- admission control (fleet PR) ------------------------------------------
+
+
+def test_server_admission_control_sheds_in_band(env, params, tmp_path):
+    """Tentpole (a): with all lanes held, a tenant over quota, a stale
+    backlog, and a full bounded queue each get an in-band shed refusal
+    — ok=False / shed=True / reason / retry_after on a live connection
+    — with a typed v9 admission event per refusal and the shed
+    accounting in stats and the drain report."""
+    import socket as socketlib
+    import time
+
+    from cpr_tpu import telemetry
+    from cpr_tpu.serve.server import ServeServer
+
+    engine = ResidentEngine(env, params, n_lanes=N_LANES, burst=BURST)
+    engine.start()
+    trace = tmp_path / "trace.jsonl"
+    telemetry.configure(str(trace))
+    try:
+        server = ServeServer(engine, heartbeat_s=5.0, idle_sleep_s=0.001,
+                             slo_s=0.3, max_queued=2, tenant_quota=1)
+        t, port = _spawn_server(server)
+        # one raw socket per parked run: the server answers frames on
+        # a connection strictly in order, so a second frame behind a
+        # blocked run would never even be read
+        raws = [socketlib.create_connection(("127.0.0.1", port),
+                                            timeout=60)
+                for _ in range(2)]
+
+        def park(sock, seed, want_queued, c):
+            sock.sendall(wire.pack_frame(dict(
+                op="episode.run", policy="honest", seed=seed)))
+            for _ in range(500):
+                if c.request("stats")["queued"] >= want_queued:
+                    return
+                time.sleep(0.01)
+            raise AssertionError(f"run seed={seed} never queued")
+
+        def open_lanes(c, first_tenant=None):
+            out = []
+            for i in range(N_LANES):
+                o = c.request("episode.open", seed=100 + i,
+                              tenant=first_tenant if i == 0 else None)
+                assert o["ok"], o
+                out.append(o["session"])
+            return out
+
+        def release(c, sessions):
+            for sid in sessions:
+                assert c.request("episode.close", session=sid)["ok"]
+            for _ in range(500):
+                st = c.request("stats")
+                if st["queued"] == 0 and st["assigned"] == 0:
+                    return
+                time.sleep(0.01)
+            raise AssertionError("backlog never drained")
+
+        try:
+            with ServeClient("127.0.0.1", port, timeout=120) as c:
+                sessions = open_lanes(c, first_tenant="hog")
+                # tenant "hog" already holds a lane: over quota
+                r = c.request("episode.run", policy="honest", seed=1,
+                              tenant="hog")
+                assert not r["ok"] and r["shed"]
+                assert r["reason"] == "tenant_quota"
+                assert r["error"].startswith("shed")
+                assert r["retry_after"] >= 0.1
+                # park one run (all lanes held, it waits), let the
+                # backlog age past the batch-class SLO budget
+                # (slo_s * 0.5): batch traffic sheds, queue not full
+                park(raws[0], 2, 1, c)
+                time.sleep(2 * 0.3)
+                r = c.request("episode.run", policy="honest", seed=3,
+                              priority="batch")
+                assert not r["ok"] and r["reason"] == "slo_breach"
+                # reset the backlog (stale queues shed everything via
+                # the SLO check, so queue_full needs a fresh queue),
+                # then hold the lanes and fill the bound
+                release(c, sessions)
+                sessions = open_lanes(c)
+                park(raws[0], 4, 1, c)
+                park(raws[1], 6, 2, c)
+                r = c.request("episode.run", policy="honest", seed=5)
+                assert not r["ok"] and r["reason"] == "queue_full"
+                stats = c.request("stats")
+                assert stats["sheds"] == 3
+                assert stats["shed_reasons"] == {"tenant_quota": 1,
+                                                 "slo_breach": 1,
+                                                 "queue_full": 1}
+                # release the lanes; the parked runs complete normally
+                release(c, sessions)
+                assert c.request("drain")["ok"]
+        finally:
+            for sock in raws:
+                sock.close()
+        t.join(60)
+        assert not t.is_alive()
+    finally:
+        telemetry.configure(None)
+    events = [json.loads(ln) for ln in trace.read_text().splitlines()
+              if ln.strip()]
+    adm = [e for e in events if e.get("kind") == "event"
+           and e.get("name") == "admission"]
+    assert [e["reason"] for e in adm] == ["tenant_quota", "slo_breach",
+                                          "queue_full"]
+    for e in adm:
+        assert e["op"] == "episode.run" and e["retry_after_s"] > 0.0
+    assert adm[0]["tenant"] == "hog"
+    assert adm[1]["priority"] == "batch"
+    # shed refusals are "refused" on the request trail, never "error"
+    refused = [e for e in events if e.get("name") == "request"
+               and e.get("role") == "server"
+               and e.get("status") == "refused"]
+    assert len(refused) >= 3
+    # the drain report carries the shed accounting + per-class tails
+    (report,) = [e for e in events if e.get("name") == "serve"
+                 and e.get("action") == "report"]
+    d = report["detail"]
+    assert d["sheds"] == 3
+    assert d["shed_reasons"] == {"tenant_quota": 1, "slo_breach": 1,
+                                 "queue_full": 1}
+    assert 0.0 < d["shed_rate"] < 1.0
+    assert d["class_p99_s"].get("normal", 0) > 0.0
+
+
+def test_server_rejects_unknown_priority_class(env, params):
+    from cpr_tpu.serve.server import _priority_of
+
+    assert _priority_of({"priority": "interactive"}) == (0, "interactive")
+    assert _priority_of({"priority": 2}) == (2, "batch")
+    assert _priority_of({"priority": 99}) == (2, "batch")  # clamped
+    assert _priority_of({}) == (1, "normal")
+    with pytest.raises(ValueError, match="unknown priority"):
+        _priority_of({"priority": "platinum"})
+
+
+def test_call_with_retry_honors_shed_and_drain_taxonomy():
+    """Satellite 1: a shed refusal is transient — the retry backoff
+    stretches to the server's retry_after hint; a drain refusal is
+    terminal; exhaustion re-raises the last ShedRefusal."""
+    c = ServeClient.__new__(ServeClient)
+    c._addr = ("127.0.0.1", 1)
+    c._timeout = 1.0
+    c._sock = object()  # non-None: attempt() never reconnects
+    replies = [dict(ok=False, shed=True, error="shed: queue_full",
+                    reason="queue_full", retry_after=0.4),
+               dict(ok=True, n=1)]
+    calls, sleeps = [], []
+    c.request = lambda op, **f: (calls.append(op), replies.pop(0))[1]
+    out = c.call_with_retry("episode.run", base_delay_s=0.01,
+                            sleep=sleeps.append, seed=7)
+    assert out == dict(ok=True, n=1)
+    assert calls == ["episode.run", "episode.run"]
+    assert sleeps == [0.4]  # the hint stretched the tiny base delay
+
+    c._sock = object()
+    c.request = lambda op, **f: dict(ok=False, error="draining",
+                                     draining=True)
+    with pytest.raises(wire.DrainRefusal):
+        c.call_with_retry("episode.run", sleep=lambda s: None)
+
+    c._sock = object()
+    c.request = lambda op, **f: dict(ok=False, shed=True,
+                                     error="shed: slo_breach",
+                                     reason="slo_breach",
+                                     retry_after=0.01)
+    with pytest.raises(wire.ShedRefusal) as ei:
+        c.call_with_retry("episode.run", max_attempts=2,
+                          sleep=lambda s: None)
+    assert ei.value.retry_after_s == pytest.approx(0.01)
+
+
+# -- the fleet router (unit surface; fleet-smoke covers end-to-end) --------
+
+
+def test_router_pick_refuse_and_pinned_bookkeeping():
+    """Tentpole (b) unit surface: least-loaded pick with exclusion,
+    shed-shaped in-band refusals, rsid translation edge cases, and the
+    purge-on-replica-loss path — all without spawning children."""
+    from cpr_tpu.serve.router import ServeRouter
+
+    with pytest.raises(ValueError, match="at least one replica"):
+        ServeRouter([], 0, workdir="/tmp/unused")
+    router = ServeRouter(["--lanes", "2"], 2, workdir="/tmp/unused")
+    r0, r1 = router.replicas
+    assert router._pick(set()) is None  # nothing up yet
+    r0.state = r1.state = "up"
+    r0.inflight, r1.inflight = 3, 1
+    assert router._pick(set()) is r1  # least loaded
+    assert router._pick({1}) is r0  # exclusion
+    r1.inflight = 3
+    assert router._pick(set()) is r0  # index breaks ties
+    # refusals are shed-shaped and counted; a restarting replica
+    # stretches the retry_after quote
+    resp = router._refuse("replica_lost", "episode.step", replica=0)
+    assert not resp["ok"] and resp["shed"]
+    assert resp["reason"] == "replica_lost"
+    assert resp["retry_after"] == 1.0
+    r1.state = "starting"
+    assert router._refuse("replica_lost", "x")["retry_after"] == 5.0
+    assert router.router_stats()["refused"] == 2
+
+    async def go():
+        # unknown rsid: close is idempotent-ok, step is a plain error
+        ok = await router._route_pinned(
+            dict(op="episode.close", session=99), "episode.close")
+        assert ok["ok"]
+        resp = await router._route_pinned(
+            dict(op="episode.step", session=99, action=0),
+            "episode.step")
+        assert not resp["ok"] and "session" in resp["error"]
+        # a session pinned to a lost replica refuses in-band and the
+        # mapping is purged (the client reopens elsewhere)
+        router._sessions[5] = (1, 42)
+        r1.state = "down"
+        resp = await router._route_pinned(
+            dict(op="episode.step", session=5, action=0),
+            "episode.step")
+        assert resp["shed"] and resp["reason"] == "replica_lost"
+        assert 5 not in router._sessions
+
+    asyncio.run(go())
+
+
+def test_router_stamps_seeds_before_first_forward():
+    """The deterministic-failover precondition: every episode.run
+    reaching a replica carries an explicit seed — router-stamped from
+    its own base (1 << 21, above the servers' 1 << 20) when the client
+    sent none, passed through untouched otherwise."""
+    from cpr_tpu.serve.router import ServeRouter
+
+    router = ServeRouter([], 1, workdir="/tmp/unused")
+    seen = []
+
+    async def fake_failover(req, op):
+        seen.append(dict(req))
+        return dict(ok=True)
+
+    router._route_failover = fake_failover
+
+    async def go():
+        await router._route_episode_run(dict(op="episode.run"))
+        await router._route_episode_run(dict(op="episode.run"))
+        await router._route_episode_run(dict(op="episode.run", seed=7))
+
+    asyncio.run(go())
+    assert seen[0]["seed"] == 1 << 21
+    assert seen[1]["seed"] == (1 << 21) + 1
+    assert seen[2]["seed"] == 7
+
+
+def test_ledger_lifts_per_class_p99_and_shed_rate(tmp_path):
+    """The drain report's class_p99_s map becomes one cfg_class-tagged
+    serve_p99_s row per class (distinct fingerprints, so each class
+    gates against its own history) and shed_rate a lower-is-better
+    serve_shed_rate row."""
+    from cpr_tpu.perf.ledger import Ledger
+
+    trace = tmp_path / "t.jsonl"
+    events = [
+        {"kind": "manifest", "backend": "cpu",
+         "config": {"entry": "serve", "n_lanes": 4}},
+        {"kind": "event", "name": "serve", "ts": 1.0,
+         "action": "report", "session": None,
+         "detail": {"steps_per_sec": 500.0,
+                    "class_p99_s": {"normal": 0.5, "batch": 0.9},
+                    "shed_rate": 0.25}},
+    ]
+    trace.write_text("".join(json.dumps(e) + "\n" for e in events))
+    ledger = Ledger(str(tmp_path / "l.jsonl"))
+    assert ledger.ingest_trace(str(trace)) == 4
+    recs = ledger.records()
+    p99 = [r for r in recs if r["metric"] == "serve_p99_s"]
+    by_cls = {r["config"]["cfg_class"]: r for r in p99}
+    assert set(by_cls) == {"normal", "batch"}
+    assert by_cls["normal"]["value"] == 0.5
+    assert by_cls["batch"]["value"] == 0.9
+    assert all(r["direction"] == "lower" for r in p99)
+    assert (by_cls["normal"]["fingerprint"]
+            != by_cls["batch"]["fingerprint"])
+    (shed,) = [r for r in recs if r["metric"] == "serve_shed_rate"]
+    assert shed["value"] == 0.25 and shed["unit"] == "fraction"
+    assert shed["direction"] == "lower"  # no _s suffix: explicit
 
 
 # -- perf ledger ingestion + gate (satellite f) ----------------------------

@@ -1,0 +1,469 @@
+"""Fleet-resilience smoke (`make fleet-smoke`).
+
+Proves the cpr_tpu/serve fleet contract — SLO-aware admission control,
+in-band load shedding, and deterministic replica failover — end to end
+on CPU, the way the SERVING.md runbook describes it:
+
+  1  launch `python -m cpr_tpu.serve.router --replicas 2` (each replica
+     a supervised server child with its own telemetry sink and an armed
+     `replica` fault site) with a deliberately tiny capacity
+     (4 lanes + max-queue 4 per replica) and
+     CPR_FAULT_INJECT=kill@replica=1 in the environment;
+  2  flood it with ~32 concurrent seeded `episode.run` clients through
+     `ServeClient.call_with_retry`.  Replica 1 dies at its first burst
+     under load; the router requeues its in-flight sessions onto
+     replica 0 (seed replay), and the overload against the halved fleet
+     forces in-band `shed: queue_full` refusals that the clients absorb
+     via the retry_after contract — zero client hangs, zero errors;
+  3  every reply (including the requeued and the router-seeded ones) is
+     checked byte-for-byte against an in-process `env.rollout` of the
+     same seed — the bit-identity failover guarantee;
+  4  the killed replica warm-restarts (fault env stripped: one-shot),
+     rejoins the fleet, and serves a post-restart round; router stats
+     must show the requeue/shed/restart accounting;
+  5  a router-initiated drain, then the evidence: the v9 `route` trail
+     (replica_up/down, requeue, drain, stop) and `admission` shed
+     events validate via `trace_summary --validate --expect
+     admission,route,serve,request`, `trace_stitch` pairs at least one
+     request across client+router+replica streams with a `route` leg,
+     and the drain reports' per-class p99 + shed-rate rows ingest into
+     a fresh perf ledger and clear the gate.
+
+Usage: python tools/fleet_smoke.py [workdir]   (default /tmp/...)
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+ROOT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+sys.path.insert(0, ROOT)
+
+from cpr_tpu import telemetry  # noqa: E402
+from cpr_tpu.perf.gate import gate_row, gate_summary  # noqa: E402
+from cpr_tpu.perf.ledger import Ledger  # noqa: E402
+from cpr_tpu.serve.protocol import ServeClient  # noqa: E402
+
+# tiny geometry: capacity 4 lanes + 4 queue slots per replica, so a
+# 32-client flood against a fleet that just lost half its replicas is
+# guaranteed to shed — and 16-step episodes keep every phase fast
+MAX_STEPS = 16
+LANES = 4
+BURST = 8
+MAX_QUEUE = 4
+REPLICAS = 2
+N_SEEDED = 28
+N_SEEDLESS = 4
+SEED0 = 9001
+ROUTER_SEED_BASE = 1 << 21  # router-stamped seeds live above this
+READY_TIMEOUT_S = 600.0
+FLOOD_TIMEOUT_S = 300.0
+
+
+def _log(msg):
+    print(f"fleet-smoke: {msg}", file=sys.stderr)
+
+
+def _router_cmd(workdir):
+    return [sys.executable, "-m", "cpr_tpu.serve.router",
+            "--replicas", str(REPLICAS), "--protocol", "nakamoto",
+            "--max-steps", str(MAX_STEPS), "--lanes", str(LANES),
+            "--burst", str(BURST), "--max-queue", str(MAX_QUEUE),
+            "--heartbeat-s", "0.5", "--workdir", workdir,
+            "--ready-file", os.path.join(workdir, "router.json")]
+
+
+def _router_env(workdir, trace):
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               CPR_TELEMETRY=trace, CPR_DEVICE_METRICS="1",
+               CPR_FAULT_INJECT="kill@replica=1",
+               CPR_RUN_ID=telemetry.run_id(),
+               CPR_TPU_CACHE=os.path.join(workdir, "cache"))
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def _wait_ready(path, proc, log_path):
+    deadline = time.time() + READY_TIMEOUT_S
+    while time.time() < deadline:
+        if proc.poll() is not None:
+            tail = open(log_path).read()[-4000:]
+            raise SystemExit(f"router exited rc={proc.returncode} before "
+                             f"becoming ready\n{tail}")
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            time.sleep(0.25)
+    raise SystemExit(f"router not ready within {READY_TIMEOUT_S:.0f}s")
+
+
+def _episode_refs(seeds):
+    """In-process ground truth: the episode aggregates `episode.run`
+    must reproduce for each seed — captured, like the engine does, at
+    the first done of rollout(PRNGKey(seed))."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from cpr_tpu.envs import registry
+    from cpr_tpu.params import make_params
+
+    env = registry.get_sized("nakamoto", MAX_STEPS)
+    params = make_params(alpha=0.25, gamma=0.5, max_steps=MAX_STEPS)
+    policy = env.policies["honest"]
+
+    batch = jax.jit(jax.vmap(
+        lambda k: env.rollout(k, params, policy, MAX_STEPS)))
+    keys = jax.vmap(jax.random.PRNGKey)(
+        jnp.asarray(list(seeds), jnp.uint32))
+    _, _, _, done, info = batch(keys)
+    done = np.asarray(done)
+    info = {k: np.asarray(v) for k, v in info.items()}
+    refs = {}
+    for row, s in enumerate(seeds):
+        idx = int(np.argmax(done[row]))
+        assert done[row][idx], f"seed {s}: no done within {MAX_STEPS}"
+        att = float(info["episode_reward_attacker"][row, idx])
+        dfn = float(info["episode_reward_defender"][row, idx])
+        refs[int(s)] = dict(
+            reward_attacker=att, reward_defender=dfn,
+            progress=float(info["episode_progress"][row, idx]),
+            n_steps=int(info["episode_n_steps"][row, idx]),
+            relative_reward=(att / (att + dfn) if (att + dfn) else 0.0))
+    return refs
+
+
+def _check_episodes(replies, label):
+    """Bit-identity: every reply must equal the rollout reference of
+    its (possibly router-stamped) seed, field for field."""
+    refs = _episode_refs(sorted({r["seed"] for r in replies}))
+    for r in replies:
+        ref = refs[r["seed"]]
+        got = r["episode"]
+        for k, want in ref.items():
+            if got.get(k) != want:
+                raise SystemExit(
+                    f"{label}: seed {r['seed']} field {k} diverged "
+                    f"from rollout: got {got.get(k)!r}, want {want!r}")
+    _log(f"{label}: {len(replies)} episodes bit-identical to rollout")
+
+
+def _flood_worker(port, seed, sleeps, lock):
+    with ServeClient("127.0.0.1", port, timeout=120.0) as c:
+        def sleep(s):
+            with lock:
+                sleeps.append(s)
+            time.sleep(s)
+
+        req = dict(policy="honest")
+        if seed is not None:
+            req["seed"] = seed
+        r = c.call_with_retry("episode.run", max_attempts=10,
+                              sleep=sleep, **req)
+        assert r.get("ok"), f"episode.run(seed={seed}): {r}"
+        return r
+
+
+def _flood(port):
+    """The chaos window: concurrent seeded load that both triggers the
+    armed kill@replica=1 (first burst under load) and overloads the
+    surviving capacity into in-band sheds."""
+    sleeps, lock = [], threading.Lock()
+    seeds = [SEED0 + i for i in range(N_SEEDED)] + [None] * N_SEEDLESS
+    with ThreadPoolExecutor(max_workers=len(seeds)) as pool:
+        jobs = [pool.submit(_flood_worker, port, s, sleeps, lock)
+                for s in seeds]
+        deadline = time.time() + FLOOD_TIMEOUT_S
+        replies = [j.result(timeout=max(1.0, deadline - time.time()))
+                   for j in jobs]  # a timeout here IS a client hang
+    for want, r in zip(seeds, replies):
+        if want is not None and r["seed"] != want:
+            raise SystemExit(f"seeded run came back as {r['seed']}")
+    stamped = [r["seed"] for w, r in zip(seeds, replies) if w is None]
+    if len(stamped) != N_SEEDLESS or \
+            any(s < ROUTER_SEED_BASE for s in stamped):
+        raise SystemExit(f"router did not stamp seedless runs from its "
+                         f"own range: {stamped}")
+    return replies, sleeps
+
+
+def _post_restart_flood(port, sleeps):
+    """The rejoin must be proven by served work, not just by
+    state == "up": concurrent rounds of 8 clients until replica 1's
+    own report shows episodes (least-loaded routing spills onto it
+    once replica 0's lanes fill), which also makes its drain report
+    bank a non-degenerate throughput row."""
+    lock = threading.Lock()
+    replies = []
+    for round_ in range(5):
+        base = 9500 + 8 * round_
+        with ThreadPoolExecutor(max_workers=8) as pool:
+            jobs = [pool.submit(_flood_worker, port, base + i,
+                                sleeps, lock) for i in range(8)]
+            deadline = time.time() + FLOOD_TIMEOUT_S
+            replies += [j.result(timeout=max(1.0, deadline - time.time()))
+                        for j in jobs]
+        rep1 = _stats(port)["replicas"].get("1", {})
+        if (rep1.get("report") or {}).get("episodes"):
+            return replies
+    raise SystemExit("restarted replica 1 served no episodes across 5 "
+                     "post-restart rounds")
+
+
+def _stats(port):
+    with ServeClient("127.0.0.1", port) as c:
+        r = c.request("stats")
+        assert r.get("ok"), r
+        return r
+
+
+def _wait_replica_back(port, timeout_s=READY_TIMEOUT_S):
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        st = _stats(port)
+        state = st["router"]["replica_state"]
+        if all(v == "up" for v in state.values()):
+            return st
+        time.sleep(1.0)
+    raise SystemExit(f"killed replica not back up within {timeout_s:.0f}s: "
+                     f"{_stats(port)['router']}")
+
+
+def _events(path, name, action=None):
+    out = []
+    try:
+        f = open(path)
+    except OSError:
+        return out
+    with f:
+        for line in f:
+            try:
+                e = json.loads(line)
+            except ValueError:
+                continue
+            if e.get("kind") == "event" and e.get("name") == name \
+                    and (action is None or e.get("action") == action):
+                out.append(e)
+    return out
+
+
+def _check_route_trail(router_trace, stats):
+    downs = _events(router_trace, "route", "replica_down")
+    if not any(e.get("replica") == 1 for e in downs):
+        raise SystemExit(f"no replica_down for replica 1: {downs}")
+    requeues = _events(router_trace, "route", "requeue")
+    if not requeues:
+        raise SystemExit("router trace has no requeue events — the "
+                         "kill produced no failover")
+    ups = _events(router_trace, "route", "replica_up")
+    if len(ups) < REPLICAS + 1:
+        raise SystemExit(f"expected >= {REPLICAS + 1} replica_up "
+                         f"(initial fleet + warm restart), got {len(ups)}")
+    for want in ("drain", "stop"):
+        if not _events(router_trace, "route", want):
+            raise SystemExit(f"no route '{want}' event in router trace")
+    r = stats["router"]
+    if r["requeued"] < 1 or r["restarts"].get("1", 0) < 1:
+        raise SystemExit(f"router stats missing the failover accounting: "
+                         f"{r}")
+    if r["requeued"] != len(requeues):
+        raise SystemExit(f"stats requeued={r['requeued']} but the route "
+                         f"trail has {len(requeues)} requeue events")
+    return len(requeues)
+
+
+def _check_sheds(replica_traces, stats, sleeps):
+    adm = [e for p in replica_traces for e in _events(p, "admission")]
+    if not adm:
+        raise SystemExit("no admission events: the overload produced "
+                         "no sheds (capacity too large for the flood?)")
+    bad = [e for e in adm if not (isinstance(e.get("retry_after_s"),
+                                             (int, float))
+                                  and e["retry_after_s"] > 0)]
+    if bad:
+        raise SystemExit(f"admission events without a positive "
+                         f"retry_after_s: {bad[:3]}")
+    per = stats["replicas"]
+    stat_sheds = sum(v.get("sheds", 0) for v in per.values()
+                     if v.get("state") == "up")
+    if stat_sheds < 1:
+        raise SystemExit(f"stats report no sheds: {per}")
+    if not sleeps:
+        raise SystemExit("clients absorbed sheds without a single "
+                         "backoff sleep — retry_after was not honored")
+    return len(adm)
+
+
+def _check_reports(replica_traces):
+    """At least one drain report must carry the per-class tail and a
+    nonzero shed rate (the overloaded survivor's report)."""
+    details = []
+    for p in replica_traces:
+        for e in _events(p, "serve", "report"):
+            d = e.get("detail")
+            if isinstance(d, dict):
+                details.append(d)
+    if not details:
+        raise SystemExit("no drain reports in the replica traces")
+    classy = [d for d in details
+              if isinstance(d.get("class_p99_s"), dict)
+              and d["class_p99_s"].get("normal", 0) > 0]
+    if not classy:
+        raise SystemExit(f"no report carries class_p99_s['normal']: "
+                         f"{[sorted(d) for d in details]}")
+    if not any(d.get("shed_rate", 0) > 0 for d in details):
+        raise SystemExit("no report carries a nonzero shed_rate")
+    return details
+
+
+def _merge_streams(workdir, paths):
+    from cpr_tpu import resilience
+
+    parts = []
+    for p in paths:
+        try:
+            with open(p) as f:
+                parts.append(f.read())
+        except OSError:
+            pass
+    merged = os.path.join(workdir, "merged.jsonl")
+    resilience.atomic_write_text(merged, "".join(parts))
+    return merged
+
+
+def _validate_stream(trace):
+    tool = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "trace_summary.py")
+    r = subprocess.run(
+        [sys.executable, tool, trace, "--validate",
+         "--expect", "admission,route,serve,request"],
+        capture_output=True, text=True)
+    if r.returncode != 0:
+        sys.stderr.write(r.stdout + r.stderr)
+        raise SystemExit(f"telemetry validation failed for {trace}")
+
+
+def _check_stitch(streams):
+    """trace_stitch across client + router + replica streams must pair
+    at least one request on all three sides — i.e. with the router-hop
+    `route` leg in its breakdown."""
+    tools_dir = os.path.dirname(os.path.abspath(__file__))
+    if tools_dir not in sys.path:
+        sys.path.insert(0, tools_dir)
+    import trace_stitch
+
+    st = trace_stitch.stitch(streams)
+    routed = [t for t in st["traces"]
+              if t.get("orphan") is None
+              and t["breakdown"].get("route_s") is not None]
+    if not routed:
+        raise SystemExit("trace_stitch found no request with a router "
+                         "hop across the captured streams")
+    return len(routed), len(st["traces"])
+
+
+# every drain report must land these rows; per-class p99 rows ride on
+# the same serve_p99_s metric with a cfg_class fingerprint
+_REQUIRED_METRICS = ("serve_steps_per_sec", "serve_p99_s",
+                     "serve_shed_rate")
+
+
+def _bank_and_gate(workdir, replica_traces):
+    ledger = Ledger(os.path.join(workdir, "perf_ledger.jsonl"))
+    n = sum(ledger.ingest_trace(p) for p in replica_traces)
+    records = ledger.records()
+    results = []
+    for metric in _REQUIRED_METRICS:
+        rows = [r for r in records if r.get("metric") == metric]
+        if not rows:
+            raise SystemExit(f"no {metric} row reached the ledger")
+        results.extend(gate_row(r, records) for r in rows)
+    per_class = [r for r in records if r.get("metric") == "serve_p99_s"
+                 and r.get("config", {}).get("cfg_class")]
+    if not per_class:
+        raise SystemExit("no per-class serve_p99_s row (cfg_class) "
+                         "reached the ledger")
+    summary = gate_summary(results)
+    if not summary["ok"]:
+        raise SystemExit(f"fleet perf gate failed: {results}")
+    return n, len(per_class), summary
+
+
+def main():
+    work = sys.argv[1] if len(sys.argv) > 1 else "/tmp/cpr-fleet-smoke"
+    os.makedirs(work, exist_ok=True)
+    router_trace = os.path.join(work, "router.jsonl")
+    replica_traces = [os.path.join(work, f"router.replica{i}.jsonl")
+                      for i in range(REPLICAS)]
+    client_trace = os.path.join(work, "client.jsonl")
+    for p in [router_trace, client_trace, *replica_traces]:
+        if os.path.exists(p):
+            os.remove(p)
+    telemetry.configure(client_trace)
+    telemetry.current().manifest(dict(role="fleet-smoke-client"))
+
+    log_path = os.path.join(work, "router.log")
+    # jaxlint: disable-next-line=raw-write — live Popen log handle
+    with open(log_path, "w") as log:
+        proc = subprocess.Popen(
+            _router_cmd(work), env=_router_env(work, router_trace),
+            cwd=ROOT, stdout=log, stderr=subprocess.STDOUT)
+    try:
+        ready = _wait_ready(os.path.join(work, "router.json"), proc,
+                            log_path)
+        port = ready["port"]
+        _log(f"router ready on port {port} with {ready['replicas']} "
+             f"replicas (kill@replica=1 armed)")
+
+        replies, sleeps = _flood(port)
+        _log(f"flood: {len(replies)} concurrent episode.run all "
+             f"answered (no hangs), {len(sleeps)} retry backoffs")
+        _check_episodes(replies, "flood")
+
+        stats = _wait_replica_back(port)
+        _log(f"killed replica warm-restarted and rejoined: "
+             f"{stats['router']['replica_state']}")
+
+        post = _post_restart_flood(port, sleeps)
+        _check_episodes(post, "post-restart")
+        stats = _stats(port)
+
+        with ServeClient("127.0.0.1", port) as c:
+            r = c.request("drain")
+            assert r.get("ok") and r.get("draining"), r
+        rc = proc.wait(timeout=300.0)
+        if rc != 0:
+            tail = open(log_path).read()[-4000:]
+            raise SystemExit(f"router exited rc={rc} after drain\n{tail}")
+        _log("drain: router and both replicas exited cleanly")
+    except BaseException:
+        if proc.poll() is None:
+            proc.kill()
+        raise
+
+    n_requeues = _check_route_trail(router_trace, stats)
+    n_sheds = _check_sheds(replica_traces, stats, sleeps)
+    _log(f"failover accounting: {n_requeues} requeues, {n_sheds} "
+         f"in-band sheds (router stats {stats['router']})")
+    _check_reports(replica_traces)
+    telemetry.configure(None)  # close the client sink before reading
+    merged = _merge_streams(
+        work, [router_trace, *replica_traces, client_trace])
+    _validate_stream(merged)
+    paired, total = _check_stitch(
+        [router_trace, *replica_traces, client_trace])
+    _log(f"trace_stitch: {paired}/{total} traces carry the router hop")
+    n_rows, n_class, summary = _bank_and_gate(work, replica_traces)
+    print(f"fleet-smoke: PASS ({N_SEEDED + N_SEEDLESS + len(post)} "
+          f"bit-identical episodes through a replica kill; {n_rows} "
+          f"ledger rows banked incl. {n_class} per-class serve_p99_s; "
+          f"gate {summary})")
+
+
+if __name__ == "__main__":
+    main()

@@ -12,16 +12,26 @@ merges the streams, pairs each trace's server and client sides, and
 prints a per-request critical-path breakdown built from durations
 only:
 
+    route   router total_s minus server total_s — the fleet hop
+            (forwarding + replica queue pickup; absent without a
+            router stream, i.e. every single-process trace)
     queue   server queue_wait_s minus the admission splice
     splice  device-program admission splice (server splice_s)
     burst   server-side service time (device bursts / ticks)
-    reply   client total_s minus server total_s — wire + framing +
+    reply   client total_s minus server total_s (minus the route hop
+            when a router sat between them) — wire + framing +
             asyncio handoff (needs both sides; "-" on orphans)
+
+Role "router" events (schema v9, cpr_tpu/serve/router.py) are an
+optional third side: traces with one gain the route segment, traces
+without one keep the exact two-sided breakdown, so the tool works
+unchanged on single-process serve runs.
 
 A trace seen on only one side is an *orphan* — expected for streams
 captured mid-run (a client stream without the server's, a request
 completed after the server stream was cut) — and is kept, marked, and
-tallied rather than dropped.
+tallied rather than dropped.  A router-only trace counts as orphaned
+too (no server side to split against).
 
 Usage: python tools/trace_stitch.py server.jsonl client.jsonl ...
            [--op PREFIX] [--limit N] [--json]
@@ -71,23 +81,34 @@ def _num(v):
     return float(v) if isinstance(v, (int, float)) else None
 
 
-def _breakdown(server: dict | None, client: dict | None) -> dict:
+def _breakdown(server: dict | None, client: dict | None,
+               router: dict | None = None) -> dict:
     """Durations-only critical path of one request.  Every component
-    is None when the side that measures it is missing."""
+    is None when the side that measures it is missing; the route hop
+    exists only when a router stream was stitched in."""
     s_total = _num(server.get("total_s")) if server else None
     c_total = _num(client.get("total_s")) if client else None
-    queue = splice = burst = reply = None
+    r_total = _num(router.get("total_s")) if router else None
+    queue = splice = burst = reply = route = None
     if server:
         wait = _num(server.get("queue_wait_s"))
         splice = _num(server.get("splice_s"))
         burst = _num(server.get("service_s"))
         if wait is not None:
             queue = max(0.0, wait - (splice or 0.0))
-    if s_total is not None and c_total is not None:
-        reply = max(0.0, c_total - s_total)
-    return {"queue_s": queue, "splice_s": splice, "burst_s": burst,
-            "reply_s": reply,
-            "total_s": c_total if c_total is not None else s_total}
+    if r_total is not None and s_total is not None:
+        route = max(0.0, r_total - s_total)
+    if c_total is not None:
+        # the reply leg is the client wall past the furthest-upstream
+        # total we have: router if present, else the server's
+        upstream = r_total if r_total is not None else s_total
+        if upstream is not None:
+            reply = max(0.0, c_total - upstream)
+    return {"route_s": route, "queue_s": queue, "splice_s": splice,
+            "burst_s": burst, "reply_s": reply,
+            "total_s": (c_total if c_total is not None
+                        else r_total if r_total is not None
+                        else s_total)}
 
 
 def stitch(paths) -> dict:
@@ -110,10 +131,12 @@ def stitch(paths) -> dict:
             if t is None:
                 t = by_id[tid] = {"trace_id": tid, "run": None,
                                   "op": None, "status": None,
-                                  "server": None, "client": None}
+                                  "server": None, "client": None,
+                                  "router": None}
                 order.append(tid)
             role = str(e.get("role") or "unknown")
-            side = "server" if role == "server" else "client"
+            side = ("server" if role == "server"
+                    else "router" if role == "router" else "client")
             if t[side] is None:  # duplicate events keep the first
                 t[side] = e
             if t["run"] is None and e.get("run"):
@@ -131,7 +154,7 @@ def stitch(paths) -> dict:
         t = by_id[tid]
         orphan = (None if t["server"] and t["client"]
                   else "no-server" if t["client"] else "no-client")
-        bd = _breakdown(t["server"], t["client"])
+        bd = _breakdown(t["server"], t["client"], t["router"])
         traces.append(dict(t, orphan=orphan, breakdown=bd))
         a = ops[t["op"] or "?"]
         a["n"] += 1
@@ -178,6 +201,8 @@ def render(st: dict, out=sys.stdout, limit: int | None = None):
             f"lane={lane}" if lane is not None else "") if p)
         if ctx:
             print(f"  {ctx}", file=out)
+        if bd.get("route_s") is not None:
+            print(f"  route   {_fmt_s(bd['route_s'])}", file=out)
         print(f"  queue   {_fmt_s(bd['queue_s'])}", file=out)
         print(f"  splice  {_fmt_s(bd['splice_s'])}", file=out)
         print(f"  burst   {_fmt_s(bd['burst_s'])}", file=out)

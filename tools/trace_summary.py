@@ -119,6 +119,8 @@ def summarize(events, out=sys.stdout):
     _resilience_lines(events, out)
     _supervisor_lines(events, out)
     _serve_lines(events, out)
+    _admission_lines(events, out)
+    _route_lines(events, out)
     _request_lines(events, out)
     _perf_gate_lines(events, out)
     for m in (e for e in events if e.get("kind") == "manifest"):
@@ -129,7 +131,7 @@ def summarize(events, out=sys.stdout):
               f"config={json.dumps(cfg, sort_keys=True)}", file=out)
     tabled = ("compile", "device_metrics", "vi_residuals", "retry",
               "checkpoint", "perf_gate", "supervisor", "serve",
-              "request")
+              "request", "admission", "route")
     for e in (e for e in events if e.get("kind") == "event"
               and e.get("name") not in tabled):
         keys = {k: v for k, v in e.items() if k not in ("kind", "ts")}
@@ -258,6 +260,50 @@ def _serve_lines(events, out):
               f"steps_per_sec={sps_txt} occupancy={occ_txt} "
               f"lanes={d.get('n_lanes')} burst={d.get('burst')}",
               file=out)
+
+
+def _admission_lines(events, out):
+    """Schema-v9 admission-control refusals (cpr_tpu/serve): one line
+    per shed reason x op x priority with the retry_after hint range —
+    whether a loaded session shed from queue pressure or SLO breach
+    (and how long it told clients to back off) reads off one block."""
+    evs = [e for e in events if e.get("kind") == "event"
+           and e.get("name") == "admission"]
+    if not evs:
+        return
+    agg = defaultdict(lambda: [0, 0.0, 0.0])  # [n, sum_ra, max_ra]
+    for e in evs:
+        key = (str(e.get("reason")), str(e.get("op")),
+               str(e.get("priority")))
+        a = agg[key]
+        a[0] += 1
+        ra = e.get("retry_after_s")
+        if isinstance(ra, (int, float)):
+            a[1] += ra
+            a[2] = max(a[2], ra)
+    print(f"\n{'shed reason':<16} {'op':<16} {'prio':<5} {'n':>6} "
+          f"{'mean_retry_s':>13} {'max_retry_s':>12}", file=out)
+    for (reason, op, prio), (n, tot, mx) in sorted(agg.items()):
+        mean_txt = f"{tot / n:.2f}" if n else "-"
+        print(f"{reason:<16} {op:<16} {prio:<5} {n:>6} "
+              f"{mean_txt:>13} {mx:>12.2f}", file=out)
+
+
+def _route_lines(events, out):
+    """Schema-v9 fleet routing decisions (cpr_tpu/serve/router): a
+    per-action x replica tally — how traffic spread over the fleet and
+    how many sessions were requeued (failover) or refused after a
+    replica loss summarizes without replaying the stream."""
+    evs = [e for e in events if e.get("kind") == "event"
+           and e.get("name") == "route"]
+    if not evs:
+        return
+    agg = defaultdict(int)
+    for e in evs:
+        agg[(str(e.get("action")), str(e.get("replica")))] += 1
+    print(f"\n{'route action':<14} {'replica':<8} {'n':>6}", file=out)
+    for (action, replica), n in sorted(agg.items()):
+        print(f"{action:<14} {replica:<8} {n:>6}", file=out)
 
 
 def _request_lines(events, out):
