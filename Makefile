@@ -4,7 +4,7 @@
 
 CPU_MESH = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: lint test test-slow bench perf-gate telemetry-smoke netsim-smoke resilience-smoke supervisor-smoke serve-smoke fleet-smoke dryrun sweeps ghostdag train-dummy native asan
+.PHONY: lint test test-slow bench perf-gate telemetry-smoke netsim-smoke resilience-smoke supervisor-smoke serve-smoke fleet-smoke multichip-smoke dryrun sweeps ghostdag train-dummy native asan
 
 lint:  ## jaxlint over cpr_tpu/ + tools/ (pure AST, no JAX import,
 	## ~1s); banks the JSON report under runs/ like the smoke flows
@@ -109,6 +109,18 @@ fleet-smoke:  ## fleet-resilience chaos proof: router + 2 replicas,
 	## Details: docs/SERVING.md
 	rm -rf $(FLEET_SMOKE_DIR)
 	python tools/fleet_smoke.py $(FLEET_SMOKE_DIR)
+
+MULTICHIP_SMOKE_DIR = /tmp/cpr-multichip-smoke
+
+multichip-smoke:  ## sharded hot-loop proof on a forced 4-device CPU
+	## mesh: supervised serve runs at --devices 1 and 4 with the same
+	## seeded flood, sharded rollout + netsim children, every output
+	## asserted bit-identical across device counts, traces validated
+	## (`--expect serve,device_metrics`), and per-device-count
+	## serve_steps_per_sec rows banked + gated with the perf_report
+	## scaling table.  Details: docs/SCALING.md
+	rm -rf $(MULTICHIP_SMOKE_DIR)
+	python tools/multichip_smoke.py $(MULTICHIP_SMOKE_DIR)
 
 dryrun:  ## multi-chip sharding dry run on the virtual CPU mesh
 	$(CPU_MESH) python -c "from __graft_entry__ import dryrun_multichip; dryrun_multichip(8)"

@@ -86,6 +86,34 @@ def _roofline_utilization(row: dict, rate: float):
             "bound": bound}
 
 
+def _bench_devices() -> int:
+    """CPR_BENCH_DEVICES: how many devices the hot loops span (1 =
+    single-device, the default).  Rows stamp the value as `n_devices`
+    so ledger-v4 fingerprints separate device counts."""
+    return max(1, int(os.environ.get("CPR_BENCH_DEVICES", "1") or 1))
+
+
+def _bench_mesh(axis: str = "d"):
+    """The 1-D mesh the measured loops shard over when
+    CPR_BENCH_DEVICES > 1 (first N visible devices; docs/SCALING.md),
+    else None.  Asking for more devices than the host exposes is a
+    deterministic config error — GuardFailure, so the supervisor
+    neither retries nor papers over it with a CPU run."""
+    n = _bench_devices()
+    if n <= 1:
+        return None
+    import jax
+
+    from cpr_tpu.parallel import default_mesh
+
+    devs = jax.devices()
+    if len(devs) < n:
+        raise GuardFailure(
+            f"CPR_BENCH_DEVICES={n} but only {len(devs)} device(s) "
+            f"visible to JAX")
+    return default_mesh(axis, devices=devs[:n])
+
+
 def _measure_episodes(env, policy_name: str, n_envs: int, n_steps: int,
                       reps: int, max_steps: int, chunk: int | None = None,
                       label: str = "episodes"):
@@ -109,8 +137,12 @@ def _measure_episodes(env, policy_name: str, n_envs: int, n_steps: int,
     policy = env.policies[policy_name]
     keys = jax.random.split(jax.random.PRNGKey(0), n_envs)
     collect = device_metrics.enabled()
+    # CPR_BENCH_DEVICES > 1: the episode batch shards over the mesh
+    # (same driver, GSPMD-partitioned); the row's n_devices stamp keeps
+    # the banked rate in its own per-device-count fingerprint
     fn = env.make_episode_stats_fn(params, policy, n_steps, chunk=chunk,
-                                   collect_metrics=collect)
+                                   collect_metrics=collect,
+                                   mesh=_bench_mesh())
     spec = getattr(fn, "metrics_spec", None)
     # compile_watch emits one schema-v2 `compile` event per traced
     # program (fn name, arg shapes, trace/compile seconds) — so the
@@ -143,7 +175,8 @@ def _measure_episodes(env, policy_name: str, n_envs: int, n_steps: int,
         return jax.vmap(lambda kk: env.episode_stats(
             kk, params, policy, steps_ana))(k)
 
-    extras = _roofline(ana, (keys,), n_envs * steps_ana)
+    extras = dict(_roofline(ana, (keys,), n_envs * steps_ana),
+                  n_devices=_bench_devices())
     return n_envs * n_steps / dt, atk / (atk + dfn), extras
 
 
@@ -247,6 +280,14 @@ def measure_tailstorm_ppo(n_envs: int, rollout_len: int = 128,
     init_fn, train_step = make_train(env, params, cfg)
     tele = telemetry.current()
     carry = jax.jit(init_fn)(jax.random.PRNGKey(0))
+    mesh = _bench_mesh("dp")
+    if mesh is not None:
+        # data-parallel sampling: env batch sharded over "dp" exactly
+        # like train(mesh=...) does it (cpr_tpu/train/ppo.py)
+        from cpr_tpu.parallel import shard_envs
+        ts, env_state, obs, key = carry
+        carry = (ts, shard_envs(mesh, env_state, "dp"),
+                 shard_envs(mesh, obs, "dp"), key)
     step = jax.jit(train_step)
     with telemetry.compile_watch(), tele.span("compile") as sp:
         carry, _ = step(carry)  # compile + warm
@@ -264,7 +305,8 @@ def measure_tailstorm_ppo(n_envs: int, rollout_len: int = 128,
     dt = sp.dur_s / reps
     ent = float(np.asarray(metrics["entropy"]))
     extras = _roofline(train_step, (carry,), n_envs * rollout_len)
-    return n_envs * rollout_len / dt, ent, dict(extras, window=window or 0)
+    return n_envs * rollout_len / dt, ent, dict(
+        extras, window=window or 0, n_devices=_bench_devices())
 
 
 def measure_netsim(n_envs: int, n_activations: int = 10_000,
@@ -285,7 +327,7 @@ def measure_netsim(n_envs: int, n_activations: int = 10_000,
     net = symmetric_clique(10, activation_delay=30.0,
                            propagation_delay=1.0)
     eng = netsim.Engine(net, protocol="nakamoto",
-                        activations=n_activations)
+                        activations=n_activations, mesh=_bench_mesh())
     seeds = list(range(n_envs))
     delays = [30.0] * n_envs
     t0 = now()
@@ -305,7 +347,7 @@ def measure_netsim(n_envs: int, n_activations: int = 10_000,
     return n_envs * n_activations / best, orphan, dict(
         lanes=n_envs, activations_per_lane=n_activations,
         compile_and_first_run_s=round(first_s, 3),
-        best_rep_s=round(best, 4))
+        best_rep_s=round(best, 4), n_devices=_bench_devices())
 
 
 # correctness guard bounds: SM1 revenue near the ES'14 closed form

@@ -24,6 +24,10 @@ HOT_CARRY_PATHS = (
     "cpr_tpu/netsim/engine.py",
     "cpr_tpu/serve/engine.py",
 )
+# ...and every module under parallel/ — notably the sharded resident
+# lane stepper (parallel/lanes.py): its mesh-sharded carries are
+# n_devices times the single-device footprint, so an undonated carry
+# there wastes memory on every chip at once
 HOT_CARRY_PREFIXES = ("cpr_tpu/parallel/",)
 
 _LOOPS = (ast.For, ast.AsyncFor, ast.While)

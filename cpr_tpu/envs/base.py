@@ -43,6 +43,29 @@ def _lane_where(mask, a, b):
     return jnp.where(m, a, b)
 
 
+def _mesh_wrap(fn, mesh, axis: str):
+    """Mesh entry seam for the episode-stats drivers: commit the keys
+    to the lane sharding (refusing uneven batches with both values
+    named) and let GSPMD partition the built driver.  Sharded inputs
+    keep their placement through the chunked host loop, so wrapping
+    the entry is enough for every driver shape."""
+    if mesh is None:
+        return fn
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from cpr_tpu.parallel.lanes import check_even_shards
+    sharding = NamedSharding(mesh, PartitionSpec(axis))
+
+    def sharded(keys):
+        check_even_shards(keys.shape[0], mesh, axis=axis,
+                          what="episode streams")
+        return fn(jax.device_put(keys, sharding))
+
+    if hasattr(fn, "metrics_spec"):
+        sharded.metrics_spec = fn.metrics_spec
+    return sharded
+
+
 class JaxEnv:
     """Abstract jittable environment.
 
@@ -318,7 +341,8 @@ class JaxEnv:
 
     def make_episode_stats_fn(self, params: EnvParams, policy: Callable,
                               n_steps: int, chunk: int | None = None,
-                              collect_metrics: bool = False):
+                              collect_metrics: bool = False,
+                              mesh=None, mesh_axis: str = "d"):
         """Build `fn(keys) -> per-env stats dict` — the batched twin of
         `episode_stats`, optionally split into multiple device calls of
         `chunk` env steps each.
@@ -351,6 +375,16 @@ class JaxEnv:
 
         The jitted pieces are built once here, so calling the returned
         fn repeatedly (bench reps) does not re-trace.
+
+        `mesh` shards the episode batch over the given 1-D mesh axis
+        (`mesh_axis`): keys are committed to
+        `NamedSharding(mesh, P(mesh_axis))` at entry and GSPMD
+        partitions the whole driver — the chunked host loop carries
+        sharded buffers between per-chunk calls, so every shape
+        (chunked, unchunked, metrics on/off) stays mesh-partitioned
+        end to end.  The batch must divide the mesh axis
+        (parallel.check_even_shards).  docs/SCALING.md covers the
+        contract.
         """
         if chunk is not None and chunk <= 0:
             raise ValueError(f"chunk must be positive, got {chunk}")
@@ -375,9 +409,10 @@ class JaxEnv:
 
         if chunk is None or chunk >= n_steps:
             if spec is None:
-                return jax.jit(jax.vmap(
+                return _mesh_wrap(jax.jit(jax.vmap(
                     lambda k: self.episode_stats(k, params, policy,
-                                                 n_steps)))
+                                                 n_steps))),
+                    mesh, mesh_axis)
 
             def one(k):
                 (_, obs_last), traj = jax.lax.scan(
@@ -414,7 +449,7 @@ class JaxEnv:
                 return run(keys)
 
             fn.metrics_spec = spec
-            return fn
+            return _mesh_wrap(fn, mesh, mesh_axis)
 
         n_full, rem = divmod(n_steps, chunk)
         lengths = (chunk,) * n_full + ((rem,) if rem else ())
@@ -422,8 +457,9 @@ class JaxEnv:
                     if k.startswith("episode_")}
 
         if spec is not None:
-            return self._make_chunked_metrics_fn(
-                params, policy, lengths, spec, acc_spec, stat_keys)
+            return _mesh_wrap(self._make_chunked_metrics_fn(
+                params, policy, lengths, spec, acc_spec, stat_keys),
+                mesh, mesh_axis)
 
         @jax.jit
         def init(keys):
@@ -467,7 +503,7 @@ class JaxEnv:
             stats["n_episodes"] = n_done
             return stats
 
-        return fn
+        return _mesh_wrap(fn, mesh, mesh_axis)
 
     def _make_chunked_metrics_fn(self, params, policy, lengths, spec,
                                  acc_spec, stat_keys):

@@ -716,7 +716,8 @@ class Engine:
                  queue_cap: int | None = None, pend_cap: int = 8,
                  window: int | None = None,
                  max_steps: int | None = None, x64: bool = True,
-                 mode: str = "auto", lookback: int = 32):
+                 mode: str = "auto", lookback: int = 32,
+                 mesh=None, mesh_axis: str = "d"):
         if protocol not in SUPPORTED_PROTOCOLS:
             raise ValueError(
                 f"netsim supports protocols {SUPPORTED_PROTOCOLS}, "
@@ -756,6 +757,14 @@ class Engine:
         self.mode = "scan" if (mode == "auto" and scan_ok) or \
             mode == "scan" else "event"
         self.lookback = int(lookback)
+        # mesh: shard the vmapped lane batch over a 1-D device mesh
+        # (keys/delays/outputs all lane-major, so one NamedSharding
+        # prefix partitions the whole program; lane counts must divide
+        # the axis — docs/SCALING.md)
+        self.mesh = mesh
+        self.mesh_axis = mesh_axis
+        self.n_devices = (int(mesh.shape[mesh_axis])
+                          if mesh is not None else 1)
         self._exe = {}          # lane count -> compiled executable
 
     def _ctx(self):
@@ -778,11 +787,22 @@ class Engine:
                 fn = _lane_fn(self.net, self.protocol, self.k,
                               self.scheme, self.activations, self.B,
                               self.M, self.F, self.W, self.S)
+            jitted = jax.jit(jax.vmap(fn))
+            if self.mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec
+
+                from cpr_tpu.parallel.lanes import check_even_shards
+                check_even_shards(L, self.mesh, axis=self.mesh_axis,
+                                  what="netsim lanes")
+                lane = NamedSharding(self.mesh,
+                                     PartitionSpec(self.mesh_axis))
+                jitted = jax.jit(jax.vmap(fn),
+                                 in_shardings=(lane, lane),
+                                 out_shardings=lane)
             tele = telemetry.current()
             with telemetry.compile_watch(), \
                     tele.span("netsim:compile", lanes=L):
-                exe = jax.jit(jax.vmap(fn)).lower(
-                    keys, delays).compile()
+                exe = jitted.lower(keys, delays).compile()
             self._exe[L] = exe
         return exe
 
@@ -803,6 +823,21 @@ class Engine:
                 [jax.random.PRNGKey(s) for s in seeds])
             dl = jnp.asarray(delays,
                              jnp.float64 if self.x64 else jnp.float32)
+            if self.mesh is not None:
+                # commit inputs to the compiled program's lane
+                # sharding (an AOT executable does not auto-place
+                # uncommitted host arrays the way jit does); refuse
+                # uneven batches BEFORE device_put, with both values
+                # named, instead of XLA's opaque sharding error
+                from jax.sharding import NamedSharding, PartitionSpec
+
+                from cpr_tpu.parallel.lanes import check_even_shards
+                check_even_shards(L, self.mesh, axis=self.mesh_axis,
+                                  what="netsim lanes")
+                lane = NamedSharding(self.mesh,
+                                     PartitionSpec(self.mesh_axis))
+                keys = jax.device_put(keys, lane)
+                dl = jax.device_put(dl, lane)
             exe = self._compiled(keys, dl)
             with tele.span("netsim:run", lanes=L,
                            activations=L * self.activations) as sp:

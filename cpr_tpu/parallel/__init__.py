@@ -10,14 +10,20 @@ Mesh` with XLA collectives over ICI (intra-slice) / DCN (across slices):
 
 - env-batch data parallelism: `vmap` over episodes (free, no mesh),
 - device data parallelism: episode batches sharded over the mesh
-  (`shard_envs`),
+  (`shard_envs`), and the RESIDENT lane block of the serving layer
+  sharded the same way (`make_sharded_lane_fns` — lanes.py: the
+  init/reset/step lane programs with NamedSharding'd, donated
+  carries),
 - solver parallelism: value-iteration sweeps with transitions sharded
   over devices and `psum`-reduced Bellman backups
   (`sharded_value_iteration`) — the analog of model/tensor parallelism
   for the MDP workload.
 
 The same code runs on a virtual CPU mesh (tests, CI) and on real TPU
-slices; the mesh is the only seam.
+slices; the mesh is the only seam.  Batch sizes must divide the mesh
+axis — `check_even_shards` raises a ValueError naming both values
+instead of XLA's opaque sharding error.  docs/SCALING.md walks the
+whole story (contract, CI, blessing a scaling row).
 """
 
 from __future__ import annotations
@@ -33,6 +39,8 @@ from cpr_tpu.mdp.explicit import (TensorMDP, _valid_actions,
                                   make_vi_chunk, resolve_vi_impl,
                                   ring_residuals, run_chunk_driver,
                                   vi_residuals_event, vi_while_loop)
+from cpr_tpu.parallel.lanes import (ShardedLaneFns, check_even_shards,
+                                    make_sharded_lane_fns)
 from cpr_tpu.telemetry import now
 
 
@@ -54,6 +62,9 @@ __all__ = [
     "sharded_value_iteration",
     "make_sharded_rollout_fn",
     "sharded_rollout",
+    "make_sharded_lane_fns",
+    "ShardedLaneFns",
+    "check_even_shards",
 ]
 
 
@@ -65,7 +76,15 @@ def default_mesh(axis: str = "d", devices=None) -> Mesh:
 
 def shard_envs(mesh: Mesh, tree, axis: str = "d"):
     """Place a batched env state/keys PyTree with the batch dimension
-    sharded over the mesh (device data parallelism for episode batches)."""
+    sharded over the mesh (device data parallelism for episode
+    batches).  The batch must divide the mesh axis — refused up front
+    with both values named (check_even_shards) instead of surfacing
+    XLA's opaque uneven-sharding error downstream."""
+    leaves = jax.tree.leaves(tree)
+    batched = [x for x in leaves if getattr(x, "ndim", 0) >= 1]
+    if batched:
+        check_even_shards(batched[0].shape[0], mesh, axis=axis,
+                          what="batched envs")
     sharding = NamedSharding(mesh, P(axis))
     return jax.device_put(tree, sharding)
 
@@ -90,22 +109,16 @@ def make_sharded_rollout_fn(env, mesh: Mesh, params, policy,
     accumulator through the sharded rollout exactly as on one device
     (the env-axis merge is part of the partitioned program, so the
     accumulator cells come back as replicated scalars — still one
-    readback per call)."""
-    stats_fn = env.make_episode_stats_fn(params, policy, n_steps,
-                                         chunk=chunk,
-                                         collect_metrics=collect_metrics)
+    readback per call).
 
-    if collect_metrics:
-        def mfn(keys):
-            return stats_fn(shard_envs(mesh, keys, axis))
-
-        mfn.metrics_spec = stats_fn.metrics_spec
-        return mfn
-
-    def fn(keys):
-        return stats_fn(shard_envs(mesh, keys, axis))
-
-    return fn
+    Delegates to `JaxEnv.make_episode_stats_fn(mesh=...)` — the mesh
+    is a first-class knob of the driver itself, so this wrapper only
+    names the parallel/ entry point; batches that do not divide the
+    mesh axis are refused with both values named."""
+    return env.make_episode_stats_fn(params, policy, n_steps,
+                                     chunk=chunk,
+                                     collect_metrics=collect_metrics,
+                                     mesh=mesh, mesh_axis=axis)
 
 
 def sharded_rollout(env, mesh: Mesh, keys, params, policy, n_steps: int,

@@ -1,0 +1,141 @@
+"""Sharded resident lane stepper: the multi-chip twin of the
+`JaxEnv` lane API (envs/base.py).
+
+`make_sharded_lane_fns(env, mesh)` rebuilds the three resident lane
+entry points — `init_lanes` / `reset_lanes` / `step_lanes` — as jitted
+programs whose lane batch is partitioned over a 1-D mesh axis with
+`NamedSharding`, so one dispatch advances `n_lanes` streams spread
+across every device on the axis.  The wrapped functions are the
+CLASS-jitted originals (via `__wrapped__`), not re-implementations:
+held-lane bit-freezing, mid-flight admission splicing, and the rollout
+stream prologue are the same code, so a lane admitted with seed S
+still replays `rollout(PRNGKey(S))` bit-for-bit — now on whichever
+shard owns it (tests/test_sharded_lanes.py asserts bit-identity
+against the single-device path).
+
+The contract that makes chaining free (the pjit/pod pattern from
+SNIPPETS.md): every fn takes and returns lane-major pytrees under the
+SAME `NamedSharding(mesh, P(axis))`, params stay replicated, and the
+carry is donated with matched in/out specs — so `init -> step -> step`
+never inserts a resharding collective, and the donated carry aliases
+in place on every shard.
+
+Uneven batches are refused up front (`check_even_shards`): XLA's error
+for a non-divisible sharded axis is opaque, and padding would break
+the lane-index <-> session mapping the serving layer relies on.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from functools import partial
+
+__all__ = ["check_even_shards", "make_sharded_lane_fns",
+           "ShardedLaneFns"]
+
+
+def check_even_shards(n: int, mesh: Mesh, *, axis: str = "d",
+                      what: str = "lanes") -> int:
+    """Refuse a batch that does not divide the mesh axis, naming both
+    values — instead of XLA's opaque sharding error.  Returns the
+    device count on the axis."""
+    n_devices = int(mesh.shape[axis])
+    n = int(n)
+    if n_devices < 1:
+        raise ValueError(f"mesh axis '{axis}' has no devices")
+    if n % n_devices:
+        raise ValueError(
+            f"cannot shard {n} {what} evenly over {n_devices} devices "
+            f"(mesh axis '{axis}': {n} % {n_devices} = "
+            f"{n % n_devices}); use a multiple of the device count or "
+            f"a smaller mesh")
+    return n_devices
+
+
+class ShardedLaneFns:
+    """The three resident lane programs of one env, sharded over one
+    mesh axis.  Mirrors the `JaxEnv` lane API call-for-call; build via
+    `make_sharded_lane_fns`.
+
+    Attributes `lane` / `replicated` are the two `NamedSharding`s every
+    argument uses — callers staging their own lane-major programs on
+    top (e.g. the serve burst) reuse them so specs stay matched across
+    chained dispatches."""
+
+    def __init__(self, env, mesh: Mesh, axis: str = "d"):
+        self.env = env
+        self.mesh = mesh
+        self.axis = axis
+        self.n_devices = int(mesh.shape[axis])
+        if self.n_devices < 1:
+            raise ValueError(f"mesh axis '{axis}' has no devices")
+        self.lane = NamedSharding(mesh, P(axis))
+        self.replicated = NamedSharding(mesh, P())
+
+        # the CLASS-jitted originals (static self), unwrapped back to
+        # plain functions so the sharded build is the same code with
+        # different placement — behavior drift is impossible by
+        # construction
+        raw_init = type(env).init_lanes.__wrapped__
+        raw_reset = type(env).reset_lanes.__wrapped__
+        raw_step = type(env).step_lanes.__wrapped__
+
+        # params replicate (scalar leaves); everything lane-major
+        # shards on the leading axis.  Donation needs in-spec ==
+        # out-spec for the carry, which holds: lane in, lane out.
+        self._init = jax.jit(partial(raw_init, env),
+                             in_shardings=(self.lane, self.replicated),
+                             out_shardings=self.lane)
+        self._reset = jax.jit(partial(raw_reset, env),
+                              in_shardings=(self.lane, self.replicated),
+                              out_shardings=self.lane)
+        self._step = jax.jit(
+            partial(raw_step, env), donate_argnums=0,
+            in_shardings=(self.lane, self.lane, self.lane, self.lane,
+                          self.lane, self.replicated),
+            out_shardings=self.lane)
+
+    def _check(self, n: int, what: str) -> None:
+        check_even_shards(n, self.mesh, axis=self.axis, what=what)
+
+    def shard(self, tree):
+        """Commit a lane-major pytree to the lane sharding (committed
+        arrays skip the implicit transfer on the next call)."""
+        return jax.device_put(tree, self.lane)
+
+    def init_lanes(self, keys, params):
+        """Sharded `JaxEnv.init_lanes`: fresh per-lane (state, obs)
+        via the rollout stream prologue, lane axis partitioned."""
+        self._check(keys.shape[0], "lanes")
+        return self._init(keys, params)
+
+    def reset_lanes(self, keys, params):
+        """Sharded `JaxEnv.reset_lanes` (raw vmapped reset)."""
+        self._check(keys.shape[0], "lanes")
+        return self._reset(keys, params)
+
+    def step_lanes(self, carry, actions, admit_mask, fresh_states,
+                   step_mask, params):
+        """Sharded `JaxEnv.step_lanes`; the carry is DONATED and comes
+        back under the same lane sharding (no resharding between
+        chained calls).  Admission/hold semantics are the single-device
+        ones, applied per shard."""
+        self._check(actions.shape[0], "lanes")
+        return self._step(carry, actions, admit_mask, fresh_states,
+                          step_mask, params)
+
+
+def make_sharded_lane_fns(env, mesh: Mesh, *,
+                          axis: str = "d") -> ShardedLaneFns:
+    """Build the sharded resident lane programs for `env` over `mesh`.
+
+        mesh = default_mesh(devices=jax.devices()[:4])
+        lanes = make_sharded_lane_fns(env, mesh)
+        carry = lanes.init_lanes(keys, params)      # lane-sharded
+        carry, out = lanes.step_lanes(carry, ...)   # donated, sharded
+
+    The lane count of every call must divide the mesh axis
+    (`check_even_shards`)."""
+    return ShardedLaneFns(env, mesh, axis)

@@ -5,9 +5,12 @@ gate"):
 
 * The baseline is the **best** same-backend, same-metric banked rows —
   top-k by value, preferring rows with the candidate's exact config
-  fingerprint (falling back to all same-backend rows with
-  `config_drift` flagged, so a batch-size change is still gated but
-  self-describes as not like-for-like).
+  fingerprint (falling back to same-backend rows at the SAME
+  `cfg_devices` with `config_drift` flagged, so a batch-size change is
+  still gated but self-describes as not like-for-like; the fallback
+  never crosses a device-count boundary — a 4-chip rate judged
+  against 1-chip history would re-create exactly the drift ledger v4's
+  cfg_devices fingerprints exist to prevent).
 * Every metric has a **direction** (ledger v3): "higher" is better
   for throughputs (the default), "lower" for latencies
   (`serve_p50_s`/`serve_p99_s`, anything `*_s`).  For "lower" the
@@ -123,6 +126,18 @@ def gate_row(candidate: dict, history, *, top_k: int = TOP_K,
     if same_fp:
         pool = same_fp
     else:
+        # ledger v4: the drift fallback never crosses a device-count
+        # boundary — judging a 4-chip rate against 1-chip history (or
+        # vice versa) is the exact misread cfg_devices exists to
+        # prevent, so an off-count candidate with no same-count
+        # history passes as a first measurement instead
+        devs = lambda r: (r.get("config") or {}).get("cfg_devices", 1)  # noqa: E731
+        pool = [r for r in pool if devs(r) == devs(candidate)]
+        if not pool:
+            result["reason"] = (
+                "no same-device-count baseline banked yet (first "
+                f"measurement at cfg_devices={devs(candidate)})")
+            return result
         result["config_drift"] = True
     lower = direction == "lower"
     # "best" is the top of the trail in the metric's own direction:

@@ -20,11 +20,17 @@ and the drain-time `serve` report events of the serving layer
 serving session's sustained throughput is banked and gated exactly
 like a bench row.
 
-Ledger records (`ledger: 3` — v3 added the `direction` field so
-lower-is-better metrics (latencies: `serve_p50_s`/`serve_p99_s`) gate
-correctly; like the v2 bump (supervisor provenance) it changed every
-row_id, and the ledger file is regenerable scratch, so a pre-v3
-ledger is simply deleted and re-ingested rather than migrated):
+Ledger records (`ledger: 4` — v4 banks the measurement's device span
+as `cfg_devices` in every config fingerprint, so multi-chip rows
+(sharded serve/rollout/netsim lanes, docs/SCALING.md) gate against
+their own per-device-count history instead of drifting against
+single-device baselines.  Backfill-safe: a row with no `n_devices`
+key measured one device and fingerprints as cfg_devices=1.  v3 added
+the `direction` field so lower-is-better metrics (latencies:
+`serve_p50_s`/`serve_p99_s`) gate correctly.  Like the v2 bump
+(supervisor provenance), each version changed every row_id, and the
+ledger file is regenerable scratch, so a pre-v4 ledger is simply
+deleted and re-ingested rather than migrated):
 
     metric, backend, value, unit, check, round, source,
     direction ("higher" | "lower" — which way is better; inferred
@@ -53,7 +59,7 @@ import re
 
 from cpr_tpu.resilience import atomic_write_text
 
-LEDGER_VERSION = 3
+LEDGER_VERSION = 4
 LEDGER_ENV_VAR = "CPR_PERF_LEDGER"
 
 # fallback_reason stamped onto rows whose artifact predates the outage
@@ -109,6 +115,16 @@ def normalize_row(row: dict, *, source: str = "live",
     for k in ("prng", "window"):
         if k in row:
             config[k] = row[k]
+    # v4: the device span is part of the fingerprint — a 4-chip
+    # serve/rollout/netsim rate is a different measurement from the
+    # 1-chip one and must gate against its own history.  Rows banked
+    # before multi-chip lanes carry no n_devices key and measured one
+    # device, so the absent-key default of 1 is exact, not a guess.
+    if "cfg_devices" not in config:
+        nd = row.get("n_devices")
+        config["cfg_devices"] = (int(nd)
+                                 if isinstance(nd, (int, float)) and nd
+                                 else 1)
     man = row.get("manifest") or {}
     direction = row.get("direction")
     if direction not in ("higher", "lower"):
@@ -223,13 +239,22 @@ def iter_trace_rows(path: str):
             elif (e.get("kind") == "event" and e.get("name") == "serve"
                   and e.get("action") == "report"):
                 detail = e.get("detail") or {}
+                # the engine's own device span (report n_devices) is
+                # authoritative for cfg_devices — stamped after the
+                # manifest config spread so it wins over a stale
+                # `devices` config key (ledger v4 fingerprints)
+                nd = detail.get("n_devices")
+                dev_cfg = ({"cfg_devices": int(nd)}
+                           if isinstance(nd, (int, float)) and nd
+                           else {})
                 for key, metric, unit in _SERVE_METRICS:
                     value = detail.get(key)
                     if not isinstance(value, (int, float)):
                         continue
                     yield ({"metric": metric, "backend": backend,
                             "value": value, "unit": unit,
-                            **{f"cfg_{k}": v for k, v in config.items()}},
+                            **{f"cfg_{k}": v for k, v in config.items()},
+                            **dev_cfg},
                            base)
                 # per-priority-class tails: serve_p99_s rows tagged
                 # cfg_class so each class gates against its own
@@ -244,7 +269,8 @@ def iter_trace_rows(path: str):
                                 "unit": "seconds",
                                 "cfg_class": str(cls),
                                 **{f"cfg_{k}": v
-                                   for k, v in config.items()}},
+                                   for k, v in config.items()},
+                                **dev_cfg},
                                base)
                 # admission-control shed rate: lower-is-better but the
                 # name carries no `_s` suffix, so the direction rides
@@ -254,7 +280,8 @@ def iter_trace_rows(path: str):
                     yield ({"metric": "serve_shed_rate",
                             "backend": backend, "value": shed_rate,
                             "unit": "fraction", "direction": "lower",
-                            **{f"cfg_{k}": v for k, v in config.items()}},
+                            **{f"cfg_{k}": v for k, v in config.items()},
+                            **dev_cfg},
                            base)
 
 

@@ -31,6 +31,17 @@ Device-metrics cells (device_metrics.serve_spec) fold once per burst
 INSIDE the jitted program from values the burst already produces —
 never per step — plus one eager `burst_s` fold at drain from the
 host-recorded dispatch walls.
+
+`mesh=` shards the lane block over a 1-D device mesh
+(parallel.make_sharded_lane_fns): both resident programs run with the
+lane axis partitioned under matched NamedSharding in/out specs, the
+burst's metrics cells reduce on-device (GSPMD inserts the psum for
+the cross-shard sums the cells already compute), and `report()` /
+drain reports stamp `n_devices` so the perf ledger banks per-device-
+count rows (cfg_devices fingerprints).  `n_lanes` must divide the
+mesh axis.  Per-lane semantics — admission, holds, seed replay — are
+bit-identical to the single-device path (tests/test_sharded_lanes.py,
+make multichip-smoke).  docs/SCALING.md covers the contract.
 """
 
 from __future__ import annotations
@@ -54,13 +65,26 @@ class ResidentEngine:
     """One resident lane block + policy table over a single JaxEnv."""
 
     def __init__(self, env, params, *, n_lanes: int, burst: int = 256,
-                 extra_policies: dict | None = None):
+                 extra_policies: dict | None = None, mesh=None,
+                 mesh_axis: str = "d"):
         if burst <= 0:
             raise ValueError(f"burst must be positive, got {burst}")
         self.env = env
         self.params = params
         self.n_lanes = int(n_lanes)
         self.burst = int(burst)
+        self.mesh = mesh
+        if mesh is not None:
+            from cpr_tpu.parallel import make_sharded_lane_fns
+            self._lanes = make_sharded_lane_fns(env, mesh,
+                                                axis=mesh_axis)
+            # fail at construction, not at the first dispatch
+            from cpr_tpu.parallel import check_even_shards
+            check_even_shards(self.n_lanes, mesh, axis=mesh_axis)
+            self.n_devices = self._lanes.n_devices
+        else:
+            self._lanes = None
+            self.n_devices = 1
 
         # policy table: the env's scripted policies (observation-only —
         # takes_state policies need the full state and cannot be served)
@@ -175,7 +199,34 @@ class ResidentEngine:
             macc = spec.observe(macc, "occupancy", occ)
             return (inner, macc), regs
 
-        return jax.jit(burst, donate_argnums=0)
+        if self._lanes is None:
+            return jax.jit(burst, donate_argnums=0)
+        # sharded burst: lane-major trees partition on the mesh axis,
+        # the metrics accumulator and occ scalar replicate, and the
+        # in/out carry specs match so the donated carry aliases in
+        # place per shard and chains with the sharded step_lanes
+        # without a resharding collective.  The cross-shard reductions
+        # the cells compute (sum over live lanes, first-done episode
+        # count) come back replicated — GSPMD inserts the psum.
+        lane, rep = self._lanes.lane, self._lanes.replicated
+        carry_sh = (lane, rep) if with_metrics else lane
+        return jax.jit(burst, donate_argnums=0,
+                       in_shardings=(carry_sh, lane, lane, rep),
+                       out_shardings=(carry_sh, lane))
+
+    # -- lane program dispatch (single-device or mesh-sharded) ------------
+
+    def _init_lanes(self, keys):
+        if self._lanes is not None:
+            return self._lanes.init_lanes(keys, self.params)
+        return self.env.init_lanes(keys, self.params)
+
+    def _step_lanes(self, carry, actions, admit, fresh, step):
+        if self._lanes is not None:
+            return self._lanes.step_lanes(carry, actions, admit, fresh,
+                                          step, self.params)
+        return self.env.step_lanes(carry, actions, admit, fresh, step,
+                                   self.params)
 
     # -- lifecycle --------------------------------------------------------
 
@@ -188,12 +239,12 @@ class ResidentEngine:
         # two separate dispatches: the carry is donated on every tick
         # while the template must stay alive as the default
         # fresh_states argument of non-admitting ticks
-        self._fresh0 = self.env.init_lanes(keys, self.params)
-        self._carry = self.env.init_lanes(keys, self.params)
+        self._fresh0 = self._init_lanes(keys)
+        self._carry = self._init_lanes(keys)
         zero_a = jnp.zeros(self.n_lanes, jnp.int32)
         zero_m = jnp.zeros(self.n_lanes, bool)
-        self._carry, _ = self.env.step_lanes(
-            self._carry, zero_a, zero_m, self._fresh0, zero_m, self.params)
+        self._carry, _ = self._step_lanes(
+            self._carry, zero_a, zero_m, self._fresh0, zero_m)
         if self._with_metrics:
             self._macc = self._spec.init()
         out, _ = self._burst_fn(self._carry_in(), zero_a, zero_m,
@@ -229,11 +280,11 @@ class ResidentEngine:
             seeds[lane] = seed
             admit[lane] = True
         keys = jax.vmap(jax.random.PRNGKey)(jnp.asarray(seeds))
-        fresh = self.env.init_lanes(keys, self.params)
+        fresh = self._init_lanes(keys)
         hold = jnp.zeros(self.n_lanes, bool)
-        carry, (obs, _, _, _) = self.env.step_lanes(
+        carry, (obs, _, _, _) = self._step_lanes(
             self._carry, jnp.zeros(self.n_lanes, jnp.int32),
-            jnp.asarray(admit), fresh, hold, self.params)
+            jnp.asarray(admit), fresh, hold)
         self._carry = carry
         obs = np.asarray(obs)
         self.admitted += len(lane_seeds)
@@ -253,9 +304,9 @@ class ResidentEngine:
             actions[lane] = a
             step[lane] = True
         no_admit = jnp.zeros(self.n_lanes, bool)
-        carry, out = self.env.step_lanes(
+        carry, out = self._step_lanes(
             self._carry, jnp.asarray(actions), no_admit, self._fresh0,
-            jnp.asarray(step), self.params)
+            jnp.asarray(step))
         self._carry = carry
         obs, reward, done, info = jax.device_get(out)
         self.ticks += 1
@@ -318,6 +369,10 @@ class ResidentEngine:
             occupancy=(self._occ_sum / self.bursts
                        if self.bursts else 0.0),
             burst=self.burst, n_lanes=self.n_lanes,
+            # device span of the lane block: the perf ledger lifts
+            # this into the cfg_devices fingerprint so per-device-
+            # count throughput rows gate separately (docs/SCALING.md)
+            n_devices=self.n_devices,
             policies=list(self.policy_names))
 
     def record_shed(self):

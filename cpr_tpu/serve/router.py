@@ -583,6 +583,9 @@ def main(argv=None) -> int:
     p.add_argument("--max-steps", type=int, default=256)
     p.add_argument("--lanes", type=int, default=32)
     p.add_argument("--burst", type=int, default=256)
+    p.add_argument("--devices", type=int, default=1,
+                   help="per-replica lane-block device span (forwarded"
+                        " to each server child; docs/SCALING.md)")
     p.add_argument("--policy-snapshot", default=None)
     p.add_argument("--slo-s", type=float, default=None)
     p.add_argument("--max-queue", type=int, default=None)
@@ -596,7 +599,8 @@ def main(argv=None) -> int:
                   "--activation-delay", str(args.activation_delay),
                   "--max-steps", str(args.max_steps),
                   "--lanes", str(args.lanes),
-                  "--burst", str(args.burst)]
+                  "--burst", str(args.burst),
+                  "--devices", str(args.devices)]
     if args.policy_snapshot:
         child_args += ["--policy-snapshot", args.policy_snapshot]
     if args.slo_s is not None:

@@ -720,6 +720,10 @@ def main(argv=None) -> int:
     p.add_argument("--max-steps", type=int, default=256)
     p.add_argument("--lanes", type=int, default=32)
     p.add_argument("--burst", type=int, default=256)
+    p.add_argument("--devices", type=int, default=1,
+                   help="shard the lane block over this many devices"
+                        " (1-D mesh; lanes must divide it; see"
+                        " docs/SCALING.md)")
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=0)
     p.add_argument("--policy-snapshot", default=None,
@@ -763,16 +767,31 @@ def main(argv=None) -> int:
                     f"snapshot trained on {meta.get('protocol')!r}, "
                     f"serving {args.protocol!r}")
             extra["ppo"] = policy
+        mesh = None
+        if args.devices > 1:
+            import jax
+
+            from cpr_tpu.parallel import default_mesh
+
+            devs = jax.devices()
+            if len(devs) < args.devices:
+                raise SystemExit(
+                    f"--devices {args.devices} but only {len(devs)} "
+                    f"device(s) visible to JAX")
+            mesh = default_mesh(devices=devs[:args.devices])
         engine = ResidentEngine(env, params, n_lanes=args.lanes,
-                                burst=args.burst, extra_policies=extra)
+                                burst=args.burst, extra_policies=extra,
+                                mesh=mesh)
     with supervisor.child_phase("serve:compile"):
         engine.start()
     # backend-bearing manifest BEFORE traffic: the perf ledger
-    # attributes every later serve report row to this record
+    # attributes every later serve report row to this record (the
+    # `devices` key lands as cfg_devices on every lifted row, so
+    # per-device-count throughput gates separately — docs/SCALING.md)
     telemetry.current().manifest(config=dict(
         entry="serve", protocol=args.protocol, n_lanes=args.lanes,
-        burst=args.burst, max_steps=args.max_steps, alpha=args.alpha,
-        gamma=args.gamma))
+        burst=args.burst, devices=args.devices,
+        max_steps=args.max_steps, alpha=args.alpha, gamma=args.gamma))
 
     async def amain():
         server = ServeServer(engine, host=args.host, port=args.port,

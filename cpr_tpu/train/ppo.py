@@ -363,7 +363,13 @@ def relative_reward_on_done(reward, info, done):
 def train(env, env_params, cfg: PPOConfig, *, n_updates: int, seed: int = 0,
           reward_transform=relative_reward_on_done, mesh=None,
           progress: Callable[[int, dict], Any] | None = None):
-    """Run PPO for n_updates; returns (train_state, metrics history)."""
+    """Run PPO for n_updates; returns (train_state, metrics history).
+
+    `mesh` shards the sampling env batch over the mesh's "dp" axis
+    (shard_envs) so the rollout half of every train_step runs
+    data-parallel across devices; cfg.n_envs must divide the axis
+    (shard_envs raises with both values named).  docs/SCALING.md
+    covers the mesh contract shared with serve and netsim."""
     init_fn, train_step = make_train(env, env_params, cfg, reward_transform)
     carry = init_fn(jax.random.PRNGKey(seed))
     if mesh is not None:

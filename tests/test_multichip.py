@@ -14,6 +14,51 @@ pytestmark = pytest.mark.skipif(
     len(jax.devices()) < 8, reason="needs the 8-device virtual mesh")
 
 
+def test_shard_map_shim_public_api_branch(monkeypatch):
+    """On jax >= 0.6 the shim must call jax.shard_map with the
+    `check_vma` spelling — pinned with a stub so a future rename
+    breaks here, not deep inside a sharded VI trace."""
+    from cpr_tpu import parallel
+
+    calls = {}
+
+    def fake_shard_map(body, *, mesh, in_specs, out_specs, **kw):
+        calls.update(kw, mesh=mesh, in_specs=in_specs,
+                     out_specs=out_specs)
+        return body
+
+    monkeypatch.setattr(jax, "shard_map", fake_shard_map,
+                        raising=False)
+    body = lambda x: x  # noqa: E731
+    out = parallel._shard_map(body, mesh="m", in_specs="i",
+                              out_specs="o", check_vma=False)
+    assert out is body
+    assert calls == dict(check_vma=False, mesh="m", in_specs="i",
+                         out_specs="o")
+
+
+def test_shard_map_shim_experimental_fallback(monkeypatch):
+    """Without jax.shard_map (jax < 0.6) the shim must route to
+    jax.experimental.shard_map with the knob respelled `check_rep`."""
+    import jax.experimental.shard_map as esm
+
+    from cpr_tpu import parallel
+
+    calls = {}
+
+    def fake_shard_map(body, *, mesh, in_specs, out_specs, **kw):
+        calls.update(kw)
+        return body
+
+    monkeypatch.delattr(jax, "shard_map", raising=False)
+    monkeypatch.setattr(esm, "shard_map", fake_shard_map)
+    out = parallel._shard_map(lambda x: x, mesh="m", in_specs="i",
+                              out_specs="o", check_vma=True)
+    assert callable(out)
+    assert calls == dict(check_rep=True)
+    assert "check_vma" not in calls
+
+
 def test_dp_tp_train_step_and_sharded_vi():
     from jax.sharding import Mesh
 

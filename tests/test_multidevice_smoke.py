@@ -49,18 +49,73 @@ _CHILD = textwrap.dedent("""
 """)
 
 
-def test_four_virtual_devices_sharded_vi_parity():
+def _run_child(script):
     env = dict(
         os.environ,
         JAX_PLATFORMS="cpu",
         XLA_FLAGS="--xla_force_host_platform_device_count=4 "
                   "--xla_backend_optimization_level=0",
     )
-    proc = subprocess.run([sys.executable, "-c", _CHILD], env=env,
+    proc = subprocess.run([sys.executable, "-c", script], env=env,
                           cwd=REPO, capture_output=True, text=True,
                           timeout=480)
     assert proc.returncode == 0, proc.stderr[-2000:]
-    out = json.loads(proc.stdout.splitlines()[-1])
+    return json.loads(proc.stdout.splitlines()[-1])
+
+
+def test_four_virtual_devices_sharded_vi_parity():
+    out = _run_child(_CHILD)
     assert out["platform"] == "cpu"
     assert out["device_count"] == 4, out
     assert abs(out["sharded"] - out["single"]) < 1e-4, out
+
+
+# the sharded resident lane stepper from the same cold start: episode
+# aggregates out of a burst over mesh-sharded lanes must be
+# BIT-identical to the single-device engine — the multichip-smoke
+# acceptance check, small enough for the fast tier
+_LANES_CHILD = textwrap.dedent("""
+    import json
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+
+    from cpr_tpu.envs import registry
+    from cpr_tpu.parallel import default_mesh
+    from cpr_tpu.params import make_params
+    from cpr_tpu.serve.engine import ResidentEngine
+
+    devs = jax.devices()
+    env = registry.get_sized("nakamoto", 16)
+    params = make_params(alpha=0.25, gamma=0.5, max_steps=16)
+    engines = {
+        1: ResidentEngine(env, params, n_lanes=8, burst=16),
+        4: ResidentEngine(env, params, n_lanes=8, burst=16,
+                          mesh=default_mesh(devices=devs[:4])),
+    }
+    regs = {}
+    for n, eng in engines.items():
+        eng.start()
+        eng.splice({lane: 10 + lane for lane in range(8)})
+        pid = eng.policy_ids["honest"]
+        out = eng.burst_run({lane: pid for lane in range(8)})
+        regs[n] = {k: np.asarray(v).tolist() for k, v in out.items()}
+    print(json.dumps({
+        "platform": devs[0].platform,
+        "device_count": len(devs),
+        "report_devices": {str(n): e.report()["n_devices"]
+                           for n, e in engines.items()},
+        "identical": regs[1] == regs[4],
+    }))
+""")
+
+
+def test_four_virtual_devices_lane_burst_parity():
+    out = _run_child(_LANES_CHILD)
+    assert out["platform"] == "cpu"
+    assert out["device_count"] == 4, out
+    assert out["report_devices"] == {"1": 1, "4": 4}, out
+    assert out["identical"], "sharded burst registers diverged"

@@ -147,7 +147,7 @@ def test_restart_count_tagged_but_rows_stay_baseline_eligible():
     read 2-5x slow) but stays in the baseline pool; junk counts
     normalize to 0 instead of wedging ingestion."""
     rec = _row(value=90.0, restart_count=1)
-    assert rec["ledger"] == 3
+    assert rec["ledger"] == perf.LEDGER_VERSION
     assert rec["restart_count"] == 1 and rec["probe"] is False
     assert _row(value=1.0, restart_count="two")["restart_count"] == 0
     hist = ([_row(value=100.0, rnd=i) for i in range(2)]
@@ -220,7 +220,7 @@ def test_ledger_v3_direction_field_and_inference():
     assert perf.metric_direction("serve_steps_per_sec") == "higher"
     assert perf.metric_direction("serve_occupancy") == "higher"
     rec = _row(metric="serve_p99_s", value=0.5)
-    assert rec["ledger"] == 3 and rec["direction"] == "lower"
+    assert rec["ledger"] >= 3 and rec["direction"] == "lower"
     assert _row(value=100.0)["direction"] == "higher"
     rec = perf.normalize_row({"metric": "weird_metric", "backend": "tpu",
                               "value": 1.0, "direction": "lower"})
@@ -228,6 +228,130 @@ def test_ledger_v3_direction_field_and_inference():
     rec = perf.normalize_row({"metric": "x_per_sec", "backend": "tpu",
                               "value": 1.0, "direction": "sideways"})
     assert rec["direction"] == "higher"
+
+
+def test_ledger_v4_cfg_devices_backfills_and_fingerprints():
+    """Ledger v4: every config fingerprint carries the device span.
+    Rows with no `n_devices` key measured one device (backfill-exact,
+    not a guess), an explicit span lands verbatim, junk normalizes to
+    1 — and a 4-chip row fingerprints as a DIFFERENT measurement from
+    the otherwise-identical 1-chip row."""
+    one = _row(value=100.0)
+    four = _row(value=100.0, n_devices=4)
+    assert one["ledger"] == perf.LEDGER_VERSION == 4
+    assert one["config"]["cfg_devices"] == 1
+    assert four["config"]["cfg_devices"] == 4
+    assert one["fingerprint"] != four["fingerprint"]
+    assert _row(value=1.0, n_devices="many")["config"]["cfg_devices"] == 1
+    # an explicit cfg_devices config key wins over the n_devices spell
+    rec = _row(value=1.0, cfg_devices=2)
+    assert rec["config"]["cfg_devices"] == 2
+
+
+def test_serve_report_n_devices_lifts_into_cfg_devices(tmp_path):
+    """iter_trace_rows: the drain report's own device span is
+    authoritative for the lifted rows' cfg_devices — it lands even
+    when the manifest config says nothing, and it overrides a stale
+    manifest `devices` key."""
+    trace = tmp_path / "t.jsonl"
+    events = [{"kind": "manifest", "backend": "cpu",
+               "config": {"entry": "serve", "devices": 1}},
+              {"kind": "event", "name": "serve", "action": "report",
+               "session": None,
+               "detail": {"steps_per_sec": 1000.0, "occupancy": 0.9,
+                          "p50_s": 0.02, "p99_s": 0.2, "n_devices": 4}}]
+    trace.write_text("".join(json.dumps(e) + "\n" for e in events))
+    rows = [perf.normalize_row(row, source=src)
+            for row, src in perf.iter_trace_rows(str(trace))]
+    assert rows
+    assert all(r["config"]["cfg_devices"] == 4 for r in rows)
+    # no n_devices in the report (pre-v4 serve trace): backfill to 1
+    events[1]["detail"].pop("n_devices")
+    events[0]["config"].pop("devices")
+    trace.write_text("".join(json.dumps(e) + "\n" for e in events))
+    rows = [perf.normalize_row(row, source=src)
+            for row, src in perf.iter_trace_rows(str(trace))]
+    assert all(r["config"]["cfg_devices"] == 1 for r in rows)
+
+
+def test_gate_drift_fallback_never_crosses_device_counts():
+    """Ledger v4 gate semantics: config drift still gates within a
+    device count, but a 4-chip candidate with only 1-chip history is a
+    FIRST measurement — on a 1-core CI host the 4-virtual-device rate
+    is honestly slower, and failing it against 1-chip baselines would
+    re-create exactly the drift cfg_devices exists to prevent."""
+    one_chip = [_row(value=100.0, rnd=i, cfg_n_envs=8192)
+                for i in range(3)]
+    # 60% below the 1-chip trail, but at a different device count:
+    # pass, with the first-measurement reason naming the count
+    res = perf.gate_row(_row(value=40.0, n_devices=4,
+                             cfg_n_envs=8192), one_chip)
+    assert res["verdict"] == "pass"
+    assert res["baseline"] is None and not res["config_drift"]
+    assert "cfg_devices=4" in res["reason"]
+    # once 4-chip history exists, an off-fingerprint 4-chip candidate
+    # drifts against THAT pool, never the 1-chip rows
+    mixed = one_chip + [_row(value=40.0, rnd=9, n_devices=4,
+                             cfg_n_envs=8192)]
+    res = perf.gate_row(_row(value=38.0, n_devices=4,
+                             cfg_n_envs=4096), mixed)
+    assert res["verdict"] == "pass" and res["config_drift"]
+    assert res["baseline"]["median"] == 40.0
+    # and a genuine same-count regression still fails
+    res = perf.gate_row(_row(value=10.0, n_devices=4,
+                             cfg_n_envs=4096), mixed)
+    assert res["verdict"] == "fail"
+
+
+def test_perf_report_scaling_table(tmp_path, capsys):
+    """scaling_groups: rows split only by cfg_devices group into one
+    scaling view with direction-aware best, speedup vs the smallest
+    count, and efficiency = speedup / device ratio; the markdown
+    report grows a Device scaling section."""
+    pr = _load_tool("perf_report")
+    recs = [
+        _row(metric="serve_steps_per_sec", backend="cpu", value=100.0,
+             rnd=1, cfg_lanes=8),
+        _row(metric="serve_steps_per_sec", backend="cpu", value=95.0,
+             rnd=2, cfg_lanes=8),  # best-per-count keeps the 100
+        _row(metric="serve_steps_per_sec", backend="cpu", value=300.0,
+             rnd=3, cfg_lanes=8, n_devices=4),
+        # lower-is-better: best per count is the SMALLEST latency
+        _row(metric="serve_p99_s", backend="cpu", value=0.4, rnd=1),
+        _row(metric="serve_p99_s", backend="cpu", value=0.2, rnd=2,
+             n_devices=4),
+        _row(metric="serve_p99_s", backend="cpu", value=0.3, rnd=3,
+             n_devices=4),
+        # single device count only: never a scaling group
+        _row(metric="lonely_per_sec", backend="cpu", value=5.0, rnd=1),
+        # a differing non-device config key splits the group
+        _row(metric="serve_steps_per_sec", backend="cpu", value=9.0,
+             rnd=4, cfg_lanes=16),
+    ]
+    scaling = pr.scaling_groups(recs)
+    by_metric = {g["metric"]: g for g in scaling}
+    assert set(by_metric) == {"serve_steps_per_sec", "serve_p99_s"}
+    sps = {r["devices"]: r for r in
+           by_metric["serve_steps_per_sec"]["rows"]}
+    assert sps[1]["value"] == 100.0 and sps[4]["value"] == 300.0
+    assert sps[4]["speedup"] == pytest.approx(3.0)
+    assert sps[4]["efficiency"] == pytest.approx(0.75)
+    p99 = {r["devices"]: r for r in by_metric["serve_p99_s"]["rows"]}
+    assert p99[4]["value"] == 0.2  # best = lowest latency
+    assert p99[4]["speedup"] == pytest.approx(2.0)
+    lines = list(pr.scaling_lines(scaling))
+    assert any("serve_steps_per_sec" in ln and "3.00x" in ln
+               for ln in lines)
+
+    led = perf.Ledger(str(tmp_path / "l.jsonl"))
+    led.append(recs)
+    md = tmp_path / "report.md"
+    assert pr.main([led.path, "--markdown", str(md)]) == 0
+    out = capsys.readouterr().out
+    assert "speedup" in out and "3.00x" in out
+    text = md.read_text()
+    assert "## Device scaling" in text and "0.75" not in text  # table is %
+    assert "| serve_steps_per_sec | cpu | 4 | 300 | 3.00x | 75% |" in text
 
 
 def test_gate_band_flips_for_lower_is_better_metrics():

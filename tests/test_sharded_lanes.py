@@ -1,0 +1,213 @@
+"""Sharded resident lanes (cpr_tpu/parallel/lanes.py) on the virtual
+8-device mesh: bit-identity against the single-device lane API, the
+uneven-shard refusals, the mesh-threaded serve engine, and the netsim
+lane sharding — the fast-tier twins of `make multichip-smoke`."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs the 8-device virtual mesh")
+
+MAX_STEPS = 16
+LANES = 8
+N_DEV = 4
+
+
+def _env_and_params():
+    from cpr_tpu.envs import registry
+    from cpr_tpu.params import make_params
+
+    env = registry.get_sized("nakamoto", MAX_STEPS)
+    return env, make_params(alpha=0.25, gamma=0.5, max_steps=MAX_STEPS)
+
+
+def _mesh(n=N_DEV):
+    from cpr_tpu.parallel import default_mesh
+
+    return default_mesh(devices=jax.devices()[:n])
+
+
+def _keys(seeds):
+    return jax.vmap(jax.random.PRNGKey)(
+        jnp.asarray(seeds, dtype=jnp.uint32))
+
+
+def _assert_trees_equal(a, b, what):
+    for la, lb in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb),
+                                      err_msg=what)
+
+
+def test_sharded_step_lanes_bit_identical_with_holds_and_admission():
+    """Six ticks with pseudo-random admit/hold/step masks: the sharded
+    stepper must track env.step_lanes bit-for-bit — held lanes frozen
+    (PRNG key included), admissions spliced, outputs equal."""
+    from cpr_tpu.parallel import make_sharded_lane_fns
+
+    env, params = _env_and_params()
+    fns = make_sharded_lane_fns(env, _mesh())
+    rng = np.random.RandomState(7)
+
+    keys = _keys(range(LANES))
+    fresh_keys = _keys(range(100, 100 + LANES))
+    single = env.init_lanes(keys, params)
+    sharded = fns.init_lanes(keys, params)
+    _assert_trees_equal(single, sharded, "init_lanes carry")
+    fresh_s = env.init_lanes(fresh_keys, params)
+    fresh_m = fns.init_lanes(fresh_keys, params)
+
+    for t in range(6):
+        actions = jnp.asarray(
+            rng.randint(0, env.n_actions, LANES), jnp.int32)
+        admit = jnp.asarray(rng.rand(LANES) < 0.25)
+        step = jnp.asarray(rng.rand(LANES) < 0.7)
+        single, out_s = env.step_lanes(single, actions, admit, fresh_s,
+                                       step, params)
+        sharded, out_m = fns.step_lanes(sharded, actions, admit,
+                                        fresh_m, step, params)
+        _assert_trees_equal(out_s, out_m, f"tick {t} outputs")
+        _assert_trees_equal(single, sharded, f"tick {t} carry")
+
+
+def test_sharded_carry_stays_lane_partitioned():
+    """The carry must come back under the lane NamedSharding after
+    init and after a (donated) step — chained dispatches reshard
+    nothing."""
+    from cpr_tpu.parallel import make_sharded_lane_fns
+
+    env, params = _env_and_params()
+    fns = make_sharded_lane_fns(env, _mesh())
+    carry = fns.init_lanes(_keys(range(LANES)), params)
+    fresh = fns.init_lanes(_keys(range(50, 50 + LANES)), params)
+    zeros = jnp.zeros(LANES, jnp.int32)
+    mask = jnp.ones(LANES, bool)
+    carry, _ = fns.step_lanes(carry, zeros, ~mask, fresh, mask, params)
+    _, obs = carry
+    assert not obs.sharding.is_fully_replicated
+    assert obs.sharding.spec == fns.lane.spec
+
+
+def test_uneven_lane_batches_refused_with_both_values_named():
+    """6 lanes over 4 devices must raise a ValueError naming both the
+    batch and the device count — from every lane entry point, the env
+    batch placer, and the mesh-wrapped stats fn — not XLA's opaque
+    sharding error."""
+    from cpr_tpu.parallel import make_sharded_lane_fns, shard_envs
+
+    env, params = _env_and_params()
+    mesh = _mesh()
+    fns = make_sharded_lane_fns(env, mesh)
+    bad_keys = _keys(range(6))
+
+    with pytest.raises(ValueError, match=r"6 lanes.*4 devices"):
+        fns.init_lanes(bad_keys, params)
+    with pytest.raises(ValueError, match=r"6 lanes.*4 devices"):
+        fns.reset_lanes(bad_keys, params)
+
+    carry = fns.init_lanes(_keys(range(LANES)), params)
+    with pytest.raises(ValueError, match=r"6 lanes.*4 devices"):
+        fns.step_lanes(carry, jnp.zeros(6, jnp.int32),
+                       jnp.zeros(6, bool), carry, jnp.ones(6, bool),
+                       params)
+
+    with pytest.raises(ValueError, match=r"6 batched envs.*4 devices"):
+        shard_envs(mesh, {"x": jnp.zeros((6, 3))})
+
+    fn = env.make_episode_stats_fn(params, env.policies["honest"],
+                                   MAX_STEPS, mesh=mesh)
+    with pytest.raises(ValueError, match=r"6 episode streams.*4 devices"):
+        fn(bad_keys)
+
+
+def test_mesh_needs_multiple_of_device_count_message():
+    """The refusal text carries the remainder and the fix."""
+    from cpr_tpu.parallel.lanes import check_even_shards
+
+    mesh = _mesh()
+    assert check_even_shards(8, mesh) == 4
+    with pytest.raises(ValueError) as ei:
+        check_even_shards(10, mesh, what="lanes")
+    msg = str(ei.value)
+    assert "10 % 4 = 2" in msg and "multiple of the device count" in msg
+
+
+def test_resident_engine_mesh_parity_and_report_devices():
+    """ResidentEngine(mesh=) must splice and burst bit-identically to
+    the single-device engine, and stamp the device span into its
+    report (the cfg_devices fingerprint source)."""
+    from cpr_tpu.serve.engine import ResidentEngine
+
+    env, params = _env_and_params()
+    eng1 = ResidentEngine(env, params, n_lanes=LANES, burst=MAX_STEPS)
+    eng4 = ResidentEngine(env, params, n_lanes=LANES, burst=MAX_STEPS,
+                          mesh=_mesh())
+    assert eng1.n_devices == 1 and eng4.n_devices == N_DEV
+    eng1.start()
+    eng4.start()
+
+    seeds = {lane: 40 + lane for lane in range(LANES - 2)}
+    obs1 = eng1.splice(seeds)
+    obs4 = eng4.splice(seeds)
+    for lane in seeds:
+        np.testing.assert_array_equal(obs1[lane], obs4[lane],
+                                      err_msg=f"splice obs lane {lane}")
+
+    pid = eng1.policy_ids["honest"]
+    assert eng4.policy_ids["honest"] == pid
+    lane_policy = {lane: pid for lane in seeds}  # 2 lanes stay held
+    for burst in range(2):
+        out1 = eng1.burst_run(lane_policy)
+        out4 = eng4.burst_run(lane_policy)
+        for k in out1:
+            np.testing.assert_array_equal(
+                np.asarray(out1[k]), np.asarray(out4[k]),
+                err_msg=f"burst {burst} register {k}")
+
+    r1, r4 = eng1.report(), eng4.report()
+    assert r1["n_devices"] == 1 and r4["n_devices"] == N_DEV
+    assert r1["steps"] == r4["steps"]
+
+    with pytest.raises(ValueError, match=r"6 lanes.*4 devices"):
+        ResidentEngine(env, params, n_lanes=6, burst=MAX_STEPS,
+                       mesh=_mesh())
+
+
+def test_netsim_engine_mesh_parity_and_guard():
+    """netsim.Engine(mesh=) output arrays must equal the single-device
+    run bit-for-bit, and uneven lane batches are refused up front."""
+    from cpr_tpu import netsim
+    from cpr_tpu.network import symmetric_clique
+
+    net = symmetric_clique(5, activation_delay=30.0,
+                           propagation_delay=1.0)
+    eng1 = netsim.Engine(net, protocol="nakamoto", activations=100)
+    eng4 = netsim.Engine(net, protocol="nakamoto", activations=100,
+                         mesh=_mesh())
+    assert eng1.n_devices == 1 and eng4.n_devices == N_DEV
+    seeds, delays = list(range(LANES)), [30.0] * LANES
+    out1 = eng1.run(seeds, delays)
+    out4 = eng4.run(seeds, delays)
+    assert sorted(out1) == sorted(out4)
+    for k in out1:
+        np.testing.assert_array_equal(out1[k], out4[k], err_msg=k)
+
+    with pytest.raises(ValueError, match=r"6 netsim lanes.*4 devices"):
+        eng4.run(list(range(6)), [30.0] * 6)
+
+
+def test_sharded_episode_stats_parity():
+    """make_episode_stats_fn(mesh=) — chunked and unchunked — must
+    reproduce the single-device stats bit-for-bit."""
+    env, params = _env_and_params()
+    mesh = _mesh()
+    keys = _keys(range(LANES))
+    pol = env.policies["honest"]
+    for chunk in (None, MAX_STEPS // 2):
+        plain = env.make_episode_stats_fn(params, pol, MAX_STEPS,
+                                          chunk=chunk)(keys)
+        sharded = env.make_episode_stats_fn(params, pol, MAX_STEPS,
+                                            chunk=chunk, mesh=mesh)(keys)
+        _assert_trees_equal(plain, sharded, f"stats chunk={chunk}")

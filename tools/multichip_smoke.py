@@ -1,0 +1,362 @@
+"""Multi-chip smoke (`make multichip-smoke`).
+
+Proves the sharded hot loops (docs/SCALING.md) end-to-end on a forced
+4-device CPU mesh — every child runs under
+`XLA_FLAGS=--xla_force_host_platform_device_count=4`, so this passes
+on the 1-core CI host with no accelerator:
+
+  1  two supervised `python -m cpr_tpu.serve.server` runs, `--devices 1`
+     then `--devices 4`, each flooded with the SAME seeded honest
+     episodes over persistent clients, then SIGTERM-drained; each trace
+     must pass `trace_summary --validate --expect serve,device_metrics`
+     and each drain report must stamp its `n_devices`;
+  2  device-count parity: every seeded episode's aggregates (rewards,
+     progress, n_steps, relative_reward) must be BIT-IDENTICAL between
+     the 1-device and 4-device runs — the sharded lane stepper is the
+     same program, just partitioned;
+  3  a rollout + netsim child per device count: the same seeds through
+     `make_episode_stats_fn(..., mesh=)` and `netsim.Engine(mesh=)`,
+     full output pytrees asserted bit-identical across device counts,
+     with telemetry spans landing per-device ledger rows under the
+     manifest's `devices` config;
+  4  all four traces ingest into one perf ledger: `serve_steps_per_sec`
+     rows must land at BOTH cfg_devices=1 and cfg_devices=4 (the
+     ledger-v4 per-device-count fingerprints), every banked row must
+     clear the regression gate, and the perf_report device-scaling
+     table must cover the serve metric at both counts.
+
+Usage: python tools/multichip_smoke.py [workdir]   (default /tmp/...)
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+ROOT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+sys.path.insert(0, ROOT)
+
+from cpr_tpu import supervisor  # noqa: E402
+from cpr_tpu.perf.gate import gate_row, gate_summary  # noqa: E402
+from cpr_tpu.perf.ledger import Ledger  # noqa: E402
+from cpr_tpu.serve.protocol import ServeClient  # noqa: E402
+
+DEVICES = 4                 # the forced virtual CPU mesh span
+MAX_STEPS = 128
+LANES = 8                   # divides DEVICES — the sharding contract
+BURST = 128
+N_CLIENTS = 4
+FLOOD_EPISODES = 32
+ROLLOUT_STREAMS = 8
+NETSIM_ACTIVATIONS = 200
+READY_TIMEOUT_S = 300.0
+WALL_S = 600.0
+
+
+def _log(msg):
+    print(f"multichip-smoke: {msg}", file=sys.stderr)
+
+
+def _child_env(workdir, trace, extra=None):
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS=f"--xla_force_host_platform_device_count="
+                         f"{DEVICES}",
+               CPR_TELEMETRY=trace, CPR_DEVICE_METRICS="1",
+               CPR_TPU_CACHE=os.path.join(workdir, "cache"))
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env.update(extra or {})
+    return env
+
+
+def _wait_ready(path, proc):
+    deadline = time.time() + READY_TIMEOUT_S
+    while time.time() < deadline:
+        if proc.poll() is not None:
+            raise SystemExit(f"server child exited rc={proc.returncode} "
+                             f"before becoming ready")
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            time.sleep(0.25)
+    raise SystemExit(f"server not ready within {READY_TIMEOUT_S:.0f}s")
+
+
+def _flood_worker(port, seeds, episodes):
+    with ServeClient("127.0.0.1", port) as c:
+        for s in seeds:
+            r = c.request("episode.run", policy="honest", seed=s)
+            assert r.get("ok"), f"episode.run(seed={s}): {r}"
+            episodes[s] = r["episode"]
+
+
+def _serve_events(trace, action=None):
+    out = []
+    with open(trace) as f:
+        for line in f:
+            try:
+                e = json.loads(line)
+            except ValueError:
+                continue
+            if e.get("kind") == "event" and e.get("name") == "serve" \
+                    and (action is None or e.get("action") == action):
+                out.append(e)
+    return out
+
+
+def _validate_stream(trace):
+    tool = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "trace_summary.py")
+    r = subprocess.run(
+        [sys.executable, tool, trace, "--validate",
+         "--expect", "serve,device_metrics"],
+        capture_output=True, text=True)
+    if r.returncode != 0:
+        sys.stderr.write(r.stdout + r.stderr)
+        raise SystemExit(f"telemetry validation failed for {trace}")
+
+
+def _serve_run(work, devices):
+    """One supervised server run at `devices`: seeded flood, SIGTERM
+    drain, trace validation.  Returns (episodes-by-seed, trace path,
+    drain-report detail)."""
+    trace = os.path.join(work, f"serve_d{devices}.jsonl")
+    if os.path.exists(trace):
+        os.remove(trace)
+    cmd = [sys.executable, "-m", "cpr_tpu.serve.server",
+           "--protocol", "nakamoto", "--max-steps", str(MAX_STEPS),
+           "--lanes", str(LANES), "--burst", str(BURST),
+           "--devices", str(devices), "--heartbeat-s", "0.5",
+           "--ready-file", os.path.join(work, f"ready_d{devices}.json")]
+
+    started = threading.Event()
+    box = {}
+
+    def on_start(proc):
+        box["proc"] = proc
+        started.set()
+
+    def supervise():
+        box["attempt"] = supervisor.run_child(
+            cmd, wall_timeout_s=WALL_S, quiet_s=20.0, heartbeat_s=1.0,
+            env=_child_env(work, trace), cwd=ROOT, on_start=on_start)
+
+    child = threading.Thread(target=supervise)
+    child.start()
+    episodes = {}
+    try:
+        if not started.wait(30.0):
+            raise SystemExit("run_child never spawned the server")
+        ready = _wait_ready(
+            os.path.join(work, f"ready_d{devices}.json"), box["proc"])
+        port = ready["port"]
+        _log(f"server --devices {devices} ready on port {port}")
+
+        per = FLOOD_EPISODES // N_CLIENTS
+        with ThreadPoolExecutor(max_workers=N_CLIENTS) as pool:
+            jobs = [pool.submit(_flood_worker, port,
+                                range(100 + w * per, 100 + (w + 1) * per),
+                                episodes)
+                    for w in range(N_CLIENTS)]
+            for j in jobs:
+                j.result()
+        box["proc"].send_signal(signal.SIGTERM)
+    except BaseException:
+        proc = box.get("proc")
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+        raise
+    child.join(120.0)
+    if child.is_alive():
+        raise SystemExit("server child did not drain within 120s")
+    attempt = box["attempt"]
+    if attempt.status != "ok" or attempt.rc != 0:
+        raise SystemExit(f"--devices {devices} child did not exit "
+                         f"cleanly (status={attempt.status} "
+                         f"rc={attempt.rc})")
+    for want in ("start", "admit", "complete", "drain", "report",
+                 "stop"):
+        if not _serve_events(trace, want):
+            raise SystemExit(f"no serve '{want}' event in {trace}")
+    _validate_stream(trace)
+    reports = _serve_events(trace, "report")
+    detail = reports[-1].get("detail") or {}
+    if detail.get("n_devices") != devices:
+        raise SystemExit(f"drain report stamps n_devices="
+                         f"{detail.get('n_devices')}, expected {devices}")
+    _log(f"--devices {devices}: {len(episodes)} episodes, drained "
+         f"clean, report n_devices={devices}, "
+         f"{detail.get('steps_per_sec', 0):,.0f} steps/s")
+    return episodes, trace, detail
+
+
+# the in-process twin of the serve parity run: the same mesh seam
+# through make_episode_stats_fn and netsim.Engine, outputs dumped as
+# exact JSON for the parent's bit-identity check
+_COMPUTE_CHILD = textwrap.dedent("""\
+    import json, os
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import jax.numpy as jnp
+
+    from cpr_tpu import netsim, telemetry
+    from cpr_tpu.envs import registry
+    from cpr_tpu.network import symmetric_clique
+    from cpr_tpu.params import make_params
+
+    devices = int(os.environ["CPR_SMOKE_DEVICES"])
+    max_steps = int(os.environ["CPR_SMOKE_MAX_STEPS"])
+    streams = int(os.environ["CPR_SMOKE_STREAMS"])
+    activations = int(os.environ["CPR_SMOKE_ACTIVATIONS"])
+
+    mesh = None
+    if devices > 1:
+        from cpr_tpu.parallel import default_mesh
+        devs = jax.devices()
+        assert len(devs) >= devices, (len(devs), devices)
+        mesh = default_mesh(devices=devs[:devices])
+
+    tele = telemetry.current()
+    tele.manifest(dict(role="multichip-compute", devices=devices,
+                       protocol="nakamoto", streams=streams,
+                       max_steps=max_steps))
+
+    env = registry.get_sized("nakamoto", max_steps)
+    params = make_params(alpha=0.25, gamma=0.5, max_steps=max_steps)
+    fn = env.make_episode_stats_fn(params, env.policies["honest"],
+                                   max_steps, chunk=max_steps // 2,
+                                   mesh=mesh)
+    keys = jax.vmap(jax.random.PRNGKey)(
+        jnp.arange(streams, dtype=jnp.uint32))
+    with tele.span("multichip:rollout", steps=streams * max_steps):
+        stats = jax.block_until_ready(fn(keys))
+
+    net = symmetric_clique(5, activation_delay=30.0,
+                           propagation_delay=1.0)
+    eng = netsim.Engine(net, protocol="nakamoto",
+                        activations=activations, mesh=mesh)
+    out = eng.run(list(range(streams)), [30.0] * streams)
+
+    payload = dict(
+        devices=devices,
+        rollout=jax.tree.map(lambda x: jnp.asarray(x).tolist(), stats),
+        netsim={k: out[k].tolist() for k in sorted(out)},
+    )
+    with open(os.environ["CPR_SMOKE_OUT"], "w") as f:
+        json.dump(payload, f, sort_keys=True)
+    print("multichip compute child ok:", devices, "device(s)")
+""")
+
+
+def _compute_run(work, devices):
+    """Sharded rollout + netsim in a forced-mesh child; returns the
+    exact output payload and the trace path."""
+    trace = os.path.join(work, f"compute_d{devices}.jsonl")
+    out_path = os.path.join(work, f"compute_d{devices}.json")
+    for p in (trace, out_path):
+        if os.path.exists(p):
+            os.remove(p)
+    env = _child_env(work, trace, extra={
+        "CPR_SMOKE_DEVICES": str(devices),
+        "CPR_SMOKE_MAX_STEPS": str(MAX_STEPS),
+        "CPR_SMOKE_STREAMS": str(ROLLOUT_STREAMS),
+        "CPR_SMOKE_ACTIVATIONS": str(NETSIM_ACTIVATIONS),
+        "CPR_SMOKE_OUT": out_path,
+    })
+    r = subprocess.run([sys.executable, "-c", _COMPUTE_CHILD], env=env,
+                       cwd=ROOT, capture_output=True, text=True,
+                       timeout=WALL_S)
+    if r.returncode != 0:
+        sys.stderr.write(r.stdout + r.stderr)
+        raise SystemExit(f"compute child (devices={devices}) failed "
+                         f"rc={r.returncode}")
+    with open(out_path) as f:
+        payload = json.load(f)
+    _log(f"compute child devices={devices}: rollout "
+         f"{ROLLOUT_STREAMS}x{MAX_STEPS} + netsim "
+         f"{ROLLOUT_STREAMS}x{NETSIM_ACTIVATIONS} done")
+    return payload, trace
+
+
+def _assert_identical(what, a, b):
+    if a != b:
+        raise SystemExit(f"{what} NOT bit-identical between 1-device "
+                         f"and {DEVICES}-device runs")
+    _log(f"{what}: bit-identical across device counts")
+
+
+def _bank_and_gate(work, traces):
+    """All traces into one ledger; serve_steps_per_sec must land at
+    both device counts, every banked row must clear the gate, and the
+    perf_report scaling table must cover the serve metric."""
+    ledger = Ledger(os.path.join(work, "perf_ledger.jsonl"))
+    n = sum(ledger.ingest_trace(t) for t in traces)
+    records = ledger.records()
+    sps = [r for r in records if r.get("metric") == "serve_steps_per_sec"]
+    got = {r.get("config", {}).get("cfg_devices") for r in sps}
+    if not {1, DEVICES} <= got:
+        raise SystemExit(f"serve_steps_per_sec banked at device counts "
+                         f"{sorted(got)}, need both 1 and {DEVICES}")
+    results = [gate_row(r, records) for r in records]
+    summary = gate_summary(results)
+    if not summary["ok"]:
+        bad = [res for res in results if res["verdict"] == "fail"]
+        raise SystemExit(f"multichip perf gate failed: {bad}")
+
+    tools_dir = os.path.dirname(os.path.abspath(__file__))
+    if tools_dir not in sys.path:
+        sys.path.insert(0, tools_dir)
+    import perf_report
+
+    scaling = perf_report.scaling_groups(records)
+    covered = [g for g in scaling
+               if g["metric"] == "serve_steps_per_sec"
+               and {row["devices"] for row in g["rows"]}
+               >= {1, DEVICES}]
+    if not covered:
+        raise SystemExit("perf_report scaling table does not cover "
+                         "serve_steps_per_sec at both device counts")
+    for line in perf_report.scaling_lines(scaling):
+        _log(line)
+    return n, summary, covered[0]
+
+
+def main():
+    work = sys.argv[1] if len(sys.argv) > 1 else "/tmp/cpr-multichip-smoke"
+    os.makedirs(work, exist_ok=True)
+
+    eps_1, trace_s1, _ = _serve_run(work, 1)
+    eps_n, trace_sn, _ = _serve_run(work, DEVICES)
+    if sorted(eps_1) != sorted(eps_n):
+        raise SystemExit("the two serve runs completed different seed "
+                         "sets — flood harness bug")
+    _assert_identical(f"serve episode aggregates ({len(eps_1)} seeded "
+                      f"episodes)", eps_1, eps_n)
+
+    out_1, trace_c1 = _compute_run(work, 1)
+    out_n, trace_cn = _compute_run(work, DEVICES)
+    _assert_identical("sharded rollout episode stats",
+                      out_1["rollout"], out_n["rollout"])
+    _assert_identical("sharded netsim outputs",
+                      out_1["netsim"], out_n["netsim"])
+
+    n, summary, grp = _bank_and_gate(
+        work, [trace_s1, trace_sn, trace_c1, trace_cn])
+    top = grp["rows"][-1]
+    print(f"multichip-smoke: PASS (serve + rollout + netsim "
+          f"bit-identical at 1 vs {DEVICES} devices; banked {n} ledger "
+          f"rows incl. serve_steps_per_sec at devices 1 and {DEVICES} "
+          f"[{DEVICES}-dev speedup {top['speedup']:.2f}x, efficiency "
+          f"{top['efficiency']:.0%}]; gate {summary})")
+
+
+if __name__ == "__main__":
+    main()

@@ -4,7 +4,11 @@ Reads the banked bench trail — either a persisted ledger JSONL or the
 tracked `BENCH*.json` banks directly — and renders per-metric trend
 tables plus a gate verdict per metric x backend: the newest banked row
 is judged against the best earlier same-backend rows (median/MAD band,
-outage/error rows never baselines; see docs/OBSERVABILITY.md).
+outage/error rows never baselines; see docs/OBSERVABILITY.md).  When
+the trail holds the same metric at multiple device counts (ledger-v4
+cfg_devices fingerprints), a device-scaling table — value, speedup,
+parallel efficiency per device count — is printed and written to the
+markdown report (docs/SCALING.md "blessing a scaling row").
 
     python tools/perf_report.py                      # tracked banks
     python tools/perf_report.py runs/perf_ledger.jsonl
@@ -109,6 +113,61 @@ def trend_lines(records):
                f"{str(r.get('source')):<26} {_flags(r)}")
 
 
+def scaling_groups(records) -> list[dict]:
+    """Device-count scaling view (ledger v4): group clean measurement
+    rows by (metric, backend, config minus cfg_devices) and keep the
+    groups spanning >= 2 device counts.  Per device count the BEST
+    banked value (direction-aware) represents it; speedup is vs the
+    group's smallest device count and efficiency = speedup / device
+    ratio — the (near-)linear-scaling evidence docs/SCALING.md asks
+    for, instead of 'ran on 8'."""
+    groups = {}
+    for r in records:
+        if r.get("outage") or r.get("error") or r.get("probe"):
+            continue
+        if not isinstance(r.get("value"), (int, float)) or r["value"] <= 0:
+            continue
+        cfg = dict(r.get("config") or {})
+        try:
+            dev = int(cfg.pop("cfg_devices", 1))
+        except (TypeError, ValueError):
+            continue
+        key = (str(r.get("metric")), str(r.get("backend")),
+               tuple(sorted((k, str(v)) for k, v in cfg.items())))
+        groups.setdefault(key, {}).setdefault(dev, []).append(r)
+    out = []
+    for (metric, backend, _cfg) in sorted(groups):
+        by_dev = groups[(metric, backend, _cfg)]
+        if len(by_dev) < 2:
+            continue
+        lower = any(r.get("direction") == "lower"
+                    for rows in by_dev.values() for r in rows)
+        pick = min if lower else max
+        best = {dev: pick(r["value"] for r in rows)
+                for dev, rows in by_dev.items()}
+        base_dev = min(best)
+        rows = []
+        for dev in sorted(best):
+            speed = (best[base_dev] / best[dev] if lower
+                     else best[dev] / best[base_dev])
+            rows.append(dict(devices=dev, value=best[dev],
+                             speedup=speed,
+                             efficiency=speed / (dev / base_dev)))
+        out.append(dict(metric=metric, backend=backend,
+                        base_devices=base_dev, rows=rows))
+    return out
+
+
+def scaling_lines(scaling):
+    yield (f"{'metric':<44} {'backend':<7} {'devices':>7} "
+           f"{'value':>14} {'speedup':>8} {'eff':>6}")
+    for grp in scaling:
+        for row in grp["rows"]:
+            yield (f"{grp['metric']:<44} {grp['backend']:<7} "
+                   f"{row['devices']:>7} {_fmt_val(row['value']):>14} "
+                   f"{row['speedup']:>7.2f}x {row['efficiency']:>5.0%}")
+
+
 def gate_lines(results):
     for res in results:
         base = res.get("baseline")
@@ -126,7 +185,7 @@ def gate_lines(results):
             yield f"      {res['reason']}"
 
 
-def markdown_report(records, results, summary) -> str:
+def markdown_report(records, results, summary, scaling=()) -> str:
     lines = ["# Perf ledger report", "",
              f"{len(records)} ledger rows; gate: "
              f"{summary['fail']} fail / {summary['warn']} warn / "
@@ -143,6 +202,17 @@ def markdown_report(records, results, summary) -> str:
         lines.append(f"| {res['metric']} | {res['backend']} | "
                      f"{res['verdict']}{drift} | {_fmt_val(res['value'])} "
                      f"| {med} | {best} |")
+    if scaling:
+        lines += ["", "## Device scaling", "",
+                  "| metric | backend | devices | value | speedup | "
+                  "efficiency |", "|---|---|---|---|---|---|"]
+        for grp in scaling:
+            for row in grp["rows"]:
+                lines.append(
+                    f"| {grp['metric']} | {grp['backend']} | "
+                    f"{row['devices']} | {_fmt_val(row['value'])} | "
+                    f"{row['speedup']:.2f}x | "
+                    f"{row['efficiency']:.0%} |")
     lines += ["", "## Banked trail", "",
               "| metric | backend | round | value | check | source | "
               "flags |", "|---|---|---|---|---|---|---|"]
@@ -191,10 +261,15 @@ def main(argv=None) -> int:
         return 2 if not args.gate else 1
     results = gate_all(records)
     summary = perf.gate_summary(results)
+    scaling = scaling_groups(records)
 
     for line in trend_lines(records):
         print(line)
     print()
+    if scaling:
+        for line in scaling_lines(scaling):
+            print(line)
+        print()
     for line in gate_lines(results):
         print(line)
     print(f"perf-gate: {'PASS' if summary['ok'] else 'FAIL'} "
@@ -202,7 +277,8 @@ def main(argv=None) -> int:
           f"{summary['pass']} pass, {summary['skip']} skip)")
     if args.markdown:
         atomic_write_text(args.markdown,
-                          markdown_report(records, results, summary))
+                          markdown_report(records, results, summary,
+                                          scaling))
         print(f"perf_report: wrote {args.markdown}", file=sys.stderr)
     return 0 if (summary["ok"] or not args.gate) else 1
 
