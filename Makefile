@@ -4,7 +4,7 @@
 
 CPU_MESH = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: lint test test-slow bench perf-gate telemetry-smoke netsim-smoke resilience-smoke supervisor-smoke serve-smoke fleet-smoke multichip-smoke dryrun sweeps ghostdag train-dummy native asan
+.PHONY: lint test test-slow bench perf-gate telemetry-smoke netsim-smoke resilience-smoke supervisor-smoke serve-smoke fleet-smoke multichip-smoke mdp-smoke dryrun sweeps ghostdag train-dummy native asan
 
 lint:  ## jaxlint over cpr_tpu/ + tools/ (pure AST, no JAX import,
 	## ~1s); banks the JSON report under runs/ like the smoke flows
@@ -121,6 +121,20 @@ multichip-smoke:  ## sharded hot-loop proof on a forced 4-device CPU
 	## scaling table.  Details: docs/SCALING.md
 	rm -rf $(MULTICHIP_SMOKE_DIR)
 	python tools/multichip_smoke.py $(MULTICHIP_SMOKE_DIR)
+
+MDP_SMOKE_DIR = /tmp/cpr-mdp-smoke
+
+mdp-smoke:  ## grid-batched MDP proof: parametric compile of fc16 +
+	## aft20 (one BFS per protocol), revalue parity vs fresh compiles,
+	## a 16-point (alpha, gamma) grid solved as ONE vmapped VI program
+	## at forced 1 and 4 CPU devices with bit-identical per-point
+	## fixpoints, a telemetry-spanned A/B where the grid beats the
+	## serial per-point loop >= 3x, a serve mdp.solve_grid cache-hit
+	## round-trip, v10 `mdp_solve` trace validation, and
+	## mdp_grid_points_per_sec rows banked + gated at both device
+	## counts.  Details: docs/MDP.md
+	rm -rf $(MDP_SMOKE_DIR)
+	python tools/mdp_smoke.py $(MDP_SMOKE_DIR)
 
 dryrun:  ## multi-chip sharding dry run on the virtual CPU mesh
 	$(CPU_MESH) python -c "from __graft_entry__ import dryrun_multichip; dryrun_multichip(8)"

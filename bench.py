@@ -350,6 +350,58 @@ def measure_netsim(n_envs: int, n_activations: int = 10_000,
         best_rep_s=round(best, 4), n_devices=_bench_devices())
 
 
+def measure_mdp_grid(n_envs: int, mfl: int = 12, horizon: int = 100,
+                     stop_delta: float = 1e-6):
+    """Grid-batched exact-MDP solving (cpr_tpu/mdp/grid.py): one
+    parametric compile per protocol (fc16 + aft20 at fork-length
+    `mfl`) and ONE vmapped/sharded VI program per protocol over an
+    `n_envs`-point (alpha, gamma) grid, the batch seam the serial
+    battery lacks (one compile + one solve per point).  Rate counts
+    solved grid points/sec across both protocols (solve only — the
+    host-side compile is amortized once per protocol and reported in
+    extras); the check is the fc16 optimal revenue at the hardest
+    grid corner (max alpha, max gamma), guarded against the exact
+    solve's value at this shape."""
+    import numpy as np
+
+    from cpr_tpu.mdp.grid import (compile_protocol, grid_value_iteration,
+                                  param_ptmdp)
+    from cpr_tpu.telemetry import now
+
+    gammas = (0.25, 0.75)
+    n_alphas = max(2, n_envs // len(gammas))
+    alphas = [round(float(a), 6)
+              for a in np.linspace(0.15, 0.45, n_alphas)]
+    mesh = _bench_mesh()
+    points = solve_s = 0
+    check = 0.0
+    extras = dict(protocols="fc16+aft20", mfl=mfl,
+                  grid=f"{n_alphas}x{len(gammas)}",
+                  n_devices=_bench_devices())
+    for proto in ("fc16", "aft20"):
+        t0 = now()
+        pm = param_ptmdp(compile_protocol(proto, cutoff=mfl),
+                         horizon=horizon)
+        extras[f"{proto}_compile_s"] = round(now() - t0, 3)
+        vi = grid_value_iteration(pm, alphas, gammas,
+                                  stop_delta=stop_delta, mesh=mesh,
+                                  protocol=proto, cutoff=mfl)
+        if not bool(vi["grid_converged"].all()):
+            raise GuardFailure(
+                f"mdp_grid: {proto} left "
+                f"{int((~vi['grid_converged']).sum())} points "
+                f"unconverged")
+        points += len(vi["grid_points"])
+        solve_s += vi["vi_time"]
+        extras[f"{proto}_sweeps"] = int(vi["vi_iter"])
+        if proto == "fc16":
+            # hardest corner: alpha-major point list ends at
+            # (max alpha, max gamma)
+            check = float(vi["grid_revenue"][-1])
+    extras["point_solve_s"] = round(solve_s / points, 4)
+    return points / solve_s, check, extras
+
+
 # correctness guard bounds: SM1 revenue near the ES'14 closed form
 # (alpha=.35, gamma=.5 -> 0.416)
 SM1_GUARD = (0.38, 0.45)
@@ -600,6 +652,16 @@ CONFIGS = {
         fn="measure_netsim", tpu=dict(n_envs=96),
         cpu=dict(n_envs=24), guard=(0.01, 0.06),
         guard_name="nakamoto orphan rate @ delay 30"),
+    # grid-batched exact-MDP solving (cpr_tpu/mdp/grid.py): n_envs is
+    # the (alpha, gamma) grid size per protocol; the rate counts
+    # solved points/sec, so the metric/unit override the env-steps
+    # default.  Guard: fc16 optimal revenue at the (0.45, 0.75)
+    # corner, mfl=12 horizon=100 — exact solve gives ~0.753
+    "mdp_grid": dict(
+        fn="measure_mdp_grid", tpu=dict(n_envs=32),
+        cpu=dict(n_envs=16), guard=(0.70, 0.80),
+        guard_name="fc16 optimal revenue @ (0.45, 0.75)",
+        metric="mdp_grid_points_per_sec", unit="grid-points/sec"),
 }
 
 
@@ -624,9 +686,11 @@ def _measure_config(name: str, platform: str, n_envs_override=None):
             f"{name}: {spec['guard_name']} {check} outside ({lo}, {hi})")
     base = _cpu_baseline(name)
     return {
-        "metric": f"{name}_env_steps_per_sec_per_chip",
-        "value": round(rate),
-        "unit": "env-steps/sec/chip",
+        "metric": spec.get("metric", f"{name}_env_steps_per_sec_per_chip"),
+        # sub-1000 rates (e.g. grid points/sec) keep 3 decimals; the
+        # env-steps rates stay integral as before
+        "value": round(rate) if rate >= 1000 else round(rate, 3),
+        "unit": spec.get("unit", "env-steps/sec/chip"),
         "check": round(check, 4),
         "backend": platform,
         "prng": _prng_choice(),

@@ -23,6 +23,11 @@ HOT_CARRY_PATHS = (
     "cpr_tpu/train/ppo.py",
     "cpr_tpu/netsim/engine.py",
     "cpr_tpu/serve/engine.py",
+    # the grid-batched VI carry is [G, S] x 3 planes — G grid points
+    # of value/progress/policy stepped per chunk dispatch, the
+    # dominant resident block of a grid solve
+    "cpr_tpu/mdp/explicit.py",
+    "cpr_tpu/mdp/grid.py",
 )
 # ...and every module under parallel/ — notably the sharded resident
 # lane stepper (parallel/lanes.py): its mesh-sharded carries are

@@ -86,3 +86,66 @@ def measure_rows(battery=None, *, horizon=100, stop_delta=1e-6,
                           if prog else 0.0)
         rows.append(row)
     return rows
+
+
+def battery_groups(*, native=True, generic_cutoff=7, mfl=20):
+    """The model_battery regrouped by (protocol, cutoff): each group
+    shares one transition structure across every (alpha, gamma) point,
+    so ONE parametric compile + ONE grid solve covers what the serial
+    battery re-compiles and re-solves per point.  Entries are
+    (protocol, cutoff, kwargs-for-compile_protocol, serial-name-stem);
+    the stems reproduce measure_rows' `model` labels ("fc16-{alpha}",
+    "generic-bitcoin-{alpha}", ...)."""
+    return [
+        ("fc16", mfl, {}, "fc16"),
+        ("aft20", mfl, {}, "aft20"),
+        ("bitcoin", generic_cutoff, {"native": native},
+         "generic-bitcoin"),
+        ("ghostdag", generic_cutoff, {"native": native, "k": 2},
+         "generic-ghostdag"),
+    ]
+
+
+def measure_rows_grid(groups=None, *, alphas=(0.25, 0.33, 0.4),
+                      gamma=0.5, horizon=100, stop_delta=1e-6,
+                      max_transitions=1_000_000, mesh=None):
+    """Grid-batched twin of measure_rows: per (protocol, cutoff) group,
+    one parametric compile + one vmapped/sharded grid solve over every
+    alpha (cpr_tpu.mdp.grid), instead of a compile+solve loop per
+    point.  Emits the same per-point row schema (`model` matches the
+    serial battery's labels; compile_s/vi_s are the group totals
+    amortized over its points, with the raw group totals alongside) so
+    existing TSV consumers diff cleanly against measure_rows.  The
+    per-point fixpoints — and hence revenue — are those of a solo
+    chunked solve of the same revalued tensor, bit-for-bit."""
+    from cpr_tpu.mdp.grid import (compile_protocol, grid_value_iteration,
+                                  param_ptmdp)
+
+    if groups is None:
+        groups = battery_groups()
+    rows = []
+    gammas = (gamma,)
+    for protocol, cutoff, kw, stem in groups:
+        t0 = now()
+        pm = param_ptmdp(compile_protocol(protocol, cutoff=cutoff, **kw),
+                         horizon=horizon)
+        compile_s = now() - t0
+        shared = {"n_states": pm.n_states,
+                  "n_transitions": pm.n_transitions}
+        if pm.n_transitions > max_transitions:
+            rows.extend([dict(model=f"{stem}-{a}", compile_s=compile_s,
+                              skipped="transition cap", **shared)
+                         for a in alphas])
+            continue
+        vi = grid_value_iteration(pm, alphas, gammas,
+                                  stop_delta=stop_delta, mesh=mesh,
+                                  protocol=protocol, cutoff=cutoff)
+        n = len(vi["grid_points"])
+        for i, (a, _) in enumerate(vi["grid_points"]):
+            rows.append(dict(
+                model=f"{stem}-{a}", compile_s=compile_s / n,
+                vi_s=vi["vi_time"] / n, vi_iter=int(vi["grid_iter"][i]),
+                revenue=float(vi["grid_revenue"][i]),
+                group_compile_s=compile_s,
+                group_vi_s=vi["vi_time"], group_points=n, **shared))
+    return rows

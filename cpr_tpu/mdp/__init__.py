@@ -15,5 +15,18 @@ from cpr_tpu.mdp.implicit import Effect, Model, PTOWrapper, Transition  # noqa: 
 from cpr_tpu.mdp.compiler import Compiler  # noqa: F401
 from cpr_tpu.mdp.explicit import MDP, TensorMDP, ptmdp  # noqa: F401
 from cpr_tpu.mdp.explorer import Explorer  # noqa: F401
+from cpr_tpu.mdp.grid import (  # noqa: F401
+    Param,
+    ParamError,
+    ParamMDP,
+    check_revalue_parity,
+    compile_protocol,
+    grid_value_iteration,
+    param_pair,
+    param_ptmdp,
+    parametric_compile,
+    parametric_compile_native,
+    solve_grid_cached,
+)
 from cpr_tpu.mdp.rtdp import RTDP  # noqa: F401
 from cpr_tpu.mdp import generic  # noqa: F401

@@ -66,6 +66,7 @@ class MDP:
     def add_transition(self, src: int, act: int, dst: int, *, probability: float,
                        reward: float, progress: float):
         assert src >= 0 and dst >= 0 and act >= 0
+        self._arrays_cache = None  # invalidate materialized columns
         self.n_states = max(self.n_states, src + 1, dst + 1)
         self.n_actions = max(self.n_actions, act + 1)
         self.src.append(src)
@@ -76,7 +77,15 @@ class MDP:
         self.progress.append(progress)
 
     def arrays(self):
-        return (
+        """Materialized COO columns, cached: check()/tensor()/ptmdp and
+        the parametric grid pipeline all call this, and rebuilding six
+        numpy arrays from Python lists per call dominates for
+        multi-million-transition native compiles.  add_transition
+        invalidates; callers must treat the tuple as read-only."""
+        cached = getattr(self, "_arrays_cache", None)
+        if cached is not None:
+            return cached
+        out = (
             np.asarray(self.src, np.int32),
             np.asarray(self.act, np.int32),
             np.asarray(self.dst, np.int32),
@@ -84,11 +93,54 @@ class MDP:
             np.asarray(self.reward, np.float64),
             np.asarray(self.progress, np.float64),
         )
+        self._arrays_cache = out
+        return out
 
     def check(self) -> bool:
         """Invariant check (mirrors mdp/lib/explicit_mdp.py:63-95):
         start distribution sums to one, per-(state,action) outgoing
-        probabilities sum to one, actions are contiguous per state."""
+        probabilities sum to one, actions are contiguous per state.
+
+        Runs on the sorted (src, act) key pairs via group-boundary
+        reduceat — O(T log T) time, O(T) memory — instead of two dense
+        S x A host planes, so checking a multi-million-transition
+        native compile stays cheap even for sparse action sets
+        (check_dense keeps the old dense implementation as the parity
+        oracle)."""
+        src, act, dst, prob, _, _ = self.arrays()
+        assert sum_to_one(self.start.values())
+        for s in self.start:
+            assert 0 <= s < self.n_states
+        key = src.astype(np.int64) * self.n_actions + act
+        if len(key):
+            order = np.argsort(key, kind="stable")
+            ks = key[order]
+            first = np.ones(len(ks), dtype=bool)
+            first[1:] = ks[1:] != ks[:-1]
+            group = np.flatnonzero(first)
+            uniq = ks[group]
+            sums = np.add.reduceat(prob[order], group)
+            bad = ~np.isclose(sums, 1.0, rtol=1e-9)
+            assert not bad.any(), \
+                f"probabilities do not sum to 1 at {uniq[bad]}"
+            # action contiguity per state: the distinct action ids of a
+            # state must be exactly {0..max}; with uniq sorted and
+            # deduplicated, that is max == count - 1 per state group
+            state = uniq // self.n_actions
+            acts = uniq % self.n_actions
+            sfirst = np.ones(len(uniq), dtype=bool)
+            sfirst[1:] = state[1:] != state[:-1]
+            sgroup = np.flatnonzero(sfirst)
+            amax = np.maximum.reduceat(acts, sgroup)
+            count = np.diff(np.append(sgroup, len(uniq)))
+            assert (amax == count - 1).all(), "non-contiguous actions"
+        assert dst.max(initial=-1) < self.n_states
+        return True
+
+    def check_dense(self) -> bool:
+        """The original dense S x A invariant check — kept as the
+        parity oracle for check() (tests/test_mdp_grid.py); O(S*A)
+        memory, do not call on large sparse compiles."""
         src, act, dst, prob, _, _ = self.arrays()
         assert sum_to_one(self.start.values())
         for s in self.start:
@@ -495,6 +547,141 @@ def vi_chunked(src, act, dst, prob, reward, progress, S, A, discount,
                             max_iter, chunk, accel_m=accel_m,
                             checkpoint_path=checkpoint_path,
                             checkpoint_every=checkpoint_every)
+
+
+def make_grid_vi_chunk(S: int, A: int, reduce=lambda x: x):
+    """Grid-batched twin of make_vi_chunk: one chunk of Bellman sweeps
+    vmapped over a [G] grid axis — shared (src, act, dst, reward,
+    progress) structure, per-point probability columns [G, T] and
+    per-point (value, prog, policy) planes [G, S] riding in the carry.
+
+    Per-point convergence masking: `frozen` [G] bools bit-freeze a
+    converged point's carry exactly like held serve lanes — the chunk
+    runs unconditionally (no ragged compute on device) and the outputs
+    of frozen points are replaced by their inputs at chunk end, so a
+    point frozen after the chunk where its last in-chunk delta crossed
+    stop_delta holds exactly the solo vi_chunked fixpoint (the solo
+    driver also only stops at chunk boundaries).  Frozen points report
+    delta 0 so the host driver's history stays interpretable.
+
+    The valid-action masks are recomputed per chunk inside the program
+    (one segment_sum per point per chunk — noise next to chunk*2
+    backup segment_sums) rather than carried as [G, S, A] planes."""
+    chunk_body = make_vi_chunk(S, A, reduce)
+
+    def grid_chunk(carry, src, act, dst, probs, reward, progress,
+                   discount, frozen, chunk):
+        value, prog, pol = carry
+
+        def per_point(prob, v, p):
+            valid, any_valid = _valid_actions(src, act, prob, S, A,
+                                              reduce)
+            return chunk_body(src, act, dst, prob, reward, progress,
+                              valid, any_valid, discount, v, p, chunk)
+
+        v2, p2, pol2, deltas = jax.vmap(per_point)(probs, value, prog)
+        fz = frozen[:, None]
+        v2 = jnp.where(fz, value, v2)
+        p2 = jnp.where(fz, prog, p2)
+        pol2 = jnp.where(fz, pol, pol2)
+        deltas = jnp.where(fz, jnp.zeros_like(deltas), deltas)
+        return (v2, p2, pol2), deltas
+
+    return grid_chunk
+
+
+def run_grid_chunk_driver(chunk_step, place, G, S, dtype, stop_delta,
+                          max_iter, chunk: int = 64,
+                          checkpoint_path: str | None = None,
+                          checkpoint_every: int = 1):
+    """Host loop for grid-batched chunked VI — run_chunk_driver's
+    semantics (full chunks with a chunk=1 tail, with_retries around
+    each dispatch, between-chunk checkpoint/resume) at grid
+    granularity: per-point convergence is tracked host-side and fed
+    back as the `frozen` mask, and the whole grid stops when every
+    point froze or max_iter sweeps ran.
+
+    `chunk_step(carry, frozen, steps) -> (carry, deltas[G, steps])`
+    with carry = (value, prog, policy) planes [G, S] (the policy rides
+    in the carry so a frozen point keeps its converged policy across
+    later chunks); `place(x)` device-puts a host array under the
+    caller's grid sharding (identity for single-device).
+
+    Returns (value, prog, policy, delta[G], conv_iter[G], converged[G],
+    it, resid[G, it]) — conv_iter is the sweep count at which each
+    point froze (chunk-boundary granularity; the full budget for
+    unconverged points)."""
+    from cpr_tpu import resilience, telemetry
+
+    np_dtype = np.dtype(dtype)
+    value = np.zeros((G, S), np_dtype)
+    prog = np.zeros((G, S), np_dtype)
+    pol = np.full((G, S), -1, np.int32)
+    frozen = np.zeros(G, dtype=bool)
+    conv_it = np.zeros(G, np.int64)
+    final_delta = np.full(G, np.inf)
+    it = 0
+    resids: list = []
+    if checkpoint_path is not None and os.path.exists(checkpoint_path):
+        st = resilience.load_grid_vi_checkpoint(
+            checkpoint_path, G=G, S=S, dtype=np_dtype)
+        value, prog, pol = st["value"], st["prog"], st["pol"]
+        frozen = st["frozen"].copy()
+        conv_it = st["conv_it"].copy()
+        final_delta = st["final_delta"].copy()
+        it = int(st["it"])
+        resids = [st["resid"]] if st["resid"].size else []
+        telemetry.current().event("resume", path=checkpoint_path,
+                                  update=it, scope="grid_vi")
+    carry = (place(value), place(prog), place(pol))
+    chunks_done = 0
+    while it < max_iter and not bool(frozen.all()):
+        step = chunk if max_iter - it >= chunk else 1
+        frozen_dev = place(frozen)
+        prev_carry = carry
+
+        def one_chunk():
+            resilience.fault_point("vi_chunk")
+            return chunk_step(prev_carry, frozen_dev, step)
+
+        carry, deltas = resilience.with_retries(
+            one_chunk, max_attempts=3, base_delay_s=0.2, max_delay_s=5.0,
+            name="grid_vi_chunk")
+        it += step
+        # the convergence check syncs on the chunk anyway; the full
+        # [G, step] delta plane is the residual history
+        d = np.asarray(deltas)
+        resids.append(d)
+        last = d[:, -1]
+        live = ~frozen
+        final_delta[live] = last[live]
+        newly = live & (last <= float(stop_delta))
+        conv_it[newly] = it
+        frozen |= newly
+        chunks_done += 1
+        if (checkpoint_path is not None and not bool(frozen.all())
+                and chunks_done % checkpoint_every == 0):
+            resilience.save_grid_vi_checkpoint(
+                checkpoint_path, value=np.asarray(carry[0]),
+                prog=np.asarray(carry[1]), pol=np.asarray(carry[2]),
+                frozen=frozen, conv_it=conv_it,
+                final_delta=final_delta, it=it, resids=resids,
+                stop_delta=float(stop_delta))
+            telemetry.current().event("checkpoint", path=checkpoint_path,
+                                      what="grid_vi", update=it)
+    if checkpoint_path is not None and os.path.exists(checkpoint_path):
+        # crash-recovery scratch only, exactly like run_chunk_driver
+        os.unlink(checkpoint_path)
+        try:
+            os.unlink(checkpoint_path + ".json")
+        except OSError:
+            pass
+    conv_it[~frozen] = it  # unconverged points ran the whole budget
+    resid = (np.concatenate(resids, axis=1) if resids
+             else np.zeros((G, 0), np_dtype))
+    return (np.asarray(carry[0]), np.asarray(carry[1]),
+            np.asarray(carry[2]), final_delta, conv_it, frozen.copy(),
+            it, resid)
 
 
 @partial(jax.jit, static_argnums=(6, 9))

@@ -1,0 +1,651 @@
+"""Parametric MDP compile + grid-batched value iteration.
+
+The exact-analysis sweeps (measure_mdp battery, break-even curves, the
+paper's alpha x gamma figures) all share one shape: for a FIXED
+protocol + cutoff the transition structure (src, act, dst, reward,
+progress) is identical across the whole grid — only the probability
+column changes, and it changes as a *monomial* in alpha, 1-alpha,
+gamma, 1-gamma (mdp/models/bitcoin_sm.py: every edge is literally
+`self.alpha`, `self.gamma * (1.0 - self.alpha)`, ...).  Today every
+grid point recompiles its own MDP from scratch (host BFS or the
+native C++ compiler) and solves it in its own serial value_iteration
+call.
+
+This module amortizes both:
+
+* **Parametric compile** — bind the implicit models' alpha/gamma to a
+  tiny monomial tracer (`Param`: supports `*`, `1 - x`, float
+  coefficients) so ONE BFS yields a `ParamMDP`: the usual flat COO
+  columns plus per-transition exponents (i, j, k, l) and coefficient
+  such that `prob = c * alpha^i (1-alpha)^j gamma^k (1-gamma)^l`.
+  `revalue(alpha, gamma)` then materializes any grid point's
+  probability column in one vectorized expression.  The native C++
+  compiler is covered by a parallel exponent-columns path
+  (`parametric_compile_native`): it forms alpha/gamma-dependent
+  probabilities at exactly one site (the Continue mining/communication
+  split `pc * pm`, plus the loop_honest start split) and never merges
+  same-destination transitions, so exponents are recovered exactly by
+  matching each probe-point probability against the closed key set.
+
+* **Grid solve** — `grid_value_iteration` stacks the revalued columns
+  into a [G, T] plane and runs the chunked VI sweep vmapped over the
+  grid axis (mdp/explicit.py `make_grid_vi_chunk`), with per-point
+  convergence masking (converged points bit-freeze their value/prog/
+  policy like held serve lanes) and the grid axis optionally sharded
+  over the device mesh (cpr_tpu/parallel/grid.py — embarrassingly
+  parallel, no per-sweep collective).  Per-point fixpoints are
+  bit-identical to solo `vi_chunked` solves of the same tensor
+  (tests/test_mdp_grid.py, `make mdp-smoke`).
+
+`check_revalue_parity` is the correctness guard: revalued columns must
+match a fresh compile at probe points.  `solve_grid_cached` serves
+whole solved grids (optimal-policy tables included) through a
+content-fingerprint disk cache — the serve `mdp.solve_grid` op and the
+break-even exact mode sit on top of it.  docs/MDP.md documents the
+contract.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from cpr_tpu.mdp.compiler import Compiler
+from cpr_tpu.mdp.explicit import MDP, ptmdp
+from cpr_tpu.telemetry import now
+
+# interior probe values for the tracer / exponent recovery: any
+# 0 < alpha < 0.5, 0 < gamma < 1 pair works for the Python tracer;
+# exponent recovery additionally needs the 9 monomial keys pairwise
+# distinct (asserted at compile time), which these irrational-looking
+# values guarantee with huge margin
+PROBE_ALPHA = 0.3137557218
+PROBE_GAMMA = 0.7243031127
+
+_ONE = (0, 0, 0, 0)
+# 1 - x on a pure coefficient-1 single-variable monomial flips it to
+# the complementary variable: 1 - a = (1-a), 1 - (1-a) = a, same for g
+_COMPLEMENT = {
+    (1, 0, 0, 0): (0, 1, 0, 0),
+    (0, 1, 0, 0): (1, 0, 0, 0),
+    (0, 0, 1, 0): (0, 0, 0, 1),
+    (0, 0, 0, 1): (0, 0, 1, 0),
+}
+
+
+class ParamError(TypeError):
+    """An implicit model used alpha/gamma outside the monomial algebra
+    the parametric compile supports (products and 1-x only)."""
+
+
+class Param:
+    """Monomial tracer: `coef * alpha^i (1-alpha)^j gamma^k (1-gamma)^l`.
+
+    Supports exactly the algebra the implicit models use on their
+    parameters — multiplication (by numbers and other monomials) and
+    the complement `1 - x` of a bare variable — plus the float-context
+    operations the compiler's validation needs (float(), comparisons,
+    and addition, which exits to plain probe-value floats: the
+    compiler only ever SUMS probabilities to check them against 1).
+    Anything else raises ParamError so an unsupported model fails the
+    compile loudly instead of mis-tracing."""
+
+    __slots__ = ("coef", "expo", "value")
+
+    def __init__(self, coef: float, expo: tuple, value: float):
+        self.coef = float(coef)
+        self.expo = tuple(int(e) for e in expo)
+        self.value = float(value)
+
+    def __repr__(self):
+        i, j, k, l = self.expo
+        return (f"Param({self.coef:g} * a^{i} (1-a)^{j} g^{k} (1-g)^{l}"
+                f" = {self.value:g})")
+
+    # -- the supported algebra -------------------------------------------
+
+    def _mul(self, other):
+        if isinstance(other, Param):
+            return Param(self.coef * other.coef,
+                         tuple(a + b for a, b in zip(self.expo,
+                                                     other.expo)),
+                         self.value * other.value)
+        if isinstance(other, (int, float)):
+            return Param(self.coef * other, self.expo,
+                         self.value * other)
+        return NotImplemented
+
+    __mul__ = _mul
+    __rmul__ = _mul
+
+    def __rsub__(self, other):
+        comp = _COMPLEMENT.get(self.expo)
+        if (isinstance(other, (int, float)) and float(other) == 1.0
+                and self.coef == 1.0 and comp is not None):
+            return Param(1.0, comp, 1.0 - self.value)
+        raise ParamError(
+            f"parametric compile only supports 1 - x on a bare "
+            f"alpha/gamma monomial, got {other!r} - {self!r}")
+
+    def __sub__(self, other):
+        raise ParamError(
+            f"parametric compile does not support {self!r} - {other!r}")
+
+    # addition exits the parametric domain: the compiler and the
+    # models only sum probabilities to VALIDATE them (sum_to_one),
+    # never to build a transition probability
+    def _add(self, other):
+        return self.value + float(other)
+
+    __add__ = _add
+    __radd__ = _add
+
+    # -- float-context plumbing ------------------------------------------
+
+    def __float__(self):
+        return self.value
+
+    def __bool__(self):
+        return self.value != 0.0
+
+    def __eq__(self, other):
+        if isinstance(other, Param):
+            return (self.coef, self.expo) == (other.coef, other.expo)
+        if isinstance(other, (int, float)):
+            return self.value == float(other)
+        return NotImplemented
+
+    def __hash__(self):
+        return hash((self.coef, self.expo))
+
+    def __lt__(self, other):
+        return self.value < float(other)
+
+    def __le__(self, other):
+        return self.value <= float(other)
+
+    def __gt__(self, other):
+        return self.value > float(other)
+
+    def __ge__(self, other):
+        return self.value >= float(other)
+
+
+def param_pair(probe_alpha: float = PROBE_ALPHA,
+               probe_gamma: float = PROBE_GAMMA):
+    """(alpha, gamma) tracer pair to bind into an implicit model."""
+    assert 0.0 < probe_alpha < 0.5 and 0.0 < probe_gamma < 1.0
+    return (Param(1.0, (1, 0, 0, 0), probe_alpha),
+            Param(1.0, (0, 0, 1, 0), probe_gamma))
+
+
+@dataclass(frozen=True)
+class ParamMDP:
+    """A compiled MDP whose probability column is symbolic in
+    (alpha, gamma): `mdp` holds the shared structure with the
+    PROBE-point probabilities (a fully valid MDP — check() passed on
+    it), and `prob[t] = coef[t] * alpha^expo[t,0] (1-alpha)^expo[t,1]
+    * gamma^expo[t,2] (1-gamma)^expo[t,3]` for any grid point.  The
+    start distribution is parametric too (fc16's stochastic start is
+    {alpha, 1-alpha})."""
+
+    mdp: MDP
+    coef: np.ndarray          # [T] float64
+    expo: np.ndarray          # [T, 4] int16
+    start_ids: np.ndarray     # [n_start] int32
+    start_coef: np.ndarray    # [n_start] float64
+    start_expo: np.ndarray    # [n_start, 4] int16
+    probe_alpha: float
+    probe_gamma: float
+    meta: dict = field(default_factory=dict)
+
+    @property
+    def n_states(self) -> int:
+        return self.mdp.n_states
+
+    @property
+    def n_transitions(self) -> int:
+        return self.mdp.n_transitions
+
+    def __repr__(self):
+        return (f"ParamMDP({self.mdp!r}, probe=({self.probe_alpha:g}, "
+                f"{self.probe_gamma:g}), meta={self.meta})")
+
+    @staticmethod
+    def _monomial(coef, expo, alpha: float, gamma: float) -> np.ndarray:
+        a, g = float(alpha), float(gamma)
+        e = expo
+        return (coef * a ** e[:, 0] * (1.0 - a) ** e[:, 1]
+                * g ** e[:, 2] * (1.0 - g) ** e[:, 3])
+
+    def revalue(self, alpha: float, gamma: float) -> np.ndarray:
+        """The [T] float64 probability column at (alpha, gamma) — one
+        vectorized monomial evaluation, no recompile."""
+        return self._monomial(self.coef, self.expo, alpha, gamma)
+
+    def start_vector(self, alpha: float, gamma: float) -> np.ndarray:
+        """The [S] float64 start distribution at (alpha, gamma)."""
+        s = np.zeros(self.n_states, np.float64)
+        s[self.start_ids] = self._monomial(self.start_coef,
+                                           self.start_expo, alpha, gamma)
+        return s
+
+    def fingerprint(self) -> str:
+        """Content hash of the parametric compile — the solve-cache
+        key (solve_grid_cached): two compiles whose structure,
+        exponents, or coefficients differ in any way (model fix,
+        compiler change, different cutoff) can never share a cached
+        solve."""
+        src, act, dst, _, reward, progress = self.mdp.arrays()
+        h = hashlib.sha256()
+        h.update(repr((self.mdp.n_states, self.mdp.n_actions,
+                       self.probe_alpha, self.probe_gamma,
+                       sorted(self.meta.items()))).encode())
+        for arr in (src, act, dst, reward, progress, self.coef,
+                    self.expo, self.start_ids, self.start_coef,
+                    self.start_expo):
+            h.update(np.ascontiguousarray(arr).tobytes())
+        return h.hexdigest()[:24]
+
+
+def _extract_param(p, what: str):
+    """(coef, expo) of one traced probability; plain floats are
+    constant monomials."""
+    if isinstance(p, Param):
+        return p.coef, p.expo
+    if isinstance(p, (int, float)):
+        return float(p), _ONE
+    raise ParamError(f"{what} is {type(p).__name__}, expected a "
+                     f"Param monomial or a plain number")
+
+
+def _param_mdp_from(mdp: MDP, probe_alpha: float, probe_gamma: float,
+                    meta: dict) -> ParamMDP:
+    """Split a tracer-compiled MDP into probe-valued float columns +
+    (coef, expo) parametric columns."""
+    coef = np.empty(mdp.n_transitions, np.float64)
+    expo = np.empty((mdp.n_transitions, 4), np.int16)
+    for t, p in enumerate(mdp.prob):
+        coef[t], expo[t] = _extract_param(p, f"transition {t} prob")
+    start_ids = np.asarray(sorted(mdp.start), np.int32)
+    start_coef = np.empty(len(start_ids), np.float64)
+    start_expo = np.empty((len(start_ids), 4), np.int16)
+    for i, sid in enumerate(start_ids):
+        start_coef[i], start_expo[i] = _extract_param(
+            mdp.start[int(sid)], f"start prob of state {sid}")
+    # re-materialize the base MDP with plain probe-valued floats so
+    # downstream tensor()/ptmdp/check() see an ordinary MDP
+    src, act, dst, prob, reward, progress = mdp.arrays()
+    base = MDP(n_states=mdp.n_states, n_actions=mdp.n_actions,
+               start={int(s): float(p) for s, p in mdp.start.items()},
+               src=src, act=act, dst=dst, prob=prob, reward=reward,
+               progress=progress)
+    return ParamMDP(mdp=base, coef=coef, expo=expo,
+                    start_ids=start_ids, start_coef=start_coef,
+                    start_expo=start_expo, probe_alpha=probe_alpha,
+                    probe_gamma=probe_gamma, meta=dict(meta))
+
+
+def parametric_compile(factory, *, probe_alpha: float = PROBE_ALPHA,
+                       probe_gamma: float = PROBE_GAMMA,
+                       meta: dict | None = None) -> ParamMDP:
+    """One Python-BFS compile of `factory(alpha=<tracer>,
+    gamma=<tracer>)` -> ParamMDP.  The model runs unmodified — its
+    probability expressions evaluate in the monomial tracer domain, so
+    BFS order, state ids, and transition order are exactly those of a
+    fresh compile at the probe point (the models' control flow depends
+    on alpha/gamma only through comparisons, which the tracer answers
+    with its probe value)."""
+    a, g = param_pair(probe_alpha, probe_gamma)
+    model = factory(alpha=a, gamma=g)
+    mdp = Compiler(model).mdp()
+    return _param_mdp_from(mdp, probe_alpha, probe_gamma, meta or {})
+
+
+def _native_keys(a: float, g: float):
+    """The closed set of probability values the native generic
+    compiler can emit at probe point (a, g), with their exponents.
+    Verified against cpr_tpu/native/src/generic_compiler.cpp: alpha/
+    gamma enter transition probabilities ONLY at the Continue action
+    (`pc[ci] * pm[mi]` over pc = {g, 1-g}, pm = {a, 1-a}), Release/
+    Consider are deterministic (prob 1), start probabilities under
+    loop_honest are {a, 1-a}, and same-destination transitions are
+    never merged — so every emitted probability is exactly one of
+    these 9 IEEE doubles."""
+    return [
+        (1.0, _ONE),
+        (a, (1, 0, 0, 0)),
+        (1.0 - a, (0, 1, 0, 0)),
+        (g, (0, 0, 1, 0)),
+        (1.0 - g, (0, 0, 0, 1)),
+        (g * a, (1, 0, 1, 0)),
+        (g * (1.0 - a), (0, 1, 1, 0)),
+        ((1.0 - g) * a, (1, 0, 0, 1)),
+        ((1.0 - g) * (1.0 - a), (0, 1, 0, 1)),
+    ]
+
+
+def parametric_compile_native(proto: str, *, k: int = 0,
+                              probe_alpha: float = PROBE_ALPHA,
+                              probe_gamma: float = PROBE_GAMMA,
+                              meta: dict | None = None,
+                              **kw) -> ParamMDP:
+    """ParamMDP from ONE native (C++) compile at the probe point: the
+    exponent columns are recovered by matching each emitted
+    probability against the closed native key set (_native_keys) —
+    exact, because the compiler forms those values with the same IEEE
+    double expressions.  Any probability outside the key set aborts
+    (a compiler change that widened the probability algebra must fail
+    loudly, not mis-parameterize)."""
+    from cpr_tpu.mdp.generic.native import compile_native
+
+    mdp = compile_native(proto, k=k, alpha=probe_alpha,
+                         gamma=probe_gamma, **kw)
+    keys = _native_keys(probe_alpha, probe_gamma)
+    vals = np.asarray([v for v, _ in keys])
+    expos = np.asarray([e for _, e in keys], np.int16)
+    assert len(np.unique(vals)) == len(vals), \
+        "probe point produced colliding native keys; pick another"
+
+    def match(col, what):
+        col = np.asarray(col, np.float64)
+        idx = np.abs(col[:, None] - vals[None, :]).argmin(axis=1)
+        bad = ~np.isclose(col, vals[idx], rtol=1e-12, atol=0.0)
+        if bad.any():
+            t = int(np.flatnonzero(bad)[0])
+            raise ParamError(
+                f"native {what} {t} has probability {col[t]!r} outside "
+                f"the known monomial key set — the native compiler's "
+                f"probability algebra changed; update _native_keys")
+        return idx
+
+    prob = np.asarray(mdp.prob, np.float64)
+    idx = match(prob, "transition")
+    # the key table is coefficient-1; the emitted value IS the
+    # monomial, so coef is the ratio (exactly 1 in IEEE terms)
+    coef = np.ones(len(prob), np.float64)
+    expo = expos[idx]
+    start_ids = np.asarray(sorted(mdp.start), np.int32)
+    start_vals = np.asarray([mdp.start[int(s)] for s in start_ids])
+    sidx = match(start_vals, "start entry")
+    base = MDP(n_states=mdp.n_states, n_actions=mdp.n_actions,
+               start={int(s): float(p)
+                      for s, p in zip(start_ids, start_vals)},
+               src=mdp.src, act=mdp.act, dst=mdp.dst, prob=mdp.prob,
+               reward=mdp.reward, progress=mdp.progress)
+    m = dict(meta or {}, proto=proto, k=k)
+    return ParamMDP(mdp=base, coef=coef, expo=expo,
+                    start_ids=start_ids,
+                    start_coef=np.ones(len(start_ids), np.float64),
+                    start_expo=expos[sidx], probe_alpha=probe_alpha,
+                    probe_gamma=probe_gamma, meta=m)
+
+
+def param_ptmdp(pm: ParamMDP, *, horizon: int) -> ParamMDP:
+    """Parametric twin of explicit.ptmdp: the PTO continue probability
+    `keep = (1 - 1/horizon)^progress` is a CONSTANT per transition
+    (progress does not depend on alpha/gamma), so the transform only
+    scales coefficients — continue rows by keep, the appended terminal
+    rows by (1 - keep) — with exponents carried through unchanged.
+    The base MDP goes through explicit.ptmdp itself, so row order
+    matches by construction."""
+    base = ptmdp(pm.mdp, horizon=horizon)
+    _, _, _, _, _, progress = pm.mdp.arrays()
+    keep = (1.0 - 1.0 / horizon) ** progress
+    hp = progress != 0.0
+    coef = np.concatenate([np.where(hp, pm.coef * keep, pm.coef),
+                           (pm.coef * (1.0 - keep))[hp]])
+    expo = np.concatenate([pm.expo, pm.expo[hp]])
+    return ParamMDP(mdp=base, coef=coef, expo=expo,
+                    start_ids=pm.start_ids, start_coef=pm.start_coef,
+                    start_expo=pm.start_expo,
+                    probe_alpha=pm.probe_alpha,
+                    probe_gamma=pm.probe_gamma,
+                    meta=dict(pm.meta, horizon=horizon))
+
+
+def check_revalue_parity(pm: ParamMDP, fresh, points, *,
+                         rtol: float = 1e-9) -> int:
+    """The parity guard: for each (alpha, gamma) probe point, a FRESH
+    compile via `fresh(alpha, gamma) -> MDP` must have identical
+    state/transition counts and a probability column allclose (tight
+    rtol, atol 0) to `pm.revalue(alpha, gamma)`; start distributions
+    likewise.  Returns the number of points checked.  Probe at
+    INTERIOR points: at gamma in {0, 1} the generic models skip
+    zero-probability branches, so a fresh compile has a different
+    (smaller) transition set — the revalued column is still correct
+    there (the extra rows carry probability 0), it just cannot be
+    compared row-for-row."""
+    n = 0
+    for alpha, gamma in points:
+        m = fresh(alpha, gamma)
+        if not isinstance(m, MDP):
+            m = Compiler(m).mdp()
+        if (m.n_states, m.n_transitions) != (pm.n_states,
+                                             pm.n_transitions):
+            raise AssertionError(
+                f"parametric compile diverges from fresh compile at "
+                f"({alpha}, {gamma}): {pm.n_states}/{pm.n_transitions} "
+                f"vs {m.n_states}/{m.n_transitions} states/transitions")
+        got = pm.revalue(alpha, gamma)
+        want = m.arrays()[3]
+        if not np.allclose(got, want, rtol=rtol, atol=0.0):
+            worst = int(np.abs(got - want).argmax())
+            raise AssertionError(
+                f"revalued probability column diverges at "
+                f"({alpha}, {gamma}), transition {worst}: "
+                f"{got[worst]!r} vs fresh {want[worst]!r}")
+        sv = pm.start_vector(alpha, gamma)
+        for sid, p in m.start.items():
+            if not np.isclose(sv[sid], float(p), rtol=rtol, atol=0.0):
+                raise AssertionError(
+                    f"start prob of state {sid} diverges at "
+                    f"({alpha}, {gamma}): {sv[sid]!r} vs {float(p)!r}")
+        n += 1
+    return n
+
+
+# -- the grid solver ---------------------------------------------------------
+
+
+def grid_points(alphas, gammas):
+    """The row-major (alpha-major) point list both the solver and its
+    callers index by."""
+    alphas = [float(a) for a in np.atleast_1d(alphas)]
+    gammas = [float(g) for g in np.atleast_1d(gammas)]
+    return alphas, gammas, [(a, g) for a in alphas for g in gammas]
+
+
+def grid_value_iteration(pm: ParamMDP, alphas, gammas, *,
+                         discount: float = 1.0, eps: float | None = None,
+                         stop_delta: float | None = None,
+                         max_iter: int = 0, chunk: int = 64,
+                         dtype=None, mesh=None, axis: str = "d",
+                         checkpoint_path: str | None = None,
+                         checkpoint_every: int = 1,
+                         protocol: str | None = None,
+                         cutoff: int | None = None) -> dict:
+    """Solve the whole (alphas x gammas) grid as ONE vmapped (and
+    optionally grid-axis-sharded) chunked-VI program over `pm`'s
+    shared transition structure.
+
+    Semantics per point match `TensorMDP.value_iteration(impl=
+    "chunked")` on the revalued tensor bit-for-bit: same chunk
+    schedule, same stop rule at chunk granularity — a converged point
+    is bit-frozen (value/prog/policy passed through unchanged) while
+    the rest of the grid keeps sweeping.  `mesh` shards the [G] grid
+    axis via cpr_tpu.parallel.make_grid_chunk_step (G must divide the
+    axis; refused up front).  checkpoint_path/checkpoint_every give
+    per-grid-solve crash checkpoints + resume
+    (resilience.save_grid_vi_checkpoint).
+
+    Emits one typed `mdp_solve` telemetry event (schema v10) with the
+    protocol/cutoff labels, grid shape, total sweeps, and per-point
+    convergence count.  Returns a dict of grid-major arrays (see
+    docs/MDP.md)."""
+    import jax.numpy as jnp
+
+    from cpr_tpu import telemetry
+    from cpr_tpu.mdp.explicit import run_grid_chunk_driver
+    from cpr_tpu.parallel.grid import make_grid_chunk_step
+
+    dtype = jnp.float32 if dtype is None else dtype
+    alphas, gammas, points = grid_points(alphas, gammas)
+    G = len(points)
+    assert G > 0, "empty grid"
+    tm = pm.mdp.tensor(dtype)
+    stop_delta = tm.resolve_stop_delta(discount=discount, eps=eps,
+                                       stop_delta=stop_delta,
+                                       max_iter=max_iter)
+    tm._check_segment_width()
+    t0 = now()
+    probs = np.stack([pm.revalue(a, g) for a, g in points])
+    starts = np.stack([pm.start_vector(a, g) for a, g in points])
+    chunk_step, place = make_grid_chunk_step(tm, G, discount=discount,
+                                             mesh=mesh, axis=axis)
+    probs_dev = place(probs.astype(np.dtype(tm.prob.dtype)))
+
+    def step(carry, frozen, steps):
+        return chunk_step(carry, probs_dev, frozen, steps)
+
+    value, prog, policy, delta, conv_it, converged, it, resid = \
+        run_grid_chunk_driver(
+            step, place, G, pm.n_states, tm.prob.dtype, stop_delta,
+            max_iter if max_iter > 0 else (1 << 30), chunk=chunk,
+            checkpoint_path=checkpoint_path,
+            checkpoint_every=checkpoint_every)
+    vi_time = now() - t0
+    # per-point revenue: expected reward / expected progress from the
+    # point's own start distribution (fc16 starts are alpha-dependent)
+    num = (starts * value).sum(axis=1)
+    den = (starts * prog).sum(axis=1)
+    revenue = np.divide(num, den, out=np.zeros_like(num),
+                        where=den != 0.0)
+    telemetry.current().event(
+        "mdp_solve", protocol=protocol, cutoff=cutoff,
+        grid=[len(alphas), len(gammas)], sweeps=int(it),
+        converged=int(converged.sum()), points=G,
+        n_states=pm.n_states, n_transitions=pm.n_transitions,
+        n_devices=(int(mesh.shape[axis]) if mesh is not None else 1),
+        solve_s=round(vi_time, 6),
+        points_per_sec=round(G / vi_time, 3) if vi_time > 0 else None)
+    return dict(
+        grid_alphas=alphas, grid_gammas=gammas, grid_points=points,
+        grid_value=value, grid_progress=prog, grid_policy=policy,
+        grid_start=starts, grid_revenue=revenue, grid_delta=delta,
+        grid_iter=conv_it, grid_converged=converged,
+        vi_iter=int(it), vi_stop_delta=float(stop_delta),
+        vi_residuals=resid, vi_time=vi_time,
+    )
+
+
+# -- protocol registry + cached solves ---------------------------------------
+
+
+def compile_protocol(protocol: str, *, cutoff: int, k: int = 2,
+                     native: bool = False,
+                     probe_alpha: float = PROBE_ALPHA,
+                     probe_gamma: float = PROBE_GAMMA) -> ParamMDP:
+    """Parametric compile of one battery protocol family: "fc16" /
+    "aft20" (maximum_fork_length=cutoff, Python BFS) or "bitcoin" /
+    "ghostdag" (generic model, dag_size_cutoff=cutoff; `native=True`
+    uses the C++ compiler's exponent-recovery path)."""
+    meta = dict(protocol=protocol, cutoff=int(cutoff))
+    if protocol in ("fc16", "aft20"):
+        from cpr_tpu.mdp.models import Aft20BitcoinSM, Fc16BitcoinSM
+
+        cls = Fc16BitcoinSM if protocol == "fc16" else Aft20BitcoinSM
+        return parametric_compile(
+            lambda alpha, gamma: cls(alpha=alpha, gamma=gamma,
+                                     maximum_fork_length=cutoff),
+            probe_alpha=probe_alpha, probe_gamma=probe_gamma, meta=meta)
+    if protocol in ("bitcoin", "ghostdag"):
+        kk = k if protocol == "ghostdag" else 0
+        if native:
+            return parametric_compile_native(
+                protocol, k=kk, probe_alpha=probe_alpha,
+                probe_gamma=probe_gamma, collect_garbage="simple",
+                dag_size_cutoff=cutoff, meta=meta)
+        from cpr_tpu.mdp.generic import SingleAgent, get_protocol
+
+        kw = {"k": kk} if protocol == "ghostdag" else {}
+        return parametric_compile(
+            lambda alpha, gamma: SingleAgent(
+                get_protocol(protocol, **kw), alpha=alpha, gamma=gamma,
+                collect_garbage="simple", merge_isomorphic=True,
+                truncate_common_chain=True, dag_size_cutoff=cutoff),
+            probe_alpha=probe_alpha, probe_gamma=probe_gamma, meta=meta)
+    raise ValueError(f"unknown protocol {protocol!r}; expected fc16, "
+                     f"aft20, bitcoin, or ghostdag")
+
+
+def _cache_dir() -> str:
+    """Solve-cache directory: CPR_MDP_CACHE > <CPR_TPU_CACHE>/mdp_grid
+    > ~/.cache/cpr_tpu/mdp_grid (the break_even cache-dir pattern;
+    delete the directory to bust the cache)."""
+    d = os.environ.get("CPR_MDP_CACHE")
+    if d:
+        return d
+    base = os.environ.get("CPR_TPU_CACHE")
+    if base:
+        return os.path.join(base, "mdp_grid")
+    return os.path.join(os.path.expanduser("~"), ".cache", "cpr_tpu",
+                        "mdp_grid")
+
+
+def solve_grid_cached(protocol: str, *, cutoff: int, alphas, gammas,
+                      horizon: int = 100, stop_delta: float = 1e-6,
+                      discount: float = 1.0, k: int = 2,
+                      native: bool = False, include_policy: bool = False,
+                      cache: bool = True, mesh=None) -> dict:
+    """Compile (parametric) + solve the grid, with the SOLVE cached on
+    disk keyed by the ParamMDP content fingerprint + solve knobs: the
+    cheap compile re-runs on every call and anything that changes its
+    output — model fix, compiler change, different cutoff — changes
+    the fingerprint and so invalidates the cached solve automatically.
+    The serve `mdp.solve_grid` op and break_even's exact mode sit on
+    this.  Returns a JSON-safe dict (policy tables as nested lists
+    when include_policy)."""
+    import cpr_tpu
+    from cpr_tpu import resilience
+
+    alphas, gammas, points = grid_points(alphas, gammas)
+    pm = param_ptmdp(
+        compile_protocol(protocol, cutoff=cutoff, k=k, native=native),
+        horizon=horizon)
+    fp = pm.fingerprint()
+    key = dict(kind="mdp_grid", fingerprint=fp, alphas=alphas,
+               gammas=gammas, horizon=horizon, stop_delta=stop_delta,
+               discount=discount, include_policy=bool(include_policy),
+               _version=cpr_tpu.__version__)
+    h = hashlib.sha256(
+        json.dumps(key, sort_keys=True).encode()).hexdigest()[:24]
+    path = os.path.join(_cache_dir(), h + ".json")
+    if cache and os.path.exists(path):
+        with open(path) as f:
+            return dict(json.load(f)["value"], cached=True)
+    vi = grid_value_iteration(pm, alphas, gammas, discount=discount,
+                              stop_delta=stop_delta, mesh=mesh,
+                              protocol=protocol, cutoff=cutoff)
+    value = dict(
+        protocol=protocol, cutoff=int(cutoff), horizon=int(horizon),
+        stop_delta=float(stop_delta), discount=float(discount),
+        fingerprint=fp, n_states=pm.n_states,
+        n_transitions=pm.n_transitions, alphas=alphas, gammas=gammas,
+        points=[list(p) for p in points],
+        revenue=[round(float(r), 12) for r in vi["grid_revenue"]],
+        converged=[bool(c) for c in vi["grid_converged"]],
+        sweeps=int(vi["vi_iter"]),
+        conv_iter=[int(i) for i in vi["grid_iter"]],
+        solve_s=round(float(vi["vi_time"]), 6), cached=False,
+    )
+    if include_policy:
+        value["policy"] = [[int(x) for x in row]
+                           for row in vi["grid_policy"]]
+    if cache:
+        resilience.atomic_write_json(path, {"key": key, "value": value})
+    return value

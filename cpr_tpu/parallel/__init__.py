@@ -39,6 +39,7 @@ from cpr_tpu.mdp.explicit import (TensorMDP, _valid_actions,
                                   make_vi_chunk, resolve_vi_impl,
                                   ring_residuals, run_chunk_driver,
                                   vi_residuals_event, vi_while_loop)
+from cpr_tpu.parallel.grid import make_grid_chunk_step
 from cpr_tpu.parallel.lanes import (ShardedLaneFns, check_even_shards,
                                     make_sharded_lane_fns)
 from cpr_tpu.telemetry import now
@@ -60,6 +61,7 @@ __all__ = [
     "default_mesh",
     "shard_envs",
     "sharded_value_iteration",
+    "make_grid_chunk_step",
     "make_sharded_rollout_fn",
     "sharded_rollout",
     "make_sharded_lane_fns",

@@ -1,0 +1,74 @@
+"""Grid-axis sharded chunk step for grid-batched value iteration.
+
+The grid solver (cpr_tpu/mdp/grid.py `grid_value_iteration`) vmaps the
+chunked Bellman sweep over a [G] axis of (alpha, gamma) points.  That
+axis is embarrassingly parallel — every point solves an independent
+MDP over the SAME transition structure — which makes it a far better
+scaling seam than sharding transitions (sharded_value_iteration pays a
+psum per sweep; the grid axis pays nothing): `make_grid_chunk_step`
+partitions the [G, *] planes over a 1-D mesh axis with `NamedSharding`
+and replicates the shared COO columns, so one dispatch advances every
+grid point on whichever device owns it, bit-identically to the
+single-device program (tests/test_mdp_grid.py).
+
+Same contract as the lane stepper (lanes.py): grid-major pytrees under
+`NamedSharding(mesh, P(axis))`, shared columns replicated under `P()`,
+the carry donated with matched in/out shardings so the chunk loop
+aliases in place and never inserts a resharding collective.  Uneven
+grids are refused up front (`check_even_shards`).
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from cpr_tpu.mdp.explicit import make_grid_vi_chunk
+from cpr_tpu.parallel.lanes import check_even_shards
+
+__all__ = ["make_grid_chunk_step"]
+
+
+def make_grid_chunk_step(tm, G: int, *, discount, mesh=None,
+                         axis: str = "d"):
+    """Build the jitted grid chunk step over `tm`'s transition
+    structure (a TensorMDP template; its probe probability column is
+    unused — per-point columns arrive as the [G, T] `probs` plane).
+
+    Returns `(chunk_step, place)`:
+    `chunk_step(carry, probs, frozen, steps)` advances every unfrozen
+    grid point `steps` Bellman sweeps and returns `(carry, deltas)`
+    with deltas [G, steps]; `place(x)` device-puts a grid-major host
+    array under the grid sharding (identity placement when mesh is
+    None).  `probs` is placed once by the caller via `place` and
+    reused across chunks."""
+    S, A = tm.n_states, tm.n_actions
+    body = make_grid_vi_chunk(S, A)
+    consts = (tm.src, tm.act, tm.dst, tm.reward, tm.progress)
+    disc = float(discount)
+    jit_kw = {}
+    if mesh is not None:
+        check_even_shards(G, mesh, axis=axis, what="grid points")
+        gshard = NamedSharding(mesh, P(axis))
+        rep = NamedSharding(mesh, P())
+        consts = tuple(jax.device_put(c, rep) for c in consts)
+        # carry pytree prefix: one sharding covers all three [G, S]
+        # planes; deltas [G, steps] shard the same axis
+        jit_kw = dict(in_shardings=(gshard, gshard, gshard),
+                      out_shardings=(gshard, gshard))
+
+        def place(x):
+            return jax.device_put(x, gshard)
+    else:
+        def place(x):
+            return jax.device_put(x)
+
+    src, act, dst, reward, progress = consts
+
+    def chunk(carry, probs, frozen, steps):
+        return body(carry, src, act, dst, probs, reward, progress,
+                    disc, frozen, steps)
+
+    chunk_step = jax.jit(chunk, static_argnums=(3,),
+                         donate_argnums=(0,), **jit_kw)
+    return chunk_step, place

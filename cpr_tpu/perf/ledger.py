@@ -210,10 +210,12 @@ _SERVE_METRICS = (("steps_per_sec", "serve_steps_per_sec", "steps/sec"),
 def iter_trace_rows(path: str):
     """Yield ledger-shaped rows from a telemetry JSONL trace: one per
     span carrying `per_sec` counters, metric `<span path>:<counter>`,
-    plus up to four per `serve` report event (the serving layer's
-    drain-time throughput + latency summary; _SERVE_METRICS);
-    backend/config taken from the last manifest seen before the row
-    (the stream layout every producer follows)."""
+    up to four per `serve` report event (the serving layer's
+    drain-time throughput + latency summary; _SERVE_METRICS), and a
+    throughput + per-point-latency pair per `mdp_solve` event (grid-
+    batched exact-MDP solves, schema v10); backend/config taken from
+    the last manifest seen before the row (the stream layout every
+    producer follows)."""
     base = os.path.basename(path)
     backend, config = None, {}
     with open(path) as f:
@@ -283,6 +285,37 @@ def iter_trace_rows(path: str):
                             **{f"cfg_{k}": v for k, v in config.items()},
                             **dev_cfg},
                            base)
+            elif (e.get("kind") == "event"
+                  and e.get("name") == "mdp_solve"):
+                # schema v10: grid-batched exact-MDP solves bank their
+                # points/sec throughput and per-point solve latency
+                # (`_s` suffix: lower-is-better via metric_direction),
+                # fingerprinted by protocol/cutoff/grid shape and the
+                # solve's own device count
+                pps = e.get("points_per_sec")
+                if not isinstance(pps, (int, float)):
+                    continue
+                grid = e.get("grid") or []
+                mdp_cfg = {
+                    **{f"cfg_{k}": v for k, v in config.items()},
+                    "cfg_protocol": str(e.get("protocol")),
+                    "cfg_cutoff": e.get("cutoff"),
+                    "cfg_grid": "x".join(str(x) for x in grid),
+                }
+                nd = e.get("n_devices")
+                if isinstance(nd, (int, float)) and nd:
+                    mdp_cfg["cfg_devices"] = int(nd)
+                yield ({"metric": "mdp_grid_points_per_sec",
+                        "backend": backend, "value": pps,
+                        "unit": "grid-points/sec", **mdp_cfg}, base)
+                solve_s = e.get("solve_s")
+                points = e.get("points")
+                if (isinstance(solve_s, (int, float))
+                        and isinstance(points, int) and points > 0):
+                    yield ({"metric": "mdp_grid_point_solve_s",
+                            "backend": backend,
+                            "value": round(solve_s / points, 6),
+                            "unit": "seconds", **mdp_cfg}, base)
 
 
 class Ledger:

@@ -446,6 +446,56 @@ def load_vi_checkpoint(path: str, *, S: int, dtype):
     return value, prog, it, resid
 
 
+def save_grid_vi_checkpoint(path: str, *, value, prog, pol, frozen,
+                            conv_it, final_delta, it: int, resids,
+                            stop_delta: float):
+    """Grid-VI twin of save_vi_checkpoint (mdp/explicit.py
+    run_grid_chunk_driver): the per-point planes AND the per-point
+    convergence state (frozen mask, freeze iterations, final deltas,
+    converged policies) ride in one atomically-written npz — a resumed
+    grid solve must keep already-frozen points bit-frozen, which the
+    scalar VI checkpoint cannot express."""
+    import numpy as np
+
+    value = np.asarray(value)
+    buf = io.BytesIO()
+    np.savez(buf, value=value, prog=np.asarray(prog),
+             pol=np.asarray(pol), frozen=np.asarray(frozen),
+             conv_it=np.asarray(conv_it),
+             final_delta=np.asarray(final_delta),
+             it=np.asarray(int(it)),
+             resid=(np.concatenate([np.asarray(r) for r in resids],
+                                   axis=1)
+                    if resids else np.zeros((value.shape[0], 0),
+                                            value.dtype)),
+             stop_delta=np.asarray(float(stop_delta)))
+    atomic_write_bytes(path, buf.getvalue())
+    atomic_write_json(path + ".json", {
+        "version": SNAPSHOT_VERSION, "kind": "grid_vi", "it": int(it),
+        "G": int(value.shape[0]), "S": int(value.shape[1]),
+        "dtype": str(value.dtype), "stop_delta": float(stop_delta)})
+
+
+def load_grid_vi_checkpoint(path: str, *, G: int, S: int, dtype):
+    """Load a grid-VI checkpoint as a dict of numpy arrays, validated
+    against the solve's [G, S] plane shape and dtype."""
+    import numpy as np
+
+    with open(path, "rb") as f:
+        with np.load(io.BytesIO(f.read())) as z:
+            st = {k: z[k] for k in ("value", "prog", "pol", "frozen",
+                                    "conv_it", "final_delta", "it",
+                                    "resid")}
+    if st["value"].shape != (G, S):
+        raise ValueError(f"grid VI checkpoint {path} has plane "
+                         f"{st['value'].shape}, solve expects {(G, S)}")
+    if st["value"].dtype != np.dtype(dtype):
+        raise ValueError(f"grid VI checkpoint {path} has dtype "
+                         f"{st['value'].dtype}, solve expects "
+                         f"{np.dtype(dtype)}")
+    return st
+
+
 # -- metrics.jsonl resume helpers --------------------------------------------
 
 

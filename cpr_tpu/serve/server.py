@@ -489,6 +489,11 @@ class ServeServer:
             _serve_event("query", endpoint=op,
                          protocol=req.get("protocol"))
             return out
+        if op == "mdp.solve_grid":
+            out = await self._blocking(self._mdp_solve_grid, req)
+            _serve_event("query", endpoint="mdp.solve_grid",
+                         protocol=req.get("protocol"))
+            return out
         return dict(ok=False, error=f"unknown op {op!r}")
 
     # -- admission control -------------------------------------------------
@@ -703,6 +708,25 @@ class ServeServer:
             episode_len=episode_len, reps=reps,
             seed=int(req.get("seed", 0)))
         return dict(ok=True, protocol=proto, policy=policy, alpha=value)
+
+    def _mdp_solve_grid(self, req: dict) -> dict:
+        """Exact-MDP optimal-policy tables over an (alpha, gamma) grid:
+        one parametric compile + one batched grid solve, served from
+        the content-fingerprint disk cache (cpr_tpu.mdp.
+        solve_grid_cached) — a repeated query for the same protocol/
+        cutoff/grid costs one cache read, never a re-solve."""
+        from cpr_tpu.mdp.grid import solve_grid_cached
+
+        out = solve_grid_cached(
+            req["protocol"], cutoff=int(req["cutoff"]),
+            alphas=tuple(float(a) for a in req["alphas"]),
+            gammas=tuple(float(g) for g in req["gammas"]),
+            horizon=int(req.get("horizon", 100)),
+            stop_delta=float(req.get("stop_delta", 1e-6)),
+            native=bool(req.get("native", False)),
+            k=int(req.get("k", 2)),
+            include_policy=bool(req.get("include_policy", False)))
+        return dict(ok=True, **out)
 
 
 # -- child entry point ----------------------------------------------------

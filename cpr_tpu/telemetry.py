@@ -54,7 +54,7 @@ from contextlib import contextmanager
 from datetime import datetime, timezone
 from time import perf_counter as now  # noqa: F401 — re-exported
 
-SCHEMA_VERSION = 9
+SCHEMA_VERSION = 10
 TELEMETRY_ENV_VAR = "CPR_TELEMETRY"
 # trace context: one run id per process tree, exported so supervisor
 # children and serve clients land their events under the same id
@@ -126,6 +126,14 @@ EVENT_FIELDS = {
     # being routed (null for lifecycle actions).  Extras ride
     # free-form: session, seed, reason, restarts.
     "route": ("action", "replica", "op"),
+    # v10: one per grid-batched exact-MDP solve (cpr_tpu/mdp/grid.py
+    # grid_value_iteration): grid is the [n_alphas, n_gammas] shape,
+    # sweeps the total Bellman sweep count of the batched program,
+    # converged how many grid points froze below stop_delta.  Extras
+    # ride free-form: points, n_states, n_transitions, n_devices,
+    # solve_s, points_per_sec (the ledger lifts the rate via
+    # iter_trace_rows-style banking in tools/mdp_smoke.py).
+    "mdp_solve": ("protocol", "cutoff", "grid", "sweeps", "converged"),
 }
 
 

@@ -1,0 +1,401 @@
+"""Grid-batched MDP solving tests (docs/MDP.md): the monomial
+parameter tracer, parametric compile parity against fresh per-point
+compiles (Python BFS and native C++ paths), the parametric PTO
+transform, grid value iteration's bit-identity contract against solo
+solves (unsharded, mesh-sharded, and across a kill+resume), the
+content-fingerprint solve cache, the v10 `mdp_solve` telemetry event,
+and the sparse check()/check_dense() oracle pair behind it all."""
+
+import importlib.util
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+from cpr_tpu import telemetry
+from cpr_tpu.mdp import Compiler, ptmdp
+from cpr_tpu.mdp.explicit import MDP
+from cpr_tpu.mdp.grid import (
+    Param,
+    ParamError,
+    check_revalue_parity,
+    compile_protocol,
+    grid_value_iteration,
+    param_pair,
+    param_ptmdp,
+    parametric_compile_native,
+    solve_grid_cached,
+)
+from cpr_tpu.mdp.models import Aft20BitcoinSM, Fc16BitcoinSM
+from cpr_tpu.resilience import FAULT_ENV_VAR, InjectedKill
+
+MFL = 6           # battery fork-length small enough for fast VI
+HORIZON = 30
+POINTS = [(0.2, 0.3), (0.33, 0.5), (0.45, 0.9)]
+
+
+@pytest.fixture(scope="module")
+def fc16_pm():
+    return compile_protocol("fc16", cutoff=MFL)
+
+
+@pytest.fixture(scope="module")
+def fc16_pt(fc16_pm):
+    return param_ptmdp(fc16_pm, horizon=HORIZON)
+
+
+def revalued_mdp(pm, a, g):
+    """A plain MDP over the SAME revalued probability column the grid
+    solves (fresh compiles differ by up to 1 ulp of float association,
+    so bit-level comparisons must share the column)."""
+    src, act, dst, _, reward, progress = pm.mdp.arrays()
+    return MDP(n_states=pm.mdp.n_states, n_actions=pm.mdp.n_actions,
+               start=dict(pm.mdp.start), src=src, act=act, dst=dst,
+               prob=pm.revalue(a, g), reward=reward, progress=progress)
+
+
+# ---------------------------------------------------------------- tracer
+
+
+def test_param_tracer_algebra():
+    a, g = param_pair()
+    p = a * g * (1 - a)
+    assert isinstance(p, Param)
+    assert p.expo == (1, 1, 1, 0) and p.coef == 1.0
+    # complements map onto the paired exponent slots
+    q = (1 - g) * (1 - g)
+    assert q.expo == (0, 0, 0, 2)
+    # numeric coefficients scale coef, never exponents
+    r = 0.5 * a * 2.0
+    assert r.expo == (1, 0, 0, 0) and r.coef == 1.0
+    # float() recovers the probe evaluation exactly
+    assert float(p) == pytest.approx(
+        float(a) * float(g) * (1 - float(a)), rel=0, abs=0)
+    # comparisons and equality work by probe value / structure
+    assert a < 0.5 and a * g < a
+    assert a * g == g * a
+    # addition exits the monomial ring to a plain float (validation
+    # sums only)
+    s = a + (1 - a)
+    assert isinstance(s, float) and s == pytest.approx(1.0)
+
+
+def test_param_tracer_rejects_non_monomials():
+    a, g = param_pair()
+    with pytest.raises(ParamError):
+        a - 1  # noqa: B018 — only (1 - x) complements are monomial
+    with pytest.raises(ParamError):
+        1 - a * g  # complement of a product is not a monomial
+    with pytest.raises(ParamError):
+        1 - 2 * a  # complement needs a coefficient-1 operand
+    with pytest.raises(TypeError):
+        a / g  # noqa: B018 — division is not supported at all
+
+
+# ------------------------------------------------- parametric compile
+
+
+def test_revalue_parity_fc16_aft20():
+    for proto, cls in (("fc16", Fc16BitcoinSM), ("aft20", Aft20BitcoinSM)):
+        pm = compile_protocol(proto, cutoff=MFL)
+        n = check_revalue_parity(
+            pm, lambda a, g, cls=cls: cls(alpha=a, gamma=g,
+                                          maximum_fork_length=MFL),
+            POINTS)
+        assert n == len(POINTS)
+
+
+def test_revalue_parity_generic_python():
+    from cpr_tpu.mdp.generic import SingleAgent, get_protocol
+
+    for proto, kw in (("bitcoin", {}), ("ghostdag", {"k": 2})):
+        pm = compile_protocol(proto, cutoff=5, native=False, **kw)
+
+        def fresh(a, g, proto=proto, kw=kw):
+            return SingleAgent(get_protocol(proto, **kw), alpha=a,
+                               gamma=g, collect_garbage="simple",
+                               merge_isomorphic=True,
+                               truncate_common_chain=True,
+                               dag_size_cutoff=5)
+
+        assert check_revalue_parity(pm, fresh, POINTS) == len(POINTS)
+
+
+def test_native_exponent_recovery_matches_python():
+    """The native path recovers (i, j, k, l) from the two-probe float
+    table: the resulting ParamMDP must revalue onto the Python BFS
+    compile's columns at every probe point."""
+    py = compile_protocol("bitcoin", cutoff=5, native=False)
+    nat = parametric_compile_native("bitcoin", collect_garbage="simple",
+                                    dag_size_cutoff=5)
+    assert nat.n_states == py.n_states
+    assert nat.n_transitions == py.n_transitions
+    for a, g in POINTS:
+        np.testing.assert_allclose(nat.revalue(a, g), py.revalue(a, g),
+                                   rtol=1e-9, atol=0)
+
+
+def test_param_ptmdp_matches_explicit_ptmdp(fc16_pm, fc16_pt):
+    a, g = 0.33, 0.6
+    oracle = ptmdp(revalued_mdp(fc16_pm, a, g), horizon=HORIZON)
+    assert fc16_pt.n_transitions == oracle.n_transitions
+    assert fc16_pt.mdp.start == oracle.start
+    np.testing.assert_allclose(fc16_pt.revalue(a, g),
+                               np.asarray(oracle.prob, np.float64),
+                               rtol=1e-12, atol=0)
+
+
+def test_fingerprint_tracks_structure_not_probes(fc16_pm):
+    fp = fc16_pm.fingerprint()
+    assert fp == compile_protocol("fc16", cutoff=MFL).fingerprint()
+    assert fp != compile_protocol("fc16", cutoff=MFL + 1).fingerprint()
+
+
+# ---------------------------------------------------------- grid solve
+
+
+def test_grid_vi_bit_identical_to_solo(fc16_pt):
+    alphas, gammas = (0.25, 0.4), (0.3, 0.8)
+    vi = grid_value_iteration(fc16_pt, alphas, gammas, stop_delta=1e-6)
+    assert vi["grid_converged"].all()
+    for gi, (a, g) in enumerate(vi["grid_points"]):
+        tm = revalued_mdp(fc16_pt, a, g).tensor()
+        solo = tm.value_iteration(impl="chunked", stop_delta=1e-6)
+        # the contract: per-point fixpoints are the SOLO fixpoints,
+        # bit for bit — convergence bit-freezing never perturbs them
+        np.testing.assert_array_equal(vi["grid_value"][gi],
+                                      solo["vi_value"])
+        np.testing.assert_array_equal(vi["grid_progress"][gi],
+                                      solo["vi_progress"])
+        np.testing.assert_array_equal(vi["grid_policy"][gi],
+                                      solo["vi_policy"])
+        assert int(vi["grid_iter"][gi]) == int(solo["vi_iter"])
+        # revenue weights by the point's OWN revalued start vector
+        # (fc16 starts are alpha-dependent, unlike the probe start
+        # baked into revalued_mdp)
+        start = fc16_pt.start_vector(a, g)
+        rev = ((start * solo["vi_value"]).sum()
+               / (start * solo["vi_progress"]).sum())
+        assert vi["grid_revenue"][gi] == pytest.approx(float(rev),
+                                                       rel=1e-12)
+
+
+def test_grid_vi_sharded_matches_unsharded(fc16_pt):
+    from cpr_tpu.parallel import default_mesh
+
+    mesh = default_mesh(devices=jax.devices()[:4])
+    alphas, gammas = (0.25, 0.4), (0.3, 0.8)  # G=4 over 4 devices
+    plain = grid_value_iteration(fc16_pt, alphas, gammas,
+                                 stop_delta=1e-6)
+    shard = grid_value_iteration(fc16_pt, alphas, gammas,
+                                 stop_delta=1e-6, mesh=mesh)
+    for key in ("grid_value", "grid_progress", "grid_policy",
+                "grid_iter", "grid_revenue"):
+        np.testing.assert_array_equal(plain[key], shard[key])
+    assert plain["vi_iter"] == shard["vi_iter"]
+
+
+def test_grid_vi_rejects_uneven_shards(fc16_pt):
+    from cpr_tpu.parallel import default_mesh
+
+    mesh = default_mesh(devices=jax.devices()[:4])
+    with pytest.raises(ValueError, match="grid points"):
+        grid_value_iteration(fc16_pt, (0.25, 0.3, 0.4), (0.5,),
+                             stop_delta=1e-6, mesh=mesh)
+
+
+def test_grid_vi_kill_resume_bit_identical(fc16_pt, tmp_path,
+                                           monkeypatch):
+    """A crash mid-grid-solve leaves a checkpoint; the resumed run
+    lands on exactly the uninterrupted fixpoints and cleans up."""
+    alphas, gammas = (0.25, 0.4), (0.5,)
+    clean = grid_value_iteration(fc16_pt, alphas, gammas,
+                                 stop_delta=1e-6, chunk=32)
+    ck = tmp_path / "grid_vi.npz"
+    monkeypatch.setenv(FAULT_ENV_VAR, "kill@vi_chunk=3")
+    with pytest.raises(InjectedKill):
+        grid_value_iteration(fc16_pt, alphas, gammas, stop_delta=1e-6,
+                             chunk=32, checkpoint_path=str(ck))
+    assert ck.exists(), "checkpoint must survive the crash"
+    monkeypatch.delenv(FAULT_ENV_VAR)
+    resumed = grid_value_iteration(fc16_pt, alphas, gammas,
+                                   stop_delta=1e-6, chunk=32,
+                                   checkpoint_path=str(ck))
+    for key in ("grid_value", "grid_progress", "grid_policy",
+                "grid_iter"):
+        np.testing.assert_array_equal(clean[key], resumed[key])
+    assert clean["vi_iter"] == resumed["vi_iter"]
+    assert not ck.exists(), "checkpoint is crash scratch, not artifact"
+
+
+def test_solve_grid_cached(tmp_path, monkeypatch):
+    monkeypatch.setenv("CPR_MDP_CACHE", str(tmp_path))
+    kw = dict(cutoff=MFL, alphas=(0.25, 0.4), gammas=(0.5,),
+              horizon=HORIZON, stop_delta=1e-6)
+    miss = solve_grid_cached("fc16", **kw)
+    assert miss["cached"] is False and all(miss["converged"])
+    hit = solve_grid_cached("fc16", **kw)
+    assert hit["cached"] is True
+    assert hit["revenue"] == miss["revenue"]
+    assert hit["fingerprint"] == miss["fingerprint"]
+    # the policy variant is a distinct cache entry carrying the tables
+    pol = solve_grid_cached("fc16", include_policy=True, **kw)
+    assert pol["cached"] is False and "policy" in pol
+    assert pol["revenue"] == pytest.approx(miss["revenue"])
+
+
+# -------------------------------------------------------- observability
+
+
+def _load_trace_summary():
+    path = os.path.join(os.path.dirname(__file__), "..", "tools",
+                        "trace_summary.py")
+    spec = importlib.util.spec_from_file_location("trace_summary", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_mdp_solve_event_validates(fc16_pt, tmp_path):
+    trace = tmp_path / "mdp.jsonl"
+    telemetry.configure(str(trace))
+    try:
+        tele = telemetry.current()
+        tele.manifest(config={"role": "test-mdp-grid"})
+        grid_value_iteration(fc16_pt, (0.25, 0.4), (0.5,),
+                             stop_delta=1e-6, protocol="fc16",
+                             cutoff=MFL)
+    finally:
+        telemetry.configure(None)
+    ts = _load_trace_summary()
+    events, bad = ts.read_events(str(trace))
+    assert ts.validate(events, bad, expect=("mdp_solve",)) == []
+    (ev,) = [e for e in events if e.get("name") == "mdp_solve"]
+    assert ev["protocol"] == "fc16" and ev["cutoff"] == MFL
+    assert ev["grid"] == [2, 1] and ev["converged"] == 2
+    assert ev["points_per_sec"] > 0
+
+
+def test_mdp_solve_event_banks_in_ledger(fc16_pt, tmp_path):
+    from cpr_tpu.perf.ledger import Ledger
+
+    trace = tmp_path / "mdp.jsonl"
+    telemetry.configure(str(trace))
+    try:
+        telemetry.current().manifest(config={"devices": 1})
+        grid_value_iteration(fc16_pt, (0.25, 0.4), (0.5,),
+                             stop_delta=1e-6, protocol="fc16",
+                             cutoff=MFL)
+    finally:
+        telemetry.configure(None)
+    led = Ledger(str(tmp_path / "ledger.jsonl"))
+    assert led.ingest_trace(str(trace)) >= 2
+    by_metric = {r["metric"]: r for r in led.records()}
+    pps = by_metric["mdp_grid_points_per_sec"]
+    assert pps["unit"] == "grid-points/sec" and pps["value"] > 0
+    assert pps["config"]["cfg_protocol"] == "fc16"
+    assert pps["config"]["cfg_grid"] == "2x1"
+    assert pps["config"]["cfg_devices"] == 1
+    lat = by_metric["mdp_grid_point_solve_s"]
+    assert lat["unit"] == "seconds" and lat["value"] > 0
+
+
+# -------------------------------------------- check() + arrays() cache
+
+
+def test_check_sparse_matches_dense_oracle(fc16_pm):
+    good = fc16_pm.mdp
+    assert good.check() and good.check_dense()
+
+    bad_prob = MDP()
+    bad_prob.add_transition(0, 0, 1, probability=0.6, reward=0.0,
+                            progress=0.0)
+    bad_prob.add_transition(1, 0, 0, probability=1.0, reward=0.0,
+                            progress=0.0)
+    bad_prob.start = {0: 1.0}
+    with pytest.raises(AssertionError, match="sum to 1"):
+        bad_prob.check()
+    with pytest.raises(AssertionError, match="sum to 1"):
+        bad_prob.check_dense()
+
+    gap = MDP()
+    gap.add_transition(0, 0, 1, probability=1.0, reward=0.0,
+                       progress=0.0)
+    gap.add_transition(0, 2, 1, probability=1.0, reward=0.0,
+                       progress=0.0)  # action 1 missing at state 0
+    gap.add_transition(1, 0, 0, probability=1.0, reward=0.0,
+                       progress=0.0)
+    gap.start = {0: 1.0}
+    with pytest.raises(AssertionError, match="non-contiguous"):
+        gap.check()
+    with pytest.raises(AssertionError, match="non-contiguous"):
+        gap.check_dense()
+
+
+def test_arrays_cache_identity_and_invalidation():
+    m = MDP()
+    m.add_transition(0, 0, 1, probability=1.0, reward=1.0, progress=1.0)
+    first = m.arrays()
+    assert m.arrays() is first  # cached tuple, no rebuild
+    m.add_transition(1, 0, 0, probability=1.0, reward=0.0, progress=1.0)
+    second = m.arrays()
+    assert second is not first and len(second[0]) == 2
+
+
+# ------------------------------------------------------------- adoption
+
+
+def test_measure_rows_grid_matches_serial(tmp_path, monkeypatch):
+    from cpr_tpu.experiments.measure_mdp import (measure_rows,
+                                                 measure_rows_grid)
+
+    alphas, gamma = (0.25, 0.4), 0.5
+    battery = [(f"fc16-{a}",
+                lambda a=a: Fc16BitcoinSM(alpha=a, gamma=gamma,
+                                          maximum_fork_length=MFL))
+               for a in alphas]
+    serial = measure_rows(battery, horizon=HORIZON)
+    grid = measure_rows_grid([("fc16", MFL, {}, "fc16")], alphas=alphas,
+                             gamma=gamma, horizon=HORIZON)
+    assert [r["model"] for r in grid] == [r["model"] for r in serial]
+    for gr, sr in zip(grid, serial):
+        assert gr["n_states"] == sr["n_states"]
+        assert gr["n_transitions"] == sr["n_transitions"]
+        assert gr["revenue"] == pytest.approx(sr["revenue"], abs=5e-6)
+
+
+def test_break_even_exact_monotone_in_gamma(tmp_path, monkeypatch):
+    from cpr_tpu.experiments.break_even import (break_even_exact,
+                                                exact_revenue_curve)
+
+    monkeypatch.setenv("CPR_MDP_CACHE", str(tmp_path))
+    curve = exact_revenue_curve("fc16", gamma=0.5, cutoff=MFL,
+                                alphas=(0.2, 0.3, 0.4), horizon=HORIZON)
+    assert curve == sorted(curve)  # revenue rises with attacker share
+    kw = dict(cutoff=MFL, support=(0.1, 0.45), grid=5, horizon=HORIZON)
+    be_lo = break_even_exact("fc16", gamma=0.2, **kw)
+    be_hi = break_even_exact("fc16", gamma=0.9, **kw)
+    assert 0.1 <= be_hi <= be_lo <= 0.45  # better comms, easier attack
+
+
+def test_serve_mdp_solve_grid_dispatch(tmp_path, monkeypatch):
+    """The serve op is a thin blocking wrapper over solve_grid_cached:
+    exercise the handler directly (the full socket path is covered by
+    `make mdp-smoke`)."""
+    import asyncio
+
+    from cpr_tpu.serve.server import ServeServer
+
+    monkeypatch.setenv("CPR_MDP_CACHE", str(tmp_path))
+    srv = ServeServer.__new__(ServeServer)
+
+    async def run():
+        return srv._mdp_solve_grid(dict(
+            protocol="fc16", cutoff=MFL, alphas=[0.25, 0.4],
+            gammas=[0.5], horizon=HORIZON))
+
+    out = asyncio.run(run())
+    assert out["ok"] and out["cached"] is False
+    assert len(out["revenue"]) == 2 and all(out["converged"])

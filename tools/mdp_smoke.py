@@ -1,0 +1,381 @@
+"""Grid-batched MDP smoke (`make mdp-smoke`).
+
+Proves the parametric-compile + grid-VI pipeline (docs/MDP.md)
+end-to-end on the CPU CI host — solve children run under forced
+1-device and 4-device XLA CPU meshes, so the grid-axis sharding seam
+is exercised with no accelerator:
+
+  1  per device count, a solve child parametrically compiles fc16 +
+     aft20 (fork length 20), proves revalue parity against fresh
+     compiles at probe points, and solves the same 16-point
+     (alpha, gamma) grid per protocol as ONE vmapped (and, at 4
+     devices, grid-axis-sharded) VI program;
+  2  the 1-device child additionally runs the telemetry-spanned A/B:
+     the serial battery loop (fresh compile + ptmdp + solo chunked
+     solve per point) vs [one parametric compile + one grid solve] —
+     the grid side must win >= 3x wall-clock across the two
+     protocols — and spot-checks grid fixpoints bit-identical to solo
+     solves of the same revalued tensors at the grid corners;
+  3  device-count parity: per-point value/progress/policy planes and
+     convergence sweep counts must be BIT-IDENTICAL between the
+     1-device and 4-device grid solves — same program, partitioned;
+  4  a supervised `python -m cpr_tpu.serve.server` answers
+     `mdp.solve_grid` twice: the first solve banks an `mdp_solve`
+     event, the repeat must come back `cached` with identical revenue
+     (the content-fingerprint solve cache);
+  5  every trace passes `trace_summary --validate --expect mdp_solve`
+     (serve trace: `--expect serve`), and all traces ingest into one
+     perf ledger: `mdp_grid_points_per_sec` rows must land at BOTH
+     cfg_devices=1 and cfg_devices=4 and every banked row (including
+     the lower-is-better `mdp_grid_point_solve_s`) must clear the
+     regression gate.
+
+Usage: python tools/mdp_smoke.py [workdir]   (default /tmp/...)
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+ROOT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+sys.path.insert(0, ROOT)
+
+from cpr_tpu import supervisor  # noqa: E402
+from cpr_tpu.perf.gate import gate_row, gate_summary  # noqa: E402
+from cpr_tpu.perf.ledger import Ledger  # noqa: E402
+from cpr_tpu.serve.protocol import ServeClient  # noqa: E402
+
+DEVICES = 4                 # the forced virtual CPU mesh span
+MFL = 20                    # battery fork-length for fc16/aft20
+HORIZON = 50
+N_ALPHAS = 8                # x len(GAMMAS) = 16 grid points/protocol
+GAMMAS = (0.25, 0.75)
+AB_MIN_SPEEDUP = 3.0
+READY_TIMEOUT_S = 300.0
+WALL_S = 900.0
+
+
+def _log(msg):
+    print(f"mdp-smoke: {msg}", file=sys.stderr)
+
+
+def _child_env(workdir, trace, extra=None, devices=1):
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS=f"--xla_force_host_platform_device_count="
+                         f"{devices}",
+               CPR_TELEMETRY=trace,
+               CPR_TPU_CACHE=os.path.join(workdir, "cache"))
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env.update(extra or {})
+    return env
+
+
+def _validate_stream(trace, expect):
+    tool = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "trace_summary.py")
+    r = subprocess.run(
+        [sys.executable, tool, trace, "--validate", "--expect", expect],
+        capture_output=True, text=True)
+    if r.returncode != 0:
+        sys.stderr.write(r.stdout + r.stderr)
+        raise SystemExit(f"telemetry validation failed for {trace}")
+
+
+# one solve child per device count: parametric compile + parity + grid
+# solve, exact outputs dumped as JSON for the parent's cross-device
+# bit-identity check; the 1-device child also runs the spanned A/B and
+# the solo-fixpoint spot check
+_SOLVE_CHILD = textwrap.dedent("""\
+    import json, os
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+
+    from cpr_tpu import telemetry
+    from cpr_tpu.mdp import Compiler, ptmdp
+    from cpr_tpu.mdp.explicit import MDP
+    from cpr_tpu.mdp.grid import (check_revalue_parity, compile_protocol,
+                                  grid_value_iteration, param_ptmdp)
+    from cpr_tpu.mdp.models import Aft20BitcoinSM, Fc16BitcoinSM
+    from cpr_tpu.telemetry import now
+
+    devices = int(os.environ["CPR_SMOKE_DEVICES"])
+    mfl = int(os.environ["CPR_SMOKE_MFL"])
+    horizon = int(os.environ["CPR_SMOKE_HORIZON"])
+    n_alphas = int(os.environ["CPR_SMOKE_N_ALPHAS"])
+    gammas = tuple(float(g) for g in
+                   os.environ["CPR_SMOKE_GAMMAS"].split(","))
+    run_ab = os.environ.get("CPR_SMOKE_AB") == "1"
+    alphas = [round(float(a), 6)
+              for a in np.linspace(0.15, 0.45, n_alphas)]
+
+    mesh = None
+    if devices > 1:
+        from cpr_tpu.parallel import default_mesh
+        devs = jax.devices()
+        assert len(devs) >= devices, (len(devs), devices)
+        mesh = default_mesh(devices=devs[:devices])
+
+    tele = telemetry.current()
+    tele.manifest(dict(role="mdp-smoke-solve", devices=devices,
+                       mfl=mfl, horizon=horizon))
+
+    MODELS = {
+        "fc16": Fc16BitcoinSM,
+        "aft20": Aft20BitcoinSM,
+    }
+
+    def solo_tensor(pt, a, g):
+        # a solo tensor over the SAME revalued probability column the
+        # grid solved (fresh compiles differ by float association)
+        src, act, dst, _, reward, progress = pt.mdp.arrays()
+        m = MDP(n_states=pt.mdp.n_states, n_actions=pt.mdp.n_actions,
+                start=dict(pt.mdp.start), src=src, act=act, dst=dst,
+                prob=pt.revalue(a, g), reward=reward, progress=progress)
+        return m.tensor()
+
+    payload = dict(devices=devices, grids={}, ab={})
+    for proto, cls in MODELS.items():
+        pm = compile_protocol(proto, cutoff=mfl)
+        n = check_revalue_parity(
+            pm, lambda a, g, cls=cls: cls(alpha=a, gamma=g,
+                                          maximum_fork_length=mfl),
+            [(0.2, 0.3), (0.33, 0.5), (0.45, 0.9)])
+        print(f"{proto}: revalue parity ok at {n} probe points")
+        pt = param_ptmdp(pm, horizon=horizon)
+        with tele.span(f"mdp_ab:grid:{proto}"):
+            t0 = now()
+            vi = grid_value_iteration(pt, alphas, gammas,
+                                      stop_delta=1e-6, mesh=mesh,
+                                      protocol=proto, cutoff=mfl)
+            grid_s = now() - t0
+        assert bool(vi["grid_converged"].all()), proto
+        payload["grids"][proto] = dict(
+            value=vi["grid_value"].tolist(),
+            progress=vi["grid_progress"].tolist(),
+            policy=vi["grid_policy"].tolist(),
+            conv_iter=vi["grid_iter"].tolist(),
+            revenue=vi["grid_revenue"].tolist(),
+            sweeps=int(vi["vi_iter"]),
+        )
+        if not run_ab:
+            continue
+        # grid corners: solo chunked solves of the same revalued
+        # tensors must reproduce the grid fixpoints bit-for-bit
+        pts = list(vi["grid_points"])
+        for gi in (0, len(gammas) - 1, len(pts) - len(gammas),
+                   len(pts) - 1):
+            a, g = pts[gi]
+            solo = solo_tensor(pt, a, g).value_iteration(
+                impl="chunked", stop_delta=1e-6)
+            for plane, key in ((vi["grid_value"][gi], "vi_value"),
+                               (vi["grid_progress"][gi], "vi_progress"),
+                               (vi["grid_policy"][gi], "vi_policy")):
+                assert np.array_equal(plane, solo[key]), (proto, a, g,
+                                                         key)
+            assert int(vi["grid_iter"][gi]) == int(solo["vi_iter"])
+        print(f"{proto}: grid corners bit-identical to solo solves")
+        # the serial battery loop this PR replaces: fresh compile +
+        # ptmdp + solo chunked solve per grid point
+        with tele.span(f"mdp_ab:serial:{proto}"):
+            t0 = now()
+            for a, g in pts:
+                m = ptmdp(Compiler(cls(alpha=a, gamma=g,
+                                       maximum_fork_length=mfl)).mdp(),
+                          horizon=horizon)
+                m.tensor().value_iteration(impl="chunked",
+                                           stop_delta=1e-6)
+            serial_s = now() - t0
+        payload["ab"][proto] = dict(points=len(pts), serial_s=serial_s,
+                                    grid_s=grid_s,
+                                    speedup=serial_s / grid_s)
+        print(f"{proto}: A/B serial {serial_s:.2f}s vs grid "
+              f"{grid_s:.2f}s -> {serial_s / grid_s:.2f}x")
+
+    if run_ab:
+        tot_serial = sum(r["serial_s"] for r in payload["ab"].values())
+        tot_grid = sum(r["grid_s"] for r in payload["ab"].values())
+        payload["ab"]["combined_speedup"] = tot_serial / tot_grid
+        min_speedup = float(os.environ["CPR_SMOKE_MIN_SPEEDUP"])
+        assert tot_serial / tot_grid >= min_speedup, (
+            f"grid solve only {tot_serial / tot_grid:.2f}x faster than "
+            f"the serial loop, need >= {min_speedup}x")
+
+    with open(os.environ["CPR_SMOKE_OUT"], "w") as f:
+        json.dump(payload, f, sort_keys=True)
+    print("mdp solve child ok:", devices, "device(s)")
+""")
+
+
+def _solve_run(work, devices, run_ab):
+    trace = os.path.join(work, f"solve_d{devices}.jsonl")
+    out_path = os.path.join(work, f"solve_d{devices}.json")
+    for p in (trace, out_path):
+        if os.path.exists(p):
+            os.remove(p)
+    env = _child_env(work, trace, devices=devices, extra={
+        "CPR_SMOKE_DEVICES": str(devices),
+        "CPR_SMOKE_MFL": str(MFL),
+        "CPR_SMOKE_HORIZON": str(HORIZON),
+        "CPR_SMOKE_N_ALPHAS": str(N_ALPHAS),
+        "CPR_SMOKE_GAMMAS": ",".join(str(g) for g in GAMMAS),
+        "CPR_SMOKE_AB": "1" if run_ab else "0",
+        "CPR_SMOKE_MIN_SPEEDUP": str(AB_MIN_SPEEDUP),
+        "CPR_SMOKE_OUT": out_path,
+    })
+    r = subprocess.run([sys.executable, "-c", _SOLVE_CHILD], env=env,
+                       cwd=ROOT, capture_output=True, text=True,
+                       timeout=WALL_S)
+    sys.stderr.write(r.stdout)
+    if r.returncode != 0:
+        sys.stderr.write(r.stderr)
+        raise SystemExit(f"solve child (devices={devices}) failed "
+                         f"rc={r.returncode}")
+    _validate_stream(trace, "mdp_solve")
+    with open(out_path) as f:
+        payload = json.load(f)
+    _log(f"solve child devices={devices}: fc16+aft20, "
+         f"{N_ALPHAS * len(GAMMAS)} grid points each")
+    return payload, trace
+
+
+def _wait_ready(path, proc):
+    deadline = time.time() + READY_TIMEOUT_S
+    while time.time() < deadline:
+        if proc.poll() is not None:
+            raise SystemExit(f"server child exited rc={proc.returncode} "
+                             f"before becoming ready")
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            time.sleep(0.25)
+    raise SystemExit(f"server not ready within {READY_TIMEOUT_S:.0f}s")
+
+
+def _serve_run(work):
+    """Supervised serve child answering mdp.solve_grid: the repeat
+    query must hit the content-fingerprint solve cache."""
+    trace = os.path.join(work, "serve_mdp.jsonl")
+    if os.path.exists(trace):
+        os.remove(trace)
+    cmd = [sys.executable, "-m", "cpr_tpu.serve.server",
+           "--protocol", "nakamoto", "--max-steps", "64",
+           "--lanes", "2", "--burst", "32", "--devices", "1",
+           "--heartbeat-s", "0.5",
+           "--ready-file", os.path.join(work, "ready_mdp.json")]
+    started = threading.Event()
+    box = {}
+
+    def on_start(proc):
+        box["proc"] = proc
+        started.set()
+
+    def supervise():
+        box["attempt"] = supervisor.run_child(
+            cmd, wall_timeout_s=WALL_S, quiet_s=60.0, heartbeat_s=1.0,
+            env=_child_env(work, trace), cwd=ROOT, on_start=on_start)
+
+    child = threading.Thread(target=supervise)
+    child.start()
+    try:
+        if not started.wait(30.0):
+            raise SystemExit("run_child never spawned the server")
+        ready = _wait_ready(os.path.join(work, "ready_mdp.json"),
+                            box["proc"])
+        port = ready["port"]
+        _log(f"serve child ready on port {port}")
+        query = dict(protocol="fc16", cutoff=6, alphas=[0.25, 0.4],
+                     gammas=[0.3, 0.8], horizon=30)
+        with ServeClient("127.0.0.1", port) as c:
+            r1 = c.request("mdp.solve_grid", **query)
+            assert r1.get("ok"), f"mdp.solve_grid: {r1}"
+            assert r1["cached"] is False, r1
+            r2 = c.request("mdp.solve_grid", **query)
+            assert r2.get("ok") and r2["cached"] is True, r2
+        if r1["revenue"] != r2["revenue"]:
+            raise SystemExit("cached mdp.solve_grid replay changed the "
+                             "revenue table")
+        if r1["fingerprint"] != r2["fingerprint"]:
+            raise SystemExit("solve-cache fingerprint drifted between "
+                             "identical queries")
+        box["proc"].send_signal(signal.SIGTERM)
+    except BaseException:
+        proc = box.get("proc")
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+        raise
+    child.join(120.0)
+    if child.is_alive():
+        raise SystemExit("server child did not drain within 120s")
+    attempt = box["attempt"]
+    if attempt.status != "ok" or attempt.rc != 0:
+        raise SystemExit(f"serve child did not exit cleanly "
+                         f"(status={attempt.status} rc={attempt.rc})")
+    _validate_stream(trace, "serve,mdp_solve")
+    _log(f"serve mdp.solve_grid: solved then cache-hit, "
+         f"{len(r1['revenue'])} points, drained clean")
+    return trace
+
+
+def _bank_and_gate(work, traces):
+    """All traces into one ledger; mdp_grid_points_per_sec must land
+    at both device counts and every banked row must clear the gate."""
+    ledger = Ledger(os.path.join(work, "perf_ledger.jsonl"))
+    n = sum(ledger.ingest_trace(t) for t in traces)
+    records = ledger.records()
+    pps = [r for r in records
+           if r.get("metric") == "mdp_grid_points_per_sec"]
+    got = {r.get("config", {}).get("cfg_devices") for r in pps}
+    if not {1, DEVICES} <= got:
+        raise SystemExit(f"mdp_grid_points_per_sec banked at device "
+                         f"counts {sorted(got)}, need both 1 and "
+                         f"{DEVICES}")
+    lat = [r for r in records
+           if r.get("metric") == "mdp_grid_point_solve_s"]
+    if not lat:
+        raise SystemExit("no mdp_grid_point_solve_s rows banked")
+    results = [gate_row(r, records) for r in records]
+    summary = gate_summary(results)
+    if not summary["ok"]:
+        bad = [res for res in results if res["verdict"] == "fail"]
+        raise SystemExit(f"mdp perf gate failed: {bad}")
+    return n, summary
+
+
+def main():
+    work = sys.argv[1] if len(sys.argv) > 1 else "/tmp/cpr-mdp-smoke"
+    os.makedirs(work, exist_ok=True)
+
+    out_1, trace_1 = _solve_run(work, 1, run_ab=True)
+    out_n, trace_n = _solve_run(work, DEVICES, run_ab=False)
+    if out_1["grids"] != out_n["grids"]:
+        raise SystemExit(f"grid solves NOT bit-identical between "
+                         f"1-device and {DEVICES}-device runs")
+    _log(f"grid fixpoints bit-identical at 1 vs {DEVICES} devices "
+         f"(fc16 + aft20, {N_ALPHAS * len(GAMMAS)} points each)")
+
+    trace_s = _serve_run(work)
+
+    n, summary = _bank_and_gate(work, [trace_1, trace_n, trace_s])
+    ab = out_1["ab"]
+    print(f"mdp-smoke: PASS (parametric compile + grid VI bit-identical "
+          f"at 1 vs {DEVICES} devices; A/B "
+          f"{ab['combined_speedup']:.1f}x >= {AB_MIN_SPEEDUP:.0f}x vs "
+          f"the serial loop [fc16 {ab['fc16']['speedup']:.1f}x, aft20 "
+          f"{ab['aft20']['speedup']:.1f}x]; serve mdp.solve_grid "
+          f"cache-hit round-trip; banked {n} ledger rows incl. "
+          f"mdp_grid_points_per_sec at devices 1 and {DEVICES}; "
+          f"gate {summary})")
+
+
+if __name__ == "__main__":
+    main()

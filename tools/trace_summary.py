@@ -122,6 +122,7 @@ def summarize(events, out=sys.stdout):
     _admission_lines(events, out)
     _route_lines(events, out)
     _request_lines(events, out)
+    _mdp_solve_lines(events, out)
     _perf_gate_lines(events, out)
     for m in (e for e in events if e.get("kind") == "manifest"):
         cfg = m.get("config") or {}
@@ -131,7 +132,7 @@ def summarize(events, out=sys.stdout):
               f"config={json.dumps(cfg, sort_keys=True)}", file=out)
     tabled = ("compile", "device_metrics", "vi_residuals", "retry",
               "checkpoint", "perf_gate", "supervisor", "serve",
-              "request", "admission", "route")
+              "request", "admission", "route", "mdp_solve")
     for e in (e for e in events if e.get("kind") == "event"
               and e.get("name") not in tabled):
         keys = {k: v for k, v in e.items() if k not in ("kind", "ts")}
@@ -330,6 +331,31 @@ def _request_lines(events, out):
         mean_txt = f"{tot / n:.4f}" if n else "-"
         print(f"{op:<20} {role:<7} {status:<8} {n:>6} {mean_txt:>9} "
               f"{mx:>9.4f}", file=out)
+
+
+def _mdp_solve_lines(events, out):
+    """Schema-v10 grid-batched exact-MDP solves (cpr_tpu/mdp/grid):
+    one line per solve — grid shape, MDP size, sweep count, how many
+    points converged, and the points/sec rate the perf ledger banks."""
+    evs = [e for e in events if e.get("kind") == "event"
+           and e.get("name") == "mdp_solve"]
+    if not evs:
+        return
+    print(f"\n{'mdp_solve':<18} {'grid':<8} {'states':>9} {'trans':>10} "
+          f"{'sweeps':>7} {'conv':>6} {'solve_s':>9} {'pts/sec':>9}",
+          file=out)
+    for e in evs:
+        g = e.get("grid") or []
+        grid_txt = "x".join(str(x) for x in g) if g else "-"
+        label = f"{e.get('protocol')}@{e.get('cutoff')}"
+        pps = e.get("points_per_sec")
+        pps_txt = f"{pps:.2f}" if isinstance(pps, (int, float)) else "-"
+        sol = e.get("solve_s")
+        sol_txt = f"{sol:.3f}" if isinstance(sol, (int, float)) else "-"
+        print(f"{label:<18} {grid_txt:<8} {e.get('n_states'):>9} "
+              f"{e.get('n_transitions'):>10} {e.get('sweeps'):>7} "
+              f"{e.get('converged'):>6} {sol_txt:>9} {pps_txt:>9}",
+              file=out)
 
 
 def _perf_gate_lines(events, out):
