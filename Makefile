@@ -4,7 +4,7 @@
 
 CPU_MESH = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: lint test test-slow bench perf-gate telemetry-smoke netsim-smoke resilience-smoke supervisor-smoke serve-smoke fleet-smoke multichip-smoke mdp-smoke dryrun sweeps ghostdag train-dummy native asan
+.PHONY: lint test test-slow bench perf-gate telemetry-smoke netsim-smoke resilience-smoke supervisor-smoke serve-smoke fleet-smoke multichip-smoke mdp-smoke attack-smoke dryrun sweeps ghostdag train-dummy native asan
 
 lint:  ## jaxlint over cpr_tpu/ + tools/ (pure AST, no JAX import,
 	## ~1s); banks the JSON report under runs/ like the smoke flows
@@ -135,6 +135,21 @@ mdp-smoke:  ## grid-batched MDP proof: parametric compile of fc16 +
 	## counts.  Details: docs/MDP.md
 	rm -rf $(MDP_SMOKE_DIR)
 	python tools/mdp_smoke.py $(MDP_SMOKE_DIR)
+
+ATTACK_SMOKE_DIR = /tmp/cpr-attack-smoke
+
+attack-smoke:  ## adversary-in-the-network proof: a protocol x
+	## topology x alpha attack_sweep grid (nakamoto clean + an
+	## unsupported protocol's reason-tagged error row) as ONE vmapped
+	## lane program at forced 1 and 2 CPU devices with bit-identical
+	## rows, the degenerate two-party anchor asserted (zero-delay
+	## clique == NakamotoSSZ env at gamma=0), a serve
+	## netsim.attack_sweep cache-hit round-trip with SIGTERM drain,
+	## v11 `attack_sweep` trace validation, and
+	## attack_sweep_lanes_per_sec rows banked + gated at both device
+	## counts.  Details: docs/NETSIM.md
+	rm -rf $(ATTACK_SMOKE_DIR)
+	python tools/attack_smoke.py $(ATTACK_SMOKE_DIR)
 
 dryrun:  ## multi-chip sharding dry run on the virtual CPU mesh
 	$(CPU_MESH) python -c "from __graft_entry__ import dryrun_multichip; dryrun_multichip(8)"

@@ -402,6 +402,64 @@ def measure_mdp_grid(n_envs: int, mfl: int = 12, horizon: int = 100,
     return points / solve_s, check, extras
 
 
+def measure_attack_sweep(n_envs: int, n_activations: int = 1500,
+                         reps: int = 3):
+    """Adversary-in-the-network sweep (cpr_tpu/netsim/attack.py):
+    `n_envs` attack lanes — (seed, delay, alpha, policy) tuples over a
+    4-node clique with the attacker at node 0 — execute as ONE
+    vmapped/sharded device program per rep (alpha and policy are lane
+    inputs, so the whole grid shares one executable).  Rate counts
+    lanes/sec on the best rep; the check is the honest-policy
+    attacker's relative revenue at alpha=1/3, which must track its
+    compute share (orphans at propagation 1.0 cost well under the
+    guard slack).  The engine's own attack:run spans and the v11
+    `attack_sweep` typed event land in the telemetry artifact, where
+    the perf ledger lifts them into attack_sweep_lanes_per_sec rows."""
+    import numpy as np
+
+    from cpr_tpu.netsim.attack import AttackEngine
+    from cpr_tpu.network import symmetric_clique
+    from cpr_tpu.telemetry import now
+
+    net = symmetric_clique(4, activation_delay=30.0,
+                           propagation_delay=1.0)
+    policies = ("honest", "sapirshtein-2016-sm1")
+    alpha_axis = (0.15, 0.25, 0.33, 0.45)
+    eng = AttackEngine(net, activations=n_activations,
+                       policies=policies, topology="clique-4",
+                       mesh=_bench_mesh())
+    # lane grid: alpha-major over alpha_axis x policies, cycled to
+    # n_envs so every point gets n_envs/8 independent seeds
+    grid = [(a, p) for a in alpha_axis for p in range(len(policies))]
+    lanes = [grid[i % len(grid)] for i in range(n_envs)]
+    seeds = list(range(n_envs))
+    delays = [30.0] * n_envs
+    al = [a for a, _ in lanes]
+    pi = [p for _, p in lanes]
+    t0 = now()
+    out = eng.run(seeds, delays, al, pi)     # compile + first run
+    first_s = now() - t0
+    best = first_s
+    for _ in range(reps):
+        t0 = now()
+        out = eng.run(seeds, delays, al, pi)
+        best = min(best, now() - t0)
+    drops = int(out["drop_q"].sum() + out["drop_p"].sum()
+                + out["drop_b"].sum() + out["win_miss"].sum())
+    if drops:
+        raise GuardFailure(f"attack_sweep: {drops} capacity drops")
+    atk = np.asarray(out["reward_attacker"], dtype=float)
+    dfn = np.asarray(out["reward_defender"], dtype=float)
+    rel = atk / np.maximum(atk + dfn, 1e-9)
+    hon = [rel[i] for i, ln in enumerate(lanes) if ln == (0.33, 0)]
+    check = float(np.mean(hon))
+    return n_envs / best, check, dict(
+        lanes=n_envs, activations_per_lane=n_activations,
+        grid="4 alphas x 2 policies", topology="clique-4",
+        compile_and_first_run_s=round(first_s, 3),
+        best_rep_s=round(best, 4), n_devices=_bench_devices())
+
+
 # correctness guard bounds: SM1 revenue near the ES'14 closed form
 # (alpha=.35, gamma=.5 -> 0.416)
 SM1_GUARD = (0.38, 0.45)
@@ -662,6 +720,16 @@ CONFIGS = {
         cpu=dict(n_envs=16), guard=(0.70, 0.80),
         guard_name="fc16 optimal revenue @ (0.45, 0.75)",
         metric="mdp_grid_points_per_sec", unit="grid-points/sec"),
+    # adversary-in-the-network lanes (cpr_tpu/netsim/attack.py): n_envs
+    # lanes over an alpha x policy grid on the 4-node clique; the rate
+    # counts lanes/sec.  Guard: honest attacker relative revenue at
+    # alpha=1/3 tracks compute share (orphan losses << the slack).
+    # Sharding honors CPR_BENCH_DEVICES via _bench_mesh, like netsim
+    "attack_sweep": dict(
+        fn="measure_attack_sweep", tpu=dict(n_envs=64),
+        cpu=dict(n_envs=16), guard=(0.28, 0.39),
+        guard_name="honest attacker relative revenue @ alpha 1/3",
+        metric="attack_sweep_lanes_per_sec", unit="lanes/sec"),
 }
 
 

@@ -147,9 +147,11 @@ def _netsim_rows(protocols, activation_delays, *, n_nodes,
         k = kw.get("k", 1)
         scheme = kw.get("scheme", "constant")
         if not netsim.supports(proto, k, scheme):
-            raise ValueError(
+            err = ValueError(
                 f"netsim supports protocols {netsim.SUPPORTED_PROTOCOLS}"
                 f", not '{proto}' (k={k}, scheme='{scheme}')")
+            err.reason = "unsupported-protocol"
+            raise err
         eng = netsim.Engine(net, protocol=proto, k=k, scheme=scheme,
                             activations=n_activations)
         with tele.span("honest_net:netsim", lanes=len(delays),

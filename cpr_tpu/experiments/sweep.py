@@ -63,4 +63,9 @@ def run_task(task: Callable[[], list[dict] | dict], ident: dict) -> list[dict]:
     except Exception as e:  # noqa: BLE001 — sweep must degrade per-task
         return [{**ident,
                  "error": f"{type(e).__name__}: {e}",
+                 # machine-readable class so downstream tooling can
+                 # filter error rows without parsing the message; a
+                 # task can attach a more specific slug by setting a
+                 # `reason` attribute on the exception it raises
+                 "reason": getattr(e, "reason", "runtime-error"),
                  "machine_duration_s": now() - t0}]

@@ -23,7 +23,13 @@ from cpr_tpu.netsim.compile import (  # noqa: F401
 from cpr_tpu.netsim.engine import (  # noqa: F401
     Engine, SUPPORTED_PROTOCOLS, grid, supports,
 )
+from cpr_tpu.netsim.attack import (  # noqa: F401
+    ATTACK_PROTOCOLS, AttackEngine, DEFAULT_ATTACK_POLICIES,
+    attack_supports, attack_sweep, attack_sweep_cached,
+)
 
 __all__ = ["CompiledNet", "compile_network", "sample_delay_matrix",
            "NETSIM_KINDS", "Engine", "SUPPORTED_PROTOCOLS", "grid",
-           "supports"]
+           "supports", "ATTACK_PROTOCOLS", "AttackEngine",
+           "DEFAULT_ATTACK_POLICIES", "attack_supports", "attack_sweep",
+           "attack_sweep_cached"]

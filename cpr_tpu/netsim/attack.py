@@ -1,0 +1,741 @@
+"""Adversary-in-the-network: withholding attacks inside the netsim.
+
+Every selfish-mining env in this repo collapses the network to the
+paper's two-party abstraction (attacker vs. one aggregated defender
+cloud, zero propagation structure, gamma as an explicit parameter).
+This module puts the attacker *inside* the simulated network instead:
+node 0 of an arbitrary `network.Network` topology runs a withholding
+policy over the SSZ observation space while the remaining nodes mine
+and flood honestly through the event engine's queue/pending/flooding
+machinery (`netsim/engine.py`).  Withholding and break-even sweeps
+thus run under realistic network assumptions — per-link delay
+distributions, GraphML topologies, flooding relays — the exact axis
+arXiv:2501.10888 sweeps.
+
+Attacker semantics (nakamoto; mirrors envs/nakamoto.py which mirrors
+nakamoto_ssz.ml):
+
+* node 0 mines on its **private** tip and never announces at mint;
+  honest nodes run unmodified nakamoto (mine on preference, send on
+  links, flood on first delivery).
+* the attacker keeps a public-view pointer `pub` (highest block
+  delivered to node 0) and a private tip `priv`; after every own mint
+  (event `PoW`) or public-view advance (event `Network`) it computes
+  (a, h) relative to the common ancestor, encodes the SSZ observation
+  `(h, a, a - h, event)`, and applies the lane's policy:
+  Adopt | Override | Match | Wait.
+* Adopt resets `priv <- pub` and abandons the withheld suffix.
+  Override releases the private chain up to height h(pub)+1; Match up
+  to h(pub).  A release emits the withheld blocks lowest-id-first,
+  one per engine step at the decision timestamp, onto node 0's real
+  links with sampled delays — whether a Match splits the honest
+  miners is decided by message racing, not by a gamma parameter
+  (gamma therefore reports as -1.0 in sweep rows).
+* common-ancestor search is a bounded two-pointer height walk over
+  the ledger (cap `walk_cap`); overflow counts into `win_miss`,
+  asserted zero by the tests.
+
+Degenerate-network anchor: on `network.two_agents` (two nodes, zero
+link delay) a Match can never split the single honest node, so the
+lane must reproduce the two-party env at gamma=0 — the tier-1
+cross-check in tests/test_netsim_attack.py holds the relative revenue
+gap under a stated tolerance on matched (policy, alpha, seed) grids.
+
+`attack_sweep()` runs protocols x topologies x delays x alphas x
+policies as ONE vmapped (and mesh-shardable) program per topology —
+alpha and policy id are lane inputs, so the whole grid shares a
+single compiled executable per lane count.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from functools import partial
+
+import numpy as np
+
+from cpr_tpu import telemetry
+from cpr_tpu.netsim.compile import (CompiledNet, compile_network,
+                                    sample_delay_matrix)
+
+ATTACK_PROTOCOLS = ("nakamoto",)
+SCRIPTED_POLICIES = ("honest", "simple", "eyal-sirer-2014",
+                     "sapirshtein-2016-sm1")
+DEFAULT_ATTACK_POLICIES = ("honest", "eyal-sirer-2014",
+                           "sapirshtein-2016-sm1")
+DEFAULT_ALPHAS = (0.15, 0.25, 0.33, 0.4, 0.45)
+
+
+def attack_supports(protocol: str, k: int = 1,
+                    scheme: str = "constant") -> bool:
+    """True when the attack lane implements this protocol config.
+    Only nakamoto for now: the other engine protocols (bk, ethereum,
+    spar) run honest-only; their withholding spaces need per-protocol
+    release semantics (vote withholding, uncle games) — see
+    docs/NETSIM.md's supported-protocol matrix."""
+    return protocol in ATTACK_PROTOCOLS
+
+
+def _attack_lane_fn(cn: CompiledNet, activations: int, B: int, M: int,
+                    F: int, S: int, WA: int, branches,
+                    strict_match: bool = True):
+    """Build lane(key, activation_delay, alpha, policy_id) -> metrics.
+    Structure follows engine._lane_fn's nakamoto path; the deltas are
+    the private/public bookkeeping, the release step type, and the
+    policy handle."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from cpr_tpu import obs as obslib
+    from cpr_tpu.envs.nakamoto import (ADOPT, EV_NETWORK, EV_POW, MATCH,
+                                       OBS_FIELDS, OVERRIDE)
+
+    N = int(cn.n)
+    A = int(activations)
+    C = N * F + N * N
+    ft = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+    i32 = jnp.int32
+    INF = jnp.asarray(jnp.inf, ft)
+
+    kindm = jnp.asarray(cn.kind, i32)
+    p0m = jnp.asarray(cn.p0, ft)
+    p1m = jnp.asarray(cn.p1, ft)
+    has_link = kindm >= 0
+    # honest compute shares renormalized over nodes 1..N-1; node 0's
+    # weight is the lane's alpha (the declared topology weight of the
+    # attacker node is overridden per lane)
+    _wh = np.asarray(cn.compute[1:], np.float64)
+    whon = jnp.asarray(_wh / _wh.sum(), jnp.float32)
+    arangeN = jnp.arange(N, dtype=i32)
+    idsB = jnp.arange(B, dtype=i32)
+    n_pol = len(branches)
+
+    def init(key, activation_delay):
+        key, k0 = jax.random.split(key)
+        first = jax.random.exponential(k0, dtype=ft) * activation_delay
+        return dict(
+            key=key,
+            now=jnp.asarray(0.0, ft),
+            next_act=first,
+            n_act=jnp.asarray(0, i32),
+            nb=jnp.asarray(1, i32),
+            seq=jnp.asarray(0, i32),
+            steps=jnp.asarray(0, i32),
+            live=jnp.asarray(True, bool),
+            parent0=jnp.full((B,), -1, i32),
+            height=jnp.zeros((B,), i32),
+            miner=jnp.full((B,), -1, i32),
+            pref=jnp.zeros((N,), i32),
+            vis=jnp.zeros((N, B), bool).at[:, 0].set(True),
+            vis_at=jnp.full((N, B), jnp.inf, ft).at[:, 0].set(0.0),
+            known=jnp.zeros((N, B), bool).at[:, 0].set(True),
+            node_act=jnp.zeros((N,), i32),
+            q_time=jnp.full((M,), jnp.inf, ft),
+            q_dst=jnp.zeros((M,), i32),
+            q_blk=jnp.zeros((M,), i32),
+            q_seq=jnp.zeros((M,), i32),
+            pend=jnp.full((N, F), -1, i32),
+            priv=jnp.asarray(0, i32),
+            pub=jnp.asarray(0, i32),
+            withheld=jnp.zeros((B,), bool),
+            rel_h=jnp.asarray(-1, i32),
+            drop_q=jnp.asarray(0, i32),
+            drop_p=jnp.asarray(0, i32),
+            drop_b=jnp.asarray(0, i32),
+            win_miss=jnp.asarray(0, i32),
+        )
+
+    def body(st, activation_delay, logw, pid):
+        key, k_mine, k_next, k_delay = jax.random.split(st["key"], 4)
+        tmin = jnp.min(st["q_time"])
+        has_q = jnp.isfinite(tmin)
+        can_act = st["n_act"] < A
+        # a pending release preempts both activations and deliveries:
+        # the whole withheld prefix goes out at the decision timestamp
+        wh_ok = st["withheld"] & (st["height"] <= st["rel_h"])
+        is_rel = jnp.any(wh_ok)
+        act_now = can_act & (st["next_act"] <= tmin)
+        recv_ok = has_q & ~(~can_act & (tmin >= st["next_act"]))
+        is_act = ~is_rel & act_now
+        is_recv = ~is_rel & ~act_now & recv_ok
+        now2 = jnp.where(is_act, st["next_act"],
+                         jnp.where(is_recv, tmin, st["now"]))
+
+        # ---- delivery wave (engine semantics, nakamoto preference) --
+        wave0 = is_recv & (st["q_time"] == tmin)
+        seqs = jnp.where(wave0, st["q_seq"],
+                         jnp.asarray(2**31 - 1, i32))
+        i0 = jnp.argmin(seqs)
+        b = jnp.where(is_recv, st["q_blk"][i0], 0)
+        wave = wave0 & (st["q_blk"] == b)
+        dvec = jnp.zeros((N + 1,), bool).at[
+            jnp.where(wave, st["q_dst"], N)].max(True)
+        dmask = dvec[:N]
+        q_time_pop = jnp.where(wave, INF, st["q_time"])
+
+        pb = st["parent0"][b]
+        pbc = jnp.clip(pb, 0)
+        pv = (pb < 0) | st["vis"][:, pbc]
+        fresh = dmask & ~st["known"][:, b]
+        deliver = dmask & ~st["vis"][:, b] & pv
+        blocked = fresh & ~pv
+        known2 = st["known"].at[arangeN, b].max(dmask)
+        vis2 = st["vis"].at[arangeN, b].max(deliver)
+        vis_at2 = st["vis_at"].at[arangeN, b].min(
+            jnp.where(deliver, tmin, INF))
+
+        occ = st["pend"] >= 0
+        has_free = ~jnp.all(occ, axis=1)
+        slot = jnp.argmin(occ, axis=1).astype(i32)
+        park = blocked & has_free
+        pend2 = st["pend"].at[arangeN, slot].set(
+            jnp.where(park, b, st["pend"][arangeN, slot]))
+        drop_p2 = st["drop_p"] + jnp.sum(
+            blocked & ~has_free).astype(i32)
+
+        better = st["height"][b] > st["height"][st["pref"]]
+        pref2 = jnp.where(deliver & better, b, st["pref"])
+        # the attacker's public view advances on first delivery of a
+        # strictly higher block at node 0
+        pub_gain = is_recv & deliver[0] & (
+            st["height"][b] > st["height"][st["pub"]])
+        pub2 = jnp.where(pub_gain, b, st["pub"])
+
+        par_p = st["parent0"][jnp.clip(pend2, 0)]
+        vis_par = (par_p < 0) | vis2[arangeN[:, None],
+                                     jnp.clip(par_p, 0)]
+        unl = (pend2 >= 0) & deliver[:, None] & vis_par
+        pend3 = jnp.where(unl, -1, pend2)
+
+        # ---- release step: lowest-id withheld block <= rel_h --------
+        # (lowest id first keeps the released chain parent-before-
+        # child, so honest delivery never parks more than transiently)
+        rb = jnp.clip(jnp.min(jnp.where(wh_ok, idsB, B)), 0, B - 1)
+        withheld2 = st["withheld"].at[
+            jnp.where(is_rel, rb, B)].set(False)
+        rel_done = is_rel & (jnp.sum(wh_ok).astype(i32) <= 1)
+        rel_h2 = jnp.where(rel_done, -1, st["rel_h"])
+        pub3 = jnp.where(
+            is_rel & (st["height"][rb] > st["height"][pub2]), rb, pub2)
+
+        # ---- activation: node 0 mines privately, honest on pref -----
+        m = jax.random.categorical(k_mine, logw).astype(i32)
+        next_act2 = jnp.where(
+            is_act,
+            st["next_act"]
+            + jax.random.exponential(k_next, dtype=ft)
+            * activation_delay,
+            st["next_act"])
+        atk_mine = m == 0
+        parent_act = jnp.where(atk_mine, st["priv"], st["pref"][m])
+        h_parent = st["height"][parent_act]
+        n_act2 = st["n_act"] + is_act.astype(i32)
+        node_act2 = st["node_act"].at[jnp.where(is_act, m, N)].add(1)
+
+        ok_act = is_act & (st["nb"] < B)
+        drop_b2 = st["drop_b"] + (is_act & (st["nb"] >= B)).astype(i32)
+        idxs = jnp.where(ok_act, st["nb"], B)
+        parent3 = st["parent0"].at[idxs].set(parent_act)
+        height3 = st["height"].at[idxs].set(h_parent + 1)
+        miner3 = st["miner"].at[idxs].set(m)
+        nb2 = st["nb"] + ok_act.astype(i32)
+        vis3 = vis2.at[m, idxs].set(True)
+        known3 = known2.at[m, idxs].set(True)
+        vis_at3 = vis_at2.at[m, idxs].min(now2)
+        # honest miners advance their preference at mint; the
+        # attacker's mint stays private (pref[0] is public-view only)
+        pref3 = pref2.at[
+            jnp.where(ok_act & ~atk_mine, m, N)].set(st["nb"])
+        atk_new = ok_act & atk_mine
+        priv2 = jnp.where(atk_new, st["nb"], st["priv"])
+        withheld3 = withheld2.at[
+            jnp.where(atk_new, st["nb"], B)].set(True)
+
+        # ---- SSZ handle: own PoW or public-view advance -------------
+        ev = jnp.where(atk_new, EV_POW, EV_NETWORK).astype(i32)
+        do_handle = atk_new | pub_gain
+
+        # bounded two-pointer common-ancestor walk (equal heights step
+        # both sides; distinct blocks share height only off-chain, so
+        # the walk meets at the fork point)
+        x0 = jnp.where(do_handle, priv2, 0)
+        y0 = jnp.where(do_handle, pub3, 0)
+
+        def wcond(c):
+            x, y, i = c
+            return (x != y) & (i < WA)
+
+        def wstep(c):
+            x, y, i = c
+            hx = height3[x]
+            hy = height3[y]
+            x2 = jnp.where(hx >= hy, jnp.maximum(parent3[x], 0), x)
+            y2 = jnp.where(hy >= hx, jnp.maximum(parent3[y], 0), y)
+            return (x2, y2, i + 1)
+
+        xf, yf, _ = lax.while_loop(
+            wcond, wstep, (x0, y0, jnp.asarray(0, i32)))
+        win_miss2 = st["win_miss"] + (do_handle
+                                      & (xf != yf)).astype(i32)
+        h_ca = height3[xf]
+        a_rel = height3[priv2] - h_ca
+        h_rel = height3[pub3] - h_ca
+        obs = obslib.encode(OBS_FIELDS,
+                            (h_rel, a_rel, a_rel - h_rel, ev), True)
+        action = lax.switch(pid, branches, obs).astype(i32)
+        adopt = do_handle & (action == ADOPT)
+        override_eff = do_handle & (action == OVERRIDE) & (a_rel > h_rel)
+        match_eff = (do_handle & (action == MATCH) & (a_rel >= h_rel)
+                     & (h_rel > 0))
+        if strict_match:
+            match_eff = match_eff & (ev == EV_NETWORK)
+        priv3 = jnp.where(adopt, pub3, priv2)
+        withheld4 = jnp.where(adopt, jnp.zeros((B,), bool), withheld3)
+        h_pub = height3[pub3]
+        rel_h3 = jnp.where(override_eff, h_pub + 1,
+                           jnp.where(match_eff, h_pub, rel_h2))
+
+        # ---- push: unlock re-queues + link sends --------------------
+        delays = sample_delay_matrix(k_delay, kindm, p0m, p1m, ft)
+        if cn.flooding:
+            flood_src = deliver & (st["miner"][b] != arangeN)
+        else:
+            flood_src = jnp.zeros((N,), bool)
+        send_src = jnp.where(
+            is_recv, flood_src,
+            jnp.where(is_rel, arangeN == 0,
+                      (arangeN == m) & ok_act & ~atk_mine))
+        s_valid = send_src[:, None] & has_link
+        s_time = now2 + delays
+        s_blk = jnp.where(is_recv, b, jnp.where(is_rel, rb, st["nb"]))
+
+        c_valid = jnp.concatenate([unl.reshape(-1),
+                                   s_valid.reshape(-1)])
+        c_time = jnp.concatenate([jnp.full((N * F,), 1.0, ft) * now2,
+                                  s_time.reshape(-1)])
+        c_dst = jnp.concatenate([jnp.repeat(arangeN, F),
+                                 jnp.tile(arangeN, N)])
+        c_blk = jnp.concatenate([jnp.clip(pend2.reshape(-1), 0),
+                                 jnp.full((N * N,), 1, i32) * s_blk])
+
+        free = ~jnp.isfinite(q_time_pop)
+        rank = jnp.cumsum(c_valid.astype(i32))
+        n_valid = rank[-1]
+        frank = jnp.cumsum(free.astype(i32))
+        n_free = frank[-1]
+        n_place = jnp.minimum(n_valid, n_free)
+        placed = c_valid & (rank <= n_place)
+        r2c = jnp.zeros((max(C, M) + 1,), i32).at[
+            jnp.where(placed, rank, 0)].set(jnp.arange(C, dtype=i32))
+        fill = free & (frank <= n_place)
+        cidx = r2c[jnp.clip(frank, 0, C)]
+        q_time2 = jnp.where(fill, c_time[cidx], q_time_pop)
+        q_dst2 = jnp.where(fill, c_dst[cidx], st["q_dst"])
+        q_blk2 = jnp.where(fill, c_blk[cidx], st["q_blk"])
+        q_seq2 = jnp.where(fill, st["seq"] + frank, st["q_seq"])
+        seq2 = st["seq"] + n_valid
+        drop_q2 = st["drop_q"] + (n_valid - n_place)
+
+        new = dict(
+            key=key, now=now2, next_act=next_act2, n_act=n_act2,
+            nb=nb2, seq=seq2, steps=st["steps"] + 1,
+            parent0=parent3, height=height3, miner=miner3,
+            pref=pref3, vis=vis3, vis_at=vis_at3, known=known3,
+            node_act=node_act2, q_time=q_time2, q_dst=q_dst2,
+            q_blk=q_blk2, q_seq=q_seq2, pend=pend3,
+            priv=priv3, pub=pub3, withheld=withheld4, rel_h=rel_h3,
+            drop_q=drop_q2, drop_p=drop_p2, drop_b=drop_b2,
+            win_miss=win_miss2,
+        )
+        tmin2 = jnp.min(q_time2)
+        rel_pending = jnp.any(withheld4 & (height3 <= rel_h3))
+        new["live"] = (rel_pending | (n_act2 < A)
+                       | ((tmin2 < next_act2) & jnp.isfinite(tmin2)))
+        return new
+
+    def finalize(st):
+        height = st["height"]
+        hp = height[st["pref"]]
+        h_hon = jnp.where(arangeN >= 1, hp, -1)
+        jb = jnp.argmax(h_hon).astype(i32)
+        best_h = jnp.max(h_hon)
+        h_priv = height[st["priv"]]
+        # the withheld suffix competes at episode end; ties go to the
+        # attacker (engine.ml winner fold order, envs/nakamoto.py)
+        head = jnp.where(h_priv >= best_h, st["priv"], st["pref"][jb])
+        head_height = height[head]
+
+        def rstep(cur, _):
+            ok = cur > 0
+            cc = jnp.clip(cur, 0)
+            return (jnp.where(ok, st["parent0"][cc], 0),
+                    jnp.where(ok, st["miner"][cc], N))
+
+        _, miners = lax.scan(rstep, head, None, length=A + 2)
+        rewards = jnp.zeros((N + 1,), jnp.float32).at[
+            miners].add(1.0)[:N]
+        return dict(
+            head=head, head_height=head_height,
+            progress=head_height.astype(ft),
+            on_chain=head_height.astype(ft),
+            reward=rewards,
+            reward_attacker=rewards[0],
+            reward_defender=jnp.sum(rewards[1:]),
+            sim_time=st["now"], n_blocks=st["nb"] - 1,
+            n_act=st["n_act"], node_act=st["node_act"],
+            steps=st["steps"],
+            drop_q=st["drop_q"], drop_p=st["drop_p"],
+            drop_b=st["drop_b"], win_miss=st["win_miss"],
+            exhausted=st["live"] & (st["steps"] >= S),
+        )
+
+    def lane(key, activation_delay, alpha, policy_id):
+        alpha32 = jnp.asarray(alpha, jnp.float32)
+        logw = jnp.log(jnp.concatenate(
+            [alpha32[None], (1.0 - alpha32) * whon]))
+        pid = jnp.clip(policy_id, 0, n_pol - 1)
+        st = init(key, activation_delay)
+        st = jax.lax.while_loop(
+            lambda s: s["live"] & (s["steps"] < S),
+            partial(body, activation_delay=activation_delay,
+                    logw=logw, pid=pid), st)
+        return finalize(st)
+
+    return lane
+
+
+class AttackEngine:
+    """One compiled attacker-in-the-network program: fixed topology and
+    activation target; `run()` executes a batch of lanes — independent
+    (seed, activation_delay, alpha, policy_id) tuples — as a single
+    jitted, vmapped (and optionally mesh-sharded) call.
+
+        eng = AttackEngine(net, activations=2000,
+                           policies=("honest", "sapirshtein-2016-sm1"))
+        out = eng.run(seeds=[0, 1], activation_delays=[60.0, 60.0],
+                      alphas=[0.33, 0.33], policy_ids=[0, 1])
+
+    Alpha and policy id are LANE inputs: a whole alphas x policies grid
+    shares one executable.  `extra_policies` maps names to obs->action
+    callables (e.g. a loaded PPO snapshot via
+    train.driver.load_policy_snapshot); scripted names come from
+    envs.nakamoto.NakamotoSSZ.policies.
+    """
+
+    def __init__(self, net, *, protocol: str = "nakamoto", k: int = 1,
+                 scheme: str = "constant", activations: int,
+                 policies=DEFAULT_ATTACK_POLICIES, extra_policies=None,
+                 strict_match: bool = True, topology: str = "custom",
+                 block_cap: int | None = None,
+                 queue_cap: int | None = None, pend_cap: int = 8,
+                 walk_cap: int | None = None,
+                 max_steps: int | None = None,
+                 x64: bool = True, mesh=None, mesh_axis: str = "d"):
+        if not attack_supports(protocol, k, scheme):
+            raise ValueError(
+                f"netsim attack supports protocols {ATTACK_PROTOCOLS}, "
+                f"not '{protocol}'")
+        extra_policies = dict(extra_policies or {})
+        bad = [p for p in policies
+               if p not in SCRIPTED_POLICIES and p not in extra_policies]
+        if bad:
+            raise ValueError(
+                f"unknown attack policies {bad}; scripted: "
+                f"{SCRIPTED_POLICIES}, extra: "
+                f"{sorted(extra_policies)}")
+        self.net = (net if isinstance(net, CompiledNet)
+                    else compile_network(net))
+        self.protocol = protocol
+        self.topology = str(topology)
+        self.activations = int(activations)
+        self.policies = tuple(policies)
+        self.extra_policies = extra_policies
+        # extras not named in `policies` ride along after them, so a
+        # PPO snapshot can be addressed by id without reordering
+        self.policy_names = self.policies + tuple(
+            nm for nm in extra_policies if nm not in self.policies)
+        self.strict_match = bool(strict_match)
+        n, a = self.net.n, self.activations
+        self.B = block_cap or a + 2
+        # releases re-send the withheld chain: up to 2x the mint sends
+        self.M = queue_cap or max(256, 32 * n)
+        self.F = int(pend_cap)
+        # common-ancestor walk cap: the batched while_loop exits as
+        # soon as every lane's walk meets, so the absolute bound (one
+        # chain can never be longer than the ledger) costs nothing at
+        # runtime — high-alpha MATCH play sustains forks hundreds deep
+        self.WA = int(walk_cap or a + 2)
+        self.S = max_steps or a * (n + 5) + 4096
+        self.x64 = bool(x64)
+        self.mesh = mesh
+        self.mesh_axis = mesh_axis
+        self.n_devices = (int(mesh.shape[mesh_axis])
+                          if mesh is not None else 1)
+        self._exe = {}
+
+    def _ctx(self):
+        import contextlib
+
+        from jax.experimental import enable_x64
+
+        return enable_x64() if self.x64 else contextlib.nullcontext()
+
+    def _branches(self):
+        from cpr_tpu.envs.nakamoto import NakamotoSSZ
+
+        env = NakamotoSSZ(unit_observation=True,
+                          strict_match=self.strict_match)
+        out = []
+        for nm in self.policy_names:
+            out.append(self.extra_policies.get(nm) or env.policies[nm])
+        return out
+
+    def _compiled(self, keys, delays, alphas, pids):
+        import jax
+
+        L = keys.shape[0]
+        exe = self._exe.get(L)
+        if exe is None:
+            fn = _attack_lane_fn(self.net, self.activations, self.B,
+                                 self.M, self.F, self.S, self.WA,
+                                 self._branches(), self.strict_match)
+            jitted = jax.jit(jax.vmap(fn))
+            if self.mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec
+
+                from cpr_tpu.parallel.lanes import check_even_shards
+                check_even_shards(L, self.mesh, axis=self.mesh_axis,
+                                  what="attack lanes")
+                lane = NamedSharding(self.mesh,
+                                     PartitionSpec(self.mesh_axis))
+                jitted = jax.jit(
+                    jax.vmap(fn),
+                    in_shardings=(lane, lane, lane, lane),
+                    out_shardings=lane)
+            tele = telemetry.current()
+            with telemetry.compile_watch(), \
+                    tele.span("attack:compile", lanes=L):
+                exe = jitted.lower(keys, delays, alphas, pids).compile()
+            self._exe[L] = exe
+        return exe
+
+    def run(self, seeds, activation_delays, alphas, policy_ids) -> dict:
+        """Execute len(seeds) attack lanes as one device program;
+        returns numpy arrays with lane axis 0 plus the v11
+        `attack_sweep` typed telemetry event."""
+        import jax
+        import jax.numpy as jnp
+
+        seeds = list(seeds)
+        delays = list(activation_delays)
+        alphas = [float(a) for a in alphas]
+        pids = [int(p) for p in policy_ids]
+        L = len(seeds)
+        if not (len(delays) == len(alphas) == len(pids) == L):
+            raise ValueError(
+                "seeds, activation_delays, alphas, policy_ids must "
+                "pair up")
+        bad_a = [a for a in alphas if not 0.0 < a < 1.0]
+        if bad_a:
+            raise ValueError(f"alphas must lie in (0, 1), got {bad_a}")
+        tele = telemetry.current()
+        with self._ctx():
+            keys = jnp.stack([jax.random.PRNGKey(s) for s in seeds])
+            dl = jnp.asarray(delays,
+                             jnp.float64 if self.x64 else jnp.float32)
+            al = jnp.asarray(alphas, jnp.float32)
+            pi = jnp.asarray(pids, jnp.int32)
+            if self.mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec
+
+                from cpr_tpu.parallel.lanes import check_even_shards
+                check_even_shards(L, self.mesh, axis=self.mesh_axis,
+                                  what="attack lanes")
+                lane = NamedSharding(self.mesh,
+                                     PartitionSpec(self.mesh_axis))
+                keys = jax.device_put(keys, lane)
+                dl = jax.device_put(dl, lane)
+                al = jax.device_put(al, lane)
+                pi = jax.device_put(pi, lane)
+            exe = self._compiled(keys, dl, al, pi)
+            with tele.span("attack:run", lanes=L,
+                           activations=L * self.activations) as sp:
+                out = sp.fence(exe(keys, dl, al, pi))
+        out = {kk: np.asarray(v) for kk, v in out.items()}
+        drops = int(out["drop_q"].sum() + out["drop_p"].sum()
+                    + out["drop_b"].sum() + out["win_miss"].sum())
+        tele.event("attack_sweep", protocol=self.protocol,
+                   topology=self.topology, lanes=L,
+                   policies=len(self.policy_names), drops=drops,
+                   activations=int(np.sum(out["n_act"])),
+                   n_devices=self.n_devices,
+                   sweep_s=round(sp.dur_s, 6),
+                   lanes_per_sec=round(L / max(sp.dur_s, 1e-9), 3))
+        return out
+
+
+def attack_sweep(topologies, *, protocols=(("nakamoto", {}),),
+                 policies=DEFAULT_ATTACK_POLICIES, extra_policies=None,
+                 alphas=DEFAULT_ALPHAS, activation_delays=(60.0,),
+                 activations: int = 2000, reps: int = 4, seed: int = 0,
+                 strict_match: bool = True, mesh=None,
+                 engine_kwargs=None) -> list[dict]:
+    """The vmapped attack grid: protocols x topologies x delays x
+    alphas x policies, one engine (one compiled program) per
+    (protocol, topology), every other axis a lane input.  Rows use the
+    `experiments/withholding.py` schema (protocol, attack, alpha,
+    gamma, reward_attacker, reward_defender, relative_reward, ...)
+    plus topology/activation_delay/n_nodes extras; gamma reports -1.0
+    because the communication advantage emerges from message racing on
+    the real topology.  Unsupported protocols degrade to error rows
+    with a machine-readable `reason`, mirroring honest_net_rows."""
+    items = (list(topologies.items()) if isinstance(topologies, dict)
+             else list(topologies))
+    pols = list(policies) + [nm for nm in (extra_policies or {})
+                             if nm not in policies]
+    grid_pts = [(d, a, pi) for d in activation_delays for a in alphas
+                for pi in range(len(pols))]
+    rows: list[dict] = []
+    for proto, kw in protocols:
+        kk = int(kw.get("k", 1))
+        scheme = kw.get("scheme", "constant")
+        for tname, net in items:
+            ident = {"protocol": proto, "topology": str(tname),
+                     "engine": "netsim-attack"}
+            t0 = telemetry.now()
+            if not attack_supports(proto, kk, scheme):
+                rows.append({
+                    **ident,
+                    "error": (f"netsim attack supports protocols "
+                              f"{ATTACK_PROTOCOLS}, not '{proto}'"),
+                    "reason": "unsupported-protocol",
+                    "machine_duration_s": telemetry.now() - t0,
+                })
+                continue
+            try:
+                eng = AttackEngine(
+                    net, protocol=proto, k=kk, scheme=scheme,
+                    activations=activations, policies=policies,
+                    extra_policies=extra_policies,
+                    strict_match=strict_match, topology=str(tname),
+                    mesh=mesh, **(engine_kwargs or {}))
+                ss, dd, aa, pp = [], [], [], []
+                for gi, (d, a, pi) in enumerate(grid_pts):
+                    for r in range(reps):
+                        ss.append(seed + gi * reps + r)
+                        dd.append(float(d))
+                        aa.append(float(a))
+                        pp.append(pi)
+                out = eng.run(ss, dd, aa, pp)
+            except Exception as e:  # mirror experiments.sweep.run_task
+                rows.append({
+                    **ident,
+                    "error": f"{type(e).__name__}: {e}",
+                    "reason": "runtime-error",
+                    "machine_duration_s": telemetry.now() - t0,
+                })
+                continue
+            dt = telemetry.now() - t0
+            atk = out["reward_attacker"].reshape(len(grid_pts), reps)
+            dfn = out["reward_defender"].reshape(len(grid_pts), reps)
+            prg = np.asarray(out["progress"]).reshape(
+                len(grid_pts), reps)
+            for gi, (d, a, pi) in enumerate(grid_pts):
+                ra = float(atk[gi].mean())
+                rd = float(dfn[gi].mean())
+                pg = float(prg[gi].mean())
+                total = ra + rd
+                rows.append({
+                    **ident,
+                    "attack": f"{proto}-{pols[pi]}",
+                    "alpha": float(a),
+                    "gamma": -1.0,
+                    "episode_len": int(activations),
+                    "reps": int(reps),
+                    "reward_attacker": ra,
+                    "reward_defender": rd,
+                    "relative_reward": ra / total if total else 0.0,
+                    "reward_per_progress": ra / pg if pg else 0.0,
+                    "machine_duration_s": dt / len(grid_pts),
+                    "activation_delay": float(d),
+                    "n_nodes": int(eng.net.n),
+                })
+    return rows
+
+
+def _cache_dir() -> str:
+    """Sweep-cache directory: CPR_ATTACK_CACHE >
+    <CPR_TPU_CACHE>/attack_sweep > ~/.cache/cpr_tpu/attack_sweep (the
+    mdp_grid cache-dir pattern; delete the directory to bust)."""
+    d = os.environ.get("CPR_ATTACK_CACHE")
+    if d:
+        return d
+    base = os.environ.get("CPR_TPU_CACHE")
+    if base:
+        return os.path.join(base, "attack_sweep")
+    return os.path.join(os.path.expanduser("~"), ".cache", "cpr_tpu",
+                        "attack_sweep")
+
+
+def attack_sweep_cached(net, topology: str, *,
+                        protocol: str = "nakamoto", k: int = 1,
+                        scheme: str = "constant",
+                        policies=DEFAULT_ATTACK_POLICIES,
+                        alphas=DEFAULT_ALPHAS,
+                        activation_delays=(60.0,),
+                        activations: int = 2000, reps: int = 4,
+                        seed: int = 0, strict_match: bool = True,
+                        cache: bool = True, mesh=None,
+                        extra_policies=None,
+                        extra_fingerprint: str = "") -> dict:
+    """`attack_sweep` for one (protocol, topology), with the result
+    cached on disk keyed by the topology's GraphML fingerprint + every
+    sweep knob (the `mdp.solve_grid` caching pattern): anything that
+    changes the network or the grid changes the key.  The serve
+    `netsim.attack_sweep` op sits on this.  `extra_fingerprint` must
+    name any extra policy content (e.g. the PPO snapshot path) since
+    callables cannot be hashed."""
+    import cpr_tpu
+    from cpr_tpu import resilience
+    from cpr_tpu.network import to_graphml
+
+    topo_fp = hashlib.sha256(
+        to_graphml(net).encode()).hexdigest()[:16]
+    pols = list(policies) + [nm for nm in (extra_policies or {})
+                             if nm not in policies]
+    key = dict(kind="attack_sweep", protocol=protocol, k=int(k),
+               scheme=scheme, topology=str(topology), topo_fp=topo_fp,
+               policies=pols, alphas=[float(a) for a in alphas],
+               activation_delays=[float(d) for d in activation_delays],
+               activations=int(activations), reps=int(reps),
+               seed=int(seed), strict_match=bool(strict_match),
+               extra_fingerprint=str(extra_fingerprint),
+               _version=cpr_tpu.__version__)
+    h = hashlib.sha256(
+        json.dumps(key, sort_keys=True).encode()).hexdigest()[:24]
+    path = os.path.join(_cache_dir(), h + ".json")
+    if cache and os.path.exists(path):
+        with open(path) as f:
+            return dict(json.load(f)["value"], cached=True)
+    t0 = telemetry.now()
+    rows = attack_sweep(
+        [(topology, net)], protocols=((protocol, dict(k=k,
+                                                      scheme=scheme)),),
+        policies=policies, extra_policies=extra_policies,
+        alphas=alphas, activation_delays=activation_delays,
+        activations=activations, reps=reps, seed=seed,
+        strict_match=strict_match, mesh=mesh)
+    value = dict(
+        protocol=protocol, topology=str(topology),
+        topo_fingerprint=topo_fp, policies=pols,
+        alphas=[float(a) for a in alphas],
+        activation_delays=[float(d) for d in activation_delays],
+        activations=int(activations), reps=int(reps), seed=int(seed),
+        rows=rows, sweep_s=round(telemetry.now() - t0, 6),
+        cached=False)
+    if cache:
+        resilience.atomic_write_json(path, {"key": key, "value": value})
+    return value

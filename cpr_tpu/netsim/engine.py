@@ -75,27 +75,37 @@ from cpr_tpu import telemetry
 from cpr_tpu.netsim.compile import (CompiledNet, compile_network,
                                     sample_delay_matrix)
 
-SUPPORTED_PROTOCOLS = ("nakamoto", "bk")
+SUPPORTED_PROTOCOLS = ("nakamoto", "bk", "ethereum-whitepaper",
+                       "ethereum-byzantium", "spar")
 _SCHEMES = ("constant", "block")
+_ETH = ("ethereum-whitepaper", "ethereum-byzantium")
 
 
 def supports(protocol: str, k: int = 1, scheme: str = "constant") -> bool:
     """True when the engine implements this protocol config."""
-    if protocol == "nakamoto":
+    if protocol == "nakamoto" or protocol in _ETH:
         return True
-    return (protocol == "bk" and k >= 1
+    return (protocol in ("bk", "spar") and k >= 1
             and (scheme or "constant") in _SCHEMES)
 
 
 def _lane_fn(cn: CompiledNet, protocol: str, k: int, scheme: str,
-             activations: int, B: int, M: int, F: int, W: int, S: int):
+             activations: int, B: int, M: int, F: int, W: int, S: int,
+             U: int = 2):
     """Build lane(key, activation_delay) -> metrics dict.  All shapes
-    static; closure constants come from the compiled network."""
+    static; closure constants come from the compiled network.  `U` is
+    the ethereum uncle capacity per block (byzantium: exactly the
+    protocol's cap of 2; whitepaper: a fixed budget whose overflow
+    counts into `win_miss`, asserted 0 by the parity tests)."""
     import jax
     import jax.numpy as jnp
     from jax import lax
 
     is_bk = protocol == "bk"
+    is_eth = protocol in _ETH
+    byz = protocol == "ethereum-byzantium"
+    is_spar = protocol == "spar"
+    KQ = max(k - 1, 1)          # spar quorum row width (k-1 votes)
     N = int(cn.n)
     A = int(activations)
     C = N * F + N * N  # per-step push candidates: unlocks + sends
@@ -150,6 +160,20 @@ def _lane_fn(cn: CompiledNet, protocol: str, k: int, scheme: str,
                 repl=jnp.full((N, B), 2.0, jnp.float32),
                 noprop=jnp.zeros((N, B), bool),
                 quorum=jnp.full((B, k), -1, i32),
+                win_miss=jnp.asarray(0, i32),
+            )
+        if is_eth:
+            st.update(
+                work=jnp.zeros((B,), i32),
+                uncles=jnp.full((B, U), -1, i32),
+                win_miss=jnp.asarray(0, i32),
+            )
+        if is_spar:
+            st.update(
+                is_vote=jnp.zeros((B,), bool),
+                conf=jnp.zeros((N, B), i32),
+                conf_own=jnp.zeros((N, B), i32),
+                quorum=jnp.full((B, KQ), -1, i32),
                 win_miss=jnp.asarray(0, i32),
             )
         return st
@@ -237,6 +261,26 @@ def _lane_fn(cn: CompiledNet, protocol: str, k: int, scheme: str,
             better = (hb > hp) | ((hb == hp) & (
                 (cb > cp) | ((cb == cp) & (lb < lp))))
             pref2 = jnp.where(deliver & better, bb, st["pref"])
+        elif is_eth:
+            # ethereum.ml preference: byzantium by height, whitepaper
+            # by cumulative work; strict > (incumbent wins ties)
+            ekey = st["height"] if byz else st["work"]
+            better = ekey[b] > ekey[st["pref"]]
+            pref2 = jnp.where(deliver & better, b, st["pref"])
+        elif is_spar:
+            # ParallelBase prefer: candidate = the chain block (vote ->
+            # the block it confirms, which IS its parent0); keys
+            # (height, visible confirming votes), incumbent wins ties
+            is_v = st["is_vote"][b]
+            dv = deliver & is_v
+            conf2 = st["conf"].at[arangeN, pbc].add(dv.astype(i32))
+            bb = jnp.where(is_v, pbc, b)
+            hb = st["height"][bb]
+            hp = st["height"][st["pref"]]
+            cb = conf2[arangeN, bb]
+            cp = conf2[arangeN, st["pref"]]
+            better = (hb > hp) | ((hb == hp) & (cb > cp))
+            pref2 = jnp.where(deliver & better, bb, st["pref"])
         else:
             better = st["height"][b] > st["height"][st["pref"]]
             pref2 = jnp.where(deliver & better, b, st["pref"])
@@ -263,6 +307,104 @@ def _lane_fn(cn: CompiledNet, protocol: str, k: int, scheme: str,
         n_act2 = st["n_act"] + is_act.astype(i32)
         node_act2 = st["node_act"].at[
             jnp.where(is_act, m, N)].add(1)
+
+        # ---- ethereum draft: uncle selection at mint ----------------
+        if is_eth:
+            # 6-generation chain window from the miner's preference
+            # (ethereum.ml chain_window): ancs[j] = (j+1)'th chain
+            # ancestor of the tip, -1 past genesis
+            tip = parent_act
+            ancs = []
+            cur = tip
+            for _ in range(6):
+                cur = jnp.where(cur > 0,
+                                st["parent0"][jnp.clip(cur, 0)], -1)
+                ancs.append(cur)
+            anc = jnp.stack(ancs)                    # (6,)
+            # in-chain set = tip + every window block's parents (chain
+            # parent + its uncle list); candidates must avoid it
+            winb = jnp.stack([tip] + ancs[:5])       # the 6 window blocks
+            in_chain = jnp.concatenate([
+                jnp.stack([tip] + ancs),
+                st["uncles"][jnp.clip(winb, 0)].reshape(-1)])
+            # candidate scan over a ledger window from the deepest
+            # ancestor (uncles are minted after their chain parent, so
+            # every candidate id exceeds it); a window that cannot see
+            # the whole [deepest, nb) range is a potential silent miss
+            # — counted, asserted 0 by parity
+            e_start = jnp.clip(
+                jnp.minimum(jnp.min(jnp.where(anc >= 0, anc, B)),
+                            st["nb"]), 0, max(B - W, 0))
+            sl_epar = lax.dynamic_slice(st["parent0"], (e_start,), (W,))
+            sl_ekey = lax.dynamic_slice(
+                st["height"] if byz else st["work"], (e_start,), (W,))
+            sl_emn = lax.dynamic_slice(st["miner"], (e_start,), (W,))
+            sl_evs = lax.dynamic_slice(st["vis"][m], (e_start,), (W,))
+            egidx = e_start + jnp.arange(W, dtype=i32)
+            par_in_anc = jnp.any((sl_epar[None, :] == anc[:, None])
+                                 & (anc[:, None] >= 0), axis=0)
+            not_chain = jnp.all(egidx[None, :] != in_chain[:, None],
+                                axis=0)
+            ecand = sl_evs & par_in_anc & not_chain & (egidx < st["nb"])
+            # sort: own uncles first, then older (lower pref key) first
+            skey = jnp.where(
+                ecand,
+                jnp.where(sl_emn == m, 0.0, 1e6)
+                + sl_ekey.astype(jnp.float32), 1e9)
+            e_ord = jnp.argsort(skey)
+            n_cand = jnp.sum(ecand).astype(i32)
+            n_unc = jnp.minimum(n_cand, U)
+            iu = jnp.arange(U, dtype=i32)
+            uncle_row = jnp.where(
+                iu < n_unc,
+                e_start + e_ord[jnp.clip(iu, 0, W - 1)], -1).astype(i32)
+            # byzantium's cap of 2 is the protocol rule; the whitepaper
+            # preset is unbounded, so dropping past U is a miss
+            win_miss2 = st["win_miss"] + (is_act & (
+                (st["nb"] > e_start + W)
+                | ((not byz) & (n_cand > U)))).astype(i32)
+            a_work = st["work"][tip] + 1 + n_unc
+
+        # ---- spar draft: block iff k-1 confirming votes visible -----
+        if is_spar:
+            pj = parent_act
+            can_block = st["conf"][m, pj] >= (k - 1)
+            s_start = jnp.clip(pj + 1, 0, max(B - W, 0))
+            sp_par = lax.dynamic_slice(st["parent0"], (s_start,), (W,))
+            sp_iv = lax.dynamic_slice(st["is_vote"], (s_start,), (W,))
+            sp_mn = lax.dynamic_slice(st["miner"], (s_start,), (W,))
+            sp_vs = lax.dynamic_slice(st["vis"][m], (s_start,), (W,))
+            onpar = (sp_par == pj) & sp_iv & sp_vs
+            mine = onpar & (sp_mn == m)
+            theirs = onpar & (sp_mn != m)
+            n_mine = jnp.sum(mine).astype(i32)
+            n_their = jnp.sum(theirs).astype(i32)
+            cnt_ok = ((n_mine == st["conf_own"][m, pj])
+                      & (n_their == st["conf"][m, pj]
+                         - st["conf_own"][m, pj]))
+            win_miss2 = st["win_miss"] + (
+                is_act & can_block & ~cnt_ok).astype(i32)
+            # quorum = k-1 confirming votes, own first then others',
+            # each group in append (= mint-time) order — the stable
+            # sort of spar.ml:205-213 (mint times are unique, so
+            # append order IS time order)
+            kq = k - 1
+            take_mine = jnp.minimum(n_mine, kq)
+            need = jnp.clip(kq - n_mine, 0, kq)
+            mrank = jnp.cumsum(mine.astype(i32))
+            r2m = jnp.zeros((W + 1,), i32).at[
+                jnp.where(mine & (mrank <= kq), mrank, 0)].set(
+                jnp.arange(W, dtype=i32))
+            trank = jnp.cumsum(theirs.astype(i32))
+            r2t = jnp.zeros((W + 1,), i32).at[
+                jnp.where(theirs & (trank <= need), trank, 0)].set(
+                jnp.arange(W, dtype=i32))
+            iq = jnp.arange(KQ, dtype=i32)
+            own_part = s_start + r2m[jnp.clip(iq + 1, 0, W)]
+            their_part = s_start + r2t[
+                jnp.clip(iq - take_mine + 1, 0, W)]
+            q_row = jnp.where(iq < take_mine, own_part, their_part)
+            q_row = jnp.where(iq < kq, q_row, -1).astype(i32)
 
         # ---- bk proposal (one proposer per step, no time advance) ---
         if is_bk:
@@ -327,6 +469,12 @@ def _lane_fn(cn: CompiledNet, protocol: str, k: int, scheme: str,
             a_miner = jnp.where(is_act, m, jstar)
             a_powh = jnp.where(is_act, powh_new, jnp.float32(2.0))
             a_lhash = jnp.where(is_act, jnp.float32(2.0), mb)
+        elif is_spar:
+            a_parent = parent_act
+            # a vote sits at its confirmed block's height; a block one up
+            a_height = h_parent + can_block.astype(i32)
+            a_miner = m
+            a_powh = powh_new
         else:
             a_parent = parent_act
             a_height = h_parent + 1
@@ -359,6 +507,24 @@ def _lane_fn(cn: CompiledNet, protocol: str, k: int, scheme: str,
             repl3 = repl2.at[jstar, pidx].min(mb)
             pref3 = pref2.at[jnp.where(ok_prop, jstar, N)].set(
                 st["nb"])
+        elif is_eth:
+            work3 = st["work"].at[idxs].set(
+                jnp.where(is_act, a_work, 0))
+            uncles3 = st["uncles"].at[
+                jnp.where(ok_act, st["nb"], B)].set(uncle_row)
+            pref3 = pref2.at[jnp.where(ok_act, m, N)].set(st["nb"])
+        elif is_spar:
+            isv3 = st["is_vote"].at[idxs].set(is_act & ~can_block)
+            # vote mint: own confirming tallies on the parent block
+            vidx = jnp.where(ok_act & ~can_block, parent_act, B)
+            conf3 = conf2.at[m, vidx].add(1)
+            conf_own2 = st["conf_own"].at[m, vidx].add(1)
+            quorum2 = st["quorum"].at[
+                jnp.where(ok_act & can_block, st["nb"], B)].set(q_row)
+            # a freshly mined block advances the miner's preference; a
+            # vote leaves it on the same chain block
+            pref3 = pref2.at[
+                jnp.where(ok_act & can_block, m, N)].set(st["nb"])
         else:
             pref3 = pref2.at[jnp.where(ok_act, m, N)].set(st["nb"])
 
@@ -417,6 +583,13 @@ def _lane_fn(cn: CompiledNet, protocol: str, k: int, scheme: str,
             want2 = jnp.any(bk_want(pref3, conf3, conf_own2, mybest2,
                                     repl3, noprop4))
         else:
+            if is_eth:
+                new.update(work=work3, uncles=uncles3,
+                           win_miss=win_miss2)
+            if is_spar:
+                new.update(is_vote=isv3, conf=conf3,
+                           conf_own=conf_own2, quorum=quorum2,
+                           win_miss=win_miss2)
             want2 = jnp.asarray(False, bool)
         tmin2 = jnp.min(q_time2)
         new["live"] = (want2 | (n_act2 < A)
@@ -427,11 +600,17 @@ def _lane_fn(cn: CompiledNet, protocol: str, k: int, scheme: str,
         height = st["height"]
         pref = st["pref"]
         hp = height[pref]
-        if is_bk:
+        if is_bk or is_spar:
+            # bk votes' parent0 is the block they extend; spar votes'
+            # parent0 IS the block they confirm — either way the
+            # per-block vote tally is one scatter over parent0
             votes = jnp.zeros((B,), i32).at[
                 jnp.clip(st["parent0"], 0)].add(
                 st["is_vote"].astype(i32))
             score = hp.astype(ft) * (A + 1.0) + votes[pref].astype(ft)
+        elif is_eth:
+            ekey = st["height"] if byz else st["work"]
+            score = ekey[pref].astype(ft)
         else:
             score = hp.astype(ft)
         head = pref[jnp.argmax(score)]
@@ -440,13 +619,24 @@ def _lane_fn(cn: CompiledNet, protocol: str, k: int, scheme: str,
             progress = head_height * k
             on_chain = head_height * (k + 1)
             walk_len = A // max(k, 1) + 3
+        elif is_spar:
+            # k PoWs (k-1 votes + the block) per confirmed height
+            progress = head_height * k
+            on_chain = head_height * k
+            walk_len = A // max(k, 1) + 3
+        elif is_eth:
+            # whitepaper progresses by height, byzantium by work;
+            # on_chain (block + its uncles) accumulates in the walk
+            progress = st["work"][head] if byz else head_height
+            on_chain = head_height          # placeholder, see below
+            walk_len = A + 2
         else:
             progress = head_height
             on_chain = head_height
             walk_len = A + 2
 
         def rstep(carry, _):
-            cur, rew = carry
+            cur, rew, onc = carry
             ok = cur > 0
             cc = jnp.clip(cur, 0)
             if is_bk:
@@ -458,14 +648,45 @@ def _lane_fn(cn: CompiledNet, protocol: str, k: int, scheme: str,
                     vm = st["miner"][jnp.clip(qr, 0)]
                     rew = rew.at[jnp.where(ok & (qr >= 0), vm, N)
                                  ].add(1.0)
+            elif is_spar:
+                if scheme == "block":
+                    rew = rew.at[jnp.where(ok, st["miner"][cc], N)
+                                 ].add(jnp.float32(k))
+                else:
+                    # constant: the block's miner and each quorum
+                    # vote's miner get 1 (spar.ml rewards)
+                    rew = rew.at[jnp.where(ok, st["miner"][cc], N)
+                                 ].add(1.0)
+                    qr = st["quorum"][cc]
+                    vm = st["miner"][jnp.clip(qr, 0)]
+                    rew = rew.at[jnp.where(ok & (qr >= 0), vm, N)
+                                 ].add(1.0)
+            elif is_eth:
+                urow = st["uncles"][cc]
+                nu = jnp.sum(urow >= 0).astype(i32)
+                rew = rew.at[jnp.where(ok, st["miner"][cc], N)].add(
+                    1.0 + nu.astype(jnp.float32) * 0.03125)
+                um = st["miner"][jnp.clip(urow, 0)]
+                if byz:
+                    uh = st["height"][jnp.clip(urow, 0)]
+                    amt = (8.0 - (st["height"][cc] - uh)
+                           .astype(jnp.float32)) / 8.0
+                else:
+                    amt = jnp.full((U,), 0.9375, jnp.float32)
+                rew = rew.at[jnp.where(ok & (urow >= 0), um, N)
+                             ].add(amt)
+                onc = onc + jnp.where(ok, 1 + nu, 0)
             else:
                 rew = rew.at[jnp.where(ok, st["miner"][cc], N)
                              ].add(1.0)
-            return (jnp.where(ok, st["parent0"][cc], 0), rew), None
+            return (jnp.where(ok, st["parent0"][cc], 0), rew, onc), None
 
-        (_, rewards), _ = lax.scan(
-            rstep, (head, jnp.zeros((N,), jnp.float32)), None,
+        (_, rewards, onc), _ = lax.scan(
+            rstep, (head, jnp.zeros((N,), jnp.float32),
+                    jnp.asarray(0, i32)), None,
             length=walk_len)
+        if is_eth:
+            on_chain = onc
 
         out = dict(
             head=head, head_height=head_height,
@@ -478,7 +699,7 @@ def _lane_fn(cn: CompiledNet, protocol: str, k: int, scheme: str,
             drop_b=st["drop_b"],
             exhausted=st["live"] & (st["steps"] >= S),
         )
-        out["win_miss"] = (st["win_miss"] if is_bk
+        out["win_miss"] = (st["win_miss"] if (is_bk or is_eth or is_spar)
                            else jnp.asarray(0, i32))
         return out
 
@@ -714,7 +935,7 @@ class Engine:
                  scheme: str = "constant", activations: int,
                  block_cap: int | None = None,
                  queue_cap: int | None = None, pend_cap: int = 8,
-                 window: int | None = None,
+                 window: int | None = None, uncle_cap: int | None = None,
                  max_steps: int | None = None, x64: bool = True,
                  mode: str = "auto", lookback: int = 32,
                  mesh=None, mesh_axis: str = "d"):
@@ -723,9 +944,10 @@ class Engine:
                 f"netsim supports protocols {SUPPORTED_PROTOCOLS}, "
                 f"not '{protocol}'")
         scheme = scheme or "constant"
-        if protocol == "bk" and (k < 1 or scheme not in _SCHEMES):
+        if protocol in ("bk", "spar") and (k < 1
+                                           or scheme not in _SCHEMES):
             raise ValueError(
-                f"bk needs k >= 1 and scheme in {_SCHEMES} "
+                f"{protocol} needs k >= 1 and scheme in {_SCHEMES} "
                 f"(got k={k}, scheme='{scheme}')")
         self.net = (net if isinstance(net, CompiledNet)
                     else compile_network(net))
@@ -741,7 +963,14 @@ class Engine:
             self.B = block_cap or (
                 a + min(n, self.k) * (a // max(self.k, 1) + 2) + 64)
         else:
+            # nakamoto / ethereum / spar: every activation appends
+            # exactly one PoW item (spar votes included)
             self.B = block_cap or a + 2
+        # ethereum uncle capacity: byzantium's protocol cap of 2 is
+        # exact; the whitepaper preset is unbounded, so a fixed budget
+        # applies and overflow counts into win_miss
+        self.U = int(uncle_cap or (2 if protocol == "ethereum-byzantium"
+                                   else 8))
         self.M = queue_cap or max(256, 16 * n)
         self.F = int(pend_cap)
         self.W = min(self.B, window or max(256, 32 * (self.k + n)))
@@ -786,7 +1015,7 @@ class Engine:
             else:
                 fn = _lane_fn(self.net, self.protocol, self.k,
                               self.scheme, self.activations, self.B,
-                              self.M, self.F, self.W, self.S)
+                              self.M, self.F, self.W, self.S, self.U)
             jitted = jax.jit(jax.vmap(fn))
             if self.mesh is not None:
                 from jax.sharding import NamedSharding, PartitionSpec

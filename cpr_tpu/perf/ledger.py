@@ -316,6 +316,30 @@ def iter_trace_rows(path: str):
                             "backend": backend,
                             "value": round(solve_s / points, 6),
                             "unit": "seconds", **mdp_cfg}, base)
+            elif (e.get("kind") == "event"
+                  and e.get("name") == "attack_sweep"):
+                # schema v11: adversary-in-the-network sweeps bank
+                # their vmapped lane throughput, fingerprinted by
+                # protocol/topology/sweep shape and the sweep's own
+                # device count — a clique-4 sweep never gates against
+                # a ring-6 one, nor an 8-lane grid against a 16-lane
+                lps = e.get("lanes_per_sec")
+                if not isinstance(lps, (int, float)):
+                    continue
+                atk_cfg = {
+                    **{f"cfg_{k}": v for k, v in config.items()},
+                    "cfg_protocol": str(e.get("protocol")),
+                    "cfg_topology": str(e.get("topology")),
+                }
+                for shape_key in ("lanes", "activations"):
+                    if isinstance(e.get(shape_key), (int, float)):
+                        atk_cfg[f"cfg_{shape_key}"] = int(e[shape_key])
+                nd = e.get("n_devices")
+                if isinstance(nd, (int, float)) and nd:
+                    atk_cfg["cfg_devices"] = int(nd)
+                yield ({"metric": "attack_sweep_lanes_per_sec",
+                        "backend": backend, "value": lps,
+                        "unit": "lanes/sec", **atk_cfg}, base)
 
 
 class Ledger:

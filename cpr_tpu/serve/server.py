@@ -175,6 +175,12 @@ class ServeServer:
         # dispatch walls (the `stats`/`heartbeat`/`report` SLO surface)
         self.latency = LatencyBoard()
         self._netsim_engines: dict[tuple, object] = {}
+        # loaded nets servable as attack policies (main() mirrors the
+        # engine's snapshot table here; the fingerprint — the snapshot
+        # path — keys the attack-sweep disk cache, since callables
+        # cannot be hashed)
+        self.attack_policies: dict = {}
+        self.attack_fingerprint: str = ""
         self._server = None
         self._loop_task = None
         self._draining = False
@@ -494,6 +500,11 @@ class ServeServer:
             _serve_event("query", endpoint="mdp.solve_grid",
                          protocol=req.get("protocol"))
             return out
+        if op == "netsim.attack_sweep":
+            out = await self._blocking(self._attack_sweep, req)
+            _serve_event("query", endpoint="netsim.attack_sweep",
+                         protocol=req.get("protocol"))
+            return out
         return dict(ok=False, error=f"unknown op {op!r}")
 
     # -- admission control -------------------------------------------------
@@ -728,6 +739,62 @@ class ServeServer:
             include_policy=bool(req.get("include_policy", False)))
         return dict(ok=True, **out)
 
+    def _attack_sweep(self, req: dict) -> dict:
+        """Adversary-in-the-network sweeps (netsim.attack_sweep_cached):
+        the whole protocol x topology x delay x alpha x policy grid of
+        one request runs as a single vmapped lane batch, served from
+        the topology-fingerprint disk cache.  `topology` selects the
+        network: {"kind": "two-agents"} (default, the degenerate
+        anchor), {"kind": "clique", "n", "propagation_delay"}, or
+        {"kind": "graphml", "xml", "label"} for arbitrary topologies
+        over the wire.  Loaded policy snapshots (--policy-snapshot)
+        are addressable by name next to the scripted SSZ policies."""
+        from cpr_tpu import netsim
+        from cpr_tpu.netsim.attack import DEFAULT_ALPHAS
+        from cpr_tpu.network import (of_graphml, symmetric_clique,
+                                     two_agents)
+
+        topo = req.get("topology") or {"kind": "two-agents"}
+        kind = topo.get("kind", "two-agents")
+        act_delay = float(topo.get("activation_delay", 60.0))
+        if kind == "graphml":
+            net = of_graphml(topo["xml"])
+            label = str(topo.get("label", "graphml"))
+        elif kind == "clique":
+            n = int(topo.get("n", 4))
+            net = symmetric_clique(
+                n, activation_delay=act_delay,
+                propagation_delay=float(
+                    topo.get("propagation_delay", 1.0)))
+            label = f"clique-{n}"
+        elif kind == "two-agents":
+            net = two_agents(alpha=0.5, activation_delay=act_delay)
+            label = "two-agents"
+        else:
+            raise ValueError(f"unknown topology kind {kind!r}")
+        policies = tuple(req.get("policies",
+                                 netsim.DEFAULT_ATTACK_POLICIES))
+        extra = {nm: fn for nm, fn in self.attack_policies.items()
+                 if nm in policies}
+        out = netsim.attack_sweep_cached(
+            net, label,
+            protocol=req.get("protocol", "nakamoto"),
+            k=int(req.get("k", 1)),
+            scheme=req.get("scheme", "constant"),
+            policies=tuple(p for p in policies if p not in extra),
+            extra_policies=extra or None,
+            extra_fingerprint=self.attack_fingerprint if extra else "",
+            alphas=tuple(float(a)
+                         for a in req.get("alphas", DEFAULT_ALPHAS)),
+            activation_delays=tuple(
+                float(d) for d in req.get("activation_delays",
+                                          (act_delay,))),
+            activations=int(req.get("activations", 2000)),
+            reps=int(req.get("reps", 4)),
+            seed=int(req.get("seed", 0)),
+            cache=bool(req.get("cache", True)))
+        return dict(ok=True, **out)
+
 
 # -- child entry point ----------------------------------------------------
 
@@ -823,6 +890,11 @@ def main(argv=None) -> int:
                              slo_s=args.slo_s, max_queued=args.max_queue,
                              tenant_quota=args.tenant_quota,
                              replica_index=args.replica_index)
+        # the same loaded nets double as in-network attack policies
+        # (netsim.attack_sweep); the snapshot path is the cache
+        # fingerprint for their sweep results
+        server.attack_policies = dict(extra)
+        server.attack_fingerprint = args.policy_snapshot or ""
         await server.start()
         if args.ready_file:
             resilience.atomic_write_json(

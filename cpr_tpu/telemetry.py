@@ -54,7 +54,7 @@ from contextlib import contextmanager
 from datetime import datetime, timezone
 from time import perf_counter as now  # noqa: F401 — re-exported
 
-SCHEMA_VERSION = 10
+SCHEMA_VERSION = 11
 TELEMETRY_ENV_VAR = "CPR_TELEMETRY"
 # trace context: one run id per process tree, exported so supervisor
 # children and serve clients land their events under the same id
@@ -134,6 +134,16 @@ EVENT_FIELDS = {
     # solve_s, points_per_sec (the ledger lifts the rate via
     # iter_trace_rows-style banking in tools/mdp_smoke.py).
     "mdp_solve": ("protocol", "cutoff", "grid", "sweeps", "converged"),
+    # v11: one per adversary-in-the-network sweep
+    # (cpr_tpu/netsim/attack.py AttackEngine.run): lanes counts the
+    # vmapped (seed, delay, alpha, policy) tuples of the batch,
+    # policies the size of the lane policy table, drops sums every
+    # capacity-overflow counter including the common-ancestor walk cap
+    # (healthy runs report drops=0).  Extras ride free-form:
+    # activations, n_devices, sweep_s, lanes_per_sec (the perf ledger
+    # lifts the rate into attack_sweep_lanes_per_sec rows).
+    "attack_sweep": ("protocol", "topology", "lanes", "policies",
+                     "drops"),
 }
 
 

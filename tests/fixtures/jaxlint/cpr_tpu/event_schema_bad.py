@@ -13,3 +13,6 @@ def report(tele, fn_name, tid):
     # finding: missing op (v9 route)
     tele.emit({"kind": "event", "name": "route", "action": "requeue",
                "replica": 1})
+    # finding: missing policies, drops (v11 attack_sweep)
+    tele.event("attack_sweep", protocol="nakamoto",
+               topology="two-agents", lanes=54)

@@ -15,3 +15,6 @@ def report(tele, fn_name, dt, err, extra, tid):
                priority=1, tenant=None, retry_after_s=dt)
     tele.emit({"kind": "event", "name": "route", "action": "route",
                "replica": 0, "op": "episode.run", "seed": 7})
+    tele.event("attack_sweep", protocol="nakamoto",
+               topology="two-agents", lanes=54, policies=3, drops=0,
+               lanes_per_sec=dt)  # extras ride free-form

@@ -8,4 +8,6 @@ EVENT_FIELDS = {
     "admission": ("reason", "op", "priority", "tenant",
                   "retry_after_s"),
     "route": ("action", "replica", "op"),
+    "attack_sweep": ("protocol", "topology", "lanes", "policies",
+                     "drops"),
 }

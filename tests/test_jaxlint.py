@@ -75,6 +75,8 @@ def test_event_fields_resolved_cross_module_by_ast():
         "admission": ("reason", "op", "priority", "tenant",
                       "retry_after_s"),
         "route": ("action", "replica", "op"),
+        "attack_sweep": ("protocol", "topology", "lanes", "policies",
+                         "drops"),
     }
 
 

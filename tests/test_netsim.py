@@ -147,6 +147,51 @@ def test_bk_event_engine_invariants():
     assert np.all(hh < 400)
 
 
+def test_ethereum_event_engine_invariants():
+    """Both ethereum variants run on the event engine: zero overflow,
+    rewards bounded by [height, activations] (miner 1/block + bounded
+    uncle terms), byzantium progress counts uncle work."""
+    for proto in ("ethereum-whitepaper", "ethereum-byzantium"):
+        out = netsim.Engine(_clique(), protocol=proto,
+                            activations=400).run([0, 1], [50.0, 200.0])
+        for key in ("drop_q", "drop_p", "drop_b", "win_miss"):
+            assert not np.any(out[key]), (proto, key, out[key])
+        assert not np.any(out["exhausted"])
+        assert np.all(out["node_act"].sum(axis=1) == 400)
+        hh = np.asarray(out["head_height"])
+        prog = np.asarray(out["progress"])
+        rew = np.asarray(out["reward"]).sum(axis=1)
+        onc = np.asarray(out["on_chain"])
+        if proto == "ethereum-byzantium":
+            # progress = work on the preferred tip >= chain height
+            assert np.all(prog >= hh), (prog, hh)
+        else:
+            np.testing.assert_allclose(prog, hh)
+        assert np.all(rew >= hh - 1e-6), (proto, rew, hh)
+        assert np.all(rew <= 400.0 + 1e-6), (proto, rew)
+        # uncles land on chain alongside the linear ancestry
+        assert np.all(onc >= hh), (proto, onc, hh)
+
+
+def test_spar_event_engine_invariants():
+    """Spar on the event engine: every k activations close one height
+    (one block + k-1 votes), rewards sum to k per height for both
+    reward schemes, progress = height * k."""
+    for scheme in ("constant", "block"):
+        out = netsim.Engine(_clique(), protocol="spar", k=4,
+                            scheme=scheme,
+                            activations=400).run([0, 1], [50.0, 200.0])
+        _assert_clean(out, 400)
+        hh = np.asarray(out["head_height"])
+        np.testing.assert_allclose(np.asarray(out["progress"]), hh * 4)
+        # 400 activations / k=4 => ~100 heights, minus orphaned votes
+        assert np.all(hh > 80) and np.all(hh <= 100), hh
+    # k=1 degenerates to a nakamoto-like chain
+    out = netsim.Engine(_clique(), protocol="spar", k=1,
+                        activations=300).run([0], [60.0])
+    _assert_clean(out, 300)
+
+
 def test_netsim_emits_typed_event_and_spans(tmp_path):
     """The engine's telemetry lands as schema-valid artifacts: fenced
     netsim:run spans plus the typed `netsim` point event."""
@@ -185,6 +230,9 @@ def test_honest_net_rows_jax_schema():
     assert len(ok) == 2 and len(bad) == 1
     assert bad[0]["protocol"] == "tailstorm"
     assert "netsim supports protocols" in bad[0]["error"]
+    # machine-readable error class: tools filter on `reason` instead
+    # of parsing the message (the column shrinks as ports land)
+    assert bad[0]["reason"] == "unsupported-protocol"
     assert set(oracle[0]) == set(ok[0])
     for r in ok:
         assert r["engine"] == "jax"
@@ -326,3 +374,47 @@ def test_parity_bk_event_engine():
     for i, ad in enumerate(delays):
         gap = abs(float(orphan[i].mean()) - float(oracle[ad]))
         assert gap < 0.006, (ad, orphan[i], oracle[ad])
+
+
+def _parity_reduced(proto, kw, band=0.006):
+    """Reduced event-engine parity grid (see test_parity_bk_event_engine
+    for why it stays at 4 lanes x 4k activations)."""
+    n, a = 10, 4_000
+    delays = (30.0, 120.0)
+    seeds = (0, 1)
+    oracle = {ad: np.mean([_oracle_orphan(proto, kw, n, ad, a, s)
+                           for s in seeds]) for ad in delays}
+    ss, dd = netsim.grid(seeds, delays)
+    out = netsim.Engine(_clique(n), protocol=proto, activations=a,
+                        **kw).run(ss, dd)
+    for key in ("drop_q", "drop_p", "drop_b", "win_miss"):
+        assert not np.any(out[key]), (proto, key, out[key])
+    assert not np.any(out["exhausted"])
+    orphan = _orphan(out, a).reshape(len(delays), len(seeds))
+    for i, ad in enumerate(delays):
+        gap = abs(float(orphan[i].mean()) - float(oracle[ad]))
+        assert gap < band, (proto, ad, orphan[i], oracle[ad])
+
+
+@pytest.mark.slow
+def test_parity_ethereum_whitepaper_event_engine():
+    """Ethereum (whitepaper uncle accounting) vs the unmodified oracle:
+    progress = chain height, so orphan rate exercises the work-based
+    preference + uncle window jointly."""
+    _parity_reduced("ethereum-whitepaper", {})
+
+
+@pytest.mark.slow
+def test_parity_ethereum_byzantium_event_engine():
+    """Byzantium variant: height-based preference, uncle cap 2,
+    progress = tip work (uncles count), so the measured 'orphan rate'
+    is the work the network failed to absorb."""
+    _parity_reduced("ethereum-byzantium", {})
+
+
+@pytest.mark.slow
+def test_parity_spar_event_engine():
+    """Spar k=4 vs the oracle: vote-confirmation gating means orphans
+    are votes on the losing branch; progress = height * k on both
+    sides."""
+    _parity_reduced("spar", dict(k=4, scheme="constant"))

@@ -102,6 +102,46 @@ def test_network_graphml_roundtrip():
             [(l.dest, l.delay) for l in b.links]
 
 
+def test_fixture_topologies_roundtrip():
+    """The shipped GraphML fixtures (tests/fixtures/topologies/) parse,
+    round-trip through to_graphml/of_graphml, and have the documented
+    shape — so topology-axis tests never depend on external files."""
+    import os
+
+    fixdir = os.path.join(os.path.dirname(__file__), "fixtures",
+                          "topologies")
+
+    def load(name):
+        with open(os.path.join(fixdir, name)) as f:
+            return netlib.of_graphml(f.read())
+
+    ring = load("ring-6.xml")
+    assert len(ring.nodes) == 6
+    assert ring.activation_delay == 60.0
+    assert ring.dissemination == "flooding"
+    for i, node in enumerate(ring.nodes):
+        # undirected ring: reverse links materialized, degree 2
+        assert sorted(l.dest for l in node.links) == \
+            sorted(((i - 1) % 6, (i + 1) % 6))
+        assert all(l.delay == dist.exponential(2) for l in node.links)
+
+    clusters = load("two-cluster-8.xml")
+    assert len(clusters.nodes) == 8
+    assert clusters.nodes[0].compute == 2.0  # attacker-heavy node 0
+    bridge = [l for l in clusters.nodes[3].links if l.dest == 4]
+    assert bridge and bridge[0].delay == dist.uniform(10, 20)
+    assert sorted(l.dest for l in clusters.nodes[0].links) == [1, 2, 3]
+
+    for net in (ring, clusters):
+        back = netlib.of_graphml(netlib.to_graphml(net))
+        assert back.activation_delay == net.activation_delay
+        assert back.dissemination == net.dissemination
+        for a, b in zip(net.nodes, back.nodes):
+            assert a.compute == pytest.approx(b.compute)
+            assert [(l.dest, l.delay) for l in a.links] == \
+                [(l.dest, l.delay) for l in b.links]
+
+
 def _graphml_with_delay(delay_str):
     net = netlib.symmetric_clique(3, activation_delay=20.0,
                                   propagation_delay=1.0)

@@ -1,0 +1,386 @@
+"""Adversary-in-the-network smoke (`make attack-smoke`).
+
+Proves the netsim attack subsystem (docs/NETSIM.md, "Attacks under
+real networks") end-to-end on the CPU CI host — sweep children run
+under forced 1-device and 2-device XLA CPU meshes, so the lane-axis
+sharding seam is exercised with no accelerator:
+
+  1  per device count, a sweep child runs `attack_sweep` over a
+     protocol x topology x alpha grid (nakamoto + an unsupported
+     protocol on the 4-node clique) with alpha and policy as LANE
+     inputs — the whole alphas x policies x reps batch is ONE vmapped
+     (and, at 2 devices, lane-sharded) device program — the nakamoto
+     rows must come back clean (full withholding row schema) and the
+     unsupported protocol must degrade to a reason-tagged error row;
+  2  lane parity: the reward columns of the sweep rows must be
+     BIT-IDENTICAL between the 1-device and 2-device runs — same
+     lanes, partitioned;
+  3  an anchor child asserts the degenerate-network equivalence: on
+     the zero-delay two-node clique, the netsim attacker's mean
+     relative revenue per (policy, alpha) must match the two-party
+     NakamotoSSZ env at gamma=0 within TOLERANCE (tier-1 proves 0.05
+     at larger samples; the smoke's smaller samples get 0.06);
+  4  a supervised `python -m cpr_tpu.serve.server` answers
+     `netsim.attack_sweep` twice: the first sweep banks v11
+     `attack_sweep` events, the repeat must come back `cached` with
+     identical rows (the topology-fingerprint sweep cache), then the
+     server drains clean on SIGTERM;
+  5  every trace passes `trace_summary --validate --expect
+     attack_sweep` (serve trace: `--expect serve,attack_sweep`), and
+     the two same-shaped sweep traces ingest into one perf ledger:
+     `attack_sweep_lanes_per_sec` rows must land at BOTH
+     cfg_devices=1 and cfg_devices=2 with cfg_protocol/cfg_topology
+     attached, and every banked row must clear the regression gate.
+     (The anchor and serve traces are validated but not banked: their
+     sweeps are correctness probes with different topology/lane
+     shapes, exactly what the ledger's shape fingerprints keep out of
+     each other's baselines.)
+
+Usage: python tools/attack_smoke.py [workdir]   (default /tmp/...)
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+ROOT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+sys.path.insert(0, ROOT)
+
+from cpr_tpu import supervisor  # noqa: E402
+from cpr_tpu.perf.gate import gate_row, gate_summary  # noqa: E402
+from cpr_tpu.perf.ledger import Ledger  # noqa: E402
+from cpr_tpu.serve.protocol import ServeClient  # noqa: E402
+
+DEVICES = 2                 # the forced virtual CPU mesh span
+ALPHAS = (0.33, 0.45)
+POLICIES = ("honest", "eyal-sirer-2014")
+ACTIVATIONS = 600           # per sweep lane
+REPS = 2                    # lanes/point: 2x2x2 = 8, shards evenly
+TOLERANCE = 0.06            # degenerate anchor gap (tier-1: 0.05)
+READY_TIMEOUT_S = 300.0
+WALL_S = 900.0
+
+
+def _log(msg):
+    print(f"attack-smoke: {msg}", file=sys.stderr)
+
+
+def _child_env(workdir, trace, extra=None, devices=1):
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS=f"--xla_force_host_platform_device_count="
+                         f"{devices}",
+               CPR_TELEMETRY=trace,
+               CPR_TPU_CACHE=os.path.join(workdir, "cache"))
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env.update(extra or {})
+    return env
+
+
+def _validate_stream(trace, expect):
+    tool = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "trace_summary.py")
+    r = subprocess.run(
+        [sys.executable, tool, trace, "--validate", "--expect", expect],
+        capture_output=True, text=True)
+    if r.returncode != 0:
+        sys.stderr.write(r.stdout + r.stderr)
+        raise SystemExit(f"telemetry validation failed for {trace}")
+
+
+# one sweep child per device count: the clique-4 attack grid with rows
+# dumped as JSON for the parent's cross-device bit-identity check
+_SWEEP_CHILD = textwrap.dedent("""\
+    import json, os
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from cpr_tpu import telemetry
+    from cpr_tpu.netsim.attack import attack_sweep
+    from cpr_tpu.network import symmetric_clique
+
+    devices = int(os.environ["CPR_SMOKE_DEVICES"])
+    alphas = tuple(float(a) for a in
+                   os.environ["CPR_SMOKE_ALPHAS"].split(","))
+    policies = tuple(os.environ["CPR_SMOKE_POLICIES"].split(","))
+    activations = int(os.environ["CPR_SMOKE_ACTIVATIONS"])
+    reps = int(os.environ["CPR_SMOKE_REPS"])
+
+    mesh = None
+    if devices > 1:
+        from cpr_tpu.parallel import default_mesh
+        devs = jax.devices()
+        assert len(devs) >= devices, (len(devs), devices)
+        mesh = default_mesh(devices=devs[:devices])
+
+    tele = telemetry.current()
+    tele.manifest(dict(role="attack-smoke-sweep", devices=devices,
+                       activations=activations, reps=reps))
+
+    net = symmetric_clique(4, activation_delay=30.0,
+                           propagation_delay=1.0)
+    rows = attack_sweep([("clique-4", net)],
+                        protocols=(("nakamoto", {}), ("tailstorm", {})),
+                        policies=policies, alphas=alphas,
+                        activation_delays=(60.0,),
+                        activations=activations, reps=reps, seed=11,
+                        mesh=mesh)
+    # the unsupported protocol degrades to exactly one reason-tagged
+    # error row; the nakamoto half must be clean
+    bad = [r for r in rows if "error" in r]
+    assert len(bad) == 1 and bad[0]["protocol"] == "tailstorm", bad
+    assert bad[0]["reason"] == "unsupported-protocol", bad
+    rows = [r for r in rows if "error" not in r]
+    need = {"protocol", "attack", "alpha", "gamma", "relative_reward",
+            "reward_attacker", "reward_defender", "topology",
+            "n_nodes", "engine"}
+    for r in rows:
+        assert need <= set(r), sorted(need - set(r))
+        assert r["gamma"] == -1.0, r   # emerges from message racing
+    print(f"sweep: {len(rows)} clean rows at {devices} device(s)")
+
+    # timing differs per run; the physics must not
+    for r in rows:
+        r.pop("machine_duration_s", None)
+    with open(os.environ["CPR_SMOKE_OUT"], "w") as f:
+        json.dump(rows, f, sort_keys=True)
+    print("attack sweep child ok:", devices, "device(s)")
+""")
+
+
+# the degenerate anchor: zero-delay two-node clique == two-party
+# NakamotoSSZ env at gamma=0, within tolerance per (policy, alpha)
+_ANCHOR_CHILD = textwrap.dedent("""\
+    import os
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from cpr_tpu import telemetry
+    from cpr_tpu.experiments.withholding import withholding_rows
+    from cpr_tpu.netsim.attack import attack_sweep
+    from cpr_tpu.network import two_agents
+
+    alphas = tuple(float(a) for a in
+                   os.environ["CPR_SMOKE_ALPHAS"].split(","))
+    tol = float(os.environ["CPR_SMOKE_TOL"])
+
+    telemetry.current().manifest(dict(role="attack-smoke-anchor"))
+
+    pols = ("honest", "sapirshtein-2016-sm1")
+    env_rows = withholding_rows("nakamoto", policies=list(pols),
+                                alphas=alphas, gammas=(0.0,),
+                                episode_len=384, reps=48, seed=7)
+    env_rel = {(r["attack"].removeprefix("nakamoto-"), r["alpha"]):
+               r["relative_reward"] for r in env_rows}
+    net_rows = attack_sweep(
+        [("two-agents", two_agents(alpha=0.33,
+                                   activation_delay=60.0))],
+        policies=pols, alphas=alphas, activation_delays=(60.0,),
+        activations=1200, reps=4, seed=7)
+    assert not [r for r in net_rows if "error" in r], net_rows
+    worst = 0.0
+    for r in net_rows:
+        p = r["attack"].removeprefix("nakamoto-")
+        gap = abs(r["relative_reward"] - env_rel[(p, r["alpha"])])
+        worst = max(worst, gap)
+        assert gap < tol, (p, r["alpha"], r["relative_reward"],
+                           env_rel[(p, r["alpha"])], tol)
+    print(f"degenerate anchor: netsim attacker matches the two-party "
+          f"env, worst gap {worst:.4f} < {tol}")
+""")
+
+
+def _sweep_run(work, devices):
+    trace = os.path.join(work, f"sweep_d{devices}.jsonl")
+    out_path = os.path.join(work, f"sweep_d{devices}.json")
+    for p in (trace, out_path):
+        if os.path.exists(p):
+            os.remove(p)
+    env = _child_env(work, trace, devices=devices, extra={
+        "CPR_SMOKE_DEVICES": str(devices),
+        "CPR_SMOKE_ALPHAS": ",".join(str(a) for a in ALPHAS),
+        "CPR_SMOKE_POLICIES": ",".join(POLICIES),
+        "CPR_SMOKE_ACTIVATIONS": str(ACTIVATIONS),
+        "CPR_SMOKE_REPS": str(REPS),
+        "CPR_SMOKE_OUT": out_path,
+    })
+    r = subprocess.run([sys.executable, "-c", _SWEEP_CHILD], env=env,
+                       cwd=ROOT, capture_output=True, text=True,
+                       timeout=WALL_S)
+    sys.stderr.write(r.stdout)
+    if r.returncode != 0:
+        sys.stderr.write(r.stderr)
+        raise SystemExit(f"sweep child (devices={devices}) failed "
+                         f"rc={r.returncode}")
+    _validate_stream(trace, "attack_sweep")
+    with open(out_path) as f:
+        rows = json.load(f)
+    _log(f"sweep child devices={devices}: {len(rows)} rows")
+    return rows, trace
+
+
+def _anchor_run(work):
+    trace = os.path.join(work, "anchor.jsonl")
+    if os.path.exists(trace):
+        os.remove(trace)
+    env = _child_env(work, trace, extra={
+        "CPR_SMOKE_ALPHAS": ",".join(str(a) for a in ALPHAS),
+        "CPR_SMOKE_TOL": str(TOLERANCE),
+    })
+    r = subprocess.run([sys.executable, "-c", _ANCHOR_CHILD], env=env,
+                       cwd=ROOT, capture_output=True, text=True,
+                       timeout=WALL_S)
+    sys.stderr.write(r.stdout)
+    if r.returncode != 0:
+        sys.stderr.write(r.stderr)
+        raise SystemExit(f"anchor child failed rc={r.returncode}")
+    _validate_stream(trace, "attack_sweep")
+    _log("degenerate two-party anchor held")
+
+
+def _wait_ready(path, proc):
+    deadline = time.time() + READY_TIMEOUT_S
+    while time.time() < deadline:
+        if proc.poll() is not None:
+            raise SystemExit(f"server child exited rc={proc.returncode} "
+                             f"before becoming ready")
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            time.sleep(0.25)
+    raise SystemExit(f"server not ready within {READY_TIMEOUT_S:.0f}s")
+
+
+def _serve_run(work):
+    """Supervised serve child answering netsim.attack_sweep: the repeat
+    query must hit the topology-fingerprint sweep cache."""
+    trace = os.path.join(work, "serve_attack.jsonl")
+    if os.path.exists(trace):
+        os.remove(trace)
+    cmd = [sys.executable, "-m", "cpr_tpu.serve.server",
+           "--protocol", "nakamoto", "--max-steps", "64",
+           "--lanes", "2", "--burst", "32", "--devices", "1",
+           "--heartbeat-s", "0.5",
+           "--ready-file", os.path.join(work, "ready_attack.json")]
+    started = threading.Event()
+    box = {}
+
+    def on_start(proc):
+        box["proc"] = proc
+        started.set()
+
+    def supervise():
+        box["attempt"] = supervisor.run_child(
+            cmd, wall_timeout_s=WALL_S, quiet_s=60.0, heartbeat_s=1.0,
+            env=_child_env(work, trace), cwd=ROOT, on_start=on_start)
+
+    child = threading.Thread(target=supervise)
+    child.start()
+    try:
+        if not started.wait(30.0):
+            raise SystemExit("run_child never spawned the server")
+        ready = _wait_ready(os.path.join(work, "ready_attack.json"),
+                            box["proc"])
+        port = ready["port"]
+        _log(f"serve child ready on port {port}")
+        query = dict(topology={"kind": "two-agents"},
+                     policies=list(POLICIES), alphas=list(ALPHAS),
+                     activations=400, reps=2, seed=3)
+        with ServeClient("127.0.0.1", port) as c:
+            r1 = c.request("netsim.attack_sweep", **query)
+            assert r1.get("ok"), f"netsim.attack_sweep: {r1}"
+            assert r1["cached"] is False, r1
+            assert not [r for r in r1["rows"] if "error" in r], r1
+            r2 = c.request("netsim.attack_sweep", **query)
+            assert r2.get("ok") and r2["cached"] is True, r2
+        if r1["rows"] != r2["rows"]:
+            raise SystemExit("cached netsim.attack_sweep replay changed "
+                             "the row table")
+        if r1["topo_fingerprint"] != r2["topo_fingerprint"]:
+            raise SystemExit("sweep-cache topology fingerprint drifted "
+                             "between identical queries")
+        box["proc"].send_signal(signal.SIGTERM)
+    except BaseException:
+        proc = box.get("proc")
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+        raise
+    child.join(120.0)
+    if child.is_alive():
+        raise SystemExit("server child did not drain within 120s")
+    attempt = box["attempt"]
+    if attempt.status != "ok" or attempt.rc != 0:
+        raise SystemExit(f"serve child did not exit cleanly "
+                         f"(status={attempt.status} rc={attempt.rc})")
+    _validate_stream(trace, "serve,attack_sweep")
+    _log(f"serve netsim.attack_sweep: swept then cache-hit, "
+         f"{len(r1['rows'])} rows, drained clean")
+    return trace
+
+
+def _bank_and_gate(work, traces):
+    """The same-shaped sweep traces into one ledger;
+    attack_sweep_lanes_per_sec must land at both device counts with
+    its protocol/topology config attached, and every banked row must
+    clear the gate."""
+    ledger = Ledger(os.path.join(work, "perf_ledger.jsonl"))
+    n = sum(ledger.ingest_trace(t) for t in traces)
+    records = ledger.records()
+    lps = [r for r in records
+           if r.get("metric") == "attack_sweep_lanes_per_sec"]
+    if not lps:
+        raise SystemExit("no attack_sweep_lanes_per_sec rows banked")
+    got = {r.get("config", {}).get("cfg_devices") for r in lps}
+    if not {1, DEVICES} <= got:
+        raise SystemExit(f"attack_sweep_lanes_per_sec banked at device "
+                         f"counts {sorted(got)}, need both 1 and "
+                         f"{DEVICES}")
+    for r in lps:
+        cfg = r.get("config", {})
+        if not cfg.get("cfg_protocol") or not cfg.get("cfg_topology"):
+            raise SystemExit(f"attack_sweep row missing "
+                             f"cfg_protocol/cfg_topology: {r}")
+    results = [gate_row(r, records) for r in records]
+    summary = gate_summary(results)
+    if not summary["ok"]:
+        bad = [res for res in results if res["verdict"] == "fail"]
+        raise SystemExit(f"attack perf gate failed: {bad}")
+    return n, summary
+
+
+def main():
+    work = sys.argv[1] if len(sys.argv) > 1 else "/tmp/cpr-attack-smoke"
+    os.makedirs(work, exist_ok=True)
+
+    rows_1, trace_1 = _sweep_run(work, 1)
+    rows_n, trace_n = _sweep_run(work, DEVICES)
+    if rows_1 != rows_n:
+        raise SystemExit(f"attack sweep rows NOT bit-identical between "
+                         f"1-device and {DEVICES}-device runs")
+    _log(f"sweep rows bit-identical at 1 vs {DEVICES} devices "
+         f"({len(rows_1)} rows)")
+
+    _anchor_run(work)
+    _serve_run(work)
+
+    n, summary = _bank_and_gate(work, [trace_1, trace_n])
+    print(f"attack-smoke: PASS (clique-4 attack sweep bit-identical at "
+          f"1 vs {DEVICES} devices over {len(rows_1)} rows; degenerate "
+          f"two-party anchor within {TOLERANCE}; serve "
+          f"netsim.attack_sweep cache-hit round-trip with clean "
+          f"SIGTERM drain; banked {n} ledger rows incl. "
+          f"attack_sweep_lanes_per_sec at devices 1 and {DEVICES}; "
+          f"gate {summary})")
+
+
+if __name__ == "__main__":
+    main()

@@ -123,6 +123,7 @@ def summarize(events, out=sys.stdout):
     _route_lines(events, out)
     _request_lines(events, out)
     _mdp_solve_lines(events, out)
+    _attack_sweep_lines(events, out)
     _perf_gate_lines(events, out)
     for m in (e for e in events if e.get("kind") == "manifest"):
         cfg = m.get("config") or {}
@@ -132,7 +133,8 @@ def summarize(events, out=sys.stdout):
               f"config={json.dumps(cfg, sort_keys=True)}", file=out)
     tabled = ("compile", "device_metrics", "vi_residuals", "retry",
               "checkpoint", "perf_gate", "supervisor", "serve",
-              "request", "admission", "route", "mdp_solve")
+              "request", "admission", "route", "mdp_solve",
+              "attack_sweep")
     for e in (e for e in events if e.get("kind") == "event"
               and e.get("name") not in tabled):
         keys = {k: v for k, v in e.items() if k not in ("kind", "ts")}
@@ -355,6 +357,30 @@ def _mdp_solve_lines(events, out):
         print(f"{label:<18} {grid_txt:<8} {e.get('n_states'):>9} "
               f"{e.get('n_transitions'):>10} {e.get('sweeps'):>7} "
               f"{e.get('converged'):>6} {sol_txt:>9} {pps_txt:>9}",
+              file=out)
+
+
+def _attack_sweep_lines(events, out):
+    """Schema-v11 adversary-in-the-network sweeps
+    (cpr_tpu/netsim/attack): one line per vmapped batch — protocol,
+    topology, lane/policy counts, overflow drops (healthy: 0), and
+    the lanes/sec rate the perf ledger banks."""
+    evs = [e for e in events if e.get("kind") == "event"
+           and e.get("name") == "attack_sweep"]
+    if not evs:
+        return
+    print(f"\n{'attack_sweep':<12} {'topology':<16} {'lanes':>6} "
+          f"{'policies':>8} {'devs':>5} {'drops':>6} {'sweep_s':>9} "
+          f"{'lanes/sec':>10}", file=out)
+    for e in evs:
+        sw = e.get("sweep_s")
+        sw_txt = f"{sw:.3f}" if isinstance(sw, (int, float)) else "-"
+        lps = e.get("lanes_per_sec")
+        lps_txt = f"{lps:.2f}" if isinstance(lps, (int, float)) else "-"
+        print(f"{str(e.get('protocol')):<12} "
+              f"{str(e.get('topology')):<16} {e.get('lanes'):>6} "
+              f"{e.get('policies'):>8} {e.get('n_devices', '-'):>5} "
+              f"{e.get('drops'):>6} {sw_txt:>9} {lps_txt:>10}",
               file=out)
 
 
