@@ -4,7 +4,7 @@
 
 CPU_MESH = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: lint test test-slow bench perf-gate telemetry-smoke netsim-smoke resilience-smoke supervisor-smoke serve-smoke fleet-smoke multichip-smoke mdp-smoke attack-smoke dryrun sweeps ghostdag train-dummy native asan
+.PHONY: lint test test-slow bench perf-gate telemetry-smoke netsim-smoke resilience-smoke supervisor-smoke serve-smoke fleet-smoke multichip-smoke mdp-smoke compile-smoke attack-smoke dryrun sweeps ghostdag train-dummy native asan
 
 lint:  ## jaxlint over cpr_tpu/ + tools/ (pure AST, no JAX import,
 	## ~1s); banks the JSON report under runs/ like the smoke flows
@@ -135,6 +135,20 @@ mdp-smoke:  ## grid-batched MDP proof: parametric compile of fc16 +
 	## counts.  Details: docs/MDP.md
 	rm -rf $(MDP_SMOKE_DIR)
 	python tools/mdp_smoke.py $(MDP_SMOKE_DIR)
+
+COMPILE_SMOKE_DIR = /tmp/cpr-compile-smoke
+
+compile-smoke:  ## frontier-batched MDP compile proof: serial Compiler
+	## vs frontier inline vs FORCED multi-worker expansion on the
+	## generic bitcoin model, all three byte-identical, best frontier
+	## states/sec over a core-adaptive floor (>= 2x on multi-core, >=
+	## 4x target on >= 4 cores; parity on the 1-core CI), a
+	## kill@compile_round=3 + resume leg byte-identical through the
+	## real fault grammar, v12 `mdp_compile` trace validation, and
+	## mdp_compile_states_per_sec rows banked + gated at workers 1 and
+	## N.  Details: docs/MDP.md
+	rm -rf $(COMPILE_SMOKE_DIR)
+	python tools/compile_smoke.py $(COMPILE_SMOKE_DIR)
 
 ATTACK_SMOKE_DIR = /tmp/cpr-attack-smoke
 

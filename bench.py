@@ -402,6 +402,33 @@ def measure_mdp_grid(n_envs: int, mfl: int = 12, horizon: int = 100,
     return points / solve_s, check, extras
 
 
+def measure_mdp_compile(n_envs: int):
+    """Frontier-batched MDP compilation (cpr_tpu/mdp/frontier.py):
+    one compile of the generic bitcoin model at dag_size_cutoff
+    `n_envs` through whole-frontier rounds — columnar successor
+    collect, vectorized per-round validation, and (when
+    CPR_MDP_COMPILE_WORKERS > 1) multi-core frontier expansion.  Rate
+    counts discovered states/sec; the check is the transitions-per-
+    state ratio of the compiled MDP, which is exact per cutoff (any
+    drift means the compile emitted a different state graph)."""
+    from cpr_tpu.mdp.frontier import FrontierCompiler, resolve_workers
+    from cpr_tpu.mdp.generic import SingleAgent, get_protocol
+    from cpr_tpu.telemetry import now
+
+    model = SingleAgent(get_protocol("bitcoin"), alpha=0.3, gamma=0.5,
+                        collect_garbage="simple", merge_isomorphic=True,
+                        truncate_common_chain=True,
+                        dag_size_cutoff=n_envs)
+    fc = FrontierCompiler(model, protocol="bitcoin", cutoff=n_envs)
+    t0 = now()
+    m = fc.mdp()
+    dt = now() - t0
+    extras = dict(protocol="bitcoin", cutoff=n_envs,
+                  states=m.n_states, transitions=m.n_transitions,
+                  n_workers=resolve_workers(), compile_s=round(dt, 4))
+    return m.n_states / dt, m.n_transitions / m.n_states, extras
+
+
 def measure_attack_sweep(n_envs: int, n_activations: int = 1500,
                          reps: int = 3):
     """Adversary-in-the-network sweep (cpr_tpu/netsim/attack.py):
@@ -720,6 +747,17 @@ CONFIGS = {
         cpu=dict(n_envs=16), guard=(0.70, 0.80),
         guard_name="fc16 optimal revenue @ (0.45, 0.75)",
         metric="mdp_grid_points_per_sec", unit="grid-points/sec"),
+    # frontier-batched MDP compilation (cpr_tpu/mdp/frontier.py):
+    # n_envs is the generic bitcoin dag_size_cutoff (6 -> 5730
+    # states); the rate counts discovered states/sec, host-side work
+    # on every backend.  Guard: transitions-per-state of the compiled
+    # MDP — exactly 22710/5730 = 3.9634 at cutoff 6, so the band is a
+    # graph-shape checksum, not a tolerance
+    "mdp_compile": dict(
+        fn="measure_mdp_compile", tpu=dict(n_envs=6),
+        cpu=dict(n_envs=6), guard=(3.95, 3.98),
+        guard_name="bitcoin@6 transitions per state",
+        metric="mdp_compile_states_per_sec", unit="states/sec"),
     # adversary-in-the-network lanes (cpr_tpu/netsim/attack.py): n_envs
     # lanes over an alpha x policy grid on the 4-node clique; the rate
     # counts lanes/sec.  Guard: honest attacker relative revenue at

@@ -102,27 +102,37 @@ def break_even(protocol_key: str, policy: str, *, gamma: float,
 def exact_revenue_curve(protocol: str, *, gamma: float, cutoff: int,
                         alphas, horizon: int = 100,
                         stop_delta: float = 1e-6, native: bool = False,
-                        k: int = 2, mesh=None) -> list[float]:
+                        k: int = 2, mesh=None, full: bool = False):
     """OPTIMAL-attack revenue over `alphas` at fixed gamma from one
     cached grid solve of the exact MDP (cpr_tpu.mdp.solve_grid_cached:
     one parametric compile, one vmapped grid VI, disk-cached by content
     fingerprint).  Where the Monte-Carlo `revenue` scores a FIXED
     policy with sampling noise, this is the value-iteration optimum —
-    an upper bound over policies with no estimator variance."""
+    an upper bound over policies with no estimator variance.
+
+    `full=True` returns the solve-cache provenance alongside the
+    curve (the serve break_even endpoints surface it): a dict with
+    `revenue`, `alphas`, `cached` (fingerprint-keyed disk-cache hit)
+    and the ParamMDP content `fingerprint`."""
     from cpr_tpu.mdp.grid import solve_grid_cached
 
     out = solve_grid_cached(protocol, cutoff=cutoff, alphas=alphas,
                             gammas=(gamma,), horizon=horizon,
                             stop_delta=stop_delta, native=native, k=k,
                             mesh=mesh)
-    return [float(r) for r in out["revenue"]]
+    rev = [float(r) for r in out["revenue"]]
+    if full:
+        return dict(revenue=rev, alphas=[float(a) for a in out["alphas"]],
+                    cached=bool(out["cached"]),
+                    fingerprint=out["fingerprint"])
+    return rev
 
 
 def break_even_exact(protocol: str, *, gamma: float, cutoff: int,
                      support=(0.1, 0.5), grid: int = 17,
                      horizon: int = 100, stop_delta: float = 1e-6,
                      native: bool = False, k: int = 2,
-                     mesh=None) -> float:
+                     mesh=None, full: bool = False):
     """Exact-MDP break-even alpha: the root of excess(alpha) =
     revenue(alpha)/alpha - 1 for the OPTIMAL attack, from one cached
     grid solve over `grid` evenly-spaced alphas in `support` (the
@@ -131,23 +141,32 @@ def break_even_exact(protocol: str, *, gamma: float, cutoff: int,
     root is located by sign change and refined by linear interpolation
     between the bracketing grid points; clipped to the support bounds
     when the attack is never/always profitable there (same convention
-    as `break_even`)."""
+    as `break_even`).  `full=True` wraps the root with the solve-cache
+    provenance (`cached`, `fingerprint`) like exact_revenue_curve."""
     lo, hi = support
     alphas = list(np.linspace(lo, hi, grid))
-    rev = exact_revenue_curve(protocol, gamma=gamma, cutoff=cutoff,
+    out = exact_revenue_curve(protocol, gamma=gamma, cutoff=cutoff,
                               alphas=alphas, horizon=horizon,
                               stop_delta=stop_delta, native=native,
-                              k=k, mesh=mesh)
+                              k=k, mesh=mesh, full=True)
+    rev = out["revenue"]
     excess = [r / a - 1.0 for r, a in zip(rev, alphas)]
+
+    def wrap(alpha):
+        if full:
+            return dict(alpha=float(alpha), cached=out["cached"],
+                        fingerprint=out["fingerprint"])
+        return float(alpha)
+
     if excess[0] > 0:
-        return lo
+        return wrap(lo)
     if excess[-1] < 0:
-        return hi
+        return wrap(hi)
     for i in range(1, len(alphas)):
         if excess[i] > 0:
             a0, a1 = alphas[i - 1], alphas[i]
             e0, e1 = excess[i - 1], excess[i]
             if e1 == e0:
-                return 0.5 * (a0 + a1)
-            return a0 + (a1 - a0) * (0.0 - e0) / (e1 - e0)
-    return hi
+                return wrap(0.5 * (a0 + a1))
+            return wrap(a0 + (a1 - a0) * (0.0 - e0) / (e1 - e0))
+    return wrap(hi)

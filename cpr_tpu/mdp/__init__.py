@@ -13,7 +13,13 @@ sparse solves) stay on CPU like the reference.
 
 from cpr_tpu.mdp.implicit import Effect, Model, PTOWrapper, Transition  # noqa: F401
 from cpr_tpu.mdp.compiler import Compiler  # noqa: F401
-from cpr_tpu.mdp.explicit import MDP, TensorMDP, ptmdp  # noqa: F401
+from cpr_tpu.mdp.explicit import (  # noqa: F401
+    MDP,
+    PaddedLayoutTooLarge,
+    TensorMDP,
+    ptmdp,
+)
+from cpr_tpu.mdp.frontier import FrontierCompiler  # noqa: F401
 from cpr_tpu.mdp.explorer import Explorer  # noqa: F401
 from cpr_tpu.mdp.grid import (  # noqa: F401
     Param,

@@ -35,6 +35,19 @@ def sum_to_one(xs) -> bool:
     return math.isclose(sum(xs), 1.0, rel_tol=1e-9)
 
 
+# ceiling (bytes) on the dense [S*A, K] padded tables padded_layout()
+# materializes for the device RTDP path; ~2 GiB by default
+PAD_BYTES_ENV_VAR = "CPR_MDP_PAD_BYTES"
+_PAD_BYTES_DEFAULT = 2 << 30
+
+
+class PaddedLayoutTooLarge(MemoryError):
+    """padded_layout() refused to materialize its dense [S*A, K]
+    tables: the actual byte size exceeds the CPR_MDP_PAD_BYTES
+    ceiling.  Large compiles should solve through the COO segment-sum
+    sweep (value_iteration impl="chunked"/"while"), which never pads."""
+
+
 @dataclass
 class MDP:
     """Host-side MDP builder with flat transition storage.
@@ -54,9 +67,14 @@ class MDP:
     reward: list[float] = field(default_factory=list)
     progress: list[float] = field(default_factory=list)
 
+    # column dtypes of the materialized COO layout, in field order
+    _COL_DTYPES = (np.int32, np.int32, np.int32,
+                   np.float64, np.float64, np.float64)
+
     @property
     def n_transitions(self) -> int:
-        return len(self.src)
+        return len(self.src) + sum(len(c[0]) for c in
+                                   getattr(self, "_chunks", ()) or ())
 
     def __repr__(self):
         s, a, t = self.n_states, self.n_actions, self.n_transitions
@@ -65,6 +83,14 @@ class MDP:
 
     def add_transition(self, src: int, act: int, dst: int, *, probability: float,
                        reward: float, progress: float):
+        if getattr(self, "_chunks", None):
+            # bulk chunks already appended: route through the columnar
+            # path so transition order (and therefore state-id
+            # assignment downstream) stays the call order under mixed
+            # add_transition/add_transitions use
+            self.add_transitions([src], [act], [dst], [probability],
+                                 [reward], [progress])
+            return
         assert src >= 0 and dst >= 0 and act >= 0
         self._arrays_cache = None  # invalidate materialized columns
         self.n_states = max(self.n_states, src + 1, dst + 1)
@@ -76,23 +102,77 @@ class MDP:
         self.reward.append(reward)
         self.progress.append(progress)
 
+    def add_transitions(self, src, act, dst, prob, reward, progress):
+        """Bulk columnar append: one numpy chunk per call, no
+        per-transition Python work.  Chunks stack up in a growable
+        side list and are concatenated lazily by arrays() (or folded
+        into the public columns by consolidate()), so a frontier-
+        batched compile appends each BFS round in O(1) list pushes
+        instead of six list.append calls per transition.  Probability
+        columns must already be numeric — the monomial tracer's Param
+        objects travel as separate coef/expo columns on the bulk path
+        (cpr_tpu/mdp/frontier.py), never inside `prob`."""
+        cols = tuple(np.asarray(c, dt) for c, dt in
+                     zip((src, act, dst, prob, reward, progress),
+                         self._COL_DTYPES))
+        n = len(cols[0])
+        if any(c.ndim != 1 or len(c) != n for c in cols):
+            raise ValueError(
+                "add_transitions wants six equal-length 1-d columns, "
+                f"got lengths {[c.shape for c in cols]}")
+        if n == 0:
+            return
+        if min(int(cols[0].min()), int(cols[1].min()),
+               int(cols[2].min())) < 0:
+            raise ValueError("negative state/action id in bulk append")
+        self._arrays_cache = None
+        self.n_states = max(self.n_states, int(cols[0].max()) + 1,
+                            int(cols[2].max()) + 1)
+        self.n_actions = max(self.n_actions, int(cols[1].max()) + 1)
+        chunks = getattr(self, "_chunks", None)
+        if chunks is None:
+            chunks = self._chunks = []
+        chunks.append(cols)
+
+    def consolidate(self):
+        """Fold any pending bulk chunks into the public column fields
+        (as numpy arrays), so code that reads `mdp.src` etc. directly
+        sees the full transition set.  Returns self.  After this the
+        MDP behaves like a ptmdp()-built one: columns are arrays, and
+        further single add_transition calls are not supported."""
+        arrs = self.arrays()
+        (self.src, self.act, self.dst,
+         self.prob, self.reward, self.progress) = arrs
+        self._chunks = []
+        self._arrays_cache = arrs
+        return self
+
     def arrays(self):
         """Materialized COO columns, cached: check()/tensor()/ptmdp and
         the parametric grid pipeline all call this, and rebuilding six
         numpy arrays from Python lists per call dominates for
-        multi-million-transition native compiles.  add_transition
-        invalidates; callers must treat the tuple as read-only."""
+        multi-million-transition native compiles.  add_transition /
+        add_transitions invalidate; callers must treat the tuple as
+        read-only.  Fast path is zero-copy: when a column is already a
+        numpy array of the right dtype (consolidated bulk compiles,
+        ptmdp outputs), np.asarray returns it as-is."""
         cached = getattr(self, "_arrays_cache", None)
         if cached is not None:
             return cached
-        out = (
-            np.asarray(self.src, np.int32),
-            np.asarray(self.act, np.int32),
-            np.asarray(self.dst, np.int32),
-            np.asarray(self.prob, np.float64),
-            np.asarray(self.reward, np.float64),
-            np.asarray(self.progress, np.float64),
-        )
+        base = (self.src, self.act, self.dst,
+                self.prob, self.reward, self.progress)
+        chunks = getattr(self, "_chunks", None) or []
+        cols = []
+        for i, dt in enumerate(self._COL_DTYPES):
+            parts = ([np.asarray(base[i], dt)] if len(base[0]) else [])
+            parts += [c[i] for c in chunks]
+            if not parts:
+                cols.append(np.zeros(0, dt))
+            elif len(parts) == 1:
+                cols.append(parts[0])
+            else:
+                cols.append(np.concatenate(parts))
+        out = tuple(cols)
         self._arrays_cache = out
         return out
 
@@ -905,6 +985,18 @@ class TensorMDP:
         key_s = key[order]
         pos = np.arange(len(key_s)) - np.searchsorted(key_s, key_s)
         K = int(pos.max()) + 1 if len(key_s) else 1
+        need = S * A * K * (np.dtype(np.int32).itemsize
+                            + 3 * dtype.itemsize)
+        ceiling = int(os.environ.get(PAD_BYTES_ENV_VAR,
+                                     _PAD_BYTES_DEFAULT))
+        if need > ceiling:
+            raise PaddedLayoutTooLarge(
+                f"padded [S*A, K] layout needs {need:,} bytes "
+                f"(S={S}, A={A}, K={K}, dtype={dtype}), over the "
+                f"{PAD_BYTES_ENV_VAR} ceiling of {ceiling:,}; solve "
+                f"large compiles through the COO sweep "
+                f"(value_iteration impl='chunked') instead of the "
+                f"padded rtdp() path, or raise the ceiling explicitly")
         Tdst = np.zeros((S * A, K), np.int32)
         Tpack = np.zeros((S * A, K, 3), dtype)
         Tdst[key_s, pos] = np.asarray(self.dst, np.int32)[order]

@@ -1,0 +1,455 @@
+"""Frontier-batched implicit -> explicit MDP compiler.
+
+The serial `Compiler` (cpr_tpu/mdp/compiler.py) explores one state per
+step: a dict hash per successor, six list.append calls per transition,
+and a Python `sum_to_one` per (state, action).  At the state counts the
+exact-analysis papers reach (arXiv:2007.05614, arXiv:2309.11924 grow
+into the millions as cutoffs rise) that loop dominates end-to-end
+wall-clock — the grid SOLVE has been one vmapped program since the
+grid-batched VI landed.
+
+`FrontierCompiler` replaces the per-state loop with whole-frontier
+rounds:
+
+* **Round semantics.**  A round expands every state of the current
+  frontier (all states discovered in the previous round — their ids
+  are one contiguous range, because ids are assigned in discovery
+  order), collects the successors columnar, and appends one numpy
+  chunk per round through the bulk `MDP.add_transitions` — no
+  per-transition Python appends.
+
+* **Id determinism contract.**  New states get ids in (source id,
+  action slot, transition order) within the round.  FIFO BFS order is
+  exactly that order, so the result is bit-identical to the serial
+  `Compiler`: same state ids, same transition columns, same start map,
+  same action_map.  Per-round dedup runs vectorized — np.unique over
+  pickled state keys — with unique representatives mapped back to
+  first-occurrence order before id assignment; the global state table
+  still dedups by the state objects' own hash/eq, so a model whose
+  equal states pickle differently loses only batching, never
+  correctness.
+
+* **Multi-core expansion.**  Because the merge order is deterministic,
+  each frontier can be sharded across worker processes
+  (concurrent.futures; the model is pickled once into each worker's
+  initializer) and the shard payloads concatenated in shard order —
+  bit-identical to inline expansion at any worker count.  The spawn
+  context is used by default (fork-after-JAX-init is not worth the
+  deadlock risk; override with CPR_MDP_COMPILE_MP_CONTEXT).
+
+* **Validation.**  Per-round vectorized probability-mass check
+  (group-boundary reduceat over the round's columns) replaces the
+  serial per-state `sum_to_one` Python sum, with the same tolerance
+  and the same AssertionError((state, action)) on violation.
+
+* **Checkpoint / resume.**  Between rounds the partial columns, the
+  frontier position, and the state-key table land in one atomic npz
+  (resilience.save_compile_checkpoint); the `compile_round` fault site
+  is occurrence-counted, so `kill@compile_round=N` + resume is proven
+  bit-identical to an uninterrupted compile (tier-1 +
+  tools/compile_smoke.py).
+
+* **Telemetry.**  One schema-v12 `mdp_compile` event per compile
+  (protocol/cutoff/rounds/states/transitions/n_workers, plus
+  compile_s / states_per_sec extras the perf ledger lifts into
+  `mdp_compile_states_per_sec` rows).
+
+The parametric monomial tracer rides the same path: probe values are a
+per-transition float column and the (coef, expo) columns travel
+through the columnar collect, so `grid.parametric_compile` /
+`grid.compile_protocol` (and everything above them: the grid VI
+pipeline, solve_grid_cached, the ghostdag capstone) inherit the
+batched compile.  See docs/MDP.md.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+
+import numpy as np
+
+from cpr_tpu import resilience, telemetry
+from cpr_tpu.telemetry import now
+
+WORKERS_ENV_VAR = "CPR_MDP_COMPILE_WORKERS"
+MP_CONTEXT_ENV_VAR = "CPR_MDP_COMPILE_MP_CONTEXT"
+_PICKLE_PROTO = 5
+_COL_NAMES = ("src", "act", "dst", "prob", "reward", "progress")
+
+
+def resolve_workers(n: int | None = None) -> int:
+    """Worker-process count: explicit argument, else
+    CPR_MDP_COMPILE_WORKERS, else 1 (inline expansion)."""
+    if n is None:
+        n = int(os.environ.get(WORKERS_ENV_VAR, "1") or 1)
+    return max(1, int(n))
+
+
+def _expand_states(model, states, trace_params: bool,
+                   with_keys: bool = False) -> dict:
+    """Expand one frontier shard in order.  Returns a columnar payload:
+    per-state semantic actions, per-(state, action) transition counts,
+    and flat transition columns in (state order, action slot,
+    transition order) — plus each successor state object.
+    `with_keys` (worker shards only) additionally pickles a dedup key
+    per successor so the vectorized np.unique pre-dedup runs on
+    worker-encoded bytes; the inline path skips the encode and dedups
+    through the state dict directly, which is cheaper when no worker
+    parallelism pays for the pickling.  The merge is a plain
+    concatenation in shard order."""
+    actions_out: list = []
+    tcounts: list[int] = []
+    probs: list = []
+    rewards: list = []
+    progresses: list = []
+    succs: list = []
+    for state in states:
+        actions = list(model.actions(state))
+        actions_out.append(actions)
+        for action in actions:
+            ts = model.apply(action, state)
+            tcounts.append(len(ts))
+            probs.extend(t.probability for t in ts)
+            rewards.extend(t.reward for t in ts)
+            progresses.extend(t.progress for t in ts)
+            succs.extend(t.state for t in ts)
+    if trace_params:
+        from cpr_tpu.mdp.grid import _extract_param
+
+        ce = [_extract_param(p, "transition prob") for p in probs]
+        coef = np.asarray([c for c, _ in ce], np.float64)
+        expo = np.asarray([e for _, e in ce],
+                          np.int16).reshape(len(ce), 4)
+    else:
+        coef = expo = None
+    return dict(
+        actions=actions_out,
+        tcounts=np.asarray(tcounts, np.int64),
+        # works for plain numbers and Param tracers alike (__float__)
+        val=np.asarray(probs, np.float64),
+        coef=coef, expo=expo,
+        reward=np.asarray(rewards, np.float64),
+        progress=np.asarray(progresses, np.float64),
+        succs=succs,
+        keys=([pickle.dumps(s, _PICKLE_PROTO) for s in succs]
+              if with_keys else None),
+    )
+
+
+# worker-process state: the model is shipped ONCE through the pool
+# initializer (pickled bytes), not once per round/shard
+_WORKER: dict = {"model": None, "trace_params": False}
+
+
+def _worker_init(model_blob: bytes, trace_params: bool):
+    _WORKER["model"] = pickle.loads(model_blob)
+    _WORKER["trace_params"] = bool(trace_params)
+
+
+def _worker_expand(states):
+    return _expand_states(_WORKER["model"], states,
+                          _WORKER["trace_params"], with_keys=True)
+
+
+class FrontierCompiler:
+    """Drop-in batched twin of `Compiler`: same `mdp()` entry point,
+    same `state_map` / `states` / `action_map` surfaces, bit-identical
+    output.  Extra knobs: `n_workers` (frontier sharded across a
+    process pool), `checkpoint_path`/`checkpoint_every` (between-round
+    crash checkpoints + resume), `trace_params` (collect the monomial
+    tracer's coef/expo per-transition columns for `param_mdp()`), and
+    `protocol`/`cutoff` labels for the `mdp_compile` telemetry event."""
+
+    # frontiers smaller than n_workers * min_shard expand inline: IPC
+    # setup costs more than the round for the tiny early frontiers
+    min_shard = 16
+
+    def __init__(self, model, *, n_workers: int | None = None,
+                 checkpoint_path: str | None = None,
+                 checkpoint_every: int = 1,
+                 trace_params: bool = False,
+                 protocol: str | None = None,
+                 cutoff: int | None = None):
+        self.model = model
+        self.n_workers = resolve_workers(n_workers)
+        self.trace_params = bool(trace_params)
+        self.protocol = protocol
+        self.cutoff = cutoff
+        self._ck_path = checkpoint_path
+        self._ck_every = max(1, int(checkpoint_every))
+        self._model_blob = pickle.dumps(model, _PICKLE_PROTO)
+        self._model_fp = hashlib.sha256(self._model_blob).hexdigest()[:16]
+        self.state_map: dict = {}
+        self.states: list = []
+        self.action_map: list[list] = []
+        self._start: dict = {}
+        self._cols: list[tuple] = []    # per-round column chunks
+        self._pcols: list[tuple] = []   # per-round (coef, expo) chunks
+        self._explored_upto = 0
+        self._round = 0
+        self._elapsed = 0.0
+        self._resumed = False
+        self._result = None
+        self._pool = None
+        if checkpoint_path and os.path.exists(checkpoint_path):
+            self._resume(checkpoint_path)
+        else:
+            for state, probability in model.start():
+                sid = self._id_of(state)
+                self._start[sid] = probability
+
+    # -- state table ------------------------------------------------------
+
+    def _id_of(self, state) -> int:
+        sid = self.state_map.get(state)
+        if sid is None:
+            sid = len(self.state_map)
+            self.state_map[state] = sid
+            self.states.append(state)
+            self.action_map.append([])
+        return sid
+
+    @property
+    def n_states(self) -> int:
+        return len(self.state_map)
+
+    # -- expansion --------------------------------------------------------
+
+    def _expand(self, frontier: list) -> list[dict]:
+        if (self.n_workers <= 1
+                or len(frontier) < self.n_workers * self.min_shard):
+            return [_expand_states(self.model, frontier,
+                                   self.trace_params)]
+        if self._pool is None:
+            import multiprocessing
+            from concurrent.futures import ProcessPoolExecutor
+
+            ctx = multiprocessing.get_context(
+                os.environ.get(MP_CONTEXT_ENV_VAR, "spawn"))
+            self._pool = ProcessPoolExecutor(
+                max_workers=self.n_workers, mp_context=ctx,
+                initializer=_worker_init,
+                initargs=(self._model_blob, self.trace_params))
+        k = self.n_workers
+        n = len(frontier)
+        shards = [frontier[n * i // k: n * (i + 1) // k]
+                  for i in range(k)]
+        futs = [self._pool.submit(_worker_expand, s)
+                for s in shards if s]
+        # deterministic merge: results gathered in shard order
+        return [f.result() for f in futs]
+
+    def _absorb(self, lo: int, hi: int, payloads: list[dict]):
+        """Merge one round's shard payloads (in shard order), validate
+        probability mass, assign ids to the new states in first-sight
+        order, and append the round's columns as one bulk chunk."""
+        actions: list = []
+        for p in payloads:
+            actions.extend(p["actions"])
+        self.action_map[lo:hi] = actions
+        tcounts = np.concatenate([p["tcounts"] for p in payloads])
+        total = int(tcounts.sum())
+        na = np.asarray([len(a) for a in actions], np.int64)
+        # (state, action) of each per-round transition group
+        sid_of_group = np.repeat(np.arange(lo, hi, dtype=np.int64), na)
+        off = np.cumsum(na) - na
+        act_of_group = (np.arange(int(na.sum()), dtype=np.int64)
+                        - np.repeat(off, na))
+        if (tcounts == 0).any():
+            g = int(np.flatnonzero(tcounts == 0)[0])
+            state = self.states[int(sid_of_group[g])]
+            action = actions[int(sid_of_group[g]) - lo][
+                int(act_of_group[g])]
+            raise AssertionError((state, action))
+        if total == 0:
+            return
+        val = np.concatenate([p["val"] for p in payloads])
+        reward = np.concatenate([p["reward"] for p in payloads])
+        progress = np.concatenate([p["progress"] for p in payloads])
+        succs: list = []
+        for p in payloads:
+            succs.extend(p["succs"])
+        # vectorized per-round probability-mass validation: transitions
+        # are contiguous per (state, action), so group sums are one
+        # reduceat over the round's column (tolerance matches
+        # sum_to_one: rel 1e-9, no absolute slack)
+        starts = np.cumsum(tcounts) - tcounts
+        sums = np.add.reduceat(val, starts)
+        bad = ~np.isclose(sums, 1.0, rtol=1e-9, atol=0.0)
+        if bad.any():
+            g = int(np.flatnonzero(bad)[0])
+            state = self.states[int(sid_of_group[g])]
+            action = actions[int(sid_of_group[g]) - lo][
+                int(act_of_group[g])]
+            raise AssertionError((state, action))
+        src = np.repeat(sid_of_group, tcounts).astype(np.int32)
+        act = np.repeat(act_of_group, tcounts).astype(np.int32)
+        if payloads[0]["keys"] is not None:
+            # vectorized dedup over worker-pickled keys: unique keys,
+            # representatives walked in first-occurrence order so new
+            # ids land exactly in (source id, action slot, transition
+            # order) — the serial first-sight order.  The global
+            # _id_of dict lookup runs only on the unique
+            # representatives, so a model whose equal states pickle
+            # differently loses batching, never correctness.
+            # np.asarray over bytes gives a fixed-width 'S' array
+            # (pure C sort); trailing-null padding cannot collide
+            # because every pickle ends with the non-null STOP opcode.
+            keys: list = []
+            for p in payloads:
+                keys.extend(p["keys"])
+            karr = np.asarray(keys)
+            uniq, first_idx, inverse = np.unique(
+                karr, return_index=True, return_inverse=True)
+            uid_gid = np.empty(len(uniq), np.int64)
+            for u in np.argsort(first_idx, kind="stable"):
+                uid_gid[u] = self._id_of(succs[int(first_idx[u])])
+            dst = uid_gid[inverse].astype(np.int32)
+        else:
+            # inline expansion: no worker parallelism paid for key
+            # encoding, so dedup through the state dict directly
+            # (exactly the serial compiler's per-successor cost)
+            idf = self._id_of
+            dst = np.fromiter((idf(s) for s in succs), np.int32,
+                              len(succs))
+        self._cols.append((src, act, dst, val, reward, progress))
+        if self.trace_params:
+            self._pcols.append((
+                np.concatenate([p["coef"] for p in payloads]),
+                np.concatenate([p["expo"] for p in payloads])))
+
+    # -- the round driver -------------------------------------------------
+
+    def _run(self):
+        t0 = now()
+        try:
+            while self._explored_upto < len(self.states):
+                self._round += 1
+                resilience.fault_point("compile_round")
+                lo, hi = self._explored_upto, len(self.states)
+                self._absorb(lo, hi, self._expand(self.states[lo:hi]))
+                self._explored_upto = hi
+                if self._ck_path and self._round % self._ck_every == 0:
+                    self._save_checkpoint()
+        finally:
+            self._elapsed += now() - t0
+            if self._pool is not None:
+                self._pool.shutdown()
+                self._pool = None
+
+    # -- checkpoint / resume ----------------------------------------------
+
+    def _columns_so_far(self) -> dict:
+        cols = {}
+        for i, name in enumerate(_COL_NAMES):
+            parts = [c[i] for c in self._cols]
+            cols[name] = (np.concatenate(parts) if parts else
+                          np.zeros(0, np.int32 if i < 3 else np.float64))
+        if self.trace_params:
+            cols["coef"] = (np.concatenate([c for c, _ in self._pcols])
+                            if self._pcols else np.zeros(0, np.float64))
+            cols["expo"] = (np.concatenate([e for _, e in self._pcols])
+                            if self._pcols
+                            else np.zeros((0, 4), np.int16))
+        return cols
+
+    def _save_checkpoint(self):
+        blob = pickle.dumps(
+            {"states": self.states, "action_map": self.action_map,
+             "start": self._start}, _PICKLE_PROTO)
+        resilience.save_compile_checkpoint(
+            self._ck_path, columns=self._columns_so_far(), blob=blob,
+            round_idx=self._round, explored_upto=self._explored_upto,
+            model_fp=self._model_fp)
+
+    def _resume(self, path: str):
+        st = resilience.load_compile_checkpoint(
+            path, model_fp=self._model_fp)
+        tab = pickle.loads(st["blob"])
+        self.states = list(tab["states"])
+        self.action_map = list(tab["action_map"])
+        self._start = dict(tab["start"])
+        self.state_map = {s: i for i, s in enumerate(self.states)}
+        if len(st["src"]):
+            self._cols = [tuple(st[n] for n in _COL_NAMES)]
+        if self.trace_params and "coef" in st and len(st["coef"]):
+            self._pcols = [(st["coef"], st["expo"])]
+        self._round = int(st["round"])
+        self._explored_upto = int(st["explored"])
+        self._resumed = True
+        telemetry.current().event("resume", path=path,
+                                  update=self._round)
+
+    # -- results ----------------------------------------------------------
+
+    def mdp(self):
+        """Run the compile to exhaustion and return the MDP —
+        bit-identical (ids, columns, start map) to
+        `Compiler(model).mdp()`.  Emits the schema-v12 `mdp_compile`
+        telemetry event and deletes the crash-recovery checkpoint on
+        completion."""
+        if self._result is not None:
+            return self._result
+        from cpr_tpu.mdp.explicit import MDP
+
+        self._run()
+        m = MDP()
+        m.start = dict(self._start)
+        for cols in self._cols:
+            m.add_transitions(*cols)
+        m.n_states = max(m.n_states, len(self.states))
+        m.consolidate()
+        m.check()
+        dt = self._elapsed
+        telemetry.current().event(
+            "mdp_compile", protocol=self.protocol, cutoff=self.cutoff,
+            rounds=self._round, states=len(self.states),
+            transitions=m.n_transitions, n_workers=self.n_workers,
+            compile_s=round(dt, 6),
+            states_per_sec=(round(len(self.states) / dt, 3)
+                            if dt > 0 else None),
+            resumed=self._resumed)
+        if self._ck_path:
+            for p in (self._ck_path, self._ck_path + ".json"):
+                if os.path.exists(p):
+                    os.unlink(p)
+        self._result = m
+        return m
+
+    def param_mdp(self, *, probe_alpha: float, probe_gamma: float,
+                  meta: dict | None = None):
+        """The ParamMDP of a `trace_params=True` compile: the base MDP
+        already holds the probe-valued float probability column (the
+        tracer's per-transition probe values ARE the collected column);
+        the (coef, expo) columns were carried through the columnar
+        collect round by round.  Matches grid._param_mdp_from on a
+        serial tracer compile bit-for-bit."""
+        if not self.trace_params:
+            raise ValueError("param_mdp() needs trace_params=True")
+        from cpr_tpu.mdp.explicit import MDP
+        from cpr_tpu.mdp.grid import ParamMDP, _extract_param
+
+        m = self.mdp()
+        if self._pcols:
+            coef = np.concatenate([c for c, _ in self._pcols])
+            expo = np.concatenate([e for _, e in self._pcols])
+        else:
+            coef = np.zeros(0, np.float64)
+            expo = np.zeros((0, 4), np.int16)
+        start_ids = np.asarray(sorted(m.start), np.int32)
+        start_coef = np.empty(len(start_ids), np.float64)
+        start_expo = np.empty((len(start_ids), 4), np.int16)
+        for i, sid in enumerate(start_ids):
+            start_coef[i], start_expo[i] = _extract_param(
+                m.start[int(sid)], f"start prob of state {sid}")
+        src, act, dst, prob, reward, progress = m.arrays()
+        base = MDP(n_states=m.n_states, n_actions=m.n_actions,
+                   start={int(s): float(p) for s, p in m.start.items()},
+                   src=src, act=act, dst=dst, prob=prob, reward=reward,
+                   progress=progress)
+        return ParamMDP(mdp=base, coef=coef, expo=expo,
+                        start_ids=start_ids, start_coef=start_coef,
+                        start_expo=start_expo, probe_alpha=probe_alpha,
+                        probe_gamma=probe_gamma, meta=dict(meta or {}))

@@ -292,18 +292,32 @@ def _param_mdp_from(mdp: MDP, probe_alpha: float, probe_gamma: float,
 
 def parametric_compile(factory, *, probe_alpha: float = PROBE_ALPHA,
                        probe_gamma: float = PROBE_GAMMA,
-                       meta: dict | None = None) -> ParamMDP:
-    """One Python-BFS compile of `factory(alpha=<tracer>,
+                       meta: dict | None = None,
+                       n_workers: int | None = None,
+                       checkpoint_path: str | None = None) -> ParamMDP:
+    """One frontier-batched compile of `factory(alpha=<tracer>,
     gamma=<tracer>)` -> ParamMDP.  The model runs unmodified — its
     probability expressions evaluate in the monomial tracer domain, so
     BFS order, state ids, and transition order are exactly those of a
     fresh compile at the probe point (the models' control flow depends
     on alpha/gamma only through comparisons, which the tracer answers
-    with its probe value)."""
+    with its probe value).  The (coef, expo) columns are carried
+    through the columnar collect (FrontierCompiler trace_params), so
+    the tracer inherits multi-core expansion and checkpointed resume;
+    the result is bit-identical to the old serial
+    `Compiler` + `_param_mdp_from` pair."""
+    from cpr_tpu.mdp.frontier import FrontierCompiler
+
     a, g = param_pair(probe_alpha, probe_gamma)
     model = factory(alpha=a, gamma=g)
-    mdp = Compiler(model).mdp()
-    return _param_mdp_from(mdp, probe_alpha, probe_gamma, meta or {})
+    meta = dict(meta or {})
+    fc = FrontierCompiler(model, n_workers=n_workers,
+                          checkpoint_path=checkpoint_path,
+                          trace_params=True,
+                          protocol=meta.get("protocol"),
+                          cutoff=meta.get("cutoff"))
+    return fc.param_mdp(probe_alpha=probe_alpha,
+                        probe_gamma=probe_gamma, meta=meta)
 
 
 def _native_keys(a: float, g: float):
@@ -549,11 +563,17 @@ def grid_value_iteration(pm: ParamMDP, alphas, gammas, *,
 def compile_protocol(protocol: str, *, cutoff: int, k: int = 2,
                      native: bool = False,
                      probe_alpha: float = PROBE_ALPHA,
-                     probe_gamma: float = PROBE_GAMMA) -> ParamMDP:
+                     probe_gamma: float = PROBE_GAMMA,
+                     n_workers: int | None = None,
+                     checkpoint_path: str | None = None) -> ParamMDP:
     """Parametric compile of one battery protocol family: "fc16" /
-    "aft20" (maximum_fork_length=cutoff, Python BFS) or "bitcoin" /
-    "ghostdag" (generic model, dag_size_cutoff=cutoff; `native=True`
-    uses the C++ compiler's exponent-recovery path)."""
+    "aft20" (maximum_fork_length=cutoff) or "bitcoin" / "ghostdag"
+    (generic model, dag_size_cutoff=cutoff; `native=True` uses the C++
+    compiler's exponent-recovery path).  The Python paths ride the
+    frontier-batched compiler; `n_workers` (default
+    CPR_MDP_COMPILE_WORKERS) shards each frontier across worker
+    processes and `checkpoint_path` enables between-round crash
+    checkpoints — both bit-identity-preserving."""
     meta = dict(protocol=protocol, cutoff=int(cutoff))
     if protocol in ("fc16", "aft20"):
         from cpr_tpu.mdp.models import Aft20BitcoinSM, Fc16BitcoinSM
@@ -562,7 +582,8 @@ def compile_protocol(protocol: str, *, cutoff: int, k: int = 2,
         return parametric_compile(
             lambda alpha, gamma: cls(alpha=alpha, gamma=gamma,
                                      maximum_fork_length=cutoff),
-            probe_alpha=probe_alpha, probe_gamma=probe_gamma, meta=meta)
+            probe_alpha=probe_alpha, probe_gamma=probe_gamma, meta=meta,
+            n_workers=n_workers, checkpoint_path=checkpoint_path)
     if protocol in ("bitcoin", "ghostdag"):
         kk = k if protocol == "ghostdag" else 0
         if native:
@@ -578,7 +599,8 @@ def compile_protocol(protocol: str, *, cutoff: int, k: int = 2,
                 get_protocol(protocol, **kw), alpha=alpha, gamma=gamma,
                 collect_garbage="simple", merge_isomorphic=True,
                 truncate_common_chain=True, dag_size_cutoff=cutoff),
-            probe_alpha=probe_alpha, probe_gamma=probe_gamma, meta=meta)
+            probe_alpha=probe_alpha, probe_gamma=probe_gamma, meta=meta,
+            n_workers=n_workers, checkpoint_path=checkpoint_path)
     raise ValueError(f"unknown protocol {protocol!r}; expected fc16, "
                      f"aft20, bitcoin, or ghostdag")
 

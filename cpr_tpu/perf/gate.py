@@ -130,13 +130,18 @@ def gate_row(candidate: dict, history, *, top_k: int = TOP_K,
         # boundary — judging a 4-chip rate against 1-chip history (or
         # vice versa) is the exact misread cfg_devices exists to
         # prevent, so an off-count candidate with no same-count
-        # history passes as a first measurement instead
-        devs = lambda r: (r.get("config") or {}).get("cfg_devices", 1)  # noqa: E731
+        # history passes as a first measurement instead.  Same logic
+        # for cfg_workers (frontier compiles: a 1-worker rate must
+        # never gate a 4-worker one).
+        devs = lambda r: ((r.get("config") or {}).get("cfg_devices", 1),  # noqa: E731
+                          (r.get("config") or {}).get("cfg_workers", 1))
         pool = [r for r in pool if devs(r) == devs(candidate)]
         if not pool:
+            dd, dw = devs(candidate)
             result["reason"] = (
-                "no same-device-count baseline banked yet (first "
-                f"measurement at cfg_devices={devs(candidate)})")
+                "no same-device/worker-count baseline banked yet "
+                f"(first measurement at cfg_devices={dd}, "
+                f"cfg_workers={dw})")
             return result
         result["config_drift"] = True
     lower = direction == "lower"

@@ -317,6 +317,25 @@ def iter_trace_rows(path: str):
                             "value": round(solve_s / points, 6),
                             "unit": "seconds", **mdp_cfg}, base)
             elif (e.get("kind") == "event"
+                  and e.get("name") == "mdp_compile"):
+                # schema v12: frontier-batched MDP compiles bank their
+                # states/sec throughput, fingerprinted by protocol/
+                # cutoff/worker count — a 1-worker compile never gates
+                # against a 4-worker one, nor fc16@8 against
+                # ghostdag@7
+                sps = e.get("states_per_sec")
+                if not isinstance(sps, (int, float)):
+                    continue
+                cmp_cfg = {
+                    **{f"cfg_{k}": v for k, v in config.items()},
+                    "cfg_protocol": str(e.get("protocol")),
+                    "cfg_cutoff": e.get("cutoff"),
+                    "cfg_workers": int(e.get("n_workers") or 1),
+                }
+                yield ({"metric": "mdp_compile_states_per_sec",
+                        "backend": backend, "value": sps,
+                        "unit": "states/sec", **cmp_cfg}, base)
+            elif (e.get("kind") == "event"
                   and e.get("name") == "attack_sweep"):
                 # schema v11: adversary-in-the-network sweeps bank
                 # their vmapped lane throughput, fingerprinted by

@@ -192,7 +192,8 @@ def atomic_write_text(path: str, text: str, encoding: str = "utf-8"):
 # -- deterministic fault injection -------------------------------------------
 
 _ACTIONS = ("kill", "io_error", "fault", "nan", "preempt", "hang")
-_COUNTED_SITES = ("checkpoint", "vi_chunk")  # occurrence-counted sites
+# occurrence-counted sites (kill@vi_chunk=3 means the third pass)
+_COUNTED_SITES = ("checkpoint", "vi_chunk", "compile_round")
 
 # how long an injected `hang` blocks.  The default approximates a truly
 # wedged process (the supervisor's watchdog must kill the child, exactly
@@ -493,6 +494,54 @@ def load_grid_vi_checkpoint(path: str, *, G: int, S: int, dtype):
         raise ValueError(f"grid VI checkpoint {path} has dtype "
                          f"{st['value'].dtype}, solve expects "
                          f"{np.dtype(dtype)}")
+    return st
+
+
+# -- frontier-compile checkpoints --------------------------------------------
+#
+# The frontier-batched MDP compiler (cpr_tpu/mdp/frontier.py)
+# checkpoints between rounds: the partial transition columns
+# concatenated so far, the pickled state/action/start tables, and the
+# frontier position.  Same atomic-npz + informational-sidecar shape as
+# the VI checkpoints; same crash-recovery-scratch lifecycle (deleted
+# when the compile completes).  `model_fp` pins the checkpoint to the
+# model it came from — a checkpoint from a different protocol/cutoff
+# must not silently seed this compile.
+
+
+def save_compile_checkpoint(path: str, *, columns: dict, blob: bytes,
+                            round_idx: int, explored_upto: int,
+                            model_fp: str):
+    import numpy as np
+
+    buf = io.BytesIO()
+    np.savez(buf, blob=np.frombuffer(blob, np.uint8),
+             round=np.asarray(int(round_idx)),
+             explored=np.asarray(int(explored_upto)),
+             model_fp=np.asarray(model_fp),
+             **{k: np.asarray(v) for k, v in columns.items()})
+    atomic_write_bytes(path, buf.getvalue())
+    atomic_write_json(path + ".json", {
+        "version": SNAPSHOT_VERSION, "kind": "mdp_compile",
+        "round": int(round_idx), "explored": int(explored_upto),
+        "transitions": int(len(columns["src"])),
+        "model_fp": model_fp})
+
+
+def load_compile_checkpoint(path: str, *, model_fp: str) -> dict:
+    """Load a frontier-compile checkpoint as a dict of numpy arrays
+    plus the raw `blob` bytes, validated against the resuming model's
+    fingerprint."""
+    import numpy as np
+
+    with open(path, "rb") as f:
+        with np.load(io.BytesIO(f.read())) as z:
+            st = {k: z[k] for k in z.files}
+    got = str(st.pop("model_fp"))
+    if got != model_fp:
+        raise ValueError(f"compile checkpoint {path} is for model "
+                         f"{got}, this compile is {model_fp}")
+    st["blob"] = st["blob"].tobytes()
     return st
 
 

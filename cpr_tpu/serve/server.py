@@ -700,6 +700,8 @@ class ServeServer:
         # so pull the callables straight from the submodule
         from cpr_tpu.experiments.break_even import break_even, revenue
 
+        if req.get("mode") == "exact":
+            return self._break_even_exact(req, op)
         proto = req["protocol"]
         policy = req["policy"]
         gamma = float(req["gamma"])
@@ -719,6 +721,40 @@ class ServeServer:
             episode_len=episode_len, reps=reps,
             seed=int(req.get("seed", 0)))
         return dict(ok=True, protocol=proto, policy=policy, alpha=value)
+
+    def _break_even_exact(self, req: dict, op: str) -> dict:
+        """`mode: "exact"` break-even queries ride solve_grid_cached
+        (ROADMAP item 3): the optimal-attack revenue curve / break-even
+        alpha from one fingerprint-cached exact grid solve — a repeat
+        query for the same protocol/cutoff/grid is a disk-cache hit,
+        surfaced by the `cached` flag in the reply (no `policy` field:
+        the exact path optimizes over all policies)."""
+        from cpr_tpu.experiments.break_even import (break_even_exact,
+                                                    exact_revenue_curve)
+
+        proto = req["protocol"]
+        gamma = float(req["gamma"])
+        cutoff = int(req.get("cutoff", 8))
+        kw = dict(gamma=gamma, cutoff=cutoff,
+                  horizon=int(req.get("horizon", 100)),
+                  stop_delta=float(req.get("stop_delta", 1e-6)),
+                  native=bool(req.get("native", False)),
+                  k=int(req.get("k", 2)), full=True)
+        if op == "break_even.revenue":
+            alphas = req.get("alphas") or [float(req["alpha"])]
+            out = exact_revenue_curve(
+                proto, alphas=tuple(float(a) for a in alphas), **kw)
+            return dict(ok=True, protocol=proto, mode="exact",
+                        cutoff=cutoff, revenue=out["revenue"],
+                        alphas=out["alphas"], cached=out["cached"],
+                        fingerprint=out["fingerprint"])
+        out = break_even_exact(
+            proto, support=tuple(req.get("support", (0.1, 0.5))),
+            grid=int(req.get("grid", 17)), **kw)
+        return dict(ok=True, protocol=proto, mode="exact",
+                    cutoff=cutoff, alpha=out["alpha"],
+                    cached=out["cached"],
+                    fingerprint=out["fingerprint"])
 
     def _mdp_solve_grid(self, req: dict) -> dict:
         """Exact-MDP optimal-policy tables over an (alpha, gamma) grid:

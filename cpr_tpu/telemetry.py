@@ -54,7 +54,7 @@ from contextlib import contextmanager
 from datetime import datetime, timezone
 from time import perf_counter as now  # noqa: F401 — re-exported
 
-SCHEMA_VERSION = 11
+SCHEMA_VERSION = 12
 TELEMETRY_ENV_VAR = "CPR_TELEMETRY"
 # trace context: one run id per process tree, exported so supervisor
 # children and serve clients land their events under the same id
@@ -144,6 +144,15 @@ EVENT_FIELDS = {
     # lifts the rate into attack_sweep_lanes_per_sec rows).
     "attack_sweep": ("protocol", "topology", "lanes", "policies",
                      "drops"),
+    # v12: one per frontier-batched MDP compile
+    # (cpr_tpu/mdp/frontier.py FrontierCompiler.mdp): rounds counts the
+    # whole-frontier BFS rounds, states/transitions size the compiled
+    # MDP, n_workers is the expansion process count (1 = inline).
+    # Extras ride free-form: compile_s, states_per_sec (the perf
+    # ledger lifts the rate into mdp_compile_states_per_sec rows),
+    # resumed.
+    "mdp_compile": ("protocol", "cutoff", "rounds", "states",
+                    "transitions", "n_workers"),
 }
 
 

@@ -16,3 +16,5 @@ def report(tele, fn_name, tid):
     # finding: missing policies, drops (v11 attack_sweep)
     tele.event("attack_sweep", protocol="nakamoto",
                topology="two-agents", lanes=54)
+    # finding: missing states, transitions, n_workers (v12 mdp_compile)
+    tele.event("mdp_compile", protocol="fc16", cutoff=8, rounds=17)

@@ -18,3 +18,6 @@ def report(tele, fn_name, dt, err, extra, tid):
     tele.event("attack_sweep", protocol="nakamoto",
                topology="two-agents", lanes=54, policies=3, drops=0,
                lanes_per_sec=dt)  # extras ride free-form
+    tele.event("mdp_compile", protocol="fc16", cutoff=8, rounds=17,
+               states=1024, transitions=6144, n_workers=4,
+               compile_s=dt, states_per_sec=dt)  # extras ride free-form

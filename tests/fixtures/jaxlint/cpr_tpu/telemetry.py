@@ -10,4 +10,6 @@ EVENT_FIELDS = {
     "route": ("action", "replica", "op"),
     "attack_sweep": ("protocol", "topology", "lanes", "policies",
                      "drops"),
+    "mdp_compile": ("protocol", "cutoff", "rounds", "states",
+                    "transitions", "n_workers"),
 }

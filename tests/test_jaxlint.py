@@ -77,6 +77,8 @@ def test_event_fields_resolved_cross_module_by_ast():
         "route": ("action", "replica", "op"),
         "attack_sweep": ("protocol", "topology", "lanes", "policies",
                          "drops"),
+        "mdp_compile": ("protocol", "cutoff", "rounds", "states",
+                        "transitions", "n_workers"),
     }
 
 

@@ -1,0 +1,269 @@
+"""Frontier-batched MDP compile tests (docs/MDP.md): bit-identity of
+FrontierCompiler against the serial Compiler (inline, multi-worker,
+and across a kill@compile_round + resume), ParamMDP coef/expo parity
+through the columnar tracer collect, the bulk MDP.add_transitions
+chunk semantics, the padded_layout memory guard, the v12 `mdp_compile`
+telemetry event + its perf-ledger rows, and the serve break_even exact
+mode riding solve_grid_cached."""
+
+import importlib.util
+import json
+import os
+
+import numpy as np
+import pytest
+
+from cpr_tpu import telemetry
+from cpr_tpu.mdp import Compiler, FrontierCompiler, PaddedLayoutTooLarge
+from cpr_tpu.mdp import grid
+from cpr_tpu.mdp.explicit import MDP, ptmdp
+from cpr_tpu.mdp.models import Aft20BitcoinSM, Fc16BitcoinSM
+from cpr_tpu.resilience import FAULT_ENV_VAR, InjectedKill
+
+MFL = 6
+COLS = ("src", "act", "dst", "prob", "reward", "progress")
+
+
+def fc16_model():
+    return Fc16BitcoinSM(alpha=0.33, gamma=0.7, maximum_fork_length=MFL)
+
+
+def ghostdag_model():
+    from cpr_tpu.mdp.generic import SingleAgent, get_protocol
+
+    return SingleAgent(get_protocol("ghostdag", k=2), alpha=0.3,
+                       gamma=0.5, collect_garbage="simple",
+                       merge_isomorphic=True, truncate_common_chain=True,
+                       dag_size_cutoff=5)
+
+
+MODELS = {
+    "fc16": fc16_model,
+    "aft20": lambda: Aft20BitcoinSM(alpha=0.33, gamma=0.7,
+                                    maximum_fork_length=MFL),
+    "ghostdag": ghostdag_model,
+}
+
+
+def assert_mdp_equal(a: MDP, b: MDP):
+    assert a.n_states == b.n_states and a.n_actions == b.n_actions
+    assert a.n_transitions == b.n_transitions
+    assert dict(a.start) == dict(b.start)
+    for x, y, name in zip(a.arrays(), b.arrays(), COLS):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y),
+                                      err_msg=name)
+
+
+# ------------------------------------------------------- bit-identity
+
+
+@pytest.mark.parametrize("proto", sorted(MODELS))
+def test_frontier_bit_identical_to_serial(proto):
+    ref = Compiler(MODELS[proto]()).mdp()
+    out = FrontierCompiler(MODELS[proto]()).mdp()
+    assert_mdp_equal(ref, out)
+
+
+def test_frontier_multiworker_bit_identical():
+    ref = Compiler(fc16_model()).mdp()
+    fc = FrontierCompiler(fc16_model(), n_workers=2)
+    fc.min_shard = 1  # tiny fixture: force sharded expansion
+    assert_mdp_equal(ref, fc.mdp())
+
+
+def test_param_mdp_parity_including_exponent_columns():
+    a, g = grid.param_pair(grid.PROBE_ALPHA, grid.PROBE_GAMMA)
+    ref = grid._param_mdp_from(
+        Compiler(Fc16BitcoinSM(alpha=a, gamma=g,
+                               maximum_fork_length=MFL)).mdp(),
+        grid.PROBE_ALPHA, grid.PROBE_GAMMA, {})
+    out = grid.parametric_compile(
+        lambda alpha, gamma: Fc16BitcoinSM(alpha=alpha, gamma=gamma,
+                                           maximum_fork_length=MFL))
+    assert_mdp_equal(ref.mdp, out.mdp)
+    np.testing.assert_array_equal(ref.coef, out.coef)
+    np.testing.assert_array_equal(ref.expo, out.expo)
+    np.testing.assert_array_equal(ref.start_ids, out.start_ids)
+    np.testing.assert_array_equal(ref.start_coef, out.start_coef)
+    np.testing.assert_array_equal(ref.start_expo, out.start_expo)
+
+
+# -------------------------------------------------- checkpoint/resume
+
+
+def test_compile_round_kill_and_resume_bit_identical(tmp_path,
+                                                     monkeypatch):
+    ref = Compiler(fc16_model()).mdp()
+    ck = str(tmp_path / "compile-ck.npz")
+    monkeypatch.setenv(FAULT_ENV_VAR, "kill@compile_round=3")
+    with pytest.raises(InjectedKill):
+        FrontierCompiler(fc16_model(), checkpoint_path=ck).mdp()
+    assert os.path.exists(ck)  # rounds 1-2 landed before the crash
+
+    monkeypatch.delenv(FAULT_ENV_VAR)
+    out = FrontierCompiler(fc16_model(), checkpoint_path=ck).mdp()
+    assert_mdp_equal(ref, out)
+    # crash-recovery scratch is deleted once the compile completes
+    assert not os.path.exists(ck) and not os.path.exists(ck + ".json")
+
+
+def test_checkpoint_rejects_different_model(tmp_path, monkeypatch):
+    ck = str(tmp_path / "compile-ck.npz")
+    monkeypatch.setenv(FAULT_ENV_VAR, "kill@compile_round=2")
+    with pytest.raises(InjectedKill):
+        FrontierCompiler(fc16_model(), checkpoint_path=ck).mdp()
+    monkeypatch.delenv(FAULT_ENV_VAR)
+    other = Fc16BitcoinSM(alpha=0.4, gamma=0.7, maximum_fork_length=MFL)
+    with pytest.raises(ValueError, match="is for model"):
+        FrontierCompiler(other, checkpoint_path=ck)
+
+
+# ---------------------------------------------------------- telemetry
+
+
+def _load_trace_summary():
+    path = os.path.join(os.path.dirname(__file__), "..", "tools",
+                        "trace_summary.py")
+    spec = importlib.util.spec_from_file_location("trace_summary", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_mdp_compile_event_validates(tmp_path):
+    trace = tmp_path / "compile.jsonl"
+    telemetry.configure(str(trace))
+    try:
+        telemetry.current().manifest(config={"role": "test-frontier"})
+        FrontierCompiler(fc16_model(), protocol="fc16",
+                         cutoff=MFL).mdp()
+    finally:
+        telemetry.configure(None)
+    ts = _load_trace_summary()
+    events, bad = ts.read_events(str(trace))
+    assert ts.validate(events, bad, expect=("mdp_compile",)) == []
+    (ev,) = [e for e in events if e.get("name") == "mdp_compile"]
+    assert ev["protocol"] == "fc16" and ev["cutoff"] == MFL
+    assert ev["n_workers"] == 1 and ev["resumed"] is False
+    assert ev["rounds"] > 1 and ev["states"] == 88
+    assert ev["states_per_sec"] > 0
+
+
+def test_mdp_compile_event_banks_in_ledger(tmp_path):
+    from cpr_tpu.perf.ledger import Ledger
+
+    trace = tmp_path / "compile.jsonl"
+    telemetry.configure(str(trace))
+    try:
+        telemetry.current().manifest(config={"devices": 1})
+        FrontierCompiler(fc16_model(), protocol="fc16",
+                         cutoff=MFL).mdp()
+    finally:
+        telemetry.configure(None)
+    led = Ledger(str(tmp_path / "ledger.jsonl"))
+    assert led.ingest_trace(str(trace)) >= 1
+    by_metric = {r["metric"]: r for r in led.records()}
+    row = by_metric["mdp_compile_states_per_sec"]
+    assert row["unit"] == "states/sec" and row["value"] > 0
+    assert row["config"]["cfg_protocol"] == "fc16"
+    assert row["config"]["cfg_cutoff"] == MFL
+    assert row["config"]["cfg_workers"] == 1
+
+
+# -------------------------------------------------- bulk transitions
+
+
+def test_add_transitions_matches_serial_appends():
+    a, b = MDP(), MDP()
+    rows = [(0, 0, 1, 0.3, 1.0, 0.0), (0, 0, 2, 0.7, 0.0, 1.0),
+            (1, 1, 0, 1.0, 0.5, 0.5)]
+    for r in rows:
+        a.add_transition(r[0], r[1], r[2], probability=r[3],
+                         reward=r[4], progress=r[5])
+    cols = list(zip(*rows))
+    b.add_transitions(*cols)
+    assert a.n_states == b.n_states and a.n_actions == b.n_actions
+    for x, y in zip(a.arrays(), b.arrays()):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_add_transitions_cache_invalidation_and_mixed_use():
+    m = MDP()
+    m.add_transitions([0], [0], [1], [1.0], [0.0], [0.0])
+    first = m.arrays()
+    assert m.arrays() is first  # cached + zero-copy on the fast path
+    # single-transition append after a bulk chunk keeps call order
+    m.add_transition(1, 0, 0, probability=1.0, reward=2.0, progress=0.5)
+    assert m.arrays() is not first  # appends invalidate the cache
+    src, act, dst, prob, reward, progress = m.arrays()
+    np.testing.assert_array_equal(src, [0, 1])
+    np.testing.assert_array_equal(reward, [0.0, 2.0])
+    assert m.n_transitions == 2 and m.n_states == 2
+    m.add_transitions([0, 1], [1, 1], [1, 1], [0.5, 0.5], [0, 0], [0, 0])
+    assert m.n_transitions == 4 and m.n_actions == 2
+    np.testing.assert_array_equal(m.arrays()[1], [0, 0, 1, 1])
+
+
+def test_consolidate_folds_chunks_into_fields():
+    m = MDP()
+    m.add_transitions([0, 0], [0, 0], [1, 2], [0.4, 0.6], [1, 0], [0, 1])
+    m.add_transitions([1], [0], [0], [1.0], [0.0], [1.0])
+    assert m.consolidate() is m
+    assert isinstance(m.src, np.ndarray) and len(m.src) == 3
+    assert m.arrays()[0] is m.src  # zero-copy after consolidation
+    m.start = {0: 1.0}
+    m.check()
+
+
+def test_add_transitions_rejects_ragged_and_negative():
+    m = MDP()
+    with pytest.raises(ValueError, match="equal-length"):
+        m.add_transitions([0, 1], [0], [1], [1.0], [0.0], [0.0])
+    with pytest.raises(ValueError, match="negative"):
+        m.add_transitions([-1], [0], [1], [1.0], [0.0], [0.0])
+    m.add_transitions([], [], [], [], [], [])  # empty append is a no-op
+    assert m.n_transitions == 0
+
+
+# --------------------------------------------------- padded layout guard
+
+
+def test_padded_layout_memory_guard(monkeypatch):
+    pt = ptmdp(Compiler(fc16_model()).mdp(), horizon=10)
+    assert pt.tensor().padded_layout()  # default ~2 GiB ceiling passes
+    monkeypatch.setenv("CPR_MDP_PAD_BYTES", "1")
+    with pytest.raises(PaddedLayoutTooLarge) as ei:
+        pt.tensor().padded_layout()
+    # the fallback is named so the error is actionable
+    assert "COO sweep" in str(ei.value)
+    assert "CPR_MDP_PAD_BYTES" in str(ei.value)
+
+
+# ------------------------------------------------- serve break_even exact
+
+
+def test_serve_break_even_exact_round_trip(tmp_path, monkeypatch):
+    """The exact mode of the break_even.* ops rides solve_grid_cached:
+    first query computes, the repeat is a fingerprint-keyed disk-cache
+    hit surfaced by the `cached` flag (the full socket path is covered
+    by tools/compile_smoke.py + serve-smoke)."""
+    from cpr_tpu.serve.server import ServeServer
+
+    monkeypatch.setenv("CPR_MDP_CACHE", str(tmp_path))
+    srv = ServeServer.__new__(ServeServer)
+    req = dict(mode="exact", protocol="fc16", gamma=0.5, cutoff=MFL,
+               alphas=[0.25, 0.4], horizon=30)
+    out = srv._break_even(dict(req), "break_even.revenue")
+    assert out["ok"] and out["mode"] == "exact"
+    assert out["cached"] is False and len(out["revenue"]) == 2
+    assert out["revenue"] == sorted(out["revenue"])
+    again = srv._break_even(dict(req), "break_even.revenue")
+    assert again["cached"] is True
+    assert again["revenue"] == out["revenue"]
+    assert again["fingerprint"] == out["fingerprint"]
+
+    be = srv._break_even(dict(mode="exact", protocol="fc16", gamma=0.5,
+                              cutoff=MFL, support=(0.1, 0.45), grid=5,
+                              horizon=30), "break_even.alpha")
+    assert be["ok"] and 0.1 <= be["alpha"] <= 0.45
+    assert "fingerprint" in be

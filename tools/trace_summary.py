@@ -123,6 +123,7 @@ def summarize(events, out=sys.stdout):
     _route_lines(events, out)
     _request_lines(events, out)
     _mdp_solve_lines(events, out)
+    _mdp_compile_lines(events, out)
     _attack_sweep_lines(events, out)
     _perf_gate_lines(events, out)
     for m in (e for e in events if e.get("kind") == "manifest"):
@@ -134,7 +135,7 @@ def summarize(events, out=sys.stdout):
     tabled = ("compile", "device_metrics", "vi_residuals", "retry",
               "checkpoint", "perf_gate", "supervisor", "serve",
               "request", "admission", "route", "mdp_solve",
-              "attack_sweep")
+              "mdp_compile", "attack_sweep")
     for e in (e for e in events if e.get("kind") == "event"
               and e.get("name") not in tabled):
         keys = {k: v for k, v in e.items() if k not in ("kind", "ts")}
@@ -358,6 +359,30 @@ def _mdp_solve_lines(events, out):
               f"{e.get('n_transitions'):>10} {e.get('sweeps'):>7} "
               f"{e.get('converged'):>6} {sol_txt:>9} {pps_txt:>9}",
               file=out)
+
+
+def _mdp_compile_lines(events, out):
+    """Schema-v12 frontier-batched MDP compiles (cpr_tpu/mdp/frontier):
+    one line per compile — BFS round count, compiled MDP size, worker
+    process count, resume flag, and the states/sec rate the perf
+    ledger banks."""
+    evs = [e for e in events if e.get("kind") == "event"
+           and e.get("name") == "mdp_compile"]
+    if not evs:
+        return
+    print(f"\n{'mdp_compile':<18} {'rounds':>7} {'states':>9} "
+          f"{'trans':>10} {'workers':>8} {'resumed':>8} "
+          f"{'compile_s':>10} {'st/sec':>9}", file=out)
+    for e in evs:
+        label = f"{e.get('protocol')}@{e.get('cutoff')}"
+        sps = e.get("states_per_sec")
+        sps_txt = f"{sps:.1f}" if isinstance(sps, (int, float)) else "-"
+        cs = e.get("compile_s")
+        cs_txt = f"{cs:.3f}" if isinstance(cs, (int, float)) else "-"
+        print(f"{label:<18} {e.get('rounds'):>7} {e.get('states'):>9} "
+              f"{e.get('transitions'):>10} {e.get('n_workers'):>8} "
+              f"{str(bool(e.get('resumed'))).lower():>8} {cs_txt:>10} "
+              f"{sps_txt:>9}", file=out)
 
 
 def _attack_sweep_lines(events, out):
