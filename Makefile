@@ -4,7 +4,7 @@
 
 CPU_MESH = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: lint test test-slow bench perf-gate telemetry-smoke netsim-smoke resilience-smoke supervisor-smoke serve-smoke fleet-smoke multichip-smoke mdp-smoke compile-smoke attack-smoke dryrun sweeps ghostdag train-dummy native asan
+.PHONY: lint test test-slow bench perf-gate telemetry-smoke netsim-smoke resilience-smoke supervisor-smoke serve-smoke fleet-smoke multichip-smoke mdp-smoke vi-smoke compile-smoke attack-smoke dryrun sweeps ghostdag train-dummy native asan
 
 lint:  ## jaxlint over cpr_tpu/ + tools/ (pure AST, no JAX import,
 	## ~1s); banks the JSON report under runs/ like the smoke flows
@@ -135,6 +135,21 @@ mdp-smoke:  ## grid-batched MDP proof: parametric compile of fc16 +
 	## counts.  Details: docs/MDP.md
 	rm -rf $(MDP_SMOKE_DIR)
 	python tools/mdp_smoke.py $(MDP_SMOKE_DIR)
+
+VI_SMOKE_DIR = /tmp/cpr-vi-smoke
+
+vi-smoke:  ## state-sharded VI proof: ONE bitcoin (fc16@6) solve with
+	## its state space partitioned over forced 1 vs 4 CPU devices,
+	## fixpoints bit-identical to each other and to the solo chunked
+	## oracle, the in-graph RTDP start value checked against the
+	## host-computed exact oracle (seeded, reproducible), the
+	## rtdp_sharded_polish explore-then-certify handoff, a composed
+	## ("g", "s") 2-D grid x state solve bit-identical to the 1-D
+	## grid solve, v13 `mdp_solve` trace validation, and
+	## mdp_states_per_sec rows banked + gated at state-shard counts
+	## 1 and 4.  Details: docs/MDP.md "State-sharded solving"
+	rm -rf $(VI_SMOKE_DIR)
+	python tools/vi_smoke.py $(VI_SMOKE_DIR)
 
 COMPILE_SMOKE_DIR = /tmp/cpr-compile-smoke
 

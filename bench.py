@@ -402,6 +402,51 @@ def measure_mdp_grid(n_envs: int, mfl: int = 12, horizon: int = 100,
     return points / solve_s, check, extras
 
 
+def measure_mdp_state_shard(n_envs: int, horizon: int = 100,
+                            stop_delta: float = 1e-6):
+    """State-sharded exact-MDP solving (cpr_tpu/parallel/
+    state_shard.py): ONE fc16 solve at fork-length `n_envs`, its
+    state space partitioned over the CPR_BENCH_DEVICES mesh
+    (source-block COO shards, per-sweep value-halo all_gather) —
+    the capacity seam for models whose working set exceeds one
+    device.  Rate counts state backups/sec (n_states x sweeps /
+    solve_s, the same `mdp_states_per_sec` the solve's v13 telemetry
+    event banks); the check is the fc16 optimal revenue at the
+    hardest grid corner (0.45, 0.75), same band as `mdp_grid`."""
+    from cpr_tpu.mdp.explicit import MDP
+    from cpr_tpu.mdp.grid import compile_protocol, param_ptmdp
+    from cpr_tpu.parallel import (sharded_state_value_iteration,
+                                  state_halo_bytes)
+
+    alpha, gamma = 0.45, 0.75
+    pm = param_ptmdp(compile_protocol("fc16", cutoff=n_envs),
+                     horizon=horizon)
+    m = pm.mdp
+    sv = pm._monomial(pm.start_coef, pm.start_expo, alpha, gamma)
+    tm = MDP(n_states=m.n_states, n_actions=m.n_actions,
+             start={int(s): float(v)
+                    for s, v in zip(pm.start_ids, sv)},
+             src=m.src, act=m.act, dst=m.dst,
+             prob=pm.revalue(alpha, gamma),
+             reward=m.reward, progress=m.progress).tensor()
+    mesh = _bench_mesh()
+    n = _bench_devices()
+    vi = sharded_state_value_iteration(
+        tm, mesh, stop_delta=stop_delta, pad_states=True,
+        protocol="fc16", cutoff=n_envs)
+    rate = tm.n_states * vi["vi_iter"] / vi["vi_time"]
+    check = (tm.start_value(vi["vi_value"])
+             / tm.start_value(vi["vi_progress"]))
+    extras = dict(protocol="fc16", mfl=n_envs, n_states=tm.n_states,
+                  sweeps=vi["vi_iter"],
+                  solve_s=round(vi["vi_time"], 4),
+                  n_devices=n, state_shards=vi["vi_state_shards"],
+                  halo_bytes=state_halo_bytes(
+                      tm.n_states + (-tm.n_states % n), n,
+                      tm.prob.dtype))
+    return rate, check, extras
+
+
 def measure_mdp_compile(n_envs: int):
     """Frontier-batched MDP compilation (cpr_tpu/mdp/frontier.py):
     one compile of the generic bitcoin model at dag_size_cutoff
@@ -747,6 +792,18 @@ CONFIGS = {
         cpu=dict(n_envs=16), guard=(0.70, 0.80),
         guard_name="fc16 optimal revenue @ (0.45, 0.75)",
         metric="mdp_grid_points_per_sec", unit="grid-points/sec"),
+    # state-sharded exact-MDP solving (cpr_tpu/parallel/
+    # state_shard.py): n_envs is the fc16 fork-length; ONE solve's
+    # state space shards over CPR_BENCH_DEVICES (pad_states covers
+    # non-dividing counts) and the rate counts state backups/sec —
+    # the ledger fingerprints it by cfg_state_shards, so 1- and
+    # N-shard rows never gate each other.  Same revenue guard as
+    # mdp_grid: the check is solve-correctness, not throughput
+    "mdp_state_shard": dict(
+        fn="measure_mdp_state_shard", tpu=dict(n_envs=12),
+        cpu=dict(n_envs=12), guard=(0.70, 0.80),
+        guard_name="fc16 optimal revenue @ (0.45, 0.75)",
+        metric="mdp_states_per_sec", unit="states/sec"),
     # frontier-batched MDP compilation (cpr_tpu/mdp/frontier.py):
     # n_envs is the generic bitcoin dag_size_cutoff (6 -> 5730
     # states); the rate counts discovered states/sec, host-side work

@@ -28,6 +28,11 @@ HOT_CARRY_PATHS = (
     # dominant resident block of a grid solve
     "cpr_tpu/mdp/explicit.py",
     "cpr_tpu/mdp/grid.py",
+    # the in-graph RTDP while_loop carries the full [S] value/progress
+    # planes plus visit counters and the priority buffer — the whole
+    # point of the port is keeping that state device-resident, so an
+    # undonated input table doubles the explored-table footprint
+    "cpr_tpu/mdp/rtdp_graph.py",
 )
 # ...and every module under parallel/ — notably the sharded resident
 # lane stepper (parallel/lanes.py): its mesh-sharded carries are

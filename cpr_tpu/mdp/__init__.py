@@ -35,4 +35,5 @@ from cpr_tpu.mdp.grid import (  # noqa: F401
     solve_grid_cached,
 )
 from cpr_tpu.mdp.rtdp import RTDP  # noqa: F401
+from cpr_tpu.mdp.rtdp_graph import rtdp_graph, rtdp_sharded_polish  # noqa: F401
 from cpr_tpu.mdp import generic  # noqa: F401

@@ -48,6 +48,57 @@ class PaddedLayoutTooLarge(MemoryError):
     sweep (value_iteration impl="chunked"/"while"), which never pads."""
 
 
+# opt-in ceiling (bytes) on one device's VI working set — COO columns
+# + [S, A] Q planes + the [S] value/progress/policy vectors.  0 (the
+# default) disables the guard; chips with a known HBM budget set it so
+# an over-sized single-device solve refuses by name instead of letting
+# the runtime OOM mid-sweep.  The state-sharded solver checks its
+# PER-SHARD working set against the same ceiling.
+VI_BYTES_ENV_VAR = "CPR_VI_BYTES"
+_VI_BYTES_DEFAULT = 0
+
+
+class ViWorkingSetTooLarge(MemoryError):
+    """A VI solve's per-device working set exceeds the CPR_VI_BYTES
+    ceiling.  Shard the state axis over more devices
+    (cpr_tpu.parallel.sharded_state_value_iteration) or raise the
+    ceiling explicitly."""
+
+
+def vi_working_set_bytes(T: int, S: int, A: int, dtype, *,
+                         shards: int = 1) -> int:
+    """Per-device bytes a chunked COO sweep keeps resident: T
+    transition rows (per shard when state-sharded), the shard's
+    [S/shards, A] Q-value/Q-progress planes, and the replicated [S]
+    value/progress/policy vectors every shard's `value[dst]` gather
+    reads."""
+    item = np.dtype(dtype).itemsize
+    cols = T * (3 * np.dtype(np.int32).itemsize + 3 * item)
+    planes = 2 * (S // shards) * A * item
+    vectors = 3 * S * item
+    return int(cols + planes + vectors)
+
+
+def check_vi_working_set(T: int, S: int, A: int, dtype, *,
+                         shards: int = 1):
+    """Refuse (by name) a VI solve whose per-device working set
+    exceeds the opt-in CPR_VI_BYTES ceiling — no-op when unset."""
+    ceiling = int(os.environ.get(VI_BYTES_ENV_VAR, _VI_BYTES_DEFAULT))
+    if ceiling <= 0:
+        return
+    need = vi_working_set_bytes(T, S, A, dtype, shards=shards)
+    if need > ceiling:
+        label = (f"{shards} state shard(s)" if shards > 1
+                 else "one device")
+        raise ViWorkingSetTooLarge(
+            f"VI working set needs {need:,} bytes per device at "
+            f"{label} (T={T:,} transition rows/shard, S={S:,}, A={A}, "
+            f"dtype={np.dtype(dtype)}), over the {VI_BYTES_ENV_VAR} "
+            f"ceiling of {ceiling:,}; shard the state axis over more "
+            f"devices (cpr_tpu.parallel.sharded_state_value_iteration) "
+            f"or raise the ceiling explicitly")
+
+
 @dataclass
 class MDP:
     """Host-side MDP builder with flat transition storage.
@@ -504,7 +555,8 @@ def _anderson_mix(hist):
 def run_chunk_driver(chunk_step, S, dtype, stop_delta, max_iter,
                      chunk: int = 64, accel_m: int = 0,
                      checkpoint_path: str | None = None,
-                     checkpoint_every: int = 1):
+                     checkpoint_every: int = 1,
+                     value0=None, prog0=None):
     """Shared host loop for device-while-free VI: call
     `chunk_step(value, prog, steps) -> (value, prog, pol, deltas)` in
     full chunks with a chunk=1 tail (steps is a static argnum in both
@@ -532,11 +584,20 @@ def run_chunk_driver(chunk_step, S, dtype, stop_delta, max_iter,
     (bit-identical result); with acceleration on, resume drops the
     mixing history (the fixpoint is unchanged, the path there may
     differ).  Each chunk dispatch is retried on transient device
-    faults via resilience.with_retries."""
+    faults via resilience.with_retries.
+
+    `value0`/`prog0` warm-start the solve (the RTDP handoff —
+    cpr_tpu/mdp/rtdp_graph.py seeds the sharded polish with its
+    partially-explored table); an existing checkpoint overrides a
+    warm start, so resume replays the checkpointed trajectory."""
     from cpr_tpu import resilience, telemetry
 
-    z = jnp.zeros(S, dtype)
-    value, prog = z, z
+    # distinct buffers: a chunk_step that donates its carry (the
+    # state-sharded solver) must not see the same zeros array twice
+    value = (jnp.zeros(S, dtype) if value0 is None
+             else jnp.asarray(value0, dtype))
+    prog = (jnp.zeros(S, dtype) if prog0 is None
+            else jnp.asarray(prog0, dtype))
     it = 0
     delta = jnp.inf
     pol = None
@@ -915,6 +976,8 @@ class TensorMDP:
         stop_delta = self.resolve_stop_delta(
             discount=discount, eps=eps, stop_delta=stop_delta, max_iter=max_iter)
         self._check_segment_width()
+        check_vi_working_set(int(self.src.shape[0]), self.n_states,
+                             self.n_actions, self.prob.dtype)
         impl = resolve_vi_impl(impl)
         if checkpoint_path is not None and impl == "while":
             raise ValueError(

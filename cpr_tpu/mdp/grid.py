@@ -479,6 +479,7 @@ def grid_value_iteration(pm: ParamMDP, alphas, gammas, *,
                          stop_delta: float | None = None,
                          max_iter: int = 0, chunk: int = 64,
                          dtype=None, mesh=None, axis: str = "d",
+                         state_axis: str | None = None,
                          checkpoint_path: str | None = None,
                          checkpoint_every: int = 1,
                          protocol: str | None = None,
@@ -493,14 +494,18 @@ def grid_value_iteration(pm: ParamMDP, alphas, gammas, *,
     is bit-frozen (value/prog/policy passed through unchanged) while
     the rest of the grid keeps sweeping.  `mesh` shards the [G] grid
     axis via cpr_tpu.parallel.make_grid_chunk_step (G must divide the
-    axis; refused up front).  checkpoint_path/checkpoint_every give
-    per-grid-solve crash checkpoints + resume
-    (resilience.save_grid_vi_checkpoint).
+    axis; refused up front).  `state_axis` names a SECOND mesh axis to
+    shard each point's STATE space over as well (the grid x state 2-D
+    mesh, cpr_tpu.parallel.make_grid_state_chunk_step): pass a 2-D
+    mesh whose axes are (`axis`, `state_axis`); both G and n_states
+    must divide their axis, refused up front by name.
+    checkpoint_path/checkpoint_every give per-grid-solve crash
+    checkpoints + resume (resilience.save_grid_vi_checkpoint).
 
-    Emits one typed `mdp_solve` telemetry event (schema v10) with the
-    protocol/cutoff labels, grid shape, total sweeps, and per-point
-    convergence count.  Returns a dict of grid-major arrays (see
-    docs/MDP.md)."""
+    Emits one typed `mdp_solve` telemetry event (schema v13: the v10
+    fields plus `state_shards`/`halo_bytes`) with the protocol/cutoff
+    labels, grid shape, total sweeps, and per-point convergence count.
+    Returns a dict of grid-major arrays (see docs/MDP.md)."""
     import jax.numpy as jnp
 
     from cpr_tpu import telemetry
@@ -519,12 +524,30 @@ def grid_value_iteration(pm: ParamMDP, alphas, gammas, *,
     t0 = now()
     probs = np.stack([pm.revalue(a, g) for a, g in points])
     starts = np.stack([pm.start_vector(a, g) for a, g in points])
-    chunk_step, place = make_grid_chunk_step(tm, G, discount=discount,
-                                             mesh=mesh, axis=axis)
-    probs_dev = place(probs.astype(np.dtype(tm.prob.dtype)))
+    state_shards = 1
+    if state_axis is not None:
+        from cpr_tpu.parallel.state_shard import make_grid_state_chunk_step
 
-    def step(carry, frozen, steps):
-        return chunk_step(carry, probs_dev, frozen, steps)
+        if mesh is None:
+            raise ValueError(
+                "state_axis requires a 2-D mesh whose axes are "
+                f"({axis!r}, {state_axis!r}); got mesh=None")
+        state_shards = int(mesh.shape[state_axis])
+        # the composed builder closes over the probability plane (it
+        # owns its [G, n_s * t_blk] bucketed layout), so its chunk_step
+        # already has the run_grid_chunk_driver signature
+        step, place = make_grid_state_chunk_step(
+            tm, G, probs.astype(np.dtype(tm.prob.dtype)),
+            discount=discount, mesh=mesh, axis=axis,
+            state_axis=state_axis)
+    else:
+        chunk_step, place = make_grid_chunk_step(tm, G,
+                                                 discount=discount,
+                                                 mesh=mesh, axis=axis)
+        probs_dev = place(probs.astype(np.dtype(tm.prob.dtype)))
+
+        def step(carry, frozen, steps):
+            return chunk_step(carry, probs_dev, frozen, steps)
 
     value, prog, policy, delta, conv_it, converged, it, resid = \
         run_grid_chunk_driver(
@@ -539,14 +562,22 @@ def grid_value_iteration(pm: ParamMDP, alphas, gammas, *,
     den = (starts * prog).sum(axis=1)
     revenue = np.divide(num, den, out=np.zeros_like(num),
                         where=den != 0.0)
+    from cpr_tpu.parallel.state_shard import state_halo_bytes
+
+    halo = state_halo_bytes(pm.n_states, state_shards,
+                            np.dtype(tm.prob.dtype))
     telemetry.current().event(
         "mdp_solve", protocol=protocol, cutoff=cutoff,
         grid=[len(alphas), len(gammas)], sweeps=int(it),
         converged=int(converged.sum()), points=G,
         n_states=pm.n_states, n_transitions=pm.n_transitions,
-        n_devices=(int(mesh.shape[axis]) if mesh is not None else 1),
+        n_devices=(int(np.prod(list(mesh.shape.values())))
+                   if mesh is not None else 1),
+        state_shards=state_shards, halo_bytes=int(halo),
         solve_s=round(vi_time, 6),
-        points_per_sec=round(G / vi_time, 3) if vi_time > 0 else None)
+        points_per_sec=round(G / vi_time, 3) if vi_time > 0 else None,
+        states_per_sec=(round(pm.n_states * int(it) / vi_time, 3)
+                        if vi_time > 0 else None))
     return dict(
         grid_alphas=alphas, grid_gammas=gammas, grid_points=points,
         grid_value=value, grid_progress=prog, grid_policy=policy,

@@ -41,9 +41,19 @@ class _ActionTable:
 
 
 class RTDP:
+    """All exploration randomness flows through ONE explicit stream:
+    `seed` builds a private `random.Random(seed)` (never the module
+    global, so two RTDP instances — or RTDP and anything else using
+    `random` — cannot perturb each other), or pass `rng` to share /
+    control the stream directly (any object with the random.Random
+    surface: random(), randrange(), choice(), choices()).  Same seed
+    or same-state rng -> bit-identical runs; this is the deterministic
+    host oracle the in-graph port (cpr_tpu/mdp/rtdp_graph.py) is
+    value-checked against."""
+
     def __init__(self, model: Model, *, eps: float, eps_honest: float = 0.0,
                  es: float = 0.0, es_threshold: int = 500_000,
-                 state_key_fn=None, seed: int = 0):
+                 state_key_fn=None, seed: int = 0, rng=None):
         assert 0.0 <= eps <= 1.0 and 0.0 <= eps_honest <= 1.0
         assert eps + eps_honest <= 1.0 and 0.0 <= es <= 1.0
         self.model = model
@@ -53,7 +63,7 @@ class RTDP:
         self.es_threshold = es_threshold
         self._keep_full = state_key_fn is None
         self.key_of = state_key_fn or (lambda s: s)
-        self.rng = random.Random(seed)
+        self.rng = rng if rng is not None else random.Random(seed)
 
         self._idx: dict = {}  # state key -> int id
         self._full: dict = {}  # int id -> full state (kept while needed)

@@ -1,0 +1,206 @@
+"""In-graph RTDP: device-resident exploration, no host round-trips.
+
+The host RTDP (cpr_tpu/mdp/rtdp.py) walks one trajectory at a time in
+Python — every step is a dict lookup plus a numpy dot product, and the
+device sits idle.  `TensorMDP.rtdp` already batches the walk as a
+jitted `lax.scan`, but it runs a FIXED number of steps and keeps no
+exploration state beyond the value table.  This module finishes the
+port (ROADMAP item 1, "exploration stays host-bound"):
+
+* a `lax.while_loop` instead of a fixed scan — the loop watches a
+  damped residual of its own greedy backups and exits as soon as the
+  estimate stops moving (or the step budget runs out), so easy tables
+  do not pay the full budget;
+* device-resident `visits` counters — the per-state visit histogram
+  comes back with the values (coverage diagnostics, and the natural
+  prioritization signal for downstream sweeps);
+* a fixed-capacity priority buffer of the highest-|delta| states seen
+  so far (top-k merge per step, the in-graph analog of the host
+  RTDP's exploring-starts buffer): restarting lanes resume from a
+  buffered high-error state with probability `restart_p` instead of
+  always re-rolling the start distribution, which focuses the batch
+  on the frontier where the estimate is still wrong;
+* `rtdp_sharded_polish` — the capstone handoff: run the in-graph
+  exploration, then feed its table to the state-sharded exact VI
+  (cpr_tpu.parallel.sharded_state_value_iteration value0/progress0)
+  so the final fixpoint is exact while the sharded sweeps start from
+  a near-converged estimate.
+
+Same transition layout as `TensorMDP.rtdp` (`padded_layout()`s
+[S*A, K] tables) and the same masked `_greedy_backup`, so the
+per-visited-state math is identical to the scan version and to the
+exact sweeps.  All sampling flows from the single `key` argument —
+bit-reproducible across calls by construction.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cpr_tpu.mdp.explicit import TensorMDP, _greedy_backup
+from cpr_tpu.telemetry import now
+
+__all__ = ["rtdp_graph", "rtdp_sharded_polish"]
+
+
+@partial(jax.jit, static_argnums=(4, 5, 6, 7, 8))
+def _rtdp_graph_loop(Tdst, Tpack, start_cdf, key, S, A, max_steps,
+                     batch, cap, eps, restart_p, discount, stop_delta,
+                     decay, value0, prog0):
+    """The while_loop program: `batch` eps-greedy walkers, greedy
+    Bellman backups on every visited state, visit counters, and a
+    top-k priority buffer feeding restarts.  Stops when the damped
+    backup residual falls to `stop_delta` or after `max_steps`."""
+    Tprob = Tpack[..., 0]
+    valid_a = Tprob.reshape(S, A, -1).sum(-1) > 0  # [S, A]
+    any_valid = valid_a.any(-1)  # [S]
+    B = batch
+    bi = jnp.arange(B)
+
+    def draw_start(k):
+        # inverse-CDF draw, exactly as _rtdp_loop (explicit.py)
+        u = jax.random.uniform(k, (B,)) * start_cdf[-1]
+        return jnp.clip(jnp.searchsorted(start_cdf, u, side="right"),
+                        0, S - 1).astype(jnp.int32)
+
+    def cond(carry):
+        _, _, _, _, _, _, _, t, resid = carry
+        return (t < max_steps) & (resid > stop_delta)
+
+    def body(carry):
+        V, P, visits, buf_s, buf_pri, s, k, t, resid = carry
+        k, k1, k2, k3, k4, k5, k6 = jax.random.split(k, 7)
+        rows = s[:, None] * A + jnp.arange(A)  # [B, A]
+        dstb = Tdst[rows]  # [B, A, K]
+        packb = Tpack[rows]
+        probb, rewb, prgb = packb[..., 0], packb[..., 1], packb[..., 2]
+        q = (probb * (rewb + discount * V[dstb])).sum(-1)  # [B, A]
+        qp = (probb * (prgb + discount * P[dstb])).sum(-1)
+        va = valid_a[s]
+        has_a = any_valid[s]
+        newv, newp, a_greedy = _greedy_backup(q, qp, va, has_a)
+        delta_lane = jnp.abs(newv - V[s])  # [B]
+        V = V.at[s].set(newv)
+        P = P.at[s].set(newp)
+        visits = visits.at[s].add(1)
+        # top-k merge of this step's |delta|s into the priority buffer
+        # (duplicate state ids are harmless: a stale entry just
+        # restarts a lane somewhere the estimate RECENTLY moved)
+        all_pri = jnp.concatenate([buf_pri, delta_lane])
+        all_s = jnp.concatenate([buf_s, s])
+        buf_pri, top = jax.lax.top_k(all_pri, cap)
+        buf_s = all_s[top]
+        # eps-greedy behavior action over the valid set
+        a_rand = jax.random.categorical(
+            k1, jnp.where(va, 0.0, -jnp.inf), axis=-1)
+        a_beh = jnp.where(jax.random.uniform(k2, (B,)) < eps,
+                          a_rand, a_greedy)
+        a_beh = jnp.where(has_a, a_beh, 0)
+        prow = probb[bi, a_beh]  # [B, K]; padding prob 0 ~ never drawn
+        nxt = jax.random.categorical(k3, jnp.log(prow + 1e-30), axis=-1)
+        s_next = dstb[bi, a_beh, nxt]
+        # restarts: terminal/action-less lanes resume from a buffered
+        # high-error state w.p. restart_p, else from the start CDF
+        filled = buf_pri > 0.0
+        logits = jnp.where(filled, 0.0, -jnp.inf)
+        logits = jnp.where(filled.any(), logits, jnp.zeros_like(logits))
+        pick = buf_s[jax.random.categorical(k4, logits, shape=(B,))]
+        use_buf = (jax.random.uniform(k5, (B,)) < restart_p) & filled.any()
+        restart = jnp.where(use_buf, pick, draw_start(k6))
+        s_next = jnp.where(any_valid[s_next] & has_a, s_next, restart)
+        # damped running peak; the inf sentinel (step 0) is replaced
+        # outright or it would stay inf forever and disable early exit
+        resid = jnp.maximum(jnp.where(jnp.isinf(resid), 0.0,
+                                      resid * decay),
+                            delta_lane.max())
+        return (V, P, visits, buf_s, buf_pri, s_next, k, t + 1, resid)
+
+    key, k0 = jax.random.split(key)
+    carry0 = (value0, prog0, jnp.zeros(S, jnp.int32),
+              jnp.zeros(cap, jnp.int32),
+              jnp.full(cap, -jnp.inf, value0.dtype),
+              draw_start(k0), key, jnp.int32(0),
+              jnp.asarray(jnp.inf, value0.dtype))
+    V, P, visits, buf_s, buf_pri, _, _, t, resid = jax.lax.while_loop(
+        cond, body, carry0)
+    return V, P, visits, buf_s, buf_pri, t, resid
+
+
+def rtdp_graph(tm: TensorMDP, key, *, max_steps: int, batch: int = 256,
+               buffer: int = 1024, eps: float = 0.2,
+               restart_p: float = 0.5, discount: float = 1.0,
+               stop_delta: float = 0.0, decay: float = 0.95,
+               value0=None, progress0=None) -> dict:
+    """In-graph RTDP over a compiled TensorMDP (module docstring).
+
+    `stop_delta` > 0 enables early exit: the loop tracks
+    `resid = max(resid * decay, <this step's max backup delta>)` — a
+    damped running peak, so one quiet step cannot stop a loop that is
+    still finding new states — and exits when it drops below the
+    threshold.  At the default 0.0 the loop runs exactly `max_steps`
+    steps (matching `TensorMDP.rtdp`'s fixed budget).
+
+    Returns dict(rtdp_value, rtdp_progress, rtdp_visits, rtdp_buffer
+    (the [buffer] highest-|delta| state ids, -1 where unfilled),
+    rtdp_steps (steps actually run), rtdp_resid, rtdp_time)."""
+    assert max_steps > 0 and batch > 0 and buffer > 0
+    assert 0.0 <= eps <= 1.0 and 0.0 <= restart_p <= 1.0
+    assert 0.0 < decay < 1.0
+    tm._check_segment_width()  # rows index by s*A+a in int32 too
+    Tdst, Tpack, _ = tm.padded_layout()
+    dtype = tm.prob.dtype
+    start_cdf = jnp.cumsum(jnp.asarray(tm.start, dtype))
+    z = jnp.zeros(tm.n_states, dtype)
+    v0 = z if value0 is None else jnp.asarray(value0, dtype)
+    p0 = z if progress0 is None else jnp.asarray(progress0, dtype)
+    t0 = now()
+    V, P, visits, buf_s, buf_pri, t, resid = _rtdp_graph_loop(
+        Tdst, Tpack, start_cdf, key, tm.n_states, tm.n_actions,
+        max_steps, batch, buffer, jnp.asarray(eps, dtype),
+        jnp.asarray(restart_p, dtype), jnp.asarray(discount, dtype),
+        jnp.asarray(stop_delta, dtype), jnp.asarray(decay, dtype),
+        v0, p0)
+    buf = np.where(np.asarray(buf_pri) > 0.0, np.asarray(buf_s), -1)
+    return dict(rtdp_value=np.asarray(V), rtdp_progress=np.asarray(P),
+                rtdp_visits=np.asarray(visits), rtdp_buffer=buf,
+                rtdp_steps=int(t), rtdp_resid=float(resid),
+                rtdp_batch=batch, rtdp_time=now() - t0)
+
+
+def rtdp_sharded_polish(tm: TensorMDP, mesh, key, *, rtdp_steps: int,
+                        batch: int = 256, buffer: int = 1024,
+                        eps: float = 0.2, restart_p: float = 0.5,
+                        rtdp_stop_delta: float = 0.0,
+                        discount: float = 1.0,
+                        stop_delta: float | None = None,
+                        vi_eps: float | None = None, max_iter: int = 0,
+                        axis: str = "d", chunk: int = 64,
+                        pad_states: bool = False,
+                        checkpoint_path: str | None = None,
+                        checkpoint_every: int = 1,
+                        protocol: str | None = None,
+                        cutoff: int | None = None) -> dict:
+    """Explore in-graph, polish exactly: `rtdp_graph` hands its
+    partially-converged (value, progress) table to the state-sharded
+    chunked VI as a warm start, so the exact solve starts sweeps from
+    a near-fixpoint instead of zero.  Same return dict as
+    `sharded_state_value_iteration` plus the rtdp_* diagnostics
+    (prefixed as returned by rtdp_graph)."""
+    from cpr_tpu.parallel import sharded_state_value_iteration
+
+    r = rtdp_graph(tm, key, max_steps=rtdp_steps, batch=batch,
+                   buffer=buffer, eps=eps, restart_p=restart_p,
+                   discount=discount, stop_delta=rtdp_stop_delta)
+    vi = sharded_state_value_iteration(
+        tm, mesh, axis=axis, max_iter=max_iter, discount=discount,
+        eps=vi_eps, stop_delta=stop_delta, chunk=chunk,
+        checkpoint_path=checkpoint_path,
+        checkpoint_every=checkpoint_every,
+        value0=r["rtdp_value"], progress0=r["rtdp_progress"],
+        pad_states=pad_states, protocol=protocol, cutoff=cutoff)
+    vi.update((k, v) for k, v in r.items() if k.startswith("rtdp_"))
+    return vi

@@ -42,6 +42,10 @@ from cpr_tpu.mdp.explicit import (TensorMDP, _valid_actions,
 from cpr_tpu.parallel.grid import make_grid_chunk_step
 from cpr_tpu.parallel.lanes import (ShardedLaneFns, check_even_shards,
                                     make_sharded_lane_fns)
+from cpr_tpu.parallel.state_shard import (make_grid_state_chunk_step,
+                                          partition_by_state_block,
+                                          sharded_state_value_iteration,
+                                          state_halo_bytes)
 from cpr_tpu.telemetry import now
 
 
@@ -61,7 +65,11 @@ __all__ = [
     "default_mesh",
     "shard_envs",
     "sharded_value_iteration",
+    "sharded_state_value_iteration",
     "make_grid_chunk_step",
+    "make_grid_state_chunk_step",
+    "partition_by_state_block",
+    "state_halo_bytes",
     "make_sharded_rollout_fn",
     "sharded_rollout",
     "make_sharded_lane_fns",

@@ -1,0 +1,370 @@
+"""State-axis sharded Bellman backups — VI past one device's memory.
+
+`sharded_value_iteration` (transition sharding) pays a psum of the
+full [S*A] Q planes per sweep and still keeps every plane replicated,
+so one (alpha, gamma) point is capped by ONE lane's memory.  This
+module shards the STATE axis instead: each device owns a contiguous
+block of S/n states plus exactly the transitions that leave it, runs
+the per-block segment-sum backup locally, and per sweep exchanges only
+the [S] value/progress vectors (a tiled all_gather of the per-block
+slices — the boundary "halo" every shard's `value[dst]` gather reads).
+Per-shard memory drops from O(T + S*A) to O(T/n + S*A/n + S); the
+collective traffic per sweep is 2*(S - S/n)*itemsize per device
+instead of 2*S*A.
+
+Bit-identity by construction: every (state, action) segment lies
+wholly in one shard with its transitions in the original relative
+order, so each partial sum, each greedy argmax row, and the gathered
+[S] iterate are the same floats the single-device `impl="chunked"`
+solve produces — `tests/test_state_shard.py` pins fc16/aft20/ghostdag
+at 1 vs 4 forced-CPU devices, including through kill@vi_chunk+resume.
+
+Chunked impl only: the host chunk seam (explicit.run_chunk_driver) is
+what provides checkpoint/resume and fault retries, and the carry
+(value, prog) is a replicated full-[S] pair at every chunk boundary,
+so the checkpoint format is identical to the single-device driver's.
+`impl="while"` is refused by name.  The grid axis composes: see
+`make_grid_state_chunk_step` (grid x state 2-D mesh — PR 13's [G]
+plane sharding with each point's backup itself state-sharded).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from functools import partial
+
+from cpr_tpu.mdp.explicit import (TensorMDP, _greedy_backup,
+                                  _valid_actions, check_vi_working_set,
+                                  make_vi_sweep, resolve_vi_impl,
+                                  run_chunk_driver, vi_residuals_event)
+from cpr_tpu.parallel.lanes import check_even_shards
+
+__all__ = [
+    "partition_by_state_block",
+    "sharded_state_value_iteration",
+    "make_grid_state_chunk_step",
+    "state_halo_bytes",
+]
+
+
+def partition_by_state_block(tm: TensorMDP, n: int,
+                             S_pad: int | None = None):
+    """Bucket the COO transition columns by source-state block.
+
+    Block b of `n` owns states [b*S/n, (b+1)*S/n); every transition is
+    routed to its source's block with src LOCALIZED (src - block
+    start), blocks are padded to the max block length with inert rows
+    (prob 0, src = S/n — the local segment id lands out of range and
+    the scatter-add drops it, so padding cannot even flip a -0.0), and
+    the padded blocks are concatenated so `PartitionSpec(axis)` hands
+    shard b exactly its block.
+
+    Frontier-compiled MDPs arrive pre-bucketed — FrontierCompiler
+    assigns state ids in BFS discovery order and emits each round's
+    transitions with nondecreasing src, so the bucketing permutation
+    degenerates to a split (no argsort pass).
+
+    Returns `(cols, slot, t_blk)`: cols the six [n*t_blk] numpy
+    columns (src_local, act, dst, prob, reward, progress), `slot` the
+    destination index of each original transition inside the padded
+    layout (callers with per-point probability planes — the grid
+    solver — scatter their [G, T] columns through it), and `t_blk`
+    the per-shard padded transition count.
+
+    `S_pad` (a multiple of n, >= n_states) blocks over an internally
+    padded state space: the pad states own no transitions (so they
+    back up to value 0 / policy -1 — inert) and callers slice the
+    gathered vectors back to [n_states].  This is how `pad_states=True`
+    entry points solve state counts that do not divide the mesh.
+    """
+    S = S_pad if S_pad is not None else tm.n_states
+    if S % n or S < tm.n_states:
+        raise ValueError(
+            f"cannot shard {S} states into {n} blocks: {S} % {n} = "
+            f"{S % n}")
+    s_blk = S // n
+    src = np.asarray(tm.src, np.int64)
+    T = src.shape[0]
+    blk = src // s_blk
+    counts = np.bincount(blk, minlength=n)
+    t_blk = max(int(counts.max()), 1) if T else 1
+    if np.all(src[1:] >= src[:-1]):
+        order = np.arange(T)  # pre-bucketed (frontier compiles)
+    else:
+        order = np.argsort(blk, kind="stable")
+    starts = np.zeros(n, np.int64)
+    starts[1:] = np.cumsum(counts)[:-1]
+    blk_o = blk[order]
+    slot_o = blk_o * t_blk + (np.arange(T) - starts[blk_o])
+    slot = np.empty(T, np.int64)
+    slot[order] = slot_o
+    src_local = np.full(n * t_blk, s_blk, np.int32)  # pad: out of range
+    src_local[slot] = (src - blk * s_blk).astype(np.int32)
+    cols = [src_local]
+    for col, fill, dt in ((tm.act, 0, np.int32), (tm.dst, 0, np.int32),
+                          (tm.prob, 0.0, None), (tm.reward, 0.0, None),
+                          (tm.progress, 0.0, None)):
+        a = np.asarray(col)
+        out = np.full(n * t_blk, fill, dt or a.dtype)
+        out[slot] = a
+        cols.append(out)
+    return tuple(cols), slot, t_blk
+
+
+def state_halo_bytes(S: int, n: int, dtype) -> int:
+    """Bytes of value+progress crossing device boundaries per sweep:
+    each of the n shards all-gathers the (S - S/n) remote entries of
+    both vectors (the policy gather happens once per chunk — noise)."""
+    if n <= 1:
+        return 0
+    return 2 * (S - S // n) * np.dtype(dtype).itemsize * n
+
+
+def sharded_state_value_iteration(tm: TensorMDP, mesh, *,
+                                  axis: str = "d", max_iter: int = 0,
+                                  discount: float = 1.0,
+                                  eps: float | None = None,
+                                  stop_delta: float | None = None,
+                                  impl: str | None = None,
+                                  chunk: int = 64,
+                                  checkpoint_path: str | None = None,
+                                  checkpoint_every: int = 1,
+                                  value0=None, progress0=None,
+                                  pad_states: bool = False,
+                                  protocol: str | None = None,
+                                  cutoff: int | None = None):
+    """Value iteration with the STATE axis sharded over the mesh —
+    same dict, same fixpoint, bit-identical to
+    `TensorMDP.value_iteration(impl="chunked")` (see module
+    docstring).  `value0`/`progress0` warm-start the solve (the
+    in-graph RTDP handoff — cpr_tpu/mdp/rtdp_graph.py); a resumable
+    checkpoint overrides a warm start.  `protocol`/`cutoff` label the
+    emitted `mdp_solve` telemetry event (schema v13: `state_shards`,
+    `halo_bytes`, `states_per_sec` ride as extras).
+
+    State counts that do not divide the mesh are refused up front by
+    name (check_even_shards) unless `pad_states=True`, which blocks
+    over an internally padded state space — the pad states own no
+    transitions, are never a destination, and are sliced off before
+    return, so the real-state fixpoint stays bit-identical (padded
+    entries back up to exactly 0 and cannot move the sweep delta).
+
+    Chunked impl only — `impl="while"` is refused: the host chunk
+    seam is what carries kill@vi_chunk retries and checkpoint/resume
+    through the sharded path, and a mesh program with no host seam
+    would lose both.  The CPR_VI_IMPL env default does not apply
+    here; an explicit impl other than "chunked" raises.
+    """
+    from cpr_tpu import telemetry
+
+    impl = resolve_vi_impl(impl or "chunked")
+    if impl != "chunked":
+        raise ValueError(
+            "state-sharded VI requires impl='chunked': the host "
+            "between-chunk seam is what provides checkpoint/resume "
+            "and fault retries; the while impl is a single device "
+            "program with no such seam (use "
+            "cpr_tpu.parallel.sharded_value_iteration for a "
+            "transition-sharded while solve)")
+    stop_delta = tm.resolve_stop_delta(
+        discount=discount, eps=eps, stop_delta=stop_delta,
+        max_iter=max_iter)
+    tm._check_segment_width()
+    S, A = tm.n_states, tm.n_actions
+    n = mesh.shape[axis]
+    if pad_states:
+        S_pad = S + (-S % n)
+    else:
+        check_even_shards(S, mesh, axis=axis, what="states")
+        S_pad = S
+    t0 = telemetry.now()
+    (src_l, act, dst, prob, reward, progress), _, t_blk = \
+        partition_by_state_block(tm, n, S_pad)
+    check_vi_working_set(t_blk, S_pad, A, tm.prob.dtype, shards=n)
+    s_blk = S_pad // n
+    sweep = make_vi_sweep(s_blk, A)  # local src ids: the same math
+    disc = jnp.asarray(discount, tm.prob.dtype)
+    cols = tuple(jnp.asarray(c) for c in
+                 (src_l, act, dst, prob, reward, progress))
+
+    def make_chunk_fn(steps: int):
+        def body(src_l, act, dst, prob, reward, progress, value, prog):
+            valid, any_valid = _valid_actions(src_l, act, prob, s_blk, A)
+
+            def sweep_step(carry, _):
+                value, prog, _ = carry
+                v_blk, p_blk, pol_blk = sweep(
+                    src_l, act, dst, prob, reward, progress, valid,
+                    any_valid, disc, value, prog)
+                v2 = jax.lax.all_gather(v_blk, axis, tiled=True)
+                p2 = jax.lax.all_gather(p_blk, axis, tiled=True)
+                return (v2, p2, pol_blk), jnp.abs(v2 - value).max()
+
+            pol0 = jnp.full((s_blk,), -1, jnp.int32)
+            (v, p, pol_blk), deltas = jax.lax.scan(
+                sweep_step, (value, prog, pol0), None, length=steps)
+            pol = jax.lax.all_gather(pol_blk, axis, tiled=True)
+            return v, p, pol, deltas
+
+        return body
+
+    from cpr_tpu.parallel import _shard_map
+
+    @partial(jax.jit, static_argnums=(2,), donate_argnums=(0, 1))
+    def chunk_fn(value, prog, steps):
+        return _shard_map(
+            make_chunk_fn(steps), mesh=mesh,
+            in_specs=(P(axis),) * 6 + (P(), P()),
+            out_specs=(P(), P(), P(), P()),
+            check_vma=False,
+        )(*cols, value, prog)
+
+    max_iter_ = max_iter if max_iter > 0 else (1 << 30)
+
+    def pad0(x):
+        if x is None or S_pad == S:
+            return x
+        return np.concatenate([np.asarray(x),
+                               np.zeros(S_pad - S, np.asarray(x).dtype)])
+
+    value, progress_v, policy, delta, it, resid = run_chunk_driver(
+        chunk_fn, S_pad, tm.prob.dtype, stop_delta, max_iter_, chunk,
+        checkpoint_path=checkpoint_path,
+        checkpoint_every=checkpoint_every,
+        value0=pad0(value0), prog0=pad0(progress0))
+    resid = vi_residuals_event(impl, int(it), resid, stop_delta, delta)
+    vi_time = telemetry.now() - t0
+    halo = state_halo_bytes(S_pad, n, tm.prob.dtype)
+    telemetry.current().event(
+        "mdp_solve", protocol=protocol, cutoff=cutoff, grid=[1, 1],
+        sweeps=int(it), converged=int(float(delta) <= float(stop_delta)),
+        points=1, n_states=S, n_transitions=int(np.asarray(tm.src).shape[0]),
+        n_devices=int(n), state_shards=int(n), halo_bytes=int(halo),
+        solve_s=round(vi_time, 6),
+        states_per_sec=(round(S * int(it) / vi_time, 3)
+                        if vi_time > 0 else None))
+    return dict(
+        vi_discount=discount,
+        vi_delta=float(delta),
+        vi_stop_delta=stop_delta,
+        vi_policy=np.asarray(policy)[:S],
+        vi_value=np.asarray(value)[:S],
+        vi_progress=np.asarray(progress_v)[:S],
+        vi_iter=int(it),
+        vi_max_iter=max_iter,
+        vi_residuals=resid,
+        vi_time=vi_time,
+        vi_state_shards=int(n),
+        vi_halo_bytes=int(halo),
+    )
+
+
+def make_grid_state_chunk_step(tm: TensorMDP, G: int, probs, *,
+                               discount, mesh, axis: str = "g",
+                               state_axis: str = "s"):
+    """Grid x state 2-D mesh chunk step: PR 13's [G] grid-plane
+    sharding with each point's Bellman backup itself state-sharded.
+
+    The [G, T] probability plane is bucketed through the state
+    partition's `slot` map and sharded over BOTH axes; each (g, s)
+    shard computes its [t_blk, G_blk] contribution columns and runs
+    ONE segment-sum over the transition axis (a vmap over the grid
+    axis would wrap the collective — transposing keeps the gather and
+    the scatter-add a single 2-D program), then all-gathers only its
+    [G_blk, s_blk] value/progress slices along the state axis.  The
+    greedy backup (pure per-state math) is vmapped over G_blk.
+
+    Same bit-freezing contract as explicit.make_grid_vi_chunk: frozen
+    points pass their carry through unchanged and report delta 0, so
+    each point's fixpoint equals the 1-D grid solve (and the solo
+    chunked solve) bit-for-bit.
+
+    Returns `(chunk_step, place)` with the run_grid_chunk_driver
+    calling convention — `chunk_step(carry, frozen, steps)`, `place`
+    putting [G, ...] grid-major host arrays under the grid sharding
+    (probs is placed internally, once).
+    """
+    from cpr_tpu.parallel import _shard_map
+
+    S, A = tm.n_states, tm.n_actions
+    n_g = mesh.shape[axis]
+    n_s = mesh.shape[state_axis]
+    check_even_shards(G, mesh, axis=axis, what="grid points")
+    check_even_shards(S, mesh, axis=state_axis, what="states")
+    (src_l, act, dst, prob_probe, reward, progress), slot, t_blk = \
+        partition_by_state_block(tm, n_s)
+    check_vi_working_set(t_blk, S, A, tm.prob.dtype, shards=n_s)
+    s_blk = S // n_s
+    probs = np.asarray(probs)
+    probs_b = np.zeros((G, n_s * t_blk), probs.dtype)
+    probs_b[:, slot] = probs
+    gshard = NamedSharding(mesh, P(axis))
+    rep_t = NamedSharding(mesh, P(state_axis))
+    probs_dev = jax.device_put(probs_b,
+                               NamedSharding(mesh, P(axis, state_axis)))
+    consts = tuple(jax.device_put(jnp.asarray(c), rep_t)
+                   for c in (src_l, act, dst, reward, progress))
+    disc = float(discount)
+
+    def place(x):
+        return jax.device_put(x, gshard)
+
+    def body(value, prog, pol, frozen, probs, src_l, act, dst, reward,
+             progress, steps):
+        # local shapes: value/prog/pol [G_blk, S], frozen [G_blk],
+        # probs [G_blk, t_blk], transition columns [t_blk]
+        seg = src_l * jnp.int32(A) + act
+        nseg = s_blk * A
+        mass = jax.ops.segment_sum(
+            jnp.where(probs > 0, 1.0, 0.0).T, seg, num_segments=nseg)
+        valid = mass.T.reshape(-1, s_blk, A) > 0  # [G_blk, s_blk, A]
+        any_valid = valid.any(-1)
+
+        def sweep_step(carry, _):
+            value, prog, _ = carry
+            qv = jax.ops.segment_sum(
+                (probs * (reward + disc * value[:, dst])).T, seg,
+                num_segments=nseg).T.reshape(-1, s_blk, A)
+            qp = jax.ops.segment_sum(
+                (probs * (progress + disc * prog[:, dst])).T, seg,
+                num_segments=nseg).T.reshape(-1, s_blk, A)
+            v_blk, p_blk, pol_blk = jax.vmap(_greedy_backup)(
+                qv, qp, valid, any_valid)
+            v2 = jax.lax.all_gather(v_blk, state_axis, axis=1,
+                                    tiled=True)
+            p2 = jax.lax.all_gather(p_blk, state_axis, axis=1,
+                                    tiled=True)
+            delta = jnp.abs(v2 - value).max(axis=1)
+            return (v2, p2, pol_blk), delta
+
+        pol0 = jnp.full(value.shape[:1] + (s_blk,), -1, jnp.int32)
+        (v2, p2, pol_blk), deltas = jax.lax.scan(
+            sweep_step, (value, prog, pol0), None, length=steps)
+        pol2 = jax.lax.all_gather(pol_blk, state_axis, axis=1,
+                                  tiled=True)
+        fz = frozen[:, None]
+        v2 = jnp.where(fz, value, v2)
+        p2 = jnp.where(fz, prog, p2)
+        pol2 = jnp.where(fz, pol, pol2)
+        deltas = jnp.where(fz, 0.0, deltas.T)  # -> [G_blk, steps]
+        return (v2, p2, pol2), deltas
+
+    def chunk(carry, frozen, steps):
+        value, prog, pol = carry
+        return _shard_map(
+            partial(body, steps=steps), mesh=mesh,
+            in_specs=(P(axis), P(axis), P(axis), P(axis),
+                      P(axis, state_axis)) + (P(state_axis),) * 5,
+            out_specs=((P(axis), P(axis), P(axis)), P(axis)),
+            check_vma=False,
+        )(value, prog, pol, frozen, probs_dev, *consts)
+
+    chunk_step = jax.jit(chunk, static_argnums=(2,),
+                         donate_argnums=(0,),
+                         in_shardings=(gshard, gshard),
+                         out_shardings=(gshard, gshard))
+    return chunk_step, place

@@ -132,16 +132,19 @@ def gate_row(candidate: dict, history, *, top_k: int = TOP_K,
         # prevent, so an off-count candidate with no same-count
         # history passes as a first measurement instead.  Same logic
         # for cfg_workers (frontier compiles: a 1-worker rate must
-        # never gate a 4-worker one).
+        # never gate a 4-worker one) and cfg_state_shards (state-
+        # sharded VI: the per-sweep halo exchange alone moves the
+        # sweep rate across shard counts).
         devs = lambda r: ((r.get("config") or {}).get("cfg_devices", 1),  # noqa: E731
-                          (r.get("config") or {}).get("cfg_workers", 1))
+                          (r.get("config") or {}).get("cfg_workers", 1),
+                          (r.get("config") or {}).get("cfg_state_shards", 1))
         pool = [r for r in pool if devs(r) == devs(candidate)]
         if not pool:
-            dd, dw = devs(candidate)
+            dd, dw, ds = devs(candidate)
             result["reason"] = (
-                "no same-device/worker-count baseline banked yet "
-                f"(first measurement at cfg_devices={dd}, "
-                f"cfg_workers={dw})")
+                "no same-device/worker/state-shard-count baseline "
+                f"banked yet (first measurement at cfg_devices={dd}, "
+                f"cfg_workers={dw}, cfg_state_shards={ds})")
             return result
         result["config_drift"] = True
     lower = direction == "lower"

@@ -291,10 +291,12 @@ def iter_trace_rows(path: str):
                 # points/sec throughput and per-point solve latency
                 # (`_s` suffix: lower-is-better via metric_direction),
                 # fingerprinted by protocol/cutoff/grid shape and the
-                # solve's own device count
-                pps = e.get("points_per_sec")
-                if not isinstance(pps, (int, float)):
-                    continue
+                # solve's own device count.  v13 adds state-sharded
+                # solves: `state_shards` joins the fingerprint (only
+                # when the event carries it, so pre-v13 row ids are
+                # unchanged) and `states_per_sec` banks as its own
+                # metric — a 1-shard sweep rate never gates against a
+                # 4-shard one (the halo traffic alone moves it)
                 grid = e.get("grid") or []
                 mdp_cfg = {
                     **{f"cfg_{k}": v for k, v in config.items()},
@@ -305,17 +307,31 @@ def iter_trace_rows(path: str):
                 nd = e.get("n_devices")
                 if isinstance(nd, (int, float)) and nd:
                     mdp_cfg["cfg_devices"] = int(nd)
-                yield ({"metric": "mdp_grid_points_per_sec",
-                        "backend": backend, "value": pps,
-                        "unit": "grid-points/sec", **mdp_cfg}, base)
-                solve_s = e.get("solve_s")
-                points = e.get("points")
-                if (isinstance(solve_s, (int, float))
-                        and isinstance(points, int) and points > 0):
-                    yield ({"metric": "mdp_grid_point_solve_s",
-                            "backend": backend,
-                            "value": round(solve_s / points, 6),
-                            "unit": "seconds", **mdp_cfg}, base)
+                # absent key fingerprints the same as 1 shard (the
+                # gate's .get default), so unsharded rows banked
+                # before v13 keep their row ids
+                ns = e.get("state_shards")
+                if isinstance(ns, (int, float)) and int(ns) > 1:
+                    mdp_cfg["cfg_state_shards"] = int(ns)
+                pps = e.get("points_per_sec")
+                if isinstance(pps, (int, float)):
+                    yield ({"metric": "mdp_grid_points_per_sec",
+                            "backend": backend, "value": pps,
+                            "unit": "grid-points/sec", **mdp_cfg},
+                           base)
+                    solve_s = e.get("solve_s")
+                    points = e.get("points")
+                    if (isinstance(solve_s, (int, float))
+                            and isinstance(points, int) and points > 0):
+                        yield ({"metric": "mdp_grid_point_solve_s",
+                                "backend": backend,
+                                "value": round(solve_s / points, 6),
+                                "unit": "seconds", **mdp_cfg}, base)
+                sps = e.get("states_per_sec")
+                if isinstance(sps, (int, float)):
+                    yield ({"metric": "mdp_states_per_sec",
+                            "backend": backend, "value": sps,
+                            "unit": "states/sec", **mdp_cfg}, base)
             elif (e.get("kind") == "event"
                   and e.get("name") == "mdp_compile"):
                 # schema v12: frontier-batched MDP compiles bank their

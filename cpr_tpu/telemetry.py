@@ -54,7 +54,7 @@ from contextlib import contextmanager
 from datetime import datetime, timezone
 from time import perf_counter as now  # noqa: F401 — re-exported
 
-SCHEMA_VERSION = 12
+SCHEMA_VERSION = 13
 TELEMETRY_ENV_VAR = "CPR_TELEMETRY"
 # trace context: one run id per process tree, exported so supervisor
 # children and serve clients land their events under the same id
@@ -133,6 +133,13 @@ EVENT_FIELDS = {
     # ride free-form: points, n_states, n_transitions, n_devices,
     # solve_s, points_per_sec (the ledger lifts the rate via
     # iter_trace_rows-style banking in tools/mdp_smoke.py).
+    # v13: state-sharded solves (cpr_tpu/parallel/state_shard.py, and
+    # grid_value_iteration's grid x state 2-D mesh) extend the extras
+    # with state_shards (mesh size along the state axis, 1 when
+    # unsharded), halo_bytes (per-sweep boundary-exchange traffic,
+    # state_halo_bytes), and states_per_sec (n_states * sweeps /
+    # solve_s — the ledger banks it as mdp_states_per_sec,
+    # fingerprinted by cfg_state_shards).
     "mdp_solve": ("protocol", "cutoff", "grid", "sweeps", "converged"),
     # v11: one per adversary-in-the-network sweep
     # (cpr_tpu/netsim/attack.py AttackEngine.run): lanes counts the

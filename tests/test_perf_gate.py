@@ -303,6 +303,68 @@ def test_gate_drift_fallback_never_crosses_device_counts():
     assert res["verdict"] == "fail"
 
 
+def test_gate_drift_fallback_never_crosses_state_shard_counts():
+    """v13 twin of the device-count rule: a state-sharded VI rate
+    (cfg_state_shards, state_shard.py) pays per-sweep halo traffic a
+    1-shard solve does not, so the drift fallback must never judge a
+    4-shard candidate against 1-shard history (or vice versa)."""
+    one_shard = [_row(metric="mdp_states_per_sec", value=100.0, rnd=i,
+                      cfg_protocol="fc16") for i in range(3)]
+    # 60% below the 1-shard trail but at 4 state shards: first
+    # measurement, with the shard count named in the reason
+    res = perf.gate_row(_row(metric="mdp_states_per_sec", value=40.0,
+                             cfg_state_shards=4, cfg_protocol="fc16"),
+                        one_shard)
+    assert res["verdict"] == "pass"
+    assert res["baseline"] is None and not res["config_drift"]
+    assert "cfg_state_shards=4" in res["reason"]
+    # once 4-shard history exists, an off-fingerprint 4-shard
+    # candidate drifts against THAT pool, never the 1-shard rows
+    mixed = one_shard + [_row(metric="mdp_states_per_sec", value=40.0,
+                              rnd=9, cfg_state_shards=4,
+                              cfg_protocol="fc16")]
+    res = perf.gate_row(_row(metric="mdp_states_per_sec", value=38.0,
+                             cfg_state_shards=4, cfg_protocol="aft20"),
+                        mixed)
+    assert res["verdict"] == "pass" and res["config_drift"]
+    assert res["baseline"]["median"] == 40.0
+    # and a genuine same-shard-count regression still fails
+    res = perf.gate_row(_row(metric="mdp_states_per_sec", value=10.0,
+                             cfg_state_shards=4, cfg_protocol="aft20"),
+                        mixed)
+    assert res["verdict"] == "fail"
+
+
+def test_mdp_solve_state_shards_lift_into_ledger(tmp_path):
+    """iter_trace_rows, v13: an mdp_solve event carrying state_shards
+    + states_per_sec banks an mdp_states_per_sec row fingerprinted by
+    cfg_state_shards; an unsharded event (state_shards 1 or absent)
+    yields rows WITHOUT the key, so pre-v13 row ids are unchanged."""
+    trace = tmp_path / "t.jsonl"
+    base = {"kind": "event", "name": "mdp_solve", "protocol": "fc16",
+            "cutoff": 6, "grid": [1, 1], "sweeps": 640, "converged": 1,
+            "points": 1, "solve_s": 2.0, "points_per_sec": 0.5}
+    events = [
+        {"kind": "manifest", "backend": "cpu", "config": {}},
+        {**base, "n_devices": 4, "state_shards": 4,
+         "halo_bytes": 1024, "states_per_sec": 5000.0},
+        {**base, "state_shards": 1, "states_per_sec": 9000.0},
+    ]
+    trace.write_text("".join(json.dumps(e) + "\n" for e in events))
+    rows = [perf.normalize_row(row, source=src, rnd=i)
+            for i, (row, src) in
+            enumerate(perf.iter_trace_rows(str(trace)))]
+    sps = [r for r in rows if r["metric"] == "mdp_states_per_sec"]
+    assert len(sps) == 2
+    sharded = [r for r in sps if r["value"] == 5000.0][0]
+    solo = [r for r in sps if r["value"] == 9000.0][0]
+    assert sharded["config"]["cfg_state_shards"] == 4
+    assert sharded["config"]["cfg_devices"] == 4
+    assert sharded["unit"] == "states/sec"
+    assert "cfg_state_shards" not in solo["config"]
+    assert sharded["fingerprint"] != solo["fingerprint"]
+
+
 def test_perf_report_scaling_table(tmp_path, capsys):
     """scaling_groups: rows split only by cfg_devices group into one
     scaling view with direction-aware best, speedup vs the smallest

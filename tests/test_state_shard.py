@@ -1,0 +1,391 @@
+"""State-sharded Bellman backups + in-graph RTDP (PR 16).
+
+The acceptance contract of cpr_tpu/parallel/state_shard.py and
+cpr_tpu/mdp/rtdp_graph.py on the 8-virtual-CPU-device mesh:
+
+* sharded VI fixpoints bit-identical to the single-device
+  `impl="chunked"` solve on fc16@6, aft20@6, and a generic ghostdag
+  compile, at 1 vs 4 devices, including through kill@vi_chunk+resume;
+* uneven state blocks refused by name from every entry point;
+* grid x state 2-D mesh composition parity with the 1-D grid solve;
+* the CPR_VI_BYTES working-set guard: a ceiling the single-device
+  path refuses under is enough for the 4-shard path to complete;
+* in-graph RTDP: seeded bit-reproducibility, convergence to the exact
+  start value, damped-residual early exit, and the sharded-VI polish
+  handoff reaching the exact fixpoint in fewer sweeps.
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from cpr_tpu import telemetry  # noqa: E402
+from cpr_tpu.mdp.explicit import (MDP, ViWorkingSetTooLarge, ptmdp,  # noqa: E402
+                                  vi_working_set_bytes)
+from cpr_tpu.mdp.grid import (compile_protocol, grid_value_iteration,  # noqa: E402
+                              param_ptmdp)
+from cpr_tpu.mdp.rtdp_graph import rtdp_graph, rtdp_sharded_polish  # noqa: E402
+from cpr_tpu.parallel import (default_mesh,  # noqa: E402
+                              make_grid_state_chunk_step,
+                              partition_by_state_block,
+                              sharded_state_value_iteration,
+                              state_halo_bytes)
+from cpr_tpu.resilience import FAULT_ENV_VAR, InjectedKill  # noqa: E402
+
+pytestmark = pytest.mark.skipif(
+    jax.device_count() < 8,
+    reason="needs the 8-virtual-device CPU mesh (conftest XLA_FLAGS)")
+
+ALPHA, GAMMA = 0.35, 0.5
+
+
+def _mesh(n):
+    return default_mesh(devices=jax.devices()[:n])
+
+
+def _materialize(pm, alpha=ALPHA, gamma=GAMMA, dtype=jnp.float32):
+    """One grid point of a ParamMDP as a plain TensorMDP."""
+    m = pm.mdp
+    sv = pm._monomial(pm.start_coef, pm.start_expo, alpha, gamma)
+    m2 = MDP(n_states=m.n_states, n_actions=m.n_actions,
+             start={int(s): float(v)
+                    for s, v in zip(pm.start_ids, sv)},
+             src=m.src, act=m.act, dst=m.dst,
+             prob=pm.revalue(alpha, gamma),
+             reward=m.reward, progress=m.progress)
+    return m2.tensor(dtype)
+
+
+@pytest.fixture(scope="module")
+def fc16_pm():
+    return param_ptmdp(compile_protocol("fc16", cutoff=6), horizon=20)
+
+
+@pytest.fixture(scope="module")
+def aft20_pm():
+    return param_ptmdp(compile_protocol("aft20", cutoff=6), horizon=20)
+
+
+@pytest.fixture(scope="module")
+def fc16_tm(fc16_pm):
+    return _materialize(fc16_pm)
+
+
+@pytest.fixture(scope="module")
+def ghostdag_tm():
+    from cpr_tpu.mdp.generic.native import compile_native
+
+    table = compile_native("ghostdag", k=2, alpha=ALPHA, gamma=GAMMA,
+                           collect_garbage="simple", dag_size_cutoff=5)
+    return ptmdp(table, horizon=10).tensor(jnp.float32)
+
+
+# -- partition contract ------------------------------------------------------
+
+
+def test_partition_round_trips_and_pads_inert(fc16_tm):
+    """Every original transition lands in its source block with src
+    localized; pad rows carry src_local == s_blk (out-of-range segment
+    id — dropped by the scatter-add) and probability 0."""
+    n = 4
+    S = fc16_tm.n_states
+    S_pad = S + (-S % n)
+    (src_l, act, dst, prob, reward, progress), slot, t_blk = \
+        partition_by_state_block(fc16_tm, n, S_pad)
+    s_blk = S_pad // n
+    src = np.asarray(fc16_tm.src)
+    blk = src // s_blk
+    assert np.array_equal(src_l[slot] + blk * s_blk, src)
+    for col, ref in ((act, fc16_tm.act), (dst, fc16_tm.dst),
+                     (prob, fc16_tm.prob), (reward, fc16_tm.reward),
+                     (progress, fc16_tm.progress)):
+        assert np.array_equal(col[slot], np.asarray(ref))
+    pad = np.ones(n * t_blk, bool)
+    pad[slot] = False
+    assert np.all(src_l[pad] == s_blk)
+    assert np.all(prob[pad] == 0.0)
+    # the ptmdp horizon transform interleaves shutdown rows, so this
+    # tensor exercises the argsort (non-pre-bucketed) path; a raw
+    # frontier compile with nondecreasing src takes the split fast
+    # path — both land in the same padded layout contract asserted
+    # above
+    assert not np.all(src[1:] >= src[:-1])
+
+
+def test_partition_refuses_uneven_and_short_pad(fc16_tm):
+    with pytest.raises(ValueError, match="cannot shard"):
+        partition_by_state_block(fc16_tm, 4)  # S=89, not a multiple
+    with pytest.raises(ValueError, match="cannot shard"):
+        partition_by_state_block(fc16_tm, 4, S_pad=88)  # < n_states
+
+
+def test_halo_bytes():
+    assert state_halo_bytes(100, 1, np.float32) == 0
+    # 4 shards x 2 vectors x 75 remote entries x 4 bytes
+    assert state_halo_bytes(100, 4, np.float32) == 2 * 75 * 4 * 4
+
+
+# -- named refusals from every entry point -----------------------------------
+
+
+def test_uneven_states_refused_by_name(fc16_pm, fc16_tm):
+    mesh = _mesh(4)
+    with pytest.raises(ValueError, match=r"cannot shard 89 states"):
+        sharded_state_value_iteration(fc16_tm, mesh, stop_delta=1e-6)
+    with pytest.raises(ValueError, match=r"cannot shard 89 states"):
+        make_grid_state_chunk_step(
+            fc16_tm, 4, np.zeros((4, fc16_tm.src.shape[0])),
+            discount=1.0,
+            mesh=jax.sharding.Mesh(
+                np.asarray(jax.devices()[:8]).reshape(2, 4), ("g", "s")))
+    # the grid axis is refused by the same rule
+    with pytest.raises(ValueError, match=r"cannot shard 3 grid points"):
+        make_grid_state_chunk_step(
+            fc16_tm, 3, np.zeros((3, fc16_tm.src.shape[0])),
+            discount=1.0,
+            mesh=jax.sharding.Mesh(
+                np.asarray(jax.devices()[:4]).reshape(2, 2), ("g", "s")))
+    with pytest.raises(ValueError, match=r"cannot shard 89 states"):
+        grid_value_iteration(
+            fc16_pm, (0.25, 0.4), (0.5,), stop_delta=1e-6,
+            mesh=jax.sharding.Mesh(
+                np.asarray(jax.devices()[:4]).reshape(2, 2), ("g", "s")),
+            axis="g", state_axis="s")
+    with pytest.raises(ValueError, match="2-D mesh"):
+        grid_value_iteration(fc16_pm, (0.25,), (0.5,), stop_delta=1e-6,
+                             mesh=None, state_axis="s")
+
+
+def test_while_impl_refused(fc16_tm):
+    with pytest.raises(ValueError, match="impl='chunked'"):
+        sharded_state_value_iteration(fc16_tm, _mesh(1), impl="while",
+                                      stop_delta=1e-6)
+
+
+# -- bit-identity vs the single-device chunked solve -------------------------
+
+
+@pytest.mark.parametrize("tm_fixture",
+                         ["fc16_tm", "aft20_tm_", "ghostdag_tm"])
+def test_sharded_bit_identity_1_vs_4(tm_fixture, request, fc16_tm,
+                                     aft20_pm, ghostdag_tm):
+    tm = (fc16_tm if tm_fixture == "fc16_tm" else
+          _materialize(aft20_pm) if tm_fixture == "aft20_tm_" else
+          ghostdag_tm)
+    ref = tm.value_iteration(stop_delta=1e-6, impl="chunked")
+    for n in (1, 4):
+        got = sharded_state_value_iteration(
+            tm, _mesh(n), stop_delta=1e-6, pad_states=True)
+        assert got["vi_iter"] == ref["vi_iter"], (tm_fixture, n)
+        for k in ("vi_value", "vi_progress", "vi_policy"):
+            assert np.array_equal(got[k], ref[k]), (tm_fixture, n, k)
+        assert got["vi_state_shards"] == n
+        assert got["vi_halo_bytes"] == (0 if n == 1 else
+                                        state_halo_bytes(
+                                            tm.n_states
+                                            + (-tm.n_states % n),
+                                            n, tm.prob.dtype))
+
+
+def test_sharded_no_pad_exact_division(aft20_pm):
+    """aft20@6 has S=94: divisible by 2, so the default (no padding)
+    path runs and stays bit-identical."""
+    tm = _materialize(aft20_pm)
+    assert tm.n_states % 2 == 0
+    ref = tm.value_iteration(stop_delta=1e-6, impl="chunked")
+    got = sharded_state_value_iteration(tm, _mesh(2), stop_delta=1e-6)
+    assert got["vi_iter"] == ref["vi_iter"]
+    for k in ("vi_value", "vi_progress", "vi_policy"):
+        assert np.array_equal(got[k], ref[k])
+
+
+def test_sharded_kill_resume_bit_identical(fc16_tm, tmp_path,
+                                           monkeypatch):
+    """kill@vi_chunk mid-solve through the SHARDED path: the resumed
+    run lands on exactly the uninterrupted sharded fixpoint (which is
+    itself the single-device fixpoint) and cleans up the checkpoint."""
+    mesh = _mesh(4)
+    clean = sharded_state_value_iteration(
+        fc16_tm, mesh, stop_delta=1e-6, pad_states=True, chunk=32)
+    ck = str(tmp_path / "svi-ck.npz")
+    monkeypatch.setenv(FAULT_ENV_VAR, "kill@vi_chunk=3")
+    with pytest.raises(InjectedKill):
+        sharded_state_value_iteration(
+            fc16_tm, mesh, stop_delta=1e-6, pad_states=True, chunk=32,
+            checkpoint_path=ck)
+    assert os.path.exists(ck)  # chunks 1-2 landed before the crash
+    monkeypatch.delenv(FAULT_ENV_VAR)
+    got = sharded_state_value_iteration(
+        fc16_tm, mesh, stop_delta=1e-6, pad_states=True, chunk=32,
+        checkpoint_path=ck)
+    assert got["vi_iter"] == clean["vi_iter"]
+    for k in ("vi_value", "vi_progress", "vi_policy"):
+        assert np.array_equal(got[k], clean[k])
+    assert not os.path.exists(ck)  # finished solves leave no seed
+
+
+# -- grid x state composition ------------------------------------------------
+
+
+def test_grid_state_composition_parity(aft20_pm):
+    """The 2-D (grid x state) mesh solve equals the 1-D grid solve
+    bit-for-bit — per-point fixpoints, freeze iterations, sweep
+    count."""
+    alphas, gammas = (0.3, 0.4), (0.25, 0.75)
+    ref = grid_value_iteration(aft20_pm, alphas, gammas,
+                               stop_delta=1e-6, mesh=None)
+    mesh2 = jax.sharding.Mesh(
+        np.asarray(jax.devices()[:4]).reshape(2, 2), ("g", "s"))
+    got = grid_value_iteration(aft20_pm, alphas, gammas,
+                               stop_delta=1e-6, mesh=mesh2, axis="g",
+                               state_axis="s")
+    assert got["vi_iter"] == ref["vi_iter"]
+    assert np.array_equal(got["grid_iter"], ref["grid_iter"])
+    for k in ("grid_value", "grid_progress", "grid_policy"):
+        assert np.array_equal(np.asarray(got[k]), np.asarray(ref[k])), k
+
+
+# -- the working-set guard: sharding unlocks refused sizes -------------------
+
+
+def test_working_set_guard_sharded_completes(ghostdag_tm, monkeypatch):
+    """ISSUE-16 acceptance, scaled to CI: pick a CPR_VI_BYTES ceiling
+    between the 4-shard and single-device working sets — the
+    single-device path refuses the ghostdag solve by name while the
+    sharded path completes it end-to-end (same fixpoint as an
+    unguarded solve)."""
+    tm = ghostdag_tm
+    S, A = tm.n_states, tm.n_actions
+    T = int(np.asarray(tm.src).shape[0])
+    n = 4
+    S_pad = S + (-S % n)
+    _, _, t_blk = partition_by_state_block(tm, n, S_pad)
+    single = vi_working_set_bytes(T, S, A, tm.prob.dtype)
+    sharded = vi_working_set_bytes(t_blk, S_pad, A, tm.prob.dtype,
+                                   shards=n)
+    assert sharded < single  # the whole point of the state axis
+    ceiling = (sharded + single) // 2
+    monkeypatch.setenv("CPR_VI_BYTES", str(ceiling))
+    with pytest.raises(ViWorkingSetTooLarge, match="CPR_VI_BYTES"):
+        tm.value_iteration(stop_delta=1e-6, impl="chunked")
+    got = sharded_state_value_iteration(
+        tm, _mesh(n), stop_delta=1e-6, pad_states=True)
+    monkeypatch.delenv("CPR_VI_BYTES")
+    ref = tm.value_iteration(stop_delta=1e-6, impl="chunked")
+    for k in ("vi_value", "vi_progress", "vi_policy"):
+        assert np.array_equal(got[k], ref[k])
+
+
+# -- telemetry ---------------------------------------------------------------
+
+
+def test_sharded_solve_event_carries_shard_extras(fc16_tm, tmp_path):
+    trace = tmp_path / "svi.jsonl"
+    telemetry.configure(str(trace))
+    try:
+        telemetry.current().manifest(config={"role": "test-state-shard"})
+        sharded_state_value_iteration(
+            fc16_tm, _mesh(4), stop_delta=1e-6, pad_states=True,
+            protocol="fc16", cutoff=6)
+    finally:
+        telemetry.configure(None)
+    import json
+
+    events = [json.loads(ln) for ln in open(trace)]
+    (ev,) = [e for e in events if e.get("name") == "mdp_solve"]
+    assert ev["protocol"] == "fc16" and ev["cutoff"] == 6
+    assert ev["state_shards"] == 4
+    assert ev["halo_bytes"] > 0
+    assert ev["states_per_sec"] > 0
+    assert ev["sweeps"] > 0 and ev["converged"] == 1
+
+
+# -- in-graph RTDP -----------------------------------------------------------
+
+
+def test_rtdp_graph_converges_and_reproduces(fc16_tm):
+    exact = fc16_tm.value_iteration(stop_delta=1e-7)
+    sv_exact = fc16_tm.start_value(exact["vi_value"])
+    key = jax.random.PRNGKey(0)
+    r = rtdp_graph(fc16_tm, key, max_steps=3000, batch=128, buffer=256)
+    assert r["rtdp_steps"] == 3000  # stop_delta=0: full budget
+    sv = fc16_tm.start_value(r["rtdp_value"])
+    assert abs(sv - sv_exact) < 1e-3 * max(1.0, abs(sv_exact))
+    assert (r["rtdp_visits"] > 0).sum() > 0.5 * fc16_tm.n_states
+    assert (r["rtdp_buffer"] >= 0).any()
+    # same key -> bit-identical everything
+    r2 = rtdp_graph(fc16_tm, key, max_steps=3000, batch=128, buffer=256)
+    for k in ("rtdp_value", "rtdp_progress", "rtdp_visits",
+              "rtdp_buffer"):
+        assert np.array_equal(r[k], r2[k]), k
+    # different key -> a different exploration trace
+    r3 = rtdp_graph(fc16_tm, jax.random.PRNGKey(7), max_steps=3000,
+                    batch=128, buffer=256)
+    assert not np.array_equal(r["rtdp_visits"], r3["rtdp_visits"])
+
+
+def test_rtdp_graph_early_exit(fc16_tm):
+    r = rtdp_graph(fc16_tm, jax.random.PRNGKey(0), max_steps=100_000,
+                   batch=128, buffer=256, stop_delta=1e-4)
+    assert r["rtdp_steps"] < 100_000
+    assert r["rtdp_resid"] <= 1e-4
+
+
+def test_rtdp_host_oracle_value_check(fc16_tm):
+    """The in-graph port and the host RTDP's deterministic rng agree
+    on what they are estimating: both land on the exact start value
+    (the host oracle runs on the same compiled table via the
+    explicit-MDP extraction contract, so the exact VI start value is
+    the shared oracle)."""
+    exact = fc16_tm.value_iteration(stop_delta=1e-7)
+    sv_exact = fc16_tm.start_value(exact["vi_value"])
+    r = rtdp_graph(fc16_tm, jax.random.PRNGKey(3), max_steps=4000,
+                   batch=128, buffer=256)
+    assert fc16_tm.start_value(r["rtdp_value"]) == pytest.approx(
+        sv_exact, rel=1e-3)
+
+
+def test_rtdp_sharded_polish_handoff(fc16_tm):
+    """Explore in-graph, polish exactly: the handoff reaches the cold
+    exact fixpoint (to stop_delta) in no more sweeps than the cold
+    solve, with the rtdp_* diagnostics riding along."""
+    cold = fc16_tm.value_iteration(stop_delta=1e-7, impl="chunked")
+    vi = rtdp_sharded_polish(
+        fc16_tm, _mesh(4), jax.random.PRNGKey(0), rtdp_steps=2000,
+        batch=128, stop_delta=1e-7, pad_states=True)
+    assert vi["vi_iter"] <= cold["vi_iter"]
+    assert np.allclose(vi["vi_value"], cold["vi_value"], atol=1e-5)
+    assert vi["vi_state_shards"] == 4
+    assert vi["rtdp_steps"] == 2000 and vi["rtdp_batch"] == 128
+
+
+def test_host_rtdp_accepts_rng_instance():
+    """Satellite: the host RTDP threads one explicit random stream —
+    same seed or equal-state rng instances walk bit-identical
+    trajectories; the module-global `random` is never consulted."""
+    import random as random_mod
+
+    from cpr_tpu.mdp.models import Fc16BitcoinSM
+    from cpr_tpu.mdp.rtdp import RTDP
+
+    mk = lambda: Fc16BitcoinSM(alpha=0.3, gamma=0.5,  # noqa: E731
+                               maximum_fork_length=4)
+    a = RTDP(mk(), eps=0.3, seed=11).run(400)
+    b = RTDP(mk(), eps=0.3, rng=random_mod.Random(11)).run(400)
+    assert a.n_states == b.n_states
+    np.testing.assert_array_equal(a.value[:a.n_states],
+                                  b.value[:b.n_states])
+    np.testing.assert_array_equal(a.count[:a.n_states],
+                                  b.count[:b.n_states])
+    # and a different seed explores differently
+    c = RTDP(mk(), eps=0.3, seed=12).run(400)
+    assert (a.n_states != c.n_states
+            or not np.array_equal(a.count[:a.n_states],
+                                  c.count[:c.n_states]))

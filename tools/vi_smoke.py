@@ -1,0 +1,295 @@
+"""State-sharded VI smoke (`make vi-smoke`).
+
+Proves the state-sharded exact-analysis seam (docs/MDP.md
+"State-sharded solving") end-to-end on the CPU CI host — solve
+children run under forced 1-device and 4-device XLA CPU meshes:
+
+  1  per device count, a solve child parametrically compiles
+     bitcoin (fc16) at fork-length 6, revalues one (alpha, gamma)
+     point, and solves it through
+     `parallel.sharded_state_value_iteration` (4 devices shard the
+     89-state space with `pad_states`, 1 device runs the degenerate
+     single-shard program);
+  2  device-count parity: value/progress/policy fixpoints and the
+     convergence sweep must be BIT-IDENTICAL between the 1- and
+     4-device runs, and the 1-device child additionally pins them
+     bit-identical to the solo `value_iteration(impl="chunked")`
+     oracle — sharding is an execution strategy, not an
+     approximation;
+  3  the 1-device child runs the in-graph RTDP
+     (`mdp.rtdp_graph.rtdp_graph`, one `lax.while_loop`, seeded)
+     and checks its start value against the host-computed exact-VI
+     oracle; the 4-device child runs the full
+     `rtdp_sharded_polish` handoff (explore in-graph, certify with
+     the sharded VI) and checks the polished fixpoint against its
+     own sharded solve;
+  4  the 4-device child also solves a 2x2 (alpha, gamma) grid of
+     aft20 on the composed ("g", "s") 2-D mesh and pins it
+     bit-identical to the 1-D grid solve (grid x state
+     composition);
+  5  every trace passes `trace_summary --validate --expect
+     mdp_solve`, and all traces ingest into one perf ledger:
+     `mdp_states_per_sec` rows must land at BOTH state-shard counts
+     (cfg_state_shards absent == 1, and 4), the composed grid solve
+     must bank `mdp_grid_points_per_sec`, and every banked row must
+     clear the regression gate.
+
+Usage: python tools/vi_smoke.py [workdir]   (default /tmp/...)
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+ROOT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+sys.path.insert(0, ROOT)
+
+from cpr_tpu.perf.gate import gate_row, gate_summary  # noqa: E402
+from cpr_tpu.perf.ledger import Ledger  # noqa: E402
+
+DEVICES = 4
+MFL = 6                      # bitcoin (fc16) fork-length
+HORIZON = 20
+ALPHA, GAMMA = 0.35, 0.5
+WALL_S = 900.0
+
+
+def _log(msg):
+    print(f"vi-smoke: {msg}", file=sys.stderr)
+
+
+def _child_env(workdir, trace, extra=None, devices=1):
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS=f"--xla_force_host_platform_device_count="
+                         f"{devices}",
+               CPR_TELEMETRY=trace,
+               CPR_TPU_CACHE=os.path.join(workdir, "cache"))
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env.update(extra or {})
+    return env
+
+
+def _validate_stream(trace, expect):
+    tool = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "trace_summary.py")
+    r = subprocess.run(
+        [sys.executable, tool, trace, "--validate", "--expect", expect],
+        capture_output=True, text=True)
+    if r.returncode != 0:
+        sys.stderr.write(r.stdout + r.stderr)
+        raise SystemExit(f"telemetry validation failed for {trace}")
+
+
+# one solve child per device count: the same bitcoin@6 point through
+# the sharded VI, exact outputs dumped as JSON for the parent's
+# cross-device bit-identity check.  The 1-device child adds the solo
+# oracle + in-graph-RTDP value check; the 4-device child adds the
+# polish handoff and the composed grid x state solve.
+_SOLVE_CHILD = textwrap.dedent("""\
+    import json, os
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+
+    from cpr_tpu import telemetry
+    from cpr_tpu.mdp.explicit import MDP
+    from cpr_tpu.mdp.grid import (compile_protocol, grid_value_iteration,
+                                  param_ptmdp)
+    from cpr_tpu.mdp.rtdp_graph import rtdp_graph, rtdp_sharded_polish
+    from cpr_tpu.parallel import (default_mesh,
+                                  sharded_state_value_iteration)
+
+    devices = int(os.environ["CPR_SMOKE_DEVICES"])
+    mfl = int(os.environ["CPR_SMOKE_MFL"])
+    horizon = int(os.environ["CPR_SMOKE_HORIZON"])
+    alpha = float(os.environ["CPR_SMOKE_ALPHA"])
+    gamma = float(os.environ["CPR_SMOKE_GAMMA"])
+
+    devs = jax.devices()
+    assert len(devs) >= devices, (len(devs), devices)
+    mesh = default_mesh(devices=devs[:devices])
+
+    tele = telemetry.current()
+    tele.manifest(dict(role="vi-smoke-solve", devices=devices,
+                       mfl=mfl, horizon=horizon))
+
+    def point_tensor(pm, a, g):
+        m = pm.mdp
+        sv = pm._monomial(pm.start_coef, pm.start_expo, a, g)
+        return MDP(n_states=m.n_states, n_actions=m.n_actions,
+                   start={int(s): float(v)
+                          for s, v in zip(pm.start_ids, sv)},
+                   src=m.src, act=m.act, dst=m.dst,
+                   prob=pm.revalue(a, g),
+                   reward=m.reward, progress=m.progress).tensor()
+
+    pm = param_ptmdp(compile_protocol("fc16", cutoff=mfl),
+                     horizon=horizon)
+    tm = point_tensor(pm, alpha, gamma)
+    vi = sharded_state_value_iteration(
+        tm, mesh, stop_delta=1e-6, pad_states=True,
+        protocol="fc16", cutoff=mfl)
+    assert vi["vi_state_shards"] == devices
+    payload = dict(devices=devices, vi=dict(
+        value=vi["vi_value"].tolist(),
+        progress=vi["vi_progress"].tolist(),
+        policy=vi["vi_policy"].tolist(),
+        sweeps=int(vi["vi_iter"])))
+    print(f"sharded solve: {tm.n_states} states over {devices} "
+          f"shard(s), {vi['vi_iter']} sweeps")
+
+    if devices == 1:
+        # solo oracle: the sharded program at one shard must BE the
+        # solo chunked solve, bit for bit
+        solo = tm.value_iteration(impl="chunked", stop_delta=1e-6)
+        for k in ("vi_value", "vi_progress", "vi_policy"):
+            assert np.array_equal(vi[k], solo[k]), k
+        assert int(vi["vi_iter"]) == int(solo["vi_iter"])
+        print("1-shard fixpoint bit-identical to solo chunked VI")
+        # in-graph RTDP vs the host-computed exact oracle
+        sv_exact = tm.start_value(solo["vi_value"])
+        r = rtdp_graph(tm, jax.random.PRNGKey(0), max_steps=4000,
+                       batch=128, buffer=256)
+        sv_rtdp = tm.start_value(r["rtdp_value"])
+        assert abs(sv_rtdp - sv_exact) <= 1e-3 * max(
+            1.0, abs(sv_exact)), (sv_rtdp, sv_exact)
+        # seeded: a re-run is bit-identical
+        r2 = rtdp_graph(tm, jax.random.PRNGKey(0), max_steps=4000,
+                        batch=128, buffer=256)
+        assert np.array_equal(r["rtdp_value"], r2["rtdp_value"])
+        print(f"in-graph RTDP start value {sv_rtdp:.6f} matches "
+              f"exact oracle {sv_exact:.6f} (seeded, reproducible)")
+    else:
+        # oracle solves (the warm-started polish, the mesh=None grid
+        # reference) are correctness checks, not measurements: their
+        # mdp_solve events go to a separate validated-but-unbanked
+        # trace so their rates (compile time amortized over fewer or
+        # differently-batched sweeps) never gate the cold rows
+        telemetry.configure(os.environ["CPR_SMOKE_ORACLE"])
+        telemetry.current().manifest(dict(role="vi-smoke-oracle",
+                                          devices=devices))
+        # explore in-graph, certify with the sharded VI
+        pol = rtdp_sharded_polish(
+            tm, mesh, jax.random.PRNGKey(0), rtdp_steps=2000,
+            batch=128, stop_delta=1e-6, pad_states=True,
+            protocol="fc16", cutoff=mfl)
+        assert pol["vi_state_shards"] == devices
+        assert pol["vi_iter"] <= int(vi["vi_iter"])
+        assert np.allclose(pol["vi_value"], vi["vi_value"], atol=1e-5)
+        print(f"rtdp_sharded_polish: {pol['rtdp_steps']} RTDP steps "
+              f"then {pol['vi_iter']} sweeps (cold: {vi['vi_iter']})")
+        # composed grid x state 2-D mesh vs the 1-D grid solve
+        pt2 = param_ptmdp(compile_protocol("aft20", cutoff=mfl),
+                          horizon=horizon)
+        alphas, gammas = (0.3, 0.4), (0.25, 0.75)
+        ref = grid_value_iteration(pt2, alphas, gammas,
+                                   stop_delta=1e-6, mesh=None,
+                                   protocol="aft20", cutoff=mfl)
+        telemetry.configure(os.environ["CPR_TELEMETRY"])  # appends
+        mesh2 = jax.sharding.Mesh(
+            np.asarray(devs[:devices]).reshape(2, devices // 2),
+            ("g", "s"))
+        got = grid_value_iteration(pt2, alphas, gammas,
+                                   stop_delta=1e-6, mesh=mesh2,
+                                   axis="g", state_axis="s",
+                                   protocol="aft20", cutoff=mfl)
+        for k in ("grid_value", "grid_progress", "grid_policy"):
+            assert np.array_equal(np.asarray(ref[k]),
+                                  np.asarray(got[k])), k
+        assert int(ref["vi_iter"]) == int(got["vi_iter"])
+        print(f"composed ('g', 's') grid solve bit-identical to the "
+              f"1-D grid solve ({got['vi_iter']} sweeps)")
+
+    with open(os.environ["CPR_SMOKE_OUT"], "w") as f:
+        json.dump(payload, f, sort_keys=True)
+    print("vi solve child ok:", devices, "device(s)")
+""")
+
+
+def _solve_run(work, devices):
+    trace = os.path.join(work, f"solve_d{devices}.jsonl")
+    oracle = os.path.join(work, f"oracle_d{devices}.jsonl")
+    out_path = os.path.join(work, f"solve_d{devices}.json")
+    for p in (trace, oracle, out_path):
+        if os.path.exists(p):
+            os.remove(p)
+    env = _child_env(work, trace, devices=devices, extra={
+        "CPR_SMOKE_DEVICES": str(devices),
+        "CPR_SMOKE_MFL": str(MFL),
+        "CPR_SMOKE_HORIZON": str(HORIZON),
+        "CPR_SMOKE_ALPHA": str(ALPHA),
+        "CPR_SMOKE_GAMMA": str(GAMMA),
+        "CPR_SMOKE_ORACLE": oracle,
+        "CPR_SMOKE_OUT": out_path,
+    })
+    r = subprocess.run([sys.executable, "-c", _SOLVE_CHILD], env=env,
+                       cwd=ROOT, capture_output=True, text=True,
+                       timeout=WALL_S)
+    sys.stderr.write(r.stdout)
+    if r.returncode != 0:
+        sys.stderr.write(r.stderr)
+        raise SystemExit(f"solve child (devices={devices}) failed "
+                         f"rc={r.returncode}")
+    _validate_stream(trace, "mdp_solve")
+    if os.path.exists(oracle):
+        _validate_stream(oracle, "mdp_solve")
+    with open(out_path) as f:
+        payload = json.load(f)
+    _log(f"solve child devices={devices} ok")
+    return payload, trace
+
+
+def _bank_and_gate(work, traces):
+    """All traces into one ledger; mdp_states_per_sec must land at
+    both state-shard counts, the composed grid solve must bank
+    mdp_grid_points_per_sec, and every row must clear the gate."""
+    ledger = Ledger(os.path.join(work, "perf_ledger.jsonl"))
+    n = sum(ledger.ingest_trace(t) for t in traces)
+    records = ledger.records()
+    sps = [r for r in records
+           if r.get("metric") == "mdp_states_per_sec"]
+    got = {r.get("config", {}).get("cfg_state_shards", 1) for r in sps}
+    if not {1, DEVICES} <= got:
+        raise SystemExit(f"mdp_states_per_sec banked at state-shard "
+                         f"counts {sorted(got)}, need both 1 and "
+                         f"{DEVICES}")
+    if not any(r.get("metric") == "mdp_grid_points_per_sec"
+               for r in records):
+        raise SystemExit("composed grid solve banked no "
+                         "mdp_grid_points_per_sec row")
+    results = [gate_row(r, records) for r in records]
+    summary = gate_summary(results)
+    if not summary["ok"]:
+        bad = [res for res in results if res["verdict"] == "fail"]
+        raise SystemExit(f"vi perf gate failed: {bad}")
+    return n, summary
+
+
+def main():
+    work = sys.argv[1] if len(sys.argv) > 1 else "/tmp/cpr-vi-smoke"
+    os.makedirs(work, exist_ok=True)
+
+    out_1, trace_1 = _solve_run(work, 1)
+    out_n, trace_n = _solve_run(work, DEVICES)
+    if out_1["vi"] != out_n["vi"]:
+        raise SystemExit(f"state-sharded solves NOT bit-identical "
+                         f"between 1-device and {DEVICES}-device runs")
+    _log(f"sharded fixpoints bit-identical at 1 vs {DEVICES} shards "
+         f"(bitcoin fc16@{MFL}, {out_1['vi']['sweeps']} sweeps)")
+
+    n, summary = _bank_and_gate(work, [trace_1, trace_n])
+    print(f"vi-smoke: PASS (state-sharded VI bit-identical at 1 vs "
+          f"{DEVICES} forced CPU devices on bitcoin fc16@{MFL}; solo-"
+          f"oracle and in-graph-RTDP value checks; rtdp_sharded_polish "
+          f"handoff; composed ('g', 's') 2-D grid solve bit-identical; "
+          f"banked {n} ledger rows incl. mdp_states_per_sec at shard "
+          f"counts 1 and {DEVICES}; gate {summary})")
+
+
+if __name__ == "__main__":
+    main()
