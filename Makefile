@@ -106,6 +106,12 @@ fleet-smoke:  ## fleet-resilience chaos proof: router + 2 replicas,
 	## sheds honored via call_with_retry, warm restart rejoins, then
 	## v9 admission/route validation, a trace_stitch router-hop
 	## pairing, and per-class p99 + shed-rate rows banked + gated.
+	## v14 health plane ridealong: mid-flood Prometheus scrapes of
+	## the router + replica --metrics-port endpoints and the in-band
+	## metrics.scrape op, the fleet latency merge checked exact
+	## against a merged-by-hand reference, >=1 SLO burn-rate alert
+	## under the kill, the killed replica's blackbox dump validated,
+	## and fleet_p99_s rows banked + gated from the router trace.
 	## Details: docs/SERVING.md
 	rm -rf $(FLEET_SMOKE_DIR)
 	python tools/fleet_smoke.py $(FLEET_SMOKE_DIR)

@@ -132,6 +132,59 @@ class LatencyHistogram:
             "p99_s": self.quantile(0.99),
         }
 
+    # -- wire form (v14 fleet merge) ------------------------------------
+    #
+    # A snapshot() carries quantile ESTIMATES, which cannot be merged
+    # (quantile-of-quantiles is wrong in general); the wire form below
+    # carries the raw bucket counts, so a router can reconstruct a
+    # replica's histogram and bucket-sum it into a fleet board exactly.
+    # Buckets ship sparse ([index, count] pairs over the nonzero cells)
+    # — with the default 145 edges a lightly-loaded family is a handful
+    # of pairs, not a 146-zero vector per heartbeat.
+
+    def to_dict(self) -> dict:
+        """Raw mergeable form: sparse nonzero buckets + exact moments.
+        `n_edges` guards the merge — histograms only combine when built
+        over the same edge vector (from_dict re-checks)."""
+        return {
+            "n_edges": len(self.edges),
+            "count": self.count,
+            "sum_s": self.sum_s,
+            "min_s": None if self.count == 0 else self.min_s,
+            "max_s": None if self.count == 0 else self.max_s,
+            "buckets": [[i, c] for i, c in enumerate(self.counts) if c],
+        }
+
+    @classmethod
+    def from_dict(cls, raw: dict, edges=None) -> "LatencyHistogram":
+        """Rebuild a histogram from `to_dict` output onto `edges`
+        (default shared edges).  Raises ValueError on an edge-count or
+        bucket-index mismatch — a silent misalignment here would corrupt
+        every fleet quantile downstream."""
+        h = cls(edges)
+        if int(raw.get("n_edges", -1)) != len(h.edges):
+            raise ValueError(
+                f"histogram wire form built over {raw.get('n_edges')} "
+                f"edges, expected {len(h.edges)}")
+        for i, c in raw.get("buckets", ()):
+            i, c = int(i), int(c)
+            if not 0 <= i < len(h.counts):
+                raise ValueError(f"bucket index {i} out of range "
+                                 f"[0, {len(h.counts)})")
+            if c < 0:
+                raise ValueError(f"negative bucket count {c}")
+            h.counts[i] += c
+        h.count = int(raw.get("count", 0))
+        h.sum_s = float(raw.get("sum_s", 0.0))
+        if h.count != sum(h.counts):
+            raise ValueError(
+                f"bucket counts sum to {sum(h.counts)}, header says "
+                f"{h.count}")
+        if h.count:
+            h.min_s = float(raw["min_s"])
+            h.max_s = float(raw["max_s"])
+        return h
+
 
 # family-cardinality bound: per-tenant / per-class labels make the
 # family space attacker-controlled under multi-tenant traffic, so a
@@ -178,3 +231,27 @@ class LatencyBoard:
     def snapshot(self) -> dict:
         """{family: histogram snapshot} over every family observed."""
         return {k: self._hists[k].snapshot() for k in self.families}
+
+    def to_dict(self) -> dict:
+        """{family: raw histogram wire form} — the mergeable companion
+        to `snapshot()` (v14: replicas ship this to the router, which
+        bucket-sums it into the fleet board via `merge_dict`)."""
+        return {k: self._hists[k].to_dict() for k in self.families}
+
+    def merge_dict(self, raw: dict):
+        """Exact bucket-sum merge of a `to_dict` payload into this
+        board.  Families novel past `max_families` fold into
+        `OVERFLOW_FAMILY` (merged there, not dropped) — the same
+        cardinality bound `observe` applies, so a hostile replica
+        payload cannot blow up router memory."""
+        for family in sorted(raw):
+            h = LatencyHistogram.from_dict(raw[family], self._edges)
+            dst = self._hists.get(family)
+            if dst is None:
+                if (len(self._hists) >= self.max_families
+                        and family != OVERFLOW_FAMILY):
+                    family = OVERFLOW_FAMILY
+                    dst = self._hists.get(family)
+            if dst is None:
+                dst = self._hists[family] = LatencyHistogram(self._edges)
+            dst.merge(h)

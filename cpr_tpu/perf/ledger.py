@@ -285,6 +285,25 @@ def iter_trace_rows(path: str):
                             **{f"cfg_{k}": v for k, v in config.items()},
                             **dev_cfg},
                            base)
+            elif (e.get("kind") == "event" and e.get("name") == "serve"
+                  and e.get("action") == "fleet_report"):
+                # v14: the router's drain-time fleet merge — exact
+                # bucket-sum of every replica's latency board — banks
+                # one `fleet_p99_s` row per op-family, tagged
+                # cfg_family so each family gates against its own
+                # history (`_s` suffix: lower-is-better)
+                fleet = (e.get("detail") or {}).get("fleet_p99_s")
+                if isinstance(fleet, dict):
+                    for family, value in sorted(fleet.items()):
+                        if not isinstance(value, (int, float)):
+                            continue
+                        yield ({"metric": "fleet_p99_s",
+                                "backend": backend, "value": value,
+                                "unit": "seconds",
+                                "cfg_family": str(family),
+                                **{f"cfg_{k}": v
+                                   for k, v in config.items()}},
+                               base)
             elif (e.get("kind") == "event"
                   and e.get("name") == "mdp_solve"):
                 # schema v10: grid-batched exact-MDP solves bank their

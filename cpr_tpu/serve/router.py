@@ -47,9 +47,21 @@ import sys
 import threading
 
 from cpr_tpu import resilience, supervisor, telemetry
+from cpr_tpu.latency import LatencyBoard
+from cpr_tpu.monitor.blackbox import dump_blackbox
+from cpr_tpu.monitor.expo import MetricsServer
+from cpr_tpu.monitor.registry import MetricsRegistry
 from cpr_tpu.serve import protocol as wire
 
 _FWD_ERRORS = (wire.ProtocolError, ConnectionError, OSError)
+
+
+def _fleet_event(action: str, **detail):
+    """Router-side `serve` event call site: the fleet-scope records
+    (action `fleet_report`) the perf ledger lifts into `fleet_p99_s`
+    rows (EVENT_FIELDS['serve'])."""
+    telemetry.current().event("serve", action=action, session=None,
+                              detail=detail)
 
 
 def _route_event(action: str, replica, op, **extra):
@@ -100,6 +112,7 @@ class Replica:
         self.ready_file = None
         self.host = None
         self.port = None
+        self.metrics_port = None  # the child's own HTTP exposition
         self.proc = None
         self.thread = None
         self.attempt = None  # supervisor.Attempt once the child exits
@@ -135,7 +148,8 @@ class ServeRouter:
                  ready_file: str | None = None, heartbeat_s: float = 1.0,
                  wall_s: float = 3600.0, quiet_s: float = 60.0,
                  max_restarts: int = 1, pick_wait_s: float = 60.0,
-                 seed_base: int = 1 << 21):
+                 seed_base: int = 1 << 21,
+                 metrics_port: int | None = None):
         if n_replicas <= 0:
             raise ValueError(f"need at least one replica, got {n_replicas}")
         self.child_args = list(child_args)
@@ -161,6 +175,18 @@ class ServeRouter:
         self._routed = 0
         self._requeued = 0
         self._refused = 0
+        # fleet-wide latency view: REBUILT from the replicas' raw
+        # bucket payloads on every refresh (exact bucket-sum merge of
+        # cumulative per-replica counts — never quantile-of-quantiles,
+        # and idempotent because each refresh starts from zero), so
+        # the registry holds it through a callable, not a reference
+        self._fleet_board = LatencyBoard()
+        self.metrics = MetricsRegistry(namespace="cpr_router")
+        self.metrics.attach_board(
+            "fleet_latency_seconds", lambda: self._fleet_board,
+            help="fleet-merged per-op-family reply latency (seconds)")
+        self.metrics_port = metrics_port  # bound port after run() binds
+        self.metrics_server: MetricsServer | None = None
         self._server = None
         self._draining = False
         self._drain_reason = None
@@ -168,12 +194,18 @@ class ServeRouter:
     # -- child lifecycle ---------------------------------------------------
 
     def _child_cmd(self, rep: Replica) -> list:
-        return [sys.executable, "-m", "cpr_tpu.serve.server",
-                *self.child_args,
-                "--host", "127.0.0.1", "--port", "0",
-                "--ready-file", rep.ready_file,
-                "--replica-index", str(rep.index),
-                "--heartbeat-s", str(self.heartbeat_s)]
+        cmd = [sys.executable, "-m", "cpr_tpu.serve.server",
+               *self.child_args,
+               "--host", "127.0.0.1", "--port", "0",
+               "--ready-file", rep.ready_file,
+               "--replica-index", str(rep.index),
+               "--heartbeat-s", str(self.heartbeat_s)]
+        if self.metrics_port is not None:
+            # a metrics-serving fleet exposes every layer: each child
+            # binds its own ephemeral scrape port (published through
+            # its ready file, read back in _try_ready)
+            cmd += ["--metrics-port", "0"]
+        return cmd
 
     def _child_env(self, rep: Replica) -> dict:
         env = dict(os.environ)
@@ -219,10 +251,12 @@ class ServeRouter:
             with open(rep.ready_file, encoding="utf-8") as f:
                 info = json.load(f)
             rep.host, rep.port = info["host"], int(info["port"])
+            rep.metrics_port = info.get("metrics_port")
         except (OSError, ValueError, KeyError):
             return
         rep.state = "up"
         _route_event("replica_up", rep.index, None, port=rep.port,
+                     metrics_port=rep.metrics_port,
                      restarts=rep.restarts)
 
     def _mark_down(self, rep: Replica, reason: str):
@@ -417,7 +451,74 @@ class ServeRouter:
                 per[str(rep.index)] = r
             except _FWD_ERRORS:
                 per[str(rep.index)] = dict(state="down")
-        return dict(ok=True, router=self.router_stats(), replicas=per)
+        # the stats replies already carry each replica's raw bucket
+        # payload — fold them into the fleet view on the way through
+        board = self._merge_fleet(
+            r.get("latencies_raw") for r in per.values())
+        return dict(ok=True, router=self.router_stats(),
+                    fleet=dict(latencies=board.snapshot(),
+                               latencies_raw=board.to_dict()),
+                    replicas=per)
+
+    # -- fleet health plane ------------------------------------------------
+
+    def _merge_fleet(self, raws) -> LatencyBoard:
+        """Fresh fleet board from replica raw-bucket payloads: an
+        EXACT bucket-sum merge of cumulative per-replica counts (the
+        boards share one edge grid), never quantile-of-quantiles.
+        Rebuilding from zero each time makes a refresh idempotent —
+        cumulative payloads re-merged into a carried-over board would
+        double-count.  The new board REPLACES the old one (the
+        registry reads it through a callable)."""
+        board = LatencyBoard()
+        for raw in raws:
+            if isinstance(raw, dict):
+                board.merge_dict(raw)
+        self._fleet_board = board
+        return board
+
+    async def _refresh_fleet(self) -> LatencyBoard:
+        """Scrape every up replica in-band and rebuild the fleet
+        board; refresh the router gauges alongside."""
+        raws = []
+        for rep in self.replicas:
+            if rep.state != "up":
+                continue
+            try:
+                r = await self._forward(rep, dict(op="metrics.scrape"))
+            except _FWD_ERRORS:
+                continue
+            if isinstance(r, dict):
+                raws.append(r.get("latencies_raw"))
+        board = self._merge_fleet(raws)
+        self._refresh_gauges()
+        return board
+
+    def _refresh_gauges(self):
+        g = self.metrics.set
+        g("routed", self._routed, help="sessions routed to replicas")
+        g("requeued", self._requeued,
+          help="failover re-forwards after replica loss")
+        g("refused", self._refused, help="router-level refusals")
+        g("open_sessions", len(self._sessions),
+          help="pinned interactive sessions")
+        for rep in self.replicas:
+            g("replica_up", 1.0 if rep.state == "up" else 0.0,
+              replica=str(rep.index), help="replica liveness (1 = up)")
+            g("replica_restarts", rep.restarts,
+              replica=str(rep.index), help="warm restarts, per replica")
+
+    def fleet_p99_s(self, board: LatencyBoard | None = None) -> dict:
+        """{family: p99 seconds} over the merged fleet board; empty
+        families are omitted (never a None value — the ledger lift
+        and burn-rate math downstream assume numbers)."""
+        board = board if board is not None else self._fleet_board
+        out = {}
+        for fam in board.families:
+            q = board.get(fam).quantile(0.99)
+            if q is not None:
+                out[fam] = q
+        return out
 
     def router_stats(self) -> dict:
         return dict(
@@ -485,6 +586,14 @@ class ServeRouter:
             return dict(ok=True, draining=True)
         if op == "stats":
             return await self._op_stats(req)
+        if op == "metrics.scrape":
+            # answered at the router, not forwarded: the reply is the
+            # router's own registry plus the freshly merged fleet view
+            board = await self._refresh_fleet()
+            return dict(ok=True, metrics=self.metrics.to_json(),
+                        fleet=dict(latencies=board.snapshot(),
+                                   latencies_raw=board.to_dict(),
+                                   p99_s=self.fleet_p99_s(board)))
         if self._draining or self._drain_reason is not None:
             if op in ("episode.run", "episode.open"):
                 return dict(ok=False, error="draining", draining=True)
@@ -509,16 +618,36 @@ class ServeRouter:
             self._server = await asyncio.start_server(
                 self._handle, self.host, self.port)
             self.port = self._server.sockets[0].getsockname()[1]
+            if self.metrics_port is not None:
+                self.metrics_server = MetricsServer(
+                    self.metrics.render_prometheus, host=self.host,
+                    port=self.metrics_port)
+                self.metrics_port = self.metrics_server.start()
+            # prime the gauges so a scrape between bind and the first
+            # fleet refresh sees real samples, not comments only
+            self._refresh_gauges()
             await self._wait_all_up()
             if self.ready_file:
                 resilience.atomic_write_json(
                     self.ready_file,
                     dict(host=self.host, port=self.port,
                          pid=os.getpid(),
-                         replicas=len(self.replicas)))
+                         replicas=len(self.replicas),
+                         metrics_port=self.metrics_port,
+                         replica_metrics_ports={
+                             str(r.index): r.metrics_port
+                             for r in self.replicas}))
+            fleet_last = telemetry.now()
             while (self._drain_reason is None
                    and not resilience.preempt_requested()):
                 await asyncio.sleep(0.05)
+                if telemetry.now() - fleet_last >= self.heartbeat_s:
+                    # periodic fleet merge + gauge refresh, so the
+                    # HTTP exposition stays live between client
+                    # scrapes (one in-band scrape per replica per
+                    # heartbeat — negligible next to the traffic)
+                    fleet_last = telemetry.now()
+                    await self._refresh_fleet()
             reason = self._drain_reason or \
                 f"preempt:{resilience.preempt_reason()}"
             await self._drain(reason)
@@ -532,6 +661,15 @@ class ServeRouter:
     async def _drain(self, reason: str):
         self._draining = True
         _route_event("drain", None, None, reason=reason)
+        # final fleet merge while the replicas are still up, then the
+        # fleet_report record: perf/ledger.py lifts its fleet_p99_s
+        # into per-family ledger rows (the fleet-wide SLO trail)
+        board = await self._refresh_fleet()
+        _fleet_event("fleet_report", reason=reason,
+                     replicas=sum(r.state == "up"
+                                  for r in self.replicas),
+                     fleet_p99_s=self.fleet_p99_s(board),
+                     latencies=board.snapshot())
         for rep in self.replicas:
             if rep.state != "up":
                 continue
@@ -551,6 +689,9 @@ class ServeRouter:
         if self._server is not None:
             self._server.close()
             await self._server.wait_closed()
+        if self.metrics_server is not None:
+            self.metrics_server.stop()
+            self.metrics_server = None
 
 
 # -- entry point -------------------------------------------------------------
@@ -575,6 +716,12 @@ def main(argv=None) -> int:
     p.add_argument("--replica-quiet-s", type=float, default=60.0)
     p.add_argument("--max-restarts", type=int, default=1,
                    help="warm restarts per replica outside a drain")
+    p.add_argument("--metrics-port", type=int, default=None,
+                   help="serve fleet-merged Prometheus metrics over"
+                        " HTTP on this port (0 = ephemeral; lands in"
+                        " the ready file) and give every replica its"
+                        " own ephemeral scrape port; default: no HTTP"
+                        " exposition")
     # pass-through server geometry/admission flags
     p.add_argument("--protocol", default="nakamoto")
     p.add_argument("--alpha", type=float, default=0.25)
@@ -614,9 +761,29 @@ def main(argv=None) -> int:
         child_args, args.replicas, workdir=workdir, host=args.host,
         port=args.port, ready_file=args.ready_file,
         heartbeat_s=args.heartbeat_s, wall_s=args.replica_wall_s,
-        quiet_s=args.replica_quiet_s, max_restarts=args.max_restarts)
+        quiet_s=args.replica_quiet_s, max_restarts=args.max_restarts,
+        metrics_port=args.metrics_port)
+    # the router's own backend-bearing manifest: its trace carries the
+    # fleet_report record, and the perf ledger attributes those rows
+    # to this config (entry "router", fleet geometry) — without it the
+    # router stream would not validate standalone
+    telemetry.current().manifest(config=dict(
+        entry="router", replicas=args.replicas,
+        protocol=args.protocol, n_lanes=args.lanes, burst=args.burst,
+        devices=args.devices, max_steps=args.max_steps,
+        alpha=args.alpha, gamma=args.gamma))
     with resilience.preemption_guard():
-        asyncio.run(router.run())
+        # flight recorder: a crash unwinding the router loop dumps the
+        # telemetry ring before re-raising; a preemption drain dumps
+        # on the way out (the preempt flag outlives the guard body)
+        try:
+            asyncio.run(router.run())
+        except BaseException as e:  # noqa: BLE001 — dump-and-reraise
+            dump_blackbox(f"router:{type(e).__name__}")
+            raise
+        if resilience.preempt_requested():
+            dump_blackbox(
+                f"router:preempt:{resilience.preempt_reason()}")
     return 0
 
 

@@ -38,6 +38,10 @@ import numpy as np
 
 from cpr_tpu import resilience, telemetry
 from cpr_tpu.latency import LatencyBoard
+from cpr_tpu.monitor import alerts as slo_alerts
+from cpr_tpu.monitor.blackbox import dump_blackbox
+from cpr_tpu.monitor.expo import MetricsServer
+from cpr_tpu.monitor.registry import MetricsRegistry
 from cpr_tpu.serve import protocol as wire
 from cpr_tpu.serve.engine import ResidentEngine
 from cpr_tpu.serve.scheduler import LaneScheduler, QueueFull
@@ -141,7 +145,8 @@ class ServeServer:
                  slo_s: float | None = None,
                  max_queued: int | None = None,
                  tenant_quota: int | None = None,
-                 replica_index: int | None = None):
+                 replica_index: int | None = None,
+                 metrics_port: int | None = None):
         self.engine = engine
         # bounded queue by default: 8x the lane count is ~8 bursts of
         # backlog, past which queueing only manufactures SLO misses —
@@ -174,6 +179,27 @@ class ServeServer:
         # per-op-family reply latency + per-entry-point device
         # dispatch walls (the `stats`/`heartbeat`/`report` SLO surface)
         self.latency = LatencyBoard()
+        # v14 live health plane: the registry mirrors the counters the
+        # event stream already carries (pull-based, scrapeable while
+        # serving), with the latency board attached by reference as
+        # the histogram family — no second observe path
+        self.metrics = MetricsRegistry(
+            namespace="cpr_serve",
+            const_labels=({"replica": str(replica_index)}
+                          if replica_index is not None else None))
+        self.metrics.attach_board(
+            "latency_seconds", self.latency,
+            help="per-op-family reply latency (seconds)")
+        # SLO burn-rate alerting: per-class latency budgets are the
+        # SAME scaled budgets admission control sheds against, so an
+        # alert and a shed always agree on what "over SLO" means
+        self.alerts = slo_alerts.AlertEngine(
+            slo_s,
+            class_slo=({name: slo_s * _SLO_SCALE[p]
+                        for name, p in PRIORITY_CLASSES.items()}
+                       if slo_s is not None else None))
+        self.metrics_port = metrics_port  # bound port after start()
+        self.metrics_server: MetricsServer | None = None
         self._netsim_engines: dict[tuple, object] = {}
         # loaded nets servable as attack policies (main() mirrors the
         # engine's snapshot table here; the fingerprint — the snapshot
@@ -192,10 +218,19 @@ class ServeServer:
         self._server = await asyncio.start_server(
             self._handle, self.host, self.port)
         self.port = self._server.sockets[0].getsockname()[1]
+        if self.metrics_port is not None:
+            self.metrics_server = MetricsServer(
+                self.metrics.render_prometheus, host=self.host,
+                port=self.metrics_port)
+            self.metrics_port = self.metrics_server.start()
+        # prime the gauges so a scrape between bind and the first
+        # heartbeat sees real samples, not a comment-only exposition
+        self._refresh_gauges()
         _serve_event("start", port=self.port,
                      n_lanes=self.engine.n_lanes,
                      burst=self.engine.burst,
-                     policies=list(self.engine.policy_names))
+                     policies=list(self.engine.policy_names),
+                     metrics_port=self.metrics_port)
         self._loop_task = asyncio.create_task(self._tick_loop())
 
     async def serve_until_drained(self):
@@ -222,6 +257,9 @@ class ServeServer:
                 # supervisor's progress signal, so an idle server
                 # stays distinguishable from a wedged one
                 hb_last = t
+                self._refresh_gauges()
+                for a in self.alerts.evaluate():
+                    slo_alerts.emit_alert(a)
                 _serve_event(
                     "heartbeat",
                     queued=self.sched.n_queued(),
@@ -233,7 +271,8 @@ class ServeServer:
                     oldest_queued_s=self.sched.oldest_queued_s(),
                     pending_steps=len(self._pending),
                     exec_ops=len(self._inflight_exec),
-                    sheds=self._sheds)
+                    sheds=self._sheds,
+                    alerts=self.alerts.summary())
             await asyncio.sleep(0.0 if progressed else self.idle_sleep_s)
 
     def _tick_once(self) -> bool:
@@ -332,6 +371,28 @@ class ServeServer:
             progressed = True
         return progressed
 
+    def _refresh_gauges(self):
+        """Refresh the registry's gauge families from live scheduler /
+        engine state — the same readings the heartbeat event carries,
+        pull-scrapeable between heartbeats."""
+        g = self.metrics.set
+        g("queued", self.sched.n_queued(),
+          help="admission queue depth")
+        g("occupancy", self.sched.occupancy(),
+          help="fraction of lanes assigned")
+        g("oldest_queued_s", self.sched.oldest_queued_s(),
+          help="age of the oldest queued session (seconds)")
+        g("pending_steps", len(self._pending),
+          help="interactive steps awaiting the next device tick")
+        g("exec_ops", len(self._inflight_exec),
+          help="executor-thread query ops in flight")
+        g("steps", self.engine.steps,
+          help="device steps executed since start")
+        g("episodes", self.engine.episodes,
+          help="episodes completed since start")
+        g("sheds", self._sheds,
+          help="admission refusals since start")
+
     def _session_latency(self, s: _Session) -> dict:
         """One completed (or refused) session's reply breakdown.
         Monotonic stamps, clamped at 0 anyway so a reply can never
@@ -385,12 +446,21 @@ class ServeServer:
         report["shed_reasons"] = dict(self._shed_reasons)
         denom = self._sheds + self.engine.admitted
         report["shed_rate"] = self._sheds / denom if denom else 0.0
+        # one last alert evaluation before the report: breaches that
+        # built up between heartbeats still emit their typed events,
+        # and the report carries the final alert surface
+        for a in self.alerts.evaluate():
+            slo_alerts.emit_alert(a)
+        report["alerts"] = self.alerts.summary()
         _serve_event("report", **report)
         self.engine.emit_metrics()
         _serve_event("stop", reason=reason, steps=report["steps"],
                      episodes=report["episodes"])
         self._server.close()
         await self._server.wait_closed()
+        if self.metrics_server is not None:
+            self.metrics_server.stop()
+            self.metrics_server = None
 
     # -- connections ------------------------------------------------------
 
@@ -441,11 +511,16 @@ class ServeServer:
         op = req.get("op")
         cls = resp.pop("_class", None)
         self.latency.observe(_op_family(op), lat["total_s"])
+        self.metrics.inc("requests_total", op=str(op), status=status,
+                         help="requests served, by op and status")
         if cls is not None:
             # per-priority-class tail latency: the drain report lifts
             # these into per-class serve_p99_s ledger rows
             self.latency.observe(f"{_op_family(op)}:{cls}",
                                  lat["total_s"])
+            # the burn-rate engine sees the same per-class totals the
+            # board does, judged against the class SLO budgets
+            self.alerts.record_latency(cls, lat["total_s"])
         _request_event(trace_id, op, status, lat["queue_wait_s"],
                        lat["service_s"], lat["total_s"],
                        resp.get("session"), resp.pop("_lane", None),
@@ -473,7 +548,18 @@ class ServeServer:
                         # per-op-family histogram summaries; named
                         # `latencies` because the singular `latency`
                         # reply key is the per-request breakdown
-                        latencies=self.latency.snapshot())
+                        latencies=self.latency.snapshot(),
+                        # the raw mergeable wire form: the router
+                        # bucket-sums these into the fleet board
+                        latencies_raw=self.latency.to_dict(),
+                        alerts=self.alerts.summary())
+        if op == "metrics.scrape":
+            # the in-band twin of the --metrics-port HTTP endpoint:
+            # the registry's structured form (histograms_raw inside is
+            # the fleet-merge input) plus the live alert surface
+            return dict(ok=True, metrics=self.metrics.to_json(),
+                        alerts=self.alerts.summary(),
+                        latencies_raw=self.latency.to_dict())
         if op == "drain":
             self.request_drain(str(req.get("reason", "client")))
             return dict(ok=True, draining=True)
@@ -530,6 +616,10 @@ class ServeServer:
         self._sheds += 1
         self._shed_reasons[reason] = self._shed_reasons.get(reason, 0) + 1
         self.engine.record_shed()
+        self.alerts.record_admission(True)
+        self.metrics.inc("sheds_total", reason=reason, op=str(op),
+                         cls=str(cls), tenant=str(tenant or ""),
+                         help="admission refusals, by reason")
         _admission_event(reason, op, cls, tenant, retry_after)
         return dict(ok=False, error=f"shed: {reason}", shed=True,
                     reason=reason, retry_after=retry_after)
@@ -583,6 +673,9 @@ class ServeServer:
             self.sched.enqueue(s, priority=prio, tenant=s.tenant)
         except QueueFull:
             return self._shed("queue_full", "episode.run", cls, s.tenant)
+        self.alerts.record_admission(False)
+        self.metrics.inc("admitted_total", cls=cls,
+                         help="sessions admitted, by priority class")
         resp = await s.future
         return dict(resp, latency=self._session_latency(s),
                     _lane=s.lane, _splice_s=s.splice_s, _class=s.cls)
@@ -599,6 +692,9 @@ class ServeServer:
             self.sched.enqueue(s, priority=prio, tenant=s.tenant)
         except QueueFull:
             return self._shed("queue_full", "episode.open", cls, s.tenant)
+        self.alerts.record_admission(False)
+        self.metrics.inc("admitted_total", cls=cls,
+                         help="sessions admitted, by priority class")
         obs = await s.future
         if isinstance(obs, dict):  # drained before admission
             return dict(obs, latency=self._session_latency(s))
@@ -871,6 +967,11 @@ def main(argv=None) -> int:
     p.add_argument("--replica-index", type=int, default=None,
                    help="fleet replica id (set by serve.router); arms"
                         " the per-replica fault-injection site")
+    p.add_argument("--metrics-port", type=int, default=None,
+                   help="serve Prometheus text metrics over HTTP on"
+                        " this port (0 = ephemeral; the bound port"
+                        " lands in the ready file); default: no HTTP"
+                        " exposition (metrics.scrape stays available)")
     args = p.parse_args(argv)
 
     from cpr_tpu import supervisor
@@ -925,7 +1026,8 @@ def main(argv=None) -> int:
                              heartbeat_s=args.heartbeat_s,
                              slo_s=args.slo_s, max_queued=args.max_queue,
                              tenant_quota=args.tenant_quota,
-                             replica_index=args.replica_index)
+                             replica_index=args.replica_index,
+                             metrics_port=args.metrics_port)
         # the same loaded nets double as in-network attack policies
         # (netsim.attack_sweep); the snapshot path is the cache
         # fingerprint for their sweep results
@@ -935,11 +1037,23 @@ def main(argv=None) -> int:
         if args.ready_file:
             resilience.atomic_write_json(
                 args.ready_file,
-                dict(host=args.host, port=server.port, pid=os.getpid()))
+                dict(host=args.host, port=server.port, pid=os.getpid(),
+                     metrics_port=server.metrics_port))
         await server.serve_until_drained()
 
     with supervisor.child_phase("serve:run"), resilience.preemption_guard():
-        asyncio.run(amain())
+        # the flight recorder's crash trigger: any exception unwinding
+        # the serve loop (including an injected kill standing in for
+        # one) dumps the telemetry ring before re-raising; a graceful
+        # preemption drain dumps on the way out too (the preempt flag
+        # outlives the guard)
+        try:
+            asyncio.run(amain())
+        except BaseException as e:  # noqa: BLE001 — dump-and-reraise
+            dump_blackbox(f"serve:{type(e).__name__}")
+            raise
+        if resilience.preempt_requested():
+            dump_blackbox(f"serve:preempt:{resilience.preempt_reason()}")
     return 0
 
 

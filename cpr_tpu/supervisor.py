@@ -85,6 +85,7 @@ from collections import deque
 from contextlib import contextmanager
 
 from cpr_tpu import telemetry
+from cpr_tpu.monitor.blackbox import dump_blackbox
 from cpr_tpu.resilience import (GuardFailure, TransientFault,
                                 default_classify, fault_point,
                                 with_retries)
@@ -548,6 +549,9 @@ def supervise(cmd, *, site: str, config: SupervisorConfig | None = None,
             _event(action="escalation", site=site,
                    reason=f"probe-before-run failed ({pr['reason']}); "
                           f"workload never committed")
+            # escalations are crash-adjacent: preserve the parent's
+            # own telemetry tail before the caller's next rung acts
+            dump_blackbox(f"supervisor:escalation:{site}")
             raise ProbeFailure(
                 f"{site}: device probe failed ({pr['reason']})")
     state = {"restarts": 0, "attempts": 0}
@@ -627,6 +631,7 @@ def supervise(cmd, *, site: str, config: SupervisorConfig | None = None,
                reason=f"attempts exhausted ({type(exc).__name__}: "
                       f"{exc}); caller's next rung takes over",
                attempts=state["attempts"], restarts=state["restarts"])
+        dump_blackbox(f"supervisor:escalation:{site}")
         raise
 
 

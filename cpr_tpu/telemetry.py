@@ -50,12 +50,17 @@ import re
 import socket
 import subprocess
 import sys
+from collections import deque
 from contextlib import contextmanager
 from datetime import datetime, timezone
 from time import perf_counter as now  # noqa: F401 — re-exported
 
-SCHEMA_VERSION = 13
+SCHEMA_VERSION = 14
 TELEMETRY_ENV_VAR = "CPR_TELEMETRY"
+# flight-recorder ring capacity (v14): last N emitted events kept
+# in-process for the crash blackbox (cpr_tpu/monitor/blackbox.py)
+BLACKBOX_ENV_VAR = "CPR_BLACKBOX_EVENTS"
+BLACKBOX_DEFAULT_EVENTS = 512
 # trace context: one run id per process tree, exported so supervisor
 # children and serve clients land their events under the same id
 RUN_ID_ENV_VAR = "CPR_RUN_ID"
@@ -160,6 +165,16 @@ EVENT_FIELDS = {
     # resumed.
     "mdp_compile": ("protocol", "cutoff", "rounds", "states",
                     "transitions", "n_workers"),
+    # v14: one per SLO burn-rate breach (cpr_tpu/monitor/alerts.py,
+    # evaluated on the serve tick loop): signal is
+    # shed_rate|p99_over_slo, severity page|ticket (page = fast-window
+    # breach, act now; ticket = slow-window breach, budget bleeding),
+    # window_s the evaluation window, value the observed signal over
+    # that window, budget the error budget it is judged against,
+    # burn_rate = value / budget (>= the severity's threshold at emit
+    # time).  Extras ride free-form: cls, threshold, slo_s.
+    "alert": ("signal", "severity", "window_s", "value", "budget",
+              "burn_rate"),
 }
 
 
@@ -174,6 +189,42 @@ EVENT_FIELDS = {
 # durations only (tools/trace_stitch.py).
 
 _run_id: str | None = None
+
+
+# -- flight recorder ring ----------------------------------------------------
+#
+# v14: every emitted event — sink or no sink — also lands in one
+# process-wide bounded ring, so a crash leaves the last N events
+# recoverable even when the JSONL tail was lost (or no sink was ever
+# configured).  The ring is the recorder; the DUMP lives in
+# cpr_tpu/monitor/blackbox.py (this module cannot import resilience —
+# resilience imports telemetry).  Overhead is one deque.append per
+# event; capacity comes from $CPR_BLACKBOX_EVENTS once per process.
+
+_blackbox: deque | None = None
+
+
+def blackbox_capacity() -> int:
+    """Ring capacity: $CPR_BLACKBOX_EVENTS (>=1), default 512."""
+    try:
+        n = int(os.environ.get(BLACKBOX_ENV_VAR,
+                               BLACKBOX_DEFAULT_EVENTS))
+    except ValueError:
+        n = BLACKBOX_DEFAULT_EVENTS
+    return max(1, n)
+
+
+def _blackbox_ring() -> deque:
+    global _blackbox
+    if _blackbox is None:
+        _blackbox = deque(maxlen=blackbox_capacity())
+    return _blackbox
+
+
+def blackbox_events() -> list[dict]:
+    """The recorded tail, oldest first (a copy — safe to serialize
+    while the emit path keeps appending)."""
+    return list(_blackbox_ring())
 
 
 def run_id() -> str:
@@ -297,6 +348,10 @@ class Telemetry:
         # counted before the sink check: the supervisor heartbeat reads
         # this as a progress signal, which must work sink or no sink
         self.n_emitted += 1
+        # the flight recorder likewise rides every emit (v14): the ring
+        # must capture the tail even when no sink is configured — a
+        # sinkless crash is exactly when the blackbox is the only record
+        _blackbox_ring().append(event)
         if self._sink is None:
             return
         self._sink.write(json.dumps(event, default=str) + "\n")

@@ -258,7 +258,8 @@ def train_from_config(cfg: TrainConfig, *, out_dir: str | None = None,
                       n_updates: int | None = None, mesh=None,
                       progress: Callable | None = None,
                       resume: bool | str = False,
-                      snapshot_freq: int | None = None):
+                      snapshot_freq: int | None = None,
+                      metrics_port: int | None = None):
     """Full training run: returns (net_params, history, eval_rows).
 
     Checkpoints (when out_dir is set): last-model.msgpack after every
@@ -275,6 +276,12 @@ def train_from_config(cfg: TrainConfig, *, out_dir: str | None = None,
     between updates snapshot + write `preempt-model.msgpack` and return
     cleanly.  On resume, `history`/`eval_rows` cover only the resumed
     segment; metrics.jsonl carries the whole run.
+
+    Live health plane (v14): a `cpr_train` MetricsRegistry tracks the
+    update rate and the snapshot staleness — seconds (and updates)
+    since the last durable snapshot, the restart-cost SLO a
+    sampler/learner split watches.  `metrics_port` exposes it over
+    HTTP (0 = ephemeral) for scraping mid-run.
     """
     env = build_env(cfg)
     lane_alphas = cfg.lane_alphas(cfg.n_envs)
@@ -308,6 +315,37 @@ def train_from_config(cfg: TrainConfig, *, out_dir: str | None = None,
     # CPR_TELEMETRY capture of a training run validates standalone
     tele.emit(manifest)
 
+    # live training health plane: update rate + snapshot staleness
+    # (time/updates since the last durable snapshot — the bound on
+    # lost work a preemption costs, ROADMAP item 2's SLO)
+    from cpr_tpu.monitor.registry import MetricsRegistry
+    health = MetricsRegistry(namespace="cpr_train")
+    metrics_server = None
+    if metrics_port is not None:
+        from cpr_tpu.monitor.expo import MetricsServer
+        metrics_server = MetricsServer(health.render_prometheus,
+                                       port=metrics_port)
+        metrics_server.start()
+    # (wall stamp of last snapshot, update it covered)
+    last_snap = [telemetry.now(), None]
+
+    def _refresh_train_gauges(update, m):
+        health.set("update", update,
+                   help="updates completed this segment")
+        wall = m.get("wall_s")
+        health.set("updates_per_sec",
+                   1.0 / wall if wall else None,
+                   help="training update rate")
+        health.set("steps_per_sec", m.get("steps_per_sec"),
+                   help="env steps per second")
+        health.set("snapshot_staleness_s",
+                   telemetry.now() - last_snap[0],
+                   help="seconds since the last durable snapshot")
+        health.set("snapshot_staleness_updates",
+                   (update - last_snap[1]
+                    if last_snap[1] is not None else update),
+                   help="updates since the last durable snapshot")
+
     snap_path = (resume if isinstance(resume, str) else
                  os.path.join(out_dir, "snapshot.msgpack")
                  if out_dir is not None else None)
@@ -335,6 +373,8 @@ def train_from_config(cfg: TrainConfig, *, out_dir: str | None = None,
                 best_params=best_params, config=snap_config)
         resilience.with_retries(write, max_attempts=3, base_delay_s=0.1,
                                 max_delay_s=2.0, name="save:snapshot")
+        last_snap[0] = telemetry.now()
+        last_snap[1] = update
         tele.event("checkpoint", path=snap_path, what="snapshot",
                    update=update)
 
@@ -363,6 +403,7 @@ def train_from_config(cfg: TrainConfig, *, out_dir: str | None = None,
             env_state = shard_envs(mesh, env_state, "dp")
             obs = shard_envs(mesh, obs, "dp")
             carry = (ts, env_state, obs, key)
+        last_snap[1] = start_update  # the restored snapshot's coverage
         tele.event("resume", path=snap_path, update=start_update)
     if device_metrics.enabled():
         # XLA's own estimate of one update (flops, bytes) into the run
@@ -444,6 +485,7 @@ def train_from_config(cfg: TrainConfig, *, out_dir: str | None = None,
             if sp.dur_s > 0:
                 m["steps_per_sec"] = round(steps_per_update / sp.dur_s)
             history.append(m)
+            _refresh_train_gauges(i + 1, m)
             if metrics_log is not None:
                 metrics_log.write(json.dumps({"update": i + 1, **m}) + "\n")
                 # flushed per update: a crash must not eat the stream's
@@ -538,4 +580,6 @@ def train_from_config(cfg: TrainConfig, *, out_dir: str | None = None,
         preempt_ctx.__exit__(None, None, None)
         if metrics_log is not None:
             metrics_log.close()
+        if metrics_server is not None:
+            metrics_server.stop()
     return carry[0].params, history, eval_rows

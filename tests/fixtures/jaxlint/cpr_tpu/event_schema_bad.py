@@ -18,3 +18,7 @@ def report(tele, fn_name, tid):
                topology="two-agents", lanes=54)
     # finding: missing states, transitions, n_workers (v12 mdp_compile)
     tele.event("mdp_compile", protocol="fc16", cutoff=8, rounds=17)
+    # finding: missing burn_rate (v14 alert — an alert without its
+    # burn rate is unjudgeable)
+    tele.event("alert", signal="shed_rate", severity="page",
+               window_s=30.0, value=0.4, budget=0.02)

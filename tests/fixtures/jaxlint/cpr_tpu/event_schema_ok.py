@@ -21,3 +21,6 @@ def report(tele, fn_name, dt, err, extra, tid):
     tele.event("mdp_compile", protocol="fc16", cutoff=8, rounds=17,
                states=1024, transitions=6144, n_workers=4,
                compile_s=dt, states_per_sec=dt)  # extras ride free-form
+    tele.event("alert", signal="p99_over_slo", severity="ticket",
+               window_s=60.0, value=dt, budget=0.5, burn_rate=dt,
+               cls="batch", threshold=1.0)  # extras ride free-form

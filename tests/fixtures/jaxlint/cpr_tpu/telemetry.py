@@ -12,4 +12,6 @@ EVENT_FIELDS = {
                      "drops"),
     "mdp_compile": ("protocol", "cutoff", "rounds", "states",
                     "transitions", "n_workers"),
+    "alert": ("signal", "severity", "window_s", "value", "budget",
+              "burn_rate"),
 }

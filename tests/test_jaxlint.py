@@ -79,6 +79,8 @@ def test_event_fields_resolved_cross_module_by_ast():
                          "drops"),
         "mdp_compile": ("protocol", "cutoff", "rounds", "states",
                         "transitions", "n_workers"),
+        "alert": ("signal", "severity", "window_s", "value", "budget",
+                  "burn_rate"),
     }
 
 

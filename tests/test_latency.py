@@ -133,3 +133,80 @@ def test_board_family_cardinality_is_bounded():
     assert snap[OVERFLOW_FAMILY]["count"] == 50
     with pytest.raises(ValueError, match="max_families"):
         LatencyBoard(max_families=0)
+
+
+# -- the v14 mergeable wire form (fleet latency merge) -----------------------
+
+
+def test_histogram_wire_roundtrip_is_exact():
+    """to_dict/from_dict round-trips counts, moments, and quantiles
+    bit-for-bit: the fleet merge is bucket-sum arithmetic, not a
+    quantile-of-quantiles approximation."""
+    h = LatencyHistogram()
+    for v in (0.001, 0.002, 0.004, 0.3, 12.0):
+        h.observe(v)
+    raw = h.to_dict()
+    json.dumps(raw)  # it rides the metrics.scrape reply
+    assert raw["n_edges"] == len(h.edges)
+    assert raw["count"] == 5 and raw["min_s"] == 0.001
+    assert sum(c for _, c in raw["buckets"]) == 5
+    back = LatencyHistogram.from_dict(raw)
+    assert back.snapshot() == h.snapshot()
+    assert back.counts == h.counts
+    # the empty histogram round-trips honestly: no fake extrema
+    empty = LatencyHistogram.from_dict(LatencyHistogram().to_dict())
+    assert empty.count == 0 and empty.quantile(0.5) is None
+    assert LatencyHistogram().to_dict()["min_s"] is None
+
+
+def test_from_dict_rejects_corrupt_wire_forms():
+    """A silent wire-form misalignment would corrupt every fleet
+    quantile downstream, so each inconsistency is a hard error."""
+    good = LatencyHistogram()
+    good.observe(0.01)
+    raw = good.to_dict()
+    with pytest.raises(ValueError, match="edges"):
+        LatencyHistogram.from_dict(dict(raw, n_edges=7))
+    with pytest.raises(ValueError, match="out of range"):
+        LatencyHistogram.from_dict(
+            dict(raw, buckets=[[10**6, 1]]))
+    with pytest.raises(ValueError, match="negative"):
+        LatencyHistogram.from_dict(dict(raw, buckets=[[0, -1]]))
+    with pytest.raises(ValueError, match="header says"):
+        LatencyHistogram.from_dict(dict(raw, count=99))
+
+
+def test_board_merge_dict_is_exact_bucket_sum():
+    """The router's fleet merge: two replicas' boards combined through
+    the wire form equal one board that saw every observation."""
+    rep_a, rep_b, direct = (LatencyBoard() for _ in range(3))
+    obs_a = [("episode.run", 0.01), ("episode.run", 0.04),
+             ("stats", 0.001)]
+    obs_b = [("episode.run", 0.02), ("netsim.query", 0.2)]
+    for fam, v in obs_a:
+        rep_a.observe(fam, v)
+        direct.observe(fam, v)
+    for fam, v in obs_b:
+        rep_b.observe(fam, v)
+        direct.observe(fam, v)
+    fleet = LatencyBoard()
+    fleet.merge_dict(rep_a.to_dict())
+    fleet.merge_dict(rep_b.to_dict())
+    assert fleet.snapshot() == direct.snapshot()
+    assert fleet.get("episode.run").count == 3
+
+
+def test_board_merge_dict_folds_novel_families_into_overflow():
+    """A hostile (or just chatty) replica payload cannot blow up
+    router memory: families novel past max_families merge into
+    OVERFLOW_FAMILY — counted there, never dropped."""
+    fleet = LatencyBoard(max_families=2)
+    fleet.observe("a", 0.01)
+    fleet.observe("b", 0.01)
+    payload = LatencyBoard()
+    for fam in ("a", "c", "d"):
+        payload.observe(fam, 0.02)
+    fleet.merge_dict(payload.to_dict())
+    assert set(fleet.families) == {"a", "b", OVERFLOW_FAMILY}
+    assert fleet.get("a").count == 2  # existing families merge home
+    assert fleet.get(OVERFLOW_FAMILY).count == 2  # c + d pooled

@@ -1,0 +1,551 @@
+"""cpr_tpu.monitor: the fleet health plane (schema v14).
+
+Units: the live `MetricsRegistry` (counter/gauge semantics, Prometheus
+text 0.0.4 grammar, the empty-histogram and `__overflow__` cardinality
+edges, callable-board indirection), the `--metrics-port` HTTP endpoint,
+the multi-window SLO burn-rate `AlertEngine` (fake clock: fire,
+cooldown, recovery, the None-never-reaches-burn-math contract), and the
+crash flight recorder (ring capacity, dump format, never-raises).
+
+Integration (satellite d): the dump triggers are proven through the
+REAL machinery — a `kill@replica=0` fault injected into a live serve
+subprocess, and a SIGTERM preemption drain — each leaving a
+schema-valid blackbox artifact that `trace_summary --validate` accepts
+standalone.
+"""
+
+import importlib.util
+import json
+import os
+import re
+import signal
+import socket
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from cpr_tpu import resilience, telemetry
+from cpr_tpu.latency import OVERFLOW_FAMILY, LatencyBoard, LatencyHistogram
+from cpr_tpu.monitor.alerts import (DEFAULT_SHED_BUDGET, PAGE_BURN,
+                                    TICKET_BURN, AlertEngine, burn_rate,
+                                    default_windows, emit_alert)
+from cpr_tpu.monitor.blackbox import blackbox_path, dump_blackbox
+from cpr_tpu.monitor.expo import MetricsServer
+from cpr_tpu.monitor.registry import (PROMETHEUS_CONTENT_TYPE,
+                                      MetricsRegistry)
+from cpr_tpu.serve import protocol as wire
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# every Prometheus text-format sample line: name{labels} value
+_SAMPLE_RE = re.compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*'
+    r'(\{[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*"'
+    r'(,[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*")*\})? '
+    r'(-?[0-9]+(\.[0-9]+)?([eE][+-]?[0-9]+)?|\+Inf|-Inf|NaN)$')
+
+
+def _assert_prometheus_grammar(text: str):
+    """Line-by-line grammar check shared with the fleet smoke: every
+    line is a comment or a well-formed sample, and no Python `None`
+    ever leaks into the exposition."""
+    assert "None" not in text
+    assert text.endswith("\n")
+    for line in text.splitlines():
+        if line.startswith("# HELP ") or line.startswith("# TYPE "):
+            continue
+        assert _SAMPLE_RE.match(line), f"bad sample line: {line!r}"
+
+
+# -- MetricsRegistry ---------------------------------------------------------
+
+
+def test_counter_gauge_semantics_and_kind_conflict():
+    reg = MetricsRegistry(namespace="t")
+    reg.inc("requests_total", op="run")
+    reg.inc("requests_total", 2.0, op="run")
+    reg.inc("requests_total", op="stats")
+    reg.set("queued", 7)
+    reg.set("queued", 3)  # gauges overwrite, counters accumulate
+    j = reg.to_json()
+    by_labels = {tuple(sorted(s["labels"].items())): s["value"]
+                 for s in j["counters"]["requests_total"]}
+    assert by_labels[(("op", "run"),)] == 3.0
+    assert by_labels[(("op", "stats"),)] == 1.0
+    assert j["gauges"]["queued"][0]["value"] == 3.0
+    # a name is one kind forever: the conflict is an error, not a
+    # silent second family
+    with pytest.raises(ValueError, match="is a counter"):
+        reg.set("requests_total", 1.0)
+    with pytest.raises(ValueError, match="max_series"):
+        MetricsRegistry(max_series=0)
+
+
+def test_gauge_set_none_unsets_the_series():
+    """`set(None)` is the explicit no-data path: the series disappears
+    from both expositions instead of rendering a bogus value (how an
+    empty histogram's None quantile stays out of the text format)."""
+    reg = MetricsRegistry(namespace="t")
+    reg.set("p99_s", 0.25, cls="interactive")
+    assert "p99_s" in reg.render_prometheus()
+    reg.set("p99_s", None, cls="interactive")
+    out = reg.render_prometheus()
+    # the family's HELP/TYPE comments may remain; no SAMPLE does
+    assert not [ln for ln in out.splitlines()
+                if ln.startswith("t_p99_s")]
+    assert reg.to_json()["gauges"]["p99_s"] == []
+    _assert_prometheus_grammar(out)
+
+
+def test_prometheus_text_grammar_and_label_escaping():
+    reg = MetricsRegistry(namespace="cpr_serve",
+                          const_labels={"replica": "0"})
+    reg.inc("sheds_total", reason='queue_full "x"\nnasty\\path',
+            tenant="t-1")
+    reg.set("occupancy", 0.5)
+    board = LatencyBoard()
+    for d in (0.001, 0.01, 0.01, 0.1):
+        board.observe("episode.run", d)
+    reg.attach_board("latency_seconds", board,
+                     help="request latency")
+    out = reg.render_prometheus()
+    _assert_prometheus_grammar(out)
+    # const labels ride every series; escapes round the funny chars
+    assert 'replica="0"' in out
+    assert r'reason="queue_full \"x\"\nnasty\\path"' in out
+    # one HELP/TYPE pair per family, histogram declared as such
+    assert out.count("# TYPE cpr_serve_latency_seconds histogram") == 1
+    assert "# TYPE cpr_serve_sheds_total counter" in out
+    assert "# TYPE cpr_serve_occupancy gauge" in out
+
+
+def test_histogram_buckets_are_cumulative_and_sum_to_count():
+    board = LatencyBoard()
+    durs = [0.001, 0.003, 0.01, 0.02, 0.5]
+    for d in durs:
+        board.observe("episode.run", d)
+    reg = MetricsRegistry(namespace="t")
+    reg.attach_board("lat", board)
+    out = reg.render_prometheus()
+    _assert_prometheus_grammar(out)
+    buckets = []
+    for line in out.splitlines():
+        if line.startswith("t_lat_bucket"):
+            le = re.search(r'le="([^"]+)"', line).group(1)
+            buckets.append((le, int(line.rsplit(" ", 1)[1])))
+    # cumulative and non-decreasing, closed by le="+Inf" == _count
+    counts = [c for _, c in buckets]
+    assert counts == sorted(counts)
+    assert buckets[-1][0] == "+Inf" and buckets[-1][1] == len(durs)
+    (count_line,) = [ln for ln in out.splitlines()
+                     if ln.startswith("t_lat_count")]
+    assert int(count_line.rsplit(" ", 1)[1]) == len(durs)
+    (sum_line,) = [ln for ln in out.splitlines()
+                   if ln.startswith("t_lat_sum")]
+    assert float(sum_line.rsplit(" ", 1)[1]) == pytest.approx(sum(durs))
+    # every finite le is a real edge, parseable as a float
+    for le, _ in buckets[:-1]:
+        assert float(le) > 0
+
+
+def test_empty_histogram_renders_all_zero_never_none():
+    """The v14 empty-histogram edge: a family that exists but has seen
+    nothing (a replica that merged in an idle peer) renders explicit
+    zeros — all buckets 0, `+Inf` 0, `_sum 0`, `_count 0` — and no
+    `None` anywhere in the text."""
+    board = LatencyBoard()
+    board.merge_dict({"idle": LatencyHistogram().to_dict()})
+    assert board.get("idle").count == 0
+    reg = MetricsRegistry(namespace="t")
+    reg.attach_board("lat", board)
+    out = reg.render_prometheus()
+    _assert_prometheus_grammar(out)
+    samples = [ln for ln in out.splitlines()
+               if not ln.startswith("#")]
+    assert samples, "an empty family still renders"
+    assert all(ln.rsplit(" ", 1)[1] == "0" for ln in samples)
+    # the structured path is honest the same way: no fake quantiles
+    j = reg.to_json()
+    assert j["histograms"]["lat"]["idle"] == {"count": 0}
+    assert j["histograms_raw"]["lat"]["idle"]["count"] == 0
+
+
+def test_series_cardinality_folds_into_overflow_label():
+    """Past max_series, novel label combinations fold into one series
+    whose every label value is the explicit `__overflow__` marker —
+    visible in the exposition, never dropped (the registry twin of the
+    LatencyBoard family bound)."""
+    reg = MetricsRegistry(namespace="t", max_series=2)
+    reg.inc("requests_total", op="a")
+    reg.inc("requests_total", op="b")
+    reg.inc("requests_total", op="c")
+    reg.inc("requests_total", op="d")
+    reg.inc("requests_total", op="a")  # existing series still lands home
+    j = reg.to_json()
+    series = {s["labels"]["op"]: s["value"]
+              for s in j["counters"]["requests_total"]}
+    assert series == {"a": 2.0, "b": 1.0, OVERFLOW_FAMILY: 2.0}
+    out = reg.render_prometheus()
+    _assert_prometheus_grammar(out)
+    assert f'op="{OVERFLOW_FAMILY}"' in out
+
+
+def test_attach_board_accepts_callable_and_rejects_junk():
+    """The router REPLACES its fleet board wholesale on every refresh,
+    so `attach_board` takes a zero-arg callable resolved at scrape
+    time: the render always sees the current board, not a stale
+    reference."""
+    reg = MetricsRegistry(namespace="t")
+    holder = {"board": LatencyBoard()}
+    holder["board"].observe("episode.run", 0.01)
+    reg.attach_board("fleet", lambda: holder["board"])
+    assert "t_fleet_count" in reg.render_prometheus()
+    (line,) = [ln for ln in reg.render_prometheus().splitlines()
+               if ln.startswith("t_fleet_count")]
+    assert line.endswith(" 1")
+    # wholesale replacement (a fresh merge) is visible immediately
+    fresh = LatencyBoard()
+    fresh.merge_dict(holder["board"].to_dict())
+    fresh.merge_dict(holder["board"].to_dict())
+    holder["board"] = fresh
+    (line,) = [ln for ln in reg.render_prometheus().splitlines()
+               if ln.startswith("t_fleet_count")]
+    assert line.endswith(" 2")
+    assert reg.to_json()["histograms_raw"]["fleet"]["episode.run"][
+        "count"] == 2
+    with pytest.raises(TypeError, match="LatencyBoard"):
+        reg.attach_board("junk", {"not": "a board"})
+    with pytest.raises(ValueError, match="already registered"):
+        reg.inc("dup")
+        reg.attach_board("dup", LatencyBoard())
+
+
+def test_to_json_raw_form_is_mergeable():
+    """`histograms_raw` is the fleet-merge input: a downstream board
+    must be able to `merge_dict` it exactly."""
+    board = LatencyBoard()
+    for d in (0.01, 0.02, 0.04):
+        board.observe("episode.run", d)
+    reg = MetricsRegistry(namespace="t")
+    reg.attach_board("lat", board)
+    downstream = LatencyBoard()
+    downstream.merge_dict(reg.to_json()["histograms_raw"]["lat"])
+    assert downstream.get("episode.run").count == 3
+    assert downstream.get("episode.run").sum_s == pytest.approx(0.07)
+
+
+# -- MetricsServer (the --metrics-port HTTP endpoint) ------------------------
+
+
+def _get(port, path):
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=10) as r:
+            return r.status, r.headers.get("Content-Type"), \
+                r.read().decode("utf-8")
+    except urllib.error.HTTPError as e:
+        return e.code, None, ""
+
+
+def test_metrics_server_serves_text_format_and_404s():
+    reg = MetricsRegistry(namespace="t")
+    reg.inc("requests_total", op="run")
+    srv = MetricsServer(reg.render_prometheus, port=0)
+    port = srv.start()
+    try:
+        assert port > 0
+        for path in ("/", "/metrics", "/metrics?x=1"):
+            status, ctype, body = _get(port, path)
+            assert status == 200
+            assert ctype == PROMETHEUS_CONTENT_TYPE
+            _assert_prometheus_grammar(body)
+            assert "t_requests_total" in body
+        # the scrape is live: later increments show on the next GET
+        reg.inc("requests_total", op="run")
+        _, _, body = _get(port, "/metrics")
+        assert 't_requests_total{op="run"} 2' in body
+        assert _get(port, "/nope")[0] == 404
+    finally:
+        srv.stop()
+    with pytest.raises(OSError):  # stopped means the port is released
+        socket.create_connection(("127.0.0.1", port), timeout=0.5)
+
+
+def test_metrics_server_500s_on_broken_render():
+    srv = MetricsServer(lambda: 1 / 0, port=0)
+    port = srv.start()
+    try:
+        assert _get(port, "/metrics")[0] == 500
+    finally:
+        srv.stop()
+
+
+# -- AlertEngine -------------------------------------------------------------
+
+
+def test_default_windows_scale_from_slo_with_floors_and_caps():
+    assert default_windows(1.0) == ((10.0, "page", PAGE_BURN),
+                                    (60.0, "ticket", TICKET_BURN))
+    # tiny SLOs floor (5 s / 30 s), huge ones cap (5 min / 1 h)
+    assert default_windows(0.01) == ((5.0, "page", PAGE_BURN),
+                                     (30.0, "ticket", TICKET_BURN))
+    assert default_windows(1000.0) == ((300.0, "page", PAGE_BURN),
+                                       (3600.0, "ticket", TICKET_BURN))
+
+
+def test_burn_rate_never_sees_missing_data():
+    assert burn_rate(0.04, 0.02) == pytest.approx(2.0)
+    assert burn_rate(None, 0.02) is None
+    assert burn_rate(0.04, None) is None
+    assert burn_rate(0.04, 0.0) is None
+    assert burn_rate(0.04, -1.0) is None
+
+
+def _engine(**kw):
+    """An engine on a fake clock with one tight page window."""
+    clock = [0.0]
+    kw.setdefault("windows", ((5.0, "page", PAGE_BURN),))
+    kw.setdefault("min_samples", 4)
+    eng = AlertEngine(slo_s=kw.pop("slo_s", 0.5),
+                      now_fn=lambda: clock[0], **kw)
+    return eng, clock
+
+
+def test_shed_rate_alert_fires_cools_down_and_recovers():
+    eng, clock = _engine()
+    for _ in range(8):
+        eng.record_admission(shed=True)
+    (alert,) = eng.evaluate()
+    assert alert["signal"] == "shed_rate" and alert["cls"] is None
+    assert alert["severity"] == "page" and alert["window_s"] == 5.0
+    assert alert["value"] == pytest.approx(1.0)
+    assert alert["budget"] == pytest.approx(DEFAULT_SHED_BUDGET)
+    assert alert["burn_rate"] == pytest.approx(1.0 / 0.02)
+    assert eng.summary() == {"active": [alert], "fired": 1}
+    # the breach persists but the cooldown gates the re-emit ...
+    clock[0] = 2.0
+    assert eng.evaluate() == []
+    assert eng.summary()["active"] == [alert] and eng.n_fired == 1
+    # ... until one full window has passed
+    clock[0] = 5.0
+    for _ in range(4):
+        eng.record_admission(shed=True)
+    assert len(eng.evaluate()) == 1 and eng.n_fired == 2
+    # recovery: the shed fraction dropping under budget clears active
+    clock[0] = 9.9
+    for _ in range(200):
+        eng.record_admission(shed=False)
+    assert eng.evaluate() == []
+    assert eng.summary() == {"active": [], "fired": 2}
+
+
+def test_p99_over_slo_alert_is_per_class_and_sample_gated():
+    eng, clock = _engine(class_slo={"interactive": 0.1, "batch": 2.0})
+    eng.record_latency("interactive", None)  # dropped at the door
+    for _ in range(3):
+        eng.record_latency("interactive", 5.0)
+    assert eng.evaluate() == []  # under min_samples: skipped, not None
+    for _ in range(5):
+        eng.record_latency("interactive", 5.0)
+        eng.record_latency("batch", 0.01)  # well inside its budget
+    (alert,) = eng.evaluate()
+    assert alert["signal"] == "p99_over_slo"
+    assert alert["cls"] == "interactive"
+    assert alert["value"] == pytest.approx(5.0)
+    assert alert["budget"] == pytest.approx(0.1)
+    assert alert["burn_rate"] == pytest.approx(50.0)
+    # old samples age out of the window: the signal goes quiet
+    clock[0] = 100.0
+    for _ in range(8):
+        eng.record_latency("batch", 0.01)
+    assert eng.evaluate() == []
+
+
+def test_budgetless_class_is_skipped_not_nonsense():
+    """slo_s=None and no class budget: the p99 signal cannot be judged
+    and is skipped outright — None never reaches burn-rate math."""
+    eng, _ = _engine(slo_s=None)
+    for _ in range(16):
+        eng.record_latency("interactive", 99.0)
+        eng.record_admission(shed=False)
+    assert eng.evaluate() == []
+    assert eng.summary() == {"active": [], "fired": 0}
+
+
+def test_emit_alert_is_v14_schema_complete(tmp_path):
+    path = tmp_path / "alert.jsonl"
+    telemetry.configure(str(path))
+    try:
+        emit_alert({"signal": "shed_rate", "severity": "page",
+                    "window_s": 5.0, "value": 0.4, "budget": 0.02,
+                    "burn_rate": 20.0, "cls": None, "threshold": 4.0,
+                    "slo_s": 0.5})
+    finally:
+        telemetry.configure(None)
+    (ev,) = [json.loads(ln) for ln in open(path)]
+    assert ev["name"] == "alert"
+    missing = [k for k in telemetry.EVENT_FIELDS["alert"]
+               if k not in ev]
+    assert not missing
+
+
+# -- flight recorder (ring + dump) -------------------------------------------
+
+
+def test_blackbox_ring_capacity_env_and_oldest_first(monkeypatch):
+    monkeypatch.setenv(telemetry.BLACKBOX_ENV_VAR, "16")
+    monkeypatch.setattr(telemetry, "_blackbox", None)  # fresh ring
+    assert telemetry.blackbox_capacity() == 16
+    tele = telemetry.Telemetry()  # sinkless: the ring still records
+    for i in range(40):
+        tele.event("tick", i=i)
+    events = telemetry.blackbox_events()
+    assert len(events) == 16
+    assert [e["i"] for e in events] == list(range(24, 40))
+    # a junk capacity falls back to the default instead of crashing
+    monkeypatch.setenv(telemetry.BLACKBOX_ENV_VAR, "banana")
+    assert telemetry.blackbox_capacity() == \
+        telemetry.BLACKBOX_DEFAULT_EVENTS
+
+
+def test_dump_blackbox_writes_validating_artifact(tmp_path,
+                                                  monkeypatch):
+    monkeypatch.setattr(telemetry, "_blackbox", None)
+    tele = telemetry.Telemetry()
+    tele.event("marker", n=1)
+    tele.event("marker", n=2)
+    path = dump_blackbox("test:unit", dest_dir=str(tmp_path))
+    assert path == blackbox_path(str(tmp_path))
+    name = os.path.basename(path)
+    assert re.fullmatch(
+        rf"blackbox-{telemetry.run_id()}-{os.getpid()}\.jsonl", name)
+    # atomic publish: the final name only, no orphaned tmp sibling
+    assert [p.name for p in tmp_path.iterdir()] == [name]
+    lines = [json.loads(ln) for ln in open(path)]
+    man, events = lines[0], lines[1:]
+    assert man["kind"] == "manifest" and man["backend"]
+    assert man["config"]["entry"] == "blackbox"
+    assert man["config"]["reason"] == "test:unit"
+    assert man["config"]["n_events"] == len(events) == 2
+    assert man["config"]["capacity"] == telemetry.blackbox_capacity()
+    assert [e["n"] for e in events] == [1, 2]  # oldest-first
+    # the dump is a standalone trace: the validator accepts it
+    ts = _load_trace_summary()
+    read, bad = ts.read_events(path)
+    assert ts.validate(read, bad) == []
+
+
+def test_dump_blackbox_never_raises(monkeypatch):
+    def boom(*a, **kw):
+        raise OSError("disk is gone")
+
+    monkeypatch.setattr(resilience, "atomic_write_text", boom)
+    assert dump_blackbox("test:broken-disk") is None
+
+
+# -- crash-path integration (satellite d): the real triggers -----------------
+
+
+def _load_trace_summary():
+    path = os.path.join(REPO, "tools", "trace_summary.py")
+    spec = importlib.util.spec_from_file_location("trace_summary", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _spawn_serve_child(tmp_path, extra_env=None, extra_args=()):
+    """One real serve subprocess on tiny geometry, blackbox directed
+    at tmp_path, telemetry to a sibling stream.  Returns (proc, ready
+    dict) once the ready file lands."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["CPR_BLACKBOX_DIR"] = str(tmp_path)
+    env[telemetry.TELEMETRY_ENV_VAR] = str(tmp_path / "serve.jsonl")
+    env.update(extra_env or {})
+    ready = tmp_path / "ready.json"
+    cmd = [sys.executable, "-m", "cpr_tpu.serve.server",
+           "--port", "0", "--ready-file", str(ready),
+           "--lanes", "2", "--burst", "4", "--max-steps", "16",
+           "--heartbeat-s", "0.2", *extra_args]
+    proc = subprocess.Popen(cmd, env=env, cwd=REPO,
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True)
+    deadline = time.time() + 180.0
+    while time.time() < deadline:
+        if proc.poll() is not None:
+            out, err = proc.communicate()
+            raise AssertionError(
+                f"server died before ready (rc={proc.returncode})\n"
+                f"{out}\n{err}")
+        try:
+            info = json.loads(ready.read_text())
+            return proc, info
+        except (OSError, ValueError):
+            time.sleep(0.05)
+    proc.kill()
+    raise AssertionError("server not ready within 180s")
+
+
+def _blackbox_dumps(tmp_path):
+    return sorted(tmp_path.glob("blackbox-*.jsonl"))
+
+
+def _read_dump(path):
+    lines = [json.loads(ln) for ln in open(path)]
+    return lines[0], lines[1:]
+
+
+def test_injected_kill_at_replica_dumps_blackbox(tmp_path):
+    """kill@replica=0 through the real injector: the InjectedKill
+    unwinds the serve main like the crash it stands in for, and the
+    main wrapper's dump trigger leaves a schema-valid blackbox whose
+    ring recorded the injected fault itself."""
+    proc, info = _spawn_serve_child(
+        tmp_path,
+        extra_env={resilience.FAULT_ENV_VAR: "kill@replica=0",
+                   telemetry.BLACKBOX_ENV_VAR: "64"},
+        extra_args=("--replica-index", "0"))
+    # one fire-and-forget episode keeps the tick loop bursting; the
+    # fault fires after the first completed burst, so the reply may
+    # never come back — send raw and only wait on the process
+    with socket.create_connection(("127.0.0.1", info["port"]),
+                                  timeout=10) as s:
+        s.sendall(wire.pack_frame(
+            dict(op="episode.run", policy="honest", seed=0)))
+        rc = proc.wait(timeout=180)
+    out, err = proc.communicate()
+    assert rc != 0, f"injected kill must not exit clean\n{out}\n{err}"
+    (dump,) = _blackbox_dumps(tmp_path)
+    man, events = _read_dump(dump)
+    assert man["config"]["reason"] == "serve:InjectedKill"
+    assert man["config"]["pid"] == info["pid"]
+    assert len(events) <= 64  # capped at the ring bound
+    # the flight recorder caught the fault marker on its way down
+    faults = [e for e in events if e.get("name") == "fault_injected"]
+    assert faults and faults[0]["site"] == "replica"
+    ts = _load_trace_summary()
+    read, bad = ts.read_events(str(dump))
+    assert ts.validate(read, bad) == []
+
+
+def test_sigterm_preemption_drains_and_dumps_blackbox(tmp_path):
+    """The preemption path: SIGTERM lands in the preemption guard, the
+    serve loop drains gracefully (exit 0), and the post-drain trigger
+    still dumps the blackbox — a preempted replica leaves the same
+    artifact a crashed one does."""
+    proc, info = _spawn_serve_child(tmp_path)
+    os.kill(proc.pid, signal.SIGTERM)
+    rc = proc.wait(timeout=180)
+    out, err = proc.communicate()
+    assert rc == 0, f"preemption drain must exit clean\n{out}\n{err}"
+    (dump,) = _blackbox_dumps(tmp_path)
+    man, events = _read_dump(dump)
+    assert man["config"]["reason"].startswith("serve:preempt:")
+    assert events, "the drain's own events are in the ring"
+    ts = _load_trace_summary()
+    read, bad = ts.read_events(str(dump))
+    assert ts.validate(read, bad) == []

@@ -533,3 +533,92 @@ def test_malformed_dag_dump_atomic(tmp_path, monkeypatch):
         trace.raise_malformed(view, "parent id above child")
     assert target.read_text().startswith("digraph")
     assert [p.name for p in tmp_path.iterdir()] == ["malformed.dot"]
+
+
+def test_trace_summary_v14_alert_table_and_expect(tmp_path, capsys):
+    """Satellite a: the v14 `alert` event round-trips the validator
+    (including `--expect alert`), renders as the aggregated alert
+    table, and a burn_rate-less alert is caught as a schema error."""
+    ts = _load_trace_summary()
+    good = tmp_path / "alerts.jsonl"
+    tele = telemetry.Telemetry(str(good))
+    with tele.span("serve"):
+        pass
+    for burn in (8.0, 20.0):
+        tele.event("alert", signal="shed_rate", severity="page",
+                   window_s=5.0, value=burn * 0.02, budget=0.02,
+                   burn_rate=burn, cls=None, threshold=4.0, slo_s=0.5)
+    tele.event("alert", signal="p99_over_slo", severity="ticket",
+               window_s=30.0, value=1.2, budget=0.5, burn_rate=2.4,
+               cls="interactive", threshold=1.0, slo_s=0.5)
+    tele.manifest(config={"entry": "serve"})
+    tele.close()
+    events, bad = ts.read_events(str(good))
+    (man,) = [e for e in events if e.get("kind") == "manifest"]
+    assert man["schema"] >= 14
+    assert ts.validate(events, bad) == []
+    assert ts.validate(events, bad, expect=("alert",)) == []
+    ts.main(["trace_summary", str(good), "--validate",
+             "--expect", "alert"])  # exits 0
+    out = capsys.readouterr().out
+    # the aggregate table: one line per signal x class x severity x
+    # window, carrying the fire count and the worst burn
+    assert "alert signal" in out and "max_burn" in out
+    (shed_line,) = [ln for ln in out.splitlines()
+                    if ln.startswith("shed_rate")]
+    assert " 2 " in shed_line and "20.0" in shed_line
+    assert any(ln.startswith("p99_over_slo") and "interactive" in ln
+               for ln in out.splitlines())
+    # an alert stream without any alert events fails the expectation
+    assert any("alert" in err for err in
+               ts.validate([man], [], expect=("alert",)))
+
+    lame = tmp_path / "lame.jsonl"
+    lines = []
+    for line in good.read_text().splitlines():
+        e = json.loads(line)
+        if e.get("name") == "alert":
+            e.pop("burn_rate")
+        lines.append(json.dumps(e))
+    lame.write_text("\n".join(lines) + "\n")
+    events, bad = ts.read_events(str(lame))
+    errors = ts.validate(events, bad)
+    assert any("alert" in err and "burn_rate" in err for err in errors)
+
+
+def test_trace_stitch_tallies_unpaired_typed_events(tmp_path, capsys):
+    """Satellite a: typed point events with no trace side (v14 alerts,
+    route decisions, admission sheds) are tolerated and tallied per
+    name — a stream full of alerts reads as health signal, not as
+    stitching loss, and the request pairing is unaffected."""
+    stitcher = _load_trace_stitch()
+    run = "cafebabe00112233"
+    server = tmp_path / "server.jsonl"
+    tele = telemetry.Telemetry(str(server))
+    tele.emit({"kind": "manifest", "run": run, "backend": "cpu"})
+    _request_line(tele, "t1", "server", run)
+    for burn in (8.0, 16.0):
+        tele.event("alert", signal="shed_rate", severity="page",
+                   window_s=5.0, value=burn * 0.02, budget=0.02,
+                   burn_rate=burn)
+    tele.event("admission", reason="queue_full", op="episode.run",
+               priority=1, tenant=None, retry_after_s=0.5)
+    tele.close()
+    client = tmp_path / "client.jsonl"
+    tele = telemetry.Telemetry(str(client))
+    tele.emit({"kind": "manifest", "run": run})
+    _request_line(tele, "t1", "client", run, total_s=0.45)
+    tele.close()
+
+    st = stitcher.stitch([str(server), str(client)])
+    assert st["unpaired"] == {"alert": 2, "admission": 1}
+    by_stream = {s["name"]: s["unpaired"] for s in st["streams"]}
+    assert by_stream["server.jsonl"] == {"alert": 2, "admission": 1}
+    assert by_stream["client.jsonl"] == {}
+    # pairing still exact: the typed noise stole nothing
+    (t1,) = st["traces"]
+    assert t1["orphan"] is None and st["orphans"] == 0
+
+    assert stitcher.main([str(server), str(client)]) == 0
+    out = capsys.readouterr().out
+    assert "unpaired typed events: admission=1 alert=2" in out

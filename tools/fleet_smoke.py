@@ -21,23 +21,36 @@ on CPU, the way the SERVING.md runbook describes it:
   4  the killed replica warm-restarts (fault env stripped: one-shot),
      rejoins the fleet, and serves a post-restart round; router stats
      must show the requeue/shed/restart accounting;
-  5  a router-initiated drain, then the evidence: the v9 `route` trail
-     (replica_up/down, requeue, drain, stop) and `admission` shed
-     events validate via `trace_summary --validate --expect
-     admission,route,serve,request`, `trace_stitch` pairs at least one
-     request across client+router+replica streams with a `route` leg,
-     and the drain reports' per-class p99 + shed-rate rows ingest into
-     a fresh perf ledger and clear the gate.
+  5  mid-flood, scrape the v14 health plane both ways: HTTP GET on the
+     router's and replicas' `--metrics-port` endpoints (every line
+     checked against the Prometheus text-format grammar) and the
+     in-band `metrics.scrape` op; after the load, prove the fleet
+     latency merge is exact by re-merging the per-replica raw bucket
+     payloads by hand and comparing the router's fleet board
+     byte-for-byte (bucket-sum, never quantile-of-quantiles);
+  6  a router-initiated drain, then the evidence: the v9 `route` trail
+     (replica_up/down, requeue, drain, stop), `admission` shed events,
+     and at least one v14 `alert` fired by the chaos leg validate via
+     `trace_summary --validate --expect admission,route,serve,request,
+     alert`; the killed replica's crash flight recorder left a
+     schema-valid blackbox dump in the workdir; `trace_stitch` pairs
+     at least one request across client+router+replica streams with a
+     `route` leg; and the drain reports' per-class p99 + shed-rate
+     rows plus the router's `fleet_p99_s` rows ingest into a fresh
+     perf ledger and clear the gate.
 
 Usage: python tools/fleet_smoke.py [workdir]   (default /tmp/...)
 """
 
+import glob
 import json
 import os
+import re
 import subprocess
 import sys
 import threading
 import time
+import urllib.request
 from concurrent.futures import ThreadPoolExecutor
 
 ROOT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
@@ -62,6 +75,16 @@ SEED0 = 9001
 ROUTER_SEED_BASE = 1 << 21  # router-stamped seeds live above this
 READY_TIMEOUT_S = 600.0
 FLOOD_TIMEOUT_S = 300.0
+# a tight SLO scales the alert windows down (fast page window floors
+# at 5 s), so the chaos leg's shed burst fires a v14 alert in-run
+SLO_S = 0.5
+
+# Prometheus text format 0.0.4: every non-comment line is one sample
+_PROM_SAMPLE_RE = re.compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*'
+    r'(\{[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*"'
+    r'(,[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*")*\})? '
+    r'(-?[0-9]+(\.[0-9]+)?([eE][+-]?[0-9]+)?|\+Inf|-Inf|NaN)$')
 
 
 def _log(msg):
@@ -74,6 +97,7 @@ def _router_cmd(workdir):
             "--max-steps", str(MAX_STEPS), "--lanes", str(LANES),
             "--burst", str(BURST), "--max-queue", str(MAX_QUEUE),
             "--heartbeat-s", "0.5", "--workdir", workdir,
+            "--slo-s", str(SLO_S), "--metrics-port", "0",
             "--ready-file", os.path.join(workdir, "router.json")]
 
 
@@ -81,6 +105,7 @@ def _router_env(workdir, trace):
     env = dict(os.environ, JAX_PLATFORMS="cpu",
                CPR_TELEMETRY=trace, CPR_DEVICE_METRICS="1",
                CPR_FAULT_INJECT="kill@replica=1",
+               CPR_BLACKBOX_DIR=workdir,
                CPR_RUN_ID=telemetry.run_id(),
                CPR_TPU_CACHE=os.path.join(workdir, "cache"))
     env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
@@ -169,15 +194,98 @@ def _flood_worker(port, seed, sleeps, lock):
         return r
 
 
-def _flood(port):
+def _assert_prometheus_text(body, family_prefix, label):
+    """The same line-by-line grammar check the tier-1 monitor tests
+    pin: comments or well-formed samples only, no Python `None`."""
+    if "None" in body:
+        raise SystemExit(f"{label}: Python None leaked into the "
+                         f"Prometheus exposition")
+    samples = 0
+    for line in body.splitlines():
+        if line.startswith("# HELP ") or line.startswith("# TYPE "):
+            continue
+        if not _PROM_SAMPLE_RE.match(line):
+            raise SystemExit(f"{label}: bad Prometheus sample line: "
+                             f"{line!r}")
+        samples += 1
+    if not any(ln.startswith(family_prefix) for ln in body.splitlines()):
+        raise SystemExit(f"{label}: no {family_prefix}* family in the "
+                         f"exposition")
+    return samples
+
+
+def _scrape_http(ready):
+    """Mid-flood HTTP scrape of every live exposition endpoint: the
+    router's own and each replica's (a replica mid-kill may refuse —
+    at least one replica endpoint must answer)."""
+    n = _assert_prometheus_text(
+        _http_get(ready["metrics_port"]), "cpr_router_", "router scrape")
+    _log(f"HTTP scrape: router exposed {n} samples")
+    ok = 0
+    for idx, port in sorted((ready.get("replica_metrics_ports")
+                             or {}).items()):
+        if port is None:
+            continue
+        try:
+            body = _http_get(port)
+        except OSError:
+            continue  # the chaos leg may have just killed this one
+        _assert_prometheus_text(body, "cpr_serve_", f"replica {idx}")
+        if f'replica="{idx}"' not in body:
+            raise SystemExit(f"replica {idx} exposition lacks its "
+                             f"replica const label")
+        ok += 1
+    if not ok:
+        raise SystemExit("no replica metrics endpoint answered the "
+                         "mid-flood scrape")
+    return ok
+
+
+def _http_get(port):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=30) as r:
+        if r.status != 200:
+            raise SystemExit(f"metrics endpoint returned {r.status}")
+        ctype = r.headers.get("Content-Type", "")
+        if "version=0.0.4" not in ctype:
+            raise SystemExit(f"wrong exposition content type: {ctype}")
+        return r.read().decode("utf-8")
+
+
+def _scrape_inband(port):
+    """The in-band path: `metrics.scrape` answered at the router with
+    its registry JSON plus the freshly merged fleet view."""
+    with ServeClient("127.0.0.1", port) as c:
+        r = c.request("metrics.scrape")
+    if not r.get("ok"):
+        raise SystemExit(f"metrics.scrape refused: {r}")
+    m = r["metrics"]
+    if m["namespace"] != "cpr_router" or "counters" not in m:
+        raise SystemExit(f"unexpected metrics.scrape payload: "
+                         f"{sorted(m)}")
+    fleet = r["fleet"]
+    for key in ("latencies", "latencies_raw", "p99_s"):
+        if key not in fleet:
+            raise SystemExit(f"metrics.scrape fleet view lacks {key}")
+    return fleet
+
+
+def _flood(port, ready):
     """The chaos window: concurrent seeded load that both triggers the
     armed kill@replica=1 (first burst under load) and overloads the
-    surviving capacity into in-band sheds."""
+    surviving capacity into in-band sheds.  The health plane is
+    scraped both ways WHILE the flood is in flight — live exposition
+    under load is the thing being proven."""
     sleeps, lock = [], threading.Lock()
     seeds = [SEED0 + i for i in range(N_SEEDED)] + [None] * N_SEEDLESS
     with ThreadPoolExecutor(max_workers=len(seeds)) as pool:
         jobs = [pool.submit(_flood_worker, port, s, sleeps, lock)
                 for s in seeds]
+        n_http = _scrape_http(ready)
+        fleet = _scrape_inband(port)
+        _log(f"mid-flood scrape: router + {n_http} replica HTTP "
+             f"endpoints grammar-clean; in-band fleet families "
+             f"{sorted(fleet['p99_s']) or '(none yet)'}")
         deadline = time.time() + FLOOD_TIMEOUT_S
         replies = [j.result(timeout=max(1.0, deadline - time.time()))
                    for j in jobs]  # a timeout here IS a client hang
@@ -299,6 +407,99 @@ def _check_sheds(replica_traces, stats, sleeps):
     return len(adm)
 
 
+def _check_fleet_merge(stats):
+    """The fleet merge must be EXACT: re-merge the per-replica raw
+    bucket payloads from one stats reply by hand and compare the
+    router's fleet board from the same reply byte-for-byte.  A
+    quantile-of-quantiles shortcut (or a double-count from a carried
+    board) cannot survive this."""
+    from cpr_tpu.latency import LatencyBoard
+
+    by_hand = LatencyBoard()
+    for rep in stats["replicas"].values():
+        raw = rep.get("latencies_raw")
+        if isinstance(raw, dict):
+            by_hand.merge_dict(raw)
+    fleet_raw = stats["fleet"]["latencies_raw"]
+    if by_hand.to_dict() != fleet_raw:
+        raise SystemExit("router fleet board diverges from the "
+                         "merged-by-hand reference")
+    if "episode.run" not in fleet_raw or \
+            fleet_raw["episode.run"]["count"] < 1:
+        raise SystemExit(f"fleet board has no episode.run latencies: "
+                         f"{sorted(fleet_raw)}")
+    snap = stats["fleet"]["latencies"]["episode.run"]
+    ref = by_hand.get("episode.run").snapshot()
+    if snap != ref:
+        raise SystemExit(f"fleet p99 snapshot diverges from the "
+                         f"by-hand merge: {snap} vs {ref}")
+    return fleet_raw["episode.run"]["count"]
+
+
+def _check_alerts(replica_traces, stats):
+    """The chaos leg must fire at least one typed v14 alert (the shed
+    burst against the halved fleet burns the 2% shed budget at >4x on
+    the fast window), schema-complete, and the drain reports carry the
+    alerts block."""
+    alerts = [e for p in replica_traces for e in _events(p, "alert")]
+    if not alerts:
+        raise SystemExit("no v14 alert event in any replica trace — "
+                         "the chaos leg burned no error budget?")
+    for e in alerts:
+        missing = [k for k in ("signal", "severity", "window_s",
+                               "value", "budget", "burn_rate")
+                   if k not in e]
+        if missing:
+            raise SystemExit(f"alert event missing {missing}: {e}")
+    if not any(e["signal"] == "shed_rate" for e in alerts):
+        raise SystemExit(f"no shed_rate alert among "
+                         f"{[e['signal'] for e in alerts]}")
+    reported = [v.get("alerts") for v in stats["replicas"].values()
+                if isinstance(v.get("alerts"), dict)]
+    if not any(a.get("fired", 0) >= 1 for a in reported):
+        raise SystemExit(f"no replica stats carries a fired alert "
+                         f"count: {reported}")
+    return len(alerts)
+
+
+def _check_blackbox(workdir):
+    """The killed replica's flight recorder must have dumped: a
+    schema-valid blackbox whose header names the InjectedKill."""
+    dumps = sorted(glob.glob(os.path.join(workdir, "blackbox-*.jsonl")))
+    if not dumps:
+        raise SystemExit("no blackbox dump in the workdir — the "
+                         "killed replica's flight recorder is dark")
+    reasons = []
+    for p in dumps:
+        with open(p) as f:
+            man = json.loads(f.readline())
+        if man.get("kind") != "manifest" or not man.get("backend"):
+            raise SystemExit(f"{p}: blackbox header is not a "
+                             f"backend-bearing manifest")
+        reasons.append(man.get("config", {}).get("reason"))
+        _validate_stream(p, expect=None)
+    if not any(r == "serve:InjectedKill" for r in reasons):
+        raise SystemExit(f"no blackbox names the injected kill: "
+                         f"{reasons}")
+    return reasons
+
+
+def _check_fleet_report(router_trace):
+    """The router's drain-time fleet_report: the fleet-merged per-
+    family p99 the perf ledger lifts into fleet_p99_s rows."""
+    reports = _events(router_trace, "serve", "fleet_report")
+    if not reports:
+        raise SystemExit("router trace has no fleet_report event")
+    fleet = (reports[-1].get("detail") or {}).get("fleet_p99_s")
+    if not isinstance(fleet, dict) or "episode.run" not in fleet:
+        raise SystemExit(f"fleet_report lacks fleet_p99_s[episode.run]: "
+                         f"{reports[-1]}")
+    if not (isinstance(fleet["episode.run"], float)
+            and fleet["episode.run"] > 0):
+        raise SystemExit(f"degenerate fleet p99: {fleet}")
+    return fleet
+
+
 def _check_reports(replica_traces):
     """At least one drain report must carry the per-class tail and a
     nonzero shed rate (the overloaded survivor's report)."""
@@ -336,13 +537,14 @@ def _merge_streams(workdir, paths):
     return merged
 
 
-def _validate_stream(trace):
+def _validate_stream(trace,
+                     expect="admission,route,serve,request,alert"):
     tool = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "trace_summary.py")
-    r = subprocess.run(
-        [sys.executable, tool, trace, "--validate",
-         "--expect", "admission,route,serve,request"],
-        capture_output=True, text=True)
+    cmd = [sys.executable, tool, trace, "--validate"]
+    if expect:
+        cmd += ["--expect", expect]
+    r = subprocess.run(cmd, capture_output=True, text=True)
     if r.returncode != 0:
         sys.stderr.write(r.stdout + r.stderr)
         raise SystemExit(f"telemetry validation failed for {trace}")
@@ -368,14 +570,15 @@ def _check_stitch(streams):
 
 
 # every drain report must land these rows; per-class p99 rows ride on
-# the same serve_p99_s metric with a cfg_class fingerprint
+# the same serve_p99_s metric with a cfg_class fingerprint, and the
+# router's fleet_report lands the fleet-merged per-family tail
 _REQUIRED_METRICS = ("serve_steps_per_sec", "serve_p99_s",
-                     "serve_shed_rate")
+                     "serve_shed_rate", "fleet_p99_s")
 
 
-def _bank_and_gate(workdir, replica_traces):
+def _bank_and_gate(workdir, traces):
     ledger = Ledger(os.path.join(workdir, "perf_ledger.jsonl"))
-    n = sum(ledger.ingest_trace(p) for p in replica_traces)
+    n = sum(ledger.ingest_trace(p) for p in traces)
     records = ledger.records()
     results = []
     for metric in _REQUIRED_METRICS:
@@ -388,6 +591,12 @@ def _bank_and_gate(workdir, replica_traces):
     if not per_class:
         raise SystemExit("no per-class serve_p99_s row (cfg_class) "
                          "reached the ledger")
+    fleet_rows = [r for r in records if r.get("metric") == "fleet_p99_s"]
+    if not any(r.get("config", {}).get("cfg_family") == "episode.run"
+               for r in fleet_rows):
+        raise SystemExit(f"no fleet_p99_s row for episode.run reached "
+                         f"the ledger: "
+                         f"{[r.get('config') for r in fleet_rows]}")
     summary = gate_summary(results)
     if not summary["ok"]:
         raise SystemExit(f"fleet perf gate failed: {results}")
@@ -418,9 +627,10 @@ def main():
                             log_path)
         port = ready["port"]
         _log(f"router ready on port {port} with {ready['replicas']} "
-             f"replicas (kill@replica=1 armed)")
+             f"replicas (kill@replica=1 armed, metrics port "
+             f"{ready.get('metrics_port')})")
 
-        replies, sleeps = _flood(port)
+        replies, sleeps = _flood(port, ready)
         _log(f"flood: {len(replies)} concurrent episode.run all "
              f"answered (no hangs), {len(sleeps)} retry backoffs")
         _check_episodes(replies, "flood")
@@ -432,6 +642,9 @@ def main():
         post = _post_restart_flood(port, sleeps)
         _check_episodes(post, "post-restart")
         stats = _stats(port)
+        n_fleet = _check_fleet_merge(stats)
+        _log(f"fleet latency merge exact over {n_fleet} episode.run "
+             f"observations (bucket-sum == merged-by-hand)")
 
         with ServeClient("127.0.0.1", port) as c:
             r = c.request("drain")
@@ -451,6 +664,11 @@ def main():
     _log(f"failover accounting: {n_requeues} requeues, {n_sheds} "
          f"in-band sheds (router stats {stats['router']})")
     _check_reports(replica_traces)
+    n_alerts = _check_alerts(replica_traces, stats)
+    reasons = _check_blackbox(work)
+    fleet_p99 = _check_fleet_report(router_trace)
+    _log(f"health plane: {n_alerts} v14 alerts fired, blackbox dumps "
+         f"{reasons}, fleet p99 {fleet_p99}")
     telemetry.configure(None)  # close the client sink before reading
     merged = _merge_streams(
         work, [router_trace, *replica_traces, client_trace])
@@ -458,11 +676,12 @@ def main():
     paired, total = _check_stitch(
         [router_trace, *replica_traces, client_trace])
     _log(f"trace_stitch: {paired}/{total} traces carry the router hop")
-    n_rows, n_class, summary = _bank_and_gate(work, replica_traces)
+    n_rows, n_class, summary = _bank_and_gate(
+        work, [*replica_traces, router_trace])
     print(f"fleet-smoke: PASS ({N_SEEDED + N_SEEDLESS + len(post)} "
           f"bit-identical episodes through a replica kill; {n_rows} "
-          f"ledger rows banked incl. {n_class} per-class serve_p99_s; "
-          f"gate {summary})")
+          f"ledger rows banked incl. {n_class} per-class serve_p99_s "
+          f"+ fleet_p99_s; {n_alerts} alerts; gate {summary})")
 
 
 if __name__ == "__main__":

@@ -53,9 +53,14 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
 def read_stream(path: str) -> dict:
     """One JSONL stream -> its run ids (manifests + request extras) and
     `request` events, each stamped with the stream name and its line
-    order (the only cross-event order that exists within a stream)."""
+    order (the only cross-event order that exists within a stream).
+    Typed point events other than `request` (alert, route, admission,
+    ... — schema v14 grows them) carry no trace_id and can never pair
+    into a trace: they are tolerated and tallied per name as
+    `unpaired`, never treated as malformed."""
     name = os.path.basename(path)
     runs, requests, n = [], [], 0
+    unpaired: dict = defaultdict(int)
     with open(path) as f:
         for i, line in enumerate(f, 1):
             line = line.strip()
@@ -73,8 +78,11 @@ def read_stream(path: str) -> dict:
                     runs.append(e["run"])
             elif e.get("kind") == "event" and e.get("name") == "request":
                 requests.append(dict(e, _stream=name, _line=i))
+            elif e.get("kind") == "event" and e.get("name"):
+                unpaired[str(e["name"])] += 1
     return {"path": path, "name": name, "runs": runs,
-            "requests": requests, "n_events": n}
+            "requests": requests, "n_events": n,
+            "unpaired": dict(unpaired)}
 
 
 def _num(v):
@@ -163,14 +171,20 @@ def stitch(paths) -> dict:
         if bd["total_s"] is not None:
             a["sum_total_s"] += bd["total_s"]
             a["max_total_s"] = max(a["max_total_s"], bd["total_s"])
+    unpaired: dict = defaultdict(int)
+    for s in streams:
+        for nm, c in s["unpaired"].items():
+            unpaired[nm] += c
     return {"streams": [{"name": s["name"], "path": s["path"],
                          "runs": s["runs"], "n_events": s["n_events"],
-                         "n_requests": len(s["requests"])}
+                         "n_requests": len(s["requests"]),
+                         "unpaired": s["unpaired"]}
                         for s in streams],
             "runs": runs,
             "traces": traces,
             "ops": dict(sorted(ops.items())),
-            "orphans": sum(1 for t in traces if t["orphan"])}
+            "orphans": sum(1 for t in traces if t["orphan"]),
+            "unpaired": dict(sorted(unpaired.items()))}
 
 
 def _fmt_s(v) -> str:
@@ -185,6 +199,13 @@ def render(st: dict, out=sys.stdout, limit: int | None = None):
     for rid, names in sorted(st["runs"].items()):
         print(f"run {rid}: {len(names)} streams "
               f"({', '.join(sorted(set(names)))})", file=out)
+    if st.get("unpaired"):
+        # typed point events with no trace side (alert, route, ...):
+        # tallied so a stream full of v14 alerts reads as health
+        # signal, not as stitching loss
+        tally = " ".join(f"{nm}={c}"
+                         for nm, c in st["unpaired"].items())
+        print(f"unpaired typed events: {tally}", file=out)
     shown = st["traces"] if limit is None else st["traces"][:limit]
     for t in shown:
         bd = t["breakdown"]

@@ -119,6 +119,7 @@ def summarize(events, out=sys.stdout):
     _resilience_lines(events, out)
     _supervisor_lines(events, out)
     _serve_lines(events, out)
+    _alert_lines(events, out)
     _admission_lines(events, out)
     _route_lines(events, out)
     _request_lines(events, out)
@@ -135,7 +136,7 @@ def summarize(events, out=sys.stdout):
     tabled = ("compile", "device_metrics", "vi_residuals", "retry",
               "checkpoint", "perf_gate", "supervisor", "serve",
               "request", "admission", "route", "mdp_solve",
-              "mdp_compile", "attack_sweep")
+              "mdp_compile", "attack_sweep", "alert")
     for e in (e for e in events if e.get("kind") == "event"
               and e.get("name") not in tabled):
         keys = {k: v for k, v in e.items() if k not in ("kind", "ts")}
@@ -264,6 +265,38 @@ def _serve_lines(events, out):
               f"steps_per_sec={sps_txt} occupancy={occ_txt} "
               f"lanes={d.get('n_lanes')} burst={d.get('burst')}",
               file=out)
+
+
+def _alert_lines(events, out):
+    """Schema-v14 SLO burn-rate alerts (cpr_tpu/monitor/alerts): one
+    aggregate line per signal x class x severity x window with the
+    fire count and the worst observed burn rate — how hard and how
+    often a run breached its error budgets reads off one block."""
+    evs = [e for e in events if e.get("kind") == "event"
+           and e.get("name") == "alert"]
+    if not evs:
+        return
+    agg = defaultdict(lambda: [0, 0.0, 0.0])  # [n, max_burn, max_value]
+    for e in evs:
+        key = (str(e.get("signal")), str(e.get("cls")),
+               str(e.get("severity")), e.get("window_s"))
+        a = agg[key]
+        a[0] += 1
+        b = e.get("burn_rate")
+        if isinstance(b, (int, float)):
+            a[1] = max(a[1], b)
+        v = e.get("value")
+        if isinstance(v, (int, float)):
+            a[2] = max(a[2], v)
+    print(f"\n{'alert signal':<16} {'class':<12} {'severity':<9} "
+          f"{'window_s':>9} {'n':>5} {'max_burn':>9} {'max_value':>10}",
+          file=out)
+    for (signal, cls, severity, window_s), (n, mb, mv) in sorted(
+            agg.items(), key=lambda kv: str(kv[0])):
+        win_txt = (f"{window_s:g}"
+                   if isinstance(window_s, (int, float)) else "-")
+        print(f"{signal:<16} {cls:<12} {severity:<9} {win_txt:>9} "
+              f"{n:>5} {mb:>9.1f} {mv:>10.4f}", file=out)
 
 
 def _admission_lines(events, out):
