@@ -4,7 +4,7 @@
 
 CPU_MESH = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: lint test test-slow bench perf-gate telemetry-smoke netsim-smoke resilience-smoke supervisor-smoke serve-smoke fleet-smoke multichip-smoke mdp-smoke vi-smoke compile-smoke attack-smoke dryrun sweeps ghostdag train-dummy native asan
+.PHONY: lint test test-slow bench perf-gate telemetry-smoke netsim-smoke resilience-smoke supervisor-smoke obs-smoke serve-smoke fleet-smoke multichip-smoke mdp-smoke vi-smoke compile-smoke attack-smoke dryrun sweeps ghostdag train-dummy native asan
 
 lint:  ## jaxlint over cpr_tpu/ + tools/ (pure AST, no JAX import,
 	## ~1s); banks the JSON report under runs/ like the smoke flows
@@ -83,6 +83,21 @@ supervisor-smoke:  ## supervised-subprocess proof: injected hang@probe
 	## typed v6 `supervisor` event trail
 	rm -rf $(SUPERVISOR_SMOKE_DIR)
 	python tools/supervisor_smoke.py $(SUPERVISOR_SMOKE_DIR)
+
+OBS_SMOKE_DIR = /tmp/cpr-obs-smoke
+
+obs-smoke:  ## v15 attribution-plane proof: two supervised server
+	## runs (baseline + one-shot injected `slow@replica` stall), live
+	## memory-watermark gauges asserted in a mid-run metrics.scrape and
+	## in the drain report, both traces validated with `memory` events
+	## and archived under distinct run ids, trace_diff over the
+	## archived pair ranking the injected serve_burst span as the #1
+	## culprit, a gated serve_p99_s FAIL carrying the run-id pair, a
+	## clean lower-is-better serve_peak_bytes gate, and `perf_report
+	## --gate --attribute` chasing the FAIL through the archive into a
+	## culprit table.  Details: docs/OBSERVABILITY.md
+	rm -rf $(OBS_SMOKE_DIR)
+	python tools/obs_smoke.py $(OBS_SMOKE_DIR)
 
 SERVE_SMOKE_DIR = /tmp/cpr-serve-smoke
 

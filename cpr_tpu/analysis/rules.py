@@ -95,7 +95,7 @@ class WallClockRule(Rule):
     def check(self, src: SourceFile, ctx: LintContext):
         if not src.rel.startswith("cpr_tpu/"):
             return
-        for node in ast.walk(src.tree):
+        for node in src.nodes:
             if not isinstance(node, ast.Call):
                 continue
             d = dotted(node.func)
@@ -126,7 +126,7 @@ class RawWriteRule(Rule):
     def check(self, src: SourceFile, ctx: LintContext):
         if src.rel == "cpr_tpu/resilience.py":
             return
-        for node in ast.walk(src.tree):
+        for node in src.nodes:
             if not (isinstance(node, ast.Call)
                     and dotted(node.func) in ("open", "io.open")):
                 continue
@@ -160,7 +160,7 @@ class EventSchemaRule(Rule):
         schema = ctx.event_fields()
         if not schema:
             return
-        for node in ast.walk(src.tree):
+        for node in src.nodes:
             if not isinstance(node, ast.Call):
                 continue
             if (isinstance(node.func, ast.Attribute)
@@ -218,7 +218,7 @@ class JitInLoopRule(Rule):
                  "jitted callable are fine.")
 
     def check(self, src: SourceFile, ctx: LintContext):
-        for node in ast.walk(src.tree):
+        for node in src.nodes:
             is_jit, _ = _is_jit_call(node)
             if not is_jit:
                 continue
@@ -276,7 +276,7 @@ class DonateCarryRule(Rule):
             args = target.args.args
             return args[0].arg if args else None
         if isinstance(target, ast.Name):
-            for n in ast.walk(src.tree):
+            for n in src.nodes:
                 if (isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
                         and n.name == target.id):
                     args = n.args.args
@@ -296,7 +296,7 @@ class DonateCarryRule(Rule):
         if not (src.rel in HOT_CARRY_PATHS
                 or src.rel.startswith(HOT_CARRY_PREFIXES)):
             return
-        for node in ast.walk(src.tree):
+        for node in src.nodes:
             is_jit, kw_carrier = _is_jit_call(node)
             if not is_jit:
                 continue
@@ -338,7 +338,7 @@ class KeyReuseRule(Rule):
 
     def check(self, src: SourceFile, ctx: LintContext):
         scopes = [src.tree] + [
-            n for n in ast.walk(src.tree)
+            n for n in src.nodes
             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
         for scope in scopes:
             yield from self._check_scope(src, scope)
@@ -530,11 +530,11 @@ class HostSyncRule(Rule):
         """(body_expr, via) for every callable passed as a traced loop
         body, resolving Names to same-file defs."""
         defs: dict[str, list] = {}
-        for n in ast.walk(src.tree):
+        for n in src.nodes:
             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 defs.setdefault(n.name, []).append(n)
         out = []
-        for node in ast.walk(src.tree):
+        for node in src.nodes:
             if not isinstance(node, ast.Call):
                 continue
             d = dotted(node.func) or ""

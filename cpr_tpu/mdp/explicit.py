@@ -556,7 +556,8 @@ def run_chunk_driver(chunk_step, S, dtype, stop_delta, max_iter,
                      chunk: int = 64, accel_m: int = 0,
                      checkpoint_path: str | None = None,
                      checkpoint_every: int = 1,
-                     value0=None, prog0=None):
+                     value0=None, prog0=None,
+                     predicted_bytes: int | None = None):
     """Shared host loop for device-while-free VI: call
     `chunk_step(value, prog, steps) -> (value, prog, pol, deltas)` in
     full chunks with a chunk=1 tail (steps is a static argnum in both
@@ -612,46 +613,58 @@ def run_chunk_driver(chunk_step, S, dtype, stop_delta, max_iter,
         telemetry.current().event("resume", path=checkpoint_path,
                                   update=int(it), scope="vi")
     chunks_done = 0
-    while it < max_iter:
-        step = chunk if max_iter - it >= chunk else 1
-        x_value, x_prog = value, prog
+    # v15 watermark: one allocator read per chunk (the convergence
+    # check already syncs there, so the probe rides an existing host
+    # round-trip), emitting the typed `memory` event on exit — crash
+    # path included.  `predicted_bytes` carries the
+    # vi_working_set_bytes claim so the report puts prediction and
+    # measurement side by side.
+    with telemetry.memory_watermark(
+            "vi", predicted_bytes=predicted_bytes) as wm:
+        while it < max_iter:
+            step = chunk if max_iter - it >= chunk else 1
+            x_value, x_prog = value, prog
 
-        def one_chunk():
-            resilience.fault_point("vi_chunk")
-            return chunk_step(x_value, x_prog, step)
+            def one_chunk():
+                resilience.fault_point("vi_chunk")
+                return chunk_step(x_value, x_prog, step)
 
-        g_value, g_prog, pol, deltas = resilience.with_retries(
-            one_chunk, max_attempts=3, base_delay_s=0.2, max_delay_s=5.0,
-            name="vi_chunk")
-        it += step
-        value, prog = g_value, g_prog
-        # the convergence check below already syncs on the chunk, so
-        # pulling the full per-sweep delta vector costs no extra trip
-        resids.append(np.asarray(deltas))
-        delta = deltas[-1]
-        chunks_done += 1
-        converged = float(delta) <= float(stop_delta)
-        if (checkpoint_path is not None and not converged
-                and chunks_done % checkpoint_every == 0):
-            resilience.save_vi_checkpoint(
-                checkpoint_path, value=value, prog=prog, it=it,
-                resids=resids, stop_delta=float(stop_delta))
-            telemetry.current().event("checkpoint", path=checkpoint_path,
-                                      what="vi", update=int(it))
-        if converged:
-            break
-        # never mix on the way out: a max_iter exit must return the
-        # plain chunk output (delta/policy describe THAT iterate; an
-        # extrapolation is only ever validated by the next chunk)
-        if accel_m > 1 and step == chunk and it < max_iter:
-            if prev_delta is not None and float(delta) > prev_delta:
-                hist = []  # extrapolation hurt: fall back to plain
-            else:
-                hist = (hist + [(x_value, x_prog, g_value, g_prog)]
-                        )[-accel_m:]
-                if len(hist) >= 2:
-                    value, prog = _anderson_mix(hist)
-            prev_delta = float(delta)
+            g_value, g_prog, pol, deltas = resilience.with_retries(
+                one_chunk, max_attempts=3, base_delay_s=0.2,
+                max_delay_s=5.0, name="vi_chunk")
+            it += step
+            value, prog = g_value, g_prog
+            # the convergence check below already syncs on the chunk,
+            # so pulling the full per-sweep delta vector costs no
+            # extra trip
+            resids.append(np.asarray(deltas))
+            delta = deltas[-1]
+            chunks_done += 1
+            wm.sample()
+            converged = float(delta) <= float(stop_delta)
+            if (checkpoint_path is not None and not converged
+                    and chunks_done % checkpoint_every == 0):
+                resilience.save_vi_checkpoint(
+                    checkpoint_path, value=value, prog=prog, it=it,
+                    resids=resids, stop_delta=float(stop_delta))
+                telemetry.current().event(
+                    "checkpoint", path=checkpoint_path,
+                    what="vi", update=int(it))
+            if converged:
+                break
+            # never mix on the way out: a max_iter exit must return
+            # the plain chunk output (delta/policy describe THAT
+            # iterate; an extrapolation is only ever validated by the
+            # next chunk)
+            if accel_m > 1 and step == chunk and it < max_iter:
+                if prev_delta is not None and float(delta) > prev_delta:
+                    hist = []  # extrapolation hurt: fall back to plain
+                else:
+                    hist = (hist + [(x_value, x_prog, g_value, g_prog)]
+                            )[-accel_m:]
+                    if len(hist) >= 2:
+                        value, prog = _anderson_mix(hist)
+                prev_delta = float(delta)
     if checkpoint_path is not None and os.path.exists(checkpoint_path):
         # crash-recovery scratch only: a finished solve must not leave
         # a checkpoint a later (different) solve could seed from
@@ -687,7 +700,9 @@ def vi_chunked(src, act, dst, prob, reward, progress, S, A, discount,
     return run_chunk_driver(chunk_step, S, prob.dtype, stop_delta,
                             max_iter, chunk, accel_m=accel_m,
                             checkpoint_path=checkpoint_path,
-                            checkpoint_every=checkpoint_every)
+                            checkpoint_every=checkpoint_every,
+                            predicted_bytes=vi_working_set_bytes(
+                                int(src.shape[0]), S, A, prob.dtype))
 
 
 def make_grid_vi_chunk(S: int, A: int, reduce=lambda x: x):
@@ -776,40 +791,46 @@ def run_grid_chunk_driver(chunk_step, place, G, S, dtype, stop_delta,
                                   update=it, scope="grid_vi")
     carry = (place(value), place(prog), place(pol))
     chunks_done = 0
-    while it < max_iter and not bool(frozen.all()):
-        step = chunk if max_iter - it >= chunk else 1
-        frozen_dev = place(frozen)
-        prev_carry = carry
+    # v15 watermark: one allocator read per chunk, riding the same
+    # host sync the convergence check forces; the typed `memory`
+    # event (scope mdp_grid) emits on exit, crash path included
+    with telemetry.memory_watermark("mdp_grid") as wm:
+        while it < max_iter and not bool(frozen.all()):
+            step = chunk if max_iter - it >= chunk else 1
+            frozen_dev = place(frozen)
+            prev_carry = carry
 
-        def one_chunk():
-            resilience.fault_point("vi_chunk")
-            return chunk_step(prev_carry, frozen_dev, step)
+            def one_chunk():
+                resilience.fault_point("vi_chunk")
+                return chunk_step(prev_carry, frozen_dev, step)
 
-        carry, deltas = resilience.with_retries(
-            one_chunk, max_attempts=3, base_delay_s=0.2, max_delay_s=5.0,
-            name="grid_vi_chunk")
-        it += step
-        # the convergence check syncs on the chunk anyway; the full
-        # [G, step] delta plane is the residual history
-        d = np.asarray(deltas)
-        resids.append(d)
-        last = d[:, -1]
-        live = ~frozen
-        final_delta[live] = last[live]
-        newly = live & (last <= float(stop_delta))
-        conv_it[newly] = it
-        frozen |= newly
-        chunks_done += 1
-        if (checkpoint_path is not None and not bool(frozen.all())
-                and chunks_done % checkpoint_every == 0):
-            resilience.save_grid_vi_checkpoint(
-                checkpoint_path, value=np.asarray(carry[0]),
-                prog=np.asarray(carry[1]), pol=np.asarray(carry[2]),
-                frozen=frozen, conv_it=conv_it,
-                final_delta=final_delta, it=it, resids=resids,
-                stop_delta=float(stop_delta))
-            telemetry.current().event("checkpoint", path=checkpoint_path,
-                                      what="grid_vi", update=it)
+            carry, deltas = resilience.with_retries(
+                one_chunk, max_attempts=3, base_delay_s=0.2,
+                max_delay_s=5.0, name="grid_vi_chunk")
+            it += step
+            # the convergence check syncs on the chunk anyway; the
+            # full [G, step] delta plane is the residual history
+            d = np.asarray(deltas)
+            resids.append(d)
+            last = d[:, -1]
+            live = ~frozen
+            final_delta[live] = last[live]
+            newly = live & (last <= float(stop_delta))
+            conv_it[newly] = it
+            frozen |= newly
+            chunks_done += 1
+            wm.sample()
+            if (checkpoint_path is not None and not bool(frozen.all())
+                    and chunks_done % checkpoint_every == 0):
+                resilience.save_grid_vi_checkpoint(
+                    checkpoint_path, value=np.asarray(carry[0]),
+                    prog=np.asarray(carry[1]), pol=np.asarray(carry[2]),
+                    frozen=frozen, conv_it=conv_it,
+                    final_delta=final_delta, it=it, resids=resids,
+                    stop_delta=float(stop_delta))
+                telemetry.current().event(
+                    "checkpoint", path=checkpoint_path,
+                    what="grid_vi", update=it)
     if checkpoint_path is not None and os.path.exists(checkpoint_path):
         # crash-recovery scratch only, exactly like run_chunk_driver
         os.unlink(checkpoint_path)

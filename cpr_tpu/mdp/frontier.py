@@ -325,14 +325,22 @@ class FrontierCompiler:
     def _run(self):
         t0 = now()
         try:
-            while self._explored_upto < len(self.states):
-                self._round += 1
-                resilience.fault_point("compile_round")
-                lo, hi = self._explored_upto, len(self.states)
-                self._absorb(lo, hi, self._expand(self.states[lo:hi]))
-                self._explored_upto = hi
-                if self._ck_path and self._round % self._ck_every == 0:
-                    self._save_checkpoint()
+            # v15 watermark: the compile's state/column tables live on
+            # the host, so this is an RSS watermark on CPU — sampled
+            # once per frontier round, `memory` event on exit (crash
+            # path included)
+            with telemetry.memory_watermark("mdp_compile") as wm:
+                while self._explored_upto < len(self.states):
+                    self._round += 1
+                    resilience.fault_point("compile_round")
+                    lo, hi = self._explored_upto, len(self.states)
+                    self._absorb(lo, hi,
+                                 self._expand(self.states[lo:hi]))
+                    self._explored_upto = hi
+                    wm.sample()
+                    if (self._ck_path
+                            and self._round % self._ck_every == 0):
+                        self._save_checkpoint()
         finally:
             self._elapsed += now() - t0
             if self._pool is not None:

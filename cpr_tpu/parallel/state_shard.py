@@ -40,7 +40,8 @@ from functools import partial
 from cpr_tpu.mdp.explicit import (TensorMDP, _greedy_backup,
                                   _valid_actions, check_vi_working_set,
                                   make_vi_sweep, resolve_vi_impl,
-                                  run_chunk_driver, vi_residuals_event)
+                                  run_chunk_driver, vi_residuals_event,
+                                  vi_working_set_bytes)
 from cpr_tpu.parallel.lanes import check_even_shards
 
 __all__ = [
@@ -235,7 +236,9 @@ def sharded_state_value_iteration(tm: TensorMDP, mesh, *,
         chunk_fn, S_pad, tm.prob.dtype, stop_delta, max_iter_, chunk,
         checkpoint_path=checkpoint_path,
         checkpoint_every=checkpoint_every,
-        value0=pad0(value0), prog0=pad0(progress0))
+        value0=pad0(value0), prog0=pad0(progress0),
+        predicted_bytes=vi_working_set_bytes(
+            t_blk, S_pad, A, tm.prob.dtype, shards=n))
     resid = vi_residuals_event(impl, int(it), resid, stop_delta, delta)
     vi_time = telemetry.now() - t0
     halo = state_halo_bytes(S_pad, n, tm.prob.dtype)

@@ -39,6 +39,10 @@ bench.py's watchdog parent can bank rows without initializing a
 backend.
 """
 
+from cpr_tpu.perf import archive
+from cpr_tpu.perf.archive import (ARCHIVE_ENV_VAR, archive_dir,
+                                  archive_run, find_runs, load_run,
+                                  primary_stream, run_streams)
 from cpr_tpu.perf.gate import (baseline_rows, emit_gate_event, gate_row,
                                gate_summary)
 from cpr_tpu.perf.ledger import (LEDGER_ENV_VAR, LEDGER_VERSION, Ledger,
@@ -47,20 +51,28 @@ from cpr_tpu.perf.ledger import (LEDGER_ENV_VAR, LEDGER_VERSION, Ledger,
                                  metric_direction, normalize_row)
 
 __all__ = [
+    "ARCHIVE_ENV_VAR",
     "LEDGER_ENV_VAR",
     "LEDGER_VERSION",
     "Ledger",
+    "archive",
+    "archive_dir",
+    "archive_run",
     "bank_and_gate",
     "baseline_rows",
     "config_fingerprint",
     "default_ledger_path",
     "emit_gate_event",
+    "find_runs",
     "gate_row",
     "gate_summary",
     "iter_bank_rows",
     "iter_trace_rows",
+    "load_run",
     "metric_direction",
     "normalize_row",
+    "primary_stream",
+    "run_streams",
 ]
 
 

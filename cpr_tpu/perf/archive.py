@@ -1,0 +1,378 @@
+"""Run archive: content-addressed per-run artifact records (v15).
+
+Every observability surface before this module answered questions
+about ONE run: a telemetry stream summarizes, a ledger row gates, a
+blackbox dumps.  Cross-run attribution — "what changed between the
+run that passed and the run that failed?" — needs the runs themselves
+to be findable after the fact, which they were not: a run's artifacts
+(the server stream, the supervisor child streams, client streams,
+blackbox dumps, banked ledger rows) scatter across scratch dirs keyed
+only by the `run_id` buried in their manifests.
+
+This module indexes them.  `archive_run()` scans a set of paths (or
+discovers streams by run id), classifies each artifact, content-hashes
+it, and writes one per-run record under `runs/archive/` (override:
+`$CPR_OBS_ARCHIVE`) — an atomic JSON file plus an append-only
+`index.jsonl` audit line.  `find_runs()`/`load_run()` query by
+run id, git SHA, config fingerprint, or time window; `run_streams()`
+hands the telemetry paths back to the consumers that learned to read
+the archive: `tools/trace_summary.py`, `tools/trace_stitch.py`,
+`tools/trace_diff.py`, and `perf_report --attribute` (which chases a
+v15 `perf_gate` verdict's `run`/`baseline_runs` ids into a culprit
+span table).
+
+Like ledger/latency, jax-free at import; every record write goes
+through `resilience.atomic_write_json` (the `index.jsonl` audit trail
+appends, which the raw-write rule exempts).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from datetime import datetime, timezone
+
+from cpr_tpu import resilience
+
+ARCHIVE_VERSION = 1
+ARCHIVE_ENV_VAR = "CPR_OBS_ARCHIVE"
+DEFAULT_ARCHIVE_DIR = os.path.join("runs", "archive")
+
+# artifact kinds a record distinguishes (everything else is "file")
+KIND_TELEMETRY = "telemetry"
+KIND_BLACKBOX = "blackbox"
+KIND_LEDGER = "ledger"
+KIND_FILE = "file"
+
+
+def archive_dir(root: str | None = None) -> str:
+    """The archive root: explicit arg, else $CPR_OBS_ARCHIVE, else
+    runs/archive."""
+    return (root or os.environ.get(ARCHIVE_ENV_VAR)
+            or DEFAULT_ARCHIVE_DIR)
+
+
+def record_path(run: str, root: str | None = None) -> str:
+    return os.path.join(archive_dir(root), f"run-{run}.json")
+
+
+def index_path(root: str | None = None) -> str:
+    return os.path.join(archive_dir(root), "index.jsonl")
+
+
+def config_fingerprint(config: dict | None) -> str | None:
+    """Stable fingerprint of a manifest's resolved config dict — the
+    archive's cross-run "same setup?" key (the ledger fingerprints
+    metric x cfg_* instead; this one is config-only so two runs of
+    different metrics still match)."""
+    if not config:
+        return None
+    blob = json.dumps(config, sort_keys=True, default=str)
+    return hashlib.sha1(blob.encode()).hexdigest()[:16]
+
+
+def _sha256(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        while True:
+            chunk = f.read(1 << 20)
+            if not chunk:
+                break
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def scan_stream(path: str) -> dict:
+    """One pass over a JSONL artifact: run ids, manifest metadata
+    (git_sha / backend / config / time window), span + event tallies.
+    Malformed lines are skipped, never fatal — a truncated stream from
+    a crashed child is exactly what the archive must still index."""
+    runs: list[str] = []
+    git_shas: list[str] = []
+    backends: list[str] = []
+    configs: list[dict] = []
+    times: list[str] = []
+    n_events = n_spans = n_manifests = n_lines = 0
+    events: dict[str, int] = {}
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                n_lines += 1
+                try:
+                    e = json.loads(line)
+                except ValueError:
+                    continue
+                if not isinstance(e, dict):
+                    continue
+                kind = e.get("kind")
+                if kind == "manifest":
+                    n_manifests += 1
+                    if e.get("run") and e["run"] not in runs:
+                        runs.append(str(e["run"]))
+                    if e.get("git_sha") and e["git_sha"] not in git_shas:
+                        git_shas.append(str(e["git_sha"]))
+                    if e.get("backend") and e["backend"] not in backends:
+                        backends.append(str(e["backend"]))
+                    if isinstance(e.get("config"), dict):
+                        configs.append(e["config"])
+                    if e.get("time_utc"):
+                        times.append(str(e["time_utc"]))
+                elif kind == "span":
+                    n_spans += 1
+                elif kind == "event":
+                    n_events += 1
+                    nm = str(e.get("name") or "?")
+                    events[nm] = events.get(nm, 0) + 1
+    except OSError:
+        pass
+    return {"runs": runs, "git_shas": git_shas, "backends": backends,
+            "configs": configs, "n_lines": n_lines,
+            "n_manifests": n_manifests, "n_spans": n_spans,
+            "n_events": n_events, "events": events,
+            "time_first": times[0] if times else None,
+            "time_last": times[-1] if times else None}
+
+
+def classify(path: str, scan: dict) -> str:
+    """Artifact kind from filename + contents."""
+    base = os.path.basename(path)
+    if base.startswith("blackbox-"):
+        return KIND_BLACKBOX
+    if "ledger" in base and base.endswith(".jsonl"):
+        return KIND_LEDGER
+    if scan["n_manifests"] or scan["n_spans"] or scan["n_events"]:
+        return KIND_TELEMETRY
+    return KIND_FILE
+
+
+def _artifact(path: str, role: str | None = None) -> dict | None:
+    """One artifact entry: content hash, size, kind, stream stats."""
+    path = os.path.abspath(path)
+    try:
+        size = os.path.getsize(path)
+        digest = _sha256(path)
+    except OSError:
+        return None
+    scan = scan_stream(path) if path.endswith((".jsonl", ".json")) \
+        else {"runs": [], "git_shas": [], "backends": [], "configs": [],
+              "n_lines": 0, "n_manifests": 0, "n_spans": 0,
+              "n_events": 0, "events": {}, "time_first": None,
+              "time_last": None}
+    art = {"path": path, "kind": classify(path, scan),
+           "sha256": digest, "bytes": size,
+           "runs": scan["runs"], "n_spans": scan["n_spans"],
+           "n_events": scan["n_events"], "events": scan["events"],
+           "_scan": scan}
+    if role:
+        art["role"] = role
+    return art
+
+
+def discover_artifacts(search_dirs, run: str) -> list[str]:
+    """Walk `search_dirs` for JSONL artifacts that belong to `run`:
+    telemetry streams whose manifests carry the run id, and blackbox
+    dumps named `blackbox-<run>-*.jsonl`.  This is how a post-hoc
+    archive pass finds the supervisor-child and client streams the
+    archiving process never opened itself."""
+    found: list[str] = []
+    for d in search_dirs:
+        if not os.path.isdir(d):
+            continue
+        for base, _dirs, files in os.walk(d):
+            for name in sorted(files):
+                if not name.endswith(".jsonl"):
+                    continue
+                p = os.path.join(base, name)
+                if name.startswith(f"blackbox-{run}-"):
+                    found.append(p)
+                    continue
+                if run in scan_stream(p)["runs"]:
+                    found.append(p)
+    return found
+
+
+def archive_run(paths=(), *, run: str | None = None,
+                root: str | None = None, search_dirs=(),
+                roles: dict | None = None,
+                label: str | None = None,
+                extra: dict | None = None) -> dict:
+    """Index one run's artifacts into the archive.  `paths` are
+    explicit artifact files; `search_dirs` are additionally walked for
+    streams carrying the run id (discovery needs `run`, or a run id
+    resolvable from the explicit paths' manifests).  Re-archiving the
+    same run merges artifacts by content hash — the record converges,
+    the index stays append-only (latest line wins on read).  Returns
+    the written record."""
+    roles = roles or {}
+    arts: list[dict] = []
+    for p in paths:
+        a = _artifact(p, roles.get(p) or roles.get(os.path.abspath(p)))
+        if a is not None:
+            arts.append(a)
+    if run is None:
+        for a in arts:
+            if a["runs"]:
+                run = a["runs"][0]
+                break
+    if run is None:
+        raise ValueError("archive_run: no run id — pass run= or at "
+                         "least one stream whose manifest carries one")
+    known = {a["sha256"] for a in arts}
+    for p in discover_artifacts(search_dirs, run):
+        a = _artifact(p, roles.get(p) or roles.get(os.path.abspath(p)))
+        if a is not None and a["sha256"] not in known:
+            known.add(a["sha256"])
+            arts.append(a)
+    # record-level metadata from the first manifest-bearing artifact
+    git_sha = backend = fingerprint = None
+    config = None
+    time_utc = None
+    for a in arts:
+        scan = a["_scan"]
+        if git_sha is None and scan["git_shas"]:
+            git_sha = scan["git_shas"][0]
+        if backend is None and scan["backends"]:
+            backend = scan["backends"][0]
+        if config is None and scan["configs"]:
+            config = scan["configs"][0]
+            fingerprint = config_fingerprint(config)
+        if time_utc is None and scan["time_first"]:
+            time_utc = scan["time_first"]
+    for a in arts:
+        a.pop("_scan", None)
+    rec = {
+        "archive": ARCHIVE_VERSION,
+        "run": run,
+        "time_utc": time_utc or datetime.now(timezone.utc).isoformat(
+            timespec="seconds"),
+        "git_sha": git_sha,
+        "backend": backend,
+        "fingerprint": fingerprint,
+        "config": config,
+        "artifacts": arts,
+    }
+    if label:
+        rec["label"] = label
+    if extra:
+        rec["extra"] = extra
+    # merge with any existing record for this run (idempotent: same
+    # artifacts dedup by content hash; label/extra: newest wins)
+    prev = load_run(run, root)
+    if prev:
+        seen = {a["sha256"] for a in arts}
+        for a in prev.get("artifacts", ()):
+            if a.get("sha256") not in seen:
+                seen.add(a.get("sha256"))
+                arts.append(a)
+        for k in ("git_sha", "backend", "fingerprint", "config",
+                  "label", "extra"):
+            if rec.get(k) is None and prev.get(k) is not None:
+                rec[k] = prev[k]
+        if prev.get("time_utc") and (not time_utc
+                                     or prev["time_utc"] < time_utc):
+            rec["time_utc"] = prev["time_utc"]
+    resilience.atomic_write_json(record_path(run, root), rec)
+    # append-only audit line (append mode: raw-write exempt, and an
+    # append can at worst tear its own line, never the trail)
+    idx = index_path(root)
+    os.makedirs(os.path.dirname(idx) or ".", exist_ok=True)
+    with open(idx, "a") as f:
+        f.write(json.dumps({
+            "run": run, "time_utc": rec["time_utc"],
+            "git_sha": git_sha, "fingerprint": fingerprint,
+            "n_artifacts": len(arts),
+            "record": os.path.basename(record_path(run, root)),
+        }, default=str) + "\n")
+        f.flush()
+    return rec
+
+
+def load_run(run: str, root: str | None = None) -> dict | None:
+    """The archived record for one run id, or None."""
+    try:
+        with open(record_path(run, root)) as f:
+            rec = json.load(f)
+        return rec if isinstance(rec, dict) else None
+    except (OSError, ValueError):
+        return None
+
+
+def find_runs(root: str | None = None, *, run: str | None = None,
+              git_sha: str | None = None,
+              fingerprint: str | None = None,
+              since: str | None = None,
+              until: str | None = None) -> list[dict]:
+    """Query the archive.  Filters AND together; `since`/`until` are
+    ISO-8601 UTC strings compared lexicographically against each
+    record's `time_utc` (the format run_manifest stamps).  `git_sha`
+    matches by prefix, so a short SHA works.  Results sort newest
+    first."""
+    d = archive_dir(root)
+    out: list[dict] = []
+    try:
+        names = sorted(os.listdir(d))
+    except OSError:
+        return out
+    for name in names:
+        if not (name.startswith("run-") and name.endswith(".json")):
+            continue
+        try:
+            with open(os.path.join(d, name)) as f:
+                rec = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if not isinstance(rec, dict) or "run" not in rec:
+            continue
+        if run is not None and rec.get("run") != run:
+            continue
+        if git_sha is not None and not str(
+                rec.get("git_sha") or "").startswith(git_sha):
+            continue
+        if fingerprint is not None \
+                and rec.get("fingerprint") != fingerprint:
+            continue
+        t = str(rec.get("time_utc") or "")
+        if since is not None and t < since:
+            continue
+        if until is not None and t > until:
+            continue
+        out.append(rec)
+    out.sort(key=lambda r: str(r.get("time_utc") or ""), reverse=True)
+    return out
+
+
+def run_streams(rec: dict, kind: str = KIND_TELEMETRY,
+                role: str | None = None) -> list[str]:
+    """Artifact paths of one kind (existing files only — the archive
+    records scratch artifacts, which may have been cleaned)."""
+    out = []
+    for a in rec.get("artifacts", ()):
+        if a.get("kind") != kind:
+            continue
+        if role is not None and a.get("role") != role:
+            continue
+        p = a.get("path")
+        if p and os.path.exists(p):
+            out.append(p)
+    return out
+
+
+def primary_stream(rec: dict) -> str | None:
+    """The run's most span-rich telemetry stream — the default side of
+    a trace diff (role "server" wins outright when labeled)."""
+    best, best_key = None, (-1, -1)
+    for a in rec.get("artifacts", ()):
+        if a.get("kind") != KIND_TELEMETRY:
+            continue
+        p = a.get("path")
+        if not (p and os.path.exists(p)):
+            continue
+        key = (1 if a.get("role") == "server" else 0,
+               int(a.get("n_spans") or 0))
+        if key > best_key:
+            best, best_key = p, key
+    return best

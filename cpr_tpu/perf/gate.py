@@ -35,8 +35,12 @@ gate"):
   a CPU row is only ever compared to CPU history.
 
 Every gate emits a typed `perf_gate` telemetry event (schema v5:
-metric, backend, verdict, value, baseline) so the verdict is part of
-the same post-mortem trail the bench rows live in.
+metric, backend, verdict, value, baseline; v15 adds `run` +
+`baseline_runs` — the candidate's and baseline rows' run ids) so the
+verdict is part of the same post-mortem trail the bench rows live in,
+and a FAIL/WARN can be chased through the run archive into the exact
+candidate/baseline trace pair (`perf_report --attribute` →
+`tools/trace_diff.py`).
 """
 
 from __future__ import annotations
@@ -93,6 +97,12 @@ def gate_row(candidate: dict, history, *, top_k: int = TOP_K,
         "direction": direction,
         "verdict": "pass",
         "baseline": None,
+        # v15 attribution plane: the candidate's run id and the run
+        # ids behind the baseline rows ride the verdict, so a
+        # FAIL/WARN resolves through the run archive into an exact
+        # A/B trace pair for tools/trace_diff.py
+        "run": candidate.get("run"),
+        "baseline_runs": [],
         "config_drift": False,
         "reason": "",
     }
@@ -162,7 +172,13 @@ def gate_row(candidate: dict, history, *, top_k: int = TOP_K,
         "best": best[0]["value"],
         "best_source": best[0].get("source"),
         "best_round": best[0].get("round"),
+        "best_run": best[0].get("run"),
     }
+    seen_runs = []
+    for r in best:
+        if r.get("run") and r["run"] not in seen_runs:
+            seen_runs.append(r["run"])
+    result["baseline_runs"] = seen_runs
     if lower:
         warn_above = med + max(warn_frac * med, noise)
         fail_above = med + max(fail_frac * med, noise)
@@ -189,11 +205,14 @@ def gate_row(candidate: dict, history, *, top_k: int = TOP_K,
 
 
 def emit_gate_event(result: dict):
-    """Emit the typed schema-v5 `perf_gate` event for one verdict."""
+    """Emit the typed `perf_gate` event for one verdict (schema v5;
+    v15 adds the candidate/baseline run ids for archive chase)."""
     telemetry.current().event(
         "perf_gate", metric=result["metric"], backend=result["backend"],
         verdict=result["verdict"], value=result["value"],
-        baseline=result["baseline"], config_drift=result["config_drift"],
+        baseline=result["baseline"], run=result.get("run"),
+        baseline_runs=result.get("baseline_runs") or [],
+        config_drift=result["config_drift"],
         direction=result.get("direction"), reason=result["reason"])
 
 

@@ -20,17 +20,21 @@ and the drain-time `serve` report events of the serving layer
 serving session's sustained throughput is banked and gated exactly
 like a bench row.
 
-Ledger records (`ledger: 4` — v4 banks the measurement's device span
-as `cfg_devices` in every config fingerprint, so multi-chip rows
-(sharded serve/rollout/netsim lanes, docs/SCALING.md) gate against
-their own per-device-count history instead of drifting against
-single-device baselines.  Backfill-safe: a row with no `n_devices`
-key measured one device and fingerprints as cfg_devices=1.  v3 added
-the `direction` field so lower-is-better metrics (latencies:
-`serve_p50_s`/`serve_p99_s`) gate correctly.  Like the v2 bump
-(supervisor provenance), each version changed every row_id, and the
-ledger file is regenerable scratch, so a pre-v4 ledger is simply
-deleted and re-ingested rather than migrated):
+Ledger records (`ledger: 5` — v5 stamps the producing run's `run` id
+on every record (trace-lifted rows inherit it from the stream's
+manifest), so a gate verdict can name the exact runs it compared and
+`perf_report --attribute` can chase a FAIL through the run archive
+(cpr_tpu/perf/archive.py) into a trace diff.  v4 banks the
+measurement's device span as `cfg_devices` in every config
+fingerprint, so multi-chip rows (sharded serve/rollout/netsim lanes,
+docs/SCALING.md) gate against their own per-device-count history
+instead of drifting against single-device baselines.  Backfill-safe:
+a row with no `n_devices` key measured one device and fingerprints as
+cfg_devices=1.  v3 added the `direction` field so lower-is-better
+metrics (latencies: `serve_p50_s`/`serve_p99_s`) gate correctly.
+Like every earlier bump, v5 changed every row_id, and the ledger file
+is regenerable scratch, so a pre-v5 ledger is simply deleted and
+re-ingested rather than migrated):
 
     metric, backend, value, unit, check, round, source,
     direction ("higher" | "lower" — which way is better; inferred
@@ -38,6 +42,8 @@ deleted and re-ingested rather than migrated):
     outage, fallback_reason, error,
     probe (health-check row, never a measurement),
     restart_count (warm restarts preceding the measuring child),
+    run (the producing run id, null when the source predates v8 run
+    stamping — the archive key for attribution),
     config (prng/window/cfg_*), fingerprint (metric x config hash),
     time_utc / git_sha / device_kind (from the embedded manifest),
     row_id (content hash — ingestion dedup key)
@@ -59,7 +65,7 @@ import re
 
 from cpr_tpu.resilience import atomic_write_text
 
-LEDGER_VERSION = 4
+LEDGER_VERSION = 5
 LEDGER_ENV_VAR = "CPR_PERF_LEDGER"
 
 # fallback_reason stamped onto rows whose artifact predates the outage
@@ -155,6 +161,13 @@ def normalize_row(row: dict, *, source: str = "live",
         "restart_count": (int(row["restart_count"])
                           if isinstance(row.get("restart_count"),
                                         (int, float)) else 0),
+        # v5: the producing run id (manifest `run`, inherited through
+        # $CPR_RUN_ID) — null for pre-v8 sources.  NOT part of the
+        # fingerprint: which run measured a number never changes what
+        # it is comparable against, it only makes the row resolvable
+        # through the run archive for attribution.
+        "run": (str(row["run"]) if row.get("run")
+                else (str(man["run"]) if man.get("run") else None)),
         "config": config,
         "fingerprint": config_fingerprint(metric, config),
         "time_utc": man.get("time_utc"),
@@ -207,17 +220,40 @@ _SERVE_METRICS = (("steps_per_sec", "serve_steps_per_sec", "steps/sec"),
                   ("p99_s", "serve_p99_s", "seconds"))
 
 
+def _memory_row(mem: dict, *, backend, run, config,
+                extra: dict | None = None) -> dict:
+    """One v15 memory watermark -> a `<scope>_peak_bytes` ledger row.
+    Lower-is-better rides explicitly (the name carries no `_s` suffix
+    — the serve_shed_rate precedent), and the sampling source joins
+    the fingerprint: an RSS watermark is host-process memory and must
+    never gate against a device-allocator one."""
+    scope = re.sub(r"[^0-9A-Za-z]+", "_",
+                   str(mem.get("scope") or "mem")).strip("_") or "mem"
+    row = {"metric": f"{scope}_peak_bytes", "backend": backend,
+           "run": run, "value": mem.get("peak_bytes"),
+           "unit": "bytes", "direction": "lower",
+           **{f"cfg_{k}": v for k, v in config.items()}}
+    if mem.get("source"):
+        row["cfg_mem_source"] = str(mem["source"])
+    if extra:
+        row.update(extra)
+    return row
+
+
 def iter_trace_rows(path: str):
     """Yield ledger-shaped rows from a telemetry JSONL trace: one per
     span carrying `per_sec` counters, metric `<span path>:<counter>`,
     up to four per `serve` report event (the serving layer's
     drain-time throughput + latency summary; _SERVE_METRICS), and a
     throughput + per-point-latency pair per `mdp_solve` event (grid-
-    batched exact-MDP solves, schema v10); backend/config taken from
-    the last manifest seen before the row (the stream layout every
-    producer follows)."""
+    batched exact-MDP solves, schema v10), and a lower-is-better
+    `<scope>_peak_bytes` row per v15 memory watermark (point event or
+    serve drain report block); backend/config/run taken from the last
+    manifest seen before the row (the stream layout every producer
+    follows) — the run id is what lets `perf_report --attribute`
+    resolve a banked number back to its archived trace."""
     base = os.path.basename(path)
-    backend, config = None, {}
+    backend, config, run = None, {}, None
     with open(path) as f:
         for line in f:
             line = line.strip()
@@ -229,12 +265,16 @@ def iter_trace_rows(path: str):
                 continue
             if e.get("kind") == "manifest":
                 backend = e.get("backend")
+                # v5: the stream's run id rides every lifted row, so a
+                # banked rate resolves back to its archived trace
+                if e.get("run"):
+                    run = str(e["run"])
                 config = {k: v for k, v in (e.get("config") or {}).items()
                           if isinstance(v, (str, int, float, bool))}
             elif e.get("kind") == "span" and e.get("per_sec"):
                 for counter, rate in e["per_sec"].items():
                     yield ({"metric": f"{e.get('path')}:{counter}_per_sec",
-                            "backend": backend, "value": rate,
+                            "backend": backend, "run": run, "value": rate,
                             "unit": f"{counter}/sec",
                             **{f"cfg_{k}": v for k, v in config.items()}},
                            base)
@@ -254,7 +294,7 @@ def iter_trace_rows(path: str):
                     if not isinstance(value, (int, float)):
                         continue
                     yield ({"metric": metric, "backend": backend,
-                            "value": value, "unit": unit,
+                            "run": run, "value": value, "unit": unit,
                             **{f"cfg_{k}": v for k, v in config.items()},
                             **dev_cfg},
                            base)
@@ -267,7 +307,8 @@ def iter_trace_rows(path: str):
                         if not isinstance(value, (int, float)):
                             continue
                         yield ({"metric": "serve_p99_s",
-                                "backend": backend, "value": value,
+                                "backend": backend, "run": run,
+                                "value": value,
                                 "unit": "seconds",
                                 "cfg_class": str(cls),
                                 **{f"cfg_{k}": v
@@ -280,10 +321,21 @@ def iter_trace_rows(path: str):
                 shed_rate = detail.get("shed_rate")
                 if isinstance(shed_rate, (int, float)):
                     yield ({"metric": "serve_shed_rate",
-                            "backend": backend, "value": shed_rate,
+                            "backend": backend, "run": run,
+                            "value": shed_rate,
                             "unit": "fraction", "direction": "lower",
                             **{f"cfg_{k}": v for k, v in config.items()},
                             **dev_cfg},
+                           base)
+                # v15: the serve memory watermark rides the drain
+                # report (the `memory` point event is also lifted,
+                # below — the report block covers streams cut before
+                # the final event landed)
+                mem = detail.get("memory")
+                if isinstance(mem, dict) and isinstance(
+                        mem.get("peak_bytes"), (int, float)):
+                    yield (_memory_row(mem, backend=backend, run=run,
+                                       config=config, extra=dev_cfg),
                            base)
             elif (e.get("kind") == "event" and e.get("name") == "serve"
                   and e.get("action") == "fleet_report"):
@@ -298,7 +350,8 @@ def iter_trace_rows(path: str):
                         if not isinstance(value, (int, float)):
                             continue
                         yield ({"metric": "fleet_p99_s",
-                                "backend": backend, "value": value,
+                                "backend": backend, "run": run,
+                                "value": value,
                                 "unit": "seconds",
                                 "cfg_family": str(family),
                                 **{f"cfg_{k}": v
@@ -335,7 +388,7 @@ def iter_trace_rows(path: str):
                 pps = e.get("points_per_sec")
                 if isinstance(pps, (int, float)):
                     yield ({"metric": "mdp_grid_points_per_sec",
-                            "backend": backend, "value": pps,
+                            "backend": backend, "run": run, "value": pps,
                             "unit": "grid-points/sec", **mdp_cfg},
                            base)
                     solve_s = e.get("solve_s")
@@ -343,13 +396,13 @@ def iter_trace_rows(path: str):
                     if (isinstance(solve_s, (int, float))
                             and isinstance(points, int) and points > 0):
                         yield ({"metric": "mdp_grid_point_solve_s",
-                                "backend": backend,
+                                "backend": backend, "run": run,
                                 "value": round(solve_s / points, 6),
                                 "unit": "seconds", **mdp_cfg}, base)
                 sps = e.get("states_per_sec")
                 if isinstance(sps, (int, float)):
                     yield ({"metric": "mdp_states_per_sec",
-                            "backend": backend, "value": sps,
+                            "backend": backend, "run": run, "value": sps,
                             "unit": "states/sec", **mdp_cfg}, base)
             elif (e.get("kind") == "event"
                   and e.get("name") == "mdp_compile"):
@@ -368,7 +421,7 @@ def iter_trace_rows(path: str):
                     "cfg_workers": int(e.get("n_workers") or 1),
                 }
                 yield ({"metric": "mdp_compile_states_per_sec",
-                        "backend": backend, "value": sps,
+                        "backend": backend, "run": run, "value": sps,
                         "unit": "states/sec", **cmp_cfg}, base)
             elif (e.get("kind") == "event"
                   and e.get("name") == "attack_sweep"):
@@ -392,8 +445,18 @@ def iter_trace_rows(path: str):
                 if isinstance(nd, (int, float)) and nd:
                     atk_cfg["cfg_devices"] = int(nd)
                 yield ({"metric": "attack_sweep_lanes_per_sec",
-                        "backend": backend, "value": lps,
+                        "backend": backend, "run": run, "value": lps,
                         "unit": "lanes/sec", **atk_cfg}, base)
+            elif (e.get("kind") == "event"
+                  and e.get("name") == "memory"):
+                # schema v15: live memory watermarks bank one
+                # lower-is-better `<scope>_peak_bytes` row apiece,
+                # sitting next to the `vi_working_set_bytes`
+                # prediction so claim meets measurement
+                if isinstance(e.get("peak_bytes"), (int, float)):
+                    yield (_memory_row(e, backend=backend, run=run,
+                                       config=config),
+                           base)
 
 
 class Ledger:

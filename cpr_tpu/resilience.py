@@ -191,7 +191,8 @@ def atomic_write_text(path: str, text: str, encoding: str = "utf-8"):
 
 # -- deterministic fault injection -------------------------------------------
 
-_ACTIONS = ("kill", "io_error", "fault", "nan", "preempt", "hang")
+_ACTIONS = ("kill", "io_error", "fault", "nan", "preempt", "hang",
+            "slow")
 # occurrence-counted sites (kill@vi_chunk=3 means the third pass)
 _COUNTED_SITES = ("checkpoint", "vi_chunk", "compile_round")
 
@@ -201,6 +202,14 @@ _COUNTED_SITES = ("checkpoint", "vi_chunk", "compile_round")
 # `fire` returns and the one-shot/count bookkeeping can be asserted.
 HANG_DURATION_ENV_VAR = "CPR_FAULT_HANG_S"
 _DEFAULT_HANG_S = 3600.0
+
+# how long an injected `slow` sleeps before RETURNING (v15): unlike
+# `hang` it is a cooperative, bounded slowdown — the site survives,
+# just late — which is what a regression looks like in a trace.  The
+# obs smoke injects one at a serve burst and asserts trace_diff names
+# the phase that ate it (tools/obs_smoke.py).
+SLOW_DURATION_ENV_VAR = "CPR_FAULT_SLOW_S"
+_DEFAULT_SLOW_S = 0.75
 
 
 class FaultSpec:
@@ -273,6 +282,13 @@ class FaultInjector:
                 # though this process is about to be killed)
                 time.sleep(float(os.environ.get(
                     HANG_DURATION_ENV_VAR, _DEFAULT_HANG_S)))
+            if s.action == "slow":
+                # a bounded cooperative slowdown: sleep, then continue
+                # — the deterministic stand-in for a perf regression
+                # (the site's own timers absorb the sleep, so the delay
+                # lands in whatever span/latency family covers it)
+                time.sleep(float(os.environ.get(
+                    SLOW_DURATION_ENV_VAR, _DEFAULT_SLOW_S)))
             return s.action
         return None
 

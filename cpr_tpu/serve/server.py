@@ -190,6 +190,12 @@ class ServeServer:
         self.metrics.attach_board(
             "latency_seconds", self.latency,
             help="per-op-family reply latency (seconds)")
+        # v15 live memory watermark: sampled once per heartbeat (one
+        # allocator stats read — well under the <2% overhead budget),
+        # surfaced as gauges in both scrape paths, in the heartbeat /
+        # stats / drain reports, and emitted as the typed `memory`
+        # event at drain (the ledger lifts it to serve_peak_bytes)
+        self.mem = telemetry.MemoryWatermark("serve")
         # SLO burn-rate alerting: per-class latency budgets are the
         # SAME scaled budgets admission control sheds against, so an
         # alert and a shed always agree on what "over SLO" means
@@ -272,7 +278,8 @@ class ServeServer:
                     pending_steps=len(self._pending),
                     exec_ops=len(self._inflight_exec),
                     sheds=self._sheds,
-                    alerts=self.alerts.summary())
+                    alerts=self.alerts.summary(),
+                    memory=self.mem.snapshot())
             await asyncio.sleep(0.0 if progressed else self.idle_sleep_s)
 
     def _tick_once(self) -> bool:
@@ -331,43 +338,58 @@ class ServeServer:
                         for lane, s in self.sched.assigned().items()
                         if s.kind == "policy"}
         if policy_lanes:
-            t0 = telemetry.now()
-            for s in policy_lanes.values():
-                if s.t_first_burst is None:
-                    s.t_first_burst = t0
-            out = self.engine.burst_run(
-                {lane: s.policy_id for lane, s in policy_lanes.items()},
-                occupancy=self.sched.occupancy())
-            t1 = telemetry.now()
-            self.latency.observe("device.burst", t1 - t0)
-            for lane, s in policy_lanes.items():
-                if not out["done"][lane]:
-                    continue  # episode spans into the next burst
-                s.t_complete = t1
-                att = float(out["episode_reward_attacker"][lane])
-                dfn = float(out["episode_reward_defender"][lane])
-                episode = dict(
-                    reward_attacker=att, reward_defender=dfn,
-                    progress=float(out["episode_progress"][lane]),
-                    n_steps=int(out["episode_n_steps"][lane]),
-                    relative_reward=(att / (att + dfn)
-                                     if (att + dfn) else 0.0))
-                if not s.future.done():
-                    s.future.set_result(dict(
-                        ok=True, session=s.sid, seed=s.seed,
-                        policy=s.policy, episode=episode))
-                self.sched.retire(lane)
-                _serve_event("complete", s.sid, kind="policy",
-                             n_steps=episode["n_steps"],
-                             relative_reward=episode["relative_reward"])
-            # chaos seam for the fleet smoke: a replica-tagged server
-            # checks the injector after each completed burst, so
-            # CPR_FAULT_INJECT="kill@replica=<i>" deterministically
-            # kills exactly replica i at its first burst under load
-            # (and hang@replica wedges its tick loop, which the
-            # supervisor's quiet watchdog catches)
-            if self.replica_index is not None:
-                resilience.fault_point("replica", self.replica_index)
+            # v15: the burst dispatch is a SPAN, not just a latency
+            # observation — span paths are what tools/trace_diff.py
+            # aligns two runs by, so the serving layer's device work
+            # (and the replica chaos seam below, whose injected
+            # `slow@replica` sleep lands inside this scope) is
+            # attributable to a named path
+            with telemetry.current().span(
+                    "serve_burst",
+                    env_steps=len(policy_lanes) * self.engine.burst):
+                t0 = telemetry.now()
+                for s in policy_lanes.values():
+                    if s.t_first_burst is None:
+                        s.t_first_burst = t0
+                out = self.engine.burst_run(
+                    {lane: s.policy_id
+                     for lane, s in policy_lanes.items()},
+                    occupancy=self.sched.occupancy())
+                t1 = telemetry.now()
+                self.latency.observe("device.burst", t1 - t0)
+                for lane, s in policy_lanes.items():
+                    if not out["done"][lane]:
+                        continue  # episode spans into the next burst
+                    s.t_complete = t1
+                    att = float(out["episode_reward_attacker"][lane])
+                    dfn = float(out["episode_reward_defender"][lane])
+                    episode = dict(
+                        reward_attacker=att, reward_defender=dfn,
+                        progress=float(out["episode_progress"][lane]),
+                        n_steps=int(out["episode_n_steps"][lane]),
+                        relative_reward=(att / (att + dfn)
+                                         if (att + dfn) else 0.0))
+                    if not s.future.done():
+                        s.future.set_result(dict(
+                            ok=True, session=s.sid, seed=s.seed,
+                            policy=s.policy, episode=episode))
+                    self.sched.retire(lane)
+                    _serve_event(
+                        "complete", s.sid, kind="policy",
+                        n_steps=episode["n_steps"],
+                        relative_reward=episode["relative_reward"])
+                # chaos seam for the fleet smoke: a replica-tagged
+                # server checks the injector after each completed
+                # burst, so CPR_FAULT_INJECT="kill@replica=<i>"
+                # deterministically kills exactly replica i at its
+                # first burst under load (hang@replica wedges its tick
+                # loop for the supervisor's quiet watchdog;
+                # slow@replica sleeps INSIDE the serve_burst span —
+                # the deterministic stand-in for a perf regression
+                # that tools/obs_smoke.py asserts trace_diff blames)
+                if self.replica_index is not None:
+                    resilience.fault_point("replica",
+                                           self.replica_index)
             progressed = True
         return progressed
 
@@ -376,6 +398,21 @@ class ServeServer:
         engine state — the same readings the heartbeat event carries,
         pull-scrapeable between heartbeats."""
         g = self.metrics.set
+        # one allocator read per refresh keeps the watermark live in
+        # both scrape paths without a second sampling thread
+        self.mem.sample()
+        if self.mem.peak_bytes is not None:
+            g("memory_peak_bytes", self.mem.peak_bytes,
+              help="peak device/process memory over the serve run "
+                   "(bytes; max across devices)")
+        if self.mem.in_use_bytes is not None:
+            g("memory_in_use_bytes", self.mem.in_use_bytes,
+              help="device/process memory in use at last sample "
+                   "(bytes)")
+        if self.mem.headroom_bytes is not None:
+            g("memory_headroom_bytes", self.mem.headroom_bytes,
+              help="allocator limit minus peak (bytes) — remaining "
+                   "capacity before the allocator refuses")
         g("queued", self.sched.n_queued(),
           help="admission queue depth")
         g("occupancy", self.sched.occupancy(),
@@ -452,6 +489,12 @@ class ServeServer:
         for a in self.alerts.evaluate():
             slo_alerts.emit_alert(a)
         report["alerts"] = self.alerts.summary()
+        # final watermark sample rides the report AND the typed
+        # `memory` event — the report block is what survives when a
+        # stream gets cut before the final event lands
+        self.mem.sample()
+        self.mem.emit()
+        report["memory"] = self.mem.snapshot()
         _serve_event("report", **report)
         self.engine.emit_metrics()
         _serve_event("stop", reason=reason, steps=report["steps"],
@@ -552,7 +595,8 @@ class ServeServer:
                         # the raw mergeable wire form: the router
                         # bucket-sums these into the fleet board
                         latencies_raw=self.latency.to_dict(),
-                        alerts=self.alerts.summary())
+                        alerts=self.alerts.summary(),
+                        memory=self.mem.snapshot())
         if op == "metrics.scrape":
             # the in-band twin of the --metrics-port HTTP endpoint:
             # the registry's structured form (histograms_raw inside is
@@ -677,7 +721,17 @@ class ServeServer:
         self.metrics.inc("admitted_total", cls=cls,
                          help="sessions admitted, by priority class")
         resp = await s.future
-        return dict(resp, latency=self._session_latency(s),
+        lat = self._session_latency(s)
+        if s.t_complete is not None:
+            # the reply can leave late: between the burst stamping
+            # t_complete and this coroutine resuming, the tick loop
+            # may stall (GC, a wedged device, an injected
+            # slow@replica) — wall the client is actually waiting, so
+            # it belongs in the latency the board/drain report gate on
+            stall = max(0.0, telemetry.now() - s.t_complete)
+            lat["service_s"] += stall
+            lat["total_s"] += stall
+        return dict(resp, latency=lat,
                     _lane=s.lane, _splice_s=s.splice_s, _class=s.cls)
 
     async def _op_episode_open(self, req):

@@ -50,12 +50,13 @@ import re
 import socket
 import subprocess
 import sys
+import threading
 from collections import deque
 from contextlib import contextmanager
 from datetime import datetime, timezone
 from time import perf_counter as now  # noqa: F401 — re-exported
 
-SCHEMA_VERSION = 14
+SCHEMA_VERSION = 15
 TELEMETRY_ENV_VAR = "CPR_TELEMETRY"
 # flight-recorder ring capacity (v14): last N emitted events kept
 # in-process for the crash blackbox (cpr_tpu/monitor/blackbox.py)
@@ -96,8 +97,15 @@ EVENT_FIELDS = {
     "netsim": ("protocol", "lanes", "activations", "steps", "drops"),
     # v5: one per perf-regression gate (cpr_tpu/perf): verdict is
     # pass|warn|fail|skip, baseline names the banked rows judged
-    # against (null when no same-backend history exists)
-    "perf_gate": ("metric", "backend", "verdict", "value", "baseline"),
+    # against (null when no same-backend history exists).  v15 makes
+    # verdicts attributable: `run` is the candidate row's run id (null
+    # when the row predates run stamping) and `baseline_runs` the run
+    # ids of the banked baseline rows — both resolvable through the
+    # run archive (cpr_tpu/perf/archive.py) into full trace streams,
+    # which is how `perf_report --attribute` chases a FAIL into a
+    # culprit span table (tools/trace_diff.py).
+    "perf_gate": ("metric", "backend", "verdict", "value", "baseline",
+                  "run", "baseline_runs"),
     # v6: one per supervisor decision (cpr_tpu/supervisor): action is
     # probe|heartbeat_stall|hang|warm_restart|escalation, site names the
     # supervised workload, reason says why (timings ride as extras)
@@ -175,6 +183,18 @@ EVENT_FIELDS = {
     # time).  Extras ride free-form: cls, threshold, slo_s.
     "alert": ("signal", "severity", "window_s", "value", "budget",
               "burn_rate"),
+    # v15: one per MemoryWatermark scope (serve run loop, VI/grid
+    # chunk drivers, frontier compiler): scope names the measured
+    # region ("serve", "vi", "mdp_grid", "mdp_compile"), peak_bytes is
+    # the per-device high-water mark over the scope (max across
+    # devices), source says where the numbers came from — "device"
+    # (allocator memory_stats) or "rss" (process fallback on backends
+    # exposing none, XLA:CPU).  Extras ride free-form: in_use_bytes,
+    # delta_bytes, limit_bytes, n_samples, devices, predicted_bytes
+    # (the vi_working_set_bytes prediction, where the caller knows it).
+    # The perf ledger lifts these into lower-is-better
+    # `<scope>_peak_bytes` rows (iter_trace_rows).
+    "memory": ("scope", "peak_bytes", "source"),
 }
 
 
@@ -203,6 +223,14 @@ _run_id: str | None = None
 
 _blackbox: deque | None = None
 
+# one process-wide lock serializes the emit path (counter, ring append,
+# sink write+flush) against concurrent emitters — the serve tick loop,
+# the heartbeat thread, and the metrics HTTP threads all emit into the
+# same sink — and guards the ring copy `dump_blackbox` takes (iterating
+# a deque while another thread appends raises RuntimeError).  Emit is
+# flushed-per-event already, so the lock adds no new syscall.
+_emit_lock = threading.Lock()
+
 
 def blackbox_capacity() -> int:
     """Ring capacity: $CPR_BLACKBOX_EVENTS (>=1), default 512."""
@@ -223,8 +251,10 @@ def _blackbox_ring() -> deque:
 
 def blackbox_events() -> list[dict]:
     """The recorded tail, oldest first (a copy — safe to serialize
-    while the emit path keeps appending)."""
-    return list(_blackbox_ring())
+    while the emit path keeps appending; taken under the emit lock so
+    a concurrent append can never abort the copy mid-iteration)."""
+    with _emit_lock:
+        return list(_blackbox_ring())
 
 
 def run_id() -> str:
@@ -247,6 +277,23 @@ def trace_env() -> dict:
     """The env-var dict that carries the trace context into a child
     process (merged into the child env by supervisor.run_child)."""
     return {RUN_ID_ENV_VAR: run_id()}
+
+
+def reset_run_id(rid: str | None = None) -> str:
+    """Mint (or install) a fresh run id for this process and every
+    child spawned after this call.  Harness-side API: a parent that
+    supervises several children as *separate* runs (the A/B pair
+    tools/obs_smoke.py archives and diffs) must re-mint between them,
+    or `run_child`'s trace_env() inheritance collapses the pair into
+    one run record."""
+    global _run_id
+    if not rid:
+        import uuid
+
+        rid = uuid.uuid4().hex[:16]
+    _run_id = rid
+    os.environ[RUN_ID_ENV_VAR] = rid
+    return rid
 
 
 def new_trace_id() -> str:
@@ -344,18 +391,26 @@ class Telemetry:
     def emit(self, event: dict):
         """Write one event line (no-op when disabled).  Flushed per
         event: telemetry exists for post-mortems, a crash must not eat
-        the tail of the stream."""
-        # counted before the sink check: the supervisor heartbeat reads
-        # this as a progress signal, which must work sink or no sink
-        self.n_emitted += 1
-        # the flight recorder likewise rides every emit (v14): the ring
-        # must capture the tail even when no sink is configured — a
-        # sinkless crash is exactly when the blackbox is the only record
-        _blackbox_ring().append(event)
-        if self._sink is None:
-            return
-        self._sink.write(json.dumps(event, default=str) + "\n")
-        self._sink.flush()
+        the tail of the stream.  Serialized under the process-wide emit
+        lock — the serve tick loop, the heartbeat thread, and the
+        metrics HTTP threads share one sink, and two interleaved
+        partial writes would corrupt the JSONL stream."""
+        line = (json.dumps(event, default=str) + "\n"
+                if self._sink is not None else None)
+        with _emit_lock:
+            # counted before the sink check: the supervisor heartbeat
+            # reads this as a progress signal, sink or no sink
+            self.n_emitted += 1
+            # the flight recorder likewise rides every emit (v14): the
+            # ring must capture the tail even when no sink is
+            # configured — a sinkless crash is exactly when the
+            # blackbox is the only record
+            _blackbox_ring().append(event)
+            sink = self._sink  # re-read under the lock: close() races
+            if line is None or sink is None:
+                return
+            sink.write(line)
+            sink.flush()
 
     def span_path(self) -> str | None:
         """Innermost open span's path, or None outside any span — the
@@ -431,9 +486,43 @@ _MEM_KEYS = ("bytes_in_use", "peak_bytes_in_use", "bytes_limit",
              "largest_free_block_bytes")
 
 
+def process_memory() -> tuple[int, int] | None:
+    """This process's (rss_bytes, peak_rss_bytes), or None when the
+    platform exposes neither /proc/self/status nor getrusage.  The v15
+    memory plane's CPU-backend fallback: XLA:CPU implements no
+    allocator `memory_stats`, and a watermark plane that is dead on
+    the forced-CPU CI host would never be exercised in tier-1."""
+    rss = peak = None
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    rss = int(line.split()[1]) * 1024
+                elif line.startswith("VmHWM:"):
+                    peak = int(line.split()[1]) * 1024
+    except (OSError, ValueError, IndexError):
+        pass
+    if rss is not None:
+        return rss, (peak if peak is not None else rss)
+    try:
+        import resource
+
+        peak = int(resource.getrusage(
+            resource.RUSAGE_SELF).ru_maxrss) * 1024  # linux: KiB
+        # no live-RSS source without /proc — the peak stands in for
+        # both (still a valid watermark, just a coarse in-use)
+        return peak, peak
+    except Exception:  # noqa: BLE001 — memory stats are best-effort
+        return None
+
+
 def device_memory_stats() -> dict | None:
-    """Per-device allocator stats (subset of memory_stats keys), or None
-    when the backend exposes none (XLA:CPU)."""
+    """Per-device allocator stats (subset of memory_stats keys).  On
+    backends exposing none (XLA:CPU) falls back to one process-RSS
+    entry tagged `source: "rss"` (v15) — consumers must treat a tagged
+    entry as host-process memory, not device allocator state; real-chip
+    entries are unchanged and untagged.  Returns None only when no
+    source exists at all."""
     import jax
 
     out = {}
@@ -445,7 +534,15 @@ def device_memory_stats() -> dict | None:
         if ms:
             out[f"{d.platform}:{d.id}"] = {
                 k: int(ms[k]) for k in _MEM_KEYS if k in ms}
-    return out or None
+    if out:
+        return out
+    pm = process_memory()
+    if pm is None:
+        return None
+    rss, peak = pm
+    return {"process:rss": {"bytes_in_use": rss,
+                            "peak_bytes_in_use": peak,
+                            "source": "rss"}}
 
 
 def run_manifest(config: dict | None = None) -> dict:
@@ -484,6 +581,156 @@ def run_manifest(config: dict | None = None) -> dict:
     if config is not None:
         man["config"] = config
     return man
+
+
+# -- live memory watermarks (schema v15) -------------------------------------
+#
+# The binding constraint on the exact-analysis ceiling is device
+# memory, but before v15 it was only visible as the one-shot manifest
+# `memory_before` and the after-the-fact ViWorkingSetTooLarge /
+# PaddedLayoutTooLarge refusals.  A MemoryWatermark samples the
+# allocator (or the RSS fallback) at a scope's natural host seams —
+# per VI chunk, per frontier round, per serve heartbeat — and emits
+# ONE typed `memory` event per scope with the high-water mark.  One
+# stats read per sample, never per device step, keeps it inside the
+# <2% overhead budget every other plane honors.
+
+
+class MemoryWatermark:
+    """Track the device-memory high-water mark over a scope.
+
+        with telemetry.memory_watermark("vi") as wm:
+            for chunk in chunks:
+                dispatch(chunk)
+                wm.sample()          # cheap: one stats read
+
+    Samples on enter, on every `sample()`, and on exit; exit emits a
+    typed v15 `memory` event (scope, peak_bytes, source + extras).
+    `peak_bytes` is the max per-device `peak_bytes_in_use` seen (the
+    capacity limit is per chip, so devices are never summed);
+    `in_use_bytes` the latest per-device max; `limit_bytes` the
+    smallest per-device `bytes_limit` (headroom = limit - peak, the
+    autoscaler signal); `delta_bytes` in-use now minus in-use at
+    enter.  On XLA:CPU every number is process RSS, tagged
+    `source: "rss"`.  All attributes are None until a sample
+    succeeds; a backend with no memory source at all leaves the
+    watermark inert (the event still emits, with nulls)."""
+
+    def __init__(self, scope: str, tele: "Telemetry | None" = None,
+                 **extra):
+        self.scope = str(scope)
+        self._tele = tele
+        self.extra = dict(extra)
+        self.source: str | None = None
+        self.peak_bytes: int | None = None
+        self.in_use_bytes: int | None = None
+        self.limit_bytes: int | None = None
+        self.baseline_bytes: int | None = None
+        self.n_samples = 0
+        self.devices: dict = {}
+
+    def sample(self) -> dict | None:
+        """Read the allocator once and fold it into the watermark.
+        Returns the raw per-device stats (or None when no source
+        exists).  Never raises — a memory probe must not kill the
+        scope it is measuring."""
+        try:
+            stats = device_memory_stats()
+        except Exception:  # noqa: BLE001 — probe failures stay silent
+            return None
+        if not stats:
+            return None
+        self.n_samples += 1
+        in_use_max: int | None = None
+        for dev, ms in stats.items():
+            if ms.get("source") == "rss":
+                self.source = "rss"
+            elif self.source is None:
+                self.source = "device"
+            peak = ms.get("peak_bytes_in_use")
+            in_use = ms.get("bytes_in_use")
+            limit = ms.get("bytes_limit")
+            rec = self.devices.setdefault(dev, {})
+            if peak is not None:
+                rec["peak_bytes"] = max(rec.get("peak_bytes", 0),
+                                        int(peak))
+                if self.peak_bytes is None or peak > self.peak_bytes:
+                    self.peak_bytes = int(peak)
+            if in_use is not None:
+                rec["in_use_bytes"] = int(in_use)
+                # the watermark must not miss a peak the allocator
+                # doesn't track: in-use is a peak lower bound
+                rec["peak_bytes"] = max(rec.get("peak_bytes", 0),
+                                        int(in_use))
+                if self.peak_bytes is None or in_use > self.peak_bytes:
+                    self.peak_bytes = int(in_use)
+                in_use_max = max(in_use_max or 0, int(in_use))
+            if limit is not None:
+                rec["limit_bytes"] = int(limit)
+                if self.limit_bytes is None or limit < self.limit_bytes:
+                    self.limit_bytes = int(limit)
+        if in_use_max is not None:
+            self.in_use_bytes = in_use_max
+            if self.baseline_bytes is None:
+                self.baseline_bytes = in_use_max
+        return stats
+
+    @property
+    def delta_bytes(self) -> int | None:
+        if self.in_use_bytes is None or self.baseline_bytes is None:
+            return None
+        return self.in_use_bytes - self.baseline_bytes
+
+    @property
+    def headroom_bytes(self) -> int | None:
+        """limit - peak: how much the scope could still grow before
+        the allocator refuses — the autoscaler's capacity signal.
+        None without a limit (the RSS fallback reports none)."""
+        if self.limit_bytes is None or self.peak_bytes is None:
+            return None
+        return self.limit_bytes - self.peak_bytes
+
+    def snapshot(self) -> dict:
+        """JSON-ready summary for heartbeat/stats/drain reports."""
+        out = {"scope": self.scope, "source": self.source,
+               "peak_bytes": self.peak_bytes,
+               "in_use_bytes": self.in_use_bytes,
+               "delta_bytes": self.delta_bytes,
+               "n_samples": self.n_samples}
+        if self.limit_bytes is not None:
+            out["limit_bytes"] = self.limit_bytes
+            out["headroom_bytes"] = self.headroom_bytes
+        return out
+
+    def emit(self, **extra):
+        """Emit the typed v15 `memory` event (also called by exit)."""
+        fields = dict(self.extra)
+        fields.update(extra)
+        tele = self._tele if self._tele is not None else current()
+        tele.event(
+            "memory", scope=self.scope, peak_bytes=self.peak_bytes,
+            source=self.source, in_use_bytes=self.in_use_bytes,
+            delta_bytes=self.delta_bytes, limit_bytes=self.limit_bytes,
+            n_samples=self.n_samples, devices=self.devices or None,
+            **fields)
+
+    def __enter__(self) -> "MemoryWatermark":
+        self.sample()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        # sample + emit on the failure path too: memory at the crash
+        # is exactly what a post-mortem wants
+        self.sample()
+        self.emit()
+        return False
+
+
+def memory_watermark(scope: str, tele: "Telemetry | None" = None,
+                     **extra) -> MemoryWatermark:
+    """A MemoryWatermark bound to the current sink (resolved at emit
+    time, so configure() after construction still lands the event)."""
+    return MemoryWatermark(scope, tele, **extra)
 
 
 # -- compile observability ---------------------------------------------------

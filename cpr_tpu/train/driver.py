@@ -320,6 +320,11 @@ def train_from_config(cfg: TrainConfig, *, out_dir: str | None = None,
     # lost work a preemption costs, ROADMAP item 2's SLO)
     from cpr_tpu.monitor.registry import MetricsRegistry
     health = MetricsRegistry(namespace="cpr_train")
+    # v15 live memory watermark: sampled once per update alongside the
+    # gauges, emitted as the typed `memory` event when the run winds
+    # down (exception path included — the finally below owns it)
+    mem = telemetry.MemoryWatermark("train")
+    mem.sample()
     metrics_server = None
     if metrics_port is not None:
         from cpr_tpu.monitor.expo import MetricsServer
@@ -345,6 +350,18 @@ def train_from_config(cfg: TrainConfig, *, out_dir: str | None = None,
                    (update - last_snap[1]
                     if last_snap[1] is not None else update),
                    help="updates since the last durable snapshot")
+        mem.sample()
+        if mem.peak_bytes is not None:
+            health.set("memory_peak_bytes", mem.peak_bytes,
+                       help="peak device/process memory this run "
+                            "(bytes; max across devices)")
+        if mem.in_use_bytes is not None:
+            health.set("memory_in_use_bytes", mem.in_use_bytes,
+                       help="device/process memory in use at last "
+                            "sample (bytes)")
+        if mem.headroom_bytes is not None:
+            health.set("memory_headroom_bytes", mem.headroom_bytes,
+                       help="allocator limit minus peak (bytes)")
 
     snap_path = (resume if isinstance(resume, str) else
                  os.path.join(out_dir, "snapshot.msgpack")
@@ -578,6 +595,8 @@ def train_from_config(cfg: TrainConfig, *, out_dir: str | None = None,
         # restore the pre-loop SIGTERM/SIGINT handlers even when the
         # loop unwinds via an exception
         preempt_ctx.__exit__(None, None, None)
+        mem.sample()
+        mem.emit()
         if metrics_log is not None:
             metrics_log.close()
         if metrics_server is not None:

@@ -22,3 +22,10 @@ def report(tele, fn_name, tid):
     # burn rate is unjudgeable)
     tele.event("alert", signal="shed_rate", severity="page",
                window_s=30.0, value=0.4, budget=0.02)
+    # finding: missing run, baseline_runs (v15 perf_gate — a verdict
+    # without provenance cannot be chased through the run archive)
+    tele.event("perf_gate", metric="serve_p99_s", backend="cpu",
+               verdict="fail", value=0.8, baseline=None)
+    # finding: missing source (v15 memory — a watermark is only
+    # comparable when it says what was sampled: device stats or rss)
+    tele.event("memory", scope="serve", peak_bytes=1 << 28)

@@ -24,3 +24,10 @@ def report(tele, fn_name, dt, err, extra, tid):
     tele.event("alert", signal="p99_over_slo", severity="ticket",
                window_s=60.0, value=dt, budget=0.5, burn_rate=dt,
                cls="batch", threshold=1.0)  # extras ride free-form
+    tele.event("perf_gate", metric="serve_p99_s", backend="cpu",
+               verdict="fail", value=dt, baseline=None,
+               run=tid, baseline_runs=[],
+               reason="x")  # extras ride free-form
+    tele.event("memory", scope="serve", peak_bytes=1 << 28,
+               source="rss", in_use_bytes=1 << 27,
+               n_samples=12)  # extras ride free-form

@@ -14,4 +14,7 @@ EVENT_FIELDS = {
                     "transitions", "n_workers"),
     "alert": ("signal", "severity", "window_s", "value", "budget",
               "burn_rate"),
+    "perf_gate": ("metric", "backend", "verdict", "value", "baseline",
+                  "run", "baseline_runs"),
+    "memory": ("scope", "peak_bytes", "source"),
 }

@@ -1,0 +1,363 @@
+"""v15 attribution-plane tests: the run archive
+(cpr_tpu/perf/archive.py), the span-level trace diff
+(tools/trace_diff.py), live memory watermarks
+(telemetry.MemoryWatermark), and the ledger/gate provenance that ties
+them together — `run` on every banked row, `run`/`baseline_runs` on
+every verdict, `<scope>_peak_bytes` capacity rows.
+
+`make obs-smoke` proves the same chain end-to-end against a real
+supervised server pair; these tests pin the pieces in isolation.
+"""
+
+import importlib.util
+import json
+import os
+import sys
+
+import pytest
+
+from cpr_tpu import telemetry
+from cpr_tpu.perf import archive
+from cpr_tpu.perf.gate import emit_gate_event, gate_row
+from cpr_tpu.perf.ledger import Ledger
+
+REPO = os.path.join(os.path.dirname(__file__), "..")
+sys.path.insert(0, REPO)
+
+
+def _load_tool(name):
+    path = os.path.join(REPO, "tools", f"{name}.py")
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _manifest(run, config=None, **extra):
+    return dict({"kind": "manifest", "schema": telemetry.SCHEMA_VERSION,
+                 "run": run, "backend": "cpu", "git_sha": "deadbeef01",
+                 "time_utc": "2026-08-07T00:00:00+00:00",
+                 "config": config if config is not None else {"n": 512}},
+                **extra)
+
+
+def _span(path, dur_s, **counters):
+    name = path.rsplit("/", 1)[-1]
+    e = {"kind": "span", "name": name, "path": path,
+         "depth": path.count("/"), "t_start": 0.0, "t_end": dur_s,
+         "dur_s": dur_s}
+    if counters:
+        e["counters"] = dict(counters)
+        e["per_sec"] = {k: v / dur_s for k, v in counters.items()}
+    return e
+
+
+def _write_trace(path, events):
+    with open(path, "w") as f:
+        for e in events:
+            f.write(json.dumps(e) + "\n")
+    return str(path)
+
+
+# -- run archive -------------------------------------------------------------
+
+
+def test_archive_roundtrip_merge_and_query(tmp_path):
+    root = str(tmp_path / "arch")
+    t1 = _write_trace(tmp_path / "server.jsonl",
+                      [_manifest("run-aaaa"), _span("tick", 0.5)])
+    rec = archive.archive_run(paths=[t1], root=root,
+                              roles={t1: "server"}, label="first")
+    assert rec["run"] == "run-aaaa"
+    assert rec["git_sha"] == "deadbeef01" and rec["backend"] == "cpu"
+    assert rec["fingerprint"] == archive.config_fingerprint({"n": 512})
+    (art,) = rec["artifacts"]
+    assert art["kind"] == archive.KIND_TELEMETRY
+    assert art["role"] == "server" and art["n_spans"] == 1
+
+    # re-archiving the same artifact converges; a new one merges in
+    t2 = _write_trace(tmp_path / "client.jsonl",
+                      [_manifest("run-aaaa"), _span("req", 0.1)])
+    rec2 = archive.archive_run(paths=[t1, t2], root=root)
+    assert {a["path"] for a in rec2["artifacts"]} == {t1, t2}
+    assert rec2["label"] == "first"  # carried from the prior record
+
+    loaded = archive.load_run("run-aaaa", root)
+    assert loaded == rec2
+    assert archive.load_run("no-such-run", root) is None
+
+    # the query side: git-sha prefix, fingerprint, time window
+    assert [r["run"] for r in archive.find_runs(root)] == ["run-aaaa"]
+    assert archive.find_runs(root, git_sha="deadbe")
+    assert archive.find_runs(
+        root, fingerprint=archive.config_fingerprint({"n": 512}))
+    assert not archive.find_runs(root, git_sha="feedface")
+    assert archive.find_runs(root, since="2026-08-01",
+                             until="2026-08-31")
+    assert not archive.find_runs(root, until="2026-01-01")
+
+    # the audit index appended one line per archive_run call
+    with open(archive.index_path(root)) as f:
+        idx = [json.loads(ln) for ln in f]
+    assert len(idx) == 2 and all(i["run"] == "run-aaaa" for i in idx)
+
+
+def test_archive_discovery_and_primary_stream(tmp_path):
+    root = str(tmp_path / "arch")
+    scratch = tmp_path / "scratch"
+    scratch.mkdir()
+    child = _write_trace(scratch / "child.jsonl",
+                         [_manifest("run-bbbb"), _span("a", 0.1),
+                          _span("b", 0.1), _span("c", 0.1)])
+    other = _write_trace(scratch / "other.jsonl",
+                         [_manifest("run-zzzz"), _span("x", 0.1)])
+    server = _write_trace(tmp_path / "server.jsonl",
+                          [_manifest("run-bbbb"), _span("tick", 0.5)])
+    rec = archive.archive_run(paths=[server], root=root,
+                              roles={server: "server"},
+                              search_dirs=[str(scratch)])
+    got = {a["path"] for a in rec["artifacts"]}
+    assert got == {server, child}  # other run's stream NOT swept in
+    assert other not in got
+    # role "server" outranks the span-richer unlabeled child stream
+    assert archive.primary_stream(rec) == server
+    assert set(archive.run_streams(rec)) == {server, child}
+    assert archive.run_streams(rec, role="server") == [server]
+
+
+def test_archive_requires_a_run_id(tmp_path):
+    bare = _write_trace(tmp_path / "bare.jsonl", [_span("tick", 0.1)])
+    with pytest.raises(ValueError, match="no run id"):
+        archive.archive_run(paths=[bare], root=str(tmp_path / "a"))
+    # explicit run= resolves it
+    rec = archive.archive_run(paths=[bare], run="run-cccc",
+                              root=str(tmp_path / "a"))
+    assert rec["run"] == "run-cccc"
+
+
+def test_archive_env_override(tmp_path, monkeypatch):
+    monkeypatch.setenv(archive.ARCHIVE_ENV_VAR, str(tmp_path / "env"))
+    assert archive.archive_dir() == str(tmp_path / "env")
+    assert archive.archive_dir("/explicit") == "/explicit"
+    monkeypatch.delenv(archive.ARCHIVE_ENV_VAR)
+    assert archive.archive_dir() == archive.DEFAULT_ARCHIVE_DIR
+
+
+# -- span-level trace diff ---------------------------------------------------
+
+
+def _ab_traces(tmp_path, stall=0.8):
+    """Baseline and candidate: same shape, candidate's `tick/burst`
+    grew by `stall` seconds of pure self time."""
+    base = _write_trace(tmp_path / "a.jsonl", [
+        _manifest("run-base"),
+        _span("tick/burst", 0.1, env_steps=1000),
+        _span("tick", 0.15),
+        {"kind": "event", "name": "memory", "scope": "serve",
+         "peak_bytes": 1000, "source": "rss"},
+    ])
+    cand = _write_trace(tmp_path / "b.jsonl", [
+        _manifest("run-cand"),
+        _span("tick/burst", 0.1 + stall, env_steps=1000),
+        _span("tick", 0.15 + stall),
+        _span("drain", 0.02),  # only in the candidate
+        {"kind": "event", "name": "memory", "scope": "serve",
+         "peak_bytes": 3000, "source": "rss"},
+    ])
+    return base, cand
+
+
+def test_trace_diff_blames_self_time_not_ancestors(tmp_path):
+    td = _load_tool("trace_diff")
+    base, cand = _ab_traces(tmp_path)
+    result = td.diff(td.collect(td.read_events([base])),
+                     td.collect(td.read_events([cand])))
+    top = result["culprits"][0]
+    # the leaf that actually ate the time wins; the parent's self time
+    # is unchanged (its growth is all in the child), so it ranks below
+    assert top["path"] == "tick/burst"
+    assert top["d_self_s"] == pytest.approx(0.8)
+    assert top["share_of_delta"] == pytest.approx(1.0, abs=0.1)
+    parent = next(r for r in result["culprits"]
+                  if r["path"] == "tick")
+    assert parent["d_self_s"] == pytest.approx(0.0, abs=1e-9)
+    # end-to-end sums ROOT spans only (tick + drain, not the child)
+    assert result["end_to_end_s"]["baseline"] == pytest.approx(0.15)
+    assert result["end_to_end_s"]["candidate"] == pytest.approx(0.97)
+    only = next(r for r in result["culprits"] if r["path"] == "drain")
+    assert only["only_in"] == "candidate"
+    assert result["overlap"] == 2
+    # the satellite planes ride the same diff
+    (mem,) = result["memory"]
+    assert mem["scope"] == "serve"
+    assert (mem["baseline_peak_bytes"],
+            mem["candidate_peak_bytes"]) == (1000, 3000)
+    rate = next(r for r in result["rates"]
+                if r["counter"] == "tick/burst:env_steps")
+    assert rate["pct"] < -80  # the stall cratered the span rate
+
+
+def test_trace_diff_resolves_archived_run_ids(tmp_path, capsys):
+    td = _load_tool("trace_diff")
+    root = str(tmp_path / "arch")
+    base, cand = _ab_traces(tmp_path)
+    archive.archive_run(paths=[base], root=root)
+    archive.archive_run(paths=[cand], root=root)
+    bl, cl, result = td.run_diff("run-base", "run-cand", root)
+    assert (bl, cl) == ("run-base", "run-cand")
+    assert result["culprits"][0]["path"] == "tick/burst"
+    # CLI: overlapping sides exit 0 and print the culprit table
+    assert td.main([base, cand]) == 0
+    out = capsys.readouterr().out
+    assert "tick/burst" in out
+    # an unknown archive run is a usage error
+    with pytest.raises(SystemExit):
+        td.resolve_side("no-such-run", root)
+
+
+def test_trace_diff_no_overlap_exits_1(tmp_path, capsys):
+    td = _load_tool("trace_diff")
+    a = _write_trace(tmp_path / "x.jsonl",
+                     [_manifest("r1"), _span("alpha", 0.1)])
+    b = _write_trace(tmp_path / "y.jsonl",
+                     [_manifest("r2"), _span("beta", 0.1)])
+    assert td.main([a, b]) == 1
+    capsys.readouterr()
+
+
+# -- memory watermarks -------------------------------------------------------
+
+
+def test_memory_watermark_samples_and_emits_valid_event(tmp_path):
+    sink = tmp_path / "mem.jsonl"
+    tele = telemetry.Telemetry(str(sink))
+    tele.emit(telemetry.run_manifest())
+    with telemetry.memory_watermark("vi", tele,
+                                    predicted_bytes=4096) as wm:
+        wm.sample()
+    tele.close()
+    # on the CPU CI host the RSS fallback must keep the plane alive
+    assert wm.n_samples >= 3  # enter + explicit + exit
+    assert wm.source in ("device", "rss")
+    assert wm.peak_bytes and wm.peak_bytes > 0
+    assert wm.in_use_bytes and wm.in_use_bytes <= wm.peak_bytes
+    assert wm.delta_bytes is not None
+    snap = wm.snapshot()
+    assert snap["scope"] == "vi" and snap["peak_bytes"] == wm.peak_bytes
+    events = [json.loads(ln) for ln in open(sink)]
+    (mem,) = [e for e in events if e.get("name") == "memory"]
+    for field in telemetry.EVENT_FIELDS["memory"]:
+        assert field in mem, f"memory event lacks {field}"
+    assert mem["scope"] == "vi" and mem["predicted_bytes"] == 4096
+    # the full stream validates with the expectation asserted
+    ts = _load_tool("trace_summary")
+    read, bad = ts.read_events(str(sink))
+    assert ts.validate(read, bad, expect=("memory",)) == []
+
+
+def test_memory_watermark_emits_even_on_exception(tmp_path):
+    sink = tmp_path / "crash.jsonl"
+    tele = telemetry.Telemetry(str(sink))
+    with pytest.raises(RuntimeError, match="boom"):
+        with telemetry.memory_watermark("mdp_compile", tele):
+            raise RuntimeError("boom")
+    tele.close()
+    events = [json.loads(ln) for ln in open(sink)]
+    (mem,) = [e for e in events if e.get("name") == "memory"]
+    assert mem["scope"] == "mdp_compile"
+
+
+def test_device_memory_stats_rss_fallback_is_tagged():
+    stats = telemetry.device_memory_stats()
+    assert stats, "no memory source at all on this host"
+    for dev, ms in stats.items():
+        if ms.get("source") == "rss":
+            assert dev == "process:rss"
+            assert ms["peak_bytes_in_use"] >= ms["bytes_in_use"] > 0
+        else:  # a real allocator entry stays untagged
+            assert "source" not in ms
+
+
+def test_process_memory_orders_rss_and_peak():
+    pm = telemetry.process_memory()
+    assert pm is not None
+    rss, peak = pm
+    assert 0 < rss <= peak
+
+
+# -- ledger v5 provenance + capacity rows ------------------------------------
+
+
+def _ledger_trace(tmp_path, name, run, peak, p99=0.02):
+    return _write_trace(tmp_path / name, [
+        _manifest(run),
+        {"kind": "event", "name": "memory", "scope": "vi",
+         "peak_bytes": peak, "in_use_bytes": peak // 2,
+         "source": "rss", "n_samples": 3},
+        {"kind": "event", "name": "serve", "action": "report",
+         "session": None,
+         "detail": {"steps_per_sec": 1e5, "occupancy": 0.9,
+                    "p50_s": 0.01, "p99_s": p99, "n_devices": 1}},
+    ])
+
+
+def test_ledger_v5_stamps_run_and_lifts_memory_rows(tmp_path):
+    ledger = Ledger(str(tmp_path / "ledger.jsonl"))
+    ledger.ingest_trace(
+        _ledger_trace(tmp_path, "a.jsonl", "run-base", peak=1 << 20))
+    ledger.ingest_trace(
+        _ledger_trace(tmp_path, "b.jsonl", "run-cand", peak=1 << 21))
+    records = ledger.records()
+    assert all(r["run"] in ("run-base", "run-cand") for r in records)
+    mem_rows = [r for r in records if r["metric"] == "vi_peak_bytes"]
+    assert len(mem_rows) == 2
+    for r in mem_rows:
+        assert r["direction"] == "lower" and r["unit"] == "bytes"
+        assert r["config"]["cfg_mem_source"] == "rss"
+    # run is provenance, NOT config: both runs share a fingerprint,
+    # which is exactly what lets them gate against each other
+    assert mem_rows[0]["fingerprint"] == mem_rows[1]["fingerprint"]
+    assert mem_rows[0]["row_id"] != mem_rows[1]["row_id"]
+
+
+def test_gate_carries_run_and_baseline_runs(tmp_path):
+    ledger = Ledger(str(tmp_path / "ledger.jsonl"))
+    ledger.ingest_trace(
+        _ledger_trace(tmp_path, "a.jsonl", "run-base", peak=1 << 20))
+    ledger.ingest_trace(_ledger_trace(tmp_path, "b.jsonl", "run-cand",
+                                      peak=1 << 20, p99=0.5))
+    records = ledger.records()
+    cand = next(r for r in records if r["metric"] == "serve_p99_s"
+                and r["run"] == "run-cand")
+    res = gate_row(cand, records)
+    assert res["verdict"] == "fail"  # 0.5s vs 0.02s, lower-is-better
+    assert res["run"] == "run-cand"
+    assert res["baseline_runs"] == ["run-base"]
+    assert res["baseline"]["best_run"] == "run-base"
+    # the emitted perf_gate event satisfies its own v15 schema
+    sink = tmp_path / "gate.jsonl"
+    tele = telemetry.configure(str(sink))
+    try:
+        emit_gate_event(res)
+    finally:
+        telemetry.configure(None)
+    (ev,) = [json.loads(ln) for ln in open(sink)]
+    for field in telemetry.EVENT_FIELDS["perf_gate"]:
+        assert field in ev, f"perf_gate event lacks {field}"
+    assert ev["run"] == "run-cand"
+    assert ev["baseline_runs"] == ["run-base"]
+
+
+def test_memory_rows_gate_lower_is_better(tmp_path):
+    ledger = Ledger(str(tmp_path / "ledger.jsonl"))
+    ledger.ingest_trace(
+        _ledger_trace(tmp_path, "a.jsonl", "run-base", peak=1 << 20))
+    ledger.ingest_trace(_ledger_trace(tmp_path, "b.jsonl", "run-cand",
+                                      peak=(1 << 20) * 2))
+    records = ledger.records()
+    cand = next(r for r in records if r["metric"] == "vi_peak_bytes"
+                and r["run"] == "run-cand")
+    res = gate_row(cand, records)
+    # a 2x working-set jump fails exactly like a 2x latency jump
+    assert res["verdict"] == "fail" and res["direction"] == "lower"
+    assert res["baseline_runs"] == ["run-base"]

@@ -81,6 +81,9 @@ def test_event_fields_resolved_cross_module_by_ast():
                         "transitions", "n_workers"),
         "alert": ("signal", "severity", "window_s", "value", "budget",
                   "burn_rate"),
+        "perf_gate": ("metric", "backend", "verdict", "value",
+                      "baseline", "run", "baseline_runs"),
+        "memory": ("scope", "peak_bytes", "source"),
     }
 
 

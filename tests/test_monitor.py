@@ -439,6 +439,60 @@ def test_dump_blackbox_writes_validating_artifact(tmp_path,
     assert ts.validate(read, bad) == []
 
 
+def test_emit_and_blackbox_survive_concurrent_emitters(tmp_path,
+                                                       monkeypatch):
+    """v15 regression: the serve tick loop, the heartbeat thread, and
+    the metrics HTTP threads all emit into one sink while dump_blackbox
+    may fire from a crash path.  Every JSONL line must stay intact (no
+    interleaved partial writes) and the ring copy must never blow up
+    mid-append (`RuntimeError: deque mutated during iteration`)."""
+    import threading
+
+    monkeypatch.setenv(telemetry.BLACKBOX_ENV_VAR, "64")
+    monkeypatch.setattr(telemetry, "_blackbox", None)
+    sink = tmp_path / "concurrent.jsonl"
+    tele = telemetry.Telemetry(str(sink))
+    n_threads, n_events = 8, 200
+    errors = []
+
+    def emitter(tid):
+        try:
+            for i in range(n_events):
+                tele.event("tick", thread=tid, i=i)
+        except Exception as e:  # pragma: no cover — the regression
+            errors.append(e)
+
+    def dumper():
+        try:
+            for _ in range(50):
+                telemetry.blackbox_events()
+                dump_blackbox("test:concurrent",
+                              dest_dir=str(tmp_path / "bb"))
+        except Exception as e:  # pragma: no cover — the regression
+            errors.append(e)
+
+    threads = [threading.Thread(target=emitter, args=(t,))
+               for t in range(n_threads)] + \
+        [threading.Thread(target=dumper)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60.0)
+    tele.close()
+    assert not errors
+    lines = sink.read_text().splitlines()
+    assert len(lines) == n_threads * n_events
+    parsed = [json.loads(ln) for ln in lines]  # no torn writes
+    # nothing lost: every (thread, i) pair landed exactly once
+    seen = {(e["thread"], e["i"]) for e in parsed}
+    assert len(seen) == n_threads * n_events
+    assert tele.n_emitted == n_threads * n_events
+    # the ring holds the last `capacity` events, all well-formed
+    ring = telemetry.blackbox_events()
+    assert len(ring) == 64
+    assert all(e["name"] == "tick" for e in ring)
+
+
 def test_dump_blackbox_never_raises(monkeypatch):
     def boom(*a, **kw):
         raise OSError("disk is gone")

@@ -238,7 +238,7 @@ def test_ledger_v4_cfg_devices_backfills_and_fingerprints():
     the otherwise-identical 1-chip row."""
     one = _row(value=100.0)
     four = _row(value=100.0, n_devices=4)
-    assert one["ledger"] == perf.LEDGER_VERSION == 4
+    assert one["ledger"] == perf.LEDGER_VERSION == 5
     assert one["config"]["cfg_devices"] == 1
     assert four["config"]["cfg_devices"] == 4
     assert one["fingerprint"] != four["fingerprint"]

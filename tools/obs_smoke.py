@@ -1,0 +1,353 @@
+"""Observability smoke (`make obs-smoke`): the v15 attribution plane
+proved end-to-end on CPU, twice through the real serving stack.
+
+  1  run a supervised `cpr_tpu.serve.server` child (A), drive a small
+     seeded policy load, scrape the in-band metrics mid-run and assert
+     the live memory watermark gauges are exposed, SIGTERM-drain it,
+     and assert the drain report banks a `memory` block;
+  2  run the identical child again (B) with a one-shot injected stall
+     (`CPR_FAULT_INJECT=slow@replica=0`) landing inside the
+     `serve_burst` span — a synthetic regression with a known culprit;
+  3  both traces must pass `trace_summary --validate --expect
+     serve,device_metrics,memory`, then both runs are archived
+     (content-addressed, distinct run ids) under the workdir;
+  4  `trace_diff` over the two *archived run ids* must rank the
+     injected `serve_burst` span as the #1 culprit by self-time delta;
+  5  both traces bank into a perf ledger: the B `serve_p99_s` row must
+     FAIL its gate against the A baseline with `run`/`baseline_runs`
+     naming the archived pair, the lower-is-better `serve_peak_bytes`
+     watermark row must gate clean, and `perf_report --gate
+     --attribute` run as a subprocess must chase the FAIL through the
+     archive and print an attribution table naming `serve_burst`.
+
+A PASS means the whole chain — watermark sampling, schema-v15 events,
+run archive, span diff, gate provenance, report attribution — holds
+together on a real child process, not just in unit tests.
+
+Usage: python tools/obs_smoke.py [workdir]   (default /tmp/...)
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+ROOT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+sys.path.insert(0, ROOT)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from cpr_tpu import supervisor, telemetry  # noqa: E402
+from cpr_tpu.perf import archive  # noqa: E402
+from cpr_tpu.perf.gate import gate_row  # noqa: E402
+from cpr_tpu.perf.ledger import Ledger  # noqa: E402
+from cpr_tpu.serve.protocol import ServeClient  # noqa: E402
+
+MAX_STEPS = 256
+LANES = 8
+BURST = 256
+EPISODES = 12
+READY_TIMEOUT_S = 300.0
+WALL_S = 300.0
+SLOW_S = 0.75  # resilience._DEFAULT_SLOW_S — the injected regression
+
+
+def _log(msg):
+    print(f"obs-smoke: {msg}", file=sys.stderr)
+
+
+def _child_cmd(work, name):
+    # --replica-index arms the per-replica fault site in run B; run A
+    # passes it too so the two configs fingerprint identically and the
+    # ledger gate judges B against A rather than skipping on drift
+    return [sys.executable, "-m", "cpr_tpu.serve.server",
+            "--protocol", "nakamoto", "--max-steps", str(MAX_STEPS),
+            "--lanes", str(LANES), "--burst", str(BURST),
+            "--heartbeat-s", "0.5", "--replica-index", "0",
+            "--ready-file", os.path.join(work, f"{name}-ready.json")]
+
+
+def _child_env(work, trace, inject):
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               CPR_TELEMETRY=trace, CPR_DEVICE_METRICS="1",
+               CPR_TPU_CACHE=os.path.join(work, "cache"),
+               # the SIGTERM drain dumps the flight recorder; keep the
+               # dumps inside the smoke workdir, not the repo's runs/
+               CPR_BLACKBOX_DIR=os.path.join(work, "blackbox"))
+    env.pop("CPR_FAULT_INJECT", None)
+    if inject:
+        env["CPR_FAULT_INJECT"] = "slow@replica=0"
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def _wait_ready(path, proc):
+    deadline = time.time() + READY_TIMEOUT_S
+    while time.time() < deadline:
+        if proc.poll() is not None:
+            raise SystemExit(f"server child exited rc={proc.returncode} "
+                             f"before becoming ready")
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            time.sleep(0.25)
+    raise SystemExit(f"server not ready within {READY_TIMEOUT_S:.0f}s")
+
+
+def _episode(port, seed):
+    with ServeClient("127.0.0.1", port) as c:
+        r = c.request("episode.run", policy="honest", seed=seed)
+        assert r.get("ok"), f"episode.run(seed={seed}): {r}"
+        return r
+
+
+def _load(port):
+    with ThreadPoolExecutor(max_workers=4) as pool:
+        jobs = [pool.submit(_episode, port, 100 + i)
+                for i in range(EPISODES)]
+        for j in jobs:
+            j.result()
+    return EPISODES
+
+
+def _scrape_memory_gauges(port):
+    """Mid-run in-band scrape: the watermark gauges must be live in
+    the registry while the server is serving, not only at drain."""
+    with ServeClient("127.0.0.1", port) as c:
+        r = c.request("metrics.scrape")
+        assert r.get("ok"), f"metrics.scrape: {r}"
+    gauges = (r.get("metrics") or {}).get("gauges") or {}
+    missing = [g for g in ("memory_peak_bytes", "memory_in_use_bytes")
+               if g not in gauges]
+    if missing:
+        raise SystemExit(f"mid-run scrape lacks watermark gauges "
+                         f"{missing} (have {sorted(gauges)})")
+    peak = gauges["memory_peak_bytes"][0]["value"]
+    if not peak > 0:
+        raise SystemExit(f"memory_peak_bytes gauge not positive: {peak}")
+    return peak
+
+
+def _serve_events(trace, action=None):
+    out = []
+    with open(trace) as f:
+        for line in f:
+            try:
+                e = json.loads(line)
+            except ValueError:
+                continue
+            if e.get("kind") == "event" and e.get("name") == "serve" \
+                    and (action is None or e.get("action") == action):
+                out.append(e)
+    return out
+
+
+def _check_drain_memory(trace):
+    reports = _serve_events(trace, "report")
+    detail = (reports[-1].get("detail") or {}) if reports else {}
+    mem = detail.get("memory") or {}
+    if not (isinstance(mem.get("peak_bytes"), (int, float))
+            and mem["peak_bytes"] > 0 and mem.get("source")):
+        raise SystemExit(f"drain report carries no usable memory "
+                         f"watermark: {mem or sorted(detail)}")
+    return mem
+
+
+def _validate_stream(trace):
+    tool = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "trace_summary.py")
+    r = subprocess.run(
+        [sys.executable, tool, trace, "--validate",
+         "--expect", "serve,device_metrics,memory"],
+        capture_output=True, text=True)
+    if r.returncode != 0:
+        sys.stderr.write(r.stdout + r.stderr)
+        raise SystemExit(f"telemetry validation failed for {trace}")
+
+
+def run_one(work, name, inject):
+    """One supervised server lifecycle; returns the trace path."""
+    trace = os.path.join(work, f"{name}.jsonl")
+    if os.path.exists(trace):
+        os.remove(trace)
+    # each lifecycle is its own run: run_child stamps the parent's run
+    # id into the child env, so without a re-mint both servers would
+    # archive under one record and there would be no A/B pair to diff
+    rid = telemetry.reset_run_id()
+    _log(f"run {name}: minted run id {rid}")
+    started = threading.Event()
+    box = {}
+
+    def on_start(proc):
+        box["proc"] = proc
+        started.set()
+
+    def supervise():
+        box["attempt"] = supervisor.run_child(
+            _child_cmd(work, name), wall_timeout_s=WALL_S, quiet_s=20.0,
+            heartbeat_s=1.0, env=_child_env(work, trace, inject),
+            cwd=ROOT, on_start=on_start)
+
+    child = threading.Thread(target=supervise)
+    child.start()
+    try:
+        if not started.wait(30.0):
+            raise SystemExit("run_child never spawned the server")
+        ready = _wait_ready(os.path.join(work, f"{name}-ready.json"),
+                            box["proc"])
+        port = ready["port"]
+        _log(f"run {name}: server ready on port {port}"
+             f"{' (slow@replica armed)' if inject else ''}")
+        n = _load(port)
+        peak = _scrape_memory_gauges(port)
+        _log(f"run {name}: {n} episodes served; live watermark "
+             f"{peak / 2 ** 20:.1f} MiB in mid-run scrape")
+        box["proc"].send_signal(signal.SIGTERM)
+    except BaseException:
+        proc = box.get("proc")
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+        raise
+    child.join(120.0)
+    if child.is_alive():
+        raise SystemExit(f"run {name}: child did not drain within 120s")
+    attempt = box["attempt"]
+    if attempt.status != "ok" or attempt.rc != 0:
+        raise SystemExit(f"run {name}: child did not exit cleanly "
+                         f"(status={attempt.status} rc={attempt.rc})")
+    mem = _check_drain_memory(trace)
+    _validate_stream(trace)
+    _log(f"run {name}: drained; report watermark "
+         f"{mem['peak_bytes'] / 2 ** 20:.1f} MiB "
+         f"(source {mem['source']}); stream validates with memory "
+         f"events")
+    return trace
+
+
+def _check_diff(run_a, run_b, arch):
+    import trace_diff
+
+    base_label, cand_label, d = trace_diff.run_diff(run_a, run_b, arch)
+    culprits = d["culprits"]
+    if not culprits:
+        raise SystemExit("trace_diff found no span culprits at all")
+    top = culprits[0]
+    if top["path"] != "serve_burst":
+        raise SystemExit(
+            f"trace_diff blamed '{top['path']}' "
+            f"(d_self={top['d_self_s']:.3f}s), expected the injected "
+            f"serve_burst; top 3: "
+            f"{[(c['path'], round(c['d_self_s'], 3)) for c in culprits[:3]]}")
+    if top["d_self_s"] < 0.5 * SLOW_S:
+        raise SystemExit(
+            f"serve_burst self-time delta {top['d_self_s']:.3f}s does "
+            f"not account for the injected {SLOW_S}s stall")
+    return top
+
+
+def _check_gates(work, trace_a, trace_b, run_a, run_b):
+    ledger = Ledger(os.path.join(work, "perf_ledger.jsonl"))
+    n = ledger.ingest_trace(trace_a) + ledger.ingest_trace(trace_b)
+    records = ledger.records()
+
+    def rows(metric, run):
+        return [r for r in records
+                if r.get("metric") == metric and r.get("run") == run]
+
+    p99 = rows("serve_p99_s", run_b)
+    if not p99:
+        raise SystemExit("no serve_p99_s row banked for run B")
+    res = gate_row(p99[-1], records)
+    if res["verdict"] != "fail":
+        raise SystemExit(
+            f"injected stall did not fail the serve_p99_s gate: {res}")
+    if res["run"] != run_b or run_a not in res["baseline_runs"]:
+        raise SystemExit(
+            f"gate verdict lacks archive provenance: run={res['run']} "
+            f"baseline_runs={res['baseline_runs']}")
+
+    peak = rows("serve_peak_bytes", run_b)
+    if not peak:
+        raise SystemExit("no serve_peak_bytes watermark row banked "
+                         "for run B")
+    mres = gate_row(peak[-1], records)
+    if mres["verdict"] not in ("pass", "warn"):
+        raise SystemExit(f"serve_peak_bytes watermark gate: {mres}")
+    return n, res, mres
+
+
+def _check_attribution(work, arch):
+    """perf_report --gate --attribute as production would run it: the
+    FAIL must exit 1 and the report must chase it through the archive
+    into a culprit table naming serve_burst."""
+    tool = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "perf_report.py")
+    r = subprocess.run(
+        [sys.executable, tool, os.path.join(work, "perf_ledger.jsonl"),
+         "--metric", "serve_p99_s", "--gate", "--attribute",
+         "--archive", arch],
+        capture_output=True, text=True)
+    out = r.stdout + r.stderr
+    if r.returncode != 1:
+        sys.stderr.write(out)
+        raise SystemExit(f"perf_report --gate --attribute exited "
+                         f"{r.returncode}, expected 1 (gated FAIL)")
+    if "attribution: serve_p99_s" not in out:
+        sys.stderr.write(out)
+        raise SystemExit("perf_report printed no attribution section "
+                         "for the failed serve_p99_s gate")
+    if "serve_burst" not in out:
+        sys.stderr.write(out)
+        raise SystemExit("perf_report attribution does not name the "
+                         "injected serve_burst span")
+
+
+def main():
+    work = sys.argv[1] if len(sys.argv) > 1 else "/tmp/cpr-obs-smoke"
+    os.makedirs(work, exist_ok=True)
+    arch = os.path.join(work, "archive")
+
+    trace_a = run_one(work, "a", inject=False)
+    trace_b = run_one(work, "b", inject=True)
+
+    rec_a = archive.archive_run(paths=[trace_a], root=arch,
+                                label="obs-smoke baseline",
+                                roles={trace_a: "server"})
+    rec_b = archive.archive_run(paths=[trace_b], root=arch,
+                                label="obs-smoke slow@replica",
+                                roles={trace_b: "server"})
+    run_a, run_b = rec_a["run"], rec_b["run"]
+    if run_a == run_b:
+        raise SystemExit(f"both runs archived under one id ({run_a}) — "
+                         f"no A/B pair to diff")
+    _log(f"archived baseline {run_a} and candidate {run_b} "
+         f"under {arch}")
+
+    top = _check_diff(run_a, run_b, arch)
+    _log(f"trace_diff: top culprit {top['path']} "
+         f"d_self={top['d_self_s']:+.3f}s "
+         f"(share {top['share_of_delta']:.0%})")
+
+    n_banked, p99_res, mem_res = _check_gates(work, trace_a, trace_b,
+                                              run_a, run_b)
+    _log(f"ledger: {n_banked} rows banked; serve_p99_s gate FAIL with "
+         f"provenance run={p99_res['run']} baselines="
+         f"{p99_res['baseline_runs']}; serve_peak_bytes gate "
+         f"{mem_res['verdict']}")
+
+    _check_attribution(work, arch)
+    print(f"obs-smoke: PASS (injected {SLOW_S}s stall attributed to "
+          f"serve_burst: diff d_self={top['d_self_s']:+.3f}s; "
+          f"serve_p99_s gate FAIL carried archived run pair "
+          f"{run_a} -> {run_b}; perf_report --attribute named the "
+          f"culprit; watermarks live in scrape + drain report; "
+          f"{n_banked} ledger rows banked incl. serve_peak_bytes "
+          f"[{mem_res['verdict']}])")
+
+
+if __name__ == "__main__":
+    main()

@@ -16,10 +16,19 @@ markdown report (docs/SCALING.md "blessing a scaling row").
     python tools/perf_report.py --since 3 --metric nakamoto
     python tools/perf_report.py --markdown runs/perf_report.md
     python tools/perf_report.py --trace /tmp/run.jsonl   # + span rates
+    python tools/perf_report.py --gate --attribute   # + culprit spans
     make perf-gate                                   # CI entry point
 
+`--attribute` chases every FAIL/WARN verdict through the run archive
+(the v15 `perf_gate` verdict carries the candidate's and baseline
+rows' run ids; cpr_tpu.perf.archive maps a run id back to its
+telemetry streams) and prints a tools/trace_diff.py culprit table —
+the span paths whose self-time moved, ranked by contribution to the
+end-to-end delta — so a red gate names WHERE the regression lives,
+not just that one exists.
+
 Exit codes: 0 = no failed gate (warn/skip/pass), 1 = at least one
-`fail` verdict in --gate mode, 2 = usage error.  To bless an
+`fail` verdict in --gate/--attribute mode, 2 = usage error.  To bless an
 intentional perf change (a config move, an accepted slowdown), bank
 the new row — once it is the newest banked round it IS the candidate,
 and future gates judge against the best history including it; the
@@ -185,6 +194,39 @@ def gate_lines(results):
             yield f"      {res['reason']}"
 
 
+def attribute_failures(results, archive_root=None, out=sys.stdout) -> int:
+    """Chase each FAIL/WARN verdict into a trace_diff culprit table
+    via the run archive.  Returns how many verdicts were attributed;
+    verdicts without an archived candidate/baseline run pair say so
+    and are skipped (pre-v15 ledgers carry no run ids)."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import trace_diff  # noqa: E402 — sibling tool, path set above
+    attributed = 0
+    for res in results:
+        if res["verdict"] not in ("fail", "warn"):
+            continue
+        cand = res.get("run")
+        bases = [b for b in (res.get("baseline_runs") or ())
+                 if b and b != cand]
+        if not cand or not bases:
+            print(f"attribute: {res['metric']} [{res['backend']}] "
+                  f"{res['verdict'].upper()}: no archived run pair "
+                  f"(candidate run={cand}, baseline runs={bases or '-'})",
+                  file=out)
+            continue
+        try:
+            bl, cl, d = trace_diff.run_diff(bases[0], cand,
+                                            archive_root)
+        except SystemExit as e:
+            print(f"attribute: {res['metric']}: {e}", file=out)
+            continue
+        print(f"\nattribution: {res['metric']} [{res['backend']}] "
+              f"{res['verdict'].upper()}", file=out)
+        trace_diff.render(d, f"run {bl}", f"run {cl}", top=10, out=out)
+        attributed += 1
+    return attributed
+
+
 def markdown_report(records, results, summary, scaling=()) -> str:
     lines = ["# Perf ledger report", "",
              f"{len(records)} ledger rows; gate: "
@@ -242,6 +284,13 @@ def main(argv=None) -> int:
     ap.add_argument("--gate", action="store_true",
                     help="exit 1 when any metric's newest row FAILS "
                          "against its banked same-backend baseline")
+    ap.add_argument("--attribute", action="store_true",
+                    help="chase FAIL/WARN verdicts through the run "
+                         "archive into trace_diff culprit tables "
+                         "(implies gate exit semantics)")
+    ap.add_argument("--archive", metavar="DIR",
+                    help="archive root for --attribute (default: "
+                         "$CPR_OBS_ARCHIVE or runs/archive)")
     ap.add_argument("--since", type=int, metavar="ROUND",
                     help="only rows banked at round >= ROUND "
                          "(unknown-round rows are kept)")
@@ -275,12 +324,15 @@ def main(argv=None) -> int:
     print(f"perf-gate: {'PASS' if summary['ok'] else 'FAIL'} "
           f"({summary['fail']} fail, {summary['warn']} warn, "
           f"{summary['pass']} pass, {summary['skip']} skip)")
+    if args.attribute:
+        attribute_failures(results, archive_root=args.archive)
     if args.markdown:
         atomic_write_text(args.markdown,
                           markdown_report(records, results, summary,
                                           scaling))
         print(f"perf_report: wrote {args.markdown}", file=sys.stderr)
-    return 0 if (summary["ok"] or not args.gate) else 1
+    return 0 if (summary["ok"]
+                 or not (args.gate or args.attribute)) else 1
 
 
 if __name__ == "__main__":

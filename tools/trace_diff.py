@@ -1,0 +1,360 @@
+"""Span-level regression diff between two telemetry runs.
+
+The attribution half of the perf plane: the ledger + gate say THAT a
+number regressed (`perf_gate` FAIL/WARN, schema v15 carrying the
+candidate and baseline run ids); this tool says WHERE.  It aligns the
+two runs' span trees by path and computes, per span path:
+
+    d_total   candidate total wall seconds minus baseline
+    d_self    same, on SELF time (total minus direct children) — the
+              ranking key, so a slow leaf is named instead of every
+              ancestor that merely contains it
+    d_call    per-call mean delta (calls can differ between runs)
+    d_count   call-count delta
+
+and ranks culprit paths by their self-time contribution to the
+end-to-end delta (the sum over root spans).  Supporting tables cover
+the other things a regression hides in: per-span counter rates
+(steps/sec and friends), `compile` events (retrace count + compile
+seconds per fn), `device_metrics` numeric cells, the serve report's
+per-family latency quantiles, and v15 `memory` watermark peaks per
+scope.
+
+Each side is either a telemetry JSONL path (repeatable via commas) or
+a run id resolved through the run archive (cpr_tpu.perf.archive —
+every archived telemetry stream of the run is merged, so a
+supervised server + client pair diffs as one run).  `perf_report
+--attribute` drives this module directly to chase a gate FAIL into a
+named culprit table.
+
+Usage: python tools/trace_diff.py BASELINE CANDIDATE
+           [--archive DIR] [--top N] [--json]
+
+Exit codes: 0 = diffed, 1 = no overlapping span paths, 2 = usage/IO.
+"""
+
+import argparse
+import json
+import os
+import sys
+from collections import defaultdict
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+
+def read_events(paths):
+    events = []
+    for path in paths:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    events.append(json.loads(line))
+                except ValueError:
+                    continue
+    return events
+
+
+def resolve_side(spec, archive_root=None):
+    """One side of the diff -> (label, [stream paths]).  A spec that
+    names existing files (comma-separated) is used verbatim; anything
+    else is a run id looked up in the archive."""
+    parts = [p for p in str(spec).split(",") if p]
+    if parts and all(os.path.exists(p) for p in parts):
+        return spec, parts
+    from cpr_tpu.perf import archive
+    rec = archive.load_run(spec, root=archive_root)
+    if rec is None:
+        raise SystemExit(
+            f"trace_diff: {spec!r} is neither a stream path nor a "
+            f"run id in archive {archive.archive_dir(archive_root)!r}")
+    streams = archive.run_streams(rec)
+    if not streams:
+        raise SystemExit(
+            f"trace_diff: archived run {spec!r} has no telemetry "
+            f"stream on disk")
+    return spec, streams
+
+
+def _children(path, all_paths):
+    """Direct children of `path` in the span tree (paths are
+    '/'-joined; a child extends the parent by exactly one segment)."""
+    prefix = path + "/"
+    depth = path.count("/") + 1
+    return [p for p in all_paths
+            if p.startswith(prefix) and p.count("/") == depth]
+
+
+def collect(events):
+    """Fold one run's events into the comparable aggregate."""
+    spans = defaultdict(lambda: {"calls": 0, "total_s": 0.0})
+    counters = defaultdict(lambda: [0.0, 0.0])  # (path, k) -> [n, dur]
+    for e in events:
+        if e.get("kind") != "span":
+            continue
+        path = e.get("path") or e.get("name") or "?"
+        s = spans[path]
+        s["calls"] += 1
+        s["total_s"] += e.get("dur_s") or 0.0
+        for k, v in (e.get("counters") or {}).items():
+            c = counters[(path, k)]
+            c[0] += v
+            c[1] += e.get("dur_s") or 0.0
+    paths = set(spans)
+    for path, s in spans.items():
+        child_total = sum(spans[c]["total_s"]
+                          for c in _children(path, paths))
+        # clamped: overlapping/async children can sum past the parent
+        s["self_s"] = max(0.0, s["total_s"] - child_total)
+    roots = [p for p in paths if "/" not in p]
+    compiles = defaultdict(lambda: {"count": 0, "compile_s": 0.0})
+    device_cells = {}
+    latency = {}
+    memory = {}
+    for e in events:
+        if e.get("kind") != "event":
+            continue
+        name = e.get("name")
+        if name == "compile":
+            c = compiles[e.get("fn") or "?"]
+            c["count"] += 1
+            c["compile_s"] += e.get("compile_s") or 0.0
+        elif name == "device_metrics":
+            scope = e.get("scope") or "?"
+            for k, v in (e.get("metrics") or {}).items():
+                cell = f"{scope}.{k}"
+                if isinstance(v, (int, float)):
+                    device_cells[cell] = float(v)
+                elif isinstance(v, dict) and isinstance(
+                        v.get("mean"), (int, float)):
+                    device_cells[cell] = float(v["mean"])
+        elif name == "serve" and e.get("action") == "report":
+            for fam, q in ((e.get("detail") or {}).get("latency")
+                           or {}).items():
+                if isinstance(q, dict):
+                    latency[fam] = {k: q[k] for k in ("p50_s", "p99_s")
+                                    if isinstance(q.get(k),
+                                                  (int, float))}
+        elif name == "memory":
+            scope = e.get("scope") or "?"
+            peak = e.get("peak_bytes")
+            if isinstance(peak, (int, float)):
+                # max across streams: the run's true high-water mark
+                prev = (memory.get(scope) or {}).get("peak_bytes", 0)
+                memory[scope] = {
+                    "peak_bytes": max(int(peak), prev),
+                    "source": e.get("source")}
+    return {
+        "spans": dict(spans),
+        "counters": {f"{p}:{k}": (n / d if d > 0 else None)
+                     for (p, k), (n, d) in counters.items()},
+        "end_to_end_s": sum(spans[r]["total_s"] for r in roots),
+        "compiles": dict(compiles),
+        "device_cells": device_cells,
+        "latency": latency,
+        "memory": memory,
+    }
+
+
+def diff(base, cand):
+    """The structured diff of two collect() aggregates — culprit rows
+    ranked by self-time contribution to the end-to-end delta."""
+    d_e2e = cand["end_to_end_s"] - base["end_to_end_s"]
+    rows = []
+    for path in sorted(set(base["spans"]) | set(cand["spans"])):
+        a = base["spans"].get(path)
+        b = cand["spans"].get(path)
+        za = a or {"calls": 0, "total_s": 0.0, "self_s": 0.0}
+        zb = b or {"calls": 0, "total_s": 0.0, "self_s": 0.0}
+        d_self = zb["self_s"] - za["self_s"]
+        rows.append({
+            "path": path,
+            "only_in": ("candidate" if a is None else
+                        "baseline" if b is None else None),
+            "calls": (za["calls"], zb["calls"]),
+            "total_s": (za["total_s"], zb["total_s"]),
+            "self_s": (za["self_s"], zb["self_s"]),
+            "d_total_s": zb["total_s"] - za["total_s"],
+            "d_self_s": d_self,
+            "d_call_s": ((zb["total_s"] / zb["calls"]
+                          if zb["calls"] else 0.0)
+                         - (za["total_s"] / za["calls"]
+                            if za["calls"] else 0.0)),
+            "share_of_delta": (d_self / d_e2e
+                               if abs(d_e2e) > 1e-12 else None),
+        })
+    # the culprit ranking: most-regressed self time first (a speedup
+    # ranks last, not nowhere — an improved span is still attribution)
+    rows.sort(key=lambda r: -r["d_self_s"])
+    rates = []
+    for key in sorted(set(base["counters"]) | set(cand["counters"])):
+        ra, rb = base["counters"].get(key), cand["counters"].get(key)
+        rates.append({"counter": key, "baseline": ra, "candidate": rb,
+                      "pct": ((rb - ra) / ra * 100.0
+                              if isinstance(ra, (int, float)) and ra
+                              and isinstance(rb, (int, float))
+                              else None)})
+    comp = []
+    for fn in sorted(set(base["compiles"]) | set(cand["compiles"])):
+        ca = base["compiles"].get(fn) or {"count": 0, "compile_s": 0.0}
+        cb = cand["compiles"].get(fn) or {"count": 0, "compile_s": 0.0}
+        if ca["count"] or cb["count"]:
+            comp.append({"fn": fn,
+                         "d_count": cb["count"] - ca["count"],
+                         "d_compile_s": (cb["compile_s"]
+                                         - ca["compile_s"])})
+    comp.sort(key=lambda r: -abs(r["d_compile_s"]))
+    cells = []
+    for cell in sorted(set(base["device_cells"])
+                       | set(cand["device_cells"])):
+        va = base["device_cells"].get(cell)
+        vb = cand["device_cells"].get(cell)
+        if va != vb:
+            cells.append({"cell": cell, "baseline": va,
+                          "candidate": vb})
+    lat = []
+    for fam in sorted(set(base["latency"]) | set(cand["latency"])):
+        qa = base["latency"].get(fam) or {}
+        qb = cand["latency"].get(fam) or {}
+        for q in ("p50_s", "p99_s"):
+            if q in qa or q in qb:
+                lat.append({"family": fam, "quantile": q,
+                            "baseline": qa.get(q),
+                            "candidate": qb.get(q)})
+    mem = []
+    for scope in sorted(set(base["memory"]) | set(cand["memory"])):
+        ma = base["memory"].get(scope) or {}
+        mb = cand["memory"].get(scope) or {}
+        mem.append({"scope": scope,
+                    "baseline_peak_bytes": ma.get("peak_bytes"),
+                    "candidate_peak_bytes": mb.get("peak_bytes"),
+                    "source": mb.get("source") or ma.get("source")})
+    return {
+        "end_to_end_s": {"baseline": base["end_to_end_s"],
+                         "candidate": cand["end_to_end_s"],
+                         "delta": d_e2e},
+        "culprits": rows,
+        "rates": rates,
+        "compiles": comp,
+        "device_cells": cells,
+        "latency": lat,
+        "memory": mem,
+        "overlap": sum(1 for r in rows if r["only_in"] is None),
+    }
+
+
+def _f(v, fmt="{:.3f}"):
+    return fmt.format(v) if isinstance(v, (int, float)) else "-"
+
+
+def render(result, base_label, cand_label, top=None, out=sys.stdout):
+    e2e = result["end_to_end_s"]
+    print(f"baseline : {base_label}", file=out)
+    print(f"candidate: {cand_label}", file=out)
+    print(f"end-to-end span time: {e2e['baseline']:.3f} s -> "
+          f"{e2e['candidate']:.3f} s (delta {e2e['delta']:+.3f} s)",
+          file=out)
+    rows = result["culprits"]
+    if top:
+        rows = rows[:top]
+    print(f"\n{'culprit span path':<36} {'calls':>11} {'self_s A':>9} "
+          f"{'self_s B':>9} {'d_self':>8} {'d_call':>8} {'share':>7}",
+          file=out)
+    for r in rows:
+        ca, cb = r["calls"]
+        share = (f"{100 * r['share_of_delta']:>6.1f}%"
+                 if r["share_of_delta"] is not None else "      -")
+        mark = {"candidate": " +", "baseline": " -"}.get(
+            r["only_in"], "")
+        print(f"{r['path'] + mark:<36} {f'{ca}->{cb}':>11} "
+              f"{r['self_s'][0]:>9.3f} {r['self_s'][1]:>9.3f} "
+              f"{r['d_self_s']:>+8.3f} {r['d_call_s']:>+8.3f} "
+              f"{share}", file=out)
+    if result["rates"]:
+        print(f"\n{'counter rate':<44} {'baseline':>13} "
+              f"{'candidate':>13} {'pct':>8}", file=out)
+        for r in result["rates"]:
+            pct = (f"{r['pct']:+.1f}%"
+                   if r["pct"] is not None else "-")
+            print(f"{r['counter']:<44} "
+                  f"{_f(r['baseline'], '{:,.0f}'):>13} "
+                  f"{_f(r['candidate'], '{:,.0f}'):>13} {pct:>8}",
+                  file=out)
+    if result["compiles"]:
+        print(f"\n{'compiled fn':<44} {'d_count':>8} "
+              f"{'d_compile_s':>12}", file=out)
+        for r in result["compiles"]:
+            print(f"{r['fn']:<44} {r['d_count']:>+8} "
+                  f"{r['d_compile_s']:>+12.3f}", file=out)
+    if result["device_cells"]:
+        print(f"\n{'device metric cell':<44} {'baseline':>13} "
+              f"{'candidate':>13}", file=out)
+        for r in result["device_cells"]:
+            print(f"{r['cell']:<44} {_f(r['baseline'], '{:.4g}'):>13} "
+                  f"{_f(r['candidate'], '{:.4g}'):>13}", file=out)
+    if result["latency"]:
+        print(f"\n{'latency family':<36} {'q':<6} {'baseline':>10} "
+              f"{'candidate':>10}", file=out)
+        for r in result["latency"]:
+            print(f"{r['family']:<36} {r['quantile']:<6} "
+                  f"{_f(r['baseline'], '{:.4f}'):>10} "
+                  f"{_f(r['candidate'], '{:.4f}'):>10}", file=out)
+    if result["memory"]:
+        print(f"\n{'memory scope':<16} {'source':<7} "
+              f"{'baseline peak MiB':>18} {'candidate peak MiB':>19}",
+              file=out)
+        for r in result["memory"]:
+            pa = r["baseline_peak_bytes"]
+            pb = r["candidate_peak_bytes"]
+            print(f"{r['scope']:<16} {str(r['source']):<7} "
+                  f"{_f(pa / (1 << 20) if pa else None, '{:,.1f}'):>18} "
+                  f"{_f(pb / (1 << 20) if pb else None, '{:,.1f}'):>19}",
+                  file=out)
+
+
+def run_diff(base_spec, cand_spec, archive_root=None):
+    """resolve + collect + diff; returns (labels, result)."""
+    base_label, base_paths = resolve_side(base_spec, archive_root)
+    cand_label, cand_paths = resolve_side(cand_spec, archive_root)
+    result = diff(collect(read_events(base_paths)),
+                  collect(read_events(cand_paths)))
+    return base_label, cand_label, result
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="trace_diff", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("baseline",
+                    help="telemetry JSONL path(s, comma-separated) "
+                         "or an archived run id")
+    ap.add_argument("candidate",
+                    help="the run under suspicion, same forms")
+    ap.add_argument("--archive", metavar="DIR",
+                    help="archive root for run-id resolution "
+                         "(default: $CPR_OBS_ARCHIVE or runs/archive)")
+    ap.add_argument("--top", type=int, metavar="N",
+                    help="print at most N culprit rows")
+    ap.add_argument("--json", action="store_true",
+                    help="dump the structured diff as JSON")
+    args = ap.parse_args(argv)
+    try:
+        base_label, cand_label, result = run_diff(
+            args.baseline, args.candidate, args.archive)
+    except OSError as e:
+        print(f"trace_diff: {e}", file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps({"baseline": base_label,
+                          "candidate": cand_label, **result},
+                         indent=2, sort_keys=True))
+    else:
+        render(result, base_label, cand_label, top=args.top)
+    return 0 if result["overlap"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -246,9 +246,17 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="trace_stitch", description=__doc__,
         formatter_class=argparse.RawDescriptionHelpFormatter)
-    ap.add_argument("streams", nargs="+", metavar="JSONL",
+    ap.add_argument("streams", nargs="*", metavar="JSONL",
                     help="telemetry streams of one run (server, "
                          "supervisor child, clients — any order)")
+    ap.add_argument("--run", metavar="RUN_ID",
+                    help="resolve ALL of the run's archived telemetry "
+                         "streams through the run archive "
+                         "(cpr_tpu.perf.archive) instead of naming "
+                         "paths")
+    ap.add_argument("--archive", metavar="DIR",
+                    help="archive root for --run (default: "
+                         "$CPR_OBS_ARCHIVE or runs/archive)")
     ap.add_argument("--op", metavar="PREFIX",
                     help="only traces whose op starts with PREFIX")
     ap.add_argument("--limit", type=int, metavar="N",
@@ -258,6 +266,20 @@ def main(argv=None) -> int:
                     help="dump the stitched structure as JSON instead "
                          "of the text tree")
     args = ap.parse_args(argv)
+    if args.run:
+        # archive resolution: every telemetry stream the run archived
+        # (server + supervisor + clients), not just the primary — the
+        # stitcher's whole point is the multi-stream view
+        from cpr_tpu.perf import archive
+        rec = archive.load_run(args.run, root=args.archive)
+        if rec is None:
+            print(f"trace_stitch: run {args.run!r} not found in "
+                  f"archive {archive.archive_dir(args.archive)!r}",
+                  file=sys.stderr)
+            return 2
+        args.streams = list(args.streams) + archive.run_streams(rec)
+    if not args.streams:
+        ap.error("no streams: name JSONL paths or pass --run RUN_ID")
     try:
         st = stitch(args.streams)
     except OSError as e:

@@ -20,8 +20,15 @@ mode.  `--expect name[,name...]` (with --validate) further requires at
 least one event of each named type in the stream, so the smoke run
 fails loudly if a producer silently stops emitting.
 
+`--run <run_id>` (instead of a path) resolves the run's primary
+telemetry stream through the run archive (cpr_tpu.perf.archive;
+`--archive <dir>` overrides the root, else $CPR_OBS_ARCHIVE or
+runs/archive) — summarize any archived run by id without knowing
+where its files landed.
+
 Usage: python tools/trace_summary.py <telemetry.jsonl>
            [--validate] [--expect device_metrics,compile]
+       python tools/trace_summary.py --run <run_id> [--archive DIR]
 """
 
 import json
@@ -126,6 +133,7 @@ def summarize(events, out=sys.stdout):
     _mdp_solve_lines(events, out)
     _mdp_compile_lines(events, out)
     _attack_sweep_lines(events, out)
+    _memory_lines(events, out)
     _perf_gate_lines(events, out)
     for m in (e for e in events if e.get("kind") == "manifest"):
         cfg = m.get("config") or {}
@@ -136,7 +144,7 @@ def summarize(events, out=sys.stdout):
     tabled = ("compile", "device_metrics", "vi_residuals", "retry",
               "checkpoint", "perf_gate", "supervisor", "serve",
               "request", "admission", "route", "mdp_solve",
-              "mdp_compile", "attack_sweep", "alert")
+              "mdp_compile", "attack_sweep", "alert", "memory")
     for e in (e for e in events if e.get("kind") == "event"
               and e.get("name") not in tabled):
         keys = {k: v for k, v in e.items() if k not in ("kind", "ts")}
@@ -442,6 +450,35 @@ def _attack_sweep_lines(events, out):
               file=out)
 
 
+def _memory_lines(events, out):
+    """Schema-v15 memory watermarks (telemetry.MemoryWatermark): one
+    line per scope with the peak / in-use / headroom bytes and the
+    predicted working set where the producer claimed one, so capacity
+    planning reads measurement next to prediction."""
+    evs = [e for e in events if e.get("kind") == "event"
+           and e.get("name") == "memory"]
+    if not evs:
+        return
+
+    def mb(v):
+        return (f"{v / (1 << 20):,.1f}"
+                if isinstance(v, (int, float)) else "-")
+
+    print(f"\n{'memory scope':<14} {'source':<7} {'peak_MiB':>10} "
+          f"{'in_use_MiB':>11} {'headroom_MiB':>13} "
+          f"{'predicted_MiB':>14} {'samples':>8}", file=out)
+    for e in evs:
+        limit = e.get("limit_bytes")
+        peak = e.get("peak_bytes")
+        headroom = (limit - peak
+                    if isinstance(limit, (int, float))
+                    and isinstance(peak, (int, float)) else None)
+        print(f"{str(e.get('scope')):<14} {str(e.get('source')):<7} "
+              f"{mb(peak):>10} {mb(e.get('in_use_bytes')):>11} "
+              f"{mb(headroom):>13} {mb(e.get('predicted_bytes')):>14} "
+              f"{e.get('n_samples', '-'):>8}", file=out)
+
+
 def _perf_gate_lines(events, out):
     """Schema-v5 perf-gate verdicts (cpr_tpu/perf): one line per gate,
     baseline median alongside the judged value so a WARN/FAIL is
@@ -462,6 +499,38 @@ def _perf_gate_lines(events, out):
               f"{fmt(med):>14}", file=out)
 
 
+def _take_value(argv, flag):
+    """Pop `--flag VALUE` or `--flag=VALUE` from the hand-rolled argv
+    (this tool predates argparse on purpose: the stream path is the
+    only positional)."""
+    value = None
+    if flag in argv:
+        i = argv.index(flag)
+        if i + 1 >= len(argv):
+            raise SystemExit(f"{flag} needs a value")
+        value = argv[i + 1]
+        del argv[i:i + 2]
+    for a in list(argv):
+        if a.startswith(flag + "="):
+            value = a.split("=", 1)[1]
+            argv.remove(a)
+    return value
+
+
+def resolve_archived_stream(run, root=None):
+    """The archived run's primary telemetry stream path, by run id."""
+    from cpr_tpu.perf import archive
+    rec = archive.load_run(run, root=root)
+    if rec is None:
+        raise SystemExit(f"run {run!r} not found in archive "
+                         f"{archive.archive_dir(root)!r}")
+    path = archive.primary_stream(rec)
+    if path is None:
+        raise SystemExit(f"archived run {run!r} has no telemetry "
+                         f"stream on disk")
+    return path
+
+
 def main(argv):
     argv = list(argv[1:])
     expect = []
@@ -475,7 +544,13 @@ def main(argv):
         if a.startswith("--expect="):
             expect = a.split("=", 1)[1].split(",")
             argv.remove(a)
+    run = _take_value(argv, "--run")
+    archive_root = _take_value(argv, "--archive")
     args = [a for a in argv if not a.startswith("--")]
+    if run is not None:
+        if args:
+            raise SystemExit("--run replaces the stream path")
+        args = [resolve_archived_stream(run, archive_root)]
     if len(args) != 1:
         raise SystemExit(__doc__)
     events, bad = read_events(args[0])
