@@ -4,7 +4,7 @@
 
 CPU_MESH = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: lint test test-slow bench perf-gate telemetry-smoke netsim-smoke resilience-smoke supervisor-smoke obs-smoke serve-smoke fleet-smoke multichip-smoke mdp-smoke vi-smoke compile-smoke attack-smoke dryrun sweeps ghostdag train-dummy native asan
+.PHONY: lint test test-slow bench perf-gate telemetry-smoke netsim-smoke resilience-smoke supervisor-smoke obs-smoke serve-smoke fleet-smoke chaos-smoke multichip-smoke mdp-smoke vi-smoke compile-smoke attack-smoke dryrun sweeps ghostdag train-dummy native asan
 
 lint:  ## jaxlint over cpr_tpu/ + tools/ (pure AST, no JAX import,
 	## ~1s); banks the JSON report under runs/ like the smoke flows
@@ -130,6 +130,24 @@ fleet-smoke:  ## fleet-resilience chaos proof: router + 2 replicas,
 	## Details: docs/SERVING.md
 	rm -rf $(FLEET_SMOKE_DIR)
 	python tools/fleet_smoke.py $(FLEET_SMOKE_DIR)
+
+CHAOS_SMOKE_DIR = /tmp/cpr-chaos-smoke
+
+chaos-smoke:  ## randomized chaos campaign (v16 artifact integrity
+	## plane): per seed (two distinct seeds), a replayable
+	## ChaosSchedule arms a randomized replica kill/slowdown under a
+	## 16-client flood (zero hangs, bit-identical episodes) while a
+	## concurrent VI solve takes a corrupt-checkpoint-then-kill
+	## sequence — resume quarantines the damaged checkpoint and cold
+	## starts bit-identical to an uninterrupted solve; the grid-solve
+	## cache entry is damaged and must regenerate (miss, never a
+	## crash); every injected corruption is matched 1:1 by a typed
+	## `integrity` event in the validated merged trace; and a
+	## hand-tampered ledger row is skipped with an integrity event,
+	## leaving perf_report --gate verdicts unchanged.
+	## Details: docs/RESILIENCE.md
+	rm -rf $(CHAOS_SMOKE_DIR)
+	python tools/chaos_smoke.py $(CHAOS_SMOKE_DIR)
 
 MULTICHIP_SMOKE_DIR = /tmp/cpr-multichip-smoke
 

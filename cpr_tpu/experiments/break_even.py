@@ -39,12 +39,24 @@ def _cached(key: dict, compute):
         json.dumps(key, sort_keys=True).encode()).hexdigest()[:24]
     path = os.path.join(_CACHE_DIR, h + ".json")
     if os.path.exists(path):
-        with open(path) as f:
-            return json.load(f)["value"]
+        # corruption is a MISS (quarantine + typed `integrity` event +
+        # recompute), pre-v19 unsealed entries still read — the
+        # solve_grid_cached policy
+        from cpr_tpu import integrity
+        try:
+            data, _ = resilience.sealed_read_json(
+                path, kind="break_even_cache", action="regenerated")
+            return data["value"]
+        except resilience.IntegrityError:
+            pass
+        except (OSError, KeyError, TypeError):
+            integrity.quarantine(path, kind="break_even_cache",
+                                 reason="truncated", action="regenerated")
     value = compute()
-    # atomic: a Ctrl-C mid-dump must not leave a torn cache entry that
-    # poisons every later read of this grid point
-    resilience.atomic_write_json(path, {"key": key, "value": value})
+    # atomic + sealed: a Ctrl-C mid-dump must not leave a torn cache
+    # entry that poisons every later read of this grid point
+    resilience.sealed_write_json(path, {"key": key, "value": value},
+                                 site="cache")
     return value
 
 

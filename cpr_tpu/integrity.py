@@ -1,0 +1,292 @@
+"""Artifact integrity plane: checksummed envelopes, corruption
+quarantine, and seeded chaos schedules (v16).
+
+Every exactness guarantee in this repo — bit-identical resumes,
+seed-replay failover, ledger-gated baselines — ultimately trusts bytes
+read back from disk.  Production storage corrupts: a torn rename the
+atomic-write discipline cannot see (the *old* file was already bad), a
+bit flip under the filesystem, a hand-edit, a partial copy.  This
+module makes corruption a *detected, typed, recoverable* event instead
+of a crash or silent poison:
+
+* **Sealed envelope** — `seal`/`unseal` wrap an artifact's payload in
+  a one-line ASCII header: magic + seal schema + payload length +
+  sha256.  `resilience.sealed_write`/`sealed_read` are the single
+  write/read seam (atomic exactly as before); every persisted artifact
+  family adopts it — train/policy snapshots, VI/grid-VI/compile
+  checkpoints, the mdp-grid/attack/break-even caches.  Pre-v19
+  unsealed artifacts still read (compat shim) but are tagged
+  `integrity: "unverified"` — detection starts at the first sealed
+  write, not at a flag day.
+
+* **Detect -> quarantine -> recover** — a corrupt artifact is never
+  deserialized into state.  `quarantine()` moves it (and its sidecar)
+  to `<path>.quarantine/` and emits one typed schema-v16 `integrity`
+  event (artifact/kind/reason/action); the *consumer* declares the
+  recovery policy via the event's action: caches treat corruption as a
+  miss and recompute (`regenerated`), checkpoint resume falls back to
+  a cold start — bit-identical, the solve is deterministic either way
+  (`quarantined`), snapshot load refuses loudly (`refused` — serving a
+  half-written policy is worse than crashing), and the ledger/archive
+  skip-and-report so one bad row can never poison a gate baseline.
+
+* **Chaos schedules** — the fault grammar grows artifact-level actions
+  (`corrupt@`, `truncate@`, `garble_json@` — resilience.py damages the
+  just-written file through `damage_artifact` here), and
+  `ChaosSchedule` composes seeded randomized fault sequences (kills,
+  stalls, corruption, slow-IO) for `tools/chaos_smoke.py` — replayable
+  from the seed alone, so a failing campaign is a repro, not a flake.
+
+Import-time this module is jax-free (stdlib + telemetry only) so the
+supervisor/bench parents and the perf tooling can verify artifacts
+without initializing a backend.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import random
+
+from cpr_tpu import telemetry
+
+# envelope header: b"CPRSEAL1 <schema> <length> <sha256hex>\n" + payload
+SEAL_MAGIC = b"CPRSEAL1"
+SEAL_SCHEMA = 1
+
+REASONS = ("checksum", "truncated", "version", "sidecar_missing")
+ACTIONS = ("quarantined", "regenerated", "refused")
+
+# fault-grammar actions that damage a just-written artifact in place
+# (dispatched by resilience.FaultInjector to damage_artifact below)
+ARTIFACT_ACTIONS = ("corrupt", "truncate", "garble_json")
+
+
+class IntegrityError(Exception):
+    """A persisted artifact failed verification.  Named and actionable:
+    carries the artifact path, its kind, and the typed reason (one of
+    REASONS) so callers can branch on policy — and so the error a user
+    sees says *which* file to look at and *what* was wrong with it."""
+
+    def __init__(self, message: str, *, artifact: str, kind: str,
+                 reason: str):
+        super().__init__(message)
+        self.artifact = artifact
+        self.kind = kind
+        self.reason = reason
+
+
+def integrity_event(*, artifact: str, kind: str, reason: str,
+                    action: str, **extra):
+    """Emit one typed v16 `integrity` event (the only emitter — every
+    detection funnels through here so the chaos smoke can match
+    injected corruptions 1:1 against the validated trace).  On the
+    wire the family travels as `artifact_kind`: `kind` is the
+    telemetry envelope discriminator and a payload field named `kind`
+    would shadow it."""
+    telemetry.current().event("integrity", artifact=artifact,
+                              artifact_kind=kind, reason=reason,
+                              action=action, **extra)
+
+
+# -- sealed envelope ---------------------------------------------------------
+
+
+def seal(payload: bytes, *, schema: int = SEAL_SCHEMA) -> bytes:
+    """Wrap payload bytes in the checksummed envelope."""
+    digest = hashlib.sha256(payload).hexdigest()
+    header = b"%s %d %d %s\n" % (SEAL_MAGIC, schema, len(payload),
+                                 digest.encode())
+    return header + payload
+
+
+def is_sealed(data: bytes) -> bool:
+    return data.startswith(SEAL_MAGIC + b" ")
+
+
+def unseal(data: bytes, *, artifact: str = "<bytes>",
+           kind: str = "artifact") -> tuple[bytes, str]:
+    """Verify + strip the envelope.  Returns (payload, tag) where tag
+    is "verified" (sealed, digest matched) or "unverified" (pre-v19
+    unsealed artifact — passed through for the downstream deserializer
+    to judge).  Raises IntegrityError with a typed reason when the
+    envelope is present but the bytes behind it are damaged."""
+    if not is_sealed(data):
+        # compat shim: a file written before the envelope landed.  A
+        # truncated-to-nothing file lands here too — the consumer's
+        # deserializer is the detector of last resort.
+        return data, "unverified"
+    nl = data.find(b"\n")
+    if nl < 0:
+        raise IntegrityError(
+            f"{kind} {artifact}: sealed header is torn (no payload)",
+            artifact=artifact, kind=kind, reason="truncated")
+    try:
+        _, schema_s, length_s, digest = data[:nl].decode().split(" ")
+        schema, length = int(schema_s), int(length_s)
+    except ValueError:
+        raise IntegrityError(
+            f"{kind} {artifact}: sealed header is malformed",
+            artifact=artifact, kind=kind, reason="truncated") from None
+    if schema > SEAL_SCHEMA:
+        raise IntegrityError(
+            f"{kind} {artifact}: sealed with schema {schema}, this "
+            f"build reads <= {SEAL_SCHEMA}",
+            artifact=artifact, kind=kind, reason="version")
+    payload = data[nl + 1:]
+    if len(payload) != length:
+        raise IntegrityError(
+            f"{kind} {artifact}: payload is {len(payload)} bytes, "
+            f"header promises {length} (truncated or torn write)",
+            artifact=artifact, kind=kind, reason="truncated")
+    got = hashlib.sha256(payload).hexdigest()
+    if got != digest:
+        raise IntegrityError(
+            f"{kind} {artifact}: sha256 mismatch — header has "
+            f"{digest[:12]}…, payload hashes to {got[:12]}… (bytes "
+            f"corrupted on disk)",
+            artifact=artifact, kind=kind, reason="checksum")
+    return payload, "verified"
+
+
+# -- quarantine --------------------------------------------------------------
+
+
+def quarantine_dir(path: str) -> str:
+    return path + ".quarantine"
+
+
+def quarantine(path: str, *, kind: str, reason: str,
+               action: str = "quarantined", sidecars=(".json",),
+               emit: bool = True) -> str | None:
+    """Move a corrupt artifact (plus any existing sidecars) into
+    `<path>.quarantine/` so it is preserved for the post-mortem but
+    can never be deserialized into state again, and emit the typed
+    `integrity` event.  Returns the quarantined path (None when the
+    artifact vanished underneath us — the event still fires: the
+    *detection* happened)."""
+    qdir = quarantine_dir(path)
+    dest = None
+    base = os.path.basename(path)
+    try:
+        os.makedirs(qdir, exist_ok=True)
+        dest = os.path.join(qdir, base)
+        n = 0
+        while os.path.exists(dest):
+            n += 1
+            dest = os.path.join(qdir, f"{base}.{n}")
+        os.replace(path, dest)
+    except OSError:
+        dest = None
+    for ext in sidecars:
+        side = path + ext
+        if os.path.exists(side):
+            try:
+                os.replace(side, os.path.join(
+                    qdir, os.path.basename(dest or side) + ext))
+            except OSError:
+                pass
+    if emit:
+        integrity_event(artifact=path, kind=kind, reason=reason,
+                        action=action, quarantine=dest)
+    return dest
+
+
+# -- injected artifact damage ------------------------------------------------
+
+
+def damage_artifact(path: str, action: str):
+    """Deterministically damage an on-disk artifact in place — the
+    storage-corruption stand-ins the fault grammar arms (`corrupt@`,
+    `truncate@`, `garble_json@`).  Deliberately NOT atomic: real
+    corruption isn't."""
+    size = os.path.getsize(path)
+    if action == "corrupt":
+        # flip the last byte — always inside the sealed payload, so the
+        # digest check (not just a decode error) is what must catch it
+        with open(path, "r+b") as f:
+            f.seek(max(size - 1, 0))
+            tail = f.read(1) or b"\0"
+            f.seek(max(size - 1, 0))
+            f.write(bytes([tail[0] ^ 0xFF]))
+    elif action == "truncate":
+        os.truncate(path, size // 2)
+    elif action == "garble_json":
+        with open(path, "r+b") as f:
+            f.write(b'{"garbled": ')
+            f.truncate()
+    else:
+        raise ValueError(f"unknown artifact damage action {action!r}")
+
+
+# -- chaos schedules ---------------------------------------------------------
+
+
+class ChaosSchedule:
+    """A seeded, replayable composition of randomized fault sequences
+    for the chaos campaign (tools/chaos_smoke.py).  Everything derives
+    from `seed` through one private random.Random — two constructions
+    with the same seed produce identical schedules (asserted by test
+    and by the smoke itself), so a failing campaign replays exactly.
+
+    Scenario legs (each a CPR_FAULT_INJECT spec string, or a list of
+    them):
+
+    * `fleet_specs()` — per-round fault spec for the router+replicas
+      under client flood: replica kills and cooperative slowdowns,
+      randomized over target replica / occurrence index.
+    * `solve_specs()` — the kill+corrupt sequence for the concurrent
+      VI solve: damage one checkpoint write (randomized action), then
+      kill a later chunk, so resume must fall back past the corrupted
+      checkpoint to a cold start.
+    * `cache_action()` — which artifact damage hits the grid cache.
+    """
+
+    def __init__(self, seed: int, *, rounds: int = 3, replicas: int = 2):
+        self.seed = int(seed)
+        self.rounds = int(rounds)
+        self.replicas = int(replicas)
+        rng = random.Random(self.seed)
+        self._fleet = []
+        for _ in range(self.rounds):
+            specs = [f"kill@replica={rng.randrange(self.replicas)}"]
+            if rng.random() < 0.5:
+                specs.append("slow@replica="
+                             f"{rng.randrange(self.replicas)}")
+            self._fleet.append(",".join(specs))
+        damage = rng.choice(ARTIFACT_ACTIONS)
+        ckpt = rng.randint(1, 2)
+        self._solve = (f"{damage}@vi_chunk={ckpt},"
+                       f"kill@vi_chunk={ckpt + 1}")
+        self._cache = rng.choice(ARTIFACT_ACTIONS)
+
+    def fleet_specs(self) -> list[str]:
+        return list(self._fleet)
+
+    def solve_specs(self) -> str:
+        return self._solve
+
+    def cache_action(self) -> str:
+        return self._cache
+
+    def describe(self) -> dict:
+        """JSON-safe self-description (logged by the smoke so the repro
+        command — same seed — is always in the artifact)."""
+        return {"seed": self.seed, "rounds": self.rounds,
+                "replicas": self.replicas, "fleet": self._fleet,
+                "solve": self._solve, "cache": self._cache}
+
+
+# -- verify-on-read helpers for content-addressed rows -----------------------
+
+
+def row_digest(row: dict, *, exclude=("row_id",)) -> str:
+    """Recompute a ledger row's content hash exactly as
+    perf.ledger._digest stamped it (sha1[:12] of the sorted-key JSON
+    without the row_id itself) — verify-on-read for append-only JSONL
+    where a whole-file envelope cannot work."""
+    body = {k: v for k, v in row.items() if k not in exclude}
+    return hashlib.sha1(
+        json.dumps(body, sort_keys=True, default=str).encode()
+    ).hexdigest()[:12]

@@ -606,12 +606,19 @@ def run_chunk_driver(chunk_step, S, dtype, stop_delta, max_iter,
     prev_delta = None
     resids: list = []
     if checkpoint_path is not None and os.path.exists(checkpoint_path):
-        v0, p0, it, r0 = resilience.load_vi_checkpoint(
-            checkpoint_path, S=S, dtype=dtype)
-        value, prog = jnp.asarray(v0), jnp.asarray(p0)
-        resids = [r0] if r0.size else []
-        telemetry.current().event("resume", path=checkpoint_path,
-                                  update=int(it), scope="vi")
+        try:
+            v0, p0, it, r0 = resilience.load_vi_checkpoint(
+                checkpoint_path, S=S, dtype=dtype)
+            value, prog = jnp.asarray(v0), jnp.asarray(p0)
+            resids = [r0] if r0.size else []
+            telemetry.current().event("resume", path=checkpoint_path,
+                                      update=int(it), scope="vi")
+        except resilience.IntegrityError:
+            # the damaged checkpoint is already quarantined + reported
+            # (typed `integrity` event); the solve falls back to a
+            # cold start, which recomputes the same deterministic
+            # trajectory — bit-identical to never having checkpointed
+            it = 0
     chunks_done = 0
     # v15 watermark: one allocator read per chunk (the convergence
     # check already syncs there, so the probe rides an existing host
@@ -779,16 +786,22 @@ def run_grid_chunk_driver(chunk_step, place, G, S, dtype, stop_delta,
     it = 0
     resids: list = []
     if checkpoint_path is not None and os.path.exists(checkpoint_path):
-        st = resilience.load_grid_vi_checkpoint(
-            checkpoint_path, G=G, S=S, dtype=np_dtype)
-        value, prog, pol = st["value"], st["prog"], st["pol"]
-        frozen = st["frozen"].copy()
-        conv_it = st["conv_it"].copy()
-        final_delta = st["final_delta"].copy()
-        it = int(st["it"])
-        resids = [st["resid"]] if st["resid"].size else []
-        telemetry.current().event("resume", path=checkpoint_path,
-                                  update=it, scope="grid_vi")
+        try:
+            st = resilience.load_grid_vi_checkpoint(
+                checkpoint_path, G=G, S=S, dtype=np_dtype)
+        except resilience.IntegrityError:
+            # quarantined + reported by sealed_read; cold-start fallback
+            # recomputes the same deterministic trajectory
+            st = None
+        if st is not None:
+            value, prog, pol = st["value"], st["prog"], st["pol"]
+            frozen = st["frozen"].copy()
+            conv_it = st["conv_it"].copy()
+            final_delta = st["final_delta"].copy()
+            it = int(st["it"])
+            resids = [st["resid"]] if st["resid"].size else []
+            telemetry.current().event("resume", path=checkpoint_path,
+                                      update=it, scope="grid_vi")
     carry = (place(value), place(prog), place(pol))
     chunks_done = 0
     # v15 watermark: one allocator read per chunk, riding the same

@@ -373,8 +373,14 @@ class FrontierCompiler:
             model_fp=self._model_fp)
 
     def _resume(self, path: str):
-        st = resilience.load_compile_checkpoint(
-            path, model_fp=self._model_fp)
+        try:
+            st = resilience.load_compile_checkpoint(
+                path, model_fp=self._model_fp)
+        except resilience.IntegrityError:
+            # quarantined + reported by sealed_read; the compile falls
+            # back to a cold start — the frontier BFS is deterministic,
+            # so the result is bit-identical either way
+            return
         tab = pickle.loads(st["blob"])
         self.states = list(tab["states"])
         self.action_map = list(tab["action_map"])

@@ -679,8 +679,21 @@ def solve_grid_cached(protocol: str, *, cutoff: int, alphas, gammas,
         json.dumps(key, sort_keys=True).encode()).hexdigest()[:24]
     path = os.path.join(_cache_dir(), h + ".json")
     if cache and os.path.exists(path):
-        with open(path) as f:
-            return dict(json.load(f)["value"], cached=True)
+        # corruption is a MISS, never a crash: a truncated, bit-flipped
+        # or garbage-JSON entry is quarantined + reported (typed
+        # `integrity` event, action "regenerated") and the solve below
+        # recomputes it; pre-v19 unsealed entries read fine, tagged
+        # integrity: "unverified"
+        from cpr_tpu import integrity
+        try:
+            data, tag = resilience.sealed_read_json(
+                path, kind="mdp_grid_cache", action="regenerated")
+            return dict(data["value"], cached=True, integrity=tag)
+        except resilience.IntegrityError:
+            pass
+        except (OSError, KeyError, TypeError):
+            integrity.quarantine(path, kind="mdp_grid_cache",
+                                 reason="truncated", action="regenerated")
     vi = grid_value_iteration(pm, alphas, gammas, discount=discount,
                               stop_delta=stop_delta, mesh=mesh,
                               protocol=protocol, cutoff=cutoff)
@@ -700,5 +713,6 @@ def solve_grid_cached(protocol: str, *, cutoff: int, alphas, gammas,
         value["policy"] = [[int(x) for x in row]
                            for row in vi["grid_policy"]]
     if cache:
-        resilience.atomic_write_json(path, {"key": key, "value": value})
+        resilience.sealed_write_json(path, {"key": key, "value": value},
+                                     site="cache")
     return value

@@ -718,8 +718,19 @@ def attack_sweep_cached(net, topology: str, *,
         json.dumps(key, sort_keys=True).encode()).hexdigest()[:24]
     path = os.path.join(_cache_dir(), h + ".json")
     if cache and os.path.exists(path):
-        with open(path) as f:
-            return dict(json.load(f)["value"], cached=True)
+        # corruption is a MISS, never a crash (the solve_grid_cached
+        # policy): quarantine + typed `integrity` event + recompute;
+        # pre-v19 unsealed entries read tagged integrity: "unverified"
+        from cpr_tpu import integrity
+        try:
+            data, tag = resilience.sealed_read_json(
+                path, kind="attack_cache", action="regenerated")
+            return dict(data["value"], cached=True, integrity=tag)
+        except resilience.IntegrityError:
+            pass
+        except (OSError, KeyError, TypeError):
+            integrity.quarantine(path, kind="attack_cache",
+                                 reason="truncated", action="regenerated")
     t0 = telemetry.now()
     rows = attack_sweep(
         [(topology, net)], protocols=((protocol, dict(k=k,
@@ -737,5 +748,6 @@ def attack_sweep_cached(net, topology: str, *,
         rows=rows, sweep_s=round(telemetry.now() - t0, 6),
         cached=False)
     if cache:
-        resilience.atomic_write_json(path, {"key": key, "value": value})
+        resilience.sealed_write_json(path, {"key": key, "value": value},
+                                     site="cache")
     return value

@@ -275,6 +275,11 @@ def archive_run(paths=(), *, run: str | None = None,
         if prev.get("time_utc") and (not time_utc
                                      or prev["time_utc"] < time_utc):
             rec["time_utc"] = prev["time_utc"]
+    # v16 verify-on-read: the record carries its own content digest, so
+    # a hand-edited or bit-flipped record is detected (and skipped with
+    # a typed `integrity` event) instead of silently steering
+    # attribution at a wrong trace
+    rec["record_sha256"] = _record_digest(rec)
     resilience.atomic_write_json(record_path(run, root), rec)
     # append-only audit line (append mode: raw-write exempt, and an
     # append can at worst tear its own line, never the trail)
@@ -288,17 +293,54 @@ def archive_run(paths=(), *, run: str | None = None,
             "record": os.path.basename(record_path(run, root)),
         }, default=str) + "\n")
         f.flush()
-    return rec
+    # what we just wrote is what a verified read returns
+    return dict(rec, integrity="verified")
+
+
+def _record_digest(rec: dict) -> str:
+    """Content digest of a record minus its own seal fields — stable
+    across the JSON round trip (sorted keys, default=str exactly as
+    the writer serialized)."""
+    body = {k: v for k, v in rec.items()
+            if k not in ("record_sha256", "integrity")}
+    return hashlib.sha256(json.dumps(
+        body, sort_keys=True, default=str).encode()).hexdigest()
+
+
+def _verified_record(path: str) -> dict | None:
+    """Parse + verify one record file.  Returns the record tagged
+    `integrity: "verified"` (digest matched) or `"unverified"`
+    (pre-v19 record with no digest); a record that fails to parse or
+    contradicts its digest is quarantined, reported as a typed
+    `integrity` event, and skipped (None) — the run-archive twin of
+    the ledger's skip-and-report."""
+    from cpr_tpu import integrity
+    try:
+        with open(path) as f:
+            rec = json.load(f)
+    except OSError:
+        return None
+    except ValueError:
+        integrity.quarantine(path, kind="archive_record",
+                             reason="truncated", action="quarantined",
+                             sidecars=())
+        return None
+    if not isinstance(rec, dict):
+        return None
+    expected = rec.get("record_sha256")
+    if expected is None:
+        return dict(rec, integrity="unverified")
+    if _record_digest(rec) != expected:
+        integrity.quarantine(path, kind="archive_record",
+                             reason="checksum", action="quarantined",
+                             sidecars=())
+        return None
+    return dict(rec, integrity="verified")
 
 
 def load_run(run: str, root: str | None = None) -> dict | None:
     """The archived record for one run id, or None."""
-    try:
-        with open(record_path(run, root)) as f:
-            rec = json.load(f)
-        return rec if isinstance(rec, dict) else None
-    except (OSError, ValueError):
-        return None
+    return _verified_record(record_path(run, root))
 
 
 def find_runs(root: str | None = None, *, run: str | None = None,
@@ -320,12 +362,8 @@ def find_runs(root: str | None = None, *, run: str | None = None,
     for name in names:
         if not (name.startswith("run-") and name.endswith(".json")):
             continue
-        try:
-            with open(os.path.join(d, name)) as f:
-                rec = json.load(f)
-        except (OSError, ValueError):
-            continue
-        if not isinstance(rec, dict) or "run" not in rec:
+        rec = _verified_record(os.path.join(d, name))
+        if rec is None or "run" not in rec:
             continue
         if run is not None and rec.get("run") != run:
             continue

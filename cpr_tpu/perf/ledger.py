@@ -63,7 +63,7 @@ import json
 import os
 import re
 
-from cpr_tpu.resilience import atomic_write_text
+from cpr_tpu.resilience import artifact_fault_point, atomic_write_text
 
 LEDGER_VERSION = 5
 LEDGER_ENV_VAR = "CPR_PERF_LEDGER"
@@ -460,26 +460,52 @@ def iter_trace_rows(path: str):
 
 
 class Ledger:
-    """Append-only JSONL ledger with content-addressed dedup."""
+    """Append-only JSONL ledger with content-addressed dedup and
+    verify-on-read (v16): every row's `row_id` IS its content hash, so
+    `records()` recomputes it and skips-and-reports any row whose bytes
+    no longer match — one hand-edited or bit-flipped line can never
+    become a gate baseline, and the skip is a typed `integrity` event,
+    not a silent drop."""
 
     def __init__(self, path: str):
         self.path = path
+        self._reported: set = set()
+
+    def _skip(self, line_no: int, reason: str):
+        from cpr_tpu.integrity import integrity_event
+        key = (line_no, reason)
+        if key in self._reported:
+            return  # records() runs per append; report each line once
+        self._reported.add(key)
+        integrity_event(artifact=f"{self.path}:{line_no}",
+                        kind="ledger_row", reason=reason,
+                        action="quarantined")
 
     def records(self) -> list[dict]:
         out = []
         try:
             with open(self.path) as f:
-                for line in f:
-                    line = line.strip()
-                    if not line:
-                        continue
-                    try:
-                        out.append(json.loads(line))
-                    except ValueError:
-                        continue  # a torn line cannot happen (atomic
-                        # writes) but a hand-edited one must not wedge
+                lines = f.readlines()
         except OSError:
-            pass
+            return out
+        for i, line in enumerate(lines, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                row = json.loads(line)
+            except ValueError:
+                # a torn line cannot happen (atomic writes); a
+                # hand-edited one must not wedge — skip and report
+                self._skip(i, "truncated")
+                continue
+            rid = row.get("row_id") if isinstance(row, dict) else None
+            if rid is not None:
+                from cpr_tpu.integrity import row_digest
+                if row_digest(row) != rid:
+                    self._skip(i, "checksum")
+                    continue
+            out.append(row)
         return out
 
     def append(self, records) -> int:
@@ -499,6 +525,10 @@ class Ledger:
         lines = "".join(json.dumps(r, sort_keys=True) + "\n"
                         for r in fresh)
         atomic_write_text(self.path, existing + lines)
+        # chaos seam: corrupt@ledger / truncate@ledger / garble_json@
+        # ledger damage the just-banked file — verify-on-read above is
+        # what must catch it
+        artifact_fault_point("ledger", self.path)
         return len(fresh)
 
     def ingest_banks(self, root: str) -> int:

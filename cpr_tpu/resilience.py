@@ -62,7 +62,8 @@ import time
 from contextlib import contextmanager
 from typing import Callable
 
-from cpr_tpu import telemetry
+from cpr_tpu import integrity, telemetry
+from cpr_tpu.integrity import IntegrityError  # re-export  # noqa: F401
 
 SNAPSHOT_VERSION = 1
 FAULT_ENV_VAR = "CPR_FAULT_INJECT"
@@ -115,19 +116,30 @@ def default_classify(exc: BaseException) -> bool:
 def with_retries(fn: Callable, *, classify: Callable | None = None,
                  max_attempts: int = 3, base_delay_s: float = 0.5,
                  max_delay_s: float = 30.0, jitter_frac: float = 0.25,
+                 jitter: str = "additive",
                  sleep: Callable = time.sleep, rng=None,
                  on_retry: Callable | None = None, name: str | None = None):
     """Call `fn()` with exponential backoff on transient failures.
 
-    Delay before attempt k+1 is `min(base * 2**(k-1), max) * (1 + j)`,
-    j uniform in [0, jitter_frac) — jitter decorrelates retry storms
-    when several workers chase the same recovering device.  Each
-    re-attempt emits a `retry` telemetry event (attempt, delay_s,
-    error) and calls `on_retry(attempt, exc, delay_s)` if given (bench
-    uses it to stamp worker-fault timestamps).  `classify(exc) -> bool`
-    decides retryability (default: `default_classify`); a fatal
-    exception or the last attempt's failure re-raises immediately."""
+    With the default `jitter="additive"`, delay before attempt k+1 is
+    `min(base * 2**(k-1), max) * (1 + j)`, j uniform in
+    [0, jitter_frac) — enough to decorrelate a couple of workers
+    chasing the same recovering device, but a whole fleet retrying the
+    same shed still clumps near the deterministic floor.
+    `jitter="full"` uses AWS-style full jitter instead: delay uniform
+    in [0, min(base * 2**(k-1), max)] — the fleet spreads over the
+    entire window, at the cost of occasional near-zero delays (the
+    serve client's shed-retry path wants this; a lone bench worker
+    does not).  Each re-attempt emits a `retry` telemetry event
+    (attempt, delay_s, error) and calls `on_retry(attempt, exc,
+    delay_s)` if given (bench uses it to stamp worker-fault
+    timestamps).  `classify(exc) -> bool` decides retryability
+    (default: `default_classify`); a fatal exception or the last
+    attempt's failure re-raises immediately."""
     classify = classify or default_classify
+    if jitter not in ("additive", "full"):
+        raise ValueError(f"jitter must be 'additive' or 'full', "
+                         f"got {jitter!r}")
     rand = rng if rng is not None else random.random
     label = name or getattr(fn, "__name__", "fn")
     for attempt in range(1, max_attempts + 1):
@@ -136,8 +148,11 @@ def with_retries(fn: Callable, *, classify: Callable | None = None,
         except Exception as exc:  # noqa: BLE001 — classifier decides
             if attempt >= max_attempts or not classify(exc):
                 raise
-            delay = min(base_delay_s * (2.0 ** (attempt - 1)), max_delay_s)
-            delay *= 1.0 + jitter_frac * rand()
+            cap = min(base_delay_s * (2.0 ** (attempt - 1)), max_delay_s)
+            if jitter == "full":
+                delay = cap * rand()
+            else:
+                delay = cap * (1.0 + jitter_frac * rand())
             telemetry.current().event(
                 "retry", attempt=attempt, delay_s=round(delay, 3),
                 error=f"{type(exc).__name__}: {exc}", site=label)
@@ -189,12 +204,103 @@ def atomic_write_text(path: str, text: str, encoding: str = "utf-8"):
     atomic_write_bytes(path, text.encode(encoding))
 
 
+# -- sealed (checksummed) artifact writes ------------------------------------
+#
+# v16: the single write/read seam every persisted artifact funnels
+# through.  `sealed_write` = atomic_write_bytes of the payload wrapped
+# in integrity.seal's envelope (magic + seal schema + length + sha256
+# on one ASCII header line), then the artifact-damage fault point for
+# the site — so chaos specs corrupt exactly what production storage
+# would.  `sealed_read` verifies the envelope before ANY deserializer
+# sees the bytes; on damage the file is quarantined
+# (<path>.quarantine/), one typed v16 `integrity` event fires with the
+# caller-declared recovery action, and IntegrityError propagates for
+# the caller's policy (miss-and-recompute, fall back to cold start,
+# refuse).  Pre-v19 unsealed artifacts pass through tagged
+# "unverified" — the compat shim, not a verification.
+
+
+def sealed_write(path: str, data: bytes, *, site: str | None = None,
+                 schema: int = integrity.SEAL_SCHEMA):
+    """Atomically write `data` wrapped in the checksummed envelope.
+    `site` names the artifact-damage fault site armed by chaos specs
+    (checkpoint, vi_chunk, compile_round, cache, snapshot...)."""
+    atomic_write_bytes(path, integrity.seal(data, schema=schema))
+    if site is not None:
+        artifact_fault_point(site, path)
+
+
+def sealed_write_json(path: str, obj, *, site: str | None = None):
+    sealed_write(path, (json.dumps(obj, indent=2, default=str)
+                        + "\n").encode(), site=site)
+
+
+def sealed_read(path: str, *, kind: str = "artifact",
+                action: str = "quarantined",
+                sidecars: tuple = (".json",)) -> tuple[bytes, str]:
+    """Read + verify a sealed artifact.  Returns (payload, tag), tag
+    "verified" for an intact envelope or "unverified" for a pre-v19
+    unsealed file (compat shim — the downstream deserializer is then
+    the detector of last resort).  On a damaged envelope the artifact
+    moves to <path>.quarantine/, one `integrity` event fires with the
+    caller's declared recovery `action` (quarantined | regenerated |
+    refused), and the typed IntegrityError propagates."""
+    with open(path, "rb") as f:
+        data = f.read()
+    try:
+        return integrity.unseal(data, artifact=path, kind=kind)
+    except IntegrityError as exc:
+        integrity.quarantine(path, kind=kind, reason=exc.reason,
+                             action=action, sidecars=sidecars)
+        raise
+
+
+def sealed_read_json(path: str, *, kind: str = "artifact",
+                     action: str = "quarantined") -> tuple[dict, str]:
+    """`sealed_read` + JSON decode, with a decode failure of the
+    *verified or legacy* payload handled exactly like a torn envelope
+    (quarantine + typed event + IntegrityError) — a cache entry that
+    parses is the only cache entry that exists."""
+    payload, tag = sealed_read(path, kind=kind, action=action)
+    try:
+        return json.loads(payload.decode("utf-8", "replace")), tag
+    except ValueError:
+        integrity.quarantine(path, kind=kind, reason="truncated",
+                             action=action)
+        raise IntegrityError(
+            f"{kind} {path}: payload is not valid JSON",
+            artifact=path, kind=kind, reason="truncated") from None
+
+
+def reject_undecodable(path: str, *, kind: str, err,
+                       action: str = "quarantined") -> IntegrityError:
+    """A payload that cleared (or predates) the envelope but fails to
+    deserialize is corruption the envelope could not see — a garbled
+    pre-v19 file, or damage that happened before the seal was written.
+    Same recovery path as a torn envelope: quarantine, one typed
+    event, and a returned IntegrityError for the caller to raise."""
+    integrity.quarantine(path, kind=kind, reason="truncated",
+                         action=action)
+    return IntegrityError(
+        f"{kind} {path}: payload does not deserialize ({err})",
+        artifact=path, kind=kind, reason="truncated")
+
+
 # -- deterministic fault injection -------------------------------------------
 
 _ACTIONS = ("kill", "io_error", "fault", "nan", "preempt", "hang",
-            "slow")
+            "slow") + integrity.ARTIFACT_ACTIONS
 # occurrence-counted sites (kill@vi_chunk=3 means the third pass)
 _COUNTED_SITES = ("checkpoint", "vi_chunk", "compile_round")
+# artifact-damage actions (v16): fire at *write* sites through
+# `artifact_fault_point(site, path)` — the just-written file is
+# damaged in place (bit flip / truncation / JSON garbling via
+# integrity.damage_artifact), the deterministic stand-in for storage
+# corruption.  They keep their own per-site occurrence counters
+# (`corrupt@vi_chunk=2` = the 2nd checkpoint WRITE at that site), so
+# arming them never perturbs the indices of the compute-site actions
+# above at the same site name.
+_ARTIFACT_ACTIONS = integrity.ARTIFACT_ACTIONS
 
 # how long an injected `hang` blocks.  The default approximates a truly
 # wedged process (the supervisor's watchdog must kill the child, exactly
@@ -257,11 +363,15 @@ class FaultInjector:
     def fire(self, site: str, index: int | None = None) -> str | None:
         """Called at a fault point.  Returns the action name for
         cooperative actions ("nan", "preempt"), None when nothing
-        fires; raises for "kill"/"io_error"/"fault"."""
+        fires; raises for "kill"/"io_error"/"fault".  Artifact-damage
+        specs never fire here — they live on the write path
+        (`fire_artifact`) with their own counters."""
         if index is None:
             index = self.counts.get(site, 0) + 1
             self.counts[site] = index
         for s in self.specs:
+            if s.action in _ARTIFACT_ACTIONS:
+                continue
             if not (s.armed and s.site == site and s.index == index):
                 continue
             s.armed = False
@@ -292,6 +402,32 @@ class FaultInjector:
             return s.action
         return None
 
+    def fire_artifact(self, site: str, path: str,
+                      index: int | None = None) -> str | None:
+        """Called right after an artifact lands at `path` on a write
+        site.  Matches only artifact-damage specs (`corrupt@`,
+        `truncate@`, `garble_json@`), counted in a namespace of their
+        own (`<site>#artifact`) so `corrupt@vi_chunk=2` means the 2nd
+        checkpoint *write* regardless of how many compute passes the
+        plain `vi_chunk` fault point has counted.  Damages the file in
+        place and returns the action name (None when nothing fires)."""
+        key = site + "#artifact"
+        if index is None:
+            index = self.counts.get(key, 0) + 1
+            self.counts[key] = index
+        for s in self.specs:
+            if s.action not in _ARTIFACT_ACTIONS:
+                continue
+            if not (s.armed and s.site == site and s.index == index):
+                continue
+            s.armed = False
+            telemetry.current().event(
+                "fault_injected", spec=s.raw, site=site, index=index,
+                artifact=path)
+            integrity.damage_artifact(path, s.action)
+            return s.action
+        return None
+
 
 _injector: FaultInjector | None = None
 _injector_src: str | None = None
@@ -314,6 +450,15 @@ def fault_point(site: str, index: int | None = None) -> str | None:
     sites (`update`); counted sites (`checkpoint`, `vi_chunk`) pass
     None.  Free when CPR_FAULT_INJECT is unset (one dict lookup)."""
     return injector().fire(site, index)
+
+
+def artifact_fault_point(site: str, path: str,
+                         index: int | None = None) -> str | None:
+    """Mark a named artifact-write site: called by `sealed_write` (and
+    the ledger's append path) right after the artifact is durably at
+    `path`, so an armed `corrupt@`/`truncate@`/`garble_json@` spec can
+    damage exactly the n-th write.  Free when nothing is armed."""
+    return injector().fire_artifact(site, path, index)
 
 
 # -- preemption --------------------------------------------------------------
@@ -390,7 +535,7 @@ def save_train_snapshot(path: str, carry, *, update: int,
             "best": float(best) if finite_best else 0.0}
     payload = {"meta": meta, "carry": carry,
                "best_params": best_params if has_best else carry[0].params}
-    atomic_write_bytes(path, serialization.to_bytes(payload))
+    sealed_write(path, serialization.to_bytes(payload), site="checkpoint")
     sidecar = dict(meta, time_utc=telemetry.run_manifest()["time_utc"])
     if config is not None:
         sidecar["config"] = config
@@ -405,9 +550,15 @@ def load_train_snapshot(path: str, template_carry):
 
     template = {"meta": _meta_template(), "carry": template_carry,
                 "best_params": template_carry[0].params}
-    with open(path, "rb") as f:
-        restored = serialization.from_bytes(template, f.read())
-    meta = dict(restored["meta"])
+    payload, tag = sealed_read(path, kind="train_snapshot")
+    try:
+        restored = serialization.from_bytes(template, payload)
+    except IntegrityError:
+        raise
+    except Exception as e:  # msgpack raises its own hierarchy
+        raise reject_undecodable(path, kind="train_snapshot",
+                                 err=e) from e
+    meta = dict(restored["meta"], integrity=tag)
     if meta["version"] != SNAPSHOT_VERSION:
         raise ValueError(
             f"snapshot {path} has version {meta['version']}, "
@@ -436,7 +587,7 @@ def save_vi_checkpoint(path: str, *, value, prog, it: int, resids,
              resid=(np.concatenate([np.asarray(r) for r in resids])
                     if resids else np.zeros(0, np.asarray(value).dtype)),
              stop_delta=np.asarray(float(stop_delta)))
-    atomic_write_bytes(path, buf.getvalue())
+    sealed_write(path, buf.getvalue(), site="vi_chunk")
     atomic_write_json(path + ".json", {
         "version": SNAPSHOT_VERSION, "it": int(it),
         "S": int(np.asarray(value).shape[0]),
@@ -450,10 +601,14 @@ def load_vi_checkpoint(path: str, *, S: int, dtype):
     MDP must not silently seed this solve)."""
     import numpy as np
 
-    with open(path, "rb") as f:
-        with np.load(io.BytesIO(f.read())) as z:
+    payload, _ = sealed_read(path, kind="vi_checkpoint")
+    try:
+        with np.load(io.BytesIO(payload)) as z:
             value, prog = z["value"], z["prog"]
             it, resid = int(z["it"]), z["resid"]
+    except Exception as e:  # np.load: ValueError/OSError/BadZipFile
+        raise reject_undecodable(path, kind="vi_checkpoint",
+                                 err=e) from e
     if value.shape != (S,):
         raise ValueError(f"VI checkpoint {path} has S={value.shape}, "
                          f"solve expects ({S},)")
@@ -486,7 +641,7 @@ def save_grid_vi_checkpoint(path: str, *, value, prog, pol, frozen,
                     if resids else np.zeros((value.shape[0], 0),
                                             value.dtype)),
              stop_delta=np.asarray(float(stop_delta)))
-    atomic_write_bytes(path, buf.getvalue())
+    sealed_write(path, buf.getvalue(), site="vi_chunk")
     atomic_write_json(path + ".json", {
         "version": SNAPSHOT_VERSION, "kind": "grid_vi", "it": int(it),
         "G": int(value.shape[0]), "S": int(value.shape[1]),
@@ -498,11 +653,15 @@ def load_grid_vi_checkpoint(path: str, *, G: int, S: int, dtype):
     against the solve's [G, S] plane shape and dtype."""
     import numpy as np
 
-    with open(path, "rb") as f:
-        with np.load(io.BytesIO(f.read())) as z:
+    payload, _ = sealed_read(path, kind="grid_vi_checkpoint")
+    try:
+        with np.load(io.BytesIO(payload)) as z:
             st = {k: z[k] for k in ("value", "prog", "pol", "frozen",
                                     "conv_it", "final_delta", "it",
                                     "resid")}
+    except Exception as e:
+        raise reject_undecodable(path, kind="grid_vi_checkpoint",
+                                 err=e) from e
     if st["value"].shape != (G, S):
         raise ValueError(f"grid VI checkpoint {path} has plane "
                          f"{st['value'].shape}, solve expects {(G, S)}")
@@ -536,7 +695,7 @@ def save_compile_checkpoint(path: str, *, columns: dict, blob: bytes,
              explored=np.asarray(int(explored_upto)),
              model_fp=np.asarray(model_fp),
              **{k: np.asarray(v) for k, v in columns.items()})
-    atomic_write_bytes(path, buf.getvalue())
+    sealed_write(path, buf.getvalue(), site="compile_round")
     atomic_write_json(path + ".json", {
         "version": SNAPSHOT_VERSION, "kind": "mdp_compile",
         "round": int(round_idx), "explored": int(explored_upto),
@@ -550,9 +709,13 @@ def load_compile_checkpoint(path: str, *, model_fp: str) -> dict:
     fingerprint."""
     import numpy as np
 
-    with open(path, "rb") as f:
-        with np.load(io.BytesIO(f.read())) as z:
+    payload, _ = sealed_read(path, kind="compile_checkpoint")
+    try:
+        with np.load(io.BytesIO(payload)) as z:
             st = {k: z[k] for k in z.files}
+    except Exception as e:
+        raise reject_undecodable(path, kind="compile_checkpoint",
+                                 err=e) from e
     got = str(st.pop("model_fp"))
     if got != model_fp:
         raise ValueError(f"compile checkpoint {path} is for model "

@@ -56,7 +56,7 @@ from contextlib import contextmanager
 from datetime import datetime, timezone
 from time import perf_counter as now  # noqa: F401 — re-exported
 
-SCHEMA_VERSION = 15
+SCHEMA_VERSION = 16
 TELEMETRY_ENV_VAR = "CPR_TELEMETRY"
 # flight-recorder ring capacity (v14): last N emitted events kept
 # in-process for the crash blackbox (cpr_tpu/monitor/blackbox.py)
@@ -195,6 +195,23 @@ EVENT_FIELDS = {
     # The perf ledger lifts these into lower-is-better
     # `<scope>_peak_bytes` rows (iter_trace_rows).
     "memory": ("scope", "peak_bytes", "source"),
+    # v16: one per artifact-integrity decision (cpr_tpu/integrity.py):
+    # artifact is the on-disk path judged, artifact_kind the family —
+    # named so because `kind` is the envelope discriminator ("event")
+    # and a payload field would shadow it —
+    # (train_snapshot, policy_snapshot, vi_checkpoint,
+    # grid_vi_checkpoint, compile_checkpoint, mdp_grid_cache,
+    # attack_cache, break_even_cache, ledger_row, archive_record),
+    # reason why the bytes were rejected — checksum (seal digest
+    # mismatch), truncated (short read / torn or unparseable frame),
+    # version (sealed with a newer schema than this build reads),
+    # sidecar_missing (payload present but its meta sidecar is gone or
+    # contradicts it) — and action what the consumer did about it:
+    # quarantined (moved to <path>.quarantine/, state untouched),
+    # regenerated (treated as a cache miss and recomputed), refused
+    # (load aborted loudly — serving a half-written artifact is worse
+    # than crashing).  Extras ride free-form: quarantine path, detail.
+    "integrity": ("artifact", "artifact_kind", "reason", "action"),
 }
 
 

@@ -11,6 +11,7 @@ parameter files.
 
 from __future__ import annotations
 
+import hashlib
 import json
 import math
 import os
@@ -178,22 +179,35 @@ def evaluate_per_alpha(env, cfg: TrainConfig, net_params, *,
     return rows
 
 
-def save_checkpoint(path: str, net_params, meta: dict | None = None):
-    """Atomic params checkpoint: tmp + fsync + os.replace, so
-    best-model.msgpack can never be observed half-written.  The meta
-    sidecar lands BEFORE the model rename: a reader that sees the new
-    model always sees meta at least as new."""
+def save_checkpoint(path: str, net_params, meta: dict | None = None,
+                    *, site: str = "checkpoint"):
+    """Sealed atomic params checkpoint (tmp + fsync + os.replace +
+    checksummed envelope), so best-model.msgpack can never be observed
+    half-written OR half-true.  The meta sidecar lands BEFORE the
+    model rename — a reader that sees the new model always sees meta
+    at least as new — and carries the payload's sha256 so
+    `load_policy_snapshot` can prove the pair belongs together."""
+    data = serialization.to_bytes(net_params)
     if meta is not None:
+        meta = dict(meta, payload_sha256=hashlib.sha256(data).hexdigest())
         resilience.atomic_write_json(path + ".json", meta)
-    resilience.atomic_write_bytes(path, serialization.to_bytes(net_params))
+    resilience.sealed_write(path, data, site=site)
 
 
 def load_checkpoint(path: str, env, cfg: TrainConfig):
     net = ActorCritic(env.n_actions, ppo_config(cfg).hidden)
     template = net.init(jax.random.PRNGKey(0),
                         jnp.zeros((1, env.observation_length)))
-    with open(path, "rb") as f:
-        return serialization.from_bytes(template, f.read())
+    payload, _ = resilience.sealed_read(path, kind="model_checkpoint",
+                                        action="refused")
+    try:
+        return serialization.from_bytes(template, payload)
+    except resilience.IntegrityError:
+        raise
+    except Exception as e:  # msgpack raises its own hierarchy
+        raise resilience.reject_undecodable(
+            path, kind="model_checkpoint", err=e,
+            action="refused") from e
 
 
 def serving_meta(env, cfg: TrainConfig) -> dict:
@@ -221,7 +235,7 @@ def export_policy_snapshot(path: str, net_params, *, protocol: str,
     meta = dict(protocol=protocol, n_actions=int(n_actions),
                 observation_length=int(observation_length),
                 hidden=[int(h) for h in hidden], **extra)
-    save_checkpoint(path, net_params, meta)
+    save_checkpoint(path, net_params, meta, site="snapshot")
     return meta
 
 
@@ -229,9 +243,30 @@ def load_policy_snapshot(path: str):
     """Reconstruct a jittable greedy policy `obs -> action` from a
     serving snapshot — the `.json` meta sidecar alone defines the net
     shape, so no TrainConfig or env instance is required.  Returns
-    (policy, meta)."""
-    with open(path + ".json") as f:
-        meta = json.load(f)
+    (policy, meta).
+
+    Refuses loudly (typed IntegrityError, never a KeyError or a
+    silently wrong net) when the sidecar is missing, the sidecar's
+    payload fingerprint contradicts the msgpack on disk, or the sealed
+    payload fails its checksum — serving a half-written or mismatched
+    policy is worse than crashing."""
+    from cpr_tpu.integrity import IntegrityError, integrity_event
+
+    sidecar = path + ".json"
+    try:
+        with open(sidecar) as f:
+            meta = json.load(f)
+    except (OSError, ValueError) as exc:
+        integrity_event(artifact=path, kind="policy_snapshot",
+                        reason="sidecar_missing", action="refused",
+                        detail=str(exc))
+        raise IntegrityError(
+            f"policy snapshot {path}: meta sidecar {sidecar} is "
+            f"missing or unreadable ({exc}) — re-export with "
+            f"export_policy_snapshot; the msgpack alone does not "
+            f"define the net shape",
+            artifact=path, kind="policy_snapshot",
+            reason="sidecar_missing") from None
     missing = [k for k in ("n_actions", "observation_length", "hidden")
                if k not in meta]
     if missing:
@@ -239,13 +274,36 @@ def load_policy_snapshot(path: str):
             f"{path}.json is not a serving snapshot: missing {missing} "
             f"(write checkpoints with export_policy_snapshot or a "
             f"train_from_config recent enough to embed serving_meta)")
+    payload, tag = resilience.sealed_read(path, kind="policy_snapshot",
+                                          action="refused")
+    expected = meta.get("payload_sha256")
+    if expected is not None:
+        found = hashlib.sha256(payload).hexdigest()
+        if found != expected:
+            integrity_event(artifact=path, kind="policy_snapshot",
+                            reason="sidecar_missing", action="refused",
+                            detail="sidecar fingerprint mismatch")
+            raise IntegrityError(
+                f"policy snapshot {path}: meta sidecar {sidecar} "
+                f"expects payload sha256 {expected[:12]}…, file on "
+                f"disk hashes to {found[:12]}… — the pair is torn "
+                f"(stale sidecar or swapped msgpack); re-export both",
+                artifact=path, kind="policy_snapshot",
+                reason="sidecar_missing")
+    meta = dict(meta, integrity=tag)
     net = ActorCritic(int(meta["n_actions"]),
                       tuple(int(h) for h in meta["hidden"]))
     template = net.init(
         jax.random.PRNGKey(0),
         jnp.zeros((1, int(meta["observation_length"]))))
-    with open(path, "rb") as f:
-        params = serialization.from_bytes(template, f.read())
+    try:
+        params = serialization.from_bytes(template, payload)
+    except IntegrityError:
+        raise
+    except Exception as e:  # garbled pre-seal payload, no fingerprint
+        raise resilience.reject_undecodable(
+            path, kind="policy_snapshot", err=e,
+            action="refused") from e
 
     def policy(obs):
         logits, _ = net.apply(params, obs)
@@ -410,18 +468,29 @@ def train_from_config(cfg: TrainConfig, *, out_dir: str | None = None,
                 raise ValueError(
                     f"snapshot {snap_path} was written by config {fp}, "
                     f"this run is {snap_config}")
-        carry, best_params, snap_meta = resilience.load_train_snapshot(
-            snap_path, carry)
-        best = snap_meta["best"] if snap_meta["has_best"] else -np.inf
-        start_update = snap_meta["update"]
-        if mesh is not None:
+        try:
+            carry, best_params, snap_meta = (
+                resilience.load_train_snapshot(snap_path, carry))
+        except resilience.IntegrityError:
+            # detect -> quarantine -> recover: sealed_read already
+            # moved the damaged snapshot to <path>.quarantine/ and
+            # emitted the typed `integrity` event; training falls back
+            # to a cold start, which is bit-identical to never having
+            # snapshotted (the resilience acceptance criterion) — the
+            # corrupt bytes were never deserialized into the carry
+            snap_meta = None
+        if snap_meta is not None:
+            best = snap_meta["best"] if snap_meta["has_best"] else -np.inf
+            start_update = snap_meta["update"]
+        if snap_meta is not None and mesh is not None:
             from cpr_tpu.parallel import shard_envs
             ts, env_state, obs, key = carry
             env_state = shard_envs(mesh, env_state, "dp")
             obs = shard_envs(mesh, obs, "dp")
             carry = (ts, env_state, obs, key)
         last_snap[1] = start_update  # the restored snapshot's coverage
-        tele.event("resume", path=snap_path, update=start_update)
+        if snap_meta is not None:
+            tele.event("resume", path=snap_path, update=start_update)
     if device_metrics.enabled():
         # XLA's own estimate of one update (flops, bytes) into the run
         # manifest; costs one extra compile, so it rides the same

@@ -29,3 +29,8 @@ def report(tele, fn_name, tid):
     # finding: missing source (v15 memory — a watermark is only
     # comparable when it says what was sampled: device stats or rss)
     tele.event("memory", scope="serve", peak_bytes=1 << 28)
+    # finding: missing reason, action (v16 integrity — a corruption
+    # report that doesn't say WHY the bytes were rejected or WHAT the
+    # consumer did about it is unactionable)
+    tele.event("integrity", artifact="/tmp/ckpt.npz",
+               artifact_kind="vi_checkpoint")

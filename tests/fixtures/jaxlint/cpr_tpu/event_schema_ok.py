@@ -31,3 +31,7 @@ def report(tele, fn_name, dt, err, extra, tid):
     tele.event("memory", scope="serve", peak_bytes=1 << 28,
                source="rss", in_use_bytes=1 << 27,
                n_samples=12)  # extras ride free-form
+    tele.event("integrity", artifact="/tmp/ckpt.npz",
+               artifact_kind="vi_checkpoint", reason="checksum",
+               action="quarantined",
+               quarantine="/tmp/q")  # extras ride free-form

@@ -17,4 +17,6 @@ EVENT_FIELDS = {
     "perf_gate": ("metric", "backend", "verdict", "value", "baseline",
                   "run", "baseline_runs"),
     "memory": ("scope", "peak_bytes", "source"),
+    "integrity": ("artifact", "artifact_kind", "reason",
+                      "action"),
 }

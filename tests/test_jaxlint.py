@@ -84,6 +84,8 @@ def test_event_fields_resolved_cross_module_by_ast():
         "perf_gate": ("metric", "backend", "verdict", "value",
                       "baseline", "run", "baseline_runs"),
         "memory": ("scope", "peak_bytes", "source"),
+        "integrity": ("artifact", "artifact_kind", "reason",
+                      "action"),
     }
 
 

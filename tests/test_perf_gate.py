@@ -68,6 +68,9 @@ def test_ledger_append_only_and_idempotent(tmp_path):
     with open(led.path) as f:
         before = f.read()
     # a foreign line (hand-edit, older writer) survives appends verbatim
+    # in the FILE — but its row_id does not match its content, so
+    # verify-on-read (v16) skips it with a typed `integrity` event and
+    # it can never become a gate baseline
     alien = json.dumps({"ledger": 1, "row_id": "feedc0ffee00",
                         "metric": "hand_added"})
     with open(led.path, "a") as f:
@@ -77,7 +80,9 @@ def test_ledger_append_only_and_idempotent(tmp_path):
         after = f.read()
     assert after.startswith(before.rstrip("\n") + "\n")
     assert alien in after
-    assert len(led.records()) == 5
+    recs = led.records()
+    assert len(recs) == 4
+    assert all(r["metric"] != "hand_added" for r in recs)
 
 
 def test_ingest_banks_idempotent(tmp_path):

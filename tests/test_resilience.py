@@ -11,6 +11,7 @@ pytest process.
 """
 
 import gc
+import hashlib
 import json
 import os
 import signal
@@ -189,10 +190,16 @@ def test_save_checkpoint_round_trips_params_and_meta(tmp_path):
     path = str(tmp_path / "model.msgpack")
     params = {"w": np.arange(4.0, dtype=np.float32)}
     save_checkpoint(path, params, meta=dict(update=3, score=0.5))
-    assert json.load(open(path + ".json")) == {"update": 3, "score": 0.5}
-    with open(path, "rb") as f:
-        restored = serialization.from_bytes(
-            {"w": np.zeros(4, np.float32)}, f.read())
+    meta = json.load(open(path + ".json"))
+    # v16: the sidecar gains the sealed payload's fingerprint so
+    # load_policy_snapshot can prove the msgpack/meta pair is untorn
+    sha = meta.pop("payload_sha256")
+    assert meta == {"update": 3, "score": 0.5}
+    payload, tag = resilience.sealed_read(path, kind="model_checkpoint")
+    assert tag == "verified"
+    assert hashlib.sha256(payload).hexdigest() == sha
+    restored = serialization.from_bytes(
+        {"w": np.zeros(4, np.float32)}, payload)
     np.testing.assert_array_equal(restored["w"], params["w"])
 
 
@@ -480,6 +487,35 @@ def test_kill_and_resume_bit_identical_history(tmp_path, monkeypatch,
     assert fp_a == fp_b
     ups = [r["update"] for r in fp_b if "eval" not in r]
     assert ups == [1, 2, 3, 4]  # no duplicates after the trim
+
+
+def test_resume_past_corrupt_snapshot_cold_starts_bit_identical(
+        tmp_path, monkeypatch, fake_eval):
+    """v16 recovery policy for the training loop: a bit-flipped
+    snapshot is quarantined and resume falls back to a cold start —
+    whose full metrics history equals an uninterrupted run's, because
+    the corrupt bytes were never deserialized into the carry."""
+    from cpr_tpu import integrity
+    from cpr_tpu.train import driver as drv
+
+    a, b = tmp_path / "a", tmp_path / "b"
+    cfg = _tiny_cfg()
+    drv.train_from_config(cfg, out_dir=str(a), snapshot_freq=2)
+
+    monkeypatch.setenv(resilience.FAULT_ENV_VAR, "kill@update=4")
+    with pytest.raises(InjectedKill):
+        drv.train_from_config(cfg, out_dir=str(b), snapshot_freq=2)
+    monkeypatch.delenv(resilience.FAULT_ENV_VAR)
+    snap = str(b / "snapshot.msgpack")
+    integrity.damage_artifact(snap, "corrupt")
+
+    _, hist, _ = drv.train_from_config(
+        cfg, out_dir=str(b), snapshot_freq=2, resume=True)
+    assert len(hist) == 4  # the resumed segment IS the whole run
+    assert os.listdir(integrity.quarantine_dir(snap))
+    fp_a = resilience.metrics_fingerprint(str(a / "metrics.jsonl"))
+    fp_b = resilience.metrics_fingerprint(str(b / "metrics.jsonl"))
+    assert fp_a == fp_b
 
 
 def test_resume_rejects_config_mismatch(tmp_path, fake_eval):

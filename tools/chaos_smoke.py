@@ -1,0 +1,377 @@
+"""Randomized chaos campaign (`make chaos-smoke`).
+
+Where fleet_smoke pins ONE fault script, this campaign derives every
+fault from a seed: `cpr_tpu.integrity.ChaosSchedule` composes
+randomized sequences of kills, cooperative slowdowns, and artifact
+corruption (bit flip / truncation / JSON garbling), and the same seed
+replays the exact same campaign — a failure here is a repro command,
+not a flake.  Per seed (default two distinct seeds):
+
+  1  schedule replay: constructing the schedule twice from the seed
+     must yield the identical description (logged, so the repro is in
+     the artifact);
+  2  fleet leg: router + 2 replicas launched with the schedule's
+     randomized replica fault spec (kill + optional slowdown,
+     randomized target) under a 16-client `episode.run` flood — zero
+     client hangs, every reply bit-identical to an in-process
+     `env.rollout` of its seed, the killed replica warm-restarts, and
+     the fleet drains cleanly;
+  3  solve leg, CONCURRENT with the flood: a chunked VI solve whose
+     n-th checkpoint write is damaged (randomized action) and whose
+     next chunk is killed.  Resume finds the corrupt checkpoint,
+     quarantines it (typed v16 `integrity` event), falls back to a
+     cold start, and must land byte-identical to an uninterrupted
+     reference solve;
+  4  cache leg: the mdp-grid solve cache entry is damaged by the
+     schedule's action on its first write; the next call must treat it
+     as a miss (quarantine + recompute, never a crash), and the call
+     after that must be a verified hit with bit-equal revenue;
+  5  accounting: every injected artifact corruption is matched 1:1 by
+     a typed `integrity` event on the same path — no silent damage, no
+     phantom reports — and the merged client+router+replica+chaos
+     stream validates via `trace_summary --validate --expect
+     route,serve,request,integrity`;
+  6  ledger leg: the fleet traces bank into a perf ledger,
+     `perf_report --gate` runs clean, a hand-tampered row is appended
+     (plausible content, stale content hash), and the gate verdicts
+     must be unchanged — the corrupt row is skipped with a typed
+     `integrity` event instead of poisoning a baseline.
+
+Usage: python tools/chaos_smoke.py [workdir [seed ...]]
+       (defaults: /tmp/cpr-chaos-smoke, seeds 11 and 23)
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+ROOT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+sys.path.insert(0, ROOT)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+import fleet_smoke  # noqa: E402  (reuses its router/flood/trace helpers)
+from cpr_tpu import resilience, telemetry  # noqa: E402
+from cpr_tpu.integrity import (  # noqa: E402
+    ARTIFACT_ACTIONS, ChaosSchedule, quarantine_dir)
+from cpr_tpu.serve.protocol import ServeClient  # noqa: E402
+
+SEEDS = (11, 23)
+N_FLOOD = 16
+PERF_REPORT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "perf_report.py")
+
+
+def _log(msg):
+    print(f"chaos-smoke: {msg}", file=sys.stderr)
+
+
+# -- solve leg: kill + corrupt + resume --------------------------------------
+
+
+def _contraction_step(value, prog, steps):
+    """chunk_step contract stand-in: `steps` Jacobi sweeps of the map
+    v <- (v + 1) / 2 (fixpoint 1) — cheap, deterministic, and chunked
+    exactly like a real VI solve, so the checkpoint/resume seam under
+    test is the production one."""
+    import jax.numpy as jnp
+
+    deltas = []
+    v = jnp.asarray(value)
+    for _ in range(steps):
+        nv = (v + 1.0) / 2.0
+        deltas.append(jnp.max(jnp.abs(nv - v)))
+        v = nv
+    return v, prog, jnp.zeros_like(v, jnp.int32), jnp.stack(deltas)
+
+
+def _run_vi(checkpoint_path=None):
+    from cpr_tpu.mdp.explicit import run_chunk_driver
+
+    return run_chunk_driver(_contraction_step, 8, np.float32, 1e-4, 64,
+                            chunk=4, checkpoint_path=checkpoint_path)
+
+
+def _solve_leg(seed_dir, sched):
+    """Damage checkpoint write k, kill chunk k+1, resume: the corrupt
+    checkpoint must quarantine and the cold-started resume must equal
+    the uninterrupted reference byte for byte."""
+    ref_value, _, _, _, ref_it, ref_resid = _run_vi()
+    ck = os.path.join(seed_dir, "vi-ck.npz")
+    spec = sched.solve_specs()
+    os.environ[resilience.FAULT_ENV_VAR] = spec
+    try:
+        try:
+            _run_vi(ck)
+        except resilience.InjectedKill:
+            pass
+        else:
+            raise SystemExit(f"solve leg: armed kill in {spec!r} never "
+                             f"fired")
+    finally:
+        os.environ.pop(resilience.FAULT_ENV_VAR, None)
+    if not os.path.exists(ck):
+        raise SystemExit("solve leg: no checkpoint landed before the "
+                         "kill")
+    value, _, _, _, it, resid = _run_vi(ck)
+    if it != ref_it or not np.array_equal(np.asarray(value),
+                                          np.asarray(ref_value)) \
+            or not np.array_equal(resid, ref_resid):
+        raise SystemExit(
+            f"solve leg: resume past the corrupted checkpoint is NOT "
+            f"bit-identical to the uninterrupted solve "
+            f"(it {it} vs {ref_it})")
+    qdir = quarantine_dir(ck)
+    if not (os.path.isdir(qdir) and os.listdir(qdir)):
+        raise SystemExit(f"solve leg: damaged checkpoint was not "
+                         f"quarantined under {qdir}")
+    return spec
+
+
+# -- cache leg: corruption is a miss, never a crash --------------------------
+
+
+def _cache_leg(seed_dir, sched):
+    from cpr_tpu.mdp.grid import solve_grid_cached
+
+    os.environ["CPR_MDP_CACHE"] = os.path.join(seed_dir, "mdp-cache")
+    kw = dict(cutoff=4, alphas=(0.3,), gammas=(0.5,), horizon=20,
+              stop_delta=1e-4)
+    action = sched.cache_action()
+    os.environ[resilience.FAULT_ENV_VAR] = f"{action}@cache=1"
+    try:
+        first = solve_grid_cached("fc16", **kw)  # miss; write damaged
+    finally:
+        os.environ.pop(resilience.FAULT_ENV_VAR, None)
+    if first["cached"] is not False:
+        raise SystemExit("cache leg: cold call claimed a cache hit")
+    second = solve_grid_cached("fc16", **kw)
+    if second["cached"] is not False:
+        raise SystemExit(f"cache leg: {action}-damaged entry was "
+                         f"served as a hit instead of regenerated")
+    third = solve_grid_cached("fc16", **kw)
+    if not (third["cached"] is True
+            and third.get("integrity") == "verified"
+            and third["revenue"] == second["revenue"]
+            and second["revenue"] == first["revenue"]):
+        raise SystemExit(f"cache leg: post-recovery hit is not a "
+                         f"verified bit-equal entry: {third}")
+    return action
+
+
+# -- corruption accounting ---------------------------------------------------
+
+
+def _match_corruptions(trace, label):
+    """Every injected artifact damage must produce exactly one typed
+    `integrity` event on the same path, and every `integrity` event
+    must trace back to an injection — no silent damage, no phantom
+    reports."""
+    injected, reported = [], []
+    with open(trace) as f:
+        for line in f:
+            try:
+                e = json.loads(line)
+            except ValueError:
+                continue
+            if e.get("kind") != "event":
+                continue
+            if e.get("name") == "fault_injected" and \
+                    e.get("spec", "").split("@")[0] in ARTIFACT_ACTIONS:
+                injected.append(e["artifact"])
+            elif e.get("name") == "integrity":
+                reported.append(e["artifact"])
+    if sorted(injected) != sorted(reported):
+        raise SystemExit(
+            f"{label}: injected corruptions and integrity events do "
+            f"not match 1:1 — injected {sorted(injected)}, reported "
+            f"{sorted(reported)}")
+    if not injected:
+        raise SystemExit(f"{label}: campaign injected no artifact "
+                         f"corruption at all")
+    return len(injected)
+
+
+# -- ledger leg: a tampered row cannot poison the gate -----------------------
+
+
+def _gate_lines(ledger_path, tele=None):
+    env = dict(os.environ)
+    env.pop(resilience.FAULT_ENV_VAR, None)
+    if tele:
+        env["CPR_TELEMETRY"] = tele
+    else:
+        env.pop("CPR_TELEMETRY", None)
+    r = subprocess.run(
+        [sys.executable, PERF_REPORT, ledger_path, "--gate"],
+        capture_output=True, text=True, env=env, cwd=ROOT)
+    # rc=1 is a legitimate FAIL verdict (a chaos-killed replica banks
+    # zero-valued drain rows); only a crash is a smoke failure.  What
+    # the leg asserts is that the verdicts — rc included — are
+    # IDENTICAL before and after the tamper.
+    if r.returncode not in (0, 1):
+        raise SystemExit(f"perf_report --gate crashed rc={r.returncode}"
+                         f"\n{r.stdout}{r.stderr}")
+    lines = [ln for ln in r.stdout.splitlines()
+             if ln.startswith("gate:")]
+    if not lines:
+        raise SystemExit(f"perf_report --gate produced no gate "
+                         f"verdicts\n{r.stdout}")
+    return [f"rc={r.returncode}"] + lines
+
+
+def _ledger_leg(seed_dir, traces):
+    from cpr_tpu.perf.ledger import Ledger
+
+    ledger_path = os.path.join(seed_dir, "perf_ledger.jsonl")
+    n = sum(Ledger(ledger_path).ingest_trace(p) for p in traces)
+    if not n:
+        raise SystemExit("ledger leg: fleet traces banked no rows")
+    clean = _gate_lines(ledger_path)
+
+    # hand-tamper: append a copy of a banked row with an inflated
+    # value but the ORIGINAL row_id — plausible JSON whose content
+    # hash no longer matches.  If records() trusted it, the gate
+    # verdicts would shift; the integrity plane must skip it instead.
+    with open(ledger_path) as f:
+        rows = [json.loads(ln) for ln in f if ln.strip()]
+    mutant = dict(rows[-1])
+    mutant["value"] = float(mutant.get("value", 1.0) or 1.0) * 1000 + 1
+    with open(ledger_path, "a") as f:
+        f.write(json.dumps(mutant, sort_keys=True) + "\n")
+
+    tele = os.path.join(seed_dir, "ledger-tele.jsonl")
+    corrupted = _gate_lines(ledger_path, tele=tele)
+    if corrupted != clean:
+        raise SystemExit(
+            f"ledger leg: tampered row CHANGED the gate verdicts:\n"
+            f"clean:     {clean}\ncorrupted: {corrupted}")
+    events = [e for e in fleet_smoke._events(tele, "integrity")
+              if e.get("artifact_kind") == "ledger_row"
+              and e.get("reason") == "checksum"]
+    if not events:
+        raise SystemExit("ledger leg: skipped tampered row emitted no "
+                         "typed integrity event")
+    return len(clean)
+
+
+# -- the per-seed campaign ---------------------------------------------------
+
+
+def _campaign(seed, work):
+    seed_dir = os.path.join(work, f"seed{seed}")
+    os.makedirs(seed_dir, exist_ok=True)
+    sched = ChaosSchedule(seed, rounds=1,
+                          replicas=fleet_smoke.REPLICAS)
+    replay = ChaosSchedule(seed, rounds=1,
+                           replicas=fleet_smoke.REPLICAS)
+    if replay.describe() != sched.describe():
+        raise SystemExit(f"seed {seed}: schedule is not replayable "
+                         f"from its seed")
+    _log(f"seed {seed}: schedule {json.dumps(sched.describe())}")
+
+    trace = os.path.join(seed_dir, "chaos.jsonl")
+    router_trace = os.path.join(seed_dir, "router.jsonl")
+    replica_traces = [
+        os.path.join(seed_dir, f"router.replica{i}.jsonl")
+        for i in range(fleet_smoke.REPLICAS)]
+    for p in [trace, router_trace, *replica_traces]:
+        if os.path.exists(p):
+            os.remove(p)
+    telemetry.configure(trace)
+    telemetry.current().manifest(
+        dict(role="chaos-smoke", schedule=sched.describe()))
+
+    fleet_spec = sched.fleet_specs()[0]
+    env = fleet_smoke._router_env(seed_dir, router_trace)
+    env["CPR_FAULT_INJECT"] = fleet_spec
+    log_path = os.path.join(seed_dir, "router.log")
+    # jaxlint: disable-next-line=raw-write — live Popen log handle
+    with open(log_path, "w") as log:
+        proc = subprocess.Popen(
+            fleet_smoke._router_cmd(seed_dir), env=env,
+            cwd=fleet_smoke.ROOT, stdout=log,
+            stderr=subprocess.STDOUT)
+    try:
+        ready = fleet_smoke._wait_ready(
+            os.path.join(seed_dir, "router.json"), proc, log_path)
+        port = ready["port"]
+        _log(f"seed {seed}: fleet up on port {port} with "
+             f"{fleet_spec!r} armed")
+
+        sleeps, lock = [], threading.Lock()
+        base = 40000 + 1000 * seed
+        with ThreadPoolExecutor(max_workers=N_FLOOD) as pool:
+            jobs = [pool.submit(fleet_smoke._flood_worker, port,
+                                base + i, sleeps, lock)
+                    for i in range(N_FLOOD)]
+            # the solve leg runs WHILE the flood is in flight — the
+            # in-process fault env never reaches the router subprocess
+            solve_spec = _solve_leg(seed_dir, sched)
+            _log(f"seed {seed}: solve leg {solve_spec!r} — corrupt "
+                 f"checkpoint quarantined, resume bit-identical")
+            deadline = time.time() + fleet_smoke.FLOOD_TIMEOUT_S
+            replies = [
+                j.result(timeout=max(1.0, deadline - time.time()))
+                for j in jobs]  # a timeout here IS a client hang
+        fleet_smoke._check_episodes(replies, f"seed {seed} flood")
+
+        fleet_smoke._wait_replica_back(port)
+        _log(f"seed {seed}: killed replica warm-restarted and "
+             f"rejoined")
+        with ServeClient("127.0.0.1", port) as c:
+            r = c.request("drain")
+            assert r.get("ok") and r.get("draining"), r
+        rc = proc.wait(timeout=300.0)
+        if rc != 0:
+            tail = open(log_path).read()[-4000:]
+            raise SystemExit(f"router exited rc={rc} after drain\n"
+                             f"{tail}")
+    except BaseException:
+        if proc.poll() is None:
+            proc.kill()
+        raise
+
+    cache_action = _cache_leg(seed_dir, sched)
+    _log(f"seed {seed}: cache leg {cache_action!r} — corrupt entry "
+         f"regenerated, clean hit verified")
+
+    telemetry.configure(None)  # close the sink before reading it
+    n_corruptions = _match_corruptions(trace, f"seed {seed}")
+    merged = fleet_smoke._merge_streams(
+        seed_dir, [router_trace, *replica_traces, trace])
+    fleet_smoke._validate_stream(
+        merged, expect="route,serve,request,integrity")
+    n_gates = _ledger_leg(seed_dir,
+                          [*replica_traces, router_trace])
+    _log(f"seed {seed}: {n_corruptions} injected corruptions matched "
+         f"1:1 by integrity events; {n_gates} gate verdicts immune to "
+         f"the tampered ledger row")
+    return len(replies), n_corruptions, n_gates
+
+
+def main():
+    work = sys.argv[1] if len(sys.argv) > 1 else "/tmp/cpr-chaos-smoke"
+    seeds = ([int(s) for s in sys.argv[2:]] if len(sys.argv) > 2
+             else list(SEEDS))
+    if len(seeds) < 2:
+        raise SystemExit("chaos-smoke needs >= 2 distinct seeds")
+    os.makedirs(work, exist_ok=True)
+    totals = [_campaign(seed, work) for seed in seeds]
+    n_eps = sum(t[0] for t in totals)
+    n_corr = sum(t[1] for t in totals)
+    print(f"chaos-smoke: PASS (seeds {seeds}: {n_eps} bit-identical "
+          f"episodes through randomized replica faults, {n_corr} "
+          f"injected corruptions quarantined + matched 1:1, "
+          f"kill+corrupt VI resumes bit-identical, corrupt cache "
+          f"entries regenerated, tampered ledger rows gate-inert)")
+
+
+if __name__ == "__main__":
+    main()
