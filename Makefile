@@ -4,7 +4,7 @@
 
 CPU_MESH = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: lint test test-slow bench perf-gate telemetry-smoke netsim-smoke resilience-smoke supervisor-smoke obs-smoke serve-smoke fleet-smoke chaos-smoke multichip-smoke mdp-smoke vi-smoke compile-smoke attack-smoke dryrun sweeps ghostdag train-dummy native asan
+.PHONY: lint test test-slow bench perf-gate telemetry-smoke netsim-smoke resilience-smoke supervisor-smoke obs-smoke serve-smoke learn-smoke fleet-smoke chaos-smoke multichip-smoke mdp-smoke vi-smoke compile-smoke attack-smoke dryrun sweeps ghostdag train-dummy native asan
 
 lint:  ## jaxlint over cpr_tpu/ + tools/ (pure AST, no JAX import,
 	## ~1s); banks the JSON report under runs/ like the smoke flows
@@ -111,6 +111,23 @@ serve-smoke:  ## continuous-batching service proof: supervised server
 	## the perf ledger.  Details: docs/SERVING.md
 	rm -rf $(SERVE_SMOKE_DIR)
 	python tools/serve_smoke.py $(SERVE_SMOKE_DIR)
+
+LEARN_SMOKE_DIR = /tmp/cpr-learn-smoke
+
+learn-smoke:  ## always-on-learning proof: supervised learner + serve
+	## children wired into the closed sampler/learner loop — the
+	## learner's untrained seq-0 snapshot serves first, fleet lanes
+	## record experience into device rings and feed it over the wire,
+	## PPO updates publish sealed snapshots, and the server hot-swaps
+	## them zero-drain at burst boundaries; under client flood the mean
+	## greedy relative_reward must measurably improve across >= 2
+	## published swaps, hot-swap bit-determinism is asserted on
+	## scripted lanes, both traces (+ their merge) validate with v17
+	## `learn` events, and learn_samples_per_sec +
+	## learn_snapshot_staleness_s rows are banked + gated.
+	## Details: docs/LEARNING.md
+	rm -rf $(LEARN_SMOKE_DIR)
+	python tools/learn_smoke.py $(LEARN_SMOKE_DIR)
 
 FLEET_SMOKE_DIR = /tmp/cpr-fleet-smoke
 

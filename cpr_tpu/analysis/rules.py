@@ -37,8 +37,11 @@ HOT_CARRY_PATHS = (
 # ...and every module under parallel/ — notably the sharded resident
 # lane stepper (parallel/lanes.py): its mesh-sharded carries are
 # n_devices times the single-device footprint, so an undonated carry
-# there wastes memory on every chip at once
-HOT_CARRY_PREFIXES = ("cpr_tpu/parallel/",)
+# there wastes memory on every chip at once — and under learn/: the
+# experience rings ride the serve burst carry ([L, C, ...] per field),
+# so an undonated buffer doubles the recording plane's footprint on
+# every drain cycle
+HOT_CARRY_PREFIXES = ("cpr_tpu/parallel/", "cpr_tpu/learn/")
 
 _LOOPS = (ast.For, ast.AsyncFor, ast.While)
 _COMPREHENSIONS = (ast.ListComp, ast.SetComp, ast.DictComp,

@@ -1,0 +1,45 @@
+"""cpr_tpu.learn — always-on learning over the serve fleet.
+
+The subsystem that closes the serve→train loop (ROADMAP item 2, after
+arXiv:1803.02811's sampler/learner decoupling): the resident serve
+lanes double as the sampler, recording transitions into device-side
+ring buffers alongside the burst scan (`buffer`), a feeder thread
+ships consolidated windows over the wire protocol to a separate
+learner process (`feed`), the learner runs the PPO update phase of
+train/ppo.py on the fed experience and publishes sealed snapshots
+(`learner`), and the server hot-swaps the serving weights at the next
+burst boundary without draining a single session
+(serve/engine.py `swap_policy`).  docs/LEARNING.md is the contract.
+
+Everything the loop does travels as ONE typed telemetry event family
+(`learn`, schema v17) so the whole sampler→feed→update→publish→swap
+cycle can be read off a validated trace; `learn_event` below is the
+only emitter.
+"""
+
+from __future__ import annotations
+
+from cpr_tpu import telemetry
+
+# the five roles of the learning loop, in causal order
+ROLES = ("sample", "feed", "update", "publish", "swap")
+
+
+def learn_event(role: str, *, steps=None, batches=None,
+                fingerprint=None, staleness_s=None, **extra):
+    """Emit one typed v17 `learn` event (the only emitter — every leg
+    of the loop funnels through here so the smoke can match sampled
+    steps against fed, learned, and swapped ones 1:1 on the trace).
+
+    role         -- one of ROLES.
+    steps        -- env steps this leg moved (None when not step-shaped).
+    batches      -- consolidated windows/batches this leg moved.
+    fingerprint  -- snapshot payload_sha256 the leg acted under/on
+                    (None before the first publish).
+    staleness_s  -- age of the serving weights at this leg (swap: age
+                    of the weights being replaced), None where the
+                    emitting process cannot know it.
+    """
+    telemetry.current().event("learn", role=role, steps=steps,
+                              batches=batches, fingerprint=fingerprint,
+                              staleness_s=staleness_s, **extra)

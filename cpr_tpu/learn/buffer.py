@@ -1,0 +1,147 @@
+"""Device-side experience plane: per-lane ring buffers for the
+sampler half of the always-on learning loop.
+
+The buffers ride INSIDE the serve burst (serve/engine.py): `record` is
+inlined into the burst scan body, so transitions accumulate with the
+donated carry, in-graph, with no per-step host sync.  Two disciplines
+from the source material shape the layout:
+
+  * never pad to the slowest lane (arXiv:2406.01939): lanes are
+    heterogeneous — some idle, some mid-episode, some freshly
+    admitted — so each lane owns its ring and a write cursor, and a
+    step is recorded with one masked scatter: lanes that are not live
+    this step write to the out-of-range drop slot (`mode="drop"`), so
+    ragged episode boundaries and idle lanes cost nothing and never
+    block the batch;
+  * sampler/learner decoupling (arXiv:1803.02811): `consolidate` (host
+    side, one `device_get` per burst boundary) packs only lanes whose
+    window filled into a dense [K, capacity] batch for the feed —
+    partial lanes are counted, not padded.
+
+Key streams: each lane's action-sampling stream is derived with
+`fold_in` from the lane's admission key (`experience_stream`), so the
+sampler side can never alias the key sequence the legacy training
+rollout consumes via `split` — and the per-step key folds a monotone
+counter `t` that survives drains (the write cursor resets, `t` never
+does), so no step key is ever reused either.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# the fold_in stream tag separating sampler-side keys from every other
+# consumer of a lane key ("EXP"); train/ppo.py re-exports it as the
+# canonical name for the training side of the contract
+EXPERIENCE_STREAM = 0x455850
+
+# per-step ring fields, all lane-major [n_lanes, capacity, ...]
+FIELDS = ("obs", "action", "reward", "done", "era", "erd", "policy")
+
+
+def experience_stream(key):
+    """The sampler-side stream of a lane key: fold_in with the stream
+    tag, never `split` — a lane admitted with PRNGKey(S) spends its
+    own stream on env dynamics, so the action-sampling stream must be
+    a sibling derivation that cannot collide with it."""
+    return jax.random.fold_in(key, EXPERIENCE_STREAM)
+
+
+def init_buffer(keys, capacity: int, obs_dim: int) -> dict:
+    """Fresh rings: `keys` is the [n_lanes, ...] per-lane sampler key
+    block (already experience_stream-derived), `capacity` the ring
+    length in steps (the serve layer uses the burst length so a
+    drain-per-burst cadence yields dense full windows)."""
+    n_lanes = keys.shape[0]
+    cap = int(capacity)
+    return dict(
+        obs=jnp.zeros((n_lanes, cap, int(obs_dim)), jnp.float32),
+        action=jnp.zeros((n_lanes, cap), jnp.int32),
+        reward=jnp.zeros((n_lanes, cap), jnp.float32),
+        done=jnp.zeros((n_lanes, cap), bool),
+        # episode aggregates at the recorded step — what the learner's
+        # reward transform (relative_reward_on_done) needs at done rows
+        era=jnp.zeros((n_lanes, cap), jnp.float32),
+        erd=jnp.zeros((n_lanes, cap), jnp.float32),
+        policy=jnp.zeros((n_lanes, cap), jnp.int32),
+        cursor=jnp.zeros((n_lanes,), jnp.int32),
+        t=jnp.zeros((n_lanes,), jnp.int32),
+        key=keys,
+    )
+
+
+def step_keys(exp: dict):
+    """Per-lane action keys for this step: the lane stream folded by
+    its monotone step counter.  `t` never resets (unlike the drain-
+    reset write cursor), so a key is never reused across drains."""
+    return jax.vmap(jax.random.fold_in)(exp["key"], exp["t"])
+
+
+def record(exp: dict, live, obs, action, reward, done, info,
+           policy_ids) -> dict:
+    """Record one burst step for every live lane — one masked scatter
+    per field.  Non-live lanes target index `capacity`, which is out
+    of range and dropped (`mode="drop"`): the ragged-lane mask costs a
+    clamp, not a pad.  Runs inside the burst scan body; inputs are the
+    scan's own values, nothing is fetched from host."""
+    cap = exp["action"].shape[1]
+    lanes = jnp.arange(exp["cursor"].shape[0])
+    idx = jnp.where(live, exp["cursor"] % cap, cap)
+    live_i = live.astype(jnp.int32)
+
+    def put(buf, val):
+        return buf.at[lanes, idx].set(val, mode="drop")
+
+    return dict(
+        exp,
+        obs=put(exp["obs"], obs.astype(jnp.float32)),
+        action=put(exp["action"], action.astype(jnp.int32)),
+        reward=put(exp["reward"], reward.astype(jnp.float32)),
+        done=put(exp["done"], done),
+        era=put(exp["era"],
+                info["episode_reward_attacker"].astype(jnp.float32)),
+        erd=put(exp["erd"],
+                info["episode_reward_defender"].astype(jnp.float32)),
+        policy=put(exp["policy"], policy_ids),
+        cursor=exp["cursor"] + live_i,
+        t=exp["t"] + live_i,
+    )
+
+
+def consolidate(host: dict, last_obs: np.ndarray) -> dict:
+    """Pack host-fetched rings into a dense feed batch.
+
+    Only lanes whose window filled (cursor >= capacity) are packed; a
+    wrapped ring is unrolled oldest-first so each window is in time
+    order.  Partial lanes are DROPPED AND COUNTED (`partial`,
+    `dropped_steps`) — never padded to the slowest lane.  `last_obs`
+    is the [n_lanes, obs_dim] current lane observation (the carry's),
+    i.e. the bootstrap observation following each full window.
+
+    Returns {lanes, obs, action, reward, done, era, erd, policy,
+    last_obs, steps, partial, dropped_steps} with leading axis K =
+    number of full lanes (arrays empty when K == 0).
+    """
+    cursor = np.asarray(host["cursor"])
+    cap = host["action"].shape[1]
+    full = [int(lane) for lane in np.nonzero(cursor >= cap)[0]]
+    part = cursor[(cursor > 0) & (cursor < cap)]
+    out = {k: [] for k in FIELDS}
+    for lane in full:
+        order = (np.arange(cap) + cursor[lane]) % cap
+        for k in FIELDS:
+            out[k].append(np.asarray(host[k])[lane][order])
+    batch = {k: (np.stack(v) if v
+                 else np.zeros((0, cap) + np.asarray(host[k]).shape[2:],
+                               np.asarray(host[k]).dtype))
+             for k, v in out.items()}
+    batch["lanes"] = np.asarray(full, np.int32)
+    batch["last_obs"] = (np.asarray(last_obs)[full] if full
+                         else np.zeros((0,) + np.asarray(last_obs).shape[1:],
+                                       np.float32))
+    batch["steps"] = len(full) * cap
+    batch["partial"] = int(part.size)
+    batch["dropped_steps"] = int(part.sum())
+    return batch

@@ -1,0 +1,346 @@
+"""The learner half of the always-on loop: PPO over fed experience.
+
+`python -m cpr_tpu.learn.learner` runs a standalone process that
+
+  1. accepts `learn.feed` frames (serve/protocol.py framing) carrying
+     consolidated experience batches the serve fleet's sampler lanes
+     recorded (learn/buffer.py -> engine.drain_experience ->
+     feed.ExperienceFeeder);
+  2. pools the per-lane windows and, whenever cfg.n_envs full windows
+     are banked, runs one jitted PPO update
+     (train/ppo.py make_experience_update — the update phase of the
+     trainer, rollout half replaced by the fleet; the decoupled
+     sampler/learner shape of arXiv:1803.02811);
+  3. publishes serving snapshots every `--publish-every` updates via
+     the sealed checkpoint plumbing (driver.export_policy_snapshot:
+     msgpack + checksummed meta sidecar), then points an atomic
+     `latest.json` at the newest one — the file serve/server.py
+     watches to hot-swap without draining.
+
+The snapshot fingerprint is the sha256 of the serialized params —
+byte-identical to the sidecar's `payload_sha256` — so the learner's
+`publish` events, the server's `swap` events and heartbeats, and the
+engine's no-op-swap detection all correlate on one id.
+
+Updates run inline in the feed handler: the learner may stall its own
+socket during an update, but the serve tick loop never feels it — the
+feeder thread owns the wait and sheds batches drop-oldest.  Every
+batch is validated against the learner's fixed window length
+(cfg.n_steps), so one compiled update program serves the whole run.
+
+Lifecycle mirrors the serve child: supervisor heartbeat, ready-file
+with the bound port, SIGTERM via resilience.preemption_guard -> final
+publish + drain, exit 0.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import os
+from collections import deque
+from datetime import datetime, timezone
+
+import numpy as np
+
+from cpr_tpu import resilience, telemetry
+from cpr_tpu.learn import learn_event
+from cpr_tpu.learn.feed import decode_batch
+from cpr_tpu.serve import protocol as wire
+
+LATEST = "latest.json"
+
+
+def params_fingerprint(net_params) -> str:
+    """sha256 of the serialized params — identical to the snapshot
+    sidecar's `payload_sha256` for the same params, so fingerprints
+    compare across the learner, the wire, and the integrity plane."""
+    from flax import serialization
+
+    return hashlib.sha256(serialization.to_bytes(net_params)).hexdigest()
+
+
+# per-lane window fields pooled between updates ([C, ...] each)
+_WINDOW_FIELDS = ("obs", "action", "reward", "done", "era", "erd")
+
+
+class Learner:
+    """Pool fed experience windows, update PPO, publish snapshots."""
+
+    def __init__(self, env, cfg, *, protocol: str, publish_dir: str,
+                 publish_every: int = 1, seed: int = 0,
+                 reward_transform="relative"):
+        from cpr_tpu.train.ppo import (make_experience_update,
+                                       relative_reward_on_done)
+
+        self.env = env
+        self.cfg = cfg
+        self.protocol = protocol
+        self.publish_dir = publish_dir
+        self.publish_every = max(1, int(publish_every))
+        rt = relative_reward_on_done if reward_transform == "relative" \
+            else reward_transform
+        self.net, init_fn, self._update, self._mspec = \
+            make_experience_update(env.n_actions, env.observation_length,
+                                   cfg, reward_transform=rt)
+        import jax
+
+        init_key, self._key = jax.random.split(jax.random.PRNGKey(seed))
+        self.ts = init_fn(init_key)
+        # per-lane windows awaiting an update: each entry is a dict of
+        # [n_steps, ...] arrays plus its bootstrap last_obs [obs_dim]
+        self.pool: deque = deque()
+        self.batches = 0
+        self.samples = 0
+        self.updates = 0
+        self.publishes = 0
+        self.last_metrics: dict = {}
+        self.fingerprint = params_fingerprint(self.ts.params)
+        # update counter at the last publish: the drain-time final
+        # publish fires only when progress is stranded past it
+        self.published_at_update = -1
+
+    # -- feed -------------------------------------------------------------
+
+    def ingest(self, batch: dict) -> dict:
+        """Pool one consolidated batch; run every update it unlocks.
+        Returns the reply block for the feed acknowledgement."""
+        n_lanes = int(np.asarray(batch["lanes"]).shape[0])
+        if n_lanes:
+            window = int(np.asarray(batch["obs"]).shape[1])
+            if window != self.cfg.n_steps:
+                raise ValueError(
+                    f"fed window length {window} != learner n_steps "
+                    f"{self.cfg.n_steps}; align the serve burst with "
+                    f"the learner's --n-steps")
+        for i in range(n_lanes):
+            win = {f: np.asarray(batch[f])[i] for f in _WINDOW_FIELDS}
+            win["last_obs"] = np.asarray(batch["last_obs"])[i]
+            self.pool.append(win)
+        self.batches += 1
+        self.samples += int(batch.get("steps", 0))
+        updated = 0
+        while len(self.pool) >= self.cfg.n_envs:
+            self._update_once()
+            updated += 1
+        return dict(pool=len(self.pool), updates=self.updates,
+                    updated=updated, publishes=self.publishes,
+                    fingerprint=self.fingerprint)
+
+    def _update_once(self):
+        """One jitted PPO update over cfg.n_envs pooled windows,
+        stacked time-major ([T, N, ...]) so the compiled program's
+        shapes never change across the run."""
+        import jax.numpy as jnp
+
+        wins = [self.pool.popleft() for _ in range(self.cfg.n_envs)]
+        b = {f: jnp.asarray(np.stack([w[f] for w in wins], axis=1))
+             for f in _WINDOW_FIELDS}
+        b["last_obs"] = jnp.asarray(
+            np.stack([w["last_obs"] for w in wins], axis=0))
+        t0 = telemetry.now()
+        self.ts, self._key, metrics = self._update(self.ts, b, self._key)
+        self.updates += 1
+        self.fingerprint = params_fingerprint(self.ts.params)
+        self.last_metrics = {
+            k: float(v) for k, v in metrics.items()
+            if np.ndim(v) == 0 and k != "device"}
+        learn_event("update", steps=self.cfg.n_steps * self.cfg.n_envs,
+                    batches=1, fingerprint=self.fingerprint,
+                    staleness_s=None, update=self.updates,
+                    update_s=telemetry.now() - t0,
+                    pg_loss=self.last_metrics.get("pg_loss"))
+        if self.updates % self.publish_every == 0:
+            self.publish()
+
+    # -- publish ----------------------------------------------------------
+
+    def publish(self) -> dict:
+        """Export the current params as a sealed serving snapshot and
+        atomically repoint `latest.json` at it.  Readers (the serve
+        watch loop) always see either the previous pointer or the new
+        one — never a torn write, never a pointer to a half-written
+        snapshot (the snapshot lands first)."""
+        from cpr_tpu.train.driver import export_policy_snapshot
+
+        seq = self.publishes
+        path = os.path.join(self.publish_dir,
+                            f"snapshot-{seq:06d}.msgpack")
+        export_policy_snapshot(
+            path, self.ts.params, protocol=self.protocol,
+            n_actions=int(self.env.n_actions),
+            observation_length=int(self.env.observation_length),
+            hidden=list(self.cfg.hidden), seq=seq,
+            updates=self.updates, samples=self.samples)
+        resilience.atomic_write_json(
+            os.path.join(self.publish_dir, LATEST),
+            dict(seq=seq, path=path, fingerprint=self.fingerprint,
+                 updates=self.updates, samples=self.samples,
+                 time_utc=datetime.now(timezone.utc).isoformat(
+                     timespec="seconds")))
+        self.publishes += 1
+        self.published_at_update = self.updates
+        learn_event("publish", steps=self.samples, batches=self.batches,
+                    fingerprint=self.fingerprint, staleness_s=None,
+                    seq=seq, path=path, updates=self.updates)
+        return dict(seq=seq, path=path, fingerprint=self.fingerprint)
+
+    def stats(self) -> dict:
+        return dict(batches=self.batches, samples=self.samples,
+                    updates=self.updates, publishes=self.publishes,
+                    pool=len(self.pool), fingerprint=self.fingerprint,
+                    metrics=dict(self.last_metrics))
+
+
+class LearnerServer:
+    """TCP front-end: learn.feed / hello / stats / drain over the
+    serve wire protocol."""
+
+    def __init__(self, learner: Learner, *, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.learner = learner
+        self.host = host
+        self.port = port  # replaced by the bound port after start()
+        self._server = None
+        self._drain_reason = None
+
+    async def start(self):
+        self._server = await asyncio.start_server(
+            self._handle, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+
+    def request_drain(self, reason: str):
+        self._drain_reason = self._drain_reason or reason
+
+    async def serve_until_drained(self, poll_s: float = 0.05):
+        while True:
+            if resilience.preempt_requested():
+                self.request_drain(
+                    f"preempt:{resilience.preempt_reason()}")
+            if self._drain_reason is not None:
+                break
+            await asyncio.sleep(poll_s)
+        # final publish so a drain never strands unpublished progress
+        # (skipped when nothing changed since the last pointer move)
+        lr = self.learner
+        if lr.updates > lr.published_at_update:
+            lr.publish()
+        self._server.close()
+        await self._server.wait_closed()
+
+    async def _handle(self, reader, writer):
+        try:
+            while True:
+                req = await wire.read_frame(reader)
+                if req is None:
+                    break
+                try:
+                    resp = self._dispatch(req)
+                except Exception as e:  # noqa: BLE001 — per-request wall
+                    resp = dict(ok=False,
+                                error=f"{type(e).__name__}: {e}")
+                await wire.write_frame(writer, resp)
+        except (wire.ProtocolError, ConnectionError,
+                asyncio.CancelledError):
+            pass
+        finally:
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except (ConnectionError, OSError):
+                pass
+
+    def _dispatch(self, req: dict) -> dict:
+        op = req.get("op")
+        lr = self.learner
+        if op == "hello":
+            return dict(ok=True, role="learner",
+                        schema=telemetry.SCHEMA_VERSION,
+                        run=telemetry.run_id(),
+                        n_steps=lr.cfg.n_steps, n_envs=lr.cfg.n_envs,
+                        fingerprint=lr.fingerprint)
+        if op == "learn.feed":
+            if self._drain_reason is not None:
+                return dict(ok=False, error="draining", draining=True)
+            return dict(ok=True, **lr.ingest(decode_batch(req)))
+        if op == "stats":
+            return dict(ok=True, **lr.stats())
+        if op == "drain":
+            self.request_drain(str(req.get("reason", "client")))
+            return dict(ok=True, draining=True)
+        return dict(ok=False, error=f"unknown op {op!r}")
+
+
+# -- child entry point ----------------------------------------------------
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(
+        description="cpr_tpu learner child (see docs/LEARNING.md)")
+    p.add_argument("--protocol", default="nakamoto")
+    p.add_argument("--max-steps", type=int, default=256)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--publish-dir", required=True,
+                   help="snapshot directory; latest.json in here is "
+                        "the hot-swap pointer serve/server.py watches")
+    p.add_argument("--ready-file", default=None,
+                   help="atomic JSON {host,port,pid} once accepting")
+    p.add_argument("--hidden", type=int, nargs="+", default=[64, 64])
+    p.add_argument("--lr", type=float, default=3e-3)
+    p.add_argument("--n-envs", type=int, default=16,
+                   help="windows per update (fixed jit batch width)")
+    p.add_argument("--n-steps", type=int, default=64,
+                   help="window length; must equal the serve burst")
+    p.add_argument("--update-epochs", type=int, default=4)
+    p.add_argument("--n-minibatches", type=int, default=4)
+    p.add_argument("--publish-every", type=int, default=1,
+                   help="publish a snapshot every N updates")
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args(argv)
+
+    from cpr_tpu import supervisor
+
+    supervisor.maybe_start_heartbeat()
+    with supervisor.child_phase("learn:init"):
+        from cpr_tpu.envs.registry import get_sized
+        from cpr_tpu.train.ppo import PPOConfig
+
+        env = get_sized(args.protocol, args.max_steps)
+        cfg = PPOConfig(n_envs=args.n_envs, n_steps=args.n_steps,
+                        lr=args.lr, update_epochs=args.update_epochs,
+                        n_minibatches=args.n_minibatches,
+                        hidden=tuple(args.hidden))
+        os.makedirs(args.publish_dir, exist_ok=True)
+        learner = Learner(env, cfg, protocol=args.protocol,
+                          publish_dir=args.publish_dir,
+                          publish_every=args.publish_every,
+                          seed=args.seed)
+    telemetry.current().manifest(config=dict(
+        entry="learn", protocol=args.protocol, n_envs=args.n_envs,
+        n_steps=args.n_steps, lr=args.lr, hidden=list(args.hidden),
+        publish_every=args.publish_every, max_steps=args.max_steps))
+    # seq-0 publish before accepting: the server always has a swap
+    # target, and the smoke's "revenue improves across swaps" baseline
+    # is the untrained net
+    with supervisor.child_phase("learn:publish0"):
+        learner.publish()
+
+    async def amain():
+        server = LearnerServer(learner, host=args.host, port=args.port)
+        await server.start()
+        if args.ready_file:
+            resilience.atomic_write_json(
+                args.ready_file,
+                dict(host=args.host, port=server.port, pid=os.getpid()))
+        await server.serve_until_drained()
+
+    with supervisor.child_phase("learn:run"), resilience.preemption_guard():
+        asyncio.run(amain())
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

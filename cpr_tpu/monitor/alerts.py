@@ -13,6 +13,12 @@ bleeding).  Two signals:
   window, judged against that class's SLO budget (`slo_s` times the
   server's per-class scale — the same budgets admission control sheds
   against).
+* `snapshot_staleness` — age of the serving policy snapshot (v17
+  always-on learning, docs/LEARNING.md), judged against
+  `staleness_slo_s`.  A gauge, not a rate: the latest recorded
+  reading IS the window value (no min-samples floor — one stale
+  reading is already a fact), so a dead learner or a wedged publish
+  pipeline burns the budget within one heartbeat of breaching.
 
 `burn_rate = value / budget`; an alert fires when it crosses the
 window's threshold.  Evaluation is cheap enough for every heartbeat:
@@ -84,11 +90,15 @@ class AlertEngine:
 
     def __init__(self, slo_s: float | None = None, *,
                  shed_budget: float = DEFAULT_SHED_BUDGET,
-                 class_slo: dict | None = None, windows=None,
+                 class_slo: dict | None = None,
+                 staleness_slo_s: float | None = None, windows=None,
                  min_samples: int = MIN_SAMPLES,
                  max_samples: int = MAX_SAMPLES, now_fn=telemetry.now):
         self.slo_s = slo_s
         self.shed_budget = shed_budget
+        # snapshot-age budget for the always-on-learning deployments;
+        # None (the default) skips the signal entirely
+        self.staleness_slo_s = staleness_slo_s
         # class -> latency budget in seconds (the server passes its
         # admission-control budgets); classes without one fall back to
         # the raw slo_s, and with neither the signal is skipped
@@ -100,6 +110,7 @@ class AlertEngine:
         self._now = now_fn
         self._admissions: deque = deque(maxlen=max_samples)
         self._latencies: dict[str, deque] = {}
+        self._staleness: deque = deque(maxlen=max_samples)
         self._active: dict[tuple, dict] = {}
         self._last_emit: dict[tuple, float] = {}
         self.n_fired = 0
@@ -119,6 +130,13 @@ class AlertEngine:
         if dq is None:
             dq = self._latencies[cls] = deque(maxlen=self.max_samples)
         dq.append((self._now(), float(dur_s)))
+
+    def record_staleness(self, staleness_s):
+        """One snapshot-staleness reading (seconds since the serving
+        policy last swapped); sampled per heartbeat by the server."""
+        if not isinstance(staleness_s, (int, float)):
+            return
+        self._staleness.append((self._now(), float(staleness_s)))
 
     # -- evaluation ------------------------------------------------------
 
@@ -140,6 +158,11 @@ class AlertEngine:
                 continue
             p99 = durs[min(len(durs) - 1, int(0.99 * len(durs)))]
             yield ("p99_over_slo", cls, p99, budget)
+        if self.staleness_slo_s is not None:
+            readings = [v for ts, v in self._staleness if ts >= cut]
+            if readings:  # gauge: the latest reading is the value
+                yield ("snapshot_staleness", None, readings[-1],
+                       self.staleness_slo_s)
 
     def evaluate(self) -> list[dict]:
         """Judge every (window, signal) pair now.  Returns the alerts

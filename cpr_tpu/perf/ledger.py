@@ -327,6 +327,27 @@ def iter_trace_rows(path: str):
                             **{f"cfg_{k}": v for k, v in config.items()},
                             **dev_cfg},
                            base)
+                # v17: the always-on learning plane's drain summary
+                # (server _drain builds it when experience rings are
+                # armed) — sampler throughput plus final snapshot
+                # staleness (`_s` suffix: lower-is-better)
+                learn = detail.get("learn")
+                if isinstance(learn, dict):
+                    for key, metric, unit in (
+                            ("samples_per_sec",
+                             "learn_samples_per_sec", "samples/sec"),
+                            ("snapshot_staleness_s",
+                             "learn_snapshot_staleness_s", "seconds")):
+                        value = learn.get(key)
+                        if not isinstance(value, (int, float)):
+                            continue
+                        yield ({"metric": metric, "backend": backend,
+                                "run": run, "value": value,
+                                "unit": unit,
+                                **{f"cfg_{k}": v
+                                   for k, v in config.items()},
+                                **dev_cfg},
+                               base)
                 # v15: the serve memory watermark rides the drain
                 # report (the `memory` point event is also lifted,
                 # below — the report block covers streams cut before

@@ -42,6 +42,27 @@ count rows (cfg_devices fingerprints).  `n_lanes` must divide the
 mesh axis.  Per-lane semantics — admission, holds, seed replay — are
 bit-identical to the single-device path (tests/test_sharded_lanes.py,
 make multichip-smoke).  docs/SCALING.md covers the contract.
+
+Always-on learning (docs/LEARNING.md) adds two orthogonal planes,
+both build-time gated so the default burst is the exact pre-learning
+program:
+
+  * `swap_policies=` registers net policies whose parameters enter the
+    jitted burst as an ARGUMENT rather than a closure constant —
+    `swap_policy()` then replaces the host-side entry between bursts
+    and the next dispatch runs the same compiled program with the new
+    weights: zero drain, zero retrace, and lanes that completed before
+    the swap boundary are bit-identical to a never-swapped engine
+    (their registers were captured in earlier dispatches).
+  * `experience=K` threads per-lane ring buffers (learn/buffer.py)
+    through the donated burst carry: every live lane's transition is
+    recorded in-graph with one masked scatter per step (ragged episode
+    boundaries absorbed, never padded to the slowest lane), and
+    `drain_experience()` consolidates full windows with one device_get
+    at a burst boundary — the sampler half of the sampler/learner
+    split.  `<name>#sample` policy variants draw categorical actions
+    from fold_in-derived per-lane experience streams instead of the
+    greedy argmax, which is what makes the served fleet explore.
 """
 
 from __future__ import annotations
@@ -65,8 +86,10 @@ class ResidentEngine:
     """One resident lane block + policy table over a single JaxEnv."""
 
     def __init__(self, env, params, *, n_lanes: int, burst: int = 256,
-                 extra_policies: dict | None = None, mesh=None,
-                 mesh_axis: str = "d"):
+                 extra_policies: dict | None = None,
+                 swap_policies: dict | None = None,
+                 sample_policies: tuple = (), experience: int = 0,
+                 mesh=None, mesh_axis: str = "d"):
         if burst <= 0:
             raise ValueError(f"burst must be positive, got {burst}")
         self.env = env
@@ -99,8 +122,6 @@ class ResidentEngine:
         if not fns:
             raise ValueError("no servable policies: env has only "
                              "takes_state policies and no extra_policies")
-        self.policy_names = tuple(names)
-        self.policy_ids = {n: i for i, n in enumerate(names)}
         wrapped = tuple(
             (lambda o, f=f: jnp.asarray(f(o), jnp.int32)) for f in fns)
         # scripted policies form the always-on switch table; loaded
@@ -113,6 +134,54 @@ class ResidentEngine:
         else:
             self._base_branches = wrapped
             self._gated = ()
+
+        # hot-swappable net policies: name -> (apply_fn, params,
+        # fingerprint); params enter the burst as an argument (see
+        # _build_burst) so swap_policy() never retraces.  `#sample`
+        # variants draw from the experience key streams and therefore
+        # require the experience plane.
+        swap_policies = dict(swap_policies or {})
+        sample_policies = tuple(sample_policies)
+        unknown = [n for n in sample_policies if n not in swap_policies]
+        if unknown:
+            raise ValueError(f"sample_policies not registered as "
+                             f"swap_policies: {unknown}")
+        self.experience = int(experience)
+        if sample_policies and not self.experience:
+            raise ValueError(
+                "sample_policies need the experience plane "
+                "(experience > 0): per-lane action keys live in the "
+                "experience buffer carry")
+        self._swap_apply: dict = {}
+        self._swap_params: dict = {}
+        self._swap_fingerprint: dict = {}
+        swap_gated, sample_gated = [], []
+        for name in sorted(swap_policies):
+            apply_fn, net_params, fp = swap_policies[name]
+            if mesh is not None:
+                net_params = jax.device_put(net_params,
+                                            self._lanes.replicated)
+            self._swap_apply[name] = apply_fn
+            self._swap_params[name] = net_params
+            self._swap_fingerprint[name] = fp
+            names.append(name)
+            swap_gated.append((len(names) - 1, name, apply_fn))
+        for name in sorted(sample_policies):
+            names.append(name + "#sample")
+            sample_gated.append((len(names) - 1, name,
+                                 self._swap_apply[name]))
+        self._swap_gated = tuple(swap_gated)
+        self._sample_gated = tuple(sample_gated)
+        self.policy_names = tuple(names)
+        self.policy_ids = {n: i for i, n in enumerate(names)}
+
+        self._exp = None
+        self._expbuf = None
+        self._exp_stream = None
+        if self.experience:
+            from cpr_tpu.learn import buffer as expbuf
+            self._expbuf = expbuf
+            self._exp_stream = expbuf.experience_stream
 
         self._spec = device_metrics.serve_spec()
         self._with_metrics = device_metrics.enabled()
@@ -133,16 +202,32 @@ class ResidentEngine:
         # admission-control refusals, recorded by the server's shed
         # path; folded into the shed_sessions metrics cell at drain
         self.sheds = 0
+        # learning-plane counters: total consolidated experience steps
+        # drained, hot-swaps applied, and the dispatch-clock time of
+        # the last swap (None until one lands) — the server derives
+        # snapshot staleness from it
+        self.samples = 0
+        self.swaps = 0
+        self.last_swap_t: float | None = None
 
     # -- program construction ---------------------------------------------
 
     def _build_burst(self):
         env, params, n = self.env, self.params, self.burst
         base, gated = self._base_branches, self._gated
+        swap_gated, sample_gated = self._swap_gated, self._sample_gated
         spec, with_metrics = self._spec, self._with_metrics
+        with_exp = bool(self.experience)
+        expbuf = self._expbuf
 
-        def burst(carry, policy_ids, live, occ):
-            inner, macc = carry if with_metrics else (carry, None)
+        # the carry is (lane_carry, aux) where aux holds the optional
+        # planes — metrics accumulator and experience rings — as dict
+        # entries fixed at build time, so every gated-off combination
+        # is the exact smaller program
+
+        def burst(carry, policy_ids, live, occ, net_params):
+            inner, aux = carry
+            exp = aux.get("exp")
             # per-lane first-done registers: nothing is stacked per
             # step, so the scan's memory traffic is the carry alone
             info_sd = jax.eval_shape(
@@ -154,7 +239,7 @@ class ResidentEngine:
             idx0 = jnp.zeros(live.shape, jnp.int32)
 
             def body(c, i):
-                (state, obs), got, idx, caps = c
+                (state, obs), got, idx, caps, exp = c
                 # scripted policies: one vmapped switch (ids of gated
                 # lanes clamp into the table; their result is replaced)
                 base_pid = jnp.clip(policy_ids, 0, len(base) - 1)
@@ -170,48 +255,92 @@ class ResidentEngine:
                         lambda a, o=obs, s=sel, f=fn:
                             jnp.where(s, jax.vmap(f)(o), a),
                         lambda a: a, actions)
-                new_state, obs_next, _, _, done, info = jax.vmap(
+                # hot-swappable nets: weights come in through the
+                # net_params ARGUMENT, not the closure — swap_policy()
+                # replaces the host-side entry between bursts and this
+                # same compiled program serves the new snapshot
+                for pid_c, name, fn in swap_gated:
+                    sel = (policy_ids == pid_c) & live
+                    actions = jax.lax.cond(
+                        jnp.any(sel),
+                        lambda a, o=obs, s=sel, nm=name, f=fn:
+                            jnp.where(s, jnp.argmax(jax.vmap(
+                                lambda oo: f(net_params[nm], oo))(o),
+                                axis=-1).astype(jnp.int32), a),
+                        lambda a: a, actions)
+                # sampling variants: categorical draws from the
+                # per-lane experience streams (fold_in of the lane key
+                # by the monotone step counter — learn/buffer.py)
+                if sample_gated:
+                    ks = expbuf.step_keys(exp)
+                    for pid_c, name, fn in sample_gated:
+                        sel = (policy_ids == pid_c) & live
+                        actions = jax.lax.cond(
+                            jnp.any(sel),
+                            lambda a, o=obs, s=sel, nm=name, f=fn, kk=ks:
+                                jnp.where(s, jax.vmap(
+                                    lambda k1, oo: jax.random.categorical(
+                                        k1, f(net_params[nm], oo))
+                                )(kk, o).astype(jnp.int32), a),
+                            lambda a: a, actions)
+                new_state, obs_next, _, reward, done, info = jax.vmap(
                     lambda s, a: env._lane_step(s, a, params)
                 )(state, actions)
+                done = done & live
+                if with_exp:
+                    # one masked scatter per field, pre-step obs + the
+                    # action taken from it; non-live lanes drop
+                    exp = expbuf.record(exp, live, obs, actions, reward,
+                                        done, info, policy_ids)
                 state = jax.tree.map(
                     lambda a, b: _lane_where(live, a, b), new_state, state)
                 obs = _lane_where(live, obs_next, obs)
-                done = done & live
                 newly = done & ~got
                 idx = jnp.where(newly, i, idx)
                 caps = {k: jnp.where(newly, info[k], caps[k])
                         for k in caps}
-                return ((state, obs), got | done, idx, caps), None
+                return ((state, obs), got | done, idx, caps, exp), None
 
-            (inner, got, idx, caps), _ = jax.lax.scan(
-                body, (inner, got0, idx0, caps0),
+            (inner, got, idx, caps, exp), _ = jax.lax.scan(
+                body, (inner, got0, idx0, caps0, exp),
                 jnp.arange(n, dtype=jnp.int32))
             regs = (got, idx) + tuple(caps[k] for k in CAPTURE_FIELDS)
-            if not with_metrics:
-                return inner, regs
-            # per-burst cells, derived from the burst's own inputs and
-            # the first-done registers — nothing per-step is added, so
-            # the scan loop is the exact metrics-off program
-            macc = spec.count(macc, "env_steps",
-                              jnp.sum(live.astype(jnp.int32)) * n)
-            macc = spec.count(macc, "episodes", got)
-            macc = spec.count(macc, "bursts", 1)
-            macc = spec.observe(macc, "occupancy", occ)
-            return (inner, macc), regs
+            aux = {}
+            if with_exp:
+                aux["exp"] = exp
+            if with_metrics:
+                # per-burst cells, derived from the burst's own inputs
+                # and the first-done registers — nothing per-step is
+                # added, so the scan loop is the exact metrics-off
+                # program
+                macc = carry[1]["macc"]
+                macc = spec.count(macc, "env_steps",
+                                  jnp.sum(live.astype(jnp.int32)) * n)
+                macc = spec.count(macc, "episodes", got)
+                macc = spec.count(macc, "bursts", 1)
+                macc = spec.observe(macc, "occupancy", occ)
+                aux["macc"] = macc
+            return (inner, aux), regs
 
         if self._lanes is None:
             return jax.jit(burst, donate_argnums=0)
         # sharded burst: lane-major trees partition on the mesh axis,
-        # the metrics accumulator and occ scalar replicate, and the
-        # in/out carry specs match so the donated carry aliases in
-        # place per shard and chains with the sharded step_lanes
-        # without a resharding collective.  The cross-shard reductions
-        # the cells compute (sum over live lanes, first-done episode
-        # count) come back replicated — GSPMD inserts the psum.
+        # the metrics accumulator, swap params and occ scalar
+        # replicate, and the in/out carry specs match so the donated
+        # carry aliases in place per shard and chains with the sharded
+        # step_lanes without a resharding collective.  The cross-shard
+        # reductions the cells compute (sum over live lanes, first-done
+        # episode count) come back replicated — GSPMD inserts the psum.
+        # The experience rings are lane-major and shard with the lanes.
         lane, rep = self._lanes.lane, self._lanes.replicated
-        carry_sh = (lane, rep) if with_metrics else lane
+        aux_sh = {}
+        if with_exp:
+            aux_sh["exp"] = lane
+        if with_metrics:
+            aux_sh["macc"] = rep
+        carry_sh = (lane, aux_sh)
         return jax.jit(burst, donate_argnums=0,
-                       in_shardings=(carry_sh, lane, lane, rep),
+                       in_shardings=(carry_sh, lane, lane, rep, rep),
                        out_shardings=(carry_sh, lane))
 
     # -- lane program dispatch (single-device or mesh-sharded) ------------
@@ -247,22 +376,38 @@ class ResidentEngine:
             self._carry, zero_a, zero_m, self._fresh0, zero_m)
         if self._with_metrics:
             self._macc = self._spec.init()
+        if self.experience:
+            # sampler key plane: each lane's stream is the fold_in
+            # sibling of its admission key (experience_stream), so env
+            # dynamics and action sampling can never alias; splice()
+            # re-derives the stream from each admitted session's seed
+            ekeys = jax.vmap(lambda s: self._exp_stream(
+                jax.random.PRNGKey(s)))(seeds)
+            self._exp = self._expbuf.init_buffer(
+                ekeys, self.experience, self.env.observation_length)
+            if self._lanes is not None:
+                self._exp = jax.device_put(self._exp, self._lanes.lane)
         out, _ = self._burst_fn(self._carry_in(), zero_a, zero_m,
-                                jnp.float32(0.0))
+                                jnp.float32(0.0), self._swap_params)
         self._carry_out(out)
         if self._with_metrics:
             # warmup must not pollute the cells (it counts as a burst)
             self._macc = self._spec.init()
 
     def _carry_in(self):
-        return (self._carry, self._macc) if self._with_metrics \
-            else self._carry
+        aux = {}
+        if self._exp is not None:
+            aux["exp"] = self._exp
+        if self._with_metrics:
+            aux["macc"] = self._macc
+        return (self._carry, aux)
 
     def _carry_out(self, out):
-        if self._with_metrics:
-            self._carry, self._macc = out
-        else:
-            self._carry = out
+        self._carry, aux = out
+        if "exp" in aux:
+            self._exp = aux["exp"]
+        if "macc" in aux:
+            self._macc = aux["macc"]
 
     # -- the three device entry points ------------------------------------
 
@@ -286,6 +431,22 @@ class ResidentEngine:
             self._carry, jnp.zeros(self.n_lanes, jnp.int32),
             jnp.asarray(admit), fresh, hold)
         self._carry = carry
+        if self._exp is not None:
+            # re-key the admitted lanes' sampler streams from their
+            # session seeds (fold_in sibling of the admission key) and
+            # restart their write windows — a re-admitted lane's stale
+            # partial window must never be consolidated.  The monotone
+            # step counter `t` keeps running; the key changed, so the
+            # stream is fresh regardless.
+            lanes = jnp.asarray(sorted(lane_seeds), jnp.int32)
+            lseeds = jnp.asarray([lane_seeds[int(l)] for l in lanes],
+                                 jnp.uint32)
+            nk = jax.vmap(lambda s: self._exp_stream(
+                jax.random.PRNGKey(s)))(lseeds)
+            self._exp = dict(
+                self._exp,
+                key=self._exp["key"].at[lanes].set(nk),
+                cursor=self._exp["cursor"].at[lanes].set(0))
         obs = np.asarray(obs)
         self.admitted += len(lane_seeds)
         self.busy_s += telemetry.now() - t0
@@ -340,7 +501,7 @@ class ResidentEngine:
                if occupancy is None else float(occupancy))
         out, regs = self._burst_fn(
             self._carry_in(), jnp.asarray(pol), jnp.asarray(live),
-            jnp.float32(occ))
+            jnp.float32(occ), self._swap_params)
         self._carry_out(out)
         host = jax.device_get(regs)
         dur = telemetry.now() - t0
@@ -351,6 +512,98 @@ class ResidentEngine:
         self._occ_sum += occ
         self._burst_wall.append(dur)
         return dict(zip(BURST_FIELDS, host))
+
+    # -- the learning plane -----------------------------------------------
+
+    @property
+    def swap_names(self) -> tuple:
+        """Names of the hot-swappable net policies, sorted."""
+        return tuple(sorted(self._swap_apply))
+
+    def policy_fingerprint(self, name: str | None = None):
+        """The snapshot fingerprint currently serving `name` (default:
+        the first swappable policy), or None without swap policies."""
+        if not self._swap_apply:
+            return None
+        if name is None:
+            name = self.swap_names[0]
+        return self._swap_fingerprint.get(name)
+
+    def swap_policy(self, name: str, net_params, *,
+                    fingerprint=None) -> dict:
+        """Hot-swap a registered net policy's weights: the next burst
+        dispatch serves `net_params`, in-flight lanes are untouched
+        (their state lives in the lane carry, not the policy), and no
+        program retraces — the weights are an argument of the compiled
+        burst, so same-structure params reuse the executable.
+
+        An identical fingerprint is a no-op (swapped=False) — the
+        watch loop may see the same latest.json twice.  A params tree
+        whose structure/shapes/dtypes differ from the serving entry is
+        REFUSED with the typed IntegrityError path (reason="version"):
+        accepting it would force a retrace mid-serve, which is exactly
+        the drain this API exists to avoid.
+        """
+        if name not in self._swap_apply:
+            raise ValueError(
+                f"unknown swappable policy {name!r}; registered: "
+                f"{sorted(self._swap_apply)}")
+        if fingerprint is not None and \
+                fingerprint == self._swap_fingerprint.get(name):
+            return dict(swapped=False, reason="identical",
+                        fingerprint=fingerprint)
+
+        def sig(tree):
+            return (jax.tree.structure(tree),
+                    [(jnp.shape(x), jnp.result_type(x))
+                     for x in jax.tree.leaves(tree)])
+
+        if sig(net_params) != sig(self._swap_params[name]):
+            from cpr_tpu.integrity import IntegrityError, integrity_event
+            artifact = str(fingerprint or name)
+            integrity_event(artifact=artifact, kind="policy_snapshot",
+                            reason="version", action="refused",
+                            detail="parameter tree does not match the "
+                                   "serving program")
+            raise IntegrityError(
+                f"swap refused for {name!r}: snapshot parameter tree "
+                f"does not match the serving program",
+                artifact=artifact, kind="policy_snapshot",
+                reason="version")
+        if self._lanes is not None:
+            net_params = jax.device_put(net_params,
+                                        self._lanes.replicated)
+        self._swap_params[name] = net_params
+        self._swap_fingerprint[name] = fingerprint
+        self.swaps += 1
+        self.last_swap_t = telemetry.now()
+        return dict(swapped=True, fingerprint=fingerprint)
+
+    def drain_experience(self) -> dict | None:
+        """Consolidate the experience rings into a feed batch — one
+        device_get at a burst boundary, never per step.  Write cursors
+        reset (the data is overwritten by the next window); key
+        streams and the monotone step counters continue, so sampling
+        stays reuse-free across drains.  Returns None when the plane
+        is off or no lane filled a window (partial windows stay
+        uncounted until re-admission resets them)."""
+        if self._exp is None:
+            return None
+        host = jax.device_get({k: v for k, v in self._exp.items()
+                               if k != "key"})
+        last_obs = np.asarray(jax.device_get(self._carry[1]))
+        self._exp = dict(self._exp,
+                         cursor=jnp.zeros_like(self._exp["cursor"]))
+        batch = self._expbuf.consolidate(host, last_obs)
+        if not batch["steps"]:
+            return None
+        self.samples += batch["steps"]
+        from cpr_tpu.learn import learn_event
+        learn_event("sample", steps=batch["steps"], batches=1,
+                    fingerprint=self.policy_fingerprint(),
+                    staleness_s=None, lanes=int(len(batch["lanes"])),
+                    partial=batch["partial"])
+        return batch
 
     # -- reporting --------------------------------------------------------
 
@@ -373,7 +626,9 @@ class ResidentEngine:
             # this into the cfg_devices fingerprint so per-device-
             # count throughput rows gate separately (docs/SCALING.md)
             n_devices=self.n_devices,
-            policies=list(self.policy_names))
+            policies=list(self.policy_names),
+            # learning plane (zeros when the plane is off)
+            samples=self.samples, swaps=self.swaps)
 
     def record_shed(self):
         """Count one admission-control refusal (the server's shed

@@ -23,8 +23,23 @@ queued and in-flight sessions with a `draining` reply, emit the
 throughput `report` event (ingested by the perf ledger) and the
 device-metrics summary, close, exit 0.
 
+Always-on learning (v17, docs/LEARNING.md): with `--learner
+host:port` the engine records sampler-lane experience in device rings
+and the tick loop ships drained batches to the learner through an
+`ExperienceFeeder` (drop-oldest — a slow learner costs samples, never
+serve latency).  With `--learn-watch dir` the heartbeat block polls
+the learner's `latest.json` pointer and hot-swaps the `ppo` policy's
+params at the next burst boundary (`engine.swap_policy`) — zero
+drain, zero retrace, in-flight sessions unperturbed; a snapshot that
+fails integrity or protocol validation is refused with a typed event
+and the server keeps serving the previous params.  Heartbeats, stats
+and the drain report carry `policy_fingerprint` +
+`snapshot_staleness_s`, and `--staleness-slo-s` arms the
+snapshot-staleness burn-rate alert next to the latency SLOs.
+
 Run: `python -m cpr_tpu.serve.server --protocol nakamoto ...`
-(tools/serve_smoke.py supervises exactly this).
+(tools/serve_smoke.py supervises exactly this; tools/learn_smoke.py
+supervises the server + learner pair).
 """
 
 from __future__ import annotations
@@ -146,8 +161,24 @@ class ServeServer:
                  max_queued: int | None = None,
                  tenant_quota: int | None = None,
                  replica_index: int | None = None,
-                 metrics_port: int | None = None):
+                 metrics_port: int | None = None,
+                 feeder=None, learn_watch: str | None = None,
+                 staleness_slo_s: float | None = None,
+                 protocol: str | None = None):
         self.engine = engine
+        # serving protocol key (main() passes --protocol): swap
+        # validation refuses snapshots trained for another protocol
+        self.protocol = protocol
+        # always-on learning plane: the feeder ships drained
+        # experience to the learner; learn_watch is the snapshot
+        # directory whose latest.json pointer the heartbeat polls
+        self.feeder = feeder
+        self.learn_watch = learn_watch
+        self.staleness_slo_s = staleness_slo_s
+        self._watch_seq = -1
+        # staleness baseline before the first swap: process start
+        # (telemetry.now() clock — never compared across processes)
+        self._serve_t0 = telemetry.now()
         # bounded queue by default: 8x the lane count is ~8 bursts of
         # backlog, past which queueing only manufactures SLO misses —
         # shed instead.  Explicit <= 0 restores the unbounded queue.
@@ -203,7 +234,8 @@ class ServeServer:
             slo_s,
             class_slo=({name: slo_s * _SLO_SCALE[p]
                         for name, p in PRIORITY_CLASSES.items()}
-                       if slo_s is not None else None))
+                       if slo_s is not None else None),
+            staleness_slo_s=staleness_slo_s)
         self.metrics_port = metrics_port  # bound port after start()
         self.metrics_server: MetricsServer | None = None
         self._netsim_engines: dict[tuple, object] = {}
@@ -263,6 +295,10 @@ class ServeServer:
                 # supervisor's progress signal, so an idle server
                 # stays distinguishable from a wedged one
                 hb_last = t
+                if self.learn_watch is not None:
+                    self._poll_snapshots()
+                self.alerts.record_staleness(
+                    self.snapshot_staleness_s())
                 self._refresh_gauges()
                 for a in self.alerts.evaluate():
                     slo_alerts.emit_alert(a)
@@ -278,6 +314,8 @@ class ServeServer:
                     pending_steps=len(self._pending),
                     exec_ops=len(self._inflight_exec),
                     sheds=self._sheds,
+                    policy_fingerprint=self.engine.policy_fingerprint(),
+                    snapshot_staleness_s=self.snapshot_staleness_s(),
                     alerts=self.alerts.summary(),
                     memory=self.mem.snapshot())
             await asyncio.sleep(0.0 if progressed else self.idle_sleep_s)
@@ -370,9 +408,15 @@ class ServeServer:
                         relative_reward=(att / (att + dfn)
                                          if (att + dfn) else 0.0))
                     if not s.future.done():
+                        # the fingerprint that served this episode's
+                        # final burst: the revenue-vs-snapshot
+                        # windowing key of tools/learn_smoke.py (None
+                        # without swap policies)
                         s.future.set_result(dict(
                             ok=True, session=s.sid, seed=s.seed,
-                            policy=s.policy, episode=episode))
+                            policy=s.policy, episode=episode,
+                            policy_fingerprint=(
+                                self.engine.policy_fingerprint())))
                     self.sched.retire(lane)
                     _serve_event(
                         "complete", s.sid, kind="policy",
@@ -390,8 +434,104 @@ class ServeServer:
                 if self.replica_index is not None:
                     resilience.fault_point("replica",
                                            self.replica_index)
+            # experience plane: one drain per burst boundary (the ring
+            # capacity equals the burst, so full windows are ready
+            # exactly now); submit never blocks — drop-oldest beyond
+            # the feeder's small queue
+            if self.feeder is not None:
+                batch = self.engine.drain_experience()
+                if batch is not None:
+                    self.feeder.submit(batch)
             progressed = True
         return progressed
+
+    # -- the learning plane -----------------------------------------------
+
+    def snapshot_staleness_s(self):
+        """Seconds since the serving policy last swapped (process
+        start stands in before the first swap), or None when no
+        swappable policy is registered.  Process-relative
+        telemetry.now() stamps only — never compared across
+        processes."""
+        if not self.engine.swap_names:
+            return None
+        t0 = self.engine.last_swap_t
+        return telemetry.now() - (t0 if t0 is not None
+                                  else self._serve_t0)
+
+    def _poll_snapshots(self):
+        """One watch-loop poll: if the learner's latest.json moved
+        past the last seq this server acted on, try the swap.  Every
+        failure mode — unreadable pointer, missing snapshot, integrity
+        refusal, protocol mismatch — leaves the previous params
+        serving; zero-drain means the learning plane may fall behind
+        but can never take the data plane down."""
+        import json
+
+        path = os.path.join(self.learn_watch, "latest.json")
+        try:
+            with open(path, "rb") as f:
+                latest = json.load(f)
+            seq = int(latest["seq"])
+        except (OSError, ValueError, KeyError, TypeError):
+            return  # not published yet / torn read: next poll retries
+        if seq <= self._watch_seq:
+            return
+        self._watch_seq = seq
+        self._swap_from_path(latest.get("path"), seq=seq)
+
+    def _swap_from_path(self, path, seq=None) -> dict:
+        """Load + validate + hot-swap one snapshot; shared by the
+        watch poll and the in-band `policy.publish` op.  Returns the
+        reply block (ok/swapped/fingerprint or the refusal)."""
+        from cpr_tpu.integrity import IntegrityError, integrity_event
+        from cpr_tpu.train.driver import load_policy_network
+
+        name = self.engine.swap_names[0] if self.engine.swap_names \
+            else None
+        if name is None:
+            return dict(ok=False, error="no swappable policy "
+                                        "(start with --policy-snapshot)")
+        staleness = self.snapshot_staleness_s()
+        try:
+            _, net_params, meta = load_policy_network(str(path))
+            # the snapshot must rebuild the net this engine compiled:
+            # protocol and dims are checked here against the serving
+            # env; hidden-layer mismatches surface as the param-tree
+            # structure refusal inside swap_policy
+            env = self.engine.env
+            if ((self.protocol is not None
+                 and meta.get("protocol") not in (None, self.protocol))
+                    or int(meta.get("n_actions", env.n_actions))
+                    != int(env.n_actions)
+                    or int(meta.get("observation_length",
+                                    env.observation_length))
+                    != int(env.observation_length)):
+                integrity_event(
+                    artifact=str(path), kind="policy_snapshot",
+                    reason="version", action="refused",
+                    expected=dict(protocol=self.protocol,
+                                  n_actions=int(env.n_actions)),
+                    found=dict(protocol=meta.get("protocol"),
+                               n_actions=meta.get("n_actions")))
+                return dict(ok=False, error="snapshot/env mismatch",
+                            refused=True)
+            out = self.engine.swap_policy(
+                name, net_params,
+                fingerprint=meta.get("payload_sha256"))
+        except IntegrityError as e:
+            # the typed event already fired inside the loader/engine
+            return dict(ok=False, error=str(e), refused=True)
+        if out.get("swapped"):
+            from cpr_tpu.learn import learn_event
+
+            learn_event("swap", steps=None, batches=None,
+                        fingerprint=out["fingerprint"],
+                        staleness_s=staleness, seq=seq,
+                        policy=name, swaps=self.engine.swaps)
+            if self.feeder is not None:
+                self.feeder.fingerprint = out["fingerprint"]
+        return dict(ok=True, **out)
 
     def _refresh_gauges(self):
         """Refresh the registry's gauge families from live scheduler /
@@ -429,6 +569,12 @@ class ServeServer:
           help="episodes completed since start")
         g("sheds", self._sheds,
           help="admission refusals since start")
+        staleness = self.snapshot_staleness_s()
+        if staleness is not None:
+            g("snapshot_staleness_s", staleness,
+              help="age of the serving policy snapshot (seconds "
+                   "since the last hot-swap; process start before "
+                   "the first)")
 
     def _session_latency(self, s: _Session) -> dict:
         """One completed (or refused) session's reply breakdown.
@@ -495,6 +641,25 @@ class ServeServer:
         self.mem.sample()
         self.mem.emit()
         report["memory"] = self.mem.snapshot()
+        # learning plane: fingerprint + staleness always ride the
+        # report; the `learn` block (ledger rows
+        # learn_samples_per_sec / learn_snapshot_staleness_s) only
+        # when the experience plane is on
+        report["policy_fingerprint"] = self.engine.policy_fingerprint()
+        staleness = self.snapshot_staleness_s()
+        report["snapshot_staleness_s"] = staleness
+        if self.engine.experience:
+            busy = self.engine.busy_s
+            report["learn"] = dict(
+                samples=self.engine.samples,
+                samples_per_sec=(self.engine.samples / busy
+                                 if busy > 0 else 0.0),
+                snapshot_staleness_s=staleness,
+                swaps=self.engine.swaps,
+                feeder=(self.feeder.stats()
+                        if self.feeder is not None else None))
+        if self.feeder is not None:
+            self.feeder.close()
         _serve_event("report", **report)
         self.engine.emit_metrics()
         _serve_event("stop", reason=reason, steps=report["steps"],
@@ -577,7 +742,9 @@ class ServeServer:
                         run=telemetry.run_id(),
                         n_lanes=self.engine.n_lanes,
                         burst=self.engine.burst,
-                        policies=list(self.engine.policy_names))
+                        policies=list(self.engine.policy_names),
+                        policy_fingerprint=(
+                            self.engine.policy_fingerprint()))
         if op == "stats":
             return dict(ok=True, report=self.engine.report(),
                         queued=self.sched.n_queued(),
@@ -596,7 +763,18 @@ class ServeServer:
                         # bucket-sums these into the fleet board
                         latencies_raw=self.latency.to_dict(),
                         alerts=self.alerts.summary(),
-                        memory=self.mem.snapshot())
+                        memory=self.mem.snapshot(),
+                        policy_fingerprint=(
+                            self.engine.policy_fingerprint()),
+                        snapshot_staleness_s=(
+                            self.snapshot_staleness_s()),
+                        feeder=(self.feeder.stats()
+                                if self.feeder is not None else None))
+        if op == "policy.publish":
+            # in-band twin of the latest.json watch: swap to the named
+            # snapshot at the next burst boundary, or refuse with the
+            # typed integrity path — either way, keep serving
+            return self._swap_from_path(req.get("path"))
         if op == "metrics.scrape":
             # the in-band twin of the --metrics-port HTTP endpoint:
             # the registry's structured form (histograms_raw inside is
@@ -1026,6 +1204,20 @@ def main(argv=None) -> int:
                         " this port (0 = ephemeral; the bound port"
                         " lands in the ready file); default: no HTTP"
                         " exposition (metrics.scrape stays available)")
+    p.add_argument("--learner", default=None, metavar="HOST:PORT",
+                   help="feed sampler-lane experience to this learner"
+                        " (cpr_tpu.learn.learner); requires"
+                        " --policy-snapshot, turns the snapshot into a"
+                        " sampling policy ('ppo#sample') and arms the"
+                        " device experience rings")
+    p.add_argument("--learn-watch", default=None, metavar="DIR",
+                   help="watch DIR/latest.json and hot-swap the 'ppo'"
+                        " policy at burst boundaries (zero drain);"
+                        " requires --policy-snapshot")
+    p.add_argument("--staleness-slo-s", type=float, default=None,
+                   help="snapshot-staleness budget for the burn-rate"
+                        " alert engine (docs/LEARNING.md); default:"
+                        " signal off")
     args = p.parse_args(argv)
 
     from cpr_tpu import supervisor
@@ -1039,16 +1231,38 @@ def main(argv=None) -> int:
         params = make_params(alpha=args.alpha, gamma=args.gamma,
                              activation_delay=args.activation_delay,
                              max_steps=args.max_steps)
+        learn_mode = bool(args.learner or args.learn_watch)
+        if learn_mode and not args.policy_snapshot:
+            raise SystemExit(
+                "--learner/--learn-watch require --policy-snapshot "
+                "(the engine needs an initial swappable net)")
         extra = {}
+        swap = None
+        sample = ()
         if args.policy_snapshot:
-            from cpr_tpu.train.driver import load_policy_snapshot
+            if learn_mode:
+                # swappable registration: the params stay an argument
+                # of the compiled burst (engine.swap_policy replaces
+                # them between bursts, zero retrace); with a learner
+                # attached the same net also samples ('ppo#sample')
+                # into the experience rings
+                from cpr_tpu.train.driver import load_policy_network
 
-            policy, meta = load_policy_snapshot(args.policy_snapshot)
+                net, net_params, meta = load_policy_network(
+                    args.policy_snapshot)
+                swap = {"ppo": (lambda p, o: net.apply(p, o)[0],
+                                net_params, meta["payload_sha256"])}
+                if args.learner:
+                    sample = ("ppo",)
+            else:
+                from cpr_tpu.train.driver import load_policy_snapshot
+
+                policy, meta = load_policy_snapshot(args.policy_snapshot)
+                extra["ppo"] = policy
             if meta.get("protocol") not in (None, args.protocol):
                 raise SystemExit(
                     f"snapshot trained on {meta.get('protocol')!r}, "
                     f"serving {args.protocol!r}")
-            extra["ppo"] = policy
         mesh = None
         if args.devices > 1:
             import jax
@@ -1063,6 +1277,12 @@ def main(argv=None) -> int:
             mesh = default_mesh(devices=devs[:args.devices])
         engine = ResidentEngine(env, params, n_lanes=args.lanes,
                                 burst=args.burst, extra_policies=extra,
+                                swap_policies=swap,
+                                sample_policies=sample,
+                                # ring capacity == burst: every burst
+                                # fills exactly one feed window
+                                experience=(args.burst if args.learner
+                                            else 0),
                                 mesh=mesh)
     with supervisor.child_phase("serve:compile"):
         engine.start()
@@ -1073,7 +1293,18 @@ def main(argv=None) -> int:
     telemetry.current().manifest(config=dict(
         entry="serve", protocol=args.protocol, n_lanes=args.lanes,
         burst=args.burst, devices=args.devices,
-        max_steps=args.max_steps, alpha=args.alpha, gamma=args.gamma))
+        max_steps=args.max_steps, alpha=args.alpha, gamma=args.gamma,
+        learner=bool(args.learner),
+        learn_watch=bool(args.learn_watch)))
+
+    feeder = None
+    if args.learner:
+        from cpr_tpu.learn.feed import ExperienceFeeder
+
+        lhost, _, lport = args.learner.rpartition(":")
+        feeder = ExperienceFeeder(lhost or "127.0.0.1", int(lport),
+                                  fingerprint=(
+                                      engine.policy_fingerprint()))
 
     async def amain():
         server = ServeServer(engine, host=args.host, port=args.port,
@@ -1081,7 +1312,11 @@ def main(argv=None) -> int:
                              slo_s=args.slo_s, max_queued=args.max_queue,
                              tenant_quota=args.tenant_quota,
                              replica_index=args.replica_index,
-                             metrics_port=args.metrics_port)
+                             metrics_port=args.metrics_port,
+                             feeder=feeder,
+                             learn_watch=args.learn_watch,
+                             staleness_slo_s=args.staleness_slo_s,
+                             protocol=args.protocol)
         # the same loaded nets double as in-network attack policies
         # (netsim.attack_sweep); the snapshot path is the cache
         # fingerprint for their sweep results

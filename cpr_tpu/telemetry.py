@@ -56,7 +56,7 @@ from contextlib import contextmanager
 from datetime import datetime, timezone
 from time import perf_counter as now  # noqa: F401 — re-exported
 
-SCHEMA_VERSION = 16
+SCHEMA_VERSION = 17
 TELEMETRY_ENV_VAR = "CPR_TELEMETRY"
 # flight-recorder ring capacity (v14): last N emitted events kept
 # in-process for the crash blackbox (cpr_tpu/monitor/blackbox.py)
@@ -212,6 +212,19 @@ EVENT_FIELDS = {
     # (load aborted loudly — serving a half-written artifact is worse
     # than crashing).  Extras ride free-form: quarantine path, detail.
     "integrity": ("artifact", "artifact_kind", "reason", "action"),
+    # v17: one per leg of the always-on learning loop (cpr_tpu/learn,
+    # sole emitter learn.learn_event): role is the leg — sample
+    # (experience drained from the serve rings), feed (batch shipped
+    # to the learner), update (one PPO update on fed experience),
+    # publish (snapshot + latest.json written), swap (serving weights
+    # replaced at a burst boundary) — steps/batches the volume moved,
+    # fingerprint the snapshot payload_sha256 the leg acted under/on
+    # (None before the first publish), staleness_s the age of the
+    # serving weights at the leg (the gauge the AlertEngine budgets;
+    # None where the emitting process cannot know it).  Extras ride
+    # free-form: lanes, partial, dropped, pool, seq, losses.
+    "learn": ("role", "steps", "batches", "fingerprint",
+              "staleness_s"),
 }
 
 

@@ -239,11 +239,15 @@ def export_policy_snapshot(path: str, net_params, *, protocol: str,
     return meta
 
 
-def load_policy_snapshot(path: str):
-    """Reconstruct a jittable greedy policy `obs -> action` from a
-    serving snapshot — the `.json` meta sidecar alone defines the net
-    shape, so no TrainConfig or env instance is required.  Returns
-    (policy, meta).
+def load_policy_network(path: str):
+    """Load a serving snapshot as its reconstruction pieces — returns
+    (net, params, meta) instead of a closed-over policy.  The serving
+    layer's hot-swap path needs the params separately: the engine holds
+    them as an argument of the compiled burst and replaces them at a
+    burst boundary without retracing (ResidentEngine.swap_policy).
+    `meta["payload_sha256"]` is the snapshot fingerprint the whole
+    learning loop correlates on (learn events, heartbeats, no-op swap
+    detection).
 
     Refuses loudly (typed IntegrityError, never a KeyError or a
     silently wrong net) when the sidecar is missing, the sidecar's
@@ -304,6 +308,21 @@ def load_policy_snapshot(path: str):
         raise resilience.reject_undecodable(
             path, kind="policy_snapshot", err=e,
             action="refused") from e
+    if "payload_sha256" not in meta:
+        # older sidecars predate the fingerprint; derive it so every
+        # consumer downstream can rely on the field
+        meta = dict(meta,
+                    payload_sha256=hashlib.sha256(payload).hexdigest())
+    return net, params, meta
+
+
+def load_policy_snapshot(path: str):
+    """Reconstruct a jittable greedy policy `obs -> action` from a
+    serving snapshot — the `.json` meta sidecar alone defines the net
+    shape, so no TrainConfig or env instance is required.  Returns
+    (policy, meta); same integrity refusals as `load_policy_network`,
+    which this wraps."""
+    net, params, meta = load_policy_network(path)
 
     def policy(obs):
         logits, _ = net.apply(params, obs)

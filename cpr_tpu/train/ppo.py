@@ -8,6 +8,26 @@ kernel, and one `train_step` = rollout + GAE + minibatched clipped
 surrogate updates, all inside a single XLA program. Multi-chip scaling:
 the env batch is sharded over the mesh's data axis and the policy's hidden
 layers over the tensor axis (see `shardings`).
+
+The two halves of a train_step are independently replaceable:
+
+  * `make_update_phase` builds the GAE + minibatch-update half alone,
+    with (T, N) taken from the trajectory itself — `make_train` runs it
+    on its own rollout, the always-on learner (learn/learner.py, via
+    `make_experience_update`) runs the same program on experience the
+    serve fleet recorded;
+  * `make_train(rollout_phase=...)` swaps the rollout half —
+    `make_lane_rollout` steps the resident lane block
+    (`JaxEnv.step_lanes`, optionally mesh-sharded like
+    parallel/lanes.py), the sampler/learner decoupling of ROADMAP
+    item 2 (arXiv:1803.02811).
+
+Sampler-side action keys are `fold_in`-derived experience streams
+(learn/buffer.py `experience_stream`): a lane admitted with PRNGKey(S)
+spends PRNGKey(S) itself on env dynamics, and the legacy rollout
+consumes `split(key)` children — the experience stream is a sibling
+`fold_in` derivation of the lane key, so sampler-side and legacy
+rollout-side trajectories can never alias a key.
 """
 
 from __future__ import annotations
@@ -26,7 +46,15 @@ from flax.training.train_state import TrainState
 
 from cpr_tpu import device_metrics, resilience, telemetry
 from cpr_tpu.envs.base import JaxEnv
+from cpr_tpu.learn.buffer import EXPERIENCE_STREAM, experience_stream
 from cpr_tpu.params import EnvParams
+
+__all__ = [
+    "PPOConfig", "ActorCritic", "Transition", "EXPERIENCE_STREAM",
+    "experience_stream", "shardings", "make_update_phase", "make_train",
+    "make_lane_rollout", "make_experience_update", "maybe_checkify",
+    "relative_reward_on_done", "train",
+]
 
 
 @struct.dataclass
@@ -108,73 +136,20 @@ def shardings(mesh, dp_axis: str = "dp", tp_axis: str = "tp"):
     return batch, param_spec
 
 
-def make_train(env: JaxEnv, env_params: EnvParams, cfg: PPOConfig,
-               reward_transform: Callable | None = None,
-               per_env_params: bool = False):
-    """Build (init_fn, train_step) — both jittable, mesh-shardable.
+def make_update_phase(net: ActorCritic, cfg: PPOConfig, *,
+                      collect: bool = False, mspec=None):
+    """Build the update half of a PPO step: GAE + epoch/minibatch
+    clipped-surrogate scans over ONE trajectory.
 
-    reward_transform(reward, info, done) -> shaped reward; the analog of
-    the reference's reward shaping pipeline (ppo.py:217-244 and the
-    wrappers in gym/ocaml/cpr_gym/wrappers.py).
+    (T, N) come from the trajectory's own shapes, not cfg — the same
+    program serves make_train's rollout (cfg.n_steps x cfg.n_envs) and
+    the learner's fed experience windows (learn/learner.py), whose
+    batch geometry is the serve fleet's, not the trainer's.
 
-    per_env_params: env_params leaves carry a leading (n_envs,) axis and
-    each env lane runs its own (alpha, gamma, ...) — the batched analog
-    of training under an assumption schedule
-    (wrappers.py:172-242 / cfg alpha lists and ranges).
-    """
-    net = ActorCritic(env.n_actions, cfg.hidden)
-    p_axis = 0 if per_env_params else None
-    # in-graph sentinels/stats (CPR_DEVICE_METRICS=1), read at build
-    # time: the off path stays the exact pre-metrics program (acc=None
-    # threads through the scans as an empty pytree)
-    collect = device_metrics.enabled()
-    mspec = device_metrics.ppo_spec() if collect else None
-
-    def lr_schedule(count):
-        if not cfg.anneal_lr:
-            return cfg.lr
-        frac = 1.0 - count / (cfg.total_updates * cfg.update_epochs * cfg.n_minibatches)
-        return cfg.lr * jnp.maximum(frac, 0.0)
-
-    tx = optax.chain(
-        optax.clip_by_global_norm(cfg.max_grad_norm),
-        optax.adam(lr_schedule, eps=1e-5),
-    )
-
-    def init_fn(key):
-        key, k_net, k_env = jax.random.split(key, 3)
-        obs_dim = env.observation_length
-        params = net.init(k_net, jnp.zeros((1, obs_dim)))
-        ts = TrainState.create(apply_fn=net.apply, params=params, tx=tx)
-        env_keys = jax.random.split(k_env, cfg.n_envs)
-        env_state, obs = jax.vmap(
-            lambda k, p: env.reset(k, p), in_axes=(0, p_axis)
-        )(env_keys, env_params)
-        return ts, env_state, obs, key
-
-    def env_step(carry, _):
-        ts, env_state, obs, key = carry
-        key, k_act = jax.random.split(key)
-        logits, value = net.apply(ts.params, obs)
-        action = jax.random.categorical(k_act, logits)
-        logp = jax.nn.log_softmax(logits)[jnp.arange(cfg.n_envs), action]
-        env_state, obs2, reward, done, info = jax.vmap(
-            lambda s, a, p: env.step(s, a, p), in_axes=(0, 0, p_axis)
-        )(env_state, action, env_params)
-        if reward_transform is not None:
-            reward = reward_transform(reward, info, done)
-        # auto-reset finished episodes, continuing each env's PRNG stream
-        reset_state, reset_obs = jax.vmap(
-            lambda s, p: env.reset(s.key, p), in_axes=(0, p_axis)
-        )(env_state, env_params)
-        env_state = jax.tree.map(
-            lambda a, b: jnp.where(
-                done.reshape(done.shape + (1,) * (a.ndim - 1)), a, b),
-            reset_state, env_state)
-        obs2 = jnp.where(done[:, None], reset_obs, obs2)
-        t = Transition(obs=obs, action=action, logp=logp, value=value,
-                       reward=reward, done=done, info=info)
-        return (ts, env_state, obs2, key), t
+    Returns update_phase(ts, traj, last_value, key) ->
+    (ts, key, metrics); traj.info must carry the episode aggregate
+    keys (`episode_reward_attacker`/`_defender`) the episode metrics
+    read."""
 
     def gae(traj: Transition, last_value):
         def back(carry, t):
@@ -228,17 +203,13 @@ def make_train(env: JaxEnv, env_params: EnvParams, cfg: PPOConfig,
         metrics["applied"] = cont.astype(jnp.float32)
         return ts, cont, metrics
 
-    def train_step(carry):
-        """One PPO update: rollout cfg.n_steps x cfg.n_envs, GAE,
-        cfg.update_epochs x cfg.n_minibatches minibatch updates."""
-        carry, traj = jax.lax.scan(env_step, carry, None, length=cfg.n_steps)
-        ts, env_state, obs, key = carry
-        _, last_value = net.apply(ts.params, obs)
+    def update_phase(ts, traj: Transition, last_value, key):
+        n_steps, n_envs = traj.action.shape
         advs, targets = gae(traj, last_value)
 
         # flatten (T, N) -> (T*N,)
         flat = jax.tree.map(
-            lambda x: x.reshape((cfg.n_steps * cfg.n_envs,) + x.shape[2:]), traj)
+            lambda x: x.reshape((n_steps * n_envs,) + x.shape[2:]), traj)
         advs_f = advs.reshape(-1)
         targets_f = targets.reshape(-1)
 
@@ -253,10 +224,10 @@ def make_train(env: JaxEnv, env_params: EnvParams, cfg: PPOConfig,
         def epoch(carry, _):
             ts, cont, key, acc = carry
             key, k_perm = jax.random.split(key)
-            mb_size = cfg.n_steps * cfg.n_envs // cfg.n_minibatches
+            mb_size = n_steps * n_envs // cfg.n_minibatches
             perm = jax.random.permutation(
-                k_perm, cfg.n_steps * cfg.n_envs
-            ).reshape(cfg.n_minibatches, mb_size)
+                k_perm, n_steps * n_envs
+            )[:cfg.n_minibatches * mb_size].reshape(cfg.n_minibatches, mb_size)
 
             def one_mb(carry, idx):
                 ts, cont, acc = carry
@@ -309,10 +280,224 @@ def make_train(env: JaxEnv, env_params: EnvParams, cfg: PPOConfig,
             # reserved key: callers pop the accumulator before their
             # float() sweep and summarize it once per telemetry span
             metrics["device_metrics"] = acc
+        return ts, key, metrics
+
+    return update_phase
+
+
+def make_train(env: JaxEnv, env_params: EnvParams, cfg: PPOConfig,
+               reward_transform: Callable | None = None,
+               per_env_params: bool = False,
+               rollout_phase: Callable | None = None):
+    """Build (init_fn, train_step) — both jittable, mesh-shardable.
+
+    reward_transform(reward, info, done) -> shaped reward; the analog of
+    the reference's reward shaping pipeline (ppo.py:217-244 and the
+    wrappers in gym/ocaml/cpr_gym/wrappers.py).
+
+    per_env_params: env_params leaves carry a leading (n_envs,) axis and
+    each env lane runs its own (alpha, gamma, ...) — the batched analog
+    of training under an assumption schedule
+    (wrappers.py:172-242 / cfg alpha lists and ranges).
+
+    rollout_phase(carry) -> (carry, traj): replaces the built-in
+    vmapped `env.step` scan — `make_lane_rollout` steps the resident
+    lane block instead (the serve sampler's unit), carry layout
+    unchanged (ts, env_state, obs, key).
+    """
+    net = ActorCritic(env.n_actions, cfg.hidden)
+    p_axis = 0 if per_env_params else None
+    # in-graph sentinels/stats (CPR_DEVICE_METRICS=1), read at build
+    # time: the off path stays the exact pre-metrics program (acc=None
+    # threads through the scans as an empty pytree)
+    collect = device_metrics.enabled()
+    mspec = device_metrics.ppo_spec() if collect else None
+    update_phase = make_update_phase(net, cfg, collect=collect, mspec=mspec)
+
+    def lr_schedule(count):
+        if not cfg.anneal_lr:
+            return cfg.lr
+        frac = 1.0 - count / (cfg.total_updates * cfg.update_epochs * cfg.n_minibatches)
+        return cfg.lr * jnp.maximum(frac, 0.0)
+
+    tx = optax.chain(
+        optax.clip_by_global_norm(cfg.max_grad_norm),
+        optax.adam(lr_schedule, eps=1e-5),
+    )
+
+    def init_fn(key):
+        key, k_net, k_env = jax.random.split(key, 3)
+        obs_dim = env.observation_length
+        params = net.init(k_net, jnp.zeros((1, obs_dim)))
+        ts = TrainState.create(apply_fn=net.apply, params=params, tx=tx)
+        env_keys = jax.random.split(k_env, cfg.n_envs)
+        env_state, obs = jax.vmap(
+            lambda k, p: env.reset(k, p), in_axes=(0, p_axis)
+        )(env_keys, env_params)
+        return ts, env_state, obs, key
+
+    def env_step(carry, _):
+        ts, env_state, obs, key = carry
+        key, k_act = jax.random.split(key)
+        logits, value = net.apply(ts.params, obs)
+        action = jax.random.categorical(k_act, logits)
+        logp = jax.nn.log_softmax(logits)[jnp.arange(cfg.n_envs), action]
+        env_state, obs2, reward, done, info = jax.vmap(
+            lambda s, a, p: env.step(s, a, p), in_axes=(0, 0, p_axis)
+        )(env_state, action, env_params)
+        if reward_transform is not None:
+            reward = reward_transform(reward, info, done)
+        # auto-reset finished episodes, continuing each env's PRNG stream
+        reset_state, reset_obs = jax.vmap(
+            lambda s, p: env.reset(s.key, p), in_axes=(0, p_axis)
+        )(env_state, env_params)
+        env_state = jax.tree.map(
+            lambda a, b: jnp.where(
+                done.reshape(done.shape + (1,) * (a.ndim - 1)), a, b),
+            reset_state, env_state)
+        obs2 = jnp.where(done[:, None], reset_obs, obs2)
+        t = Transition(obs=obs, action=action, logp=logp, value=value,
+                       reward=reward, done=done, info=info)
+        return (ts, env_state, obs2, key), t
+
+    if rollout_phase is None:
+        def rollout_phase(carry):
+            return jax.lax.scan(env_step, carry, None, length=cfg.n_steps)
+
+    def train_step(carry):
+        """One PPO update: rollout cfg.n_steps x cfg.n_envs, GAE,
+        cfg.update_epochs x cfg.n_minibatches minibatch updates."""
+        carry, traj = rollout_phase(carry)
+        ts, env_state, obs, key = carry
+        _, last_value = net.apply(ts.params, obs)
+        ts, key, metrics = update_phase(ts, traj, last_value, key)
         return (ts, env_state, obs, key), metrics
 
     train_step.metrics_spec = mspec
     return init_fn, train_step
+
+
+def make_lane_rollout(env: JaxEnv, env_params: EnvParams, cfg: PPOConfig,
+                      *, reward_transform: Callable | None = None,
+                      mesh=None, mesh_axis: str = "d"):
+    """A drop-in `rollout_phase` over the resident lane stepper.
+
+    Steps cfg.n_envs lanes with the raw `JaxEnv.step_lanes` unit (the
+    same per-lane program the serve engine's bursts and the gym
+    adapters advance, envs/base.py) instead of the vmapped `env.step`
+    scan — the sampler half of the decoupled loop, trainable in place.
+    With `mesh`, the lane carry is pinned to the partitioned lane axis
+    each step (the NamedSharding layout of parallel/lanes.py), so the
+    whole rollout runs data-parallel under GSPMD — the mesh story
+    ROADMAP item 2 names, now shared between serve and train.
+
+    Action keys are experience streams: per-lane `fold_in` derivations
+    of the carry key (learn/buffer.py), folded again by the step index
+    — never the `split` sequence the legacy rollout consumes, so the
+    two samplers can never alias a key (tests/test_learn.py).
+    """
+    net = ActorCritic(env.n_actions, cfg.hidden)
+    # the raw (unjitted, undonated) lane stepper: it inlines into the
+    # rollout scan, where the enclosing train_step jit owns donation
+    step_raw = type(env).step_lanes.__wrapped__
+    lane_sh = None
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec
+        from cpr_tpu.parallel import check_even_shards
+        check_even_shards(cfg.n_envs, mesh, axis=mesh_axis,
+                          what="cfg.n_envs")
+        lane_sh = NamedSharding(mesh, PartitionSpec(mesh_axis))
+
+    def pin(tree):
+        return jax.tree.map(
+            lambda x: jax.lax.with_sharding_constraint(x, lane_sh), tree)
+
+    def rollout_phase(carry):
+        ts, env_state, obs, key = carry
+        lane_keys = jax.vmap(
+            lambda i: jax.random.fold_in(experience_stream(key), i)
+        )(jnp.arange(cfg.n_envs))
+        no_admit = jnp.zeros(cfg.n_envs, bool)
+        step_all = jnp.ones(cfg.n_envs, bool)
+
+        def body(c, t):
+            env_state, obs = c
+            logits, value = net.apply(ts.params, obs)
+            k_t = jax.vmap(lambda k: jax.random.fold_in(k, t))(lane_keys)
+            action = jax.vmap(jax.random.categorical)(k_t, logits)
+            action = action.astype(jnp.int32)
+            logp = jnp.take_along_axis(
+                jax.nn.log_softmax(logits), action[:, None], axis=1)[:, 0]
+            (env_state, obs2), (_, reward, done, info) = step_raw(
+                env, (env_state, obs), action, no_admit,
+                (env_state, obs), step_all, env_params)
+            if reward_transform is not None:
+                reward = reward_transform(reward, info, done)
+            t_out = Transition(obs=obs, action=action, logp=logp,
+                               value=value, reward=reward, done=done,
+                               info=info)
+            if lane_sh is not None:
+                env_state, obs2 = pin(env_state), pin(obs2)
+            return (env_state, obs2), t_out
+
+        (env_state, obs), traj = jax.lax.scan(
+            body, (env_state, obs), jnp.arange(cfg.n_steps, dtype=jnp.int32))
+        return (ts, env_state, obs, key), traj
+
+    return rollout_phase
+
+
+def make_experience_update(n_actions: int, obs_dim: int, cfg: PPOConfig,
+                           *, reward_transform: Callable | None = None):
+    """The learner half of the decoupled sampler/learner loop
+    (arXiv:1803.02811): a jitted PPO update over externally-fed
+    experience windows (learn/learner.py runs this on batches the
+    serve fleet recorded via learn/buffer.py).
+
+    logp/value are recomputed under the CURRENT params — the fed
+    actions may come from a stale snapshot or even a scripted policy,
+    so the clipped surrogate's ratio is centered at 1 for the learner's
+    own policy; the approximation's staleness is bounded by the swap
+    SLO (docs/LEARNING.md).
+
+    Batch layout (time-major; shapes fixed per process so the program
+    compiles once): obs [T, N, obs_dim] f32, action [T, N] i32,
+    reward/era/erd [T, N] f32, done [T, N] bool, last_obs [N, obs_dim].
+
+    Returns (net, init_fn, update, mspec): init_fn(key) -> TrainState,
+    update(ts, batch, key) -> (ts, key, metrics) with ts DONATED (the
+    learner reassigns its train state every update; one resident copy
+    of params + opt state, the hot-path donation discipline).
+    """
+    net = ActorCritic(int(n_actions), cfg.hidden)
+    collect = device_metrics.enabled()
+    mspec = device_metrics.ppo_spec() if collect else None
+    update_phase = make_update_phase(net, cfg, collect=collect, mspec=mspec)
+    tx = optax.chain(
+        optax.clip_by_global_norm(cfg.max_grad_norm),
+        optax.adam(cfg.lr, eps=1e-5),
+    )
+
+    def init_fn(key):
+        params = net.init(key, jnp.zeros((1, int(obs_dim))))
+        return TrainState.create(apply_fn=net.apply, params=params, tx=tx)
+
+    def update(ts, batch, key):
+        obs, action, done = batch["obs"], batch["action"], batch["done"]
+        logits, value = net.apply(ts.params, obs)
+        logp = jnp.take_along_axis(
+            jax.nn.log_softmax(logits), action[..., None], axis=-1)[..., 0]
+        info = {"episode_reward_attacker": batch["era"],
+                "episode_reward_defender": batch["erd"]}
+        reward = batch["reward"]
+        if reward_transform is not None:
+            reward = reward_transform(reward, info, done)
+        traj = Transition(obs=obs, action=action, logp=logp, value=value,
+                          reward=reward, done=done, info=info)
+        _, last_value = net.apply(ts.params, batch["last_obs"])
+        return update_phase(ts, traj, last_value, key)
+
+    return net, init_fn, jax.jit(update, donate_argnums=0), mspec
 
 
 def maybe_checkify(step_fn):

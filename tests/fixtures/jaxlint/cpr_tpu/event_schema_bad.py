@@ -34,3 +34,7 @@ def report(tele, fn_name, tid):
     # consumer did about it is unactionable)
     tele.event("integrity", artifact="/tmp/ckpt.npz",
                artifact_kind="vi_checkpoint")
+    # finding: missing fingerprint, staleness_s (v17 learn — a swap
+    # that doesn't say WHICH snapshot is serving or how stale the
+    # previous one got breaks the whole correlation chain)
+    tele.event("learn", role="swap", steps=None, batches=None)

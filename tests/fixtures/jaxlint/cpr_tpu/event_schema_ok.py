@@ -35,3 +35,6 @@ def report(tele, fn_name, dt, err, extra, tid):
                artifact_kind="vi_checkpoint", reason="checksum",
                action="quarantined",
                quarantine="/tmp/q")  # extras ride free-form
+    tele.event("learn", role="sample", steps=4096, batches=1,
+               fingerprint=tid, staleness_s=dt,
+               lanes=16, partial=0)  # extras ride free-form

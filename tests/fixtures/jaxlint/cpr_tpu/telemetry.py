@@ -19,4 +19,6 @@ EVENT_FIELDS = {
     "memory": ("scope", "peak_bytes", "source"),
     "integrity": ("artifact", "artifact_kind", "reason",
                       "action"),
+    "learn": ("role", "steps", "batches", "fingerprint",
+              "staleness_s"),
 }

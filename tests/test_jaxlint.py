@@ -86,6 +86,8 @@ def test_event_fields_resolved_cross_module_by_ast():
         "memory": ("scope", "peak_bytes", "source"),
         "integrity": ("artifact", "artifact_kind", "reason",
                       "action"),
+        "learn": ("role", "steps", "batches", "fingerprint",
+                  "staleness_s"),
     }
 
 

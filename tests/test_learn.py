@@ -1,0 +1,462 @@
+"""cpr_tpu.learn: the always-on sampler/learner loop (ISSUE 20).
+
+The load-bearing contracts:
+
+* the experience rings record exactly what the lanes stepped (masked
+  scatter vs a numpy reference, ring wrap unrolled oldest-first) and
+  partial lanes are dropped-and-counted, never padded;
+* sampler key streams are `fold_in` siblings of the lane key — they
+  can alias neither the env-dynamics stream nor the legacy rollout's
+  `split` children, and per-step keys never repeat across drains;
+* hot-swap is zero-drain and bit-deterministic: scripted lanes
+  produce bitwise-identical trajectories whether or not a swap landed
+  between their bursts, an identical snapshot is a no-op, and a
+  structurally different params tree is refused with the typed
+  IntegrityError (never a silent retrace);
+* the learner's PPO update runs on fed windows (donated train state,
+  finite metrics) and its published snapshots round-trip through the
+  sealed loader with matching fingerprints;
+* the v17 `learn` event is schema-typed, the drain report's learn
+  block lifts into both perf-ledger rows, and the staleness gauge
+  feeds the burn-rate alert engine.
+
+Shapes stay tiny (nakamoto max_steps=16, 4 lanes, burst 8) so the
+module reuses a handful of compiled programs.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cpr_tpu import telemetry
+from cpr_tpu.envs import registry
+from cpr_tpu.integrity import IntegrityError
+from cpr_tpu.learn import ROLES, buffer
+from cpr_tpu.learn.feed import decode_batch, encode_batch
+from cpr_tpu.params import make_params
+from cpr_tpu.serve.engine import ResidentEngine
+from cpr_tpu.train.ppo import (ActorCritic, PPOConfig,
+                               make_experience_update, make_lane_rollout,
+                               make_train)
+
+MAX_STEPS = 16
+N_LANES = 4
+BURST = 8
+
+
+@pytest.fixture(scope="module")
+def env():
+    return registry.get_sized("nakamoto", MAX_STEPS)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return make_params(alpha=0.25, gamma=0.5, max_steps=MAX_STEPS)
+
+
+@pytest.fixture(scope="module")
+def net_and_params(env):
+    net = ActorCritic(env.n_actions, (8,))
+    p = net.init(jax.random.PRNGKey(42),
+                 jnp.zeros((1, env.observation_length)))
+    return net, jax.device_get(p)
+
+
+def _swap_engine(env, params, net, p, *, sample=True, fingerprint="fp0"):
+    eng = ResidentEngine(
+        env, params, n_lanes=N_LANES, burst=BURST,
+        swap_policies={"ppo": (lambda w, o: net.apply(w, o)[0], p,
+                               fingerprint)},
+        sample_policies=("ppo",) if sample else (),
+        experience=BURST if sample else 0)
+    eng.start()
+    eng.splice({lane: 100 + lane for lane in range(N_LANES)})
+    return eng
+
+
+# -- ring buffers ----------------------------------------------------------
+
+
+def test_record_matches_numpy_reference_with_ring_wrap():
+    L, C, D = 3, 4, 2
+    keys = jax.vmap(jax.random.PRNGKey)(jnp.arange(L, dtype=jnp.uint32))
+    exp = buffer.init_buffer(jax.vmap(buffer.experience_stream)(keys), C, D)
+    rng = np.random.default_rng(0)
+    n_steps = 2 * C + 1
+    # lane 2 goes dead halfway: its ring must freeze exactly there
+    live_plan = np.ones((n_steps, L), bool)
+    live_plan[C:, 2] = False
+    ref = {k: [[] for _ in range(L)] for k in buffer.FIELDS}
+    for s in range(n_steps):
+        obs = rng.normal(size=(L, D)).astype(np.float32)
+        action = rng.integers(0, 3, L).astype(np.int32)
+        reward = rng.normal(size=L).astype(np.float32)
+        done = rng.random(L) < 0.3
+        era = rng.normal(size=L).astype(np.float32)
+        erd = rng.normal(size=L).astype(np.float32)
+        pol = rng.integers(0, 5, L).astype(np.int32)
+        exp = buffer.record(
+            exp, jnp.asarray(live_plan[s]), jnp.asarray(obs),
+            jnp.asarray(action), jnp.asarray(reward), jnp.asarray(done),
+            {"episode_reward_attacker": jnp.asarray(era),
+             "episode_reward_defender": jnp.asarray(erd)},
+            jnp.asarray(pol))
+        vals = dict(obs=obs, action=action, reward=reward, done=done,
+                    era=era, erd=erd, policy=pol)
+        for lane in range(L):
+            if live_plan[s, lane]:
+                for k in buffer.FIELDS:
+                    ref[k][lane].append(vals[k][lane])
+    host = jax.device_get(exp)
+    # cursors advanced per live step only; t matches (no drain yet)
+    np.testing.assert_array_equal(host["cursor"], [n_steps, n_steps, C])
+    np.testing.assert_array_equal(host["t"], host["cursor"])
+    last_obs = rng.normal(size=(L, D)).astype(np.float32)
+    batch = buffer.consolidate(host, last_obs)
+    # every lane filled (lane 2 exactly at capacity)
+    np.testing.assert_array_equal(batch["lanes"], [0, 1, 2])
+    assert batch["steps"] == 3 * C and batch["partial"] == 0
+    for i, lane in enumerate(batch["lanes"]):
+        for k in buffer.FIELDS:
+            want = np.stack(ref[k][lane][-C:])  # newest C, time order
+            np.testing.assert_array_equal(batch[k][i], want, err_msg=k)
+        np.testing.assert_array_equal(batch["last_obs"][i],
+                                      last_obs[lane])
+
+
+def test_consolidate_drops_and_counts_partial_lanes():
+    L, C, D = 2, 4, 1
+    keys = jax.vmap(jax.random.PRNGKey)(jnp.arange(L, dtype=jnp.uint32))
+    exp = buffer.init_buffer(jax.vmap(buffer.experience_stream)(keys), C, D)
+    live = jnp.asarray([True, False])
+    for s in range(C - 1):  # neither lane fills
+        exp = buffer.record(
+            exp, live, jnp.zeros((L, D)), jnp.zeros(L, jnp.int32),
+            jnp.zeros(L), jnp.zeros(L, bool),
+            {"episode_reward_attacker": jnp.zeros(L),
+             "episode_reward_defender": jnp.zeros(L)},
+            jnp.zeros(L, jnp.int32))
+    batch = buffer.consolidate(jax.device_get(exp), np.zeros((L, D)))
+    assert batch["steps"] == 0 and batch["lanes"].size == 0
+    assert batch["partial"] == 1
+    assert batch["dropped_steps"] == C - 1
+    assert batch["obs"].shape == (0, C, D)
+
+
+def test_experience_stream_cannot_alias_env_or_legacy_keys():
+    key = jax.random.PRNGKey(7)
+    stream = buffer.experience_stream(key)
+    # sibling derivation: distinct from the lane's own env-dynamics
+    # key AND from every child the legacy rollout's split would spend
+    assert not np.array_equal(np.asarray(stream), np.asarray(key))
+    legacy = np.asarray(jax.random.split(key, 16))
+    lanes = np.asarray(jax.vmap(
+        lambda i: jax.random.fold_in(stream, i))(jnp.arange(16)))
+    both = np.concatenate([legacy, lanes])
+    assert len({tuple(k) for k in both}) == 32, "key stream collision"
+
+
+def test_step_keys_never_repeat_across_drains():
+    keys = jax.vmap(jax.random.PRNGKey)(jnp.arange(2, dtype=jnp.uint32))
+    exp = buffer.init_buffer(jax.vmap(buffer.experience_stream)(keys), 2, 1)
+    seen = set()
+    live = jnp.ones(2, bool)
+    for _ in range(5):  # several capacity-2 windows with drains between
+        for _ in range(2):
+            for k in np.asarray(buffer.step_keys(exp)):
+                seen.add(tuple(k))
+            exp = buffer.record(
+                exp, live, jnp.zeros((2, 1)), jnp.zeros(2, jnp.int32),
+                jnp.zeros(2), jnp.zeros(2, bool),
+                {"episode_reward_attacker": jnp.zeros(2),
+                 "episode_reward_defender": jnp.zeros(2)},
+                jnp.zeros(2, jnp.int32))
+        # drain: cursor resets, t keeps counting
+        exp = dict(exp, cursor=jnp.zeros_like(exp["cursor"]))
+    assert len(seen) == 2 * 2 * 5, "step key reused across drains"
+
+
+# -- engine learning plane -------------------------------------------------
+
+
+def test_sampling_is_reproducible_and_varied(env, params, net_and_params):
+    net, p = net_and_params
+    drains = []
+    for _ in range(2):
+        eng = _swap_engine(env, params, net, p)
+        ids = {lane: eng.policy_ids["ppo#sample"]
+               for lane in range(N_LANES)}
+        eng.burst_run(ids, occupancy=1.0)
+        drains.append(eng.drain_experience())
+    a, b = drains
+    assert a is not None and a["steps"] == N_LANES * BURST
+    for k in buffer.FIELDS + ("lanes", "last_obs"):
+        np.testing.assert_array_equal(a[k], b[k], err_msg=k)
+    # sampled, not collapsed: across lanes x steps some actions differ
+    assert len(np.unique(a["action"])) > 1
+
+
+def test_hot_swap_is_bit_deterministic_for_unswapped_lanes(
+        env, params, net_and_params):
+    net, p = net_and_params
+    p2 = jax.device_get(net.init(jax.random.PRNGKey(43),
+                                 jnp.zeros((1, env.observation_length))))
+    a = _swap_engine(env, params, net, p)
+    b = _swap_engine(env, params, net, p)
+    # lanes 0/1 scripted, lanes 2/3 on the swappable net
+    ids = {0: a.policy_ids["honest"], 1: a.policy_ids["honest"],
+           2: a.policy_ids["ppo"], 3: a.policy_ids["ppo#sample"]}
+    out_a = a.burst_run(ids, occupancy=1.0)
+    out_b = b.burst_run(ids, occupancy=1.0)
+    for k in out_a:
+        np.testing.assert_array_equal(
+            np.asarray(out_a[k]), np.asarray(out_b[k]), err_msg=k)
+    # swap lands on B only, between bursts — zero drain, no re-splice
+    swapped = b.swap_policy("ppo", p2, fingerprint="fp2")
+    assert swapped == {"swapped": True, "fingerprint": "fp2"}
+    assert b.policy_fingerprint("ppo") == "fp2"
+    out_a2 = a.burst_run(ids, occupancy=1.0)
+    out_b2 = b.burst_run(ids, occupancy=1.0)
+    for lane in (0, 1):  # scripted lanes: bitwise unperturbed
+        for k in out_a2:
+            np.testing.assert_array_equal(
+                np.asarray(out_a2[k])[lane], np.asarray(out_b2[k])[lane],
+                err_msg=f"{k}[lane {lane}]")
+
+
+def test_identical_snapshot_swap_is_noop(env, params, net_and_params):
+    net, p = net_and_params
+    eng = _swap_engine(env, params, net, p, sample=False)
+    out = eng.swap_policy("ppo", p, fingerprint="fp0")
+    assert out["swapped"] is False and out["reason"] == "identical"
+    assert eng.swaps == 0
+
+
+def test_structural_mismatch_is_refused_typed(env, params, net_and_params):
+    net, p = net_and_params
+    other = ActorCritic(env.n_actions, (12,))  # different hidden width
+    p_bad = jax.device_get(other.init(
+        jax.random.PRNGKey(1), jnp.zeros((1, env.observation_length))))
+    eng = _swap_engine(env, params, net, p, sample=False)
+    with pytest.raises(IntegrityError):
+        eng.swap_policy("ppo", p_bad, fingerprint="fp-bad")
+    assert eng.policy_fingerprint("ppo") == "fp0"  # still serving
+
+
+def test_unknown_swap_name_raises(env, params, net_and_params):
+    net, p = net_and_params
+    eng = _swap_engine(env, params, net, p, sample=False)
+    with pytest.raises(ValueError, match="swappable"):
+        eng.swap_policy("nope", p)
+
+
+def test_server_refuses_protocol_mismatched_snapshot(
+        tmp_path, env, params, net_and_params):
+    from cpr_tpu.serve.server import ServeServer
+    from cpr_tpu.train.driver import export_policy_snapshot
+
+    net, p = net_and_params
+    eng = _swap_engine(env, params, net, p, sample=False)
+    server = ServeServer(eng, protocol="nakamoto")
+    bad = str(tmp_path / "wrong-proto.msgpack")
+    export_policy_snapshot(bad, p, protocol="spar",
+                           n_actions=env.n_actions,
+                           observation_length=env.observation_length,
+                           hidden=[8])
+    out = server._swap_from_path(bad)
+    assert out.get("refused") and not out.get("ok")
+    assert eng.policy_fingerprint("ppo") == "fp0"  # keeps serving
+    good = str(tmp_path / "right-proto.msgpack")
+    meta = export_policy_snapshot(good, p, protocol="nakamoto",
+                                  n_actions=env.n_actions,
+                                  observation_length=env.observation_length,
+                                  hidden=[8])
+    out = server._swap_from_path(good)
+    assert out["ok"] and out["swapped"]
+    assert eng.policy_fingerprint("ppo") == out["fingerprint"]
+    assert server.snapshot_staleness_s() is not None
+
+
+# -- feed codec ------------------------------------------------------------
+
+
+def test_feed_codec_roundtrip(env, params, net_and_params):
+    net, p = net_and_params
+    eng = _swap_engine(env, params, net, p)
+    eng.burst_run({lane: eng.policy_ids["ppo#sample"]
+                   for lane in range(N_LANES)}, occupancy=1.0)
+    batch = eng.drain_experience()
+    back = decode_batch(json.loads(json.dumps(encode_batch(batch))))
+    for k, v in batch.items():
+        if isinstance(v, np.ndarray):
+            np.testing.assert_array_equal(back[k], v, err_msg=k)
+            assert back[k].dtype == v.dtype, k
+        else:
+            assert back[k] == v, k
+
+
+# -- learner ---------------------------------------------------------------
+
+
+def _cfg():
+    return PPOConfig(n_envs=N_LANES, n_steps=BURST, lr=1e-3,
+                     update_epochs=1, n_minibatches=1, hidden=(8,))
+
+
+def test_experience_update_changes_params_finitely(env):
+    cfg = _cfg()
+    net, init_fn, update, _ = make_experience_update(
+        env.n_actions, env.observation_length, cfg)
+    ts = init_fn(jax.random.PRNGKey(0))
+    # donated input: keep a host copy for the comparison
+    before = jax.device_get(ts.params)
+    T, N, D = cfg.n_steps, cfg.n_envs, env.observation_length
+    rng = np.random.default_rng(3)
+    batch = dict(
+        obs=jnp.asarray(rng.normal(size=(T, N, D)), jnp.float32),
+        action=jnp.asarray(rng.integers(0, env.n_actions, (T, N)),
+                           jnp.int32),
+        reward=jnp.asarray(rng.normal(size=(T, N)), jnp.float32),
+        done=jnp.asarray(rng.random((T, N)) < 0.2),
+        era=jnp.asarray(rng.normal(size=(T, N)), jnp.float32),
+        erd=jnp.asarray(rng.normal(size=(T, N)), jnp.float32),
+        last_obs=jnp.asarray(rng.normal(size=(N, D)), jnp.float32))
+    ts, _, metrics = update(ts, batch, jax.random.PRNGKey(1))
+    after = jax.device_get(ts.params)
+    diffs = jax.tree.leaves(jax.tree.map(
+        lambda a, b: float(np.abs(a - b).max()), before, after))
+    assert max(diffs) > 0, "update left params untouched"
+    for k in ("pg_loss", "v_loss", "entropy"):
+        assert np.isfinite(float(metrics[k])), k
+
+
+def test_lane_rollout_drives_make_train(env, params):
+    cfg = _cfg()
+    rollout = make_lane_rollout(env, params, cfg)
+    init_fn, train_step = make_train(env, params, cfg,
+                                     rollout_phase=rollout)
+    carry = init_fn(jax.random.PRNGKey(0))
+    carry, metrics = train_step(carry)
+    assert np.isfinite(float(metrics["pg_loss"]))
+    assert np.isfinite(float(metrics["mean_step_reward"]))
+
+
+def test_learner_pool_update_publish_roundtrip(tmp_path, env, params,
+                                               net_and_params):
+    from cpr_tpu.learn.learner import Learner, params_fingerprint
+    from cpr_tpu.train.driver import load_policy_network
+
+    net, p = net_and_params
+    cfg = _cfg()
+    lr = Learner(env, cfg, protocol="nakamoto",
+                 publish_dir=str(tmp_path), publish_every=1, seed=0)
+    assert lr.fingerprint == params_fingerprint(lr.ts.params)
+    lr.publish()  # seq 0, the pre-traffic baseline
+    eng = _swap_engine(env, params, net, p)
+    eng.burst_run({lane: eng.policy_ids["ppo#sample"]
+                   for lane in range(N_LANES)}, occupancy=1.0)
+    fed = decode_batch(encode_batch(eng.drain_experience()))
+    before = lr.fingerprint
+    reply = lr.ingest(fed)
+    assert reply["updated"] == 1 and reply["pool"] == 0
+    assert lr.updates == 1 and lr.publishes == 2
+    assert lr.fingerprint != before
+    latest = json.loads(
+        (tmp_path / "latest.json").read_text())
+    assert latest["seq"] == 1
+    _, p_pub, meta = load_policy_network(latest["path"])
+    assert meta["payload_sha256"] == latest["fingerprint"] \
+        == lr.fingerprint
+    # the published params hot-swap cleanly into the serving engine
+    out = eng.swap_policy("ppo", p_pub,
+                          fingerprint=meta["payload_sha256"])
+    assert out["swapped"] and eng.swaps == 1
+
+
+def test_learner_refuses_mismatched_window(tmp_path, env):
+    from cpr_tpu.learn.learner import Learner
+
+    lr = Learner(env, _cfg(), protocol="nakamoto",
+                 publish_dir=str(tmp_path))
+    D = env.observation_length
+    bad = dict(lanes=np.zeros(1, np.int32),
+               obs=np.zeros((1, BURST + 1, D), np.float32),
+               action=np.zeros((1, BURST + 1), np.int32),
+               reward=np.zeros((1, BURST + 1), np.float32),
+               done=np.zeros((1, BURST + 1), bool),
+               era=np.zeros((1, BURST + 1), np.float32),
+               erd=np.zeros((1, BURST + 1), np.float32),
+               policy=np.zeros((1, BURST + 1), np.int32),
+               last_obs=np.zeros((1, D), np.float32),
+               steps=BURST + 1, partial=0, dropped_steps=0)
+    with pytest.raises(ValueError, match="window length"):
+        lr.ingest(bad)
+
+
+# -- observability ---------------------------------------------------------
+
+
+def test_learn_event_is_schema_typed():
+    assert telemetry.SCHEMA_VERSION == 17
+    assert telemetry.EVENT_FIELDS["learn"] == (
+        "role", "steps", "batches", "fingerprint", "staleness_s")
+    assert ROLES == ("sample", "feed", "update", "publish", "swap")
+
+
+def test_ledger_lifts_learn_rows(tmp_path):
+    from cpr_tpu.perf.ledger import iter_trace_rows, metric_direction
+
+    trace = tmp_path / "serve.jsonl"
+    lines = [
+        dict(kind="manifest", backend="cpu", run="r1",
+             config=dict(entry="serve", protocol="nakamoto")),
+        dict(kind="event", name="serve", action="report",
+             detail=dict(steps_per_sec=10.0,
+                         learn=dict(samples=512, samples_per_sec=64.0,
+                                    snapshot_staleness_s=1.5, swaps=3))),
+    ]
+    trace.write_text("\n".join(json.dumps(x) for x in lines) + "\n")
+    rows = {r["metric"]: r for r, _ in iter_trace_rows(str(trace))}
+    assert rows["learn_samples_per_sec"]["value"] == 64.0
+    assert rows["learn_snapshot_staleness_s"]["value"] == 1.5
+    assert metric_direction("learn_snapshot_staleness_s") == "lower"
+    assert metric_direction("learn_samples_per_sec") == "higher"
+
+
+def test_staleness_gauge_feeds_alert_engine():
+    from cpr_tpu.monitor.alerts import AlertEngine
+
+    clock = [0.0]
+    eng = AlertEngine(1.0, staleness_slo_s=5.0,
+                      windows=((60.0, "page", 1.0),),
+                      now_fn=lambda: clock[0])
+    eng.record_staleness(2.0)
+    assert eng.evaluate() == []  # under budget
+    eng.record_staleness(None)  # dropped at the door
+    clock[0] = 1.0
+    eng.record_staleness(20.0)  # gauge: latest reading judges alone
+    fired = eng.evaluate()
+    assert [a["signal"] for a in fired] == ["snapshot_staleness"]
+    assert fired[0]["value"] == 20.0 and fired[0]["budget"] == 5.0
+    # engines without the budget never see the signal
+    off = AlertEngine(1.0, windows=((60.0, "page", 1.0),),
+                      now_fn=lambda: 0.0)
+    off.record_staleness(1e9)
+    assert off.evaluate() == []
+
+
+def test_heartbeat_and_report_carry_learning_fields(
+        env, params, net_and_params):
+    from cpr_tpu.serve.server import ServeServer
+
+    net, p = net_and_params
+    eng = _swap_engine(env, params, net, p)
+    server = ServeServer(eng, protocol="nakamoto")
+    assert server.snapshot_staleness_s() is not None
+    # an engine without swap policies has no staleness gauge
+    plain = ResidentEngine(env, params, n_lanes=N_LANES, burst=BURST)
+    assert ServeServer(plain).snapshot_staleness_s() is None

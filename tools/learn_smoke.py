@@ -1,0 +1,475 @@
+"""Always-on-learning smoke (`make learn-smoke`).
+
+Proves the cpr_tpu/learn contract end-to-end on CPU: a supervised
+learner child and serve child wired into the closed sampler/learner
+loop, under concurrent client flood, with zero-drain policy hot-swap
+observable from the client side:
+
+  1  an in-process bit-determinism check: two identical engines run a
+     mixed scripted+net burst, one hot-swaps its net mid-run, and the
+     scripted lanes stay bitwise identical — the swap perturbed only
+     the swapped table entry (params-as-burst-argument, no retrace);
+  2  launch `python -m cpr_tpu.learn.learner` under
+     `supervisor.run_child`; its seq-0 snapshot (untrained net,
+     published before the socket opens) becomes the server's
+     `--policy-snapshot`, so the revenue baseline is the untrained
+     policy by construction;
+  3  launch `python -m cpr_tpu.serve.server` with `--learner` (feed
+     drained experience) and `--learn-watch` (hot-swap on new
+     `latest.json`) pointing at the learner, plus
+     `--staleness-slo-s` so the staleness gauge alert plane is armed;
+  4  flood: a greedy-only baseline wave, then mixed waves of
+     `ppo#sample` (exploration), `honest` (demonstrations — every
+     live lane records experience, so scripted lanes teach too) and
+     greedy `ppo` measurement episodes.  Every `episode.run` reply
+     carries the fingerprint that served it, so revenue windows group
+     by snapshot exactly.  The flood keeps going until the serving
+     fingerprint has rotated through >= 2 published swaps AND the
+     mean greedy relative_reward under the newest fingerprint beats
+     the untrained-baseline window by CPR_LEARN_MIN_GAIN — training
+     measurably improved the serving policy, with zero client hangs
+     and zero refused sessions along the way;
+  5  SIGTERM the server (drain report must carry the learn block and
+     policy fingerprint), then the learner (final publish, exit 0);
+     both traces and their concatenation must pass `trace_summary
+     --validate --expect learn`, the server trace must carry sample /
+     feed / >= 2 swap learn events and heartbeats with
+     `policy_fingerprint` + `snapshot_staleness_s`, and the drain
+     report's `learn_samples_per_sec` / `learn_snapshot_staleness_s`
+     rows must ingest into the perf ledger and clear the
+     direction-aware regression gate.
+
+Usage: python tools/learn_smoke.py [workdir]   (default /tmp/...)
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+ROOT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+sys.path.insert(0, ROOT)
+
+from cpr_tpu import supervisor, telemetry  # noqa: E402
+from cpr_tpu.perf.gate import gate_row, gate_summary  # noqa: E402
+from cpr_tpu.perf.ledger import Ledger  # noqa: E402
+from cpr_tpu.serve.protocol import ServeClient  # noqa: E402
+
+# episode length == burst == learner window: a lane admitted at a
+# burst boundary completes exactly at the burst's last step, and every
+# drained window is exactly one update-ready experience window
+MAX_STEPS = 64
+LANES = 16
+BURST = 64
+HIDDEN = 16
+ALPHA = 0.45
+GAMMA = 0.5
+LR = 3e-3
+N_WORKERS = 16
+BASELINE_EPISODES_PER_WORKER = 6
+WAVE_CYCLE = ("ppo#sample", "honest", "ppo", "ppo#sample")
+MAX_WAVES = 30
+TAIL_WINDOW = 32  # greedy episodes in the trained-revenue window
+MIN_SWAPS = 2
+READY_TIMEOUT_S = 300.0
+WALL_S = 900.0
+
+
+def _log(msg):
+    print(f"learn-smoke: {msg}", file=sys.stderr)
+
+
+def _learner_cmd(workdir):
+    return [sys.executable, "-m", "cpr_tpu.learn.learner",
+            "--protocol", "nakamoto", "--max-steps", str(MAX_STEPS),
+            "--publish-dir", os.path.join(workdir, "published"),
+            "--hidden", str(HIDDEN), "--lr", str(LR),
+            "--n-envs", str(LANES), "--n-steps", str(BURST),
+            "--publish-every", "1", "--seed", "0",
+            "--ready-file", os.path.join(workdir, "learner_ready.json")]
+
+
+def _server_cmd(workdir, snap, learner_port):
+    return [sys.executable, "-m", "cpr_tpu.serve.server",
+            "--protocol", "nakamoto", "--max-steps", str(MAX_STEPS),
+            "--lanes", str(LANES), "--burst", str(BURST),
+            "--alpha", str(ALPHA), "--gamma", str(GAMMA),
+            "--policy-snapshot", snap,
+            "--learner", f"127.0.0.1:{learner_port}",
+            "--learn-watch", os.path.join(workdir, "published"),
+            "--staleness-slo-s", "60", "--heartbeat-s", "0.5",
+            "--ready-file", os.path.join(workdir, "server_ready.json")]
+
+
+def _child_env(workdir, trace):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", CPR_TELEMETRY=trace,
+               CPR_TPU_CACHE=os.path.join(workdir, "cache"))
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def _wait_ready(path, proc, what):
+    deadline = time.time() + READY_TIMEOUT_S
+    while time.time() < deadline:
+        if proc.poll() is not None:
+            raise SystemExit(f"{what} child exited rc={proc.returncode} "
+                             f"before becoming ready")
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            time.sleep(0.25)
+    raise SystemExit(f"{what} not ready within {READY_TIMEOUT_S:.0f}s")
+
+
+def _swap_bit_determinism():
+    """Two identical engines, mixed scripted+net lanes; B hot-swaps
+    its net between bursts; scripted lanes must stay bitwise equal to
+    A's — the ISSUE-20 zero-perturbation guarantee, asserted on real
+    burst outputs rather than trusted from the unit suite."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from cpr_tpu.envs import registry
+    from cpr_tpu.params import make_params
+    from cpr_tpu.serve.engine import ResidentEngine
+    from cpr_tpu.train.ppo import ActorCritic
+
+    n_lanes, burst, steps = 4, 16, 16
+    env = registry.get_sized("nakamoto", steps)
+    params = make_params(alpha=ALPHA, gamma=GAMMA, max_steps=steps)
+    net = ActorCritic(env.n_actions, (8,))
+    p0 = jax.device_get(net.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, env.observation_length))))
+    p1 = jax.device_get(net.init(
+        jax.random.PRNGKey(1), jnp.zeros((1, env.observation_length))))
+
+    def build():
+        eng = ResidentEngine(
+            env, params, n_lanes=n_lanes, burst=burst,
+            swap_policies={"ppo": (lambda w, o: net.apply(w, o)[0],
+                                   p0, "fp0")},
+            sample_policies=("ppo",), experience=burst)
+        eng.start()
+        eng.splice({lane: 100 + lane for lane in range(n_lanes)})
+        return eng
+
+    a, b = build(), build()
+    ids = {0: a.policy_ids["honest"], 1: a.policy_ids["honest"],
+           2: a.policy_ids["ppo"], 3: a.policy_ids["ppo#sample"]}
+    a.burst_run(ids, occupancy=1.0)
+    b.burst_run(ids, occupancy=1.0)
+    swapped = b.swap_policy("ppo", p1, fingerprint="fp1")
+    if swapped != {"swapped": True, "fingerprint": "fp1"}:
+        raise SystemExit(f"hot-swap did not land: {swapped}")
+    out_a = a.burst_run(ids, occupancy=1.0)
+    out_b = b.burst_run(ids, occupancy=1.0)
+    for lane in (0, 1):  # scripted lanes: bitwise unperturbed
+        for k in out_a:
+            va = np.asarray(out_a[k])[lane]
+            vb = np.asarray(out_b[k])[lane]
+            if not np.array_equal(va, vb):
+                raise SystemExit(
+                    f"hot-swap perturbed scripted lane {lane} "
+                    f"field {k!r}: swap is not bit-deterministic")
+
+
+def _episode(client, policy):
+    r = client.request("episode.run", policy=policy)
+    assert r.get("ok"), f"episode.run({policy}): {r}"
+    return r
+
+
+def _wave_worker(port, policies):
+    """One persistent connection, sequential episodes; returns
+    (fingerprint, relative_reward) for the greedy measurement runs."""
+    out = []
+    with ServeClient("127.0.0.1", port) as c:
+        for policy in policies:
+            r = _episode(c, policy)
+            if policy == "ppo":
+                out.append((r["policy_fingerprint"],
+                            r["episode"]["relative_reward"]))
+    return out
+
+
+def _run_wave(port, policies):
+    results = []
+    with ThreadPoolExecutor(max_workers=N_WORKERS) as pool:
+        jobs = [pool.submit(_wave_worker, port, policies)
+                for _ in range(N_WORKERS)]
+        for j in jobs:
+            results.extend(j.result())
+    return results
+
+
+def _windows(measured):
+    """Group greedy (fingerprint, revenue) pairs by fingerprint in
+    first-seen order — the revenue-vs-snapshot windows."""
+    order, groups = [], {}
+    for fp, rev in measured:
+        if fp not in groups:
+            order.append(fp)
+            groups[fp] = []
+        groups[fp].append(rev)
+    return [(fp, groups[fp]) for fp in order]
+
+
+def _flood_until_improved(port, min_gain):
+    """Baseline wave on the untrained snapshot, then mixed learn waves
+    until a trailing all-post-swap greedy window measurably beats it.
+
+    The improvement window is the TAIL_WINDOW newest greedy episodes
+    rather than the newest single fingerprint: with --publish-every 1
+    the serving fingerprint can rotate every burst, so no one
+    fingerprint need accumulate a statistically useful window."""
+    measured = _run_wave(port, ("ppo",) * BASELINE_EPISODES_PER_WORKER)
+    base_fp = measured[0][0]
+    # a swap may already land mid-wave; the baseline is strictly the
+    # episodes the untrained seq-0 snapshot served
+    base = [r for fp, r in measured if fp == base_fp]
+    base_mean = sum(base) / len(base)
+    _log(f"baseline window: {len(base)}/{len(measured)} greedy "
+         f"episodes under {base_fp[:12]} mean relative_reward "
+         f"{base_mean:.4f}")
+
+    for wave in range(1, MAX_WAVES + 1):
+        measured.extend(_run_wave(port, WAVE_CYCLE))
+        wins = _windows(measured)
+        tail = measured[-TAIL_WINDOW:]
+        mean = sum(r for _, r in tail) / len(tail)
+        _log(f"wave {wave}: {len(wins)} fingerprint windows seen, "
+             f"trailing {len(tail)} greedy episodes mean {mean:.4f} "
+             f"(baseline {base_mean:.4f})")
+        if (len(wins) >= MIN_SWAPS + 1
+                and len(tail) >= TAIL_WINDOW
+                and all(fp != base_fp for fp, _ in tail)
+                and mean >= base_mean + min_gain):
+            return wins, base_mean, mean
+    raise SystemExit(
+        f"revenue never improved by {min_gain} over the untrained "
+        f"baseline across {MAX_WAVES} waves "
+        f"(windows: {[(fp[:12], len(r)) for fp, r in _windows(measured)]})")
+
+
+def _learn_events(trace, role=None):
+    out = []
+    with open(trace) as f:
+        for line in f:
+            try:
+                e = json.loads(line)
+            except ValueError:
+                continue
+            if e.get("kind") == "event" and e.get("name") == "learn" \
+                    and (role is None or e.get("role") == role):
+                out.append(e)
+    return out
+
+
+def _serve_events(trace, action):
+    out = []
+    with open(trace) as f:
+        for line in f:
+            try:
+                e = json.loads(line)
+            except ValueError:
+                continue
+            if e.get("kind") == "event" and e.get("name") == "serve" \
+                    and e.get("action") == action:
+                out.append(e)
+    return out
+
+
+def _validate_stream(trace, expect):
+    tool = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "trace_summary.py")
+    r = subprocess.run(
+        [sys.executable, tool, trace, "--validate", "--expect", expect],
+        capture_output=True, text=True)
+    if r.returncode != 0:
+        sys.stderr.write(r.stdout + r.stderr)
+        raise SystemExit(f"telemetry validation failed for {trace}")
+
+
+def _check_server_trace(trace):
+    swaps = _learn_events(trace, "swap")
+    if len(swaps) < MIN_SWAPS:
+        raise SystemExit(f"only {len(swaps)} swap learn events in the "
+                         f"server trace (need >= {MIN_SWAPS})")
+    for role in ("sample", "feed"):
+        if not _learn_events(trace, role):
+            raise SystemExit(f"no {role!r} learn event in server trace")
+    hb = _serve_events(trace, "heartbeat")
+    beat = (hb[-1].get("detail") or {}) if hb else {}
+    if "policy_fingerprint" not in beat \
+            or "snapshot_staleness_s" not in beat:
+        raise SystemExit("heartbeat lacks policy_fingerprint / "
+                         "snapshot_staleness_s")
+    if not isinstance(beat["snapshot_staleness_s"], (int, float)):
+        raise SystemExit(f"heartbeat staleness not numeric: {beat}")
+    reports = _serve_events(trace, "report")
+    detail = (reports[-1].get("detail") or {}) if reports else {}
+    learn = detail.get("learn")
+    if not isinstance(learn, dict) or not learn.get("samples"):
+        raise SystemExit(f"drain report carries no learn block: "
+                         f"{sorted(detail)}")
+    if not detail.get("policy_fingerprint"):
+        raise SystemExit("drain report lacks policy_fingerprint")
+    return len(swaps), learn
+
+
+def _check_learner_trace(trace):
+    updates = _learn_events(trace, "update")
+    publishes = _learn_events(trace, "publish")
+    # seq-0 plus one per swap the server applied, at minimum
+    if len(updates) < MIN_SWAPS or len(publishes) < MIN_SWAPS + 1:
+        raise SystemExit(f"learner trace thin: {len(updates)} updates, "
+                         f"{len(publishes)} publishes")
+    return len(updates), len(publishes)
+
+
+# ledger rows the drain report must bank; staleness gates with the
+# flipped lower-is-better band (cpr_tpu/perf/gate.py)
+_REQUIRED_METRICS = ("learn_samples_per_sec", "learn_snapshot_staleness_s")
+
+
+def _bank_and_gate(workdir, trace):
+    ledger = Ledger(os.path.join(workdir, "perf_ledger.jsonl"))
+    n = ledger.ingest_trace(trace)
+    records = ledger.records()
+    results = []
+    for metric in _REQUIRED_METRICS:
+        rows = [r for r in records if r.get("metric") == metric]
+        if not rows:
+            raise SystemExit(f"no {metric} row reached the ledger")
+        results.extend(gate_row(r, records) for r in rows)
+    summary = gate_summary(results)
+    if not summary["ok"]:
+        raise SystemExit(f"learn perf gate failed: {results}")
+    return n, summary
+
+
+def main():
+    work = sys.argv[1] if len(sys.argv) > 1 else "/tmp/cpr-learn-smoke"
+    os.makedirs(work, exist_ok=True)
+    server_trace = os.path.join(work, "server.jsonl")
+    learner_trace = os.path.join(work, "learner.jsonl")
+    client_trace = os.path.join(work, "client.jsonl")
+    for p in (server_trace, learner_trace, client_trace,
+              os.path.join(work, "learner_ready.json"),
+              os.path.join(work, "server_ready.json")):
+        if os.path.exists(p):
+            os.remove(p)
+    telemetry.configure(client_trace)
+    telemetry.current().manifest(dict(role="learn-smoke-client"))
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    _swap_bit_determinism()
+    _log("hot-swap bit-determinism holds on scripted lanes")
+
+    boxes = {"learner": {}, "server": {}}
+    threads = {}
+
+    def launch(name, cmd, trace):
+        started = threading.Event()
+        box = boxes[name]
+
+        def on_start(proc):
+            box["proc"] = proc
+            started.set()
+
+        def supervise():
+            box["attempt"] = supervisor.run_child(
+                cmd, wall_timeout_s=WALL_S, quiet_s=30.0,
+                heartbeat_s=1.0, env=_child_env(work, trace), cwd=ROOT,
+                on_start=on_start)
+
+        threads[name] = threading.Thread(target=supervise)
+        threads[name].start()
+        if not started.wait(30.0):
+            raise SystemExit(f"run_child never spawned the {name}")
+        return box["proc"]
+
+    def reap(name):
+        proc = boxes[name].get("proc")
+        if proc is not None and proc.poll() is None:
+            proc.send_signal(signal.SIGTERM)
+        threads[name].join(120.0)
+        if threads[name].is_alive():
+            raise SystemExit(f"{name} child did not drain within 120s")
+        attempt = boxes[name]["attempt"]
+        if attempt.status != "ok" or attempt.rc != 0:
+            raise SystemExit(f"{name} child did not exit cleanly "
+                             f"(status={attempt.status} rc={attempt.rc})")
+
+    try:
+        proc = launch("learner", _learner_cmd(work), learner_trace)
+        lready = _wait_ready(os.path.join(work, "learner_ready.json"),
+                             proc, "learner")
+        snap0 = os.path.join(work, "published", "snapshot-000000.msgpack")
+        if not os.path.exists(snap0):
+            raise SystemExit(f"learner ready but no seq-0 snapshot "
+                             f"at {snap0}")
+        _log(f"learner ready on port {lready['port']} "
+             f"(seq-0 snapshot published)")
+
+        proc = launch("server", _server_cmd(work, snap0, lready["port"]),
+                      server_trace)
+        sready = _wait_ready(os.path.join(work, "server_ready.json"),
+                             proc, "server")
+        port = sready["port"]
+        _log(f"server ready on port {port} (pid {sready['pid']}), "
+             f"serving the untrained seq-0 snapshot")
+
+        min_gain = float(os.environ.get("CPR_LEARN_MIN_GAIN", "0.01"))
+        wins, base_mean, final_mean = _flood_until_improved(port, min_gain)
+        _log(f"revenue improved across {len(wins) - 1} hot-swaps: "
+             f"{base_mean:.4f} -> {final_mean:.4f} "
+             f"(+{final_mean - base_mean:.4f}, floor +{min_gain})")
+    except BaseException:
+        # don't leave orphans burning the wall budget
+        for box in boxes.values():
+            proc = box.get("proc")
+            if proc is not None and proc.poll() is None:
+                proc.kill()
+        raise
+    # drain order matters: the server's drain closes the feeder, then
+    # the learner's drain runs its final publish on a quiet socket
+    reap("server")
+    reap("learner")
+    _log("SIGTERM drained both children cleanly (exit 0)")
+
+    n_swaps, learn_block = _check_server_trace(server_trace)
+    n_updates, n_publishes = _check_learner_trace(learner_trace)
+    _log(f"traces: {n_swaps} swaps / {learn_block['samples']} samples "
+         f"fed on the serve side; {n_updates} updates / "
+         f"{n_publishes} publishes on the learner side")
+    telemetry.configure(None)  # close the client sink before merging
+    _validate_stream(server_trace, "serve,learn")
+    _validate_stream(learner_trace, "learn")
+    from cpr_tpu import resilience
+
+    merged = os.path.join(work, "merged.jsonl")
+    resilience.atomic_write_text(merged, "".join(
+        open(p).read()
+        for p in (server_trace, learner_trace, client_trace)))
+    _validate_stream(merged, "serve,learn,request")
+    _log("trace validation clean (server, learner, merged)")
+
+    n_banked, summary = _bank_and_gate(work, server_trace)
+    print(f"learn-smoke: PASS (revenue {base_mean:.4f} -> "
+          f"{final_mean:.4f} across {n_swaps} zero-drain hot-swaps; "
+          f"{n_updates} learner updates on "
+          f"{learn_block['samples']} fleet-sampled steps; banked "
+          f"{n_banked} ledger rows; gate {summary})")
+
+
+if __name__ == "__main__":
+    main()
